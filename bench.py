@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Headline benchmark: ResNet-50 training throughput on TPU.
+
+The reference's benchmark workload is tf_cnn_benchmarks ResNet-50
+(`--model=resnet50 --batch_size=32 --variable_update=parameter_server`,
+tf-controller-examples/tf-cnn/create_job_specs.py:101-121) with synthetic
+data. This is the same workload on the TPU-native stack: bf16 ResNet-50
+v1.5, pjit train step, synthetic input (input pipeline off the critical
+path, matching the tf_cnn_benchmarks synthetic-data methodology).
+
+Prints ONE JSON line:
+  {"metric": "resnet50_train_mfu", "value": <mfu>, "unit": "fraction",
+   "vs_baseline": <mfu / 0.60>, ...extras}
+
+vs_baseline is measured against the north-star target of 60% MFU
+(BASELINE.json: "ResNet-50 ... at >=60% MFU"), since the reference
+publishes no absolute numbers (BASELINE.md).
+"""
+
+import argparse
+import json
+import logging
+import sys
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=256,
+                   help="global batch (per-chip here; reference used 32/GPU worker)")
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--image-size", type=int, default=224)
+    p.add_argument("--model", default="resnet50")
+    args = p.parse_args()
+
+    logging.basicConfig(level=logging.WARNING)
+
+    import jax
+
+    from kubeflow_tpu.parallel.mesh import MeshSpec
+    from kubeflow_tpu.runtime.metrics import StepMeter, peak_flops
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    devs = jax.devices()
+    kind = devs[0].device_kind
+    on_tpu = devs[0].platform in ("tpu", "axon")
+
+    cfg = TrainConfig.from_dict(dict(
+        model=args.model,
+        task="classification",
+        global_batch=args.batch,
+        image_size=args.image_size,
+        num_classes=1000,
+        mesh=MeshSpec(data=len(devs)),
+        optimizer="sgdm",
+        learning_rate=0.1,
+        total_steps=args.steps,
+        warmup_steps=5,
+        log_every=10**9,  # quiet
+    ))
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    data = trainer.data_iter()
+    from kubeflow_tpu.runtime.data import shard_batch
+
+    # Resident device batch: synthetic-data methodology measures device
+    # throughput, not host->device link speed.
+    batch = shard_batch(next(data), next(iter(jax.tree.leaves(trainer.batch_shardings))))
+
+    # warmup (includes compile; at least one step so `m` is bound and the
+    # timed loop never pays compile). float() forces a device->host
+    # readback, the only reliable sync point through remote-exec tunnels.
+    for _ in range(max(1, args.warmup)):
+        state, m = trainer.train_step(state, batch)
+    _ = float(m["loss"])
+
+    # Chained timing: dispatch all steps (each depends on the previous
+    # state), sync once at the end. Avoids paying tunnel RTT per step.
+    import time
+
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        state, m = trainer.train_step(state, batch)
+    final_loss = float(m["loss"])
+    elapsed = time.perf_counter() - t0
+
+    meter = StepMeter(trainer.flops_per_step(), len(devs), kind)
+    meter._times.append(elapsed / args.steps)
+    mfu = meter.mfu
+    assert final_loss == final_loss, "loss is NaN"
+    result = {
+        "metric": f"{args.model}_train_mfu",
+        "value": round(mfu, 4),
+        "unit": "fraction",
+        "vs_baseline": round(mfu / 0.60, 4),
+        "images_per_sec": round(meter.throughput(args.batch), 1),
+        "step_time_ms": round(meter.step_time * 1e3, 2),
+        "global_batch": args.batch,
+        "device": kind,
+        "n_devices": len(devs),
+        "peak_flops_per_chip": peak_flops(kind),
+        "on_tpu": on_tpu,
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

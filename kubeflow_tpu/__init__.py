@@ -1,0 +1,23 @@
+"""kubeflow_tpu — a TPU-native ML platform framework.
+
+A ground-up re-design of the capability surface of the Kubeflow mono-repo
+(reference: MartinForReal/kubeflow) for Cloud TPU:
+
+- ``kubeflow_tpu.parallel``  — device meshes, shardings, distributed init
+  (the TPU-native replacement for TF_CONFIG gRPC parameter-server and
+  OpenMPI/NCCL ring-allreduce; reference: tf-controller-examples/tf-cnn/
+  launcher.py:68-80, components/openmpi-controller/controller/controller.py).
+- ``kubeflow_tpu.ops``       — Pallas TPU kernels (flash attention, ring
+  attention) and XLA-collective building blocks.
+- ``kubeflow_tpu.models``    — flax model zoo (ResNet, decoder LM, BERT, MoE);
+  the tf-cnn / tf-serving payload analogues.
+- ``kubeflow_tpu.runtime``   — jaxrt: in-pod launcher, trainer loop, MFU
+  meter, orbax checkpointing, Prometheus metrics.
+Planned (build order per SURVEY.md §7; not yet in tree):
+``control`` (JAXJob/Notebook/Profile/Tensorboard controllers, PodDefault
+webhook, KFAM, gatekeeper over an in-memory fake API server), ``tpctl``
+(bootstrap/kfctl-analogue deployment engine), ``serving`` (TF-Serving REST
+contract), ``tune`` (StudyJob-style sweeps).
+"""
+
+__version__ = "0.1.0"

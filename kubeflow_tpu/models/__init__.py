@@ -1,0 +1,8 @@
+"""Model zoo: the training/serving payloads the reference platform ships
+as opaque container images (tf_cnn_benchmarks ResNet-50, TF-Serving BERT)
+rebuilt as first-class JAX models with sharding annotations.
+"""
+
+from kubeflow_tpu.models.registry import get_model, list_models, register_model
+
+__all__ = ["get_model", "list_models", "register_model"]

@@ -1,0 +1,121 @@
+"""BERT-style bidirectional encoder — the TF-Serving parity payload.
+
+The reference's serving E2E asserts the TF-Serving REST contract against
+an mnist/BERT model (testing/test_tf_serving.py:105-133; BASELINE.json
+configs[4] "tf_serving BERT-base inference → JAX/TPU serving pod"). This
+encoder is that payload: classification or embedding head, bf16 on the
+MXU, served by kubeflow_tpu.serving.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.models.registry import register_model
+from kubeflow_tpu.models.transformer import RMSNorm, shard, HIDDEN_SPEC
+from kubeflow_tpu.ops.attention import reference_attention
+from kubeflow_tpu.parallel.mesh import AXIS_FSDP, AXIS_MODEL
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 512
+    num_classes: int = 2          # classification head size
+    dtype: Any = jnp.bfloat16
+
+
+class EncoderBlock(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        h = cfg.n_heads
+        d_head = cfg.d_model // h
+        init = nn.initializers.normal(0.02)
+        part = nn.with_partitioning
+
+        y = RMSNorm(dtype=cfg.dtype, name="ln_attn")(x)
+        qkv = nn.DenseGeneral(
+            (3, h, d_head), use_bias=False, dtype=cfg.dtype,
+            kernel_init=part(init, (AXIS_FSDP, None, AXIS_MODEL, None)), name="qkv",
+        )(y)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        att = reference_attention(q, k, v, causal=False, segment_ids=mask)
+        att = nn.DenseGeneral(
+            cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
+            kernel_init=part(init, (AXIS_MODEL, None, AXIS_FSDP)), name="o",
+        )(att)
+        x = x + att
+
+        y = RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x)
+        y = nn.DenseGeneral(
+            cfg.d_ff, use_bias=True, dtype=cfg.dtype,
+            kernel_init=part(init, (AXIS_FSDP, AXIS_MODEL)), name="fc1",
+        )(y)
+        y = nn.gelu(y)
+        y = nn.DenseGeneral(
+            cfg.d_model, use_bias=True, dtype=cfg.dtype,
+            kernel_init=part(init, (AXIS_MODEL, AXIS_FSDP)), name="fc2",
+        )(y)
+        return x + y
+
+
+class BertEncoder(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        del train
+        emb = self.param(
+            "embedding",
+            nn.with_partitioning(nn.initializers.normal(1.0), (AXIS_MODEL, AXIS_FSDP)),
+            (cfg.vocab_size, cfg.d_model), jnp.float32,
+        )
+        pos_emb = self.param(
+            "pos_embedding", nn.initializers.normal(0.02),
+            (cfg.max_seq_len, cfg.d_model), jnp.float32,
+        )
+        L = tokens.shape[1]
+        x = jnp.asarray(emb, cfg.dtype)[tokens] + jnp.asarray(pos_emb[:L], cfg.dtype)
+        x = shard(x, HIDDEN_SPEC)
+        # attention mask from padding (token 0 = [PAD]); segment ids 1/0
+        mask = (tokens != 0).astype(jnp.int32)
+        for i in range(cfg.n_layers):
+            x = EncoderBlock(cfg, name=f"layer_{i}")(x, mask)
+        x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # [CLS] pooling (position 0) → classifier, f32
+        cls = x[:, 0].astype(jnp.float32)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(cls)
+
+
+def _build(**kw) -> BertEncoder:
+    fields = {f.name for f in dataclasses.fields(BertConfig)}
+    unknown = set(kw) - fields
+    if unknown:
+        raise ValueError(f"unknown bert kwargs {sorted(unknown)}")
+    return BertEncoder(BertConfig(**kw))
+
+
+@register_model("bert-test")
+def bert_test(**kw):
+    base = dict(vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=128,
+                max_seq_len=128, num_classes=2)
+    base.update(kw)
+    return _build(**base)
+
+
+@register_model("bert-base")
+def bert_base(**kw):
+    return _build(**kw)

@@ -1,0 +1,44 @@
+"""Tiny model registry keyed by name, mirroring how the reference selects
+payloads by image+flags (tf-controller-examples/tf-cnn/create_job_specs.py:101
+`--model=resnet50`). Trainer configs refer to models by these names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+_REGISTRY: dict[str, Callable[..., Any]] = {}
+
+
+def register_model(name: str):
+    def deco(fn):
+        if name in _REGISTRY:
+            raise ValueError(f"model {name!r} already registered")
+        _REGISTRY[name] = fn
+        return fn
+
+    return deco
+
+
+def _load_zoo() -> None:
+    """Import the builtin model modules (registration side effect).
+
+    Lazy so `import kubeflow_tpu` stays cheap for control-plane processes
+    that never touch flax."""
+    import kubeflow_tpu.models.resnet  # noqa: F401
+    import kubeflow_tpu.models.transformer  # noqa: F401
+    import kubeflow_tpu.models.bert  # noqa: F401
+
+
+def get_model(name: str, **kwargs) -> Any:
+    """Build a model by registry name."""
+    if name not in _REGISTRY:
+        _load_zoo()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown model {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name](**kwargs)
+
+
+def list_models() -> list[str]:
+    _load_zoo()
+    return sorted(_REGISTRY)

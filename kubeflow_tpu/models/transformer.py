@@ -1,0 +1,281 @@
+"""Decoder-only transformer LM — the flagship distributed workload.
+
+The reference's "big" workloads are opaque TF payloads; its platform
+capabilities (PS data-parallelism, MPI allreduce) cap out at data
+parallelism (SURVEY.md §2.5). This model is where the TPU build goes
+beyond: every weight carries a mesh-axis annotation, so one module
+definition runs under any combination of
+
+- data / fsdp  (batch + ZeRO-3 parameter sharding)
+- model        (Megatron-style tensor parallelism: column-parallel up
+                projections, row-parallel down projections — XLA inserts
+                the psum on the row-parallel matmul output)
+- seq          (sequence/context parallelism; long sequences route
+                attention through ops.ring_attention over the ICI ring)
+- pipe         (pipeline stages via parallel.pipeline.PipelinedTransformer)
+- expert       (MoE blocks; ops.moe all-to-all dispatch)
+
+Architecture: pre-RMSNorm, rotary embeddings, GQA, SwiGLU — the standard
+modern decoder (Llama-class), in bf16 with f32 logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.models.registry import register_model
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+)
+
+Dtype = Any
+
+# Activation sharding: batch over (data, fsdp), sequence over seq, features
+# over model only where the tensor is the "wide" intermediate.
+HIDDEN_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+WIDE_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_MODEL)
+
+
+def shard(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint that is a no-op outside a mesh context.
+
+    Mesh presence is checked explicitly (rather than try/except) so real
+    sharding errors — rank mismatch, indivisible dims — still propagate."""
+    from kubeflow_tpu.parallel.mesh import current_mesh
+
+    if current_mesh() is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _part(init, names):
+    return nn.with_partitioning(init, names)
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    n_kv_heads: int = 4
+    head_dim: int = 64
+    d_ff: int = 2048
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    dtype: Dtype = jnp.bfloat16
+    attention_impl: str = "auto"   # auto | flash | reference | ring
+    remat: bool = False
+    # MoE: every `moe_every`-th block is a mixture layer (0 = dense only)
+    moe_every: int = 0
+    n_experts: int = 8
+    expert_top_k: int = 2
+
+
+def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary position embedding over the last dim. x: [B, L, H, D]."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, L, half]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    )
+    return out.astype(x.dtype)
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-6
+    dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x32 = x.astype(jnp.float32)
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
+        return (y * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
+            feats,
+            axis=-1,
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=_part(init, names),
+            name=name,
+        )
+        # Column-parallel QKV: heads sharded over `model`.
+        q = dense((cfg.n_heads, cfg.head_dim), (AXIS_FSDP, AXIS_MODEL, None), "q")(x)
+        k = dense((cfg.n_kv_heads, cfg.head_dim), (AXIS_FSDP, AXIS_MODEL, None), "k")(x)
+        v = dense((cfg.n_kv_heads, cfg.head_dim), (AXIS_FSDP, AXIS_MODEL, None), "v")(x)
+        q = rope(q, positions, cfg.rope_theta)
+        k = rope(k, positions, cfg.rope_theta)
+
+        if cfg.attention_impl == "ring":
+            from kubeflow_tpu.ops.ring_attention import ring_attention
+
+            out = ring_attention(q, k, v, axis_name=AXIS_SEQ)
+        else:
+            from kubeflow_tpu.ops.attention import attention
+
+            out = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+        # Row-parallel output projection: contraction dim sharded over
+        # `model` — GSPMD inserts the all-reduce here.
+        out = nn.DenseGeneral(
+            x.shape[-1],
+            axis=(-2, -1),
+            use_bias=False,
+            dtype=cfg.dtype,
+            kernel_init=_part(init, (AXIS_MODEL, None, AXIS_FSDP)),
+            name="o",
+        )(out)
+        return shard(out, HIDDEN_SPEC)
+
+
+class SwiGLU(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        init = nn.initializers.normal(0.02)
+        # Column-parallel up projections
+        gate = nn.DenseGeneral(
+            cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+            kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="gate",
+        )(x)
+        up = nn.DenseGeneral(
+            cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+            kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="up",
+        )(x)
+        h = shard(nn.silu(gate) * up, WIDE_SPEC)
+        # Row-parallel down projection (psum on output)
+        out = nn.DenseGeneral(
+            x.shape[-1], use_bias=False, dtype=cfg.dtype,
+            kernel_init=_part(init, (AXIS_MODEL, AXIS_FSDP)), name="down",
+        )(h)
+        return shard(out, HIDDEN_SPEC)
+
+
+class Block(nn.Module):
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids=None):
+        cfg = self.cfg
+        x = x + Attention(cfg, name="attn")(RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions)
+        if self.use_moe:
+            from kubeflow_tpu.ops.moe import MoEBlock
+
+            mlp_out = MoEBlock(cfg, name="moe")(RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x))
+        else:
+            mlp_out = SwiGLU(cfg, name="mlp")(RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x))
+        return x + mlp_out
+
+
+class TransformerLM(nn.Module):
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        del train  # no dropout in the speed-run configuration
+        emb = self.param(
+            "embedding",
+            _part(nn.initializers.normal(1.0), (AXIS_MODEL, AXIS_FSDP)),
+            (cfg.vocab_size, cfg.d_model),
+            jnp.float32,
+        )
+        x = jnp.asarray(emb, cfg.dtype)[tokens]
+        x = shard(x, HIDDEN_SPEC)
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+        )
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
+        for i in range(cfg.n_layers):
+            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions)
+        x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        # Untied f32 head, column-parallel over vocab.
+        logits = nn.DenseGeneral(
+            cfg.vocab_size,
+            use_bias=False,
+            dtype=jnp.float32,
+            kernel_init=_part(nn.initializers.normal(0.02), (AXIS_FSDP, AXIS_MODEL)),
+            name="lm_head",
+        )(x.astype(jnp.float32))
+        return logits
+
+    def flops_per_token(self) -> float:
+        """6*N approximation using dense param count."""
+        cfg = self.cfg
+        attn = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
+        mlp = 3 * cfg.d_model * cfg.d_ff
+        per_layer = attn + mlp
+        emb = cfg.vocab_size * cfg.d_model
+        return 6.0 * (cfg.n_layers * per_layer + 2 * emb)
+
+
+def _build(name: str, **overrides):
+    cfg_kw = {}
+    model_fields = {f.name for f in dataclasses.fields(TransformerConfig)}
+    for k in list(overrides):
+        if k in model_fields:
+            cfg_kw[k] = overrides.pop(k)
+    if overrides:
+        raise ValueError(f"unknown transformer kwargs {sorted(overrides)}")
+    return TransformerLM(TransformerConfig(**cfg_kw))
+
+
+@register_model("transformer-test")
+def transformer_test(**kw) -> TransformerLM:
+    """Tiny config for unit tests / dryruns."""
+    base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+                head_dim=16, d_ff=128, max_seq_len=256)
+    base.update(kw)
+    return _build("transformer-test", **base)
+
+
+@register_model("gpt-125m")
+def gpt_125m(**kw) -> TransformerLM:
+    base = dict(d_model=768, n_layers=12, n_heads=12, n_kv_heads=12, head_dim=64, d_ff=3072)
+    base.update(kw)
+    return _build("gpt-125m", **base)
+
+
+@register_model("llama-1b")
+def llama_1b(**kw) -> TransformerLM:
+    base = dict(d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64, d_ff=8192)
+    base.update(kw)
+    return _build("llama-1b", **base)
+
+
+@register_model("moe-test")
+def moe_test(**kw) -> TransformerLM:
+    base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,
+                head_dim=16, d_ff=128, moe_every=2, n_experts=4, expert_top_k=2)
+    base.update(kw)
+    return _build("moe-test", **base)

@@ -1,0 +1,10 @@
+"""TPU kernels and compute building blocks.
+
+- ``attention``      — XLA reference attention + dispatch to Pallas flash
+                       attention on TPU.
+- ``flash_attention``— Pallas TPU fused attention kernel.
+- ``ring_attention`` — sequence-parallel blockwise attention over the ICI
+                       ring (shard_map + collective-permute).
+- ``moe``            — mixture-of-experts dispatch/combine with expert
+                       parallelism (all-to-all).
+"""

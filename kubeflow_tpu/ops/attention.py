@@ -1,0 +1,81 @@
+"""Attention entry point: one call site, backend chosen per platform.
+
+The reference platform never owns attention math (it ships TF images);
+for the TPU build it is in-scope. `attention()` routes to:
+
+- the Pallas flash-attention kernel on TPU (fused, O(L) memory, MXU-tiled);
+- a plain XLA einsum path elsewhere (tests on the virtual CPU mesh) and
+  for shapes the kernel doesn't support.
+
+All shapes are [batch, length, heads, head_dim] ("BLHD"), GQA supported by
+passing fewer KV heads than Q heads.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _repeat_kv(k: jax.Array, num_q_heads: int) -> jax.Array:
+    """Broadcast KV heads up to Q heads for grouped-query attention."""
+    num_kv = k.shape[2]
+    if num_kv == num_q_heads:
+        return k
+    assert num_q_heads % num_kv == 0, (num_q_heads, num_kv)
+    return jnp.repeat(k, num_q_heads // num_kv, axis=2)
+
+
+def reference_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    segment_ids: jax.Array | None = None,
+) -> jax.Array:
+    """XLA attention in f32 accumulation. BLHD in, BLHD out."""
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    scale = scale if scale is not None else d ** -0.5
+    k = _repeat_kv(k, h)
+    v = _repeat_kv(v, h)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "impl"))
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jax.Array:
+    """Dispatching attention. impl: auto | flash | reference."""
+    if impl == "reference":
+        return reference_attention(q, k, v, causal=causal)
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if impl == "flash" or (impl == "auto" and on_tpu and _flash_supported(q, k)):
+        from kubeflow_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return reference_attention(q, k, v, causal=causal)
+
+
+def _flash_supported(q: jax.Array, k: jax.Array) -> bool:
+    # kernel wants seq multiples of its block size and head_dim % 128 == 0
+    d = q.shape[-1]
+    return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and d in (64, 128, 256)

@@ -1,0 +1,86 @@
+"""Mixture-of-experts with expert parallelism.
+
+GSPMD-style dense dispatch (Switch/GShard formulation): tokens are routed
+top-k with a capacity limit, dispatch/combine are einsums against one-hot
+tensors, and expert weights carry an `expert` mesh-axis annotation — XLA
+lowers the dispatch einsum into the all-to-all over ICI when tokens are
+data-sharded and experts expert-sharded. No scalar loops, static shapes,
+so the whole block stays on the MXU.
+
+Reference framework has no MoE (SURVEY.md §2.5 "Expert parallelism:
+Absent"); this is TPU-native net-new capability.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from kubeflow_tpu.parallel.mesh import AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL
+
+
+class MoEBlock(nn.Module):
+    """Drop-in replacement for the dense SwiGLU MLP."""
+
+    cfg: "TransformerConfig"  # noqa: F821 — structural typing, avoids cycle
+    capacity_factor: float = 1.25
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        b, s, d = x.shape
+        e, k = cfg.n_experts, cfg.expert_top_k
+        init = nn.initializers.normal(0.02)
+
+        # --- router (f32 for stable softmax) ---
+        router = nn.DenseGeneral(
+            e, use_bias=False, dtype=jnp.float32,
+            kernel_init=nn.with_partitioning(init, (AXIS_FSDP, None)),
+            name="router",
+        )(x.astype(jnp.float32))                      # [b,s,e]
+        probs = jax.nn.softmax(router, axis=-1)
+        gate_vals, gate_idx = jax.lax.top_k(probs, k)  # [b,s,k]
+        gate_vals = gate_vals / jnp.maximum(gate_vals.sum(-1, keepdims=True), 1e-9)
+
+        capacity = int(self.capacity_factor * s * k / e) or 1
+
+        # one-hot expert assignment per routing slot: [b,s,k,e]
+        assign = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)
+        # position of each token within its expert's buffer, per slot
+        # cumsum over (s,k) flattened gives arrival order per expert
+        flat = assign.reshape(b, s * k, e)
+        pos = jnp.cumsum(flat, axis=1) - flat          # [b, s*k, e]
+        pos = pos.reshape(b, s, k, e)
+        within_cap = pos < capacity
+        assign = assign * within_cap                   # drop overflow tokens
+        pos_oh = jax.nn.one_hot(pos.astype(jnp.int32), capacity, dtype=jnp.float32)
+        # dispatch tensor [b,s,e,c]: 1 where token (b,s) occupies slot c of expert e
+        dispatch = jnp.einsum("bske,bskec->bsec", assign, pos_oh)
+        combine = jnp.einsum("bsk,bske,bskec->bsec", gate_vals.astype(jnp.float32),
+                             assign, pos_oh)
+
+        # --- expert computation ---
+        xin = jnp.einsum("bsec,bsd->ebcd", dispatch.astype(cfg.dtype), x)
+        w_gate = self.param(
+            "w_gate", nn.with_partitioning(init, (AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
+            (e, d, cfg.d_ff), jnp.float32)
+        w_up = self.param(
+            "w_up", nn.with_partitioning(init, (AXIS_EXPERT, AXIS_FSDP, AXIS_MODEL)),
+            (e, d, cfg.d_ff), jnp.float32)
+        w_down = self.param(
+            "w_down", nn.with_partitioning(init, (AXIS_EXPERT, AXIS_MODEL, AXIS_FSDP)),
+            (e, cfg.d_ff, d), jnp.float32)
+        h = nn.silu(jnp.einsum("ebcd,edf->ebcf", xin, w_gate.astype(cfg.dtype))) * \
+            jnp.einsum("ebcd,edf->ebcf", xin, w_up.astype(cfg.dtype))
+        out = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(cfg.dtype))
+
+        # --- combine back to token order ---
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(cfg.dtype), out)
+
+        # aux load-balancing loss (GShard): mean_e (fraction * prob)
+        me = probs.mean(axis=(0, 1))                   # [e]
+        ce = assign.sum(axis=2).mean(axis=(0, 1))      # fraction dispatched per expert
+        aux = e * jnp.sum(me * ce)
+        self.sow("losses", "moe_aux", aux)
+        return y.astype(cfg.dtype)

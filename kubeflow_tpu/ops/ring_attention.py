@@ -1,0 +1,130 @@
+"""Ring attention: exact causal attention over sequence-sharded inputs.
+
+Long-context is first-class in the TPU build (the reference has nothing —
+SURVEY.md §5 "Long-context / sequence parallelism: Absent"). Sequences are
+sharded over the mesh's `seq` axis; each device holds one block of Q/K/V.
+K/V blocks rotate around the ring with `lax.ppermute` (nearest-neighbor
+ICI hops, no all-gather), and each device maintains a streaming-softmax
+accumulator (running max / sum / output), so memory stays O(L/ring) and
+the math is exactly softmax(QK^T)V.
+
+Implementation is `shard_map` over the ambient mesh: inside, arrays are
+the local blocks and collectives are explicit. Per ring step the K/V
+transfer overlaps the block matmul (XLA schedules ppermute async).
+
+References (public technique literature): Liu et al., "Ring Attention
+with Blockwise Transformers for Near-Infinite Context" (2023);
+flash-attention streaming softmax (Dao et al. 2022).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+
+NEG_INF = -1e30
+
+
+from kubeflow_tpu.parallel.mesh import current_mesh as _current_mesh
+
+
+def _ring_perm(n: int) -> list[tuple[int, int]]:
+    # send block to the next device; receive from the previous
+    return [(i, (i + 1) % n) for i in range(n)]
+
+
+def _block_attn(q, k, v, row_ids, col_ids, scale):
+    """One block pair: returns (unnormalized out, row max, row sum)."""
+    h = q.shape[2]
+    if k.shape[2] != h:
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    mask = row_ids[:, None] >= col_ids[None, :]  # causal, global indices
+    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    m = jnp.max(logits, axis=-1)                       # [b,h,q]
+    # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
+    m_safe = jnp.maximum(m, -1e29)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)                            # [b,h,q]
+    o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                   preferred_element_type=jnp.float32)
+    return o, m_safe, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+    mesh: Mesh | None = None,
+    causal: bool = True,
+) -> jax.Array:
+    """Causal attention over seq-sharded [B, L, H, D] arrays.
+
+    Falls back to single-block reference attention when the mesh has no
+    `seq` axis (so the same model code runs on any mesh spec).
+    """
+    if not causal:
+        raise NotImplementedError("ring attention is causal-only for now")
+    mesh = mesh or _current_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        from kubeflow_tpu.ops.attention import reference_attention
+
+        return reference_attention(q, k, v, causal=True)
+
+    n_ring = mesh.shape[axis_name]
+    scale = q.shape[-1] ** -0.5
+    l_total = q.shape[1]
+    l_block = l_total // n_ring
+    assert l_block * n_ring == l_total, (l_total, n_ring)
+
+    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_MODEL, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    def _ring(q_blk, k_blk, v_blk):
+        seq_idx = jax.lax.axis_index(axis_name)
+        b, lq, h, d = q_blk.shape
+        row_ids = seq_idx * l_block + jnp.arange(lq)
+        perm = _ring_perm(n_ring)
+
+        def step(carry, i):
+            o, m, l, k_cur, v_cur = carry
+            src = (seq_idx - i) % n_ring           # owner of current K/V block
+            col_ids = src * l_block + jnp.arange(k_cur.shape[1])
+            o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids, scale)
+            m_new = jnp.maximum(m, m_i)
+            alpha = jnp.exp(m - m_new)             # rescale old accumulator
+            beta = jnp.exp(m_i - m_new)
+            l_new = l * alpha + l_i * beta
+            o_new = o * alpha[..., None].transpose(0, 2, 1, 3) + \
+                o_i * beta[..., None].transpose(0, 2, 1, 3)
+            k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+            v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+            return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+        o0 = jnp.zeros((b, lq, h, d), jnp.float32)
+        m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((b, h, lq), jnp.float32)
+        (o, m, l, _, _), _ = jax.lax.scan(
+            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(n_ring)
+        )
+        l = jnp.maximum(l, 1e-20)
+        out = o / l[..., None].transpose(0, 2, 1, 3)
+        return out.astype(q_blk.dtype)
+
+    return _ring(q, k, v)

@@ -1,0 +1,36 @@
+"""Parallelism primitives: meshes, shardings, distributed bootstrap.
+
+TPU-native replacement for the reference's parallelism matrix (SURVEY.md
+§2.5): parameter-server data parallelism and MPI/NCCL allreduce become XLA
+collectives over ICI, compiled into the step function by GSPMD.
+"""
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_PIPELINE,
+    AXIS_SEQ,
+    MeshSpec,
+    build_mesh,
+)
+from kubeflow_tpu.parallel.dist import (
+    DistConfig,
+    initialize_from_env,
+    is_coordinator,
+)
+
+__all__ = [
+    "AXIS_DATA",
+    "AXIS_EXPERT",
+    "AXIS_FSDP",
+    "AXIS_MODEL",
+    "AXIS_PIPELINE",
+    "AXIS_SEQ",
+    "MeshSpec",
+    "build_mesh",
+    "DistConfig",
+    "initialize_from_env",
+    "is_coordinator",
+]

@@ -1,0 +1,135 @@
+"""Multi-host bootstrap: the TPU-native TF_CONFIG.
+
+The reference clusters TF1 processes by having the (external) TFJob
+operator inject a `TF_CONFIG` JSON env var which an in-pod launcher decodes
+into `--job_name/--ps_hosts/--worker_hosts/--task_index` flags
+(tf-controller-examples/tf-cnn/launcher.py:68-80). Parameter servers and
+gRPC disappear on TPU: every process joins one `jax.distributed` cluster
+and gradient reduction happens inside the compiled step over ICI.
+
+The JAXJob controller (kubeflow_tpu.control.jaxjob) injects:
+
+    JAXJOB_COORDINATOR_ADDRESS   host:port of process 0
+    JAXJOB_NUM_PROCESSES         world size
+    JAXJOB_PROCESS_ID            this pod's rank (from the pod index)
+    JAXJOB_NAME / JAXJOB_NAMESPACE  (identification / logging only)
+
+`initialize_from_env()` is the single call a training container makes
+before touching jax; it also honors the standard JAX / Cloud-TPU env vars
+so images run unmodified on GKE TPU node pools (where the device plugin
+injects TPU_WORKER_HOSTNAMES etc.) and under bare `jax.distributed`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import socket
+import time
+
+log = logging.getLogger("kubeflow_tpu.dist")
+
+ENV_COORD = "JAXJOB_COORDINATOR_ADDRESS"
+ENV_NPROC = "JAXJOB_NUM_PROCESSES"
+ENV_PID = "JAXJOB_PROCESS_ID"
+ENV_NAME = "JAXJOB_NAME"
+ENV_NAMESPACE = "JAXJOB_NAMESPACE"
+DEFAULT_COORD_PORT = 8476
+
+
+@dataclasses.dataclass(frozen=True)
+class DistConfig:
+    coordinator_address: str | None
+    num_processes: int
+    process_id: int
+    job_name: str = ""
+    namespace: str = ""
+
+    @property
+    def distributed(self) -> bool:
+        return self.num_processes > 1
+
+    @classmethod
+    def from_env(cls, env: dict[str, str] | None = None) -> "DistConfig":
+        env = dict(os.environ) if env is None else env
+        coord = env.get(ENV_COORD)
+        nproc = int(env.get(ENV_NPROC, "1"))
+        pid = int(env.get(ENV_PID, "0"))
+        if coord is not None and ":" not in coord:
+            coord = f"{coord}:{DEFAULT_COORD_PORT}"
+        return cls(
+            coordinator_address=coord,
+            num_processes=nproc,
+            process_id=pid,
+            job_name=env.get(ENV_NAME, ""),
+            namespace=env.get(ENV_NAMESPACE, ""),
+        )
+
+    def to_env(self) -> dict[str, str]:
+        """The env block the JAXJob controller injects into each worker pod."""
+        env = {
+            ENV_NPROC: str(self.num_processes),
+            ENV_PID: str(self.process_id),
+        }
+        if self.coordinator_address:
+            env[ENV_COORD] = self.coordinator_address
+        if self.job_name:
+            env[ENV_NAME] = self.job_name
+        if self.namespace:
+            env[ENV_NAMESPACE] = self.namespace
+        return env
+
+
+def wait_for_coordinator(address: str, timeout_s: float = 300.0) -> None:
+    """Readiness gate: block until the coordinator's port accepts TCP.
+
+    Replaces the reference's two hacks around bootstrap ordering: the
+    openmpi sidecar's SIGCONT file handshake (openmpi-controller/
+    controller/controller.py:53-57) and launcher.py's sleep-forever guard.
+    """
+    host, _, port = address.partition(":")
+    deadline = time.monotonic() + timeout_s
+    delay = 0.25
+    while True:
+        try:
+            with socket.create_connection((host, int(port or DEFAULT_COORD_PORT)), timeout=2.0):
+                return
+        except OSError:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"coordinator {address} not reachable after {timeout_s}s")
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+
+
+def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True) -> DistConfig:
+    """Join the jax.distributed cluster described by JAXJOB_* env vars.
+
+    No-op for single-process jobs, so the same image runs on one chip or a
+    multi-host slice without code changes (num_processes==1 ⇒ no
+    coordinator needed, exactly like running the reference's tf-cnn with
+    an empty TF_CONFIG, launcher.py:64-66).
+    """
+    cfg = DistConfig.from_env(env)
+    if cfg.distributed:
+        import jax  # deferred: must happen before any backend init
+
+        if cfg.coordinator_address is None:
+            raise ValueError(f"{ENV_NPROC}>1 but {ENV_COORD} unset")
+        if wait and cfg.process_id != 0:
+            wait_for_coordinator(cfg.coordinator_address)
+        log.info(
+            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+            cfg.coordinator_address, cfg.num_processes, cfg.process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=cfg.coordinator_address,
+            num_processes=cfg.num_processes,
+            process_id=cfg.process_id,
+        )
+    return cfg
+
+
+def is_coordinator(cfg: DistConfig | None = None) -> bool:
+    cfg = cfg or DistConfig.from_env()
+    return cfg.process_id == 0

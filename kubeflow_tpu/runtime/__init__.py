@@ -1,0 +1,7 @@
+"""jaxrt — the in-pod training runtime.
+
+The reference delegates all model math to opaque payload images and only
+ships the pod-side glue (launcher.py env decoding, openmpi sidecar
+lifecycle). Here the runtime is in-scope: launcher, trainer loop, MFU
+meter, checkpointing, and metrics are part of the framework.
+"""

@@ -1,0 +1,126 @@
+"""In-pod launcher — the TPU-native replacement for tf-cnn's launcher.py.
+
+Reference contract (tf-controller-examples/tf-cnn/launcher.py):
+  - decode TF_CONFIG into --job_name/--ps_hosts/--worker_hosts/--task_index
+    (:68-80), exec the payload (:31), then *sleep forever* on success so
+    the operator's restartPolicy doesn't rerun it (:90-93).
+
+This launcher:
+  - decodes JAXJOB_* env (parallel/dist.py) and joins the jax.distributed
+    cluster, with a TCP readiness gate on the coordinator instead of
+    sleep-based ordering;
+  - waits for TPU devices to be visible (the libtpu analogue of the
+    openmpi sidecar's /proc/driver/nvidia/version poll, controller.py:73-90);
+  - runs either a built-in trainer (--config JSON/YAML → TrainConfig) or a
+    user command;
+  - exits 0/1 — gang restart semantics belong to the JAXJob controller,
+    not to a sleep loop in the pod.
+
+Usage:
+    python -m kubeflow_tpu.runtime.launcher --config cfg.yaml
+    python -m kubeflow_tpu.runtime.launcher -- python my_train.py --flag
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import subprocess
+import sys
+import time
+
+log = logging.getLogger("kubeflow_tpu.launcher")
+
+
+def wait_for_devices(timeout_s: float = 300.0, expect_platform: str | None = None) -> int:
+    """Block until jax sees accelerator devices (libtpu ready)."""
+    import jax
+
+    deadline = time.monotonic() + timeout_s
+    while True:
+        try:
+            devs = jax.devices(expect_platform) if expect_platform else jax.devices()
+            if devs:
+                log.info("devices ready: %d x %s", len(devs), devs[0].device_kind)
+                return len(devs)
+        except RuntimeError:
+            pass
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"no {expect_platform or 'accelerator'} devices after {timeout_s}s")
+        time.sleep(2.0)
+
+
+def load_config(path: str) -> dict:
+    with open(path) as f:
+        text = f.read()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        from kubeflow_tpu.utils import yaml_lite
+
+        return yaml_lite.loads(text)
+
+
+def run_builtin_trainer(cfg_dict: dict) -> int:
+    from kubeflow_tpu.runtime import metrics as rt_metrics
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    metrics_port = int(os.environ.get("JAXRT_METRICS_PORT", "9100"))
+    try:
+        rt_metrics.serve_metrics(metrics_port)
+    except OSError:
+        log.warning("metrics port %d busy; metrics endpoint disabled", metrics_port)
+    cfg = TrainConfig.from_dict(cfg_dict)
+    trainer = Trainer(cfg)
+    _, summary = trainer.fit()
+    print(json.dumps({"summary": summary}))
+    return 0
+
+
+def run_user_command(argv: list[str]) -> int:
+    """Exec the user payload, streaming output (launcher.py:31
+    run_and_stream analogue, minus the sleep-forever)."""
+    log.info("exec: %s", " ".join(argv))
+    proc = subprocess.Popen(argv, stdout=sys.stdout, stderr=sys.stderr)
+    return proc.wait()
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    user_cmd: list[str] = []
+    if "--" in argv:
+        i = argv.index("--")
+        argv, user_cmd = argv[:i], argv[i + 1 :]
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--config", help="TrainConfig JSON/YAML for the built-in trainer")
+    p.add_argument("--wait-devices", action="store_true",
+                   help="block until accelerator devices are visible before starting")
+    p.add_argument("--device-timeout", type=float, default=300.0)
+    args = p.parse_args(argv)
+
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+
+    from kubeflow_tpu.parallel.dist import initialize_from_env
+
+    cfg = initialize_from_env()
+    log.info("process %d/%d (job=%s)", cfg.process_id, cfg.num_processes, cfg.job_name or "-")
+
+    if args.wait_devices:
+        wait_for_devices(args.device_timeout)
+
+    if args.config:
+        return run_builtin_trainer(load_config(args.config))
+    if user_cmd:
+        return run_user_command(user_cmd)
+    p.error("need --config or a user command after --")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,149 @@
+"""Hot-loop instrumentation the reference never had.
+
+The reference's observability is Prometheus on the control plane only
+(bootstrap/cmd/bootstrap/app/server.go:68-132, notebook-controller
+pkg/metrics/metrics.go) — per-step training metrics don't exist. Here
+every worker exports step time, throughput, and MFU in Prometheus text
+exposition format, scrapeable at :9100/metrics, with zero third-party
+dependencies (stdlib http.server on a daemon thread).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+# Peak dense bf16 FLOP/s per chip, by jax device_kind. Source: public Cloud
+# TPU docs tables (v4: 275T, v5e: 197T, v5p: 459T, v6e "Trillium": 918T).
+PEAK_FLOPS = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+}
+_DEFAULT_PEAK = 197e12
+
+
+def peak_flops(device_kind: str) -> float:
+    for prefix, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+        if device_kind.startswith(prefix):
+            return val
+    return _DEFAULT_PEAK
+
+
+class StepMeter:
+    """Tracks step wall time, examples/sec and MFU over a sliding window."""
+
+    def __init__(self, flops_per_step: float, n_chips: int, device_kind: str = "", window: int = 20):
+        self.flops_per_step = float(flops_per_step)
+        self.n_chips = max(1, n_chips)
+        self.peak = peak_flops(device_kind) * self.n_chips if device_kind else None
+        self._times: deque[float] = deque(maxlen=window)
+        self._t0: float | None = None
+        self.steps = 0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self) -> float:
+        assert self._t0 is not None, "stop() without start()"
+        dt = time.perf_counter() - self._t0
+        self._times.append(dt)
+        self.steps += 1
+        self._t0 = None
+        return dt
+
+    @property
+    def step_time(self) -> float:
+        return sum(self._times) / len(self._times) if self._times else float("nan")
+
+    def throughput(self, examples_per_step: int) -> float:
+        return examples_per_step / self.step_time
+
+    @property
+    def achieved_flops(self) -> float:
+        return self.flops_per_step / self.step_time
+
+    @property
+    def mfu(self) -> float:
+        if not self.peak:
+            return float("nan")
+        return self.achieved_flops / self.peak
+
+
+class MetricsRegistry:
+    """Minimal Prometheus registry: gauges and counters, text format 0.0.4."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._metrics: dict[str, tuple[str, str, dict[tuple, float]]] = {}
+
+    def _set(self, kind: str, name: str, help_: str, value: float, labels: dict | None):
+        key = tuple(sorted((labels or {}).items()))
+        with self._lock:
+            _, _, series = self._metrics.setdefault(name, (kind, help_, {}))
+            series[key] = value
+
+    def gauge(self, name: str, value: float, help_: str = "", **labels) -> None:
+        self._set("gauge", name, help_, value, labels)
+
+    def counter_inc(self, name: str, help_: str = "", by: float = 1.0, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            _, _, series = self._metrics.setdefault(name, ("counter", help_, {}))
+            series[key] = series.get(key, 0.0) + by
+
+    def render(self) -> str:
+        out = []
+        with self._lock:
+            for name, (kind, help_, series) in sorted(self._metrics.items()):
+                if help_:
+                    out.append(f"# HELP {name} {help_}")
+                out.append(f"# TYPE {name} {kind}")
+                for key, value in sorted(series.items()):
+                    if key:
+                        lbl = ",".join(f'{k}="{v}"' for k, v in key)
+                        out.append(f"{name}{{{lbl}}} {value}")
+                    else:
+                        out.append(f"{name} {value}")
+        return "\n".join(out) + "\n"
+
+
+REGISTRY = MetricsRegistry()
+
+
+class _Handler(BaseHTTPRequestHandler):
+    registry: MetricsRegistry = REGISTRY
+
+    def do_GET(self):  # noqa: N802
+        if self.path.rstrip("/") in ("", "/metrics"):
+            body = self.registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/healthz":
+            self.send_response(200)
+            self.end_headers()
+            self.wfile.write(b"ok")
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *a):  # silence per-request lines
+        pass
+
+
+def serve_metrics(port: int = 9100, registry: MetricsRegistry = REGISTRY) -> ThreadingHTTPServer:
+    """Start the /metrics endpoint on a daemon thread; returns the server
+    (caller may .shutdown()). Port 0 picks a free port (tests)."""
+    handler = type("Handler", (_Handler,), {"registry": registry})
+    srv = ThreadingHTTPServer(("0.0.0.0", port), handler)
+    t = threading.Thread(target=srv.serve_forever, name="metrics", daemon=True)
+    t.start()
+    return srv

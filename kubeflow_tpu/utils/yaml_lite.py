@@ -1,0 +1,30 @@
+"""YAML loading shim.
+
+Wraps PyYAML's safe loader (present in the baked image). Kept behind one
+module so every config consumer (launcher, tpctl, controllers) shares one
+entry point and the dependency stays swappable.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import yaml
+
+
+def loads(text: str) -> Any:
+    return yaml.safe_load(text)
+
+
+def load(path: str) -> Any:
+    with open(path) as f:
+        return yaml.safe_load(f)
+
+
+def dumps(obj: Any) -> str:
+    return yaml.safe_dump(obj, sort_keys=False)
+
+
+def load_all(text: str) -> list[Any]:
+    """Multi-document YAML (kustomize-style manifest bundles)."""
+    return [d for d in yaml.safe_load_all(text) if d is not None]

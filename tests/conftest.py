@@ -1,0 +1,42 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+The reference has no fake backend for distributed tests — its distributed
+behavior is only exercised on real per-CI-run GKE clusters (SURVEY.md §4).
+This conftest is the fake backend: every test sees 8 XLA host devices, so
+dp/fsdp/tp/sp/ep shardings compile and run hermetically.
+
+Must run before jax initializes a backend, hence env mutation at import
+time (pytest imports conftest before test modules).
+"""
+
+import os
+
+# Unconditional: the image pins JAX_PLATFORMS=axon (real TPU tunnel);
+# tests are hermetic CPU by design.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep XLA/CPU from oversubscribing the test machine.
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# The image's sitecustomize imports jax at interpreter start (TPU tunnel
+# plugin), so jax's config has already captured JAX_PLATFORMS=axon; the
+# env var alone is too late. Override the live config before any backend
+# initializes (backends init lazily at first jax.devices()).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,72 @@
+import socket
+import threading
+
+import pytest
+
+from kubeflow_tpu.parallel.dist import (
+    ENV_COORD,
+    ENV_NPROC,
+    ENV_PID,
+    DistConfig,
+    initialize_from_env,
+    is_coordinator,
+    wait_for_coordinator,
+)
+
+
+def test_config_defaults_single_process():
+    cfg = DistConfig.from_env({})
+    assert not cfg.distributed
+    assert cfg.process_id == 0 and cfg.num_processes == 1
+    assert is_coordinator(cfg)
+
+
+def test_config_from_env_roundtrip():
+    env = {ENV_COORD: "job-0.svc:1234", ENV_NPROC: "4", ENV_PID: "2"}
+    cfg = DistConfig.from_env(env)
+    assert cfg.distributed
+    assert cfg.coordinator_address == "job-0.svc:1234"
+    assert cfg.process_id == 2
+    out = cfg.to_env()
+    assert out[ENV_COORD] == "job-0.svc:1234"
+    assert out[ENV_PID] == "2"
+
+
+def test_config_default_port_appended():
+    cfg = DistConfig.from_env({ENV_COORD: "job-0.svc", ENV_NPROC: "2", ENV_PID: "1"})
+    assert cfg.coordinator_address.endswith(":8476")
+
+
+def test_initialize_noop_single_process():
+    # num_processes==1 must not touch jax.distributed
+    cfg = initialize_from_env({})
+    assert cfg.num_processes == 1
+
+
+def test_initialize_requires_coordinator():
+    with pytest.raises(ValueError):
+        initialize_from_env({ENV_NPROC: "2", ENV_PID: "1", }, wait=False)
+
+
+def test_wait_for_coordinator_success():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    def accept_quietly():
+        try:
+            srv.accept()
+        except OSError:
+            pass
+
+    t = threading.Thread(target=accept_quietly, daemon=True)
+    t.start()
+    try:
+        wait_for_coordinator(f"127.0.0.1:{port}", timeout_s=5)
+    finally:
+        srv.close()
+
+
+def test_wait_for_coordinator_timeout():
+    with pytest.raises(TimeoutError):
+        wait_for_coordinator("127.0.0.1:1", timeout_s=0.3)

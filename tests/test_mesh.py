@@ -1,0 +1,65 @@
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_MODEL,
+    MeshSpec,
+    batch_sharding,
+    build_mesh,
+    local_batch_size,
+    mesh_summary,
+)
+
+
+def test_default_mesh_all_data(devices8):
+    mesh = build_mesh()
+    assert mesh.shape[AXIS_DATA] == 8
+    assert mesh.devices.size == 8
+
+
+def test_mesh_spec_resolve():
+    spec = MeshSpec(model=2, seq=2).resolve(8)
+    assert spec.data == 2
+    assert spec.model == 2 and spec.seq == 2
+
+
+def test_mesh_spec_bad_divisibility():
+    with pytest.raises(ValueError):
+        MeshSpec(model=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=4, model=4).resolve(8)
+
+
+def test_mesh_spec_from_dict_rejects_unknown():
+    with pytest.raises(ValueError):
+        MeshSpec.from_dict({"tensor": 2})
+
+
+def test_build_mesh_2d(devices8):
+    mesh = build_mesh(MeshSpec(data=2, model=4))
+    assert mesh.shape[AXIS_DATA] == 2
+    assert mesh.shape[AXIS_MODEL] == 4
+
+
+def test_batch_sharding_puts_batch_on_data(devices8):
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2))
+    x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
+    xs = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
+    # batch dim sharded over data*fsdp = 8
+    assert xs.sharding.spec == P((AXIS_DATA, "fsdp"), None)
+    np.testing.assert_array_equal(np.asarray(xs), x)
+
+
+def test_local_batch_size(devices8):
+    mesh = build_mesh(MeshSpec(data=4, fsdp=2))
+    assert local_batch_size(mesh, 32) == 4
+    with pytest.raises(ValueError):
+        local_batch_size(mesh, 30)
+
+
+def test_mesh_summary(devices8):
+    s = mesh_summary(build_mesh(MeshSpec(data=8)))
+    assert "data=8" in s

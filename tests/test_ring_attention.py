@@ -1,0 +1,88 @@
+"""Ring attention == reference attention, on a real seq-sharded mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.ops.attention import reference_attention
+from kubeflow_tpu.ops.ring_attention import ring_attention
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+
+def make_qkv(b=2, l=32, h=4, hk=4, d=16, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, l, h, d), dtype)
+    k = jax.random.normal(ks[1], (b, l, hk, d), dtype)
+    v = jax.random.normal(ks[2], (b, l, hk, d), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("ring", [2, 4, 8])
+def test_ring_matches_reference(devices8, ring):
+    mesh = build_mesh(MeshSpec(data=1, seq=ring), devices=jax.devices()[:ring])
+    q, k, v = make_qkv()
+    want = reference_attention(q, k, v, causal=True)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_with_gqa(devices8):
+    mesh = build_mesh(MeshSpec(data=1, seq=4), devices=jax.devices()[:4])
+    q, k, v = make_qkv(h=8, hk=2)
+    want = reference_attention(q, k, v, causal=True)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_with_data_parallel_too(devices8):
+    mesh = build_mesh(MeshSpec(data=2, seq=4))
+    q, k, v = make_qkv(b=4)
+    want = reference_attention(q, k, v, causal=True)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_falls_back_without_seq_axis(devices8):
+    mesh = build_mesh(MeshSpec(data=8))
+    q, k, v = make_qkv()
+    want = reference_attention(q, k, v, causal=True)
+    with mesh:
+        got = ring_attention(q, k, v, mesh=mesh)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_ring_gradients_flow(devices8):
+    mesh = build_mesh(MeshSpec(data=1, seq=4), devices=jax.devices()[:4])
+    q, k, v = make_qkv()
+
+    def loss_ring(q, k, v):
+        with mesh:
+            return ring_attention(q, k, v, mesh=mesh).sum()
+
+    def loss_ref(q, k, v):
+        return reference_attention(q, k, v, causal=True).sum()
+
+    g_ring = jax.grad(loss_ring)(q, k, v)
+    g_ref = jax.grad(loss_ref)(q, k, v)
+    np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4, rtol=1e-4)
+
+
+def test_lm_with_ring_attention_end_to_end(devices8):
+    """Flagship model trains with seq parallelism enabled."""
+    from kubeflow_tpu.parallel.mesh import MeshSpec
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig.from_dict(dict(
+        model="transformer-test",
+        model_kwargs={"attention_impl": "ring"},
+        task="lm", global_batch=4, seq_len=64, vocab_size=256,
+        mesh=MeshSpec(data=2, seq=4), optimizer="adamw",
+        learning_rate=1e-3, total_steps=2, warmup_steps=1,
+    ))
+    trainer = Trainer(cfg)
+    state, summary = trainer.fit(steps=2)
+    assert np.isfinite(summary["final"]["loss"])

@@ -1,0 +1,76 @@
+"""Transformer + parallelism-matrix tests on the 8-device CPU mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+
+def lm_cfg(**over):
+    cfg = dict(
+        model="transformer-test",
+        task="lm",
+        global_batch=8,
+        seq_len=64,
+        vocab_size=256,
+        mesh=MeshSpec(data=8),
+        optimizer="adamw",
+        learning_rate=1e-3,
+        total_steps=4,
+        warmup_steps=1,
+        log_every=2,
+    )
+    cfg.update(over)
+    return TrainConfig.from_dict(cfg)
+
+
+def test_lm_dp_training(devices8):
+    trainer = Trainer(lm_cfg())
+    state, summary = trainer.fit(steps=3)
+    assert np.isfinite(summary["final"]["loss"])
+
+
+def test_lm_tensor_parallel(devices8):
+    trainer = Trainer(lm_cfg(mesh=MeshSpec(data=2, model=4)))
+    state = trainer.init_state()
+    # TP actually shards attention/MLP kernels over `model`
+    sharded = [
+        p for p in jax.tree.leaves(state.params)
+        if not p.sharding.is_fully_replicated
+    ]
+    assert sharded, "TP should shard transformer weights"
+    state, m = trainer.train_step(state, next(trainer.data_iter()))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_lm_tp_matches_dp_loss(devices8):
+    """Same seed => TP and DP compute the same loss (GSPMD correctness)."""
+    t_dp = Trainer(lm_cfg(mesh=MeshSpec(data=8)))
+    t_tp = Trainer(lm_cfg(mesh=MeshSpec(data=1, model=8)))
+    s_dp, s_tp = t_dp.init_state(), t_tp.init_state()
+    batch = next(t_dp.data_iter())
+    _, m_dp = t_dp.train_step(s_dp, batch)
+    _, m_tp = t_tp.train_step(s_tp, batch)
+    np.testing.assert_allclose(float(m_dp["loss"]), float(m_tp["loss"]), rtol=2e-2)
+
+
+def test_moe_block_runs(devices8):
+    trainer = Trainer(lm_cfg(model="moe-test", mesh=MeshSpec(data=2, expert=4)))
+    state = trainer.init_state()
+    state, m = trainer.train_step(state, next(trainer.data_iter()))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_bert_forward(devices8):
+    model = get_model("bert-test")
+    tokens = jnp.ones((2, 16), jnp.int32)
+    variables = model.init(jax.random.PRNGKey(0), tokens, train=False)
+    from flax.core import meta
+
+    logits = model.apply(meta.unbox(variables), tokens, train=False)
+    assert logits.shape == (2, 2)
+    assert np.isfinite(np.asarray(logits)).all()
