@@ -134,11 +134,14 @@ class Attention(nn.Module):
         if cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 
+            assert segment_ids is None, "ring attention does not take segment_ids yet"
             out = ring_attention(q, k, v, axis_name=AXIS_SEQ)
         else:
             from kubeflow_tpu.ops.attention import attention
 
-            out = attention(q, k, v, causal=True, impl=cfg.attention_impl)
+            out = attention(
+                q, k, v, causal=True, impl=cfg.attention_impl, segment_ids=segment_ids
+            )
         # Row-parallel output projection: contraction dim sharded over
         # `model` — GSPMD inserts the all-reduce here.
         out = nn.DenseGeneral(
@@ -184,7 +187,9 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, positions, segment_ids=None):
         cfg = self.cfg
-        x = x + Attention(cfg, name="attn")(RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions)
+        x = x + Attention(cfg, name="attn")(
+            RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions, segment_ids
+        )
         if self.use_moe:
             from kubeflow_tpu.ops.moe import MoEBlock
 
@@ -198,7 +203,7 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, train: bool = True, segment_ids=None):
         cfg = self.cfg
         del train  # no dropout in the speed-run configuration
         emb = self.param(
@@ -217,7 +222,7 @@ class TransformerLM(nn.Module):
             block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
         for i in range(cfg.n_layers):
             use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions)
+            x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Untied f32 head, column-parallel over vocab.
         logits = nn.DenseGeneral(

@@ -63,10 +63,15 @@ def attention(
     *,
     causal: bool = True,
     impl: str = "auto",
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
-    """Dispatching attention. impl: auto | flash | reference."""
-    if impl == "reference":
-        return reference_attention(q, k, v, causal=causal)
+    """Dispatching attention. impl: auto | flash | reference.
+
+    segment_ids (sequence-packing masks) force the reference path — the
+    Pallas kernel doesn't take them yet.
+    """
+    if impl == "reference" or segment_ids is not None:
+        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     on_tpu = jax.devices()[0].platform == "tpu"
     if impl == "flash" or (impl == "auto" and on_tpu and _flash_supported(q, k)):
         from kubeflow_tpu.ops.flash_attention import flash_attention

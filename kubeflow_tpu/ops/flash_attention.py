@@ -49,7 +49,11 @@ def _interpret_default() -> bool:
 # --------------------------------------------------------------------------
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                scale: float, causal: bool, block_q: int, block_k: int):
+                scale: float, causal: bool, block_q: int, block_k: int,
+                offset: int):
+    # offset = lk - lq: causality is end-aligned (query row i may attend
+    # keys <= i + offset), matching reference_attention's tril(k=lk-lq) —
+    # the KV-cache decode / chunked-prefill convention.
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -63,7 +67,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     # causal: kv block strictly above the diagonal contributes nothing
     run = True
     if causal:
-        run = ki * block_k <= qi * block_q + (block_q - 1)
+        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
 
     @pl.when(run)
     def _compute():
@@ -77,7 +81,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         if causal:
             rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qi * block_q + rows) >= (ki * block_k + cols)
+            mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_s[:]                                # [BQ, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -108,7 +112,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, offset=lk - lq,
     )
     if not _HAS_PLTPU:
         raise ImportError(
@@ -166,7 +170,7 @@ def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
         s = jnp.einsum("bqd,bkd->bqk", qf, ks) * scale
         if causal:
             cols = jb * block_k + jnp.arange(block_k)
-            mask = positions_q[:, None] >= cols[None, :]
+            mask = (positions_q[:, None] + (lk - q.shape[1])) >= cols[None, :]
             s = jnp.where(mask[None], s, NEG_INF)
         p = jnp.exp(s - lse[..., None])                      # [BH, Lq, BK]
         dv = jnp.einsum("bqk,bqd->bkd", p, gf)

@@ -87,7 +87,19 @@ def ring_attention(
     l_block = l_total // n_ring
     assert l_block * n_ring == l_total, (l_total, n_ring)
 
-    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, AXIS_MODEL, None)
+    # GQA: repeat KV heads up to Q heads *before* sharding so the head dim
+    # of all three operands shards identically over `model`. Without this,
+    # n_kv_heads < model-axis size crashes shard_map (the weight-sharding
+    # heuristic in parallel/shardings.py deliberately replicates such KV
+    # weights, so the activations really do arrive with few heads).
+    h = q.shape[2]
+    if k.shape[2] != h:
+        assert h % k.shape[2] == 0, (h, k.shape[2])
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
+    model_size = mesh.shape.get(AXIS_MODEL, 1) if AXIS_MODEL in mesh.axis_names else 1
+    head_axis = AXIS_MODEL if h % max(model_size, 1) == 0 and model_size > 1 else None
+    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, head_axis, None)
 
     @functools.partial(
         jax.shard_map,
@@ -102,8 +114,7 @@ def ring_attention(
         row_ids = seq_idx * l_block + jnp.arange(lq)
         perm = _ring_perm(n_ring)
 
-        def step(carry, i):
-            o, m, l, k_cur, v_cur = carry
+        def accumulate(o, m, l, k_cur, v_cur, i):
             src = (seq_idx - i) % n_ring           # owner of current K/V block
             col_ids = src * l_block + jnp.arange(k_cur.shape[1])
             o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids, scale)
@@ -113,16 +124,24 @@ def ring_attention(
             l_new = l * alpha + l_i * beta
             o_new = o * alpha[..., None].transpose(0, 2, 1, 3) + \
                 o_i * beta[..., None].transpose(0, 2, 1, 3)
+            return o_new, m_new, l_new
+
+        def step(carry, i):
+            o, m, l, k_cur, v_cur = carry
+            o, m, l = accumulate(o, m, l, k_cur, v_cur, i)
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-            return (o_new, m_new, l_new, k_nxt, v_nxt), None
+            return (o, m, l, k_nxt, v_nxt), None
 
         o0 = jnp.zeros((b, lq, h, d), jnp.float32)
         m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, h, lq), jnp.float32)
-        (o, m, l, _, _), _ = jax.lax.scan(
-            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(n_ring)
+        # scan the first n_ring-1 rotations; peel the last block so its
+        # K/V are not ppermuted onward (that transfer is never read).
+        (o, m, l, k_last, v_last), _ = jax.lax.scan(
+            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(n_ring - 1)
         )
+        o, m, l = accumulate(o, m, l, k_last, v_last, n_ring - 1)
         l = jnp.maximum(l, 1e-20)
         out = o / l[..., None].transpose(0, 2, 1, 3)
         return out.astype(q_blk.dtype)

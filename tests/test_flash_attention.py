@@ -70,3 +70,29 @@ def test_flash_bf16():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32), atol=3e-2, rtol=3e-2
     )
+
+
+def test_flash_cross_length_causal_end_aligned():
+    """lq != lk: causality must be end-aligned (tril k=lk-lq), the KV-cache
+    decode / chunked-prefill convention reference_attention implements."""
+    q, _, _ = make_qkv(l=128)
+    _, k, v = make_qkv(l=256, seed=1)
+    want = reference_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_flash_cross_length_causal_gradients():
+    q, _, _ = make_qkv(l=128)
+    _, k, v = make_qkv(l=256, seed=1)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True) ** 2)
+
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    g_fl = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ref, g_fl):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a), atol=5e-4, rtol=5e-4)

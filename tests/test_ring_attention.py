@@ -86,3 +86,14 @@ def test_lm_with_ring_attention_end_to_end(devices8):
     trainer = Trainer(cfg)
     state, summary = trainer.fit(steps=2)
     assert np.isfinite(summary["final"]["loss"])
+
+
+def test_ring_gqa_with_model_axis_not_dividing_kv_heads(devices8):
+    """n_kv_heads (2) < model axis (4): KV heads are repeated to Q heads
+    before sharding instead of crashing shard_map."""
+    mesh = build_mesh(MeshSpec(data=1, model=4, seq=2), devices=jax.devices()[:8])
+    q, k, v = make_qkv(h=8, hk=2)
+    want = reference_attention(q, k, v, causal=True)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh=mesh))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
