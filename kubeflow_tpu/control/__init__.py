@@ -1,0 +1,22 @@
+"""kubeflow_tpu.control — the Kubernetes control plane of the framework.
+
+The reference implements its control plane as Go kubebuilder operators
+(components/{notebook,profile,tensorboard}-controller, admission-webhook,
+access-management; shared lib components/common/reconcilehelper). This image
+ships no Go toolchain, so the TPU build implements the same capability
+surface in Python on an in-tree API machinery layer:
+
+- ``control.k8s``            — unstructured objects, an in-memory fake
+  cluster with watches/finalizers/ownerRef GC (the fake backend the
+  reference lacks — SURVEY.md §4), and a REST client for real apiservers.
+- ``control.runtime``        — the controller engine (workqueue + watches +
+  requeue; controller-runtime's Manager/Controller analogue).
+- ``control.reconcilehelper``— create-or-update diff/copy semantics
+  (components/common/reconcilehelper/util.go).
+- ``control.jaxjob``         — the training-job operator (TFJob/OpenMPI
+  analogue): gang TPU pod sets + jax.distributed env injection.
+- ``control.notebook``, ``control.profile``, ``control.tensorboard``,
+  ``control.poddefault`` (admission webhook), ``control.kfam``,
+  ``control.gatekeeper`` — the remaining operators/services, one per
+  reference component (SURVEY.md §2.2).
+"""

@@ -1,0 +1,16 @@
+"""JAXJob — the gang-scheduled TPU training-job operator.
+
+The TFJob/OpenMPI replacement (SURVEY.md §2.5, §3.2): where the reference
+wires GPU pods together with `TF_CONFIG` parameter-server gRPC
+(tf-controller-examples/tf-cnn/launcher.py:68-80) or MPI/NCCL
+(components/openmpi-controller), a JAXJob boots its workers into one
+`jax.distributed` cluster and gradient reduction happens inside the
+compiled step over ICI.
+"""
+
+from kubeflow_tpu.control.jaxjob.types import (  # noqa: F401
+    API_VERSION,
+    KIND,
+    new_jaxjob,
+)
+from kubeflow_tpu.control.jaxjob.controller import JAXJobReconciler, build_controller  # noqa: F401

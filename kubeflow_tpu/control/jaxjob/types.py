@@ -1,0 +1,148 @@
+"""JAXJob CRD: API types, defaults, validation.
+
+The reference's TFJob spec shape (replicaSpecs with per-replica pod
+templates — tf-controller-examples/tf-cnn/create_job_specs.py:125-191)
+collapses on TPU: parameter servers disappear (synchronous in-XLA
+allreduce replaces them) and MASTER/WORKER distinction reduces to
+process_id 0. A JAXJob is therefore one homogeneous worker set plus TPU
+slice topology.
+
+Condition types follow the Katib/TFJob contract that E2E tests poll
+(testing/katib_studyjob_test.py:128-194 waits on
+status.conditions[].type == Running): Created, Running, Restarting,
+Succeeded, Failed.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.control.k8s import objects as ob
+
+GROUP = "kubeflow.org"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "JAXJob"
+
+# Condition types (katib/tf-operator contract)
+COND_CREATED = "Created"
+COND_RUNNING = "Running"
+COND_RESTARTING = "Restarting"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+# Pod labels (the `notebook-name` analogue, notebook_controller.go:541-563)
+LABEL_JOB_NAME = "jaxjob.kubeflow.org/job-name"
+LABEL_REPLICA_INDEX = "jaxjob.kubeflow.org/replica-index"
+
+# Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env
+ENV_COORD = "JAXJOB_COORDINATOR_ADDRESS"
+ENV_NPROC = "JAXJOB_NUM_PROCESSES"
+ENV_PID = "JAXJOB_PROCESS_ID"
+ENV_NAME = "JAXJOB_NAME"
+ENV_NAMESPACE = "JAXJOB_NAMESPACE"
+
+# GKE TPU scheduling surface (the nvidia.com/gpu swap point —
+# create_job_specs.py:165-170 sets resources.limits["nvidia.com/gpu"])
+RESOURCE_TPU = "google.com/tpu"
+NODESELECTOR_ACCEL = "cloud.google.com/gke-tpu-accelerator"
+NODESELECTOR_TOPOLOGY = "cloud.google.com/gke-tpu-topology"
+
+DEFAULT_COORDINATOR_PORT = 8476
+RESTART_GANG = "GangOnFailure"
+RESTART_NEVER = "Never"
+
+
+def new_jaxjob(
+    name: str,
+    namespace: str = "default",
+    *,
+    replicas: int = 1,
+    image: str = "kubeflow-tpu/jaxrt:latest",
+    command: list[str] | None = None,
+    accelerator: str | None = None,
+    topology: str | None = None,
+    chips_per_worker: int = 4,
+    restart_policy: str = RESTART_GANG,
+    max_restarts: int = 3,
+) -> dict:
+    """Convenience constructor (the create_job_specs.py analogue)."""
+    spec: dict = {
+        "replicas": replicas,
+        "template": {
+            "metadata": {"labels": {}},
+            "spec": {
+                "containers": [
+                    {
+                        "name": "jax",
+                        "image": image,
+                        "command": command
+                        or ["python", "-m", "kubeflow_tpu.runtime.launcher"],
+                    }
+                ],
+                "restartPolicy": "Never",
+            },
+        },
+        "coordinatorPort": DEFAULT_COORDINATOR_PORT,
+        "restartPolicy": restart_policy,
+        "maxRestarts": max_restarts,
+    }
+    if accelerator:
+        spec["tpu"] = {
+            "accelerator": accelerator,
+            "topology": topology or "",
+            "chipsPerWorker": chips_per_worker,
+        }
+    return ob.new_object(API_VERSION, KIND, name, namespace, spec=spec)
+
+
+def validate(job: dict) -> list[str]:
+    """Spec validation; returned problems become Failed-condition reasons."""
+    errs = []
+    spec = job.get("spec") or {}
+    replicas = spec.get("replicas", 1)
+    if not isinstance(replicas, int) or replicas < 1:
+        errs.append(f"spec.replicas must be a positive int, got {replicas!r}")
+    tmpl = spec.get("template") or {}
+    containers = (tmpl.get("spec") or {}).get("containers") or []
+    if not containers:
+        errs.append("spec.template.spec.containers must have at least one container")
+    rp = spec.get("restartPolicy", RESTART_GANG)
+    if rp not in (RESTART_GANG, RESTART_NEVER):
+        errs.append(f"spec.restartPolicy must be {RESTART_GANG} or {RESTART_NEVER}")
+    port = spec.get("coordinatorPort", DEFAULT_COORDINATOR_PORT)
+    if not isinstance(port, int) or not (0 < port < 65536):
+        errs.append(f"spec.coordinatorPort invalid: {port!r}")
+    return errs
+
+
+def crd_manifest() -> dict:
+    """The CustomResourceDefinition applied by tpctl."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"jaxjobs.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": "JAXJobList",
+                "plural": "jaxjobs",
+                "singular": "jaxjob",
+                "shortNames": ["jj"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                }
+            ],
+        },
+    }

@@ -1,0 +1,30 @@
+"""In-tree Kubernetes API machinery.
+
+The reference leans on client-go / controller-runtime (Go). Here the same
+concepts are provided natively:
+
+- ``objects``  — unstructured dict objects + metadata/condition/selector
+  helpers (client-go's unstructured + apimachinery analogue).
+- ``fake``     — ``FakeCluster``: an in-memory apiserver with resource
+  versions, optimistic concurrency, label selectors, finalizers,
+  deletionTimestamps, ownerReference garbage collection and watch
+  streams. This is the hermetic test backend the reference never had
+  (it tested distributed behavior only on live GKE — SURVEY.md §4).
+- ``rest``     — ``RestClient``: the same Client interface speaking HTTPS
+  to a real apiserver (in-cluster config: serviceaccount token + CA).
+"""
+
+from kubeflow_tpu.control.k8s.objects import (  # noqa: F401
+    ApiError,
+    Conflict,
+    NotFound,
+    cond_get,
+    cond_set,
+    gvk,
+    match_labels,
+    meta,
+    new_object,
+    owner_ref,
+    set_owner,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: F401

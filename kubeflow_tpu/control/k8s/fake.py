@@ -1,0 +1,359 @@
+"""FakeCluster — an in-memory Kubernetes apiserver.
+
+The reference has *no* hermetic backend: its controllers are tested with
+either injected fakes (bootstrap/cmd/bootstrap/app/kfctlServer.go:66-67)
+or kubebuilder envtest binaries, and all distributed behavior runs on
+real per-CI GKE clusters (SURVEY.md §4). This class is the deliberate
+improvement: a single in-memory store with enough apiserver semantics —
+resource versions + optimistic concurrency, label/field selectors,
+finalizers + deletionTimestamp, ownerReference cascade GC, and watch
+streams — that every controller in kubeflow_tpu.control is testable in
+milliseconds, and the same Client interface retargets a live cluster via
+``rest.RestClient``.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+from kubeflow_tpu.control.k8s import objects as ob
+
+
+@dataclass(frozen=True)
+class Key:
+    api_version: str
+    kind: str
+    namespace: str  # "" for cluster-scoped
+    name: str
+
+
+@dataclass
+class WatchEvent:
+    type: str  # ADDED | MODIFIED | DELETED
+    object: dict
+
+
+@dataclass
+class _Watch:
+    api_version: str
+    kind: str
+    namespace: str | None
+    q: "queue.Queue[WatchEvent]" = field(default_factory=queue.Queue)
+    closed: bool = False
+
+
+class FakeCluster:
+    """In-memory apiserver + Client.
+
+    The Client surface (create/get/list/update/update_status/patch/delete/
+    watch/events) is shared with rest.RestClient, so controllers are
+    written once against either backend.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._store: dict[Key, dict] = {}
+        self._rv = 0
+        self._watches: list[_Watch] = []
+        # Mutating-webhook style interceptors: fn(verb, obj) -> obj.
+        # Lets tests wire the PodDefault webhook in-process exactly where
+        # the real admission chain sits (pod CREATE).
+        self._admission: list[Callable[[str, dict], dict]] = []
+
+    # -- internals ----------------------------------------------------------
+
+    def _next_rv(self) -> str:
+        self._rv += 1
+        return str(self._rv)
+
+    def _key(self, obj: dict) -> Key:
+        m = ob.meta(obj)
+        return Key(obj["apiVersion"], obj["kind"], m.get("namespace") or "", m["name"])
+
+    def _notify(self, etype: str, obj: dict) -> None:
+        for w in self._watches:
+            if w.closed:
+                continue
+            if (w.api_version, w.kind) != (obj["apiVersion"], obj["kind"]):
+                continue
+            ns = ob.meta(obj).get("namespace") or ""
+            if w.namespace is not None and w.namespace != ns:
+                continue
+            w.q.put(WatchEvent(etype, ob.deep_copy(obj)))
+
+    # -- admission ----------------------------------------------------------
+
+    def add_admission_hook(self, fn: Callable[[str, dict], dict]) -> None:
+        self._admission.append(fn)
+
+    # -- verbs --------------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        with self._lock:
+            obj = ob.deep_copy(obj)
+            for hook in self._admission:
+                obj = hook("CREATE", obj)
+            key = self._key(obj)
+            if key in self._store:
+                raise ob.Conflict(f"{key.kind} {key.namespace}/{key.name} already exists")
+            m = ob.meta(obj)
+            m.setdefault("uid", str(uuid.uuid4()))
+            m["resourceVersion"] = self._next_rv()
+            m.setdefault("creationTimestamp", ob.now_iso())
+            m.setdefault("generation", 1)
+            self._store[key] = obj
+            self._notify("ADDED", obj)
+            return ob.deep_copy(obj)
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
+        with self._lock:
+            key = Key(api_version, kind, namespace or "", name)
+            found = self._store.get(key)
+            if found is None:
+                raise ob.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            return ob.deep_copy(found)
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict | str | None = None,
+        field_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        if isinstance(label_selector, str):
+            label_selector = ob.parse_label_selector(label_selector)
+        with self._lock:
+            out = []
+            for key, obj in self._store.items():
+                if (key.api_version, key.kind) != (api_version, kind):
+                    continue
+                if namespace is not None and key.namespace != (namespace or ""):
+                    continue
+                if not ob.match_labels(ob.labels_of(obj), label_selector):
+                    continue
+                if not ob.match_fields(obj, field_selector):
+                    continue
+                out.append(ob.deep_copy(obj))
+            out.sort(key=lambda o: (ob.meta(o).get("namespace") or "", ob.meta(o)["name"]))
+            return out
+
+    def _update(self, obj: dict, subresource: str | None = None) -> dict:
+        with self._lock:
+            obj = ob.deep_copy(obj)
+            key = self._key(obj)
+            found = self._store.get(key)
+            if found is None:
+                raise ob.NotFound(f"{key.kind} {key.namespace}/{key.name} not found")
+            m, fm = ob.meta(obj), ob.meta(found)
+            if m.get("resourceVersion") and m["resourceVersion"] != fm["resourceVersion"]:
+                raise ob.Conflict(
+                    f"{key.kind} {key.name}: resourceVersion {m['resourceVersion']} "
+                    f"!= {fm['resourceVersion']} (object was modified)"
+                )
+            if subresource == "status":
+                # status updates cannot touch spec/metadata
+                new = ob.deep_copy(found)
+                new["status"] = obj.get("status", {})
+            else:
+                new = obj
+                # generation bumps on spec change (apiserver semantics)
+                if new.get("spec") != found.get("spec"):
+                    ob.meta(new)["generation"] = fm.get("generation", 1) + 1
+                else:
+                    ob.meta(new)["generation"] = fm.get("generation", 1)
+                new["metadata"] = {**fm, **ob.meta(new), "generation": ob.meta(new)["generation"]}
+                # immutable fields
+                new["metadata"]["uid"] = fm["uid"]
+                new["metadata"]["creationTimestamp"] = fm["creationTimestamp"]
+                if "deletionTimestamp" in fm:
+                    new["metadata"]["deletionTimestamp"] = fm["deletionTimestamp"]
+            ob.meta(new)["resourceVersion"] = self._next_rv()
+            self._store[key] = new
+            self._notify("MODIFIED", new)
+            self._maybe_finalize(key)
+            return ob.deep_copy(self._store[key]) if key in self._store else ob.deep_copy(new)
+
+    def update(self, obj: dict) -> dict:
+        return self._update(obj)
+
+    def update_status(self, obj: dict) -> dict:
+        return self._update(obj, subresource="status")
+
+    def patch(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        patch: dict | list,
+        namespace: str | None = None,
+    ) -> dict:
+        """dict → JSON merge patch; list → RFC6902 JSON patch."""
+        with self._lock:
+            cur = self.get(api_version, kind, name, namespace)
+            if isinstance(patch, list):
+                new = ob.json_patch(cur, patch)
+            else:
+                new = ob.merge_patch(cur, patch)
+            ob.meta(new)["resourceVersion"] = ob.meta(cur)["resourceVersion"]
+            return self._update(new)
+
+    def delete(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        namespace: str | None = None,
+    ) -> None:
+        with self._lock:
+            key = Key(api_version, kind, namespace or "", name)
+            found = self._store.get(key)
+            if found is None:
+                raise ob.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            m = ob.meta(found)
+            if m.get("finalizers"):
+                # graceful deletion: mark and wait for finalizers to clear
+                # (the Profile finalizer path — profile_controller.go:48)
+                if "deletionTimestamp" not in m:
+                    m["deletionTimestamp"] = ob.now_iso()
+                    m["resourceVersion"] = self._next_rv()
+                    self._notify("MODIFIED", found)
+                return
+            self._delete_now(key)
+
+    def _delete_now(self, key: Key) -> None:
+        found = self._store.pop(key, None)
+        if found is None:
+            return
+        self._notify("DELETED", found)
+        self._gc_orphans(found)
+
+    def _maybe_finalize(self, key: Key) -> None:
+        """If an object marked for deletion has no finalizers left, reap it."""
+        found = self._store.get(key)
+        if found is None:
+            return
+        m = ob.meta(found)
+        if "deletionTimestamp" in m and not m.get("finalizers"):
+            self._delete_now(key)
+
+    def _gc_orphans(self, deleted: dict) -> None:
+        """OwnerReference cascade: children of a deleted controller-owner
+        are deleted too (kube-controller-manager garbage collector; this is
+        what lets JAXJob/Notebook deletion tear down pods/services)."""
+        uid = ob.meta(deleted).get("uid")
+        if not uid:
+            return
+        victims = [
+            k
+            for k, o in self._store.items()
+            if any(r.get("uid") == uid for r in ob.meta(o).get("ownerReferences") or [])
+        ]
+        for k in victims:
+            obj = self._store.get(k)
+            if obj is None:
+                continue
+            m = ob.meta(obj)
+            refs = [r for r in m.get("ownerReferences") or [] if r.get("uid") != uid]
+            if refs:
+                m["ownerReferences"] = refs
+                continue
+            if m.get("finalizers"):
+                m.pop("ownerReferences", None)
+                m["deletionTimestamp"] = m.get("deletionTimestamp") or ob.now_iso()
+                m["resourceVersion"] = self._next_rv()
+                self._notify("MODIFIED", obj)
+            else:
+                self._delete_now(k)
+
+    # -- watch --------------------------------------------------------------
+
+    def watch(
+        self, api_version: str, kind: str, namespace: str | None = None
+    ) -> "FakeWatchStream":
+        with self._lock:
+            w = _Watch(api_version, kind, namespace)
+            self._watches.append(w)
+            return FakeWatchStream(self, w)
+
+    # -- events (corev1 Events; consumed by the notebook controller's
+    #    event-forwarding watch, notebook_controller.go:565-613, and JWA) --
+
+    def record_event(
+        self,
+        involved: dict,
+        reason: str,
+        message: str,
+        etype: str = "Normal",
+        component: str = "kubeflow-tpu",
+    ) -> dict:
+        m = ob.meta(involved)
+        ns = m.get("namespace") or "default"
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {
+                "name": f"{m['name']}.{uuid.uuid4().hex[:10]}",
+                "namespace": ns,
+            },
+            "involvedObject": {
+                "apiVersion": involved.get("apiVersion"),
+                "kind": involved.get("kind"),
+                "name": m["name"],
+                "namespace": ns,
+                "uid": m.get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "source": {"component": component},
+            "firstTimestamp": ob.now_iso(),
+            "lastTimestamp": ob.now_iso(),
+            "count": 1,
+        }
+        return self.create(ev)
+
+    # -- convenience --------------------------------------------------------
+
+    def get_or_none(self, api_version: str, kind: str, name: str, namespace: str | None = None):
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except ob.NotFound:
+            return None
+
+    def remove_finalizer(self, obj: dict, finalizer: str) -> dict:
+        cur = self.get(
+            obj["apiVersion"], obj["kind"], ob.meta(obj)["name"], ob.meta(obj).get("namespace")
+        )
+        fins = [f for f in ob.meta(cur).get("finalizers") or [] if f != finalizer]
+        ob.meta(cur)["finalizers"] = fins
+        return self.update(cur)
+
+
+class FakeWatchStream:
+    def __init__(self, cluster: FakeCluster, w: _Watch):
+        self._cluster = cluster
+        self._w = w
+
+    def __iter__(self) -> Iterator[WatchEvent]:
+        while not self._w.closed:
+            try:
+                yield self._w.q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+
+    def poll(self, timeout: float = 0.0) -> WatchEvent | None:
+        try:
+            return self._w.q.get(timeout=timeout) if timeout else self._w.q.get_nowait()
+        except queue.Empty:
+            return None
+
+    def stop(self) -> None:
+        self._w.closed = True
+        with self._cluster._lock:
+            if self._w in self._cluster._watches:
+                self._cluster._watches.remove(self._w)

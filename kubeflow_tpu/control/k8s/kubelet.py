@@ -1,0 +1,188 @@
+"""Fake kubelets: drive Pod phases against the FakeCluster.
+
+Two levels, matching the test tiers SURVEY.md §4 prescribes:
+
+- ``FakeKubelet`` — phase simulation for controller unit tests
+  (Pending -> Running via step(); tests flip terminal phases explicitly).
+- ``LocalPodExecutor`` — actually EXECUTES pod container commands as
+  local subprocesses with the pod's env (plus overrides), mapping exit
+  codes to Succeeded/Failed. This is what lets a JAXJob e2e test run a
+  real multi-process `jax.distributed` training gang on the dev machine —
+  the hermetic stand-in for the reference's per-CI-run GKE clusters.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import threading
+import time
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+
+log = logging.getLogger("kubeflow_tpu.kubelet")
+
+
+def _set_phase(cluster: FakeCluster, pod: dict, phase: str, **status_extra) -> dict | None:
+    m = ob.meta(pod)
+    try:
+        cur = cluster.get("v1", "Pod", m["name"], m.get("namespace"))
+    except ob.NotFound:
+        return None
+    cur.setdefault("status", {})
+    cur["status"]["phase"] = phase
+    cur["status"].update(status_extra)
+    return cluster.update_status(cur)
+
+
+class FakeKubelet:
+    """Pending -> Running on step(); terminal phases are test-driven."""
+
+    def __init__(self, cluster: FakeCluster):
+        self.cluster = cluster
+
+    def step(self) -> int:
+        moved = 0
+        for pod in self.cluster.list("v1", "Pod"):
+            if (pod.get("status") or {}).get("phase", "Pending") == "Pending":
+                _set_phase(
+                    self.cluster, pod, "Running",
+                    startTime=ob.now_iso(),
+                    containerStatuses=[
+                        {"name": c.get("name", "main"),
+                         "state": {"running": {"startedAt": ob.now_iso()}},
+                         "ready": True}
+                        for c in pod["spec"].get("containers", [])
+                    ],
+                )
+                moved += 1
+        return moved
+
+    def succeed(self, name: str, namespace: str = "default") -> None:
+        pod = self.cluster.get("v1", "Pod", name, namespace)
+        _set_phase(self.cluster, pod, "Succeeded")
+
+    def fail(self, name: str, namespace: str = "default", message: str = "boom") -> None:
+        pod = self.cluster.get("v1", "Pod", name, namespace)
+        _set_phase(
+            self.cluster, pod, "Failed",
+            containerStatuses=[{
+                "name": "main",
+                "state": {"terminated": {"exitCode": 1, "message": message}},
+                "ready": False,
+            }],
+        )
+
+
+class LocalPodExecutor:
+    """Run pod containers as local subprocesses.
+
+    Watches the cluster for pods (optionally label-filtered), launches
+    `spec.containers[0].command + args` with the container env exported,
+    and reflects process state back into pod.status.phase. DNS-style
+    coordinator addresses can't resolve locally, so callers provide
+    ``env_overrides`` per pod (e.g. rewrite JAXJOB_COORDINATOR_ADDRESS to
+    127.0.0.1) via a hook.
+    """
+
+    def __init__(
+        self,
+        cluster: FakeCluster,
+        label_selector: dict | None = None,
+        env_hook=None,  # fn(pod, env: dict) -> dict
+        cwd: str | None = None,
+    ):
+        self.cluster = cluster
+        self.label_selector = label_selector
+        self.env_hook = env_hook
+        self.cwd = cwd
+        self._procs: dict[tuple[str, str], subprocess.Popen] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+
+    def _pod_env(self, pod: dict) -> dict[str, str]:
+        env = dict(os.environ)
+        for c in pod["spec"].get("containers", [])[:1]:
+            for e in c.get("env", []):
+                if "value" in e:
+                    env[e["name"]] = str(e["value"])
+        if self.env_hook:
+            env = self.env_hook(pod, env)
+        return env
+
+    def poll_once(self) -> None:
+        """Launch new pods; harvest finished processes."""
+        pods = self.cluster.list("v1", "Pod", label_selector=self.label_selector)
+        with self._lock:
+            seen = set()
+            for pod in pods:
+                m = ob.meta(pod)
+                key = (m.get("namespace") or "default", m["name"])
+                seen.add(key)
+                phase = (pod.get("status") or {}).get("phase", "Pending")
+                if phase == "Pending" and key not in self._procs:
+                    c = pod["spec"]["containers"][0]
+                    cmd = list(c.get("command") or []) + list(c.get("args") or [])
+                    log.info("exec pod %s: %s", m["name"], " ".join(cmd))
+                    proc = subprocess.Popen(
+                        cmd,
+                        env=self._pod_env(pod),
+                        cwd=self.cwd,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT,
+                    )
+                    self._procs[key] = proc
+                    _set_phase(self.cluster, pod, "Running", startTime=ob.now_iso())
+            # harvest
+            for key, proc in list(self._procs.items()):
+                ns, name = key
+                rc = proc.poll()
+                pod = self.cluster.get_or_none("v1", "Pod", name, ns)
+                if pod is None:
+                    # pod deleted (gang restart): kill the process
+                    if rc is None:
+                        proc.kill()
+                        proc.wait(timeout=10)
+                    del self._procs[key]
+                    continue
+                if rc is None:
+                    continue
+                out = (proc.stdout.read() or b"").decode(errors="replace")
+                del self._procs[key]
+                if rc == 0:
+                    _set_phase(self.cluster, pod, "Succeeded")
+                else:
+                    log.warning("pod %s failed rc=%d\n%s", name, rc, out[-2000:])
+                    _set_phase(
+                        self.cluster, pod, "Failed",
+                        containerStatuses=[{
+                            "name": "main",
+                            "state": {"terminated": {"exitCode": rc,
+                                                     "message": out[-500:]}},
+                        }],
+                    )
+
+    def run_until_settled(self, timeout: float = 120.0, poll: float = 0.2) -> None:
+        """Poll until no tracked process is alive and no Pending pods remain."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            self.poll_once()
+            pods = self.cluster.list("v1", "Pod", label_selector=self.label_selector)
+            pending = any(
+                (p.get("status") or {}).get("phase", "Pending") in ("Pending", "Running")
+                for p in pods
+            )
+            if not pending and not self._procs:
+                return
+            time.sleep(poll)
+        raise TimeoutError("pods did not settle in time")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        with self._lock:
+            for proc in self._procs.values():
+                if proc.poll() is None:
+                    proc.kill()
+            self._procs.clear()

@@ -1,0 +1,279 @@
+"""RestClient — the same Client interface against a real apiserver.
+
+The reference's controllers get this from client-go / controller-runtime;
+here it is a thin HTTPS layer (requests) with in-cluster config loading
+(serviceaccount token + CA, exactly what client-go's rest.InClusterConfig
+does). Controllers written against FakeCluster run unmodified against a
+live cluster by swapping this in.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl  # noqa: F401  (documents the TLS dependency)
+from typing import Any
+
+from kubeflow_tpu.control.k8s import objects as ob
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind → (plural, cluster_scoped). CRDs registered by our operators are
+# included so no discovery round-trip is needed for the common path.
+_KINDS: dict[str, tuple[str, bool]] = {
+    "Pod": ("pods", False),
+    "Service": ("services", False),
+    "Endpoints": ("endpoints", False),
+    "Event": ("events", False),
+    "Namespace": ("namespaces", True),
+    "Node": ("nodes", True),
+    "ConfigMap": ("configmaps", False),
+    "Secret": ("secrets", False),
+    "ServiceAccount": ("serviceaccounts", False),
+    "PersistentVolumeClaim": ("persistentvolumeclaims", False),
+    "ResourceQuota": ("resourcequotas", False),
+    "Deployment": ("deployments", False),
+    "StatefulSet": ("statefulsets", False),
+    "Role": ("roles", False),
+    "RoleBinding": ("rolebindings", False),
+    "ClusterRole": ("clusterroles", True),
+    "ClusterRoleBinding": ("clusterrolebindings", True),
+    "StorageClass": ("storageclasses", True),
+    "CustomResourceDefinition": ("customresourcedefinitions", True),
+    "MutatingWebhookConfiguration": ("mutatingwebhookconfigurations", True),
+    "VirtualService": ("virtualservices", False),
+    "Gateway": ("gateways", False),
+    # kubeflow_tpu CRDs
+    "JAXJob": ("jaxjobs", False),
+    "Notebook": ("notebooks", False),
+    "Profile": ("profiles", True),
+    "Tensorboard": ("tensorboards", False),
+    "PodDefault": ("poddefaults", False),
+    "StudyJob": ("studyjobs", False),
+    "TpuDef": ("tpudefs", True),
+}
+
+
+def plural_of(kind: str) -> tuple[str, bool]:
+    if kind in _KINDS:
+        return _KINDS[kind]
+    p = kind.lower()
+    p = p + "es" if p.endswith(("s", "x", "ch")) else p[:-1] + "ies" if p.endswith("y") else p + "s"
+    return p, False
+
+
+def _label_selector_str(sel: dict | str | None) -> str | None:
+    if sel is None or isinstance(sel, str):
+        return sel
+    parts = [f"{k}={v}" for k, v in (sel.get("matchLabels") or {}).items()]
+    for e in sel.get("matchExpressions") or []:
+        if e["operator"] == "Exists":
+            parts.append(e["key"])
+        elif e["operator"] == "In" and len(e.get("values", [])) == 1:
+            parts.append(f"{e['key']}={e['values'][0]}")
+        elif e["operator"] == "NotIn" and len(e.get("values", [])) == 1:
+            parts.append(f"{e['key']}!={e['values'][0]}")
+        else:
+            raise ob.Invalid("string selectors support only single-value In/NotIn/Exists")
+    return ",".join(parts)
+
+
+class RestClient:
+    def __init__(
+        self,
+        base_url: str | None = None,
+        token: str | None = None,
+        ca_cert: str | bool | None = None,
+        namespace: str | None = None,
+    ):
+        import requests
+
+        if base_url is None:  # in-cluster config (rest.InClusterConfig analogue)
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            base_url = f"https://{host}:{port}"
+            tok_path = os.path.join(SA_DIR, "token")
+            if token is None and os.path.exists(tok_path):
+                token = open(tok_path).read().strip()
+            ca_path = os.path.join(SA_DIR, "ca.crt")
+            if ca_cert is None and os.path.exists(ca_path):
+                ca_cert = ca_path
+            ns_path = os.path.join(SA_DIR, "namespace")
+            if namespace is None and os.path.exists(ns_path):
+                namespace = open(ns_path).read().strip()
+        self.base_url = base_url.rstrip("/")
+        self.namespace = namespace or "default"
+        self._s = requests.Session()
+        if token:
+            self._s.headers["Authorization"] = f"Bearer {token}"
+        self._s.verify = ca_cert if ca_cert is not None else False
+
+    # -- path construction --------------------------------------------------
+
+    def _path(self, api_version: str, kind: str, namespace: str | None, name: str | None) -> str:
+        prefix = "/api/v1" if api_version == "v1" else f"/apis/{api_version}"
+        plural, cluster_scoped = plural_of(kind)
+        parts = [prefix]
+        if not cluster_scoped and namespace:
+            parts += ["namespaces", namespace]
+        parts.append(plural)
+        if name:
+            parts.append(name)
+        return "/".join(parts)
+
+    def _req(self, method: str, path: str, **kw) -> Any:
+        r = self._s.request(method, self.base_url + path, timeout=30, **kw)
+        if r.status_code == 404:
+            raise ob.NotFound(f"{method} {path}: {r.text[:200]}")
+        if r.status_code == 409:
+            raise ob.Conflict(f"{method} {path}: {r.text[:200]}")
+        if r.status_code >= 400:
+            err = ob.ApiError(f"{method} {path}: HTTP {r.status_code}: {r.text[:500]}")
+            err.code = r.status_code
+            raise err
+        return r.json() if r.content else None
+
+    # -- Client verbs -------------------------------------------------------
+
+    def create(self, obj: dict) -> dict:
+        m = ob.meta(obj)
+        path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), None)
+        return self._req("POST", path, json=obj)
+
+    def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
+        return self._req("GET", self._path(api_version, kind, namespace, name))
+
+    def get_or_none(self, api_version: str, kind: str, name: str, namespace: str | None = None):
+        try:
+            return self.get(api_version, kind, name, namespace)
+        except ob.NotFound:
+            return None
+
+    def list(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict | str | None = None,
+        field_selector: dict[str, str] | None = None,
+    ) -> list[dict]:
+        params: dict[str, str] = {}
+        sel = _label_selector_str(label_selector)
+        if sel:
+            params["labelSelector"] = sel
+        if field_selector:
+            params["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
+        out = self._req("GET", self._path(api_version, kind, namespace, None), params=params)
+        items = out.get("items", [])
+        for it in items:  # apiserver omits these on list items
+            it.setdefault("apiVersion", api_version)
+            it.setdefault("kind", kind)
+        return items
+
+    def update(self, obj: dict) -> dict:
+        m = ob.meta(obj)
+        path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"])
+        return self._req("PUT", path, json=obj)
+
+    def update_status(self, obj: dict) -> dict:
+        m = ob.meta(obj)
+        path = self._path(obj["apiVersion"], obj["kind"], m.get("namespace"), m["name"]) + "/status"
+        return self._req("PUT", path, json=obj)
+
+    def patch(
+        self,
+        api_version: str,
+        kind: str,
+        name: str,
+        patch: dict | list,
+        namespace: str | None = None,
+    ) -> dict:
+        path = self._path(api_version, kind, namespace, name)
+        ctype = (
+            "application/json-patch+json"
+            if isinstance(patch, list)
+            else "application/merge-patch+json"
+        )
+        return self._req(
+            "PATCH", path, data=json.dumps(patch), headers={"Content-Type": ctype}
+        )
+
+    def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
+        self._req("DELETE", self._path(api_version, kind, namespace, name))
+
+    def record_event(
+        self,
+        involved: dict,
+        reason: str,
+        message: str,
+        etype: str = "Normal",
+        component: str = "kubeflow-tpu",
+    ) -> dict:
+        import uuid
+
+        m = ob.meta(involved)
+        ns = m.get("namespace") or "default"
+        ev = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": f"{m['name']}.{uuid.uuid4().hex[:10]}", "namespace": ns},
+            "involvedObject": {
+                "apiVersion": involved.get("apiVersion"),
+                "kind": involved.get("kind"),
+                "name": m["name"],
+                "namespace": ns,
+                "uid": m.get("uid", ""),
+            },
+            "reason": reason,
+            "message": message,
+            "type": etype,
+            "source": {"component": component},
+            "firstTimestamp": ob.now_iso(),
+            "lastTimestamp": ob.now_iso(),
+            "count": 1,
+        }
+        return self.create(ev)
+
+    def watch(self, api_version: str, kind: str, namespace: str | None = None):
+        """Streaming watch (chunked JSON lines), reconnecting on EOF."""
+        return _RestWatchStream(self, api_version, kind, namespace)
+
+
+class _RestWatchStream:
+    def __init__(self, client: RestClient, api_version: str, kind: str, namespace: str | None):
+        self._c = client
+        self._args = (api_version, kind, namespace)
+        self._closed = False
+
+    def __iter__(self):
+        from kubeflow_tpu.control.k8s.fake import WatchEvent
+
+        api_version, kind, namespace = self._args
+        rv = ""
+        while not self._closed:
+            params = {"watch": "1", "allowWatchBookmarks": "false"}
+            if rv:
+                params["resourceVersion"] = rv
+            path = self._c._path(api_version, kind, namespace, None)
+            r = self._c._s.get(
+                self._c.base_url + path, params=params, stream=True, timeout=300
+            )
+            try:
+                for line in r.iter_lines():
+                    if self._closed:
+                        return
+                    if not line:
+                        continue
+                    ev = json.loads(line)
+                    obj = ev.get("object", {})
+                    rv = ob.meta(obj).get("resourceVersion", rv)
+                    if ev.get("type") in ("ADDED", "MODIFIED", "DELETED"):
+                        yield WatchEvent(ev["type"], obj)
+            except Exception:
+                if self._closed:
+                    return
+            finally:
+                r.close()
+
+    def stop(self):
+        self._closed = True

@@ -1,0 +1,282 @@
+"""K8s API machinery + controller engine tests.
+
+Mirrors the unit tier of the reference (SURVEY.md §4 tier 1): fake-client
+driven controller semantics, here against the in-memory FakeCluster.
+"""
+
+import pytest
+
+from kubeflow_tpu.control import reconcilehelper as rh
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result, seed_controller
+
+
+def make_pod(name, ns="default", labels=None, phase="Pending"):
+    pod = ob.new_object("v1", "Pod", name, ns, labels=labels, spec={"containers": []})
+    pod["status"] = {"phase": phase}
+    return pod
+
+
+class TestObjects:
+    def test_label_selector(self):
+        labels = {"app": "nb", "tier": "web"}
+        assert ob.match_labels(labels, {"matchLabels": {"app": "nb"}})
+        assert not ob.match_labels(labels, {"matchLabels": {"app": "x"}})
+        assert ob.match_labels(labels, None)
+        sel = {"matchExpressions": [{"key": "tier", "operator": "In", "values": ["web", "db"]}]}
+        assert ob.match_labels(labels, sel)
+        sel = {"matchExpressions": [{"key": "zone", "operator": "DoesNotExist"}]}
+        assert ob.match_labels(labels, sel)
+
+    def test_parse_label_selector(self):
+        sel = ob.parse_label_selector("a=b, c!=d, e")
+        assert sel["matchLabels"] == {"a": "b"}
+        ops = {(x["key"], x["operator"]) for x in sel["matchExpressions"]}
+        assert ops == {("c", "NotIn"), ("e", "Exists")}
+
+    def test_conditions_transition_time(self):
+        obj = {}
+        assert ob.cond_set(obj, "Running", "True", "Started")
+        t1 = ob.cond_get(obj, "Running")["lastTransitionTime"]
+        # same status → no transition change
+        ob.cond_set(obj, "Running", "True", "StillGoing")
+        assert ob.cond_get(obj, "Running")["lastTransitionTime"] == t1
+        assert ob.cond_get(obj, "Running")["reason"] == "StillGoing"
+        assert ob.cond_is_true(obj, "Running")
+
+    def test_json_patch(self):
+        doc = {"spec": {"containers": [{"env": [{"name": "A", "value": "1"}]}]}}
+        out = ob.json_patch(
+            doc,
+            [
+                {"op": "add", "path": "/spec/containers/0/env/-",
+                 "value": {"name": "B", "value": "2"}},
+                {"op": "replace", "path": "/spec/containers/0/env/0/value", "value": "9"},
+            ],
+        )
+        envs = out["spec"]["containers"][0]["env"]
+        assert envs == [{"name": "A", "value": "9"}, {"name": "B", "value": "2"}]
+        assert doc["spec"]["containers"][0]["env"][0]["value"] == "1"  # original untouched
+
+    def test_merge_patch_null_deletes(self):
+        out = ob.merge_patch({"a": {"b": 1, "c": 2}}, {"a": {"b": None, "d": 3}})
+        assert out == {"a": {"c": 2, "d": 3}}
+
+
+class TestFakeCluster:
+    def test_crud_and_rv_conflict(self):
+        c = FakeCluster()
+        pod = c.create(make_pod("p1"))
+        assert ob.meta(pod)["uid"]
+        stale = ob.deep_copy(pod)
+        pod["spec"]["containers"] = [{"name": "x"}]
+        c.update(pod)
+        stale["spec"]["containers"] = [{"name": "y"}]
+        with pytest.raises(ob.Conflict):
+            c.update(stale)
+
+    def test_duplicate_create_conflicts(self):
+        c = FakeCluster()
+        c.create(make_pod("p1"))
+        with pytest.raises(ob.Conflict):
+            c.create(make_pod("p1"))
+
+    def test_generation_bumps_on_spec_change_only(self):
+        c = FakeCluster()
+        nb = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default",
+                                    spec={"image": "a"}))
+        assert ob.meta(nb)["generation"] == 1
+        nb["status"] = {"readyReplicas": 1}
+        nb = c.update_status(nb)
+        assert ob.meta(nb)["generation"] == 1
+        nb["spec"]["image"] = "b"
+        nb = c.update(nb)
+        assert ob.meta(nb)["generation"] == 2
+
+    def test_update_status_subresource_isolated(self):
+        c = FakeCluster()
+        nb = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default",
+                                    spec={"image": "a"}))
+        mutated = ob.deep_copy(nb)
+        mutated["spec"]["image"] = "EVIL"
+        mutated["status"] = {"phase": "Ready"}
+        c.update_status(mutated)
+        got = c.get("kubeflow.org/v1beta1", "Notebook", "n", "default")
+        assert got["spec"]["image"] == "a"
+        assert got["status"]["phase"] == "Ready"
+
+    def test_list_selectors(self):
+        c = FakeCluster()
+        c.create(make_pod("a", labels={"job": "j1"}))
+        c.create(make_pod("b", labels={"job": "j2"}))
+        c.create(make_pod("c", ns="other", labels={"job": "j1"}))
+        assert len(c.list("v1", "Pod")) == 3
+        assert len(c.list("v1", "Pod", namespace="default")) == 2
+        assert [ob.meta(p)["name"] for p in c.list("v1", "Pod", label_selector="job=j1",
+                                                   namespace="default")] == ["a"]
+        c.patch("v1", "Pod", "a", {"status": {"phase": "Running"}}, "default")
+        running = c.list("v1", "Pod", field_selector={"status.phase": "Running"})
+        assert [ob.meta(p)["name"] for p in running] == ["a"]
+
+    def test_finalizer_blocks_deletion(self):
+        c = FakeCluster()
+        prof = ob.new_object("kubeflow.org/v1", "Profile", "team-a", spec={"owner": "u"})
+        ob.meta(prof)["finalizers"] = ["profile-finalizer"]
+        prof = c.create(prof)
+        c.delete("kubeflow.org/v1", "Profile", "team-a")
+        got = c.get("kubeflow.org/v1", "Profile", "team-a")
+        assert "deletionTimestamp" in ob.meta(got)
+        c.remove_finalizer(got, "profile-finalizer")
+        assert c.get_or_none("kubeflow.org/v1", "Profile", "team-a") is None
+
+    def test_owner_gc_cascade(self):
+        c = FakeCluster()
+        job = c.create(ob.new_object("kubeflow.org/v1alpha1", "JAXJob", "j", "default",
+                                     spec={}))
+        pod = make_pod("j-worker-0")
+        ob.set_owner(pod, job)
+        c.create(pod)
+        svc = ob.new_object("v1", "Service", "j", "default", spec={"clusterIP": "None"})
+        ob.set_owner(svc, job)
+        c.create(svc)
+        c.delete("kubeflow.org/v1alpha1", "JAXJob", "j", "default")
+        assert c.get_or_none("v1", "Pod", "j-worker-0", "default") is None
+        assert c.get_or_none("v1", "Service", "j", "default") is None
+
+    def test_watch_stream(self):
+        c = FakeCluster()
+        w = c.watch("v1", "Pod", namespace="default")
+        c.create(make_pod("p"))
+        c.create(make_pod("q", ns="other"))  # filtered out
+        ev = w.poll()
+        assert ev.type == "ADDED" and ob.meta(ev.object)["name"] == "p"
+        assert w.poll() is None
+        w.stop()
+
+    def test_admission_hook_on_create(self):
+        c = FakeCluster()
+
+        def inject(verb, obj):
+            if verb == "CREATE" and obj["kind"] == "Pod":
+                obj.setdefault("metadata", {}).setdefault("annotations", {})["mutated"] = "yes"
+            return obj
+
+        c.add_admission_hook(inject)
+        pod = c.create(make_pod("p"))
+        assert ob.annotations_of(pod)["mutated"] == "yes"
+
+    def test_events(self):
+        c = FakeCluster()
+        nb = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default", spec={}))
+        c.record_event(nb, "Created", "statefulset created")
+        evs = c.list("v1", "Event", namespace="default")
+        assert len(evs) == 1
+        assert evs[0]["involvedObject"]["name"] == "n"
+
+
+class TestReconcileHelper:
+    def test_service_preserves_cluster_ip(self):
+        c = FakeCluster()
+        owner = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default",
+                                       spec={}))
+        desired = ob.new_object("v1", "Service", "n", "default",
+                                spec={"ports": [{"port": 80}], "selector": {"app": "n"}})
+        created = rh.reconcile_child(c, owner, desired)
+        created["spec"]["clusterIP"] = "10.0.0.7"  # simulate allocation
+        c.update(created)
+        # change desired ports; clusterIP must survive the update
+        desired2 = ob.new_object("v1", "Service", "n", "default",
+                                 spec={"ports": [{"port": 8080}], "selector": {"app": "n"}})
+        updated = rh.reconcile_child(c, owner, desired2)
+        assert updated["spec"]["clusterIP"] == "10.0.0.7"
+        assert updated["spec"]["ports"] == [{"port": 8080}]
+
+    def test_statefulset_copies_replicas_and_template_only(self):
+        c = FakeCluster()
+        owner = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default",
+                                       spec={}))
+        desired = ob.new_object("apps/v1", "StatefulSet", "n", "default",
+                                spec={"replicas": 1, "template": {"spec": {"c": 1}},
+                                      "serviceName": "n"})
+        found = rh.reconcile_child(c, owner, desired)
+        # cluster adds a field the controller must not fight over
+        found["spec"]["podManagementPolicy"] = "OrderedReady"
+        c.update(found)
+        desired["spec"]["replicas"] = 0  # culling scale-to-zero
+        updated = rh.reconcile_child(c, owner, desired)
+        assert updated["spec"]["replicas"] == 0
+        assert updated["spec"]["podManagementPolicy"] == "OrderedReady"
+
+    def test_idempotent_no_update(self):
+        c = FakeCluster()
+        owner = c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "default",
+                                       spec={}))
+        desired = ob.new_object("v1", "Service", "n", "default", spec={"ports": [{"port": 80}]})
+        first = rh.reconcile_child(c, owner, ob.deep_copy(desired))
+        rv = ob.meta(first)["resourceVersion"]
+        second = rh.reconcile_child(c, owner, ob.deep_copy(desired))
+        assert ob.meta(second)["resourceVersion"] == rv  # no write happened
+
+
+class _CountingReconciler(Reconciler):
+    def __init__(self):
+        self.seen = []
+        self.requeue_once = set()
+
+    def reconcile(self, client, req):
+        self.seen.append(req)
+        if req in self.requeue_once:
+            self.requeue_once.discard(req)
+            return Result(requeue_after=60.0)
+        return None
+
+
+class TestControllerEngine:
+    def test_primary_and_owns_dispatch(self):
+        c = FakeCluster()
+        rec = _CountingReconciler()
+        ctl = Controller("jaxjob", c, rec).watches_primary(
+            "kubeflow.org/v1alpha1", "JAXJob").owns("v1", "Pod")
+        seed_controller(ctl)
+        job = c.create(ob.new_object("kubeflow.org/v1alpha1", "JAXJob", "j", "ns1", spec={}))
+        ctl.run_until_idle()
+        assert Request("ns1", "j") in rec.seen
+        rec.seen.clear()
+        pod = make_pod("j-w-0", ns="ns1")
+        ob.set_owner(pod, job)
+        c.create(pod)
+        ctl.run_until_idle()
+        assert rec.seen == [Request("ns1", "j")]  # owned pod maps to owner
+
+    def test_requeue_after_advance(self):
+        c = FakeCluster()
+        rec = _CountingReconciler()
+        ctl = Controller("nb", c, rec).watches_primary("kubeflow.org/v1beta1", "Notebook")
+        seed_controller(ctl)
+        c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "ns", spec={}))
+        rec.requeue_once.add(Request("ns", "n"))
+        ctl.run_until_idle()
+        assert len(rec.seen) == 1
+        ctl.run_until_idle(advance_delayed=True)  # fast-forward the 60s requeue
+        assert len(rec.seen) == 2
+
+    def test_error_retry(self):
+        c = FakeCluster()
+
+        class Flaky(Reconciler):
+            def __init__(self):
+                self.calls = 0
+
+            def reconcile(self, client, req):
+                self.calls += 1
+                if self.calls == 1:
+                    raise RuntimeError("transient")
+
+        rec = Flaky()
+        ctl = Controller("x", c, rec).watches_primary("kubeflow.org/v1beta1", "Notebook")
+        seed_controller(ctl)
+        c.create(ob.new_object("kubeflow.org/v1beta1", "Notebook", "n", "ns", spec={}))
+        ctl.run_until_idle(advance_delayed=True)
+        ctl.run_until_idle(advance_delayed=True)
+        assert rec.calls >= 2

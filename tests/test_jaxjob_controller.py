@@ -1,0 +1,224 @@
+"""JAXJob controller semantics against the fake cluster.
+
+The behaviors the reference delegated to the external tf-operator +
+launcher.py, specified by their consumers (SURVEY.md §3.2): gang pod
+creation, env-var topology injection, condition lifecycle matching the
+katib polling contract, and gang restart (which the reference's
+per-replica restartPolicy never provided).
+"""
+
+import pytest
+
+from kubeflow_tpu.control.jaxjob import types as T
+from kubeflow_tpu.control.jaxjob.controller import build_controller, worker_name
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.control.runtime import seed_controller
+
+
+@pytest.fixture()
+def world():
+    cluster = FakeCluster()
+    ctl = seed_controller(build_controller(cluster, record_events=True))
+    kubelet = FakeKubelet(cluster)
+    return cluster, ctl, kubelet
+
+
+def drain(ctl):
+    # a few advance rounds so requeue_after paths fire without sleeping
+    for _ in range(6):
+        ctl.run_until_idle(advance_delayed=True)
+
+
+def make_job(cluster, **kw):
+    job = T.new_jaxjob("train", replicas=kw.pop("replicas", 4),
+                       accelerator=kw.pop("accelerator", "tpu-v5-lite-podslice"),
+                       topology=kw.pop("topology", "2x4"), **kw)
+    return cluster.create(job)
+
+
+class TestGangCreation:
+    def test_creates_service_and_full_gang(self, world):
+        cluster, ctl, _ = world
+        make_job(cluster, replicas=4)
+        drain(ctl)
+        svc = cluster.get("v1", "Service", "train", "default")
+        assert svc["spec"]["clusterIP"] == "None"
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert len(pods) == 4
+        names = {ob.meta(p)["name"] for p in pods}
+        assert names == {worker_name("train", i) for i in range(4)}
+
+    def test_env_injection_contract(self, world):
+        cluster, ctl, _ = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        pod1 = cluster.get("v1", "Pod", worker_name("train", 1), "default")
+        env = {e["name"]: e["value"] for e in pod1["spec"]["containers"][0]["env"]}
+        assert env[T.ENV_COORD] == "train-worker-0.train.default.svc:8476"
+        assert env[T.ENV_NPROC] == "2"
+        assert env[T.ENV_PID] == "1"
+        assert env[T.ENV_NAME] == "train"
+        # stable DNS wiring
+        assert pod1["spec"]["hostname"] == "train-worker-1"
+        assert pod1["spec"]["subdomain"] == "train"
+
+    def test_tpu_resources_and_node_selectors(self, world):
+        cluster, ctl, _ = world
+        make_job(cluster, replicas=1)
+        drain(ctl)
+        pod = cluster.get("v1", "Pod", worker_name("train", 0), "default")
+        limits = pod["spec"]["containers"][0]["resources"]["limits"]
+        assert limits[T.RESOURCE_TPU] == 4
+        sel = pod["spec"]["nodeSelector"]
+        assert sel[T.NODESELECTOR_ACCEL] == "tpu-v5-lite-podslice"
+        assert sel[T.NODESELECTOR_TOPOLOGY] == "2x4"
+
+    def test_no_tpu_block_means_no_tpu_resources(self, world):
+        cluster, ctl, _ = world
+        job = T.new_jaxjob("cpu-job", replicas=1)
+        cluster.create(job)
+        drain(ctl)
+        pod = cluster.get("v1", "Pod", worker_name("cpu-job", 0), "default")
+        assert "resources" not in pod["spec"]["containers"][0] or (
+            T.RESOURCE_TPU
+            not in pod["spec"]["containers"][0].get("resources", {}).get("limits", {})
+        )
+
+    def test_validation_failure_sets_failed_condition(self, world):
+        cluster, ctl, _ = world
+        bad = T.new_jaxjob("bad", replicas=0)
+        cluster.create(bad)
+        drain(ctl)
+        got = cluster.get(T.API_VERSION, T.KIND, "bad", "default")
+        c = ob.cond_get(got, T.COND_FAILED)
+        assert c and c["status"] == "True" and c["reason"] == "ValidationFailed"
+        assert not cluster.list("v1", "Pod", namespace="default")
+
+
+class TestLifecycle:
+    def test_conditions_follow_pod_phases(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_CREATED)
+        assert not ob.cond_is_true(job, T.COND_RUNNING)
+
+        kubelet.step()  # Pending -> Running
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_RUNNING)
+        assert job["status"]["replicaStatuses"]["active"] == 2
+        assert "startTime" in job["status"]
+
+        kubelet.succeed(worker_name("train", 0))
+        kubelet.succeed(worker_name("train", 1))
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_SUCCEEDED)
+        assert not ob.cond_is_true(job, T.COND_RUNNING)  # katib contract: flips off
+        assert "completionTime" in job["status"]
+
+    def test_events_recorded(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=1)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        reasons = {e["reason"] for e in cluster.list("v1", "Event", namespace="default")}
+        assert "JAXJobCreated" in reasons
+        assert "JAXJobRunning" in reasons
+
+    def test_deleting_job_cascades_to_pods(self, world):
+        cluster, ctl, _ = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        assert len(cluster.list("v1", "Pod", namespace="default")) == 2
+        cluster.delete(T.API_VERSION, T.KIND, "train", "default")
+        assert cluster.list("v1", "Pod", namespace="default") == []
+        assert cluster.get_or_none("v1", "Service", "train", "default") is None
+
+
+class TestGangRestart:
+    def test_worker_failure_restarts_whole_gang(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=3)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        kubelet.fail(worker_name("train", 1))
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["restarts"] == 1
+        # the whole gang was recreated: all pods fresh (Pending again)
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert len(pods) == 3
+        assert all((p.get("status") or {}).get("phase", "Pending") == "Pending"
+                   for p in pods)
+        c = ob.cond_get(job, T.COND_RESTARTING)
+        assert c and c["status"] == "True"
+
+    def test_restart_never_policy_fails_immediately(self, world):
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("train", replicas=2, restart_policy=T.RESTART_NEVER)
+        cluster.create(job)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        kubelet.fail(worker_name("train", 0))
+        drain(ctl)
+        got = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(got, T.COND_FAILED)
+        assert got["status"].get("restarts", 0) == 0
+
+    def test_restarts_exhaust_to_failed(self, world):
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("train", replicas=1, max_restarts=2)
+        cluster.create(job)
+        for i in range(3):
+            drain(ctl)
+            kubelet.step()
+            drain(ctl)
+            kubelet.fail(worker_name("train", 0))
+            drain(ctl)
+        got = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(got, T.COND_FAILED)
+        assert got["status"]["restarts"] == 2
+
+    def test_deleted_worker_triggers_gang_restart(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=3)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        cluster.delete("v1", "Pod", worker_name("train", 2), "default")
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["restarts"] >= 1
+        assert len(cluster.list("v1", "Pod", namespace="default")) == 3
+
+
+class TestIdempotency:
+    def test_reconcile_is_idempotent(self, world):
+        """The kfctl_second_apply.py analogue: re-reconciling a settled job
+        changes nothing."""
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        pods_before = {
+            ob.meta(p)["name"]: ob.meta(p)["resourceVersion"]
+            for p in cluster.list("v1", "Pod", namespace="default")
+        }
+        from kubeflow_tpu.control.runtime import Request
+
+        for _ in range(3):
+            ctl.reconciler.reconcile(cluster, Request("default", "train"))
+        pods_after = {
+            ob.meta(p)["name"]: ob.meta(p)["resourceVersion"]
+            for p in cluster.list("v1", "Pod", namespace="default")
+        }
+        assert pods_before == pods_after
