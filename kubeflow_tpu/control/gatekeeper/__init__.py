@@ -1,0 +1,6 @@
+"""Gatekeeper — basic-auth authservice for mesh ext-authz.
+
+Reference: components/gatekeeper (SURVEY.md §2.2).
+"""
+
+from kubeflow_tpu.control.gatekeeper.auth import AuthServer, pwhash  # noqa: F401

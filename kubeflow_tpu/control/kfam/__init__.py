@@ -1,0 +1,7 @@
+"""KFAM — Kubeflow Access Management REST service.
+
+Reference: components/access-management (SURVEY.md §2.2): profile +
+contributor (RoleBinding) management consumed by the central dashboard.
+"""
+
+from kubeflow_tpu.control.kfam.service import KfamService  # noqa: F401

@@ -1,0 +1,15 @@
+"""Notebook operator — managed Jupyter servers with idle culling.
+
+Reference: components/notebook-controller (SURVEY.md §2.2). A Notebook CR
+becomes a StatefulSet (1 replica) + Service (80 -> 8888) + optional Istio
+VirtualService; status is derived from the pod's container state; idle
+servers are culled (scaled to zero) via the Jupyter /api/status probe.
+TPU twist: notebook images are JAX + libtpu (not CUDA TF), and TPU chips
+are requested through the same resources/nodeSelector surface JAXJob uses.
+"""
+
+from kubeflow_tpu.control.notebook.types import API_VERSION, KIND, new_notebook  # noqa: F401
+from kubeflow_tpu.control.notebook.controller import (  # noqa: F401
+    NotebookReconciler,
+    build_controller,
+)

@@ -1,0 +1,222 @@
+"""Notebook controller: CR -> StatefulSet + Service + VirtualService.
+
+Reconcile mirrors notebook_controller.go:85-279; generators mirror
+generateStatefulSet :282-348, generateService :349-380,
+generateVirtualService :382-443. Env knobs kept: USE_ISTIO, ISTIO_GATEWAY,
+CLUSTER_DOMAIN, ADD_FSGROUP. Status is derived from the pod's container
+state (:200-231), and namespace Events involving the notebook's pod are
+re-emitted onto the Notebook (:565-613) so JWA/dashboard can show them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import prometheus_client as prom
+
+from kubeflow_tpu.control import reconcilehelper as rh
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.notebook import culler
+from kubeflow_tpu.control.notebook import types as T
+from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
+
+log = logging.getLogger("kubeflow_tpu.notebook")
+
+_METRICS: dict[str, object] = {}
+
+
+def _metric(name, kind, doc):
+    if name not in _METRICS:
+        _METRICS[name] = kind(name, doc)
+    return _METRICS[name]
+
+
+# metrics.go:27-61 names kept
+def nb_created():
+    return _metric("notebook_create_total", prom.Counter, "notebooks created")
+
+
+def nb_culled():
+    return _metric("notebook_culling_total", prom.Counter, "notebooks culled")
+
+
+def use_istio() -> bool:
+    return os.environ.get("USE_ISTIO", "false").lower() == "true"
+
+
+def istio_gateway() -> str:
+    return os.environ.get("ISTIO_GATEWAY", "kubeflow/kubeflow-gateway")
+
+
+def cluster_domain() -> str:
+    return os.environ.get("CLUSTER_DOMAIN", "cluster.local")
+
+
+class NotebookReconciler(Reconciler):
+    def __init__(self, probe=culler.default_probe):
+        self.probe = probe
+
+    # -- generators ---------------------------------------------------------
+
+    def generate_statefulset(self, nb: dict) -> dict:
+        m = ob.meta(nb)
+        tmpl = ob.deep_copy((nb.get("spec") or {}).get("template") or {"spec": {}})
+        pod_spec = tmpl.setdefault("spec", {})
+        containers = pod_spec.setdefault("containers", [{}])
+        c0 = containers[0]
+        c0.setdefault("name", m["name"])
+        c0.setdefault("workingDir", T.HOME_DIR)  # :318
+        c0.setdefault("ports", [{"containerPort": T.CONTAINER_PORT, "name": "notebook-port",
+                                 "protocol": "TCP"}])
+        env = c0.setdefault("env", [])
+        if not any(e.get("name") == T.ENV_NB_PREFIX for e in env):
+            env.append({"name": T.ENV_NB_PREFIX,
+                        "value": f"/notebook/{m['namespace']}/{m['name']}"})  # :329-332
+        if os.environ.get("ADD_FSGROUP", "true").lower() == "true":
+            pod_spec.setdefault("securityContext", {}).setdefault("fsGroup", 100)  # :338-345
+
+        labels = tmpl.setdefault("metadata", {}).setdefault("labels", {})
+        labels[T.LABEL_NOTEBOOK_NAME] = m["name"]
+        labels["statefulset"] = m["name"]
+
+        replicas = 0 if culler.is_stopped(nb) else 1  # :284-286 scale-to-zero
+        return ob.new_object(
+            "apps/v1", "StatefulSet", m["name"], m["namespace"],
+            labels={T.LABEL_NOTEBOOK_NAME: m["name"]},
+            spec={
+                "serviceName": m["name"],
+                "replicas": replicas,
+                "selector": {"matchLabels": {"statefulset": m["name"]}},
+                "template": tmpl,
+            },
+        )
+
+    def generate_service(self, nb: dict) -> dict:
+        m = ob.meta(nb)
+        return ob.new_object(
+            "v1", "Service", m["name"], m["namespace"],
+            labels={T.LABEL_NOTEBOOK_NAME: m["name"]},
+            spec={
+                "type": "ClusterIP",
+                "selector": {"statefulset": m["name"]},
+                "ports": [{
+                    # Istio needs the protocol-prefixed port name (:367)
+                    "name": f"http-{m['name']}",
+                    "port": T.SERVICE_PORT,
+                    "targetPort": T.CONTAINER_PORT,
+                    "protocol": "TCP",
+                }],
+            },
+        )
+
+    def generate_virtual_service(self, nb: dict) -> dict:
+        """Route /notebook/<ns>/<name>/ through the mesh gateway (:382-443)."""
+        m = ob.meta(nb)
+        prefix = f"/notebook/{m['namespace']}/{m['name']}/"
+        host = f"{m['name']}.{m['namespace']}.svc.{cluster_domain()}"
+        return ob.new_object(
+            "networking.istio.io/v1alpha3", "VirtualService",
+            f"notebook-{m['namespace']}-{m['name']}", m["namespace"],
+            spec={
+                "hosts": ["*"],
+                "gateways": [istio_gateway()],
+                "http": [{
+                    "match": [{"uri": {"prefix": prefix}}],
+                    "rewrite": {"uri": prefix},
+                    "route": [{"destination": {
+                        "host": host, "port": {"number": T.SERVICE_PORT}}}],
+                    "timeout": "300s",  # :433
+                }],
+            },
+        )
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, client, req: Request) -> Result | None:
+        nb = client.get_or_none(T.API_VERSION, T.KIND, req.name, req.namespace)
+        if nb is None or ob.meta(nb).get("deletionTimestamp"):
+            return None
+
+        first_seen = not (nb.get("status") or {})
+        if first_seen:
+            nb_created().inc()
+
+        rh.reconcile_child(client, nb, self.generate_statefulset(nb))
+        rh.reconcile_child(client, nb, self.generate_service(nb))
+        if use_istio():
+            rh.reconcile_child(client, nb, self.generate_virtual_service(nb))
+
+        # -- status from pod container state (:200-231) --------------------
+        pods = client.list(
+            "v1", "Pod", namespace=req.namespace,
+            label_selector={"matchLabels": {T.LABEL_NOTEBOOK_NAME: req.name}},
+        )
+        status = nb.setdefault("status", {})
+        status["readyReplicas"] = sum(
+            1 for p in pods
+            if all(cs.get("ready") for cs in
+                   (p.get("status") or {}).get("containerStatuses") or [{}])
+            and (p.get("status") or {}).get("phase") == "Running"
+        )
+        if pods:
+            cs = ((pods[0].get("status") or {}).get("containerStatuses") or [])
+            if cs:
+                status["containerState"] = cs[0].get("state", {})
+        # re-emit pod events onto the Notebook (:565-613)
+        self._forward_pod_events(client, nb, pods)
+
+        ob.cond_set(
+            nb, "Ready",
+            "True" if status.get("readyReplicas") else "False",
+            "NotebookReady" if status.get("readyReplicas") else "NotebookNotReady",
+        )
+        client.update_status(nb)
+
+        # -- culling (:250 -> culler.GetRequeueTime) ------------------------
+        if culler.enabled() and not culler.is_stopped(nb):
+            if culler.needs_culling(nb, probe=self.probe):
+                fresh = client.get(T.API_VERSION, T.KIND, req.name, req.namespace)
+                culler.set_stop_annotation(fresh)
+                client.update(fresh)
+                nb_culled().inc()
+                client.record_event(fresh, "Culling", "notebook idle; scaling to zero")
+                return Result(requeue_after=0.0)
+            return Result(requeue_after=culler.requeue_seconds())
+        return None
+
+    def _forward_pod_events(self, client, nb: dict, pods: list[dict]) -> None:
+        nb_uid = ob.meta(nb).get("uid", "")
+        pod_names = {ob.meta(p)["name"] for p in pods}
+        if not pod_names:
+            return
+        for ev in client.list("v1", "Event", namespace=ob.meta(nb)["namespace"]):
+            inv = ev.get("involvedObject") or {}
+            if inv.get("kind") != "Pod" or inv.get("name") not in pod_names:
+                continue
+            marker = f"nb-fwd-{ev['metadata']['name']}"
+            if any(
+                e.get("source", {}).get("component") == marker
+                for e in client.list("v1", "Event", namespace=ob.meta(nb)["namespace"])
+                if (e.get("involvedObject") or {}).get("uid") == nb_uid
+            ):
+                continue
+            client.record_event(nb, ev.get("reason", ""), ev.get("message", ""),
+                                ev.get("type", "Normal"), component=marker)
+
+
+def build_controller(client, probe=culler.default_probe) -> Controller:
+    rec = NotebookReconciler(probe=probe)
+    ctl = Controller("notebook", client, rec)
+    ctl.watches_primary(T.API_VERSION, T.KIND)
+    ctl.owns("apps/v1", "StatefulSet").owns("v1", "Service")
+
+    # map pods to notebooks via the notebook-name label (:541-563)
+    def pod_to_nb(pod: dict):
+        name = ob.labels_of(pod).get(T.LABEL_NOTEBOOK_NAME)
+        if name:
+            return [Request(ob.meta(pod).get("namespace") or "", name)]
+        return []
+
+    ctl.maps("v1", "Pod", pod_to_nb)
+    return ctl

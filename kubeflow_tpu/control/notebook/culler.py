@@ -1,0 +1,103 @@
+"""Idle culling: scale notebooks to zero when Jupyter reports no activity.
+
+Reference: notebook-controller/pkg/culler/culler.go —
+- env knobs (:24-27): ENABLE_CULLING (default off), CULL_IDLE_TIME
+  (1440 min), IDLENESS_CHECK_PERIOD (1 min);
+- probe (:138): GET http://<nb>.<ns>.svc/notebook/<ns>/<nb>/api/status,
+  parse Jupyter's last_activity;
+- idle decision (:171-191) and the stop annotation write (:91), which the
+  next reconcile turns into replicas=0 (notebook_controller.go:284-286).
+
+The HTTP probe is injectable so controller tests drive idleness without a
+live Jupyter (the fake-backend stance of SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import os
+from typing import Callable
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.notebook import types as T
+
+log = logging.getLogger("kubeflow_tpu.culler")
+
+TIME_FMT = "%Y-%m-%dT%H:%M:%SZ"
+
+
+def enabled() -> bool:
+    return os.environ.get("ENABLE_CULLING", "false").lower() == "true"
+
+
+def idle_time_minutes() -> float:
+    return float(os.environ.get("CULL_IDLE_TIME", "1440"))
+
+
+def check_period_minutes() -> float:
+    return float(os.environ.get("IDLENESS_CHECK_PERIOD", "1"))
+
+
+def requeue_seconds() -> float:
+    """GetRequeueTime analogue (culler.go:61)."""
+    return check_period_minutes() * 60.0
+
+
+def default_probe(notebook: dict) -> str | None:
+    """GET the Jupyter status API; returns last_activity or None.
+
+    Address goes through the in-cluster Service DNS exactly like
+    getNotebookApiStatus (culler.go:138-169).
+    """
+    import requests
+
+    m = ob.meta(notebook)
+    url = (
+        f"http://{m['name']}.{m['namespace']}.svc.cluster.local"
+        f"/notebook/{m['namespace']}/{m['name']}/api/status"
+    )
+    try:
+        r = requests.get(url, timeout=5)
+        if r.status_code != 200:
+            return None
+        return r.json().get("last_activity")
+    except Exception as e:
+        log.debug("status probe failed for %s: %s", m["name"], e)
+        return None
+
+
+def is_idle(last_activity: str | None, now: datetime.datetime | None = None) -> bool:
+    """notebookIsIdle (culler.go:171-189)."""
+    if not last_activity:
+        return False
+    try:
+        last = datetime.datetime.strptime(
+            last_activity.split(".")[0].rstrip("Z") + "Z", TIME_FMT
+        ).replace(tzinfo=datetime.timezone.utc)
+    except ValueError:
+        return False
+    now = now or datetime.datetime.now(datetime.timezone.utc)
+    return (now - last).total_seconds() > idle_time_minutes() * 60.0
+
+
+def is_stopped(notebook: dict) -> bool:
+    return T.STOP_ANNOTATION in ob.annotations_of(notebook)
+
+
+def set_stop_annotation(notebook: dict) -> None:
+    """SetStopAnnotation (culler.go:91)."""
+    ob.set_annotation(notebook, T.STOP_ANNOTATION, ob.now_iso())
+
+
+def needs_culling(
+    notebook: dict,
+    probe: Callable[[dict], str | None] = default_probe,
+    now: datetime.datetime | None = None,
+) -> bool:
+    """NotebookNeedsCulling (culler.go:191-206)."""
+    if not enabled():
+        return False
+    if is_stopped(notebook):
+        return False
+    return is_idle(probe(notebook), now=now)

@@ -1,0 +1,16 @@
+"""PodDefault mutating admission webhook.
+
+Reference: components/admission-webhook (SURVEY.md §2.2) — label-matched
+injection of env/envFrom/volumes/volumeMounts/tolerations/labels/
+annotations into pods at admission time; how notebooks transparently get
+secrets, tokens and volumes. The TPU build keeps the exact mechanism
+(JSONPatch reply, conflict-safe merge) and uses it to inject TPU runtime
+defaults (e.g. JAX_PLATFORMS, libtpu mounts) into notebook/job pods.
+"""
+
+from kubeflow_tpu.control.poddefault.webhook import (  # noqa: F401
+    API_VERSION,
+    KIND,
+    PodDefaultMutator,
+    new_poddefault,
+)

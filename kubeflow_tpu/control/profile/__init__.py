@@ -1,0 +1,14 @@
+"""Profile operator — multi-tenancy: one namespace per user/team.
+
+Reference: components/profile-controller (SURVEY.md §2.2): Profile CR ->
+Namespace (istio-injection labeled) + default-editor/default-viewer
+ServiceAccounts + owner RoleBinding + ResourceQuota + cloud-credential
+plugins, with a finalizer for cleanup. TPU twist: quota is expressed in
+`google.com/tpu` chips alongside cpu/memory.
+"""
+
+from kubeflow_tpu.control.profile.types import API_VERSION, KIND, new_profile  # noqa: F401
+from kubeflow_tpu.control.profile.controller import (  # noqa: F401
+    ProfileReconciler,
+    build_controller,
+)

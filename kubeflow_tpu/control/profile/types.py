@@ -1,0 +1,70 @@
+"""Profile CRD types (reference: profile-controller/api/v1/profile_types.go:38)."""
+
+from __future__ import annotations
+
+from kubeflow_tpu.control.k8s import objects as ob
+
+GROUP = "kubeflow.org"
+VERSION = "v1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "Profile"
+
+FINALIZER = "profile-finalizer"  # profile_controller.go:48
+# ClusterRoles bound in the namespace (profile_controller.go:58-62)
+ADMIN_CLUSTER_ROLE = "kubeflow-admin"
+EDIT_CLUSTER_ROLE = "kubeflow-edit"
+VIEW_CLUSTER_ROLE = "kubeflow-view"
+SA_EDITOR = "default-editor"
+SA_VIEWER = "default-viewer"
+QUOTA_NAME = "kf-resource-quota"  # profile_controller.go:47
+RESOURCE_TPU = "google.com/tpu"
+# annotation consumed by KFAM bindings (kfam/bindings.go)
+ANNO_USER = "user"
+ANNO_ROLE = "role"
+
+
+def new_profile(
+    name: str,
+    owner: str,
+    *,
+    tpu_chip_quota: int | None = None,
+    cpu_quota: str | None = None,
+    memory_quota: str | None = None,
+    plugins: list[dict] | None = None,
+) -> dict:
+    spec: dict = {"owner": {"kind": "User", "name": owner}}
+    hard: dict = {}
+    if tpu_chip_quota is not None:
+        hard[f"requests.{RESOURCE_TPU}"] = tpu_chip_quota
+    if cpu_quota:
+        hard["requests.cpu"] = cpu_quota
+    if memory_quota:
+        hard["requests.memory"] = memory_quota
+    if hard:
+        spec["resourceQuotaSpec"] = {"hard": hard}
+    if plugins:
+        spec["plugins"] = plugins
+    prof = ob.new_object(API_VERSION, KIND, name, namespace=None, spec=spec)
+    ob.meta(prof)["finalizers"] = [FINALIZER]
+    return prof
+
+
+def crd_manifest() -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"profiles.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": KIND, "listKind": "ProfileList",
+                      "plural": "profiles", "singular": "profile"},
+            "scope": "Cluster",
+            "versions": [{
+                "name": VERSION, "served": True, "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True}},
+            }],
+        },
+    }

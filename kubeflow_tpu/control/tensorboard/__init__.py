@@ -1,0 +1,9 @@
+"""Tensorboard operator (reference: components/tensorboard-controller)."""
+
+from kubeflow_tpu.control.tensorboard.controller import (  # noqa: F401
+    API_VERSION,
+    KIND,
+    TensorboardReconciler,
+    build_controller,
+    new_tensorboard,
+)

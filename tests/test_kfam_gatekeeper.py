@@ -1,0 +1,210 @@
+"""KFAM REST + gatekeeper auth semantics (reference:
+access-management/kfam/{api_default,bindings}.go, bindings_test.go;
+gatekeeper/auth/AuthServer.go). Driven through the routers directly (no
+sockets) except one live-HTTP smoke test."""
+
+import pytest
+
+from kubeflow_tpu.control.gatekeeper.auth import AuthServer, pwhash
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.kfam.service import USER_HEADER, KfamService, binding_name
+from kubeflow_tpu.control.profile import types as PT
+from kubeflow_tpu.utils.httpd import HttpReq
+
+
+def mkreq(method, path, user=None, body=b"", query=None, headers=None):
+    h = {k.lower(): v for k, v in (headers or {}).items()}
+    if user:
+        h[USER_HEADER] = user
+    import json as _json
+
+    if isinstance(body, (dict, list)):
+        body = _json.dumps(body).encode()
+    return HttpReq(method=method, path=path, params={}, query=query or {},
+                   headers=h, body=body)
+
+
+@pytest.fixture()
+def kfam():
+    cluster = FakeCluster()
+    cluster.create(PT.new_profile("team-a", "alice@example.com"))
+    svc = KfamService(cluster, cluster_admin="root@example.com")
+    return cluster, svc, svc.router()
+
+
+class TestKfamBindings:
+    def binding_body(self, user="bob@example.com", ns="team-a", role="edit"):
+        return {"user": {"kind": "User", "name": user},
+                "referredNamespace": ns,
+                "roleRef": {"kind": "ClusterRole", "name": f"kubeflow-{role}"}}
+
+    def test_owner_can_create_binding(self, kfam):
+        cluster, svc, router = kfam
+        resp = router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                     user="alice@example.com",
+                                     body=self.binding_body()))
+        assert resp.status == 200, resp.body
+        rb = cluster.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         binding_name("bob@example.com", "edit"), "team-a")
+        assert rb["roleRef"]["name"] == "kubeflow-edit"
+        assert ob.annotations_of(rb)[PT.ANNO_USER] == "bob@example.com"
+        pol = cluster.get("security.istio.io/v1beta1", "AuthorizationPolicy",
+                          binding_name("bob@example.com", "edit"), "team-a")
+        assert pol["spec"]["rules"]
+
+    def test_non_owner_forbidden(self, kfam):
+        _, _, router = kfam
+        resp = router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                     user="mallory@example.com",
+                                     body=self.binding_body()))
+        assert resp.status == 403
+
+    def test_cluster_admin_allowed(self, kfam):
+        _, _, router = kfam
+        resp = router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                     user="root@example.com",
+                                     body=self.binding_body()))
+        assert resp.status == 200
+
+    def test_missing_identity_401(self, kfam):
+        _, _, router = kfam
+        resp = router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                     body=self.binding_body()))
+        assert resp.status == 401
+
+    def test_read_bindings_filters(self, kfam):
+        import json
+
+        _, _, router = kfam
+        for user, role in (("bob@example.com", "edit"), ("eve@example.com", "view")):
+            router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                  user="alice@example.com",
+                                  body=self.binding_body(user=user, role=role)))
+        all_b = json.loads(router.dispatch(
+            mkreq("GET", "/kfam/v1/bindings")).body)["bindings"]
+        assert len(all_b) == 2
+        only_bob = json.loads(router.dispatch(
+            mkreq("GET", "/kfam/v1/bindings",
+                  query={"user": ["bob@example.com"]})).body)["bindings"]
+        assert len(only_bob) == 1
+        assert only_bob[0]["roleRef"]["name"] == "kubeflow-edit"
+        only_view = json.loads(router.dispatch(
+            mkreq("GET", "/kfam/v1/bindings",
+                  query={"role": ["view"]})).body)["bindings"]
+        assert [b["user"]["name"] for b in only_view] == ["eve@example.com"]
+
+    def test_delete_binding(self, kfam):
+        cluster, _, router = kfam
+        router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                              user="alice@example.com", body=self.binding_body()))
+        resp = router.dispatch(mkreq("DELETE", "/kfam/v1/bindings",
+                                     user="alice@example.com",
+                                     body=self.binding_body()))
+        assert resp.status == 200
+        assert cluster.get_or_none(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            binding_name("bob@example.com", "edit"), "team-a") is None
+
+    def test_duplicate_binding_conflict(self, kfam):
+        _, _, router = kfam
+        router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                              user="alice@example.com", body=self.binding_body()))
+        resp = router.dispatch(mkreq("POST", "/kfam/v1/bindings",
+                                     user="alice@example.com",
+                                     body=self.binding_body()))
+        assert resp.status == 409
+
+
+class TestKfamProfiles:
+    def test_create_profile_via_api(self, kfam):
+        cluster, _, router = kfam
+        resp = router.dispatch(mkreq(
+            "POST", "/kfam/v1/profiles", user="carol@example.com",
+            body={"metadata": {"name": "team-b"}}))
+        assert resp.status == 200
+        prof = cluster.get(PT.API_VERSION, PT.KIND, "team-b")
+        assert prof["spec"]["owner"]["name"] == "carol@example.com"
+
+    def test_delete_profile_requires_owner(self, kfam):
+        cluster, _, router = kfam
+        assert router.dispatch(mkreq("DELETE", "/kfam/v1/profiles/team-a",
+                                     user="mallory@example.com")).status == 403
+        assert router.dispatch(mkreq("DELETE", "/kfam/v1/profiles/team-a",
+                                     user="alice@example.com")).status == 200
+
+    def test_query_cluster_admin(self, kfam):
+        import json
+
+        _, _, router = kfam
+        out = json.loads(router.dispatch(mkreq(
+            "GET", "/kfam/v1/clusteradmin",
+            query={"user": ["root@example.com"]})).body)
+        assert out["isClusterAdmin"] is True
+        out = json.loads(router.dispatch(mkreq(
+            "GET", "/kfam/v1/clusteradmin",
+            query={"user": ["bob@example.com"]})).body)
+        assert out["isClusterAdmin"] is False
+
+
+class TestGatekeeper:
+    @pytest.fixture()
+    def gk(self):
+        return AuthServer(username="admin", passhash=pwhash("hunter2", "s"), salt="s")
+
+    def test_basic_auth_allows(self, gk):
+        import base64
+
+        cred = base64.b64encode(b"admin:hunter2").decode()
+        resp = gk.check(mkreq("GET", "/auth",
+                              headers={"Authorization": f"Basic {cred}"}))
+        assert resp.status == 200
+        assert resp.headers["kubeflow-userid"] == "admin"
+
+    def test_wrong_password_browser_redirects(self, gk):
+        import base64
+
+        cred = base64.b64encode(b"admin:wrong").decode()
+        resp = gk.check(mkreq("GET", "/auth",
+                              headers={"Authorization": f"Basic {cred}",
+                                       "Accept": "text/html"}))
+        assert resp.status == 302
+        assert resp.headers["Location"] == "/kflogin"
+
+    def test_api_client_gets_401(self, gk):
+        assert gk.check(mkreq("GET", "/auth")).status == 401
+
+    def test_login_mints_cookie_and_cookie_allows(self, gk):
+        resp = gk.login(mkreq("POST", "/login",
+                              body={"username": "admin", "password": "hunter2"}))
+        assert resp.status == 200
+        cookie = resp.headers["Set-Cookie"].split(";")[0]
+        resp2 = gk.check(mkreq("GET", "/auth", headers={"Cookie": cookie}))
+        assert resp2.status == 200
+
+    def test_expired_cookie_rejected(self, gk):
+        tok = gk.mint_cookie("admin", now=0)  # minted at epoch -> expired
+        resp = gk.check(mkreq("GET", "/auth",
+                              headers={"Cookie": f"kubeflow-auth={tok}"}))
+        assert resp.status == 401
+
+    def test_tampered_cookie_rejected(self, gk):
+        tok = gk.mint_cookie("admin")
+        resp = gk.check(mkreq("GET", "/auth",
+                              headers={"Cookie": f"kubeflow-auth={tok[:-4]}AAAA"}))
+        assert resp.status == 401
+
+    def test_live_http_roundtrip(self, gk):
+        import requests
+
+        svc = gk.serve(host="127.0.0.1").serve_background()
+        try:
+            r = requests.post(f"http://127.0.0.1:{svc.port}/login",
+                              json={"username": "admin", "password": "hunter2"},
+                              timeout=5)
+            assert r.status_code == 200
+            r2 = requests.get(f"http://127.0.0.1:{svc.port}/auth",
+                              cookies=r.cookies, timeout=5)
+            assert r2.status_code == 200
+        finally:
+            svc.shutdown()

@@ -1,0 +1,159 @@
+"""Notebook controller + culler semantics (reference:
+notebook_controller_test.go, culler_test.go — SURVEY.md §4 tier 1)."""
+
+import datetime
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.notebook import culler
+from kubeflow_tpu.control.notebook import types as T
+from kubeflow_tpu.control.notebook.controller import build_controller
+from kubeflow_tpu.control.runtime import seed_controller
+
+
+@pytest.fixture()
+def world(monkeypatch):
+    monkeypatch.delenv("ENABLE_CULLING", raising=False)
+    monkeypatch.delenv("USE_ISTIO", raising=False)
+    cluster = FakeCluster()
+    probe_state = {"last_activity": None}
+    ctl = seed_controller(
+        build_controller(cluster, probe=lambda nb: probe_state["last_activity"])
+    )
+    return cluster, ctl, probe_state
+
+
+def drain(ctl):
+    for _ in range(4):
+        ctl.run_until_idle(advance_delayed=True)
+
+
+class TestGenerate:
+    def test_creates_statefulset_and_service(self, world):
+        cluster, ctl, _ = world
+        cluster.create(T.new_notebook("nb1", tpu_chips=4))
+        drain(ctl)
+        sts = cluster.get("apps/v1", "StatefulSet", "nb1", "default")
+        assert sts["spec"]["replicas"] == 1
+        c0 = sts["spec"]["template"]["spec"]["containers"][0]
+        assert c0["workingDir"] == T.HOME_DIR
+        env = {e["name"]: e["value"] for e in c0["env"]}
+        assert env[T.ENV_NB_PREFIX] == "/notebook/default/nb1"
+        assert c0["resources"]["limits"][T.RESOURCE_TPU] == 4
+        assert sts["spec"]["template"]["spec"]["securityContext"]["fsGroup"] == 100
+        svc = cluster.get("v1", "Service", "nb1", "default")
+        port = svc["spec"]["ports"][0]
+        assert (port["port"], port["targetPort"]) == (80, 8888)
+        assert port["name"] == "http-nb1"  # istio port-name convention
+
+    def test_virtual_service_only_with_istio(self, world, monkeypatch):
+        cluster, ctl, _ = world
+        cluster.create(T.new_notebook("nb1"))
+        drain(ctl)
+        assert not cluster.list("networking.istio.io/v1alpha3", "VirtualService")
+        monkeypatch.setenv("USE_ISTIO", "true")
+        cluster.create(T.new_notebook("nb2"))
+        drain(ctl)
+        vs = cluster.get(
+            "networking.istio.io/v1alpha3", "VirtualService",
+            "notebook-default-nb2", "default",
+        )
+        http = vs["spec"]["http"][0]
+        assert http["match"][0]["uri"]["prefix"] == "/notebook/default/nb2/"
+        assert http["timeout"] == "300s"
+        assert vs["spec"]["gateways"] == ["kubeflow/kubeflow-gateway"]
+
+    def test_status_tracks_pod_readiness(self, world):
+        cluster, ctl, _ = world
+        nb = cluster.create(T.new_notebook("nb1"))
+        drain(ctl)
+        pod = ob.new_object("v1", "Pod", "nb1-0", "default",
+                            labels={T.LABEL_NOTEBOOK_NAME: "nb1"},
+                            spec={"containers": [{"name": "nb1"}]})
+        pod["status"] = {
+            "phase": "Running",
+            "containerStatuses": [
+                {"name": "nb1", "ready": True,
+                 "state": {"running": {"startedAt": ob.now_iso()}}}],
+        }
+        cluster.create(pod)
+        drain(ctl)
+        got = cluster.get(T.API_VERSION, T.KIND, "nb1", "default")
+        assert got["status"]["readyReplicas"] == 1
+        assert "running" in got["status"]["containerState"]
+        assert ob.cond_is_true(got, "Ready")
+
+    def test_pod_events_forwarded_to_notebook(self, world):
+        cluster, ctl, _ = world
+        cluster.create(T.new_notebook("nb1"))
+        drain(ctl)
+        pod = ob.new_object("v1", "Pod", "nb1-0", "default",
+                            labels={T.LABEL_NOTEBOOK_NAME: "nb1"},
+                            spec={"containers": [{"name": "nb1"}]})
+        pod = cluster.create(pod)
+        cluster.record_event(pod, "Pulled", "image pulled")
+        drain(ctl)
+        nb = cluster.get(T.API_VERSION, T.KIND, "nb1", "default")
+        nb_events = [
+            e for e in cluster.list("v1", "Event", namespace="default")
+            if (e.get("involvedObject") or {}).get("uid") == ob.meta(nb)["uid"]
+        ]
+        assert any(e["reason"] == "Pulled" for e in nb_events)
+
+
+class TestCuller:
+    def test_disabled_by_default(self, world):
+        _, _, _ = world
+        assert not culler.enabled()
+        assert not culler.needs_culling({}, probe=lambda nb: "2020-01-01T00:00:00Z")
+
+    def test_is_idle_threshold(self, monkeypatch):
+        monkeypatch.setenv("CULL_IDLE_TIME", "60")  # minutes
+        now = datetime.datetime(2026, 1, 1, 12, 0, tzinfo=datetime.timezone.utc)
+        assert culler.is_idle("2026-01-01T10:00:00Z", now=now)
+        assert not culler.is_idle("2026-01-01T11:30:00Z", now=now)
+        assert not culler.is_idle(None, now=now)
+        assert not culler.is_idle("garbage", now=now)
+
+    def test_culling_scales_to_zero(self, world, monkeypatch):
+        cluster, ctl, probe_state = world
+        monkeypatch.setenv("ENABLE_CULLING", "true")
+        monkeypatch.setenv("CULL_IDLE_TIME", "60")
+        cluster.create(T.new_notebook("nb1"))
+        drain(ctl)
+        assert cluster.get("apps/v1", "StatefulSet", "nb1", "default")["spec"]["replicas"] == 1
+        # report ancient activity -> idle -> stop annotation -> replicas 0
+        probe_state["last_activity"] = "2020-01-01T00:00:00Z"
+        drain(ctl)
+        nb = cluster.get(T.API_VERSION, T.KIND, "nb1", "default")
+        assert T.STOP_ANNOTATION in ob.annotations_of(nb)
+        drain(ctl)
+        sts = cluster.get("apps/v1", "StatefulSet", "nb1", "default")
+        assert sts["spec"]["replicas"] == 0
+
+    def test_stopped_notebook_not_probed(self, world, monkeypatch):
+        cluster, ctl, probe_state = world
+        monkeypatch.setenv("ENABLE_CULLING", "true")
+        nb = T.new_notebook("nb1")
+        culler.set_stop_annotation(nb)
+        cluster.create(nb)
+        probe_state["last_activity"] = "2020-01-01T00:00:00Z"
+        drain(ctl)
+        sts = cluster.get("apps/v1", "StatefulSet", "nb1", "default")
+        assert sts["spec"]["replicas"] == 0
+        assert not culler.needs_culling(nb, probe=lambda n: "2020-01-01T00:00:00Z")
+
+    def test_restart_by_removing_stop_annotation(self, world):
+        cluster, ctl, _ = world
+        nb = T.new_notebook("nb1")
+        culler.set_stop_annotation(nb)
+        cluster.create(nb)
+        drain(ctl)
+        assert cluster.get("apps/v1", "StatefulSet", "nb1", "default")["spec"]["replicas"] == 0
+        fresh = cluster.get(T.API_VERSION, T.KIND, "nb1", "default")
+        del ob.meta(fresh)["annotations"][T.STOP_ANNOTATION]
+        cluster.update(fresh)
+        drain(ctl)
+        assert cluster.get("apps/v1", "StatefulSet", "nb1", "default")["spec"]["replicas"] == 1

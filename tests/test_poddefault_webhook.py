@@ -1,0 +1,150 @@
+"""PodDefault webhook merge semantics (reference: admission-webhook
+main_test.go — merge/conflict behaviors) plus the AdmissionReview HTTP
+contract, driven over a real socket."""
+
+import base64
+import json
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.poddefault import PodDefaultMutator, new_poddefault
+from kubeflow_tpu.control.poddefault.webhook import (
+    ANNOTATION_PREFIX,
+    apply_poddefaults,
+    filter_poddefaults,
+    safe_to_apply,
+)
+
+
+def make_pod(labels=None, env=None):
+    pod = ob.new_object("v1", "Pod", "p", "default", labels=labels or {},
+                        spec={"containers": [{"name": "main", "env": env or []}]})
+    return pod
+
+
+TPU_DEFAULT = dict(
+    selector={"matchLabels": {"inject-tpu": "true"}},
+    env=[{"name": "JAX_PLATFORMS", "value": "tpu"}],
+    volumes=[{"name": "libtpu", "hostPath": {"path": "/usr/lib/libtpu"}}],
+    volume_mounts=[{"name": "libtpu", "mountPath": "/usr/lib/libtpu"}],
+)
+
+
+class TestMerge:
+    def test_label_selector_filtering(self):
+        pds = [new_poddefault("tpu", **TPU_DEFAULT),
+               new_poddefault("other", selector={"matchLabels": {"x": "y"}})]
+        matched = filter_poddefaults(make_pod(labels={"inject-tpu": "true"}), pds)
+        assert [ob.meta(p)["name"] for p in matched] == ["tpu"]
+        assert filter_poddefaults(make_pod(), pds) == []
+
+    def test_exclude_annotation(self):
+        pod = make_pod(labels={"inject-tpu": "true"})
+        ob.set_annotation(pod, f"{ANNOTATION_PREFIX}/exclude", "true")
+        assert filter_poddefaults(pod, [new_poddefault("tpu", **TPU_DEFAULT)]) == []
+
+    def test_apply_injects_env_volumes_and_marker(self):
+        pod = make_pod(labels={"inject-tpu": "true"})
+        pd = new_poddefault("tpu", **TPU_DEFAULT)
+        ob.meta(pd)["resourceVersion"] = "42"
+        apply_poddefaults(pod, [pd])
+        c = pod["spec"]["containers"][0]
+        assert {"name": "JAX_PLATFORMS", "value": "tpu"} in c["env"]
+        assert c["volumeMounts"][0]["mountPath"] == "/usr/lib/libtpu"
+        assert pod["spec"]["volumes"][0]["name"] == "libtpu"
+        assert ob.annotations_of(pod)[f"{ANNOTATION_PREFIX}/poddefault-tpu"] == "42"
+
+    def test_identical_env_is_idempotent(self):
+        pod = make_pod(labels={"inject-tpu": "true"},
+                       env=[{"name": "JAX_PLATFORMS", "value": "tpu"}])
+        apply_poddefaults(pod, [new_poddefault("tpu", **TPU_DEFAULT)])
+        envs = [e for e in pod["spec"]["containers"][0]["env"]
+                if e["name"] == "JAX_PLATFORMS"]
+        assert len(envs) == 1
+
+    def test_conflicting_env_rejects_whole_set(self):
+        pod = make_pod(labels={"inject-tpu": "true"},
+                       env=[{"name": "JAX_PLATFORMS", "value": "cpu"}])
+        err = safe_to_apply(pod, [new_poddefault("tpu", **TPU_DEFAULT)])
+        assert err and "JAX_PLATFORMS" in err
+
+    def test_conflicting_mount_path(self):
+        a = new_poddefault("a", selector={}, volumes=[{"name": "v1", "emptyDir": {}}],
+                           volume_mounts=[{"name": "v1", "mountPath": "/data"}])
+        b = new_poddefault("b", selector={}, volumes=[{"name": "v2", "emptyDir": {}}],
+                           volume_mounts=[{"name": "v2", "mountPath": "/data"}])
+        err = safe_to_apply(make_pod(), [a, b])
+        assert err and "/data" in err
+
+    def test_labels_annotations_tolerations(self):
+        pd = new_poddefault(
+            "extras", selector={},
+            labels={"team": "ml"}, annotations={"note": "hi"},
+            tolerations=[{"key": "google.com/tpu", "operator": "Exists"}],
+        )
+        pod = make_pod()
+        apply_poddefaults(pod, [pd])
+        assert ob.labels_of(pod)["team"] == "ml"
+        assert ob.annotations_of(pod)["note"] == "hi"
+        assert pod["spec"]["tolerations"] == [
+            {"key": "google.com/tpu", "operator": "Exists"}]
+        # idempotent toleration merge
+        apply_poddefaults(pod, [pd])
+        assert len(pod["spec"]["tolerations"]) == 1
+
+
+class TestAdmissionChain:
+    def test_mutator_wired_into_fake_cluster(self):
+        cluster = FakeCluster()
+        cluster.create(new_poddefault("tpu", **TPU_DEFAULT))
+        mutator = PodDefaultMutator(cluster)
+        cluster.add_admission_hook(mutator.admission_hook)
+        pod = cluster.create(make_pod(labels={"inject-tpu": "true"}))
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        assert env["JAX_PLATFORMS"] == "tpu"
+
+    def test_conflict_admits_unmodified(self):
+        cluster = FakeCluster()
+        cluster.create(new_poddefault("tpu", **TPU_DEFAULT))
+        mutator = PodDefaultMutator(cluster)
+        cluster.add_admission_hook(mutator.admission_hook)
+        pod = cluster.create(make_pod(labels={"inject-tpu": "true"},
+                                      env=[{"name": "JAX_PLATFORMS", "value": "cpu"}]))
+        env = {e["name"]: e["value"] for e in pod["spec"]["containers"][0]["env"]}
+        assert env["JAX_PLATFORMS"] == "cpu"  # admitted as-is, not corrupted
+
+    def test_admission_review_http_roundtrip(self):
+        import requests
+
+        cluster = FakeCluster()
+        cluster.create(new_poddefault("tpu", **TPU_DEFAULT))
+        svc = PodDefaultMutator(cluster).serve(host="127.0.0.1").serve_background()
+        try:
+            pod = make_pod(labels={"inject-tpu": "true"})
+            review = {
+                "apiVersion": "admission.k8s.io/v1",
+                "kind": "AdmissionReview",
+                "request": {"uid": "u1", "namespace": "default", "object": pod},
+            }
+            r = requests.post(
+                f"http://127.0.0.1:{svc.port}/apply-poddefault", json=review, timeout=5)
+            assert r.status_code == 200
+            resp = r.json()["response"]
+            assert resp["allowed"] and resp["uid"] == "u1"
+            patch = json.loads(base64.b64decode(resp["patch"]))
+            patched = ob.json_patch(pod, patch)
+            env = {e["name"]: e["value"]
+                   for e in patched["spec"]["containers"][0]["env"]}
+            assert env["JAX_PLATFORMS"] == "tpu"
+        finally:
+            svc.shutdown()
+
+    def test_no_match_returns_no_patch(self):
+        cluster = FakeCluster()
+        mutator = PodDefaultMutator(cluster)
+        out = mutator.review({"request": {"uid": "u2", "namespace": "default",
+                                          "object": make_pod()}})
+        assert out["response"]["allowed"]
+        assert "patch" not in out["response"]

@@ -1,0 +1,158 @@
+"""Profile + Tensorboard controller semantics (reference:
+profile_controller.go, tensorboard_controller.go; plugin tests mirror
+plugin_workload_identity_test.go)."""
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.profile import types as PT
+from kubeflow_tpu.control.profile.controller import (
+    WorkloadIdentityPlugin,
+    build_controller as build_profile_controller,
+)
+from kubeflow_tpu.control.runtime import seed_controller
+from kubeflow_tpu.control.tensorboard import controller as TB
+
+
+def drain(ctl):
+    for _ in range(4):
+        ctl.run_until_idle(advance_delayed=True)
+
+
+class FakeIAM:
+    def __init__(self):
+        self.bindings = set()
+
+    def bind(self, gsa, ksa):
+        self.bindings.add((gsa, ksa))
+
+    def unbind(self, gsa, ksa):
+        self.bindings.discard((gsa, ksa))
+
+
+@pytest.fixture()
+def world():
+    cluster = FakeCluster()
+    iam = FakeIAM()
+    plugins = {"WorkloadIdentity": WorkloadIdentityPlugin(iam_backend=iam)}
+    ctl = seed_controller(build_profile_controller(cluster, plugins=plugins))
+    return cluster, ctl, iam
+
+
+class TestProfile:
+    def test_full_namespace_provisioning(self, world):
+        cluster, ctl, _ = world
+        cluster.create(PT.new_profile("team-a", "alice@example.com",
+                                      tpu_chip_quota=16, cpu_quota="100"))
+        drain(ctl)
+        ns = cluster.get("v1", "Namespace", "team-a")
+        assert ns["metadata"]["labels"]["istio-injection"] == "enabled"
+        assert ob.annotations_of(ns)["owner"] == "alice@example.com"
+        for sa in (PT.SA_EDITOR, PT.SA_VIEWER):
+            assert cluster.get("v1", "ServiceAccount", sa, "team-a")
+        rb = cluster.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         "namespaceAdmin", "team-a")
+        assert rb["roleRef"]["name"] == PT.ADMIN_CLUSTER_ROLE
+        assert rb["subjects"][0]["name"] == "alice@example.com"
+        quota = cluster.get("v1", "ResourceQuota", PT.QUOTA_NAME, "team-a")
+        assert quota["spec"]["hard"][f"requests.{PT.RESOURCE_TPU}"] == 16
+        pol = cluster.get("security.istio.io/v1beta1", "AuthorizationPolicy",
+                          "ns-owner-access", "team-a")
+        assert pol["spec"]["rules"]
+        prof = cluster.get(PT.API_VERSION, PT.KIND, "team-a")
+        assert ob.cond_is_true(prof, "Ready")
+
+    def test_sa_rolebindings_to_clusterroles(self, world):
+        cluster, ctl, _ = world
+        cluster.create(PT.new_profile("team-a", "alice@example.com"))
+        drain(ctl)
+        rb = cluster.get("rbac.authorization.k8s.io/v1", "RoleBinding",
+                         PT.SA_EDITOR, "team-a")
+        assert rb["roleRef"]["name"] == PT.EDIT_CLUSTER_ROLE
+        assert rb["subjects"][0] == {"kind": "ServiceAccount",
+                                     "name": PT.SA_EDITOR, "namespace": "team-a"}
+
+    def test_ownership_conflict_rejected(self, world):
+        """profile_controller.go:168-186: an existing namespace owned by a
+        different user blocks the profile."""
+        cluster, ctl, _ = world
+        cluster.create(ob.new_object("v1", "Namespace", "taken",
+                                     annotations={"owner": "bob@example.com"}))
+        cluster.create(PT.new_profile("taken", "alice@example.com"))
+        drain(ctl)
+        prof = cluster.get(PT.API_VERSION, PT.KIND, "taken")
+        c = ob.cond_get(prof, "Ready")
+        assert c["status"] == "False" and c["reason"] == "NamespaceOwnershipConflict"
+        # no SAs were provisioned into someone else's namespace
+        assert cluster.get_or_none("v1", "ServiceAccount", PT.SA_EDITOR, "taken") is None
+
+    def test_workload_identity_plugin(self, world):
+        cluster, ctl, iam = world
+        cluster.create(PT.new_profile(
+            "team-a", "alice@example.com",
+            plugins=[{"kind": "WorkloadIdentity",
+                      "spec": {"gcpServiceAccount": "gsa@proj.iam.gserviceaccount.com"}}],
+        ))
+        drain(ctl)
+        sa = cluster.get("v1", "ServiceAccount", PT.SA_EDITOR, "team-a")
+        assert (ob.annotations_of(sa)[WorkloadIdentityPlugin.ANNOTATION]
+                == "gsa@proj.iam.gserviceaccount.com")
+        assert ("gsa@proj.iam.gserviceaccount.com", "team-a/default-editor") in iam.bindings
+
+    def test_finalizer_revokes_plugins_and_deletes(self, world):
+        cluster, ctl, iam = world
+        cluster.create(PT.new_profile(
+            "team-a", "alice@example.com",
+            plugins=[{"kind": "WorkloadIdentity",
+                      "spec": {"gcpServiceAccount": "gsa@p.iam.gserviceaccount.com"}}],
+        ))
+        drain(ctl)
+        assert iam.bindings
+        cluster.delete(PT.API_VERSION, PT.KIND, "team-a")
+        drain(ctl)
+        assert cluster.get_or_none(PT.API_VERSION, PT.KIND, "team-a") is None
+        assert not iam.bindings
+        # namespace cascades via ownerRef GC
+        assert cluster.get_or_none("v1", "Namespace", "team-a") is None
+
+
+class TestTensorboard:
+    @pytest.fixture()
+    def world(self):
+        cluster = FakeCluster()
+        ctl = seed_controller(TB.build_controller(cluster))
+        return cluster, ctl
+
+    def test_cloud_logspath_no_pvc(self, world):
+        cluster, ctl = world
+        cluster.create(TB.new_tensorboard("tb1", logspath="gs://bucket/runs"))
+        drain(ctl)
+        dep = cluster.get("apps/v1", "Deployment", "tb1", "default")
+        spec = dep["spec"]["template"]["spec"]
+        assert "volumes" not in spec
+        assert "--logdir=gs://bucket/runs" in spec["containers"][0]["command"]
+        svc = cluster.get("v1", "Service", "tb1", "default")
+        assert svc["spec"]["ports"][0]["targetPort"] == 6006
+
+    def test_local_logspath_mounts_pvc(self, world):
+        cluster, ctl = world
+        cluster.create(TB.new_tensorboard("tb1", logspath="/data/logs"))
+        drain(ctl)
+        dep = cluster.get("apps/v1", "Deployment", "tb1", "default")
+        spec = dep["spec"]["template"]["spec"]
+        assert spec["volumes"][0]["persistentVolumeClaim"]["claimName"] == "tb1-logs"
+        assert spec["containers"][0]["volumeMounts"][0]["mountPath"] == "/data/logs"
+
+    def test_ready_follows_deployment(self, world):
+        cluster, ctl = world
+        cluster.create(TB.new_tensorboard("tb1", logspath="gs://b/r"))
+        drain(ctl)
+        tb = cluster.get(TB.API_VERSION, TB.KIND, "tb1", "default")
+        assert not ob.cond_is_true(tb, "Ready")
+        dep = cluster.get("apps/v1", "Deployment", "tb1", "default")
+        dep["status"] = {"readyReplicas": 1}
+        cluster.update_status(dep)
+        drain(ctl)
+        tb = cluster.get(TB.API_VERSION, TB.KIND, "tb1", "default")
+        assert ob.cond_is_true(tb, "Ready")
