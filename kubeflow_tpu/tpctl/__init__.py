@@ -1,0 +1,22 @@
+"""tpctl — the declarative deployment engine (bootstrap/kfctl analogue).
+
+The reference's deployment plane (SURVEY.md §2.1) is a Go HTTP server +
+router around the external kfctl/v3 module: a KfDef YAML describes a
+deployment; apply runs PLATFORM (cloud infra) then K8S (manifests) with
+retry; status lands in KfDef conditions; a router spawns one worker per
+deployment and a GC reaps expired ones. tpctl provides the same
+capability in-tree:
+
+- ``tpudef``    — the TpuDef config type (KfDef analogue, versioned YAML)
+- ``manifests`` — renders every platform component (CRDs, controllers,
+  webhook, KFAM, gatekeeper, dashboard/JWA backends, serving) as plain
+  K8s objects with kustomize-style overlay patching
+- ``apply``     — the coordinator: Apply(PLATFORM) -> Apply(K8S) with
+  backoff, idempotent second apply, KfAvailable/KfDegraded conditions
+- ``cli``       — `tpctl {generate,apply,delete,status}`
+- ``server``    — REST create/get endpoints + per-deployment workers + GC
+  (router.go / gcServer.go pattern)
+"""
+
+from kubeflow_tpu.tpctl.tpudef import TpuDef  # noqa: F401
+from kubeflow_tpu.tpctl.apply import Coordinator  # noqa: F401

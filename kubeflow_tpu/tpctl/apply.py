@@ -1,0 +1,205 @@
+"""Coordinator: Apply(PLATFORM) -> Apply(K8S) with retry + conditions.
+
+Mirrors kfctlServer.handleDeployment (kfctlServer.go:105-327): write the
+config, apply the platform (cloud infra), build cluster credentials, then
+apply K8S manifests with x3 constant backoff (:290-294), appending
+KfAvailable/KfDegraded status conditions (:320-327). Second apply is a
+no-op on an unchanged config (kfctl_second_apply.py contract).
+
+Platform providers are pluggable; `existing` targets a cluster that is
+already up (the common GKE TPU case — node pools carry the TPU chips),
+`gke-tpu` shells out to gcloud to create TPU node pools and is exercised
+only when gcloud is available.
+"""
+
+from __future__ import annotations
+
+import logging
+import subprocess
+import time
+
+import prometheus_client as prom
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.tpctl import manifests
+from kubeflow_tpu.tpctl.tpudef import COND_AVAILABLE, COND_DEGRADED, TpuDef
+
+log = logging.getLogger("kubeflow_tpu.tpctl")
+
+_METRICS: dict[str, object] = {}
+
+
+def _metric(name, kind, doc, **kw):
+    # deploy metrics of bootstrap/cmd/bootstrap/app/server.go:68-132
+    if name not in _METRICS:
+        _METRICS[name] = kind(name, doc, **kw)
+    return _METRICS[name]
+
+
+def deploy_requests():
+    return _metric("tpctl_deploy_requests_total", prom.Counter, "deploy requests")
+
+
+def deploy_failures():
+    return _metric("tpctl_deployments_failure_total", prom.Counter, "failed deploys")
+
+
+def deploy_duration():
+    return _metric(
+        "tpctl_dep_duration_seconds", prom.Histogram, "deployment wall time",
+        buckets=tuple(30 * i for i in range(1, 16)),  # 30s linear x15 (:112)
+    )
+
+
+class PlatformProvider:
+    def apply(self, cfg: TpuDef) -> None: ...
+
+    def delete(self, cfg: TpuDef) -> None: ...
+
+
+class ExistingCluster(PlatformProvider):
+    def apply(self, cfg: TpuDef) -> None:
+        log.info("platform=existing: nothing to provision")
+
+    def delete(self, cfg: TpuDef) -> None:
+        pass
+
+
+class GkeTpuPlatform(PlatformProvider):
+    """TPU node-pool provisioning via gcloud (the DM/kfctl-gcp analogue).
+    Command construction is testable; execution requires gcloud."""
+
+    def __init__(self, runner=subprocess.run):
+        self.runner = runner
+
+    def commands(self, cfg: TpuDef) -> list[list[str]]:
+        return [[
+            "gcloud", "container", "node-pools", "create", f"{cfg.name}-tpu",
+            f"--project={cfg.project}", f"--zone={cfg.zone}",
+            f"--cluster={cfg.name}",
+            f"--machine-type=ct5lp-hightpu-4t",
+            "--num-nodes=1",
+            f"--node-labels=cloud.google.com/gke-tpu-accelerator={cfg.accelerator},"
+            f"cloud.google.com/gke-tpu-topology={cfg.topology}",
+        ]]
+
+    def apply(self, cfg: TpuDef) -> None:
+        for cmd in self.commands(cfg):
+            log.info("platform exec: %s", " ".join(cmd))
+            self.runner(cmd, check=True)
+
+    def delete(self, cfg: TpuDef) -> None:
+        self.runner([
+            "gcloud", "container", "node-pools", "delete", f"{cfg.name}-tpu",
+            f"--project={cfg.project}", f"--zone={cfg.zone}",
+            f"--cluster={cfg.name}", "--quiet",
+        ], check=True)
+
+
+PROVIDERS = {"existing": ExistingCluster, "gke-tpu": GkeTpuPlatform}
+
+
+class Coordinator:
+    K8S_RETRIES = 3  # kfctlServer.go:290-294
+
+    def __init__(self, client, provider: PlatformProvider | None = None):
+        self.client = client
+        self.provider = provider
+
+    def _provider_for(self, cfg: TpuDef) -> PlatformProvider:
+        if self.provider is not None:
+            return self.provider
+        cls = PROVIDERS.get(cfg.platform)
+        if cls is None:
+            raise ValueError(f"unknown platform {cfg.platform!r}; "
+                             f"valid: {sorted(PROVIDERS)}")
+        return cls()
+
+    def apply(self, cfg: TpuDef) -> dict:
+        """Full deployment; returns the stored TpuDef object with
+        conditions. Idempotent: identical spec re-applies cleanly."""
+        deploy_requests().inc()
+        t0 = time.monotonic()
+        stored = self._store_tpudef(cfg)
+        try:
+            self._provider_for(cfg).apply(cfg)
+            self._apply_k8s(cfg)
+        except Exception as e:
+            deploy_failures().inc()
+            ob.cond_set(stored, COND_DEGRADED, "True", "ApplyFailed", str(e)[:500])
+            self._update_status(stored)
+            raise
+        deploy_duration().observe(time.monotonic() - t0)
+        ob.cond_set(stored, COND_AVAILABLE, "True", "ApplySucceeded",
+                    f"{len(cfg.applications)} applications applied")
+        ob.cond_set(stored, COND_DEGRADED, "False", "ApplySucceeded", "")
+        return self._update_status(stored)
+
+    def _store_tpudef(self, cfg: TpuDef) -> dict:
+        obj = cfg.to_object()
+        existing = self.client.get_or_none(obj["apiVersion"], obj["kind"],
+                                           ob.meta(obj)["name"])
+        if existing is None:
+            return self.client.create(obj)
+        if existing.get("spec") != obj.get("spec"):
+            existing["spec"] = obj["spec"]
+            return self.client.update(existing)
+        return existing
+
+    def _update_status(self, obj: dict) -> dict:
+        fresh = self.client.get(obj["apiVersion"], obj["kind"], ob.meta(obj)["name"])
+        fresh["status"] = obj.get("status", {})
+        return self.client.update_status(fresh)
+
+    def _apply_k8s(self, cfg: TpuDef) -> None:
+        objs = manifests.render(cfg)
+        last_err: Exception | None = None
+        for attempt in range(self.K8S_RETRIES):
+            try:
+                for o in objs:
+                    self._apply_one(o)
+                return
+            except ob.ApiError as e:
+                last_err = e
+                log.warning("k8s apply attempt %d failed: %s", attempt + 1, e)
+                time.sleep(0.01 * (attempt + 1))
+        raise last_err  # type: ignore[misc]
+
+    def _apply_one(self, desired: dict) -> None:
+        """Server-side-apply-ish create-or-update keyed on spec equality."""
+        m = ob.meta(desired)
+        found = self.client.get_or_none(
+            desired["apiVersion"], desired["kind"], m["name"], m.get("namespace"))
+        if found is None:
+            self.client.create(desired)
+            return
+        merged = ob.merge_patch(found, {k: v for k, v in desired.items()
+                                        if k not in ("metadata", "status")})
+        # labels are additive, like the reconcilehelper policy
+        want_labels = {**(ob.labels_of(found)), **(ob.labels_of(desired))}
+        if merged != found or want_labels != ob.labels_of(found):
+            ob.meta(merged).setdefault("labels", {}).update(want_labels)
+            self.client.update(merged)
+
+    def delete(self, cfg: TpuDef) -> None:
+        """Teardown: platform resources + the TpuDef (children GC)."""
+        self._provider_for(cfg).delete(cfg)
+        for o in reversed(manifests.render(cfg)):
+            m = ob.meta(o)
+            try:
+                self.client.delete(o["apiVersion"], o["kind"], m["name"],
+                                   m.get("namespace"))
+            except ob.NotFound:
+                pass
+        try:
+            self.client.delete(API_VERSION_KIND[0], API_VERSION_KIND[1], cfg.name)
+        except ob.NotFound:
+            pass
+
+    def status(self, name: str) -> dict | None:
+        return self.client.get_or_none(API_VERSION_KIND[0], API_VERSION_KIND[1], name)
+
+
+from kubeflow_tpu.tpctl.tpudef import API_VERSION as _AV, KIND as _K  # noqa: E402
+
+API_VERSION_KIND = (_AV, _K)

@@ -1,0 +1,105 @@
+"""tpctl CLI — the kfctl/kfctlClient command surface.
+
+`tpctl generate|apply|delete|status` against a kubeconfig-reachable
+cluster (or `--dry-run` to print). Mirrors the client flow of
+bootstrap/cmd/kfctlClient/main.go:141 (run :59) without the HTTP hop:
+the coordinator runs in-process; `tpctl server` starts the REST plane
+(router/kfctlServer pattern) instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import yaml
+
+from kubeflow_tpu.tpctl.apply import Coordinator
+from kubeflow_tpu.tpctl.tpudef import TpuDef, example_yaml
+
+
+def _client(args):
+    if args.dry_run:
+        from kubeflow_tpu.control.k8s.fake import FakeCluster
+
+        return FakeCluster()
+    from kubeflow_tpu.control.k8s.rest import RestClient
+
+    return RestClient(base_url=args.server or None)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser("tpctl", description=__doc__)
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    for name in ("apply", "delete", "status", "generate"):
+        sp = sub.add_parser(name)
+        if name != "status":
+            sp.add_argument("-f", "--file", help="TpuDef YAML (default: example)")
+        else:
+            sp.add_argument("name", nargs="?", default="kubeflow-tpu")
+        sp.add_argument("--server", default="", help="apiserver URL (default: in-cluster)")
+        sp.add_argument("--dry-run", action="store_true",
+                        help="apply against an in-memory cluster and print")
+
+    sps = sub.add_parser("server", help="REST deployment plane")
+    sps.add_argument("--port", type=int, default=8080)
+    sps.add_argument("--mode", default="router", choices=("router", "worker"))
+    sps.add_argument("--dry-run", action="store_true")
+    sps.add_argument("--server", default="")
+
+    spe = sub.add_parser("example", help="print an example TpuDef")
+
+    args = p.parse_args(argv)
+
+    if args.cmd == "example":
+        print(example_yaml(), end="")
+        return 0
+
+    if args.cmd == "server":
+        from kubeflow_tpu.tpctl.server import TpctlServer
+
+        srv = TpctlServer(_client(args))
+        svc = srv.serve(port=args.port)
+        print(f"tpctl server listening on :{svc.port}")
+        try:
+            svc._server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        return 0
+
+    if args.cmd == "status":
+        coord = Coordinator(_client(args))
+        obj = coord.status(args.name)
+        if obj is None:
+            print(f"TpuDef {args.name} not found", file=sys.stderr)
+            return 1
+        print(json.dumps(obj.get("status", {}), indent=2))
+        return 0
+
+    cfg = (TpuDef.load(args.file) if getattr(args, "file", None)
+           else TpuDef.from_dict(yaml.safe_load(example_yaml())))
+
+    if args.cmd == "generate":
+        from kubeflow_tpu.tpctl import manifests
+
+        print(yaml.safe_dump_all(manifests.render(cfg), sort_keys=False), end="")
+        return 0
+
+    coord = Coordinator(_client(args))
+    if args.cmd == "apply":
+        obj = coord.apply(cfg)
+        conds = {c["type"]: c["status"]
+                 for c in (obj.get("status") or {}).get("conditions", [])}
+        print(f"applied {cfg.name}: {conds}")
+        return 0
+    if args.cmd == "delete":
+        coord.delete(cfg)
+        print(f"deleted {cfg.name}")
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,172 @@
+"""Platform manifest renderer (the ksonnet/kustomize package registry
+equivalent, in code).
+
+The reference shipped its components as ksonnet packages in an external
+registry (bootstrap/image_registries.yaml:5-10 — absent from the
+snapshot) and later kustomize; each component also carries self-deploy
+manifests (e.g. bootstrap/kustomize/*). Here every component of THIS
+framework renders as plain dict objects from one place, with
+kustomize-style overlay patches applied last — so `tpctl generate` is
+the whole registry.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.tpctl.tpudef import TpuDef
+
+
+def _deployment(name: str, ns: str, image: str, *, args: list[str] | None = None,
+                env: dict[str, str] | None = None, port: int | None = None,
+                sa: str | None = None, replicas: int = 1) -> dict:
+    container: dict = {"name": name, "image": image}
+    if args:
+        container["args"] = args
+    if env:
+        container["env"] = [{"name": k, "value": v} for k, v in sorted(env.items())]
+    if port:
+        container["ports"] = [{"containerPort": port}]
+    pod_spec: dict = {"containers": [container]}
+    if sa:
+        pod_spec["serviceAccountName"] = sa
+    return ob.new_object(
+        "apps/v1", "Deployment", name, ns,
+        labels={"app": name, "app.kubernetes.io/part-of": "kubeflow-tpu"},
+        spec={
+            "replicas": replicas,
+            "selector": {"matchLabels": {"app": name}},
+            "template": {"metadata": {"labels": {"app": name}},
+                         "spec": pod_spec},
+        },
+    )
+
+
+def _service(name: str, ns: str, port: int, target: int) -> dict:
+    return ob.new_object(
+        "v1", "Service", name, ns,
+        spec={"selector": {"app": name},
+              "ports": [{"name": f"http-{name}", "port": port,
+                         "targetPort": target}]},
+    )
+
+
+def _clusterrole(name: str, rules: list[dict]) -> dict:
+    cr = ob.new_object("rbac.authorization.k8s.io/v1", "ClusterRole", name)
+    cr["rules"] = rules
+    return cr
+
+
+def render(cfg: TpuDef) -> list[dict]:
+    """All manifests for the selected applications, in apply order."""
+    ns = cfg.namespace
+    img = lambda c: f"{cfg.image_prefix}/{c}:latest"  # noqa: E731
+    out: list[dict] = []
+    apps = set(cfg.applications)
+
+    if "crds" in apps:
+        from kubeflow_tpu.control.jaxjob import types as JT
+        from kubeflow_tpu.control.notebook import types as NT
+        from kubeflow_tpu.control.poddefault import webhook as PW
+        from kubeflow_tpu.control.profile import types as PT
+        from kubeflow_tpu.control.tensorboard import controller as TB
+        from kubeflow_tpu.tune import studyjob as SJ
+
+        out += [JT.crd_manifest(), NT.crd_manifest(), PT.crd_manifest(),
+                PW.crd_manifest(), TB.crd_manifest(), SJ.crd_manifest()]
+
+    if "namespace" in apps:
+        out.append(ob.new_object(
+            "v1", "Namespace", ns,
+            labels={"istio-injection": "enabled" if cfg.use_istio else "disabled"}))
+
+    if "rbac" in apps:
+        # the kubeflow-{admin,edit,view} ClusterRoles the profile
+        # controller and KFAM bind to (profile_controller.go:58-62)
+        every = [{"apiGroups": ["*"], "resources": ["*"], "verbs": ["*"]}]
+        ro = [{"apiGroups": ["*"], "resources": ["*"],
+               "verbs": ["get", "list", "watch"]}]
+        out += [
+            _clusterrole("kubeflow-admin", every),
+            _clusterrole("kubeflow-edit", [
+                {"apiGroups": ["", "apps", "kubeflow.org",
+                               "tensorboard.kubeflow.org"],
+                 "resources": ["*"], "verbs": ["*"]}]),
+            _clusterrole("kubeflow-view", ro),
+            ob.new_object("v1", "ServiceAccount", "kubeflow-controller", ns),
+        ]
+        crb = ob.new_object("rbac.authorization.k8s.io/v1", "ClusterRoleBinding",
+                            "kubeflow-controller-admin")
+        crb["roleRef"] = {"apiGroup": "rbac.authorization.k8s.io",
+                          "kind": "ClusterRole", "name": "kubeflow-admin"}
+        crb["subjects"] = [{"kind": "ServiceAccount",
+                            "name": "kubeflow-controller", "namespace": ns}]
+        out.append(crb)
+
+    controllers = {
+        "jaxjob-controller": ["python", "-m", "kubeflow_tpu.control.jaxjob"],
+        "notebook-controller": ["python", "-m", "kubeflow_tpu.control.notebook"],
+        "profile-controller": ["python", "-m", "kubeflow_tpu.control.profile"],
+        "tensorboard-controller": ["python", "-m", "kubeflow_tpu.control.tensorboard"],
+    }
+    for name, cmd in controllers.items():
+        if name not in apps:
+            continue
+        env = {"USE_ISTIO": str(cfg.use_istio).lower()}
+        if name == "notebook-controller":
+            env.update({"ENABLE_CULLING": "false", "CULL_IDLE_TIME": "1440"})
+        out.append(_deployment(name, ns, img("controller"), args=cmd, env=env,
+                               sa="kubeflow-controller"))
+
+    if "poddefault-webhook" in apps:
+        out.append(_deployment(
+            "poddefault-webhook", ns, img("controller"),
+            args=["python", "-m", "kubeflow_tpu.control.poddefault"],
+            port=4443, sa="kubeflow-controller"))
+        out.append(_service("poddefault-webhook", ns, 443, 4443))
+        hook = ob.new_object(
+            "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
+            "poddefault-webhook")
+        hook["webhooks"] = [{
+            "name": "poddefault.kubeflow.org",
+            "admissionReviewVersions": ["v1"],
+            "sideEffects": "None",
+            "clientConfig": {"service": {
+                "name": "poddefault-webhook", "namespace": ns,
+                "path": "/apply-poddefault"}},
+            "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
+                       "operations": ["CREATE"], "resources": ["pods"]}],
+            "failurePolicy": "Ignore",
+        }]
+        out.append(hook)
+
+    services = {
+        "kfam": (["python", "-m", "kubeflow_tpu.control.kfam"], 8081),
+        "gatekeeper": (["python", "-m", "kubeflow_tpu.control.gatekeeper"], 8085),
+        "centraldashboard": (["python", "-m", "kubeflow_tpu.webapps.dashboard"], 8082),
+        "jupyter-web-app": (["python", "-m", "kubeflow_tpu.webapps.jwa"], 5000),
+        "serving": (["python", "-m", "kubeflow_tpu.serving"], 8500),
+        "metric-collector": (["python", "-m", "kubeflow_tpu.metric_collector"], 8088),
+    }
+    for name, (cmd, port) in services.items():
+        if name not in apps:
+            continue
+        out.append(_deployment(name, ns, img("platform"), args=cmd, port=port,
+                               sa="kubeflow-controller"))
+        out.append(_service(name, ns, 80, port))
+
+    for patch in cfg.overlays:
+        _apply_overlay(out, patch)
+    return out
+
+
+def _apply_overlay(objs: list[dict], overlay: dict) -> None:
+    """kustomize-style strategic-merge overlay: {target: {kind, name},
+    patch: {...}} merged into every matching object."""
+    target = overlay.get("target") or {}
+    patch = overlay.get("patch") or {}
+    for i, o in enumerate(objs):
+        if target.get("kind") and o.get("kind") != target["kind"]:
+            continue
+        if target.get("name") and ob.meta(o).get("name") != target["name"]:
+            continue
+        objs[i] = ob.merge_patch(o, patch)

@@ -1,0 +1,144 @@
+"""tpctl REST plane: create/get deployments + worker pool + GC.
+
+The router/kfctlServer/gcServer triple of the reference
+(bootstrap/cmd/bootstrap/app/{router,kfctlServer,gcServer}.go) collapsed
+into one process:
+
+- POST /tpctl/apps/v1/create  — enqueue a deployment (router.go:407;
+  per-deployment serialization through a channel, kfctlServer.go:87)
+- POST /tpctl/apps/v1/get     — poll status (kfctlServer.go:373-384)
+- one worker thread per deployment name (the per-deployment StatefulSet
+  pod of router.go:275-357 becomes a thread; same isMatch conflict
+  rejection, kfctlServer.go:531)
+- GC loop deleting deployments idle past TTL (gcServer.go:56-86,
+  LastRequestTime annotation)
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.tpctl.apply import Coordinator
+from kubeflow_tpu.tpctl.tpudef import TpuDef
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
+
+log = logging.getLogger("kubeflow_tpu.tpctl.server")
+
+DEFAULT_TTL_S = 3600.0
+
+
+class _Worker:
+    """Per-deployment worker: owns a queue (cap 10, kfctlServer.go:87)."""
+
+    def __init__(self, name: str, coordinator: Coordinator):
+        self.name = name
+        self.coordinator = coordinator
+        self.q: "queue.Queue[TpuDef]" = queue.Queue(maxsize=10)
+        self.last_request = time.monotonic()
+        self.current_spec: dict | None = None
+        self.error: str | None = None
+        self.thread = threading.Thread(target=self._run, daemon=True,
+                                       name=f"tpctl-worker-{name}")
+        self.thread.start()
+
+    def _run(self):
+        while True:
+            cfg = self.q.get()
+            if cfg is None:
+                return
+            try:
+                self.coordinator.apply(cfg)
+                self.error = None
+            except Exception as e:
+                log.exception("deployment %s failed", self.name)
+                self.error = str(e)
+
+    def submit(self, cfg: TpuDef) -> None:
+        spec = cfg.to_object()["spec"]
+        if self.current_spec is not None and self.current_spec != spec:
+            # isMatch guard (kfctlServer.go:531): same name, different spec
+            # is a conflict, not a silent overwrite
+            raise ApiHttpError(409, f"deployment {self.name} exists with a "
+                               "different spec; delete it first")
+        self.current_spec = spec
+        self.last_request = time.monotonic()
+        self.q.put(cfg)
+
+
+class TpctlServer:
+    def __init__(self, client, ttl_s: float = DEFAULT_TTL_S):
+        self.client = client
+        self.ttl_s = ttl_s
+        self.workers: dict[str, _Worker] = {}
+        self._lock = threading.Lock()
+
+    # -- endpoints ----------------------------------------------------------
+
+    def create(self, req: HttpReq):
+        body = req.json() or {}
+        cfg = TpuDef.from_dict(body)
+        with self._lock:
+            w = self.workers.get(cfg.name)
+            if w is None:
+                w = self.workers[cfg.name] = _Worker(cfg.name, Coordinator(self.client))
+            w.submit(cfg)
+        return 200, {"name": cfg.name, "status": "enqueued"}
+
+    def get(self, req: HttpReq):
+        body = req.json() or {}
+        name = body.get("name") or req.q1("name")
+        if not name:
+            raise ApiHttpError(400, "name required")
+        with self._lock:
+            w = self.workers.get(name)
+            if w:
+                w.last_request = time.monotonic()
+        obj = Coordinator(self.client).status(name)
+        if obj is None and (w is None or w.error is None):
+            raise ApiHttpError(404, f"deployment {name} not found")
+        return {
+            "name": name,
+            "conditions": (obj or {}).get("status", {}).get("conditions", []),
+            "error": w.error if w else None,
+        }
+
+    def router(self) -> Router:
+        r = Router("tpctl")
+        r.route("POST", "/tpctl/apps/v1/create", self.create)
+        r.route("POST", "/tpctl/apps/v1/get", self.get)
+        r.route("GET", "/tpctl/apps/v1/get", self.get)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 0) -> httpd.HttpService:
+        self.start_gc()
+        return httpd.HttpService(self.router(), host, port)
+
+    # -- GC (gcServer.go:56-86) ---------------------------------------------
+
+    def gc_once(self, now: float | None = None) -> list[str]:
+        now = time.monotonic() if now is None else now
+        reaped = []
+        with self._lock:
+            for name, w in list(self.workers.items()):
+                if now - w.last_request > self.ttl_s:
+                    w.q.put(None)
+                    del self.workers[name]
+                    reaped.append(name)
+        return reaped
+
+    def start_gc(self, period_s: float = 60.0) -> None:
+        def loop():
+            while True:
+                time.sleep(period_s)
+                reaped = self.gc_once()
+                if reaped:
+                    log.info("gc reaped idle deployments: %s", reaped)
+
+        threading.Thread(target=loop, daemon=True, name="tpctl-gc").start()

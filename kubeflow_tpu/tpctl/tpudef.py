@@ -1,0 +1,145 @@
+"""TpuDef — the declarative deployment config (KfDef analogue).
+
+The reference treats a KfDef YAML as the single source of truth for a
+deployment (written/loaded kfctlServer.go:108-133, versioned
+v1alpha1/v1beta1); status conditions appended :320-327 make re-apply
+idempotent (tested by testing/kfctl/kfctl_second_apply.py). TpuDef keeps
+that contract with TPU-specific platform fields (project/zone/slice
+accelerator types instead of GPU node pools).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+from typing import Any
+
+import yaml
+
+from kubeflow_tpu.control.k8s import objects as ob
+
+API_VERSION = "tpctl.kubeflow.org/v1alpha1"
+KIND = "TpuDef"
+
+COND_AVAILABLE = "TpuDefAvailable"   # KfAvailable analogue
+COND_DEGRADED = "TpuDefDegraded"     # KfDegraded analogue
+
+# component names known to the manifest renderer; the `applications` list
+# in a TpuDef selects a subset (default: all)
+ALL_COMPONENTS = (
+    "crds",
+    "namespace",
+    "rbac",
+    "jaxjob-controller",
+    "notebook-controller",
+    "profile-controller",
+    "tensorboard-controller",
+    "poddefault-webhook",
+    "kfam",
+    "gatekeeper",
+    "centraldashboard",
+    "jupyter-web-app",
+    "serving",
+    "metric-collector",
+)
+
+
+@dataclasses.dataclass
+class TpuDef:
+    name: str = "kubeflow-tpu"
+    namespace: str = "kubeflow"
+    platform: str = "existing"          # existing | gke-tpu
+    project: str = ""                   # gcp project (gke-tpu)
+    zone: str = ""
+    accelerator: str = "tpu-v5-lite-podslice"
+    topology: str = "2x4"
+    applications: tuple[str, ...] = ALL_COMPONENTS
+    image_prefix: str = "kubeflow-tpu"
+    use_istio: bool = True
+    overlays: list[dict] = dataclasses.field(default_factory=list)
+    raw: dict = dataclasses.field(default_factory=dict, repr=False)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "TpuDef":
+        meta = d.get("metadata") or {}
+        spec = d.get("spec") or {}
+        apps = spec.get("applications")
+        if apps is not None:
+            names = [a if isinstance(a, str) else a.get("name") for a in apps]
+            unknown = sorted(set(names) - set(ALL_COMPONENTS))
+            if unknown:
+                raise ValueError(f"unknown applications {unknown}; "
+                                 f"valid: {sorted(ALL_COMPONENTS)}")
+            apps = tuple(names)
+        plat = spec.get("platform") or {}
+        return cls(
+            name=meta.get("name", "kubeflow-tpu"),
+            namespace=spec.get("namespace", "kubeflow"),
+            platform=plat.get("kind", "existing"),
+            project=plat.get("project", ""),
+            zone=plat.get("zone", ""),
+            accelerator=plat.get("accelerator", "tpu-v5-lite-podslice"),
+            topology=plat.get("topology", "2x4"),
+            applications=apps or ALL_COMPONENTS,
+            image_prefix=spec.get("imagePrefix", "kubeflow-tpu"),
+            use_istio=bool(spec.get("useIstio", True)),
+            overlays=list(spec.get("overlays") or []),
+            raw=d,
+        )
+
+    @classmethod
+    def load(cls, path_or_stream) -> "TpuDef":
+        if hasattr(path_or_stream, "read"):
+            d = yaml.safe_load(path_or_stream)
+        else:
+            with open(path_or_stream) as f:
+                d = yaml.safe_load(f)
+        if not isinstance(d, dict):
+            raise ValueError("TpuDef YAML must be a mapping")
+        if d.get("kind") not in (KIND, None):
+            raise ValueError(f"expected kind {KIND}, got {d.get('kind')!r}")
+        return cls.from_dict(d)
+
+    def to_object(self) -> dict:
+        """The cluster-stored form (status conditions live here)."""
+        obj = ob.new_object(API_VERSION, KIND, self.name)
+        obj["spec"] = {
+            "namespace": self.namespace,
+            "platform": {
+                "kind": self.platform,
+                "project": self.project,
+                "zone": self.zone,
+                "accelerator": self.accelerator,
+                "topology": self.topology,
+            },
+            "applications": list(self.applications),
+            "imagePrefix": self.image_prefix,
+            "useIstio": self.use_istio,
+            "overlays": self.overlays,
+        }
+        return obj
+
+    def dump(self) -> str:
+        buf = io.StringIO()
+        yaml.safe_dump(self.to_object(), buf, sort_keys=False)
+        return buf.getvalue()
+
+
+def example_yaml() -> str:
+    return """\
+apiVersion: tpctl.kubeflow.org/v1alpha1
+kind: TpuDef
+metadata:
+  name: kubeflow-tpu
+spec:
+  namespace: kubeflow
+  platform:
+    kind: existing          # or gke-tpu (provisions node pools via gcloud)
+    accelerator: tpu-v5-lite-podslice
+    topology: 2x4
+  useIstio: true
+  # applications: [crds, namespace, jaxjob-controller]   # default: all
+  # overlays:               # kustomize-style strategic patches
+  # - target: {kind: Deployment, name: jaxjob-controller}
+  #   patch: {spec: {replicas: 2}}
+"""

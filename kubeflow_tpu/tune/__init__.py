@@ -1,0 +1,16 @@
+"""Hyperparameter sweeps — the Katib StudyJob capability.
+
+The reference's CI drives a StudyJob CRD and polls its conditions
+(testing/katib_studyjob_test.py:128-194); the operator itself lived
+outside the tree. Here the sweep driver is in-tree and TPU-native: each
+trial is a JAXJob (gang TPU pod set), so one StudyJob fans out over
+slices.
+"""
+
+from kubeflow_tpu.tune.studyjob import (  # noqa: F401
+    API_VERSION,
+    KIND,
+    StudyJobReconciler,
+    build_controller,
+    new_studyjob,
+)

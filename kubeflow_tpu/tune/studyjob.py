@@ -1,0 +1,290 @@
+"""StudyJob controller: HPO sweeps where every trial is a JAXJob.
+
+Contract preserved from the reference's consumer
+(testing/katib_studyjob_test.py): `status.conditions[].type` reaches
+"Running" while trials execute and "Succeeded"/"Failed" terminally; the
+E2E polls exactly that (:128-194). Spec shape follows the katib
+v1alpha1 StudyJob the test submits: objective + parameter space +
+suggestion algorithm + trial template.
+
+Search algorithms: grid and random (the two the reference example used).
+Trial metrics: trials publish their objective through the
+``studyjob.kubeflow.org/objective-value`` annotation on their JAXJob
+(written by jaxrt's launcher via its summary line, or by the test); an
+injectable collector lets other transports plug in.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import random as _random
+from typing import Any, Callable
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
+
+log = logging.getLogger("kubeflow_tpu.studyjob")
+
+GROUP = "kubeflow.org"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "StudyJob"
+
+ANNO_OBJECTIVE = "studyjob.kubeflow.org/objective-value"
+LABEL_STUDY = "studyjob.kubeflow.org/study-name"
+LABEL_TRIAL = "studyjob.kubeflow.org/trial-id"
+
+COND_RUNNING = "Running"
+COND_SUCCEEDED = "Succeeded"
+COND_FAILED = "Failed"
+
+
+def new_studyjob(
+    name: str,
+    namespace: str = "default",
+    *,
+    objective: str = "loss",
+    goal: str = "minimize",
+    algorithm: str = "grid",
+    parameters: list[dict] | None = None,
+    trial_template: dict | None = None,
+    max_trials: int = 4,
+    parallel_trials: int = 2,
+    seed: int = 0,
+) -> dict:
+    return ob.new_object(
+        API_VERSION, KIND, name, namespace,
+        spec={
+            "objective": {"objectiveMetricName": objective, "type": goal},
+            "algorithm": {"algorithmName": algorithm, "seed": seed},
+            "parameters": parameters or [],
+            "trialTemplate": trial_template or {},
+            "maxTrialCount": max_trials,
+            "parallelTrialCount": parallel_trials,
+        },
+    )
+
+
+def crd_manifest() -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"studyjobs.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {"kind": KIND, "listKind": "StudyJobList",
+                      "plural": "studyjobs", "singular": "studyjob"},
+            "scope": "Namespaced",
+            "versions": [{
+                "name": VERSION, "served": True, "storage": True,
+                "subresources": {"status": {}},
+                "schema": {"openAPIV3Schema": {
+                    "type": "object",
+                    "x-kubernetes-preserve-unknown-fields": True}},
+            }],
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# suggestion algorithms
+
+
+def _param_values(p: dict) -> list[Any]:
+    ptype = p.get("parameterType", p.get("type", "categorical"))
+    feas = p.get("feasible") or {}
+    if ptype in ("categorical", "discrete"):
+        return list(feas.get("list") or p.get("list") or [])
+    lo, hi = float(feas.get("min", 0)), float(feas.get("max", 1))
+    steps = int(feas.get("steps", 3))
+    if ptype == "int":
+        vals = sorted({round(lo + (hi - lo) * i / max(steps - 1, 1))
+                       for i in range(steps)})
+        return [int(v) for v in vals]
+    return [lo + (hi - lo) * i / max(steps - 1, 1) for i in range(steps)]
+
+
+def grid_suggestions(parameters: list[dict], max_trials: int) -> list[dict]:
+    names = [p["name"] for p in parameters]
+    spaces = [_param_values(p) for p in parameters]
+    combos = itertools.product(*spaces) if spaces else iter([()])
+    return [dict(zip(names, c)) for c in itertools.islice(combos, max_trials)]
+
+
+def random_suggestions(parameters: list[dict], max_trials: int, seed: int = 0) -> list[dict]:
+    rng = _random.Random(seed)
+    out = []
+    for _ in range(max_trials):
+        pick = {}
+        for p in parameters:
+            ptype = p.get("parameterType", p.get("type", "categorical"))
+            feas = p.get("feasible") or {}
+            if ptype in ("categorical", "discrete"):
+                pick[p["name"]] = rng.choice(list(feas.get("list") or p.get("list")))
+            elif ptype == "int":
+                pick[p["name"]] = rng.randint(int(feas.get("min", 0)),
+                                              int(feas.get("max", 1)))
+            else:
+                pick[p["name"]] = rng.uniform(float(feas.get("min", 0.0)),
+                                              float(feas.get("max", 1.0)))
+        out.append(pick)
+    return out
+
+
+def _substitute(obj: Any, params: dict) -> Any:
+    """${param} substitution in the trial template (katib's
+    go-template analogue). Full-string matches keep native types."""
+    if isinstance(obj, dict):
+        return {k: _substitute(v, params) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_substitute(v, params) for v in obj]
+    if isinstance(obj, str):
+        for k, v in params.items():
+            token = "${" + k + "}"
+            if obj == token:
+                return v
+            if token in obj:
+                obj = obj.replace(token, str(v))
+        return obj
+    return obj
+
+
+def default_collector(job: dict) -> float | None:
+    """Read the objective off the trial JAXJob's annotation."""
+    val = ob.annotations_of(job).get(ANNO_OBJECTIVE)
+    if val is None:
+        return None
+    try:
+        return float(val)
+    except ValueError:
+        return None
+
+
+class StudyJobReconciler(Reconciler):
+    def __init__(self, collector: Callable[[dict], float | None] = default_collector):
+        self.collector = collector
+
+    def _suggestions(self, study: dict) -> list[dict]:
+        spec = study["spec"]
+        algo = (spec.get("algorithm") or {}).get("algorithmName", "grid")
+        max_trials = spec.get("maxTrialCount", 4)
+        params = spec.get("parameters") or []
+        if algo == "random":
+            seed = (spec.get("algorithm") or {}).get("seed", 0)
+            return random_suggestions(params, max_trials, seed)
+        if algo == "grid":
+            return grid_suggestions(params, max_trials)
+        raise ValueError(f"unknown algorithmName {algo!r} (grid|random)")
+
+    def trial_name(self, study: dict, idx: int) -> str:
+        return f"{ob.meta(study)['name']}-trial-{idx}"
+
+    def generate_trial(self, study: dict, idx: int, params: dict) -> dict:
+        m = ob.meta(study)
+        tmpl = ob.deep_copy((study["spec"].get("trialTemplate") or {}))
+        tmpl = _substitute(tmpl, params)
+        job = {
+            "apiVersion": JT.API_VERSION,
+            "kind": JT.KIND,
+            "metadata": {
+                "name": self.trial_name(study, idx),
+                "namespace": m["namespace"],
+                "labels": {LABEL_STUDY: m["name"], LABEL_TRIAL: str(idx)},
+                "annotations": {
+                    "studyjob.kubeflow.org/parameters": json.dumps(params)},
+            },
+            "spec": tmpl.get("spec", tmpl) or {
+                "replicas": 1,
+                "template": {"spec": {"containers": [
+                    {"name": "jax", "image": "kubeflow-tpu/jaxrt:latest"}]}},
+            },
+        }
+        return job
+
+    def reconcile(self, client, req: Request) -> Result | None:
+        study = client.get_or_none(API_VERSION, KIND, req.name, req.namespace)
+        if study is None or ob.meta(study).get("deletionTimestamp"):
+            return None
+        if ob.cond_is_true(study, COND_SUCCEEDED) or ob.cond_is_true(study, COND_FAILED):
+            return None
+
+        spec = study["spec"]
+        try:
+            suggestions = self._suggestions(study)
+        except ValueError as e:
+            ob.cond_set(study, COND_FAILED, "True", "BadAlgorithm", str(e))
+            client.update_status(study)
+            return None
+        parallel = spec.get("parallelTrialCount", 2)
+
+        trials = client.list(
+            JT.API_VERSION, JT.KIND, namespace=req.namespace,
+            label_selector={"matchLabels": {LABEL_STUDY: req.name}},
+        )
+        by_idx = {int(ob.labels_of(t)[LABEL_TRIAL]): t for t in trials}
+
+        n_done = n_failed = n_active = 0
+        results: list[dict] = []
+        for idx, t in by_idx.items():
+            if ob.cond_is_true(t, JT.COND_SUCCEEDED):
+                n_done += 1
+                val = self.collector(t)
+                results.append({
+                    "trial": ob.meta(t)["name"],
+                    "parameters": json.loads(
+                        ob.annotations_of(t).get(
+                            "studyjob.kubeflow.org/parameters", "{}")),
+                    "objective": val,
+                })
+            elif ob.cond_is_true(t, JT.COND_FAILED):
+                n_failed += 1
+            else:
+                n_active += 1
+
+        # launch next trials up to parallelism
+        next_idx = max(by_idx) + 1 if by_idx else 0
+        while n_active < parallel and next_idx < len(suggestions):
+            trial = self.generate_trial(study, next_idx, suggestions[next_idx])
+            ob.set_owner(trial, study)
+            client.create(trial)
+            n_active += 1
+            next_idx += 1
+
+        status = study.setdefault("status", {})
+        status["trials"] = {"completed": n_done, "failed": n_failed,
+                            "active": n_active, "total": len(suggestions)}
+        done = n_done + n_failed >= len(suggestions) and n_active == 0
+
+        # best trial so far (objective direction from spec)
+        goal = (spec.get("objective") or {}).get("type", "minimize")
+        scored = [r for r in results if r["objective"] is not None]
+        if scored:
+            best = (min if goal == "minimize" else max)(
+                scored, key=lambda r: r["objective"])
+            status["bestTrial"] = best
+
+        if done:
+            ob.cond_set(study, COND_RUNNING, "False", "SweepComplete", "")
+            if n_done > 0:
+                ob.cond_set(study, COND_SUCCEEDED, "True", "SweepComplete",
+                            f"{n_done}/{len(suggestions)} trials succeeded")
+            else:
+                ob.cond_set(study, COND_FAILED, "True", "AllTrialsFailed",
+                            f"{n_failed} trials failed")
+            client.update_status(study)
+            return None
+
+        ob.cond_set(study, COND_RUNNING, "True", "TrialsRunning",
+                    f"{n_active} active / {n_done} done")
+        client.update_status(study)
+        return Result(requeue_after=2.0)
+
+
+def build_controller(client, collector=default_collector) -> Controller:
+    rec = StudyJobReconciler(collector=collector)
+    ctl = Controller("studyjob", client, rec)
+    ctl.watches_primary(API_VERSION, KIND).owns(JT.API_VERSION, JT.KIND)
+    return ctl

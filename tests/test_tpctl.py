@@ -1,0 +1,204 @@
+"""tpctl deployment engine semantics (reference: bootstrap/ —
+kfctlServer_test.go, router_test.go, server_test.go shapes; idempotency
+contract of testing/kfctl/kfctl_second_apply.py)."""
+
+import json
+
+import pytest
+import yaml
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.tpctl import manifests
+from kubeflow_tpu.tpctl.apply import Coordinator, GkeTpuPlatform
+from kubeflow_tpu.tpctl.server import TpctlServer
+from kubeflow_tpu.tpctl.tpudef import (
+    COND_AVAILABLE,
+    COND_DEGRADED,
+    TpuDef,
+    example_yaml,
+)
+
+
+@pytest.fixture()
+def cfg():
+    return TpuDef.from_dict(yaml.safe_load(example_yaml()))
+
+
+class TestTpuDef:
+    def test_example_roundtrip(self, cfg):
+        assert cfg.name == "kubeflow-tpu"
+        assert cfg.platform == "existing"
+        assert "jaxjob-controller" in cfg.applications
+        again = TpuDef.from_dict(yaml.safe_load(cfg.dump()))
+        assert again.to_object()["spec"] == cfg.to_object()["spec"]
+
+    def test_unknown_application_rejected(self):
+        with pytest.raises(ValueError, match="unknown applications"):
+            TpuDef.from_dict({"spec": {"applications": ["nope"]}})
+
+
+class TestManifests:
+    def test_render_all(self, cfg):
+        objs = manifests.render(cfg)
+        kinds = [(o["kind"], ob.meta(o)["name"]) for o in objs]
+        assert ("CustomResourceDefinition", "jaxjobs.kubeflow.org") in kinds
+        assert ("CustomResourceDefinition", "studyjobs.kubeflow.org") in kinds
+        assert ("Namespace", "kubeflow") in kinds
+        assert ("Deployment", "jaxjob-controller") in kinds
+        assert ("Deployment", "centraldashboard") in kinds
+        assert ("MutatingWebhookConfiguration", "poddefault-webhook") in kinds
+        assert ("ClusterRole", "kubeflow-admin") in kinds
+        # CRDs render before workloads
+        crd_idx = kinds.index(("CustomResourceDefinition", "jaxjobs.kubeflow.org"))
+        dep_idx = kinds.index(("Deployment", "jaxjob-controller"))
+        assert crd_idx < dep_idx
+
+    def test_overlay_patch(self, cfg):
+        cfg.overlays = [{"target": {"kind": "Deployment", "name": "jaxjob-controller"},
+                         "patch": {"spec": {"replicas": 3}}}]
+        objs = manifests.render(cfg)
+        dep = next(o for o in objs if o["kind"] == "Deployment"
+                   and ob.meta(o)["name"] == "jaxjob-controller")
+        assert dep["spec"]["replicas"] == 3
+
+    def test_subset_applications(self):
+        cfg = TpuDef.from_dict(
+            {"spec": {"applications": ["crds", "namespace", "jaxjob-controller"]}})
+        objs = manifests.render(cfg)
+        kinds = {o["kind"] for o in objs}
+        assert "MutatingWebhookConfiguration" not in kinds
+        assert any(o["kind"] == "Deployment" for o in objs)
+
+
+class TestCoordinator:
+    def test_apply_sets_available(self, cfg):
+        cluster = FakeCluster()
+        obj = Coordinator(cluster).apply(cfg)
+        assert ob.cond_is_true(obj, COND_AVAILABLE)
+        assert not ob.cond_is_true(obj, COND_DEGRADED)
+        assert cluster.get("apps/v1", "Deployment", "jaxjob-controller", "kubeflow")
+        assert cluster.get("v1", "Namespace", "kubeflow")
+
+    def test_second_apply_idempotent(self, cfg):
+        """kfctl_second_apply.py contract."""
+        cluster = FakeCluster()
+        coord = Coordinator(cluster)
+        coord.apply(cfg)
+        rvs = {(o["kind"], ob.meta(o)["name"]): ob.meta(o)["resourceVersion"]
+               for o in cluster.list("apps/v1", "Deployment", namespace="kubeflow")}
+        coord.apply(cfg)
+        rvs2 = {(o["kind"], ob.meta(o)["name"]): ob.meta(o)["resourceVersion"]
+                for o in cluster.list("apps/v1", "Deployment", namespace="kubeflow")}
+        assert rvs == rvs2
+
+    def test_apply_failure_sets_degraded(self, cfg):
+        cluster = FakeCluster()
+
+        class Boom(Exception):
+            pass
+
+        class FailingPlatform:
+            def apply(self, cfg):
+                raise Boom("dm quota exceeded")
+
+        coord = Coordinator(cluster, provider=FailingPlatform())
+        with pytest.raises(Boom):
+            coord.apply(cfg)
+        obj = coord.status(cfg.name)
+        assert ob.cond_is_true(obj, COND_DEGRADED)
+
+    def test_delete_removes_components(self, cfg):
+        cluster = FakeCluster()
+        coord = Coordinator(cluster)
+        coord.apply(cfg)
+        coord.delete(cfg)
+        assert cluster.list("apps/v1", "Deployment", namespace="kubeflow") == []
+        assert coord.status(cfg.name) is None
+
+    def test_gke_platform_command_shape(self):
+        cfg = TpuDef.from_dict({
+            "metadata": {"name": "kf"},
+            "spec": {"platform": {"kind": "gke-tpu", "project": "p", "zone": "us-z",
+                                  "accelerator": "tpu-v5-lite-podslice",
+                                  "topology": "4x4"}}})
+        cmds = GkeTpuPlatform().commands(cfg)
+        joined = " ".join(cmds[0])
+        assert "--project=p" in joined
+        assert "gke-tpu-topology=4x4" in joined
+
+
+class TestServer:
+    def test_create_then_poll(self, cfg):
+        import requests
+
+        cluster = FakeCluster()
+        srv = TpctlServer(cluster)
+        svc = srv.serve(host="127.0.0.1")
+        svc.serve_background()
+        try:
+            base = f"http://127.0.0.1:{svc.port}"
+            r = requests.post(f"{base}/tpctl/apps/v1/create",
+                              json=yaml.safe_load(example_yaml()), timeout=5)
+            assert r.status_code == 200, r.text
+            # poll until the worker finishes the apply
+            import time as _t
+
+            for _ in range(100):
+                g = requests.post(f"{base}/tpctl/apps/v1/get",
+                                  json={"name": "kubeflow-tpu"}, timeout=5)
+                if g.status_code == 200:
+                    conds = {c["type"]: c["status"]
+                             for c in g.json()["conditions"]}
+                    if conds.get(COND_AVAILABLE) == "True":
+                        break
+                _t.sleep(0.05)
+            else:
+                pytest.fail("deployment never became available")
+        finally:
+            svc.shutdown()
+
+    def test_conflicting_spec_rejected(self, cfg):
+        srv = TpctlServer(FakeCluster())
+        from kubeflow_tpu.utils.httpd import HttpReq
+
+        body1 = json.dumps(yaml.safe_load(example_yaml())).encode()
+        req1 = HttpReq("POST", "/tpctl/apps/v1/create", {}, {}, {}, body1)
+        assert srv.router().dispatch(req1).status == 200
+        changed = yaml.safe_load(example_yaml())
+        changed["spec"]["namespace"] = "other"
+        req2 = HttpReq("POST", "/tpctl/apps/v1/create", {}, {}, {},
+                       json.dumps(changed).encode())
+        assert srv.router().dispatch(req2).status == 409
+
+    def test_gc_reaps_idle_workers(self, cfg):
+        srv = TpctlServer(FakeCluster(), ttl_s=0.0)
+        from kubeflow_tpu.utils.httpd import HttpReq
+
+        body = json.dumps(yaml.safe_load(example_yaml())).encode()
+        srv.router().dispatch(HttpReq("POST", "/tpctl/apps/v1/create", {}, {}, {}, body))
+        assert srv.workers
+        import time as _t
+
+        _t.sleep(0.01)
+        assert srv.gc_once() == ["kubeflow-tpu"]
+        assert not srv.workers
+
+
+class TestCli:
+    def test_generate_and_dry_run_apply(self, capsys):
+        from kubeflow_tpu.tpctl.cli import main
+
+        assert main(["generate"]) == 0
+        docs = list(yaml.safe_load_all(capsys.readouterr().out))
+        assert any(d["kind"] == "CustomResourceDefinition" for d in docs)
+        assert main(["apply", "--dry-run"]) == 0
+        out = capsys.readouterr().out
+        assert "TpuDefAvailable" in out
+
+    def test_example_subcommand(self, capsys):
+        from kubeflow_tpu.tpctl.cli import main
+
+        assert main(["example"]) == 0
+        cfg = TpuDef.from_dict(yaml.safe_load(capsys.readouterr().out))
+        assert cfg.name == "kubeflow-tpu"
