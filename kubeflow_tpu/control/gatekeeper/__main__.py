@@ -1,0 +1,11 @@
+"""Gatekeeper entry: python -m kubeflow_tpu.control.gatekeeper."""
+import argparse
+
+from kubeflow_tpu.control.gatekeeper.auth import AuthServer
+
+p = argparse.ArgumentParser("gatekeeper")
+p.add_argument("--port", type=int, default=8085)
+args = p.parse_args()
+svc = AuthServer().serve(port=args.port)
+print(f"gatekeeper on :{svc.port}")
+svc.serve_forever()

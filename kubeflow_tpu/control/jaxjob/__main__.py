@@ -1,0 +1,4 @@
+from kubeflow_tpu.control.jaxjob.controller import build_controller
+from kubeflow_tpu.control.mains import run_controller
+
+run_controller("jaxjob-controller", lambda client, args: build_controller(client))

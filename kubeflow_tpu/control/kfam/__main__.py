@@ -1,0 +1,13 @@
+"""KFAM entry: python -m kubeflow_tpu.control.kfam."""
+import argparse
+
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.control.kfam.service import KfamService
+
+p = argparse.ArgumentParser("kfam")
+p.add_argument("--port", type=int, default=8081)
+p.add_argument("--apiserver", default="")
+args = p.parse_args()
+svc = KfamService(RestClient(base_url=args.apiserver or None)).serve(port=args.port)
+print(f"kfam on :{svc.port}")
+svc.serve_forever()

@@ -1,0 +1,43 @@
+"""Shared container entrypoint for controller managers.
+
+Each operator image runs `python -m kubeflow_tpu.control.<name>`; the
+__main__ stubs call into here. Mirrors the kubebuilder main.go shape:
+build the client (in-cluster), build the controller, run forever with
+/metrics + /healthz served (manager wiring of e.g.
+notebook-controller/main.go).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+
+
+def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no cover
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    p = argparse.ArgumentParser(f"kubeflow-tpu-{name}")
+    p.add_argument("--metrics-port", type=int, default=8080)
+    p.add_argument("--apiserver", default="", help="override in-cluster config")
+    if extra_args:
+        extra_args(p)
+    args = p.parse_args()
+
+    from kubeflow_tpu.control.k8s.rest import RestClient
+
+    client = RestClient(base_url=args.apiserver or None)
+    ctl = build(client, args)
+
+    import prometheus_client as prom
+
+    prom.start_http_server(args.metrics_port)
+    ctl.run(workers=2)
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *a: stop.set())
+    signal.signal(signal.SIGINT, lambda *a: stop.set())
+    stop.wait()
+    ctl.stop()

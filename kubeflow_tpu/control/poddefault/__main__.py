@@ -1,0 +1,13 @@
+"""Webhook server entry: python -m kubeflow_tpu.control.poddefault."""
+import argparse
+
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.control.poddefault import PodDefaultMutator
+
+p = argparse.ArgumentParser("poddefault-webhook")
+p.add_argument("--port", type=int, default=4443)
+p.add_argument("--apiserver", default="")
+args = p.parse_args()
+svc = PodDefaultMutator(RestClient(base_url=args.apiserver or None)).serve(port=args.port)
+print(f"poddefault webhook on :{svc.port}")
+svc.serve_forever()

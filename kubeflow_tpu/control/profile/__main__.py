@@ -1,0 +1,9 @@
+from kubeflow_tpu.control.mains import run_controller
+from kubeflow_tpu.control.profile.controller import WorkloadIdentityPlugin, build_controller
+
+run_controller(
+    "profile-controller",
+    lambda client, args: build_controller(
+        client, plugins={"WorkloadIdentity": WorkloadIdentityPlugin()}
+    ),
+)

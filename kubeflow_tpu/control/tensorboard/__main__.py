@@ -1,0 +1,4 @@
+from kubeflow_tpu.control.mains import run_controller
+from kubeflow_tpu.control.tensorboard.controller import build_controller
+
+run_controller("tensorboard-controller", lambda client, args: build_controller(client))

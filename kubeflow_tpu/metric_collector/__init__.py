@@ -1,0 +1,3 @@
+"""Platform availability prober (reference: metric-collector/)."""
+
+from kubeflow_tpu.metric_collector.prober import AvailabilityProber  # noqa: F401

@@ -1,0 +1,3 @@
+from kubeflow_tpu.metric_collector.prober import main
+
+main()

@@ -1,0 +1,89 @@
+"""Availability prober: the `kubeflow_availability` gauge.
+
+Mirrors metric-collector/service-readiness/kubeflow-readiness.py: an
+authenticated GET against the platform endpoint sets a binary Prometheus
+gauge (:20-22, metric_update :25-37). Auth is pluggable (the reference
+used OIDC-through-IAP; header-identity and none are provided here), and
+a multi-target mode probes every component the TpuDef deployed.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+import prometheus_client as prom
+
+log = logging.getLogger("kubeflow_tpu.metric_collector")
+
+_METRICS: dict[str, object] = {}
+
+
+def availability_gauge():
+    if "g" not in _METRICS:
+        _METRICS["g"] = prom.Gauge(
+            "kubeflow_availability",
+            "whether the kubeflow-tpu endpoint answers (1 up / 0 down)",
+            ["target"],
+        )
+    return _METRICS["g"]
+
+
+def http_check(url: str, headers: dict[str, str] | None = None,
+               timeout: float = 10.0) -> bool:
+    import requests
+
+    try:
+        r = requests.get(url, headers=headers or {}, timeout=timeout)
+        return 200 <= r.status_code < 400
+    except Exception as e:
+        log.debug("probe %s failed: %s", url, e)
+        return False
+
+
+class AvailabilityProber:
+    def __init__(
+        self,
+        targets: dict[str, str],
+        checker: Callable[[str], bool] | None = None,
+        user_header: str | None = None,
+    ):
+        headers = {"kubeflow-userid": user_header} if user_header else {}
+        self.targets = targets
+        self.checker = checker or (lambda url: http_check(url, headers))
+
+    def probe_once(self) -> dict[str, bool]:
+        out = {}
+        for name, url in self.targets.items():
+            up = self.checker(url)
+            availability_gauge().labels(target=name).set(1 if up else 0)
+            out[name] = up
+        return out
+
+    def run(self, period_s: float = 30.0) -> None:  # pragma: no cover
+        while True:
+            results = self.probe_once()
+            down = [k for k, v in results.items() if not v]
+            if down:
+                log.warning("targets down: %s", down)
+            time.sleep(period_s)
+
+
+def main() -> None:  # pragma: no cover - container entry
+    import argparse
+
+    p = argparse.ArgumentParser("kubeflow-tpu-metric-collector")
+    p.add_argument("--target", action="append", default=[],
+                   help="name=url, repeatable")
+    p.add_argument("--port", type=int, default=8088)
+    p.add_argument("--period-secs", type=float, default=30.0)
+    args = p.parse_args()
+    targets = dict(t.split("=", 1) for t in args.target) or {
+        "dashboard": "http://centraldashboard.kubeflow.svc/healthz"}
+    prom.start_http_server(args.port)
+    AvailabilityProber(targets).run(args.period_secs)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,10 @@
+"""Model serving with the TF-Serving REST contract.
+
+The reference deploys TF-Serving and its E2E asserts the REST surface
+POST /v1/models/<m>:predict with {"instances": [...]} and a numeric-
+tolerance golden compare (testing/test_tf_serving.py:105-133). This
+package serves jit-compiled JAX models behind the same contract, so
+those test paths run unmodified against the TPU backend.
+"""
+
+from kubeflow_tpu.serving.server import ModelServer, ServedModel  # noqa: F401

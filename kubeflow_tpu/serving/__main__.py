@@ -1,0 +1,3 @@
+from kubeflow_tpu.serving.server import main
+
+main()

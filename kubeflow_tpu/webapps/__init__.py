@@ -1,0 +1,13 @@
+"""User-facing web app backends (SURVEY.md §2.3).
+
+- ``jwa``       — the Jupyter web app REST backend (reference:
+  components/jupyter-web-app/backend): notebook spawner APIs.
+- ``dashboard`` — the central dashboard API (reference:
+  components/centraldashboard/app): workgroup/env-info/contributor
+  endpoints + activity feed + cluster metrics interface.
+
+Frontends are out of scope for parity of *capability*: both reference
+UIs talk to exactly these REST surfaces, which is what the E2E tier
+exercises programmatically (testing/test_jwa.py drives notebook state
+transitions through the same endpoints Selenium clicks through).
+"""

@@ -1,0 +1,205 @@
+"""Central dashboard backend API.
+
+Mirrors centraldashboard/app (SURVEY.md §2.3):
+- /api/workgroup/exists (api_workgroup.ts:249), /create (:276),
+  /env-info (:301), /nuke-self (:324), /get-all-namespaces (:338),
+  /get-contributors/:namespace (:367)
+- /api/activities/{namespace} — the events feed (k8s_service.ts:92)
+- /api/metrics/{type} — cluster metrics behind the MetricsService
+  interface (metrics_service.ts:37). The reference only shipped a
+  Stackdriver implementation (stackdriver_metrics_service.ts:15); here
+  the interface is the contract and a Prometheus-backed implementation
+  reads the in-process registries (node metrics come from the cluster's
+  Node objects), so the dashboard works on any cluster.
+
+Identity: the kubeflow-userid header (attach_user_middleware.ts), with
+the auth-gate middleware rejecting unidentified requests on mutating
+endpoints (:314).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Protocol
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.kfam.service import KfamService
+from kubeflow_tpu.control.profile import types as PT
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
+
+log = logging.getLogger("kubeflow_tpu.dashboard")
+
+USER_HEADER = "kubeflow-userid"
+
+
+class MetricsService(Protocol):
+    """metrics_service.ts:37 analogue."""
+
+    def node_cpu_utilization(self) -> list[dict]: ...
+
+    def node_memory_usage(self) -> list[dict]: ...
+
+    def tpu_chips(self) -> list[dict]: ...
+
+
+class ClusterMetricsService:
+    """Reads Node capacity/allocatable from the cluster — covers the
+    resource charts without a Stackdriver dependency."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def _nodes(self):
+        return self.client.list("v1", "Node")
+
+    def node_cpu_utilization(self) -> list[dict]:
+        out = []
+        for n in self._nodes():
+            st = n.get("status") or {}
+            out.append({
+                "node": ob.meta(n)["name"],
+                "capacity": (st.get("capacity") or {}).get("cpu"),
+                "allocatable": (st.get("allocatable") or {}).get("cpu"),
+            })
+        return out
+
+    def node_memory_usage(self) -> list[dict]:
+        return [{
+            "node": ob.meta(n)["name"],
+            "capacity": ((n.get("status") or {}).get("capacity") or {}).get("memory"),
+        } for n in self._nodes()]
+
+    def tpu_chips(self) -> list[dict]:
+        """The TPU-native metric the reference never had: chips per node."""
+        out = []
+        for n in self._nodes():
+            cap = ((n.get("status") or {}).get("capacity") or {})
+            if PT.RESOURCE_TPU in cap:
+                out.append({
+                    "node": ob.meta(n)["name"],
+                    "chips": cap[PT.RESOURCE_TPU],
+                    "accelerator": ob.labels_of(n).get(
+                        "cloud.google.com/gke-tpu-accelerator", ""),
+                    "topology": ob.labels_of(n).get(
+                        "cloud.google.com/gke-tpu-topology", ""),
+                })
+        return out
+
+
+class Dashboard:
+    def __init__(self, client, kfam: KfamService | None = None,
+                 metrics: MetricsService | None = None):
+        self.client = client
+        self.kfam = kfam or KfamService(client)
+        self.metrics = metrics or ClusterMetricsService(client)
+
+    def _user(self, req: HttpReq, required: bool = True) -> str:
+        user = req.header(USER_HEADER)
+        if not user and required:
+            raise ApiHttpError(401, f"missing {USER_HEADER} header")
+        return user
+
+    def _owned_profiles(self, user: str) -> list[dict]:
+        return [p for p in self.client.list(PT.API_VERSION, PT.KIND)
+                if ((p.get("spec") or {}).get("owner") or {}).get("name") == user]
+
+    def _member_namespaces(self, user: str) -> list[dict]:
+        """Owned + contributed (kfam binding) namespaces with roles."""
+        out = {ob.meta(p)["name"]: "owner" for p in self._owned_profiles(user)}
+        for rb in self.client.list("rbac.authorization.k8s.io/v1", "RoleBinding"):
+            annos = ob.annotations_of(rb)
+            if annos.get(PT.ANNO_USER) == user and annos.get(PT.ANNO_ROLE):
+                out.setdefault(ob.meta(rb)["namespace"], annos[PT.ANNO_ROLE])
+        return [{"namespace": ns, "role": role} for ns, role in sorted(out.items())]
+
+    # -- workgroup endpoints ------------------------------------------------
+
+    def exists(self, req: HttpReq):
+        user = self._user(req)
+        return {"hasAuth": True, "user": user,
+                "hasWorkgroup": bool(self._owned_profiles(user))}
+
+    def create(self, req: HttpReq):
+        user = self._user(req)
+        body = req.json() or {}
+        name = body.get("namespace") or user.split("@")[0].replace(".", "-")
+        prof = PT.new_profile(name, user)
+        try:
+            self.client.create(prof)
+        except ob.Conflict:
+            raise ApiHttpError(409, f"profile {name} already exists")
+        return 200, {"message": f"profile {name} created"}
+
+    def env_info(self, req: HttpReq):
+        user = self._user(req, required=False)
+        return {
+            "user": user,
+            "platform": {"kind": "tpu", "provider": "gke"},
+            "namespaces": self._member_namespaces(user) if user else [],
+            "isClusterAdmin": self.kfam.is_cluster_admin(user),
+        }
+
+    def get_all_namespaces(self, req: HttpReq):
+        user = self._user(req)
+        if not self.kfam.is_cluster_admin(user):
+            raise ApiHttpError(403, "cluster admin only")
+        return {"namespaces": [
+            ob.meta(n)["name"] for n in self.client.list("v1", "Namespace")]}
+
+    def get_contributors(self, req: HttpReq):
+        ns = req.params["namespace"]
+        contributors = []
+        for rb in self.client.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                                   namespace=ns):
+            annos = ob.annotations_of(rb)
+            if annos.get(PT.ANNO_USER) and annos.get(PT.ANNO_ROLE) \
+                    and ob.meta(rb)["name"] != "namespaceAdmin":
+                contributors.append(annos[PT.ANNO_USER])
+        return {"contributors": sorted(set(contributors))}
+
+    def nuke_self(self, req: HttpReq):
+        """Delete every profile the user owns (:324)."""
+        user = self._user(req)
+        victims = self._owned_profiles(user)
+        for p in victims:
+            self.client.delete(PT.API_VERSION, PT.KIND, ob.meta(p)["name"])
+        return 200, {"message": f"deleted {len(victims)} profiles"}
+
+    # -- activity + metrics -------------------------------------------------
+
+    def activities(self, req: HttpReq):
+        ns = req.params["namespace"]
+        evs = self.client.list("v1", "Event", namespace=ns)
+        evs.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
+        return {"events": evs[:50]}
+
+    def get_metrics(self, req: HttpReq):
+        mtype = req.params["type"]
+        if mtype == "node-cpu":
+            return {"values": self.metrics.node_cpu_utilization()}
+        if mtype == "node-memory":
+            return {"values": self.metrics.node_memory_usage()}
+        if mtype == "tpu-chips":
+            return {"values": self.metrics.tpu_chips()}
+        raise ApiHttpError(404, f"unknown metric type {mtype!r}")
+
+    # -- wiring -------------------------------------------------------------
+
+    def router(self) -> Router:
+        r = Router("dashboard")
+        r.route("GET", "/api/workgroup/exists", self.exists)
+        r.route("POST", "/api/workgroup/create", self.create)
+        r.route("GET", "/api/workgroup/env-info", self.env_info)
+        r.route("GET", "/api/workgroup/get-all-namespaces", self.get_all_namespaces)
+        r.route("GET", "/api/workgroup/get-contributors/{namespace}",
+                self.get_contributors)
+        r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
+        r.route("GET", "/api/activities/{namespace}", self.activities)
+        r.route("GET", "/api/metrics/{type}", self.get_metrics)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8082) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)

@@ -1,0 +1,13 @@
+"""Entry: python -m kubeflow_tpu.webapps.dashboard_main."""
+import argparse
+
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.webapps.dashboard import Dashboard
+
+p = argparse.ArgumentParser("dashboard")
+p.add_argument("--port", type=int, default=8082)
+p.add_argument("--apiserver", default="")
+args = p.parse_args()
+svc = Dashboard(RestClient(base_url=args.apiserver or None)).serve(port=args.port)
+print(f"dashboard on :{svc.port}")
+svc.serve_forever()

@@ -1,0 +1,240 @@
+"""Jupyter web app backend: the notebook spawner REST API.
+
+Mirrors jupyter-web-app/backend (SURVEY.md §2.3):
+- GETs for namespaces / notebooks / PVCs / PodDefaults / storageclasses /
+  events (common/base_app.py:23-131),
+- POST notebook: form -> Notebook CR from a template
+  (default/app.py:13, common/yaml/notebook.yaml:1-25),
+- POST pvc (:140), DELETE notebook (:164), health probes (:170-175).
+
+The GPU swap point: where the reference inserts `nvidia.com/gpu` /
+`amd.com/gpu` limits from the form (common/utils.py:262-277), this
+backend inserts `google.com/tpu` chips plus the GKE accelerator/topology
+node selectors.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.notebook import types as NT
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
+
+log = logging.getLogger("kubeflow_tpu.jwa")
+
+USER_HEADER = "kubeflow-userid"
+
+# spawner_ui_config.yaml analogue: what the form offers
+DEFAULT_CONFIG = {
+    "image": {
+        "value": "kubeflow-tpu/jax-notebook:latest",
+        "options": [
+            "kubeflow-tpu/jax-notebook:latest",
+            "kubeflow-tpu/jax-notebook-tpu:latest",
+        ],
+    },
+    "cpu": {"value": "0.5"},
+    "memory": {"value": "1Gi"},
+    "tpu": {
+        "value": 0,
+        "options": [0, 1, 4, 8],
+        "accelerators": ["tpu-v5-lite-podslice", "tpu-v4-podslice"],
+    },
+    "workspaceVolume": {"value": {"size": "10Gi", "mountPath": NT.HOME_DIR}},
+}
+
+
+def process_tpu(container: dict, pod_spec: dict, form: dict) -> None:
+    """utils.py:262-277 equivalent: insert accelerator resources from the
+    form — google.com/tpu instead of nvidia.com/gpu."""
+    tpu = form.get("tpu") or 0
+    if isinstance(tpu, dict):
+        chips = int(tpu.get("count", 0) or 0)
+    else:
+        chips, tpu = int(tpu), {}
+    if not chips:
+        return
+    limits = container.setdefault("resources", {}).setdefault("limits", {})
+    limits[NT.RESOURCE_TPU] = chips
+    accel = tpu.get("accelerator")
+    if accel:
+        sel = pod_spec.setdefault("nodeSelector", {})
+        sel["cloud.google.com/gke-tpu-accelerator"] = accel
+        if tpu.get("topology"):
+            sel["cloud.google.com/gke-tpu-topology"] = tpu["topology"]
+
+
+def notebook_from_form(namespace: str, form: dict) -> dict:
+    """The yaml template + form fill (notebook.yaml:1-25 + app.py:13)."""
+    name = form.get("name")
+    if not name:
+        raise ApiHttpError(400, "notebook form requires 'name'")
+    nb = NT.new_notebook(
+        name, namespace,
+        image=form.get("image", DEFAULT_CONFIG["image"]["value"]),
+        cpu=str(form.get("cpu", DEFAULT_CONFIG["cpu"]["value"])),
+        memory=form.get("memory", DEFAULT_CONFIG["memory"]["value"]),
+    )
+    pod_spec = nb["spec"]["template"]["spec"]
+    container = pod_spec["containers"][0]
+    process_tpu(container, pod_spec, form)
+    ws = form.get("workspaceVolume")
+    if ws:
+        claim = ws.get("name", f"workspace-{name}")
+        container["volumeMounts"] = [
+            {"name": "workspace", "mountPath": ws.get("mountPath", NT.HOME_DIR)}]
+        pod_spec["volumes"] = [
+            {"name": "workspace", "persistentVolumeClaim": {"claimName": claim}}]
+    for k, v in (form.get("labels") or {}).items():
+        ob.set_label(nb, k, v)
+    return nb
+
+
+def notebook_status(nb: dict, events: list[dict]) -> dict:
+    """The row JWA's UI renders (status + last event message)."""
+    m = ob.meta(nb)
+    ready = bool((nb.get("status") or {}).get("readyReplicas"))
+    stopped = NT.STOP_ANNOTATION in ob.annotations_of(nb)
+    phase = "stopped" if stopped else ("ready" if ready else "waiting")
+    own = [e for e in events
+           if (e.get("involvedObject") or {}).get("uid") == m.get("uid")]
+    return {
+        "name": m["name"],
+        "namespace": m["namespace"],
+        "image": nb["spec"]["template"]["spec"]["containers"][0].get("image"),
+        "status": {"phase": phase, "ready": ready},
+        "events": [{"reason": e.get("reason"), "message": e.get("message"),
+                    "type": e.get("type")} for e in own[-5:]],
+    }
+
+
+class JupyterWebApp:
+    def __init__(self, client):
+        self.client = client
+
+    def _user(self, req: HttpReq) -> str:
+        return req.header(USER_HEADER, "anonymous@kubeflow.org")
+
+    # -- GET surfaces -------------------------------------------------------
+
+    def get_config(self, req: HttpReq):
+        return {"config": DEFAULT_CONFIG}
+
+    def get_namespaces(self, req: HttpReq):
+        return {"namespaces": [
+            ob.meta(ns)["name"] for ns in self.client.list("v1", "Namespace")]}
+
+    def get_notebooks(self, req: HttpReq):
+        ns = req.params["ns"]
+        events = self.client.list("v1", "Event", namespace=ns)
+        return {"notebooks": [
+            notebook_status(nb, events)
+            for nb in self.client.list(NT.API_VERSION, NT.KIND, namespace=ns)]}
+
+    def get_pvcs(self, req: HttpReq):
+        ns = req.params["ns"]
+        return {"pvcs": [
+            {"name": ob.meta(p)["name"],
+             "size": ((p.get("spec") or {}).get("resources") or {})
+             .get("requests", {}).get("storage"),
+             "mode": ((p.get("spec") or {}).get("accessModes") or [""])[0]}
+            for p in self.client.list("v1", "PersistentVolumeClaim", namespace=ns)]}
+
+    def get_poddefaults(self, req: HttpReq):
+        ns = req.params["ns"]
+        items = self.client.list("kubeflow.org/v1alpha1", "PodDefault", namespace=ns)
+        return {"poddefaults": [
+            {"name": ob.meta(p)["name"],
+             "desc": (p.get("spec") or {}).get("desc", ob.meta(p)["name"])}
+            for p in items]}
+
+    def get_storageclasses(self, req: HttpReq):
+        return {"storageclasses": [
+            ob.meta(s)["name"]
+            for s in self.client.list("storage.k8s.io/v1", "StorageClass")]}
+
+    def get_events(self, req: HttpReq):
+        ns, name = req.params["ns"], req.params["name"]
+        nb = self.client.get_or_none(NT.API_VERSION, NT.KIND, name, ns)
+        if nb is None:
+            raise ApiHttpError(404, f"notebook {name} not found")
+        uid = ob.meta(nb).get("uid")
+        evs = [e for e in self.client.list("v1", "Event", namespace=ns)
+               if (e.get("involvedObject") or {}).get("uid") == uid]
+        return {"events": evs}
+
+    # -- mutations ----------------------------------------------------------
+
+    def post_notebook(self, req: HttpReq):
+        ns = req.params["ns"]
+        nb = notebook_from_form(ns, req.json() or {})
+        try:
+            self.client.create(nb)
+        except ob.Conflict:
+            raise ApiHttpError(409, f"notebook {ob.meta(nb)['name']} exists")
+        log.info("user %s created notebook %s/%s", self._user(req), ns,
+                 ob.meta(nb)["name"])
+        return 200, {"status": "ok", "name": ob.meta(nb)["name"]}
+
+    def post_pvc(self, req: HttpReq):
+        ns = req.params["ns"]
+        form = req.json() or {}
+        pvc = ob.new_object(
+            "v1", "PersistentVolumeClaim", form.get("name", "workspace"), ns,
+            spec={
+                "accessModes": [form.get("mode", "ReadWriteOnce")],
+                "resources": {"requests": {"storage": form.get("size", "10Gi")}},
+                **({"storageClassName": form["class"]} if form.get("class") else {}),
+            },
+        )
+        try:
+            self.client.create(pvc)
+        except ob.Conflict:
+            raise ApiHttpError(409, f"pvc {ob.meta(pvc)['name']} exists")
+        return 200, {"status": "ok"}
+
+    def delete_notebook(self, req: HttpReq):
+        ns, name = req.params["ns"], req.params["name"]
+        try:
+            self.client.delete(NT.API_VERSION, NT.KIND, name, ns)
+        except ob.NotFound:
+            raise ApiHttpError(404, f"notebook {name} not found")
+        return 200, {"status": "ok"}
+
+    def patch_notebook(self, req: HttpReq):
+        """start/stop (the stop-annotation toggle the culler honors)."""
+        ns, name = req.params["ns"], req.params["name"]
+        body = req.json() or {}
+        nb = self.client.get_or_none(NT.API_VERSION, NT.KIND, name, ns)
+        if nb is None:
+            raise ApiHttpError(404, f"notebook {name} not found")
+        if body.get("stopped"):
+            ob.set_annotation(nb, NT.STOP_ANNOTATION, ob.now_iso())
+        else:
+            ob.annotations_of(nb).pop(NT.STOP_ANNOTATION, None)
+        self.client.update(nb)
+        return 200, {"status": "ok"}
+
+    # -- wiring -------------------------------------------------------------
+
+    def router(self) -> Router:
+        r = Router("jwa")
+        r.route("GET", "/api/config", self.get_config)
+        r.route("GET", "/api/namespaces", self.get_namespaces)
+        r.route("GET", "/api/namespaces/{ns}/notebooks", self.get_notebooks)
+        r.route("POST", "/api/namespaces/{ns}/notebooks", self.post_notebook)
+        r.route("GET", "/api/namespaces/{ns}/notebooks/{name}/events", self.get_events)
+        r.route("PATCH", "/api/namespaces/{ns}/notebooks/{name}", self.patch_notebook)
+        r.route("DELETE", "/api/namespaces/{ns}/notebooks/{name}", self.delete_notebook)
+        r.route("GET", "/api/namespaces/{ns}/pvcs", self.get_pvcs)
+        r.route("POST", "/api/namespaces/{ns}/pvcs", self.post_pvc)
+        r.route("GET", "/api/namespaces/{ns}/poddefaults", self.get_poddefaults)
+        r.route("GET", "/api/storageclasses", self.get_storageclasses)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 5000) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)

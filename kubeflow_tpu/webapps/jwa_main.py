@@ -1,0 +1,13 @@
+"""Entry: python -m kubeflow_tpu.webapps.jwa_main."""
+import argparse
+
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.webapps.jwa import JupyterWebApp
+
+p = argparse.ArgumentParser("jwa")
+p.add_argument("--port", type=int, default=5000)
+p.add_argument("--apiserver", default="")
+args = p.parse_args()
+svc = JupyterWebApp(RestClient(base_url=args.apiserver or None)).serve(port=args.port)
+print(f"jwa on :{svc.port}")
+svc.serve_forever()

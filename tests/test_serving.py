@@ -1,0 +1,140 @@
+"""Serving REST contract — mirrors testing/test_tf_serving.py:105-133:
+POST /v1/models/<m>:predict with retries, numeric-tolerance compare."""
+
+import numpy as np
+import pytest
+import requests
+
+from kubeflow_tpu.serving.server import (
+    ModelServer,
+    ServedModel,
+    _next_pow2,
+    serve_flax_classifier,
+)
+
+
+def softmax_rows(x):
+    x = np.asarray(x, np.float64)
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+@pytest.fixture(scope="module")
+def server():
+    srv = ModelServer()
+    # a deterministic "mnist" stand-in: fixed linear map + softmax
+    rng = np.random.default_rng(0)
+    w = rng.normal(size=(784, 10)).astype(np.float32)
+
+    srv.register(ServedModel(
+        name="mnist",
+        predict_fn=lambda batch: softmax_rows(
+            np.asarray(batch, np.float32).reshape(len(batch), -1) @ w),
+        signature={"inputs": "images"},
+    ))
+    svc = srv.serve(host="127.0.0.1", port=0)
+    svc.serve_background()
+    yield srv, f"http://127.0.0.1:{svc.port}"
+    svc.shutdown()
+
+
+class TestRestContract:
+    def test_predict_with_retries_and_tolerance(self, server):
+        """The exact loop shape of test_tf_serving.py:105-133: retry the
+        POST, then almost_equal compare."""
+        _, base = server
+        x = np.random.default_rng(1).random((3, 28, 28)).tolist()
+        result = None
+        for _ in range(10):  # num_tries=10 (:108)
+            r = requests.post(f"{base}/v1/models/mnist:predict",
+                              json={"instances": x}, timeout=10)
+            if r.status_code == 200:
+                result = r.json()
+                break
+        assert result is not None
+        preds = np.asarray(result["predictions"])
+        assert preds.shape == (3, 10)
+        np.testing.assert_allclose(preds.sum(axis=-1), 1.0, atol=1e-5)
+        # golden determinism: same input -> same output within tolerance
+        r2 = requests.post(f"{base}/v1/models/mnist:predict",
+                           json={"instances": x}, timeout=10)
+        np.testing.assert_allclose(np.asarray(r2.json()["predictions"]),
+                                   preds, atol=1e-6)
+
+    def test_status_endpoint(self, server):
+        _, base = server
+        r = requests.get(f"{base}/v1/models/mnist", timeout=5)
+        st = r.json()["model_version_status"][0]
+        assert st["state"] == "AVAILABLE"
+        assert st["status"]["error_code"] == "OK"
+
+    def test_metadata(self, server):
+        _, base = server
+        r = requests.get(f"{base}/v1/models/mnist/metadata", timeout=5)
+        assert r.json()["model_spec"]["name"] == "mnist"
+
+    def test_unknown_model_404(self, server):
+        _, base = server
+        r = requests.post(f"{base}/v1/models/nope:predict",
+                          json={"instances": [[1]]}, timeout=5)
+        assert r.status_code == 404
+
+    def test_missing_instances_400(self, server):
+        _, base = server
+        r = requests.post(f"{base}/v1/models/mnist:predict",
+                          json={"inputs": [1]}, timeout=5)
+        assert r.status_code == 400
+
+    def test_versioned_predict(self, server):
+        srv, base = server
+        srv.register(ServedModel(name="mnist", version=2,
+                                 predict_fn=lambda b: np.zeros((len(b), 10))))
+        r = requests.post(f"{base}/v1/models/mnist/versions/2:predict",
+                          json={"instances": [[0.0] * 784]}, timeout=5)
+        assert r.status_code == 200
+        assert np.allclose(r.json()["predictions"], 0.0)
+        # latest (highest) version now serves zeros too
+        r2 = requests.post(f"{base}/v1/models/mnist:predict",
+                           json={"instances": [[0.0] * 784]}, timeout=5)
+        assert np.allclose(r2.json()["predictions"], 0.0)
+
+
+class TestBatching:
+    def test_pow2_padding(self):
+        assert [_next_pow2(n) for n in (1, 2, 3, 5, 8, 9)] == [1, 2, 4, 8, 8, 16]
+
+    def test_padding_does_not_change_results(self):
+        calls = []
+
+        def fn(batch):
+            calls.append(len(batch))
+            return np.asarray(batch) * 2
+
+        m = ServedModel(name="x", predict_fn=fn)
+        out = m.predict([[1.0], [2.0], [3.0]])
+        assert calls == [4]  # padded to pow2
+        assert out == [[2.0], [4.0], [6.0]]  # but only 3 results returned
+
+    def test_dict_instances(self):
+        m = ServedModel(
+            name="x",
+            predict_fn=lambda b: {"score": b["a"] + b["b"]},
+            pad_batches=False,
+        )
+        out = m.predict([{"a": 1, "b": 2}, {"a": 3, "b": 4}])
+        assert out == [{"score": 3}, {"score": 7}]
+
+
+class TestFlaxServing:
+    def test_resnet_classifier_end_to_end(self, server):
+        """A real jitted flax model behind the same contract (BERT-base
+        path parity: jit once, stable outputs)."""
+        srv, base = server
+        srv.register(serve_flax_classifier("digits", "resnet18", num_classes=10))
+        x = np.random.default_rng(2).random((2, 28, 28, 1)).tolist()
+        r = requests.post(f"{base}/v1/models/digits:predict",
+                          json={"instances": x}, timeout=120)
+        assert r.status_code == 200, r.text
+        preds = np.asarray(r.json()["predictions"])
+        assert preds.shape == (2, 10)
+        np.testing.assert_allclose(preds.sum(axis=-1), 1.0, atol=1e-4)

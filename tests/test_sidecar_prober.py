@@ -1,0 +1,139 @@
+"""Sidecar lifecycle protocol (reference: openmpi-controller
+controller.py) + availability prober."""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.metric_collector.prober import AvailabilityProber, availability_gauge
+from kubeflow_tpu.sidecar.controller import (
+    PHASE_FAILED,
+    PHASE_SUCCEEDED,
+    SIGCONT_FILE,
+    SIGTERM_FILE,
+    SIGNAL_DIR,
+    SidecarController,
+)
+
+
+def make_master(cluster, phase="Running"):
+    pod = ob.new_object("v1", "Pod", "job-worker-0", "default",
+                        spec={"containers": [{"name": "jax"}]})
+    pod["status"] = {"phase": phase}
+    return cluster.create(pod)
+
+
+class TestSidecar:
+    def test_ready_handshake_writes_sigcont(self, tmp_path):
+        cluster = FakeCluster()
+        make_master(cluster)
+        copies = []
+        ctl = SidecarController(
+            tmp_path, master_pod="job-worker-0", client=cluster,
+            download=("file://src", "file://dst"),
+            copier=lambda s, d: copies.append((s, d)),
+            device_check=lambda: True, timeout_s=5, poll_s=0.01,
+        )
+        with ctl:
+            ctl.wait_ready()
+            assert (tmp_path / SIGNAL_DIR / SIGCONT_FILE).exists()
+            assert copies == [("file://src", "file://dst")]
+        # __exit__ always signals termination (:51)
+        assert (tmp_path / SIGNAL_DIR / SIGTERM_FILE).exists()
+
+    def test_device_gate_blocks_until_present(self, tmp_path):
+        cluster = FakeCluster()
+        make_master(cluster)
+        state = {"present": False}
+        ctl = SidecarController(
+            tmp_path, master_pod="job-worker-0", client=cluster,
+            device_check=lambda: state["present"], timeout_s=5, poll_s=0.01,
+        )
+
+        def flip():
+            time.sleep(0.05)
+            state["present"] = True
+
+        threading.Thread(target=flip).start()
+        with ctl:
+            t0 = time.monotonic()
+            ctl.wait_ready()
+            assert time.monotonic() - t0 >= 0.04
+            assert ctl.is_ready()
+
+    def test_device_gate_timeout(self, tmp_path):
+        ctl = SidecarController(
+            tmp_path, master_pod="m", client=FakeCluster(),
+            device_check=lambda: False, timeout_s=0.05, poll_s=0.01,
+        )
+        with pytest.raises(TimeoutError):
+            with ctl:
+                ctl.wait_ready()
+
+    def test_wait_done_polls_master_to_terminal(self, tmp_path):
+        cluster = FakeCluster()
+        master = make_master(cluster, phase="Running")
+        uploads = []
+        ctl = SidecarController(
+            tmp_path, master_pod="job-worker-0", client=cluster,
+            upload=("file://out", "gs://bucket/out"),
+            copier=lambda s, d: uploads.append((s, d)),
+            device_check=lambda: True, timeout_s=5, poll_s=0.01,
+        )
+
+        def finish():
+            time.sleep(0.05)
+            master["status"]["phase"] = PHASE_SUCCEEDED
+            cluster.update_status(master)
+
+        threading.Thread(target=finish).start()
+        with ctl:
+            assert ctl.wait_done() == PHASE_SUCCEEDED
+        assert uploads == [("file://out", "gs://bucket/out")]
+
+    def test_master_disappearance_is_failure(self, tmp_path):
+        """The reference treats a vanished master as job death (:92-102)."""
+        ctl = SidecarController(tmp_path, master_pod="gone", client=FakeCluster(),
+                                device_check=lambda: True, timeout_s=1, poll_s=0.01)
+        with ctl:
+            assert ctl.wait_done() == PHASE_FAILED
+
+    def test_file_copier_local(self, tmp_path):
+        from kubeflow_tpu.sidecar.controller import default_copier
+
+        src = tmp_path / "a.txt"
+        src.write_text("artifacts")
+        default_copier(str(src), str(tmp_path / "out" / "a.txt"))
+        assert (tmp_path / "out" / "a.txt").read_text() == "artifacts"
+
+
+class TestProber:
+    def test_probe_sets_gauge(self):
+        up = {"dashboard": True, "kfam": False}
+        prober = AvailabilityProber(
+            {"dashboard": "http://d/healthz", "kfam": "http://k/healthz"},
+            checker=lambda url: up["dashboard" if "d/" in url else "kfam"],
+        )
+        results = prober.probe_once()
+        assert results == up
+        g = availability_gauge()
+        assert g.labels(target="dashboard")._value.get() == 1.0
+        assert g.labels(target="kfam")._value.get() == 0.0
+
+    def test_probe_live_http(self):
+        from kubeflow_tpu.utils.httpd import HttpService, Router, add_health_routes
+
+        r = Router("t")
+        add_health_routes(r)
+        svc = HttpService(r, host="127.0.0.1").serve_background()
+        try:
+            prober = AvailabilityProber(
+                {"svc": f"http://127.0.0.1:{svc.port}/healthz",
+                 "down": "http://127.0.0.1:1/healthz"})
+            out = prober.probe_once()
+            assert out == {"svc": True, "down": False}
+        finally:
+            svc.shutdown()

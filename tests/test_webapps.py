@@ -1,0 +1,212 @@
+"""JWA + dashboard backend semantics (reference: jupyter-web-app
+backend tests shape; centraldashboard api_workgroup_test.ts shape)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.kfam.service import KfamService
+from kubeflow_tpu.control.notebook import types as NT
+from kubeflow_tpu.control.poddefault import new_poddefault
+from kubeflow_tpu.control.profile import types as PT
+from kubeflow_tpu.utils.httpd import HttpReq
+from kubeflow_tpu.webapps.dashboard import Dashboard
+from kubeflow_tpu.webapps.jwa import JupyterWebApp
+
+USER = "alice@example.com"
+
+
+def mkreq(method, path, user=USER, body=None, query=None):
+    h = {"kubeflow-userid": user} if user else {}
+    b = json.dumps(body).encode() if body is not None else b""
+    return HttpReq(method=method, path=path, params={}, query=query or {},
+                   headers=h, body=b)
+
+
+def J(resp):
+    assert resp.status < 300, resp.body
+    return json.loads(resp.body)
+
+
+@pytest.fixture()
+def cluster():
+    c = FakeCluster()
+    c.create(ob.new_object("v1", "Namespace", "team-a"))
+    return c
+
+
+class TestJwa:
+    @pytest.fixture()
+    def jwa(self, cluster):
+        return cluster, JupyterWebApp(cluster).router()
+
+    def test_config_and_namespaces(self, jwa):
+        cluster, r = jwa
+        cfg = J(r.dispatch(mkreq("GET", "/api/config")))["config"]
+        assert "tpu" in cfg
+        out = J(r.dispatch(mkreq("GET", "/api/namespaces")))
+        assert out["namespaces"] == ["team-a"]
+
+    def test_create_notebook_with_tpu_form(self, jwa):
+        cluster, r = jwa
+        form = {
+            "name": "mynb",
+            "image": "kubeflow-tpu/jax-notebook-tpu:latest",
+            "cpu": "2", "memory": "4Gi",
+            "tpu": {"count": 4, "accelerator": "tpu-v5-lite-podslice",
+                    "topology": "2x2"},
+            "workspaceVolume": {"name": "ws-mynb", "mountPath": "/home/jovyan"},
+        }
+        out = J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                                 body=form)))
+        assert out["name"] == "mynb"
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "mynb", "team-a")
+        c0 = nb["spec"]["template"]["spec"]["containers"][0]
+        assert c0["resources"]["limits"][NT.RESOURCE_TPU] == 4
+        sel = nb["spec"]["template"]["spec"]["nodeSelector"]
+        assert sel["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert sel["cloud.google.com/gke-tpu-topology"] == "2x2"
+        assert c0["volumeMounts"][0]["mountPath"] == "/home/jovyan"
+        # duplicate -> 409
+        assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                                body=form)).status == 409
+
+    def test_cpu_only_form_has_no_tpu(self, jwa):
+        cluster, r = jwa
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                           body={"name": "cpu-nb"})))
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "cpu-nb", "team-a")
+        limits = (nb["spec"]["template"]["spec"]["containers"][0]
+                  .get("resources", {}).get("limits", {}))
+        assert NT.RESOURCE_TPU not in limits
+
+    def test_list_notebooks_status_phases(self, jwa):
+        cluster, r = jwa
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                           body={"name": "nb1"})))
+        rows = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/notebooks")))
+        assert rows["notebooks"][0]["status"]["phase"] == "waiting"
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "nb1", "team-a")
+        nb["status"] = {"readyReplicas": 1}
+        cluster.update_status(nb)
+        rows = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/notebooks")))
+        assert rows["notebooks"][0]["status"]["phase"] == "ready"
+
+    def test_stop_start_notebook(self, jwa):
+        cluster, r = jwa
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                           body={"name": "nb1"})))
+        J(r.dispatch(mkreq("PATCH", "/api/namespaces/team-a/notebooks/nb1",
+                           body={"stopped": True})))
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "nb1", "team-a")
+        assert NT.STOP_ANNOTATION in ob.annotations_of(nb)
+        J(r.dispatch(mkreq("PATCH", "/api/namespaces/team-a/notebooks/nb1",
+                           body={"stopped": False})))
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "nb1", "team-a")
+        assert NT.STOP_ANNOTATION not in ob.annotations_of(nb)
+
+    def test_delete_notebook(self, jwa):
+        cluster, r = jwa
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                           body={"name": "nb1"})))
+        assert r.dispatch(mkreq("DELETE",
+                                "/api/namespaces/team-a/notebooks/nb1")).status == 200
+        assert r.dispatch(mkreq("DELETE",
+                                "/api/namespaces/team-a/notebooks/nb1")).status == 404
+
+    def test_pvcs_and_poddefaults(self, jwa):
+        cluster, r = jwa
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/pvcs",
+                           body={"name": "data", "size": "20Gi"})))
+        pvcs = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/pvcs")))["pvcs"]
+        assert pvcs == [{"name": "data", "size": "20Gi", "mode": "ReadWriteOnce"}]
+        cluster.create(new_poddefault("tpu-access", "team-a", desc="Mount TPU libs"))
+        pds = J(r.dispatch(mkreq("GET",
+                                 "/api/namespaces/team-a/poddefaults")))["poddefaults"]
+        assert pds == [{"name": "tpu-access", "desc": "Mount TPU libs"}]
+
+
+class TestDashboard:
+    @pytest.fixture()
+    def dash(self, cluster):
+        kfam = KfamService(cluster, cluster_admin="root@example.com")
+        return cluster, Dashboard(cluster, kfam=kfam).router()
+
+    def test_exists_and_create_workgroup(self, dash):
+        cluster, r = dash
+        assert J(r.dispatch(mkreq("GET", "/api/workgroup/exists")))["hasWorkgroup"] is False
+        J(r.dispatch(mkreq("POST", "/api/workgroup/create", body={"namespace": "alice"})))
+        assert J(r.dispatch(mkreq("GET", "/api/workgroup/exists")))["hasWorkgroup"] is True
+        prof = cluster.get(PT.API_VERSION, PT.KIND, "alice")
+        assert prof["spec"]["owner"]["name"] == USER
+
+    def test_env_info_lists_roles(self, dash):
+        cluster, r = dash
+        J(r.dispatch(mkreq("POST", "/api/workgroup/create", body={"namespace": "alice"})))
+        # contributor binding in another namespace
+        rb = ob.new_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                           "user-alice-clusterrole-edit", "team-a",
+                           annotations={PT.ANNO_USER: USER, PT.ANNO_ROLE: "edit"})
+        cluster.create(rb)
+        info = J(r.dispatch(mkreq("GET", "/api/workgroup/env-info")))
+        assert {"namespace": "alice", "role": "owner"} in info["namespaces"]
+        assert {"namespace": "team-a", "role": "edit"} in info["namespaces"]
+        assert info["isClusterAdmin"] is False
+
+    def test_get_all_namespaces_admin_only(self, dash):
+        _, r = dash
+        assert r.dispatch(mkreq("GET", "/api/workgroup/get-all-namespaces")).status == 403
+        out = J(r.dispatch(mkreq("GET", "/api/workgroup/get-all-namespaces",
+                                 user="root@example.com")))
+        assert "team-a" in out["namespaces"]
+
+    def test_contributors_listing(self, dash):
+        cluster, r = dash
+        for u in ("bob@example.com", "eve@example.com"):
+            rb = ob.new_object("rbac.authorization.k8s.io/v1", "RoleBinding",
+                               f"user-{u.split('@')[0]}-clusterrole-edit", "team-a",
+                               annotations={PT.ANNO_USER: u, PT.ANNO_ROLE: "edit"})
+            cluster.create(rb)
+        out = J(r.dispatch(mkreq(
+            "GET", "/api/workgroup/get-contributors/team-a")))
+        assert out["contributors"] == ["bob@example.com", "eve@example.com"]
+
+    def test_nuke_self(self, dash):
+        cluster, r = dash
+        J(r.dispatch(mkreq("POST", "/api/workgroup/create", body={"namespace": "alice"})))
+        out = J(r.dispatch(mkreq("DELETE", "/api/workgroup/nuke-self")))
+        assert "1" in out["message"]
+        # profile has a finalizer; deletionTimestamp set, reconciler would reap
+        prof = cluster.get_or_none(PT.API_VERSION, PT.KIND, "alice")
+        assert prof is None or "deletionTimestamp" in ob.meta(prof)
+
+    def test_activities_feed(self, dash):
+        cluster, r = dash
+        nb = cluster.create(ob.new_object(NT.API_VERSION, NT.KIND, "nb", "team-a",
+                                          spec={}))
+        cluster.record_event(nb, "Created", "statefulset created")
+        out = J(r.dispatch(mkreq("GET", "/api/activities/team-a")))
+        assert out["events"][0]["reason"] == "Created"
+
+    def test_tpu_chip_metrics(self, dash):
+        cluster, r = dash
+        node = ob.new_object("v1", "Node", "tpu-node-1",
+                             labels={"cloud.google.com/gke-tpu-accelerator":
+                                     "tpu-v5-lite-podslice",
+                                     "cloud.google.com/gke-tpu-topology": "2x4"})
+        node["status"] = {"capacity": {"cpu": "8", "memory": "32Gi",
+                                       "google.com/tpu": "4"}}
+        cluster.create(node)
+        out = J(r.dispatch(mkreq("GET", "/api/metrics/tpu-chips")))
+        assert out["values"] == [{"node": "tpu-node-1", "chips": "4",
+                                  "accelerator": "tpu-v5-lite-podslice",
+                                  "topology": "2x4"}]
+        cpu = J(r.dispatch(mkreq("GET", "/api/metrics/node-cpu")))
+        assert cpu["values"][0]["capacity"] == "8"
+        assert r.dispatch(mkreq("GET", "/api/metrics/bogus")).status == 404
+
+    def test_unauthenticated_401(self, dash):
+        _, r = dash
+        assert r.dispatch(mkreq("GET", "/api/workgroup/exists", user=None)).status == 401
