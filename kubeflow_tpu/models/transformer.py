@@ -47,15 +47,9 @@ WIDE_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_MODEL)
 
 
 def shard(x: jax.Array, spec: P) -> jax.Array:
-    """with_sharding_constraint that is a no-op outside a mesh context.
+    from kubeflow_tpu.parallel.mesh import shard_constraint
 
-    Mesh presence is checked explicitly (rather than try/except) so real
-    sharding errors — rank mismatch, indivisible dims — still propagate."""
-    from kubeflow_tpu.parallel.mesh import current_mesh
-
-    if current_mesh() is None:
-        return x
-    return jax.lax.with_sharding_constraint(x, spec)
+    return shard_constraint(x, spec)
 
 
 def _part(init, names):
@@ -80,6 +74,10 @@ class TransformerConfig:
     moe_every: int = 0
     n_experts: int = 8
     expert_top_k: int = 2
+    # Pipeline parallelism: split the block stack into this many stages
+    # over the `pipe` mesh axis (0/1 = no pipelining).
+    pipeline_stages: int = 0
+    pp_microbatches: int = 4
 
 
 def rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -199,6 +197,26 @@ class Block(nn.Module):
         return x + mlp_out
 
 
+class Stage(nn.Module):
+    """One pipeline stage: n_layers/pipeline_stages consecutive blocks.
+
+    Takes batch-free 1-D positions (SPMDPipeline's broadcast-input
+    contract) and broadcasts them to the microbatch rows itself."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions_1d):
+        cfg = self.cfg
+        positions = jnp.broadcast_to(positions_1d[None, :], x.shape[:2])
+        block = Block
+        if cfg.remat:
+            block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
+        for p in range(cfg.n_layers // cfg.pipeline_stages):
+            x = block(cfg, name=f"block_{p}")(x, positions)
+        return x
+
+
 class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
@@ -217,12 +235,31 @@ class TransformerLM(nn.Module):
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
-        block = Block
-        if cfg.remat:
-            block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
-        for i in range(cfg.n_layers):
-            use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-            x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
+        if cfg.pipeline_stages > 1:
+            if cfg.n_layers % cfg.pipeline_stages:
+                raise ValueError(
+                    f"n_layers={cfg.n_layers} not divisible by "
+                    f"pipeline_stages={cfg.pipeline_stages}"
+                )
+            if cfg.moe_every or cfg.attention_impl == "ring" or segment_ids is not None:
+                raise ValueError("pipeline stages support dense blocks with "
+                                 "local attention only (no moe/ring/segments yet)")
+            from kubeflow_tpu.parallel.pipeline import SPMDPipeline
+
+            x = SPMDPipeline(
+                stage_cls=Stage,
+                stage_args=(cfg,),
+                n_stages=cfg.pipeline_stages,
+                n_microbatches=cfg.pp_microbatches,
+                name="pipeline",
+            )(x, jnp.arange(tokens.shape[1], dtype=jnp.int32))
+        else:
+            block = Block
+            if cfg.remat:
+                block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
+            for i in range(cfg.n_layers):
+                use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+                x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         # Untied f32 head, column-parallel over vocab.
         logits = nn.DenseGeneral(

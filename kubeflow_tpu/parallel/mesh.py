@@ -170,3 +170,13 @@ def current_mesh() -> Mesh | None:
     env = jax._src.mesh.thread_resources.env
     m = env.physical_mesh
     return None if m.empty else m
+
+
+def shard_constraint(x: jax.Array, spec: P) -> jax.Array:
+    """with_sharding_constraint that is a no-op outside a mesh context.
+
+    Mesh presence is checked explicitly (rather than try/except) so real
+    sharding errors — rank mismatch, indivisible dims — still propagate."""
+    if current_mesh() is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)

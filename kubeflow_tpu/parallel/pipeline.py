@@ -1,0 +1,132 @@
+"""SPMD pipeline parallelism (GPipe schedule under GSPMD).
+
+The reference has no pipeline parallelism at all — its parallelism ceiling
+is PS data-parallel / MPI allreduce (SURVEY.md §2.5). This module adds the
+`pipe` mesh axis the TPU-native way: instead of per-stage processes and
+point-to-point sends (the GPU/NCCL idiom), the pipeline is ONE jitted SPMD
+program:
+
+- every stage's parameters are stacked on a leading stage dim and sharded
+  over the `pipe` mesh axis (`nn.vmap` + flax partitioning metadata), so
+  each pipeline group holds exactly its own stage weights;
+- one schedule tick applies ALL stages at once (`nn.vmap` over the stage
+  dim — each mesh group computes only its slice);
+- between ticks the activation buffer shifts one stage forward. The shift
+  is written as concat(feed, state[:-1]) on the stage-sharded dim, which
+  XLA lowers to a collective-permute over the ICI ring — the TPU
+  equivalent of the NCCL send/recv pair, but fused into the step program
+  with zero host involvement;
+- `nn.scan` runs the n_microbatches + n_stages - 1 ticks with parameters
+  broadcast (not re-stacked per tick), keeping compile time and HBM flat
+  in the number of ticks.
+
+The GPipe bubble is (pp-1)/(ticks) — amortized by raising
+`n_microbatches`. Backward runs through the scan transpose automatically;
+activations for the backward pass can be rematerialized per-tick with the
+model's usual remat flag.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_PIPELINE,
+    AXIS_SEQ,
+    shard_constraint as _shard,
+)
+
+# Activation-buffer layout: [stage, microbatch, seq, features]
+STATE_SPEC = P(AXIS_PIPELINE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+
+
+class SPMDPipeline(nn.Module):
+    """Runs `n_stages` copies of `stage_cls(*stage_args)` as a pipeline.
+
+    The stage module must have signature ``__call__(x, *broadcast)`` where
+    ``x`` is [mb, seq, d] and ``broadcast`` inputs are shared verbatim by
+    every stage and every microbatch — they must NOT carry a batch
+    dimension (pass e.g. 1-D rope positions and broadcast inside the
+    stage). Parameters of the wrapped stage gain a leading ``pipe``-sharded
+    stage dimension.
+    """
+
+    stage_cls: Any
+    stage_args: tuple = ()
+    n_stages: int = 1
+    n_microbatches: int = 1
+
+    @nn.compact
+    def __call__(self, x: jax.Array, *broadcast: Any) -> jax.Array:
+        pp = self.n_stages
+        batch = x.shape[0]
+        n_mb = self.n_microbatches
+        if n_mb <= 0 or batch % n_mb != 0:
+            # Only shape-only paths (init/eval_shape with a tiny batch) may
+            # degrade; a real batch that doesn't divide is a config error
+            # that would otherwise silently run with a (pp-1)/pp bubble.
+            if batch >= n_mb:
+                raise ValueError(
+                    f"batch {batch} not divisible by n_microbatches {n_mb}"
+                )
+            n_mb = 1
+        mb = batch // n_mb
+        ticks = n_mb + pp - 1
+
+        x_mb = x.reshape(n_mb, mb, *x.shape[1:])
+        # Stage-0 feed for every tick; the tail of the schedule (drain
+        # ticks) re-feeds the last microbatch — its output is discarded.
+        feed = x_mb[jnp.minimum(jnp.arange(ticks), n_mb - 1)]
+        for b in broadcast:
+            if hasattr(b, "shape") and b.shape[:1] == (batch,):
+                raise ValueError(
+                    "broadcast inputs must be batch-free (shared across "
+                    f"microbatches); got leading dim {batch} in {b.shape}"
+                )
+        bcast = tuple(broadcast)
+
+        vstage = nn.vmap(
+            self.stage_cls,
+            in_axes=(0,) + tuple(None for _ in bcast),
+            out_axes=0,
+            variable_axes={"params": 0},
+            split_rngs={"params": True},
+            metadata_params={nn.meta.PARTITION_NAME: AXIS_PIPELINE},
+        )
+
+        outer = self
+
+        class Tick(nn.Module):
+            @nn.compact
+            def __call__(self, state, feed_t):
+                # state[s] = last output of stage s; stage s>0 consumes
+                # stage s-1's output, stage 0 consumes the fresh feed.
+                # The concat of a fresh row with state[:-1] on the
+                # pipe-sharded dim IS the inter-stage transfer: XLA lowers
+                # it to collective-permute over ICI.
+                stages_in = jnp.concatenate([feed_t[None], state[:-1]], axis=0)
+                stages_in = _shard(stages_in, STATE_SPEC)
+                out = vstage(*outer.stage_args, name="stages")(stages_in, *bcast)
+                out = _shard(out, STATE_SPEC)
+                return out, out[-1]
+
+        scanned = nn.scan(
+            Tick,
+            variable_broadcast="params",
+            split_rngs={"params": False},
+            in_axes=0,
+            out_axes=0,
+            length=ticks,
+        )
+        state0 = _shard(jnp.zeros((pp, mb) + x.shape[1:], x.dtype), STATE_SPEC)
+        _, drained = scanned(name="ticks")(state0, feed)
+        # First pp-1 drained rows are bubble output of the cold pipeline.
+        out = drained[pp - 1 :]
+        return out.reshape(batch, *x.shape[1:])

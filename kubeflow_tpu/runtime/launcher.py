@@ -106,6 +106,14 @@ def main(argv: list[str] | None = None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
 
+    # Honor JAX_PLATFORMS even when a sitecustomize imported jax before this
+    # process's env was consulted (jax snapshots the var at import time).
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
+
     from kubeflow_tpu.parallel.dist import initialize_from_env
 
     cfg = initialize_from_env()

@@ -27,6 +27,7 @@ from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
     AXIS_FSDP,
+    AXIS_PIPELINE,
     MeshSpec,
     build_mesh,
     batch_sharding,
@@ -63,6 +64,7 @@ class TrainConfig:
     warmup_steps: int = 100
     total_steps: int = 1000
     remat: bool = False
+    pp_microbatches: int = 4        # pipeline microbatches when mesh.pipe > 1
     aux_loss_weight: float = 0.01   # weight on sowed aux losses (MoE balance)
     seed: int = 0
     log_every: int = 20
@@ -127,6 +129,18 @@ class Trainer:
         kw = dict(self.cfg.model_kwargs)
         if self.cfg.task == "classification":
             kw.setdefault("num_classes", self.cfg.num_classes)
+        pipe = self.mesh.shape.get(AXIS_PIPELINE, 1)
+        if pipe > 1:
+            if self.cfg.task != "lm":
+                raise ValueError("pipeline parallelism (mesh.pipe > 1) is only "
+                                 "supported for transformer LM tasks")
+            if self.cfg.global_batch % self.cfg.pp_microbatches:
+                raise ValueError(
+                    f"global_batch {self.cfg.global_batch} not divisible by "
+                    f"pp_microbatches {self.cfg.pp_microbatches}"
+                )
+            kw.setdefault("pipeline_stages", pipe)
+            kw.setdefault("pp_microbatches", self.cfg.pp_microbatches)
         return kw
 
     def _example_batch(self) -> dict:

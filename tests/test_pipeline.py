@@ -1,0 +1,135 @@
+"""Pipeline-parallelism tests on the 8-device CPU mesh.
+
+The key correctness property: the GPipe-scheduled SPMD pipeline computes
+exactly the same function as the sequential layer stack — only the
+parameter layout (stage-stacked, pipe-sharded) and schedule differ.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from flax.core import meta
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+
+MODEL_KW = dict(
+    vocab_size=128, d_model=32, n_layers=4, n_heads=2, n_kv_heads=2,
+    head_dim=16, d_ff=64, max_seq_len=64, attention_impl="reference",
+)
+
+
+def _restack_params(seq_params: dict, pp: int, n_layers: int) -> dict:
+    """Map sequential params {layer_i: ...} onto the pipelined layout
+    {pipeline: {ticks: {stages: {block_p: stacked-over-stage}}}}."""
+    lps = n_layers // pp
+    out = {k: v for k, v in seq_params.items() if not k.startswith("layer_")}
+    stages = {}
+    for p in range(lps):
+        per_stage = [seq_params[f"layer_{s * lps + p}"] for s in range(pp)]
+        stages[f"block_{p}"] = jax.tree.map(
+            lambda *leaves: jnp.stack(leaves, axis=0), *per_stage
+        )
+    out["pipeline"] = {"ticks": {"stages": stages}}
+    return out
+
+
+@pytest.mark.parametrize("n_mb", [1, 2, 4])
+def test_pipeline_matches_sequential(devices8, n_mb):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+
+    seq_model = get_model("transformer-test", **MODEL_KW)
+    variables = meta.unbox(seq_model.init(jax.random.PRNGKey(0), tokens, train=False))
+    ref = seq_model.apply(variables, tokens, train=False)
+
+    pp_model = get_model(
+        "transformer-test", pipeline_stages=2, pp_microbatches=n_mb, **MODEL_KW
+    )
+    pp_params = {"params": _restack_params(variables["params"], pp=2, n_layers=4)}
+    # Shape agreement with a fresh init of the pipelined model
+    fresh = meta.unbox(pp_model.init(jax.random.PRNGKey(0), tokens, train=False))
+    jax.tree.map(
+        lambda a, b: np.testing.assert_equal(a.shape, b.shape), fresh, pp_params
+    )
+    got = pp_model.apply(pp_params, tokens, train=False)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got), atol=5e-3, rtol=5e-2)
+
+
+def test_pipeline_grads_match_sequential(devices8):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 16), 0, 128)
+    targets = jax.random.randint(jax.random.PRNGKey(2), (4, 16), 0, 128)
+
+    seq_model = get_model("transformer-test", **MODEL_KW)
+    variables = meta.unbox(seq_model.init(jax.random.PRNGKey(0), tokens, train=False))
+    pp_model = get_model(
+        "transformer-test", pipeline_stages=2, pp_microbatches=2, **MODEL_KW
+    )
+    pp_params = {"params": _restack_params(variables["params"], pp=2, n_layers=4)}
+
+    def loss(model, params):
+        import optax
+
+        logits = model.apply(params, tokens, train=False)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), targets
+        ).mean()
+
+    g_seq = jax.grad(lambda p: loss(seq_model, p))(variables)
+    g_pp = jax.grad(lambda p: loss(pp_model, p))(pp_params)
+    # Compare the embedding grad (touched by every microbatch) and the
+    # restacked layer grads.
+    np.testing.assert_allclose(
+        np.asarray(g_seq["params"]["embedding"]),
+        np.asarray(g_pp["params"]["embedding"]),
+        atol=5e-3, rtol=5e-2,
+    )
+    g_seq_stacked = _restack_params(g_seq["params"], pp=2, n_layers=4)
+    for name in ("block_0", "block_1"):
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b, np.float32),
+                atol=5e-3, rtol=5e-2),
+            g_seq_stacked["pipeline"]["ticks"]["stages"][name],
+            g_pp["params"]["pipeline"]["ticks"]["stages"][name],
+        )
+
+
+def test_pipeline_training_on_pipe_mesh(devices8):
+    """End-to-end: Trainer over a dp=2 x pipe=2 x model=2 mesh."""
+    cfg = TrainConfig.from_dict(dict(
+        model="transformer-test",
+        model_kwargs=dict(attention_impl="reference"),
+        task="lm",
+        global_batch=8,
+        seq_len=32,
+        vocab_size=256,
+        mesh=MeshSpec(data=2, pipe=2, model=2),
+        optimizer="adamw",
+        learning_rate=1e-3,
+        total_steps=2,
+        warmup_steps=1,
+        pp_microbatches=2,
+    ))
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    # stage-stacked weights must actually shard over the pipe axis
+    from kubeflow_tpu.parallel.mesh import AXIS_PIPELINE
+
+    stage_leaf = jax.tree.leaves(
+        state.params["pipeline"]["ticks"]["stages"]
+    )[0]
+    spec = stage_leaf.sharding.spec
+    assert spec and spec[0] == AXIS_PIPELINE, f"stage dim not pipe-sharded: {spec}"
+    batch = next(trainer.data_iter())
+    state, m = trainer.train_step(state, batch)
+    state, m = trainer.train_step(state, batch)
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_pipeline_rejects_bad_config(devices8):
+    with pytest.raises(ValueError, match="not divisible"):
+        m = get_model("transformer-test", pipeline_stages=3, **MODEL_KW)
+        m.init(jax.random.PRNGKey(0), jnp.ones((3, 8), jnp.int32), train=False)
