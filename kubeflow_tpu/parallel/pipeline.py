@@ -84,12 +84,10 @@ class SPMDPipeline(nn.Module):
         # Stage-0 feed for every tick; the tail of the schedule (drain
         # ticks) re-feeds the last microbatch — its output is discarded.
         feed = x_mb[jnp.minimum(jnp.arange(ticks), n_mb - 1)]
-        for b in broadcast:
-            if hasattr(b, "shape") and b.shape[:1] == (batch,):
-                raise ValueError(
-                    "broadcast inputs must be batch-free (shared across "
-                    f"microbatches); got leading dim {batch} in {b.shape}"
-                )
+        # Broadcast inputs are shared across microbatches by API contract
+        # (they are passed unsplit to every tick); no shape heuristic here —
+        # a leading dim that merely *equals* batch (e.g. positions when
+        # seq_len == global_batch) is legitimate.
         bcast = tuple(broadcast)
 
         vstage = nn.vmap(

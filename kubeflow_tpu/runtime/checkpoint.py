@@ -1,0 +1,208 @@
+"""Orbax checkpoint/resume for the training runtime.
+
+The reference has NO training checkpointing — it delegates all model state
+to the TF code inside its payload images, and its only "resume" story is
+per-replica `restartPolicy: OnFailure` with a sleep-forever guard
+(tf-controller-examples/tf-cnn/launcher.py:90-93). The platform-level
+state persistence it does have is git-pushing app dirs to Cloud Source
+Repos (bootstrap/cmd/bootstrap/app/ksServer.go:239-267).
+
+On TPU, gang restart is the *only* sane failure policy (a partially
+restarted jax.distributed world can never re-form a mesh), which makes
+training checkpointing a platform concern: the JAXJob controller tears
+down and recreates the whole pod set on any worker failure, and every
+worker resumes from the latest persisted step. This module is that
+mechanism — async orbax saves off the critical path, sharding-aware
+restore onto the live mesh.
+
+Design:
+- `Checkpointer` wraps `orbax.checkpoint.CheckpointManager` (async saves,
+  max_to_keep retention, atomic finalize so a preempted save is never
+  visible as "latest").
+- The persisted payload is the pure-array subset of `TrainState`
+  ({step, params, batch_stats, opt_state}); the optimizer *transform* is
+  rebuilt from config on restore (it is code, not state).
+- Restore takes a live template state and restores onto the template's
+  shardings, so a resumed job lands arrays directly on the mesh with zero
+  reshard traffic when the topology is unchanged — and orbax reshards
+  automatically when it isn't (elastic resume onto a different slice).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any
+
+import jax
+
+log = logging.getLogger("kubeflow_tpu.checkpoint")
+
+
+def _payload(state) -> dict:
+    """The persisted pytree: everything in TrainState that is data."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "batch_stats": state.batch_stats,
+        "opt_state": state.opt_state,
+    }
+
+
+def _abstract(tree) -> Any:
+    """Map a live pytree to ShapeDtypeStruct leaves carrying shardings,
+    the restore target orbax needs to place arrays on the mesh."""
+
+    def one(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+
+    return jax.tree.map(one, tree)
+
+
+def _match_commitment(template, restored):
+    """Orbax returns every leaf *committed* to its restore device. Leaves
+    whose template was an uncommitted single-device array (optimizer state,
+    the step counter — anything jit normally re-places freely) must come
+    back uncommitted too, or the next jitted step rejects the mix of
+    committed single-device and committed mesh-sharded arguments."""
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    def one(t, r):
+        if isinstance(t, jax.Array) and not isinstance(t.sharding, NamedSharding):
+            return jnp.asarray(np.asarray(r))
+        return r
+
+    return jax.tree.map(one, template, restored)
+
+
+class Checkpointer:
+    """Async orbax checkpointing with resume-from-latest.
+
+    Usage (what Trainer.fit does):
+        ckpt = Checkpointer(cfg.checkpoint_dir, keep=cfg.checkpoint_keep)
+        state = ckpt.restore_latest(state) or state   # gang-restart resume
+        ...
+        ckpt.save(step, state)                        # async, non-blocking
+        ...
+        ckpt.close()                                  # wait + release
+    """
+
+    def __init__(self, directory: str, keep: int = 3, async_save: bool = True):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=keep,
+                enable_async_checkpointing=async_save,
+                create=True,
+            ),
+        )
+
+    # -- inspection --------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+    # -- save / restore ----------------------------------------------------
+
+    def save(self, step: int, state, force: bool = False) -> bool:
+        """Queue an async save of `state` at `step`. Device->host transfer
+        happens before return; the filesystem write is off-thread."""
+        saved = self._mgr.save(
+            int(step),
+            args=self._ocp.args.StandardSave(_payload(state)),
+            force=force,
+        )
+        if saved:
+            log.info("checkpoint: queued save at step %d -> %s", step, self.directory)
+        return bool(saved)
+
+    def restore(self, step: int, template_state):
+        """Restore `step` onto the shardings of `template_state`, returning
+        a new TrainState (the template's optimizer transform is reused)."""
+        template = _payload(template_state)
+        restored = self._mgr.restore(
+            int(step), args=self._ocp.args.StandardRestore(_abstract(template))
+        )
+        restored = _match_commitment(template, restored)
+        log.info("checkpoint: restored step %d from %s", step, self.directory)
+        return template_state.replace(
+            step=restored["step"],
+            params=restored["params"],
+            batch_stats=restored["batch_stats"],
+            opt_state=restored["opt_state"],
+        )
+
+    def restore_latest(self, template_state):
+        """Resume-from-latest: returns a restored state, or None when the
+        directory has no finalized checkpoint (fresh start)."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        return self.restore(step, template_state)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def wait(self) -> None:
+        """Block until queued async saves are durably finalized."""
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def restore_params(directory: str, step: int | None = None, shardings=None):
+    """Standalone params-only restore for serving: load `params` from a
+    training checkpoint without optimizer state (the serving-side analogue
+    of TF-Serving pointing at a SavedModel export path). Restores the full
+    saved tree host-side, returns (params, step); pass `shardings` (pytree
+    of NamedSharding matching params) to place them on a mesh."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(directory) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        restored = mgr.restore(int(step))
+    params = restored["params"]
+    if shardings is not None:
+        params = jax.tree.map(jax.device_put, params, shardings)
+    return params, int(step)
+
+
+def restore_variables(directory: str, step: int | None = None):
+    """Inference-variable restore: the flax variables dict
+    ({"params": ..., +"batch_stats" when present}) from a training
+    checkpoint, for model.apply(..., train=False) in serving."""
+    import orbax.checkpoint as ocp
+
+    directory = os.path.abspath(directory)
+    with ocp.CheckpointManager(directory) as mgr:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {directory}")
+        restored = mgr.restore(int(step))
+    variables = {"params": restored["params"]}
+    if restored.get("batch_stats"):
+        variables["batch_stats"] = restored["batch_stats"]
+    return variables, int(step)

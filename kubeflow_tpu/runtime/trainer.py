@@ -68,6 +68,12 @@ class TrainConfig:
     aux_loss_weight: float = 0.01   # weight on sowed aux losses (MoE balance)
     seed: int = 0
     log_every: int = 20
+    # orbax checkpoint/resume (SURVEY.md §5): async saves + resume-from-
+    # latest on gang restart. checkpoint_every=0 => save only at the end.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 0
+    checkpoint_keep: int = 3
+    resume: bool = True
 
     @classmethod
     def from_dict(cls, d: dict) -> "TrainConfig":
@@ -303,18 +309,52 @@ class Trainer:
 
     def fit(self, steps: int | None = None, state: TrainState | None = None,
             callback: Callable[[int, dict], None] | None = None) -> tuple[TrainState, dict]:
-        """Run the training loop; returns final state + summary metrics."""
+        """Run the training loop; returns final state + summary metrics.
+
+        `steps` is the global step target: on a gang restart with
+        cfg.checkpoint_dir set, training resumes from the latest orbax
+        checkpoint and runs only the remaining steps.
+        """
         cfg = self.cfg
         steps = steps or cfg.total_steps
         state = state or self.init_state()
+
+        ckpt = None
+        if cfg.checkpoint_dir:
+            from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+            ckpt = Checkpointer(cfg.checkpoint_dir, keep=cfg.checkpoint_keep)
+            if cfg.resume:
+                restored = ckpt.restore_latest(state)
+                if restored is not None:
+                    state = restored
+                    log.info("resumed from checkpoint at step %d", int(state.step))
+        start_step = int(state.step)
+        if start_step >= steps:
+            # Target already reached (resume landed at/after it): no-op run.
+            # Same summary schema as the normal path; executed count is
+            # always steps - start_step.
+            if ckpt:
+                ckpt.close()
+            return state, {"steps": steps, "start_step": start_step,
+                           "step_time_s": float("nan"),
+                           "examples_per_sec": 0.0, "mfu": 0.0, "final": {}}
+
         data = self._device_iter(self.data_iter())
         kind = next(iter(self.mesh.devices.flat)).device_kind
         meter = rt_metrics.StepMeter(self.flops_per_step(), self.mesh.devices.size, kind)
         last = {}
+        last_saved = -1
         first_dt = float("nan")
         import time as _time
 
-        for i in range(steps):
+        def maybe_save(gstep: int, st) -> None:
+            nonlocal last_saved
+            if ckpt and cfg.checkpoint_every and gstep % cfg.checkpoint_every == 0:
+                ckpt.save(gstep, st)
+                last_saved = gstep
+
+        for i in range(steps - start_step):
             batch = next(data)
             if i == 0:
                 # Step 0 pays XLA compile; keep it out of the meter window
@@ -325,6 +365,7 @@ class Trainer:
                 first_dt = _time.perf_counter() - t0
                 log.info("first step (incl. compile): %.2fs", first_dt)
                 last = {k: float(v) for k, v in m.items()}
+                maybe_save(start_step + 1, state)
                 if callback:
                     callback(i, m)
                 continue
@@ -332,7 +373,7 @@ class Trainer:
             state, m = self.train_step(state, batch)
             jax.block_until_ready(m["loss"])
             meter.stop()
-            if (i + 1) % cfg.log_every == 0 or i == steps - 1:
+            if (i + 1) % cfg.log_every == 0 or i == steps - start_step - 1:
                 last = {k: float(v) for k, v in m.items()}
                 rt_metrics.REGISTRY.gauge("jaxrt_step_seconds", meter.step_time,
                                           "mean step wall time")
@@ -347,13 +388,21 @@ class Trainer:
                     meter.throughput(cfg.global_batch), meter.step_time * 1e3,
                     meter.mfu * 100,
                 )
+            maybe_save(start_step + i + 1, state)
             if callback:
                 callback(i, m)
+        if ckpt:
+            # Final save (skip if the loop just saved this step), then block
+            # until async writes are durable before returning/exiting.
+            if int(state.step) != last_saved:
+                ckpt.save(int(state.step), state, force=True)
+            ckpt.close()
         if meter.steps == 0:
             # single-step run: only the compile step exists to report
             meter._times.append(first_dt)
         summary = {
             "steps": steps,
+            "start_step": start_step,
             "step_time_s": meter.step_time,
             "examples_per_sec": meter.throughput(cfg.global_batch),
             "mfu": meter.mfu,

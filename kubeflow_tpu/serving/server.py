@@ -165,11 +165,14 @@ class ModelServer:
 
 
 def serve_flax_classifier(name: str, model_name: str, input_key: str | None = None,
-                          seed: int = 0, **model_kwargs) -> ServedModel:
+                          seed: int = 0, checkpoint_dir: str | None = None,
+                          **model_kwargs) -> ServedModel:
     """Wrap a zoo model into a ServedModel with a jitted softmax head.
-    Weights are randomly initialized unless restored via orbax (see
-    runtime.checkpoint); the serving contract is shape/latency-exercised
-    either way, matching the reference's mnist golden-compare approach."""
+    With `checkpoint_dir`, weights come from the latest orbax training
+    checkpoint (runtime.checkpoint.restore_variables) — the analogue of
+    TF-Serving pointing at an exported SavedModel; otherwise they are
+    randomly initialized and the serving contract is shape/latency-
+    exercised, matching the reference's mnist golden-compare approach."""
     import jax
     import jax.numpy as jnp
 
@@ -177,6 +180,12 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
 
     model = get_model(model_name, **model_kwargs)
     params = None
+    if checkpoint_dir:
+        from kubeflow_tpu.runtime.checkpoint import restore_variables
+
+        params, step = restore_variables(checkpoint_dir)
+        log.info("model %s: restored variables from %s step %d", name,
+                 checkpoint_dir, step)
 
     @jax.jit
     def fwd(params, x):
@@ -206,12 +215,21 @@ def main() -> None:  # pragma: no cover - container entry
     p.add_argument("--port", type=int, default=8500)
     p.add_argument("--model", action="append", default=[],
                    help="name=zoo_model, e.g. mnist=resnet18")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="orbax checkpoint dir to restore model weights from "
+                        "(single --model only; use name=zoo@dir per model)")
     args = p.parse_args()
+    models = args.model or ["mnist=resnet18"]
+    if args.checkpoint_dir and len(models) > 1:
+        p.error("--checkpoint-dir applies to exactly one --model; "
+                "use name=zoo@ckpt_dir syntax for multiple models")
     server = ModelServer()
-    for spec in args.model or ["mnist=resnet18"]:
+    for spec in models:
         name, _, zoo = spec.partition("=")
+        zoo, _, ckpt = zoo.partition("@")
         server.register(serve_flax_classifier(name, zoo or "resnet18",
-                                              num_classes=10))
+                                              num_classes=10,
+                                              checkpoint_dir=ckpt or args.checkpoint_dir))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)
     svc.serve_forever()
