@@ -68,7 +68,7 @@ class TransformerConfig:
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
     dtype: Dtype = jnp.bfloat16
-    attention_impl: str = "auto"   # auto | flash | reference | ring
+    attention_impl: str = "auto"   # auto | flash | reference | ring | ulysses
     remat: bool = False
     # MoE: every `moe_every`-th block is a mixture layer (0 = dense only)
     moe_every: int = 0
@@ -134,6 +134,11 @@ class Attention(nn.Module):
 
             assert segment_ids is None, "ring attention does not take segment_ids yet"
             out = ring_attention(q, k, v, axis_name=AXIS_SEQ)
+        elif cfg.attention_impl == "ulysses":
+            from kubeflow_tpu.ops.ulysses import ulysses_attention
+
+            assert segment_ids is None, "ulysses attention does not take segment_ids yet"
+            out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ)
         else:
             from kubeflow_tpu.ops.attention import attention
 
@@ -241,9 +246,11 @@ class TransformerLM(nn.Module):
                     f"n_layers={cfg.n_layers} not divisible by "
                     f"pipeline_stages={cfg.pipeline_stages}"
                 )
-            if cfg.moe_every or cfg.attention_impl == "ring" or segment_ids is not None:
+            if (cfg.moe_every or cfg.attention_impl in ("ring", "ulysses")
+                    or segment_ids is not None):
                 raise ValueError("pipeline stages support dense blocks with "
-                                 "local attention only (no moe/ring/segments yet)")
+                                 "local attention only (no moe/ring/ulysses/"
+                                 "segments yet)")
             from kubeflow_tpu.parallel.pipeline import SPMDPipeline
 
             x = SPMDPipeline(

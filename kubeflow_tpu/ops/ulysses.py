@@ -1,0 +1,112 @@
+"""Ulysses sequence parallelism: all-to-all head sharding for attention.
+
+The second long-context strategy of the §2.5 parallelism matrix
+(SURVEY.md: "optional Ulysses-style all-to-all head sharding" — the
+reference has no sequence parallelism at all). Complements ring
+attention:
+
+- **Ring** keeps sequence sharded and rotates K/V around the ICI ring —
+  O(L/sp) memory per device, nearest-neighbor traffic, best for very
+  long sequences.
+- **Ulysses** re-shards *heads* instead: an all-to-all converts
+  seq-sharded [B, L/sp, H, D] into head-sharded [B, L, H/sp, D], each
+  device runs ordinary (flash) attention over the FULL sequence for its
+  head group, and a second all-to-all restores sequence sharding. Two
+  collectives per attention instead of sp-1 ppermutes; attention itself
+  is completely local, so the fused flash kernel applies unmodified.
+
+Both are exact. On a TPU torus the all-to-all rides ICI; XLA lowers
+`lax.all_to_all` to the native collective.
+
+Reference (public technique literature): Jacobs et al., "DeepSpeed
+Ulysses: System Optimizations for Enabling Training of Extreme Long
+Sequence Transformer Models" (2023).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_MODEL,
+    AXIS_SEQ,
+    current_mesh as _current_mesh,
+)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = AXIS_SEQ,
+    mesh: Mesh | None = None,
+    causal: bool = True,
+    impl: str = "auto",
+) -> jax.Array:
+    """Causal attention over seq-sharded [B, L, H, D] via head all-to-all.
+
+    Requires heads-per-device (H / model-axis) divisible by the seq-axis
+    size. Falls back to the dispatching local attention when the mesh has
+    no `seq` axis, so the same model code runs on any mesh spec.
+    """
+    mesh = mesh or _current_mesh()
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        from kubeflow_tpu.ops.attention import attention
+
+        return attention(q, k, v, causal=causal, impl=impl)
+
+    sp = mesh.shape[axis_name]
+    h = q.shape[2]
+    # GQA: repeat KV heads up to Q heads before sharding (same reasoning
+    # as ring_attention: KV weights with few heads are replicated over
+    # `model`, so activations arrive with the original head count).
+    if k.shape[2] != h:
+        assert h % k.shape[2] == 0, (h, k.shape[2])
+        k = jnp.repeat(k, h // k.shape[2], axis=2)
+        v = jnp.repeat(v, h // v.shape[2], axis=2)
+
+    model_size = mesh.shape.get(AXIS_MODEL, 1) if AXIS_MODEL in mesh.axis_names else 1
+    head_axis = AXIS_MODEL if h % max(model_size, 1) == 0 and model_size > 1 else None
+    h_local = h // model_size if head_axis else h
+    if h_local % sp != 0:
+        raise ValueError(
+            f"ulysses needs heads-per-device {h_local} divisible by "
+            f"seq-axis size {sp} (H={h}, model={model_size})"
+        )
+    assert q.shape[1] % sp == 0, (q.shape, sp)
+
+    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, head_axis, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    def _ulysses(q_blk, k_blk, v_blk):
+        # [b, L/sp, h_loc, d] -> [b, L, h_loc/sp, d]: gather sequence,
+        # scatter heads. tiled=True keeps the named axes merged in-place.
+        a2a = functools.partial(
+            jax.lax.all_to_all, axis_name=axis_name, tiled=True
+        )
+        q_g = a2a(q_blk, split_axis=2, concat_axis=1)
+        k_g = a2a(k_blk, split_axis=2, concat_axis=1)
+        v_g = a2a(v_blk, split_axis=2, concat_axis=1)
+
+        from kubeflow_tpu.ops.attention import attention
+
+        out = attention(q_g, k_g, v_g, causal=causal, impl=impl)
+
+        # [b, L, h_loc/sp, d] -> [b, L/sp, h_loc, d]: scatter sequence,
+        # gather heads.
+        return a2a(out, split_axis=1, concat_axis=2)
+
+    return _ulysses(q, k, v)

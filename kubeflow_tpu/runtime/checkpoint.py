@@ -61,6 +61,18 @@ def _abstract(tree) -> Any:
     return jax.tree.map(one, tree)
 
 
+def _normalize_dir(directory: str) -> str:
+    """Local paths become absolute and are created; remote URIs
+    (gs://, s3://, ...) pass through untouched — orbax handles them via
+    epath, and abspath would mangle the scheme into a pod-local path
+    (silently defeating gang-restart resume)."""
+    if "://" in directory:
+        return directory
+    directory = os.path.abspath(directory)
+    os.makedirs(directory, exist_ok=True)
+    return directory
+
+
 def _match_commitment(template, restored):
     """Orbax returns every leaf *committed* to its restore device. Leaves
     whose template was an uncommitted single-device array (optimizer state,
@@ -95,8 +107,7 @@ class Checkpointer:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
-        self.directory = os.path.abspath(directory)
-        os.makedirs(self.directory, exist_ok=True)
+        self.directory = _normalize_dir(directory)
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -118,7 +129,14 @@ class Checkpointer:
 
     def save(self, step: int, state, force: bool = False) -> bool:
         """Queue an async save of `state` at `step`. Device->host transfer
-        happens before return; the filesystem write is off-thread."""
+        happens before return; the filesystem write is off-thread.
+        A step that already exists in the directory (e.g. a resume=False
+        rerun over a populated dir) is skipped unless force=True, which
+        overwrites it."""
+        if not force and int(step) in self._mgr.all_steps():
+            log.warning("checkpoint: step %d already exists in %s; skipping "
+                        "(pass force=True to overwrite)", step, self.directory)
+            return False
         saved = self._mgr.save(
             int(step),
             args=self._ocp.args.StandardSave(_payload(state)),
@@ -168,41 +186,46 @@ class Checkpointer:
         self.close()
 
 
-def restore_params(directory: str, step: int | None = None, shardings=None):
-    """Standalone params-only restore for serving: load `params` from a
-    training checkpoint without optimizer state (the serving-side analogue
-    of TF-Serving pointing at a SavedModel export path). Restores the full
-    saved tree host-side, returns (params, step); pass `shardings` (pytree
-    of NamedSharding matching params) to place them on a mesh."""
-    import orbax.checkpoint as ocp
-
-    directory = os.path.abspath(directory)
-    with ocp.CheckpointManager(directory) as mgr:
-        if step is None:
-            step = mgr.latest_step()
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {directory}")
-        restored = mgr.restore(int(step))
-    params = restored["params"]
-    if shardings is not None:
-        params = jax.tree.map(jax.device_put, params, shardings)
-    return params, int(step)
-
-
 def restore_variables(directory: str, step: int | None = None):
     """Inference-variable restore: the flax variables dict
     ({"params": ..., +"batch_stats" when present}) from a training
-    checkpoint, for model.apply(..., train=False) in serving."""
+    checkpoint, for model.apply(..., train=False) in serving.
+
+    Partial restore: opt_state (2x params for adamw) is skipped via
+    ocp.PLACEHOLDER so serving pods sized for inference never pay the
+    optimizer state's I/O or host memory."""
+    import numpy as np
     import orbax.checkpoint as ocp
 
-    directory = os.path.abspath(directory)
-    with ocp.CheckpointManager(directory) as mgr:
+    directory = _normalize_dir(directory)
+    with ocp.CheckpointManager(
+        directory, item_handlers=ocp.PyTreeCheckpointHandler()
+    ) as mgr:
         if step is None:
             step = mgr.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {directory}")
-        restored = mgr.restore(int(step))
+        meta = mgr.item_metadata(int(step)).tree
+        target = jax.tree.map(lambda _: ocp.PLACEHOLDER, meta)
+        for key in ("step", "params", "batch_stats"):
+            if key in meta:
+                target[key] = jax.tree.map(
+                    lambda _: ocp.type_handlers.RestoreArgs(restore_type=np.ndarray),
+                    meta[key],
+                )
+        restored = mgr.restore(int(step), args=ocp.args.PyTreeRestore(item=target))
     variables = {"params": restored["params"]}
     if restored.get("batch_stats"):
         variables["batch_stats"] = restored["batch_stats"]
     return variables, int(step)
+
+
+def restore_params(directory: str, step: int | None = None, shardings=None):
+    """Params-only convenience wrapper over restore_variables; pass
+    `shardings` (pytree of NamedSharding matching params) to place them
+    on a mesh."""
+    variables, step = restore_variables(directory, step)
+    params = variables["params"]
+    if shardings is not None:
+        params = jax.tree.map(jax.device_put, params, shardings)
+    return params, step

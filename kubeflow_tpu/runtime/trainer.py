@@ -351,8 +351,8 @@ class Trainer:
         def maybe_save(gstep: int, st) -> None:
             nonlocal last_saved
             if ckpt and cfg.checkpoint_every and gstep % cfg.checkpoint_every == 0:
-                ckpt.save(gstep, st)
-                last_saved = gstep
+                if ckpt.save(gstep, st):
+                    last_saved = gstep
 
         for i in range(steps - start_step):
             batch = next(data)
