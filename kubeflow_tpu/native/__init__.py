@@ -1,0 +1,96 @@
+"""Native (C++) runtime components, loaded via ctypes.
+
+The reference has no native source in-tree (every compiled component is
+Go — SURVEY.md §2); its data plane lives inside TF payload images. The
+TPU build keeps the runtime's host-side hot loops native: this package
+holds the compiled artifacts (built from /native at the repo root) and
+the loader glue. Everything degrades gracefully to pure-Python
+implementations when the shared library is absent (e.g. no toolchain),
+so the framework stays importable everywhere while the native path is
+the default in built images.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+
+log = logging.getLogger("kubeflow_tpu.native")
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC_DIR = os.path.join(os.path.dirname(os.path.dirname(_PKG_DIR)), "native")
+_LIB_NAME = "libkfdata.so"
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def library_path() -> str | None:
+    """Path to the built shared library, building it from source on first
+    use when a toolchain is available (dev checkouts); None if absent."""
+    p = os.path.join(_PKG_DIR, _LIB_NAME)
+    if os.path.exists(p):
+        return p
+    makefile = os.path.join(_SRC_DIR, "Makefile")
+    if os.path.exists(makefile):
+        try:
+            subprocess.run(
+                ["make", "-C", _SRC_DIR],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            log.warning("native build failed (%s); using Python fallbacks", e)
+            return None
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def load() -> ctypes.CDLL | None:
+    """The kfdata library with argtypes configured, or None (cached)."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        path = library_path()
+        if path is None:
+            _load_failed = True
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+        except OSError as e:
+            log.warning("cannot dlopen %s (%s); using Python fallbacks", path, e)
+            _load_failed = True
+            return None
+        lib.kfdl_open.restype = ctypes.c_void_p
+        lib.kfdl_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p),
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.c_int,
+            ctypes.c_int,
+        ]
+        lib.kfdl_next.restype = ctypes.c_int64
+        lib.kfdl_next.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_int64,
+        ]
+        lib.kfdl_error.restype = ctypes.c_char_p
+        lib.kfdl_error.argtypes = [ctypes.c_void_p]
+        lib.kfdl_close.restype = None
+        lib.kfdl_close.argtypes = [ctypes.c_void_p]
+        lib.kfdl_crc32.restype = ctypes.c_uint32
+        lib.kfdl_crc32.argtypes = [ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64]
+        _lib = lib
+        return _lib

@@ -133,10 +133,14 @@ class Checkpointer:
         A step that already exists in the directory (e.g. a resume=False
         rerun over a populated dir) is skipped unless force=True, which
         overwrites it."""
-        if not force and int(step) in self._mgr.all_steps():
-            log.warning("checkpoint: step %d already exists in %s; skipping "
-                        "(pass force=True to overwrite)", step, self.directory)
-            return False
+        if int(step) in self._mgr.all_steps():
+            if not force:
+                log.warning("checkpoint: step %d already exists in %s; skipping "
+                            "(pass force=True to overwrite)", step, self.directory)
+                return False
+            # orbax raises StepAlreadyExistsError even with force=True;
+            # delete-then-save is the overwrite.
+            self._mgr.delete(int(step))
         saved = self._mgr.save(
             int(step),
             args=self._ocp.args.StandardSave(_payload(state)),

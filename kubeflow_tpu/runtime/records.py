@@ -1,0 +1,249 @@
+"""KFRecord shards: the real-data input pipeline.
+
+tf_cnn_benchmarks reads TFRecord/ImageNet when --data_dir is set; the
+reference's example jobs run synthetic (create_job_specs.py passes no
+data flags), but the capability must exist. KFRecord is the TPU build's
+shard format: fixed-size records (tensor-friendly: batch assembly is a
+memcpy, random access is offset arithmetic) with per-record CRC32, read
+by the native C++ loader (native/kfdata.cc) on a background thread —
+checksums, shuffling and batching never touch the Python hot path. A
+pure-Python reader with identical semantics serves as fallback and as a
+differential test oracle for the native one.
+
+Format:
+    header : b"KFR1" | u32 version=1 | u64 record_bytes | u64 n_records
+    records: n_records x (record_bytes payload | u32 crc32)
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import zlib
+from typing import Iterator, Sequence
+
+import numpy as np
+
+MAGIC = b"KFR1"
+VERSION = 1
+_HEADER = struct.Struct("<4sIQQ")  # magic, version, record_bytes, n_records
+
+
+# ---------------------------------------------------------------------------
+# writer (Python; writing shards is an offline/CI path, not the hot loop)
+
+
+def write_records(path: str, records: np.ndarray | Sequence[bytes]) -> int:
+    """Write a KFRecord shard. `records` is [n, record_bytes] uint8 (or a
+    sequence of equal-length bytes). Returns number of records written."""
+    if isinstance(records, np.ndarray):
+        if records.ndim != 2 or records.dtype != np.uint8:
+            raise ValueError(f"records must be [n, record_bytes] uint8, got "
+                             f"{records.shape} {records.dtype}")
+        rows = [r.tobytes() for r in records]
+    else:
+        rows = [bytes(r) for r in records]
+    if not rows:
+        raise ValueError("cannot write an empty shard")
+    rb = len(rows[0])
+    if any(len(r) != rb for r in rows):
+        raise ValueError("all records must have equal length")
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(_HEADER.pack(MAGIC, VERSION, rb, len(rows)))
+        for r in rows:
+            f.write(r)
+            f.write(struct.pack("<I", zlib.crc32(r) & 0xFFFFFFFF))
+    os.replace(tmp, path)  # atomic: readers never see partial shards
+    return len(rows)
+
+
+def read_header(path: str) -> tuple[int, int]:
+    """(record_bytes, n_records) of a shard."""
+    with open(path, "rb") as f:
+        magic, version, rb, n = _HEADER.unpack(f.read(_HEADER.size))
+    if magic != MAGIC or version != VERSION:
+        raise ValueError(f"{path}: not a KFRecord v{VERSION} file")
+    return rb, n
+
+
+# ---------------------------------------------------------------------------
+# readers
+
+
+def _iter_records_py(path: str, record_bytes: int) -> Iterator[bytes]:
+    with open(path, "rb") as f:
+        magic, version, rb, n = _HEADER.unpack(f.read(_HEADER.size))
+        if magic != MAGIC or version != VERSION:
+            raise ValueError(f"{path}: not a KFRecord v{VERSION} file")
+        if rb != record_bytes:
+            raise ValueError(f"{path}: record_bytes mismatch: file has {rb}, "
+                             f"loader expects {record_bytes}")
+        for i in range(n):
+            payload = f.read(record_bytes)
+            (crc,) = struct.unpack("<I", f.read(4))
+            if len(payload) != record_bytes:
+                raise ValueError(f"{path}: truncated record {i}")
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise ValueError(f"{path}: crc mismatch in record {i}")
+            yield payload
+
+
+class _PyLoader:
+    """Pure-Python loader with the same shuffle/batch semantics as the
+    native one (reservoir-swap pool, file order, end-of-data drain)."""
+
+    def __init__(self, paths, record_bytes, batch, shuffle_buffer, seed,
+                 loop, drop_remainder):
+        self.paths = paths
+        self.record_bytes = record_bytes
+        self.batch = batch
+        self.shuffle_buffer = shuffle_buffer
+        self.loop = loop
+        self.drop_remainder = drop_remainder
+        self._rng = np.random.Generator(np.random.PCG64(seed))
+        self._gen = self._batches()
+
+    def _records(self) -> Iterator[bytes]:
+        while True:
+            for p in self.paths:
+                yield from _iter_records_py(p, self.record_bytes)
+            if not self.loop:
+                return
+
+    def _shuffled(self) -> Iterator[bytes]:
+        if self.shuffle_buffer <= 1:
+            yield from self._records()
+            return
+        pool: list[bytes] = []
+        for rec in self._records():
+            if len(pool) < self.shuffle_buffer:
+                pool.append(rec)
+                continue
+            j = int(self._rng.integers(0, len(pool)))
+            pool[j], rec = rec, pool[j]
+            yield rec
+        self._rng.shuffle(pool)  # end-of-data drain
+        yield from pool
+
+    def _batches(self) -> Iterator[np.ndarray]:
+        cur: list[bytes] = []
+        for rec in self._shuffled():
+            cur.append(rec)
+            if len(cur) == self.batch:
+                yield np.frombuffer(b"".join(cur), np.uint8).reshape(
+                    self.batch, self.record_bytes)
+                cur = []
+        if cur and not self.drop_remainder:
+            yield np.frombuffer(b"".join(cur), np.uint8).reshape(
+                len(cur), self.record_bytes)
+
+    def next(self) -> np.ndarray | None:
+        return next(self._gen, None)
+
+    def close(self) -> None:
+        pass
+
+
+class _NativeLoader:
+    def __init__(self, lib, paths, record_bytes, batch, shuffle_buffer, seed,
+                 loop, drop_remainder, queue_capacity=4):
+        import ctypes
+
+        self._lib = lib
+        self._ctypes = ctypes
+        arr = (ctypes.c_char_p * len(paths))(*[p.encode() for p in paths])
+        self._h = lib.kfdl_open(arr, len(paths), record_bytes, batch,
+                                shuffle_buffer, seed, int(loop),
+                                int(drop_remainder), queue_capacity)
+        if not self._h:
+            raise ValueError("kfdl_open failed (bad arguments)")
+        self.record_bytes = record_bytes
+        self.batch = batch
+
+    def next(self) -> np.ndarray | None:
+        if self._h is None:  # closed: NULL handle would segfault in C++
+            return None
+        cap = self.batch * self.record_bytes
+        out = np.empty(cap, np.uint8)
+        n = self._lib.kfdl_next(
+            self._h,
+            out.ctypes.data_as(self._ctypes.POINTER(self._ctypes.c_uint8)),
+            cap,
+        )
+        if n < 0:
+            err = self._lib.kfdl_error(self._h).decode()
+            raise ValueError(err or "kfdata: unknown error")
+        if n == 0:
+            return None
+        assert n % self.record_bytes == 0, (n, self.record_bytes)
+        return out[:n].reshape(n // self.record_bytes, self.record_bytes)
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.kfdl_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class RecordDataset:
+    """Iterator of [batch, record_bytes] uint8 batches over KFRecord
+    shards; native C++ loader when built, Python fallback otherwise."""
+
+    def __init__(self, paths: Sequence[str], batch: int, *,
+                 record_bytes: int | None = None, shuffle_buffer: int = 0,
+                 seed: int = 0, loop: bool = False,
+                 drop_remainder: bool = True, native: bool | None = None):
+        paths = list(paths)
+        if not paths:
+            raise ValueError("no shard paths given")
+        rb = record_bytes if record_bytes is not None else read_header(paths[0])[0]
+        lib = None
+        if native is None or native:
+            from kubeflow_tpu import native as native_pkg
+
+            lib = native_pkg.load()
+            if lib is None and native:
+                raise RuntimeError("native kfdata library unavailable")
+        args = (paths, rb, batch, shuffle_buffer, seed, loop, drop_remainder)
+        self._impl = _NativeLoader(lib, *args) if lib else _PyLoader(*args)
+        self.record_bytes = rb
+        self.native = lib is not None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        b = self._impl.next()
+        if b is None:
+            raise StopIteration
+        return b
+
+    def close(self) -> None:
+        self._impl.close()
+
+
+def token_batches(paths: Sequence[str], batch: int, seq_len: int, *,
+                  shuffle_buffer: int = 0, seed: int = 0,
+                  loop: bool = True) -> Iterator[dict]:
+    """LM batches from token shards: records are (seq_len+1) int32 tokens;
+    yields {"tokens": [b, L], "targets": [b, L]} (next-token shift)."""
+    rb = (seq_len + 1) * 4
+    ds = RecordDataset(paths, batch, record_bytes=rb,
+                       shuffle_buffer=shuffle_buffer, seed=seed, loop=loop)
+    for raw in ds:
+        tok = raw.view(np.int32).reshape(raw.shape[0], seq_len + 1)
+        yield {"tokens": tok[:, :-1], "targets": tok[:, 1:]}
+
+
+def write_token_shard(path: str, tokens: np.ndarray) -> int:
+    """Write [n, seq_len+1] int32 token sequences as a KFRecord shard."""
+    if tokens.ndim != 2 or tokens.dtype != np.int32:
+        raise ValueError(f"tokens must be [n, seq_len+1] int32, got "
+                         f"{tokens.shape} {tokens.dtype}")
+    return write_records(path, tokens.view(np.uint8).reshape(tokens.shape[0], -1))

@@ -74,6 +74,10 @@ class TrainConfig:
     checkpoint_every: int = 0
     checkpoint_keep: int = 3
     resume: bool = True
+    # Real data: glob of KFRecord token shards (runtime/records.py). When
+    # unset, synthetic batches (the tf_cnn_benchmarks default) are used.
+    data_path: str | None = None
+    shuffle_buffer: int = 0
 
     @classmethod
     def from_dict(cls, d: dict) -> "TrainConfig":
@@ -163,6 +167,19 @@ class Trainer:
 
     def data_iter(self) -> Iterator[dict]:
         cfg = self.cfg
+        if cfg.data_path:
+            if cfg.task != "lm":
+                raise ValueError("data_path currently supports lm token shards")
+            import glob as _glob
+
+            from kubeflow_tpu.runtime.records import token_batches
+
+            paths = sorted(_glob.glob(cfg.data_path))
+            if not paths:
+                raise FileNotFoundError(f"no shards match {cfg.data_path!r}")
+            return token_batches(paths, cfg.global_batch, cfg.seq_len,
+                                 shuffle_buffer=cfg.shuffle_buffer,
+                                 seed=cfg.seed, loop=True)
         if cfg.task == "classification":
             return synthetic_images(cfg.global_batch, cfg.image_size, cfg.num_classes, cfg.seed)
         return synthetic_tokens(cfg.global_batch, cfg.seq_len, cfg.vocab_size, cfg.seed)
@@ -340,7 +357,17 @@ class Trainer:
                            "step_time_s": float("nan"),
                            "examples_per_sec": 0.0, "mfu": 0.0, "final": {}}
 
-        data = self._device_iter(self.data_iter())
+        if cfg.data_path:
+            # Real data: background host->device prefetch overlaps the
+            # upload of batch N+1 with compute of batch N.
+            from kubeflow_tpu.runtime.data import Prefetcher
+
+            data = Prefetcher(
+                self.data_iter(),
+                next(iter(jax.tree.leaves(self.batch_shardings))),
+            )
+        else:
+            data = self._device_iter(self.data_iter())
         kind = next(iter(self.mesh.devices.flat)).device_kind
         meter = rt_metrics.StepMeter(self.flops_per_step(), self.mesh.devices.size, kind)
         last = {}
@@ -354,49 +381,56 @@ class Trainer:
                 if ckpt.save(gstep, st):
                     last_saved = gstep
 
-        for i in range(steps - start_step):
-            batch = next(data)
-            if i == 0:
-                # Step 0 pays XLA compile; keep it out of the meter window
-                # so step_time/throughput/MFU reflect steady state.
-                t0 = _time.perf_counter()
+        ok = False
+        try:
+            for i in range(steps - start_step):
+                batch = next(data)
+                if i == 0:
+                    # Step 0 pays XLA compile; keep it out of the meter window
+                    # so step_time/throughput/MFU reflect steady state.
+                    t0 = _time.perf_counter()
+                    state, m = self.train_step(state, batch)
+                    jax.block_until_ready(m["loss"])
+                    first_dt = _time.perf_counter() - t0
+                    log.info("first step (incl. compile): %.2fs", first_dt)
+                    last = {k: float(v) for k, v in m.items()}
+                    maybe_save(start_step + 1, state)
+                    if callback:
+                        callback(i, m)
+                    continue
+                meter.start()
                 state, m = self.train_step(state, batch)
                 jax.block_until_ready(m["loss"])
-                first_dt = _time.perf_counter() - t0
-                log.info("first step (incl. compile): %.2fs", first_dt)
-                last = {k: float(v) for k, v in m.items()}
-                maybe_save(start_step + 1, state)
+                meter.stop()
+                if (i + 1) % cfg.log_every == 0 or i == steps - start_step - 1:
+                    last = {k: float(v) for k, v in m.items()}
+                    rt_metrics.REGISTRY.gauge("jaxrt_step_seconds", meter.step_time,
+                                              "mean step wall time")
+                    rt_metrics.REGISTRY.gauge("jaxrt_examples_per_sec",
+                                              meter.throughput(cfg.global_batch),
+                                              "training throughput")
+                    rt_metrics.REGISTRY.gauge("jaxrt_mfu", meter.mfu, "model FLOPs utilization")
+                    rt_metrics.REGISTRY.gauge("jaxrt_loss", last["loss"], "training loss")
+                    log.info(
+                        "step %d loss=%.4f acc=%.3f %.1f ex/s step=%.1fms mfu=%.1f%%",
+                        i + 1, last["loss"], last.get("accuracy", float("nan")),
+                        meter.throughput(cfg.global_batch), meter.step_time * 1e3,
+                        meter.mfu * 100,
+                    )
+                maybe_save(start_step + i + 1, state)
                 if callback:
                     callback(i, m)
-                continue
-            meter.start()
-            state, m = self.train_step(state, batch)
-            jax.block_until_ready(m["loss"])
-            meter.stop()
-            if (i + 1) % cfg.log_every == 0 or i == steps - start_step - 1:
-                last = {k: float(v) for k, v in m.items()}
-                rt_metrics.REGISTRY.gauge("jaxrt_step_seconds", meter.step_time,
-                                          "mean step wall time")
-                rt_metrics.REGISTRY.gauge("jaxrt_examples_per_sec",
-                                          meter.throughput(cfg.global_batch),
-                                          "training throughput")
-                rt_metrics.REGISTRY.gauge("jaxrt_mfu", meter.mfu, "model FLOPs utilization")
-                rt_metrics.REGISTRY.gauge("jaxrt_loss", last["loss"], "training loss")
-                log.info(
-                    "step %d loss=%.4f acc=%.3f %.1f ex/s step=%.1fms mfu=%.1f%%",
-                    i + 1, last["loss"], last.get("accuracy", float("nan")),
-                    meter.throughput(cfg.global_batch), meter.step_time * 1e3,
-                    meter.mfu * 100,
-                )
-            maybe_save(start_step + i + 1, state)
-            if callback:
-                callback(i, m)
-        if ckpt:
-            # Final save (skip if the loop just saved this step), then block
-            # until async writes are durable before returning/exiting.
-            if int(state.step) != last_saved:
-                ckpt.save(int(state.step), state, force=True)
-            ckpt.close()
+            ok = True
+        finally:
+            if hasattr(data, "close"):
+                data.close()  # stop the prefetch thread
+            if ckpt:
+                # Final save only on success (skip if the loop just saved
+                # this step); always close so queued async saves finish
+                # durably even when unwinding on an exception.
+                if ok and int(state.step) != last_saved:
+                    ckpt.save(int(state.step), state, force=True)
+                ckpt.close()
         if meter.steps == 0:
             # single-step run: only the compile step exists to report
             meter._times.append(first_dt)

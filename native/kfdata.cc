@@ -1,0 +1,276 @@
+// kfdata: native record-file loader for the TPU training runtime.
+//
+// The reference platform has no in-tree native IO (every compiled
+// component is Go; data loading is delegated to TF inside payload
+// images). For the TPU build the input pipeline is in-scope: TPUs are
+// fed from host RAM over PCIe, and the feed must come off the Python
+// critical path or MXU utilization drops with it. This library is the
+// hot host-side loop: file reading, checksum validation, shuffling and
+// batch assembly run in a background C++ thread; Python sees only
+// filled numpy buffers via ctypes (kubeflow_tpu/runtime/records.py).
+//
+// File format ("KFR1"): fixed-size-record shards for tensor data.
+//   header : magic "KFR1" | u32 version | u64 record_bytes | u64 n_records
+//   records: n_records x (record_bytes payload | u32 crc32)
+// Fixed-size records make batch assembly a memcpy and random access
+// trivial (offset arithmetic), which is what tensor datasets (token
+// sequences, decoded images) want.
+//
+// Concurrency model: one producer thread per loader streams shards
+// sequentially (the fast path for spinning or networked storage),
+// validates CRCs, runs an N-record shuffle pool (reservoir swap, the
+// same algorithm as TF's ShuffleDataset), assembles batches, and pushes
+// them into a bounded queue. The consumer (Python) pops complete
+// batches. Bounded queue => bounded memory; blocking push => backpressure.
+
+#include <algorithm>
+#include <array>
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <random>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kVersion = 1;
+
+uint32_t Crc32(const uint8_t* data, size_t n) {
+  static const std::array<uint32_t, 256> table = [] {
+    std::array<uint32_t, 256> t{};
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[i] = c;
+    }
+    return t;
+  }();
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+#pragma pack(push, 1)
+struct Header {
+  char magic[4];
+  uint32_t version;
+  uint64_t record_bytes;
+  uint64_t n_records;
+};
+#pragma pack(pop)
+
+struct Loader {
+  // config
+  std::vector<std::string> paths;
+  uint64_t record_bytes = 0;
+  int batch = 1;
+  int shuffle_buffer = 0;
+  uint64_t seed = 0;
+  bool loop = false;
+  bool drop_remainder = true;
+  size_t queue_capacity = 4;
+
+  // state
+  std::deque<std::vector<uint8_t>> queue;  // ready batches
+  std::mutex mu;
+  std::condition_variable cv_space, cv_data;
+  std::thread worker;
+  std::atomic<bool> stop{false};
+  bool done = false;
+  std::string error;  // guarded by mu; non-empty => failed
+
+  ~Loader() { Shutdown(); }
+
+  void Shutdown() {
+    stop.store(true);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      cv_space.notify_all();
+      cv_data.notify_all();
+    }
+    if (worker.joinable()) worker.join();
+  }
+
+  void Fail(const std::string& msg) {
+    std::lock_guard<std::mutex> lk(mu);
+    if (error.empty()) error = msg;
+    done = true;
+    cv_data.notify_all();
+  }
+
+  // Blocking bounded push; returns false when shutting down.
+  bool Push(std::vector<uint8_t>&& b) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_space.wait(lk, [&] { return queue.size() < queue_capacity || stop.load(); });
+    if (stop.load()) return false;
+    queue.push_back(std::move(b));
+    cv_data.notify_one();
+    return true;
+  }
+
+  void Run() {
+    std::mt19937_64 rng(seed);
+    std::vector<std::vector<uint8_t>> pool;  // shuffle reservoir
+    if (shuffle_buffer > 1) pool.reserve(shuffle_buffer);
+    std::vector<uint8_t> cur;  // batch under assembly
+    cur.reserve(static_cast<size_t>(batch) * record_bytes);
+    int in_batch = 0;
+
+    auto emit = [&](std::vector<uint8_t>&& rec) -> bool {
+      cur.insert(cur.end(), rec.begin(), rec.end());
+      if (++in_batch == batch) {
+        std::vector<uint8_t> full;
+        full.swap(cur);
+        cur.reserve(static_cast<size_t>(batch) * record_bytes);
+        in_batch = 0;
+        return Push(std::move(full));
+      }
+      return true;
+    };
+    auto deliver = [&](std::vector<uint8_t>&& rec) -> bool {
+      if (shuffle_buffer > 1) {
+        if (static_cast<int>(pool.size()) < shuffle_buffer) {
+          pool.push_back(std::move(rec));
+          return true;
+        }
+        size_t j = rng() % pool.size();
+        std::swap(pool[j], rec);
+      }
+      return emit(std::move(rec));
+    };
+
+    std::vector<uint8_t> buf(record_bytes + 4);
+    do {
+      for (const auto& path : paths) {
+        if (stop.load()) return;
+        FILE* f = std::fopen(path.c_str(), "rb");
+        if (!f) {
+          Fail("kfdata: cannot open " + path);
+          return;
+        }
+        Header h{};
+        if (std::fread(&h, sizeof(h), 1, f) != 1 ||
+            std::memcmp(h.magic, "KFR1", 4) != 0 || h.version != kVersion) {
+          std::fclose(f);
+          Fail("kfdata: bad header in " + path);
+          return;
+        }
+        if (h.record_bytes != record_bytes) {
+          std::fclose(f);
+          Fail("kfdata: record_bytes mismatch in " + path + ": file has " +
+               std::to_string(h.record_bytes) + ", loader expects " +
+               std::to_string(record_bytes));
+          return;
+        }
+        for (uint64_t r = 0; r < h.n_records && !stop.load(); ++r) {
+          if (std::fread(buf.data(), 1, record_bytes + 4, f) != record_bytes + 4) {
+            std::fclose(f);
+            Fail("kfdata: truncated record in " + path);
+            return;
+          }
+          uint32_t want;
+          std::memcpy(&want, buf.data() + record_bytes, 4);
+          if (Crc32(buf.data(), record_bytes) != want) {
+            std::fclose(f);
+            Fail("kfdata: crc mismatch in " + path + " record " +
+                 std::to_string(r));
+            return;
+          }
+          std::vector<uint8_t> rec(buf.begin(), buf.begin() + record_bytes);
+          if (!deliver(std::move(rec))) {
+            std::fclose(f);
+            return;
+          }
+        }
+        std::fclose(f);
+      }
+    } while (loop && !stop.load());
+
+    // End of (non-loop) data: drain the shuffle pool, then the partial batch.
+    std::shuffle(pool.begin(), pool.end(), rng);
+    for (auto& rec : pool) {
+      if (!emit(std::move(rec))) return;
+    }
+    if (in_batch > 0 && !drop_remainder) {
+      Push(std::move(cur));
+    }
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      done = true;
+      cv_data.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Create a loader and start its producer thread. Returns NULL on bad args.
+void* kfdl_open(const char** paths, int n_paths, uint64_t record_bytes,
+                int batch, int shuffle_buffer, uint64_t seed, int loop,
+                int drop_remainder, int queue_capacity) {
+  if (n_paths <= 0 || record_bytes == 0 || batch <= 0) return nullptr;
+  auto* l = new Loader();
+  l->paths.assign(paths, paths + n_paths);
+  l->record_bytes = record_bytes;
+  l->batch = batch;
+  l->shuffle_buffer = shuffle_buffer;
+  l->seed = seed;
+  l->loop = loop != 0;
+  l->drop_remainder = drop_remainder != 0;
+  if (queue_capacity > 0) l->queue_capacity = queue_capacity;
+  l->worker = std::thread([l] { l->Run(); });
+  return l;
+}
+
+// Pop the next batch into out (capacity bytes). Returns bytes written
+// (batch*record_bytes, or less for a final partial batch), 0 at end of
+// data, -1 on error (see kfdl_error).
+int64_t kfdl_next(void* handle, uint8_t* out, int64_t capacity) {
+  auto* l = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(l->mu);
+  l->cv_data.wait(lk, [&] {
+    return !l->queue.empty() || l->done || l->stop.load();
+  });
+  // Drain queued (pre-error) batches before reporting the error, matching
+  // the Python oracle: every good batch is delivered deterministically,
+  // THEN the failure surfaces.
+  if (l->queue.empty()) {
+    if (!l->error.empty()) return -1;
+    return 0;  // done or stopping
+  }
+  auto& front = l->queue.front();
+  if (static_cast<int64_t>(front.size()) > capacity) {
+    l->error = "kfdata: output buffer too small";
+    return -1;
+  }
+  std::memcpy(out, front.data(), front.size());
+  int64_t n = static_cast<int64_t>(front.size());
+  l->queue.pop_front();
+  l->cv_space.notify_one();
+  return n;
+}
+
+const char* kfdl_error(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  std::lock_guard<std::mutex> lk(l->mu);
+  return l->error.c_str();  // valid until kfdl_close
+}
+
+void kfdl_close(void* handle) {
+  auto* l = static_cast<Loader*>(handle);
+  delete l;  // ~Loader joins the worker
+}
+
+// Checksum helper exported for the Python writer/tests (must match the
+// reader's polynomial).
+uint32_t kfdl_crc32(const uint8_t* data, uint64_t n) { return Crc32(data, n); }
+
+}  // extern "C"
