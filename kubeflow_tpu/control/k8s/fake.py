@@ -14,6 +14,7 @@ milliseconds, and the same Client interface retargets a live cluster via
 
 from __future__ import annotations
 
+import copy
 import queue
 import threading
 import uuid
@@ -318,6 +319,12 @@ class FakeCluster:
         return self.create(ev)
 
     # -- convenience --------------------------------------------------------
+
+    def dump(self) -> list[dict]:
+        """Snapshot of every stored object (copies) — test/harness helper
+        for whole-cluster assertions like apply idempotency."""
+        with self._lock:
+            return [copy.deepcopy(o) for o in self._store.values()]
 
     def get_or_none(self, api_version: str, kind: str, name: str, namespace: str | None = None):
         try:

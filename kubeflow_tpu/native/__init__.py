@@ -33,10 +33,11 @@ def library_path() -> str | None:
     """Path to the built shared library, building it from source on first
     use when a toolchain is available (dev checkouts); None if absent."""
     p = os.path.join(_PKG_DIR, _LIB_NAME)
-    if os.path.exists(p):
-        return p
     makefile = os.path.join(_SRC_DIR, "Makefile")
     if os.path.exists(makefile):
+        # Always invoke make (it no-ops when the .so is newer than the
+        # source): a stale library silently masking source edits is worse
+        # than the ~10ms make overhead on first use.
         try:
             subprocess.run(
                 ["make", "-C", _SRC_DIR],
@@ -45,11 +46,13 @@ def library_path() -> str | None:
                 timeout=120,
             )
         except (OSError, subprocess.SubprocessError) as e:
+            if os.path.exists(p):
+                log.warning("native rebuild failed (%s); using existing %s",
+                            e, p)
+                return p
             log.warning("native build failed (%s); using Python fallbacks", e)
             return None
-        if os.path.exists(p):
-            return p
-    return None
+    return p if os.path.exists(p) else None
 
 
 def load() -> ctypes.CDLL | None:

@@ -1,0 +1,15 @@
+"""Release tooling: image build/push workflows and version stamping.
+
+Mirrors components/image-releaser + releasing/releaser (SURVEY.md §2.4):
+Argo/ksonnet workflows that build each component image, tag it with the
+git SHA + semver, push, and cut a release. Here the DAG is expressed on
+kubeflow_tpu.testing.workflow (the same runner the E2E harness uses) and
+the container tool is pluggable (docker/podman/`gcloud builds submit`).
+"""
+
+from kubeflow_tpu.release.releaser import (  # noqa: F401
+    IMAGES,
+    ImageSpec,
+    build_commands,
+    release_workflow,
+)

@@ -1,0 +1,131 @@
+"""Image release workflows.
+
+The reference's releaser is a set of Argo workflow jsonnets
+(image-releaser/components/tf-{serving,notebook}-workflow.libsonnet,
+releasing/releaser/components/workflows.libsonnet) that check out the
+repo, run `docker build` per component with a registry/tag parameter
+matrix, push, and emit a release manifest. This module provides that
+capability natively:
+
+- `IMAGES`: the component image inventory (context dir + Dockerfile +
+  build-arg matrix, e.g. the notebook's cpu/tpu variant pair — the
+  versions/{x.y.z}{,gpu} analogue).
+- `build_commands(spec, registry, tag)`: the exact container-tool
+  command lines (pure function: unit-testable, auditable).
+- `release_workflow(...)`: a testing.Workflow DAG — build all images in
+  parallel, then push, then write a release manifest artifact — with a
+  pluggable runner so CI can dry-run it hermetically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import time
+from typing import Callable
+
+from kubeflow_tpu.testing.workflow import Workflow
+
+
+@dataclasses.dataclass(frozen=True)
+class ImageSpec:
+    name: str                      # image repo basename
+    context: str                   # build context, repo-relative
+    dockerfile: str = "Dockerfile"  # relative to context
+    build_args: tuple = ()          # ((key, value), ...)
+
+
+IMAGES: tuple[ImageSpec, ...] = (
+    ImageSpec("jaxrt", ".", "images/jaxrt/Dockerfile"),
+    ImageSpec("jax-notebook", ".", "images/notebook/Dockerfile",
+              (("JAX_EXTRA", "cpu"),)),
+    ImageSpec("jax-notebook-tpu", ".", "images/notebook/Dockerfile",
+              (("JAX_EXTRA", "tpu"),)),
+    ImageSpec("platform", ".", "images/platform/Dockerfile"),
+)
+
+
+def image_ref(spec: ImageSpec, registry: str, tag: str) -> str:
+    return f"{registry}/{spec.name}:{tag}"
+
+
+def build_commands(spec: ImageSpec, registry: str, tag: str,
+                   tool: str = "docker") -> list[list[str]]:
+    """The build command line(s) for one image (push is separate)."""
+    ref = image_ref(spec, registry, tag)
+    cmd = [tool, "build", "-t", ref, "-f", spec.dockerfile]
+    for k, v in spec.build_args:
+        cmd += ["--build-arg", f"{k}={v}"]
+    cmd.append(spec.context)
+    return [cmd]
+
+
+def push_commands(spec: ImageSpec, registry: str, tag: str,
+                  tool: str = "docker") -> list[list[str]]:
+    return [[tool, "push", image_ref(spec, registry, tag)]]
+
+
+def git_tag(repo_dir: str = ".") -> str:
+    """vYYYYMMDD-<shortsha>: the reference's image tag shape."""
+    sha = subprocess.run(["git", "rev-parse", "--short", "HEAD"],
+                         cwd=repo_dir, capture_output=True, text=True,
+                         check=True).stdout.strip()
+    return f"v{time.strftime('%Y%m%d')}-{sha}"
+
+
+def release_workflow(registry: str, tag: str, *,
+                     images: tuple[ImageSpec, ...] = IMAGES,
+                     runner: Callable[[list[str]], None] | None = None,
+                     artifacts_dir: str | None = None,
+                     push: bool = True,
+                     tool: str = "docker") -> Workflow:
+    """Build-all -> push-all -> manifest DAG. `runner` executes one
+    command line; default is subprocess (check=True)."""
+
+    def default_runner(cmd: list[str]) -> None:
+        subprocess.run(cmd, check=True)
+
+    run = runner or default_runner
+    wf = Workflow(f"release-{tag}", artifacts_dir=artifacts_dir)
+
+    def mk_build(spec: ImageSpec):
+        def fn(ctx):
+            for cmd in build_commands(spec, registry, tag, tool):
+                run(cmd)
+            return image_ref(spec, registry, tag)
+        return fn
+
+    def mk_push(spec: ImageSpec):
+        def fn(ctx):
+            for cmd in push_commands(spec, registry, tag, tool):
+                run(cmd)
+        return fn
+
+    push_steps = []
+    for spec in images:
+        wf.step(f"build-{spec.name}", mk_build(spec))
+        if push:
+            wf.step(f"push-{spec.name}", mk_push(spec),
+                    deps=[f"build-{spec.name}"])
+            push_steps.append(f"push-{spec.name}")
+
+    def manifest(ctx):
+        doc = {
+            "tag": tag,
+            "registry": registry,
+            "images": [image_ref(s, registry, tag) for s in images],
+        }
+        ctx.put("manifest", doc)
+        if ctx.artifacts_dir:
+            os.makedirs(ctx.artifacts_dir, exist_ok=True)
+            path = os.path.join(ctx.artifacts_dir, f"release-{tag}.json")
+            with open(path, "w") as f:
+                json.dump(doc, f, indent=2)
+            return path
+        return doc
+
+    wf.step("release-manifest", manifest,
+            deps=push_steps or [f"build-{s.name}" for s in images])
+    return wf

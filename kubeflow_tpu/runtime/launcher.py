@@ -123,6 +123,14 @@ def main(argv: list[str] | None = None) -> int:
         wait_for_devices(args.device_timeout)
 
     if args.config:
+        # On-demand xprof capture server (JAXRT_PROFILER_PORT) so
+        # tensorboard "Capture profile" works against the live pod. Only
+        # on the built-in-trainer path: user commands run in a subprocess
+        # (the process doing the JAX work), which inherits the env and
+        # starts its own server.
+        from kubeflow_tpu.runtime.profiler import start_server_from_env
+
+        start_server_from_env()
         return run_builtin_trainer(load_config(args.config))
     if user_cmd:
         return run_user_command(user_cmd)

@@ -1,0 +1,86 @@
+"""Per-job profiler trace capture (xprof).
+
+The reference has no tracing/profiling beyond request-latency histograms
+(SURVEY.md §5: dashboard charts come from Stackdriver, not from the
+workload). The TPU build makes the training hot loop observable:
+
+- **Windowed capture**: TrainConfig.profile_dir arms a capture of
+  [profile_start_step, profile_start_step + profile_steps) inside
+  Trainer.fit; traces land in <profile_dir>/plugins/profile/... where
+  the Tensorboard controller's profile plugin reads them
+  (control/tensorboard serves the same logdir convention).
+- **On-demand capture**: JAXRT_PROFILER_PORT starts jax.profiler's
+  collection server in the launcher, so `tensorboard --logdir` +
+  "Capture profile" works against a live pod, exactly how a user
+  profiles a job they didn't arm in advance.
+
+Default start step 2: step 0 pays XLA compile and step 1 may still hit
+autotuning; the window should show steady state.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("kubeflow_tpu.profiler")
+
+ENV_PROFILER_PORT = "JAXRT_PROFILER_PORT"
+
+
+def start_server_from_env(env: dict[str, str] | None = None) -> int | None:
+    """Start the on-demand profiler collection server when
+    JAXRT_PROFILER_PORT is set; returns the port or None."""
+    env = dict(os.environ) if env is None else env
+    port_s = env.get(ENV_PROFILER_PORT)
+    if not port_s:
+        return None
+    import jax
+
+    port = int(port_s)
+    jax.profiler.start_server(port)
+    log.info("profiler collection server on :%d", port)
+    return port
+
+
+class TraceWindow:
+    """Arms a [start, start+steps) trace window over a training loop.
+
+    Call .step(global_step) once per step *before* running it; the window
+    starts/stops itself. Safe to call .stop() redundantly (fit's finally
+    path) — a trace is never left open on exceptions."""
+
+    def __init__(self, trace_dir: str | None, start_step: int = 2,
+                 num_steps: int = 3):
+        self.trace_dir = trace_dir
+        self.start_step = start_step
+        self.stop_step = start_step + num_steps
+        self._active = False
+        self.captured = False
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.trace_dir) and not self.captured
+
+    def step(self, global_step: int) -> None:
+        if not self.enabled:
+            return
+        if not self._active and self.start_step <= global_step < self.stop_step:
+            import jax
+
+            os.makedirs(self.trace_dir, exist_ok=True)
+            jax.profiler.start_trace(self.trace_dir)
+            self._active = True
+            log.info("profiler: tracing steps [%d, %d) -> %s",
+                     global_step, self.stop_step, self.trace_dir)
+        elif self._active and global_step >= self.stop_step:
+            self.stop()
+
+    def stop(self) -> None:
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.captured = True
+            log.info("profiler: trace written to %s", self.trace_dir)

@@ -81,9 +81,10 @@ def _iter_records_py(path: str, record_bytes: int) -> Iterator[bytes]:
                              f"loader expects {record_bytes}")
         for i in range(n):
             payload = f.read(record_bytes)
-            (crc,) = struct.unpack("<I", f.read(4))
-            if len(payload) != record_bytes:
+            crc_raw = f.read(4)
+            if len(payload) != record_bytes or len(crc_raw) != 4:
                 raise ValueError(f"{path}: truncated record {i}")
+            (crc,) = struct.unpack("<I", crc_raw)
             if zlib.crc32(payload) & 0xFFFFFFFF != crc:
                 raise ValueError(f"{path}: crc mismatch in record {i}")
             yield payload
@@ -236,9 +237,14 @@ def token_batches(paths: Sequence[str], batch: int, seq_len: int, *,
     rb = (seq_len + 1) * 4
     ds = RecordDataset(paths, batch, record_bytes=rb,
                        shuffle_buffer=shuffle_buffer, seed=seed, loop=loop)
-    for raw in ds:
-        tok = raw.view(np.int32).reshape(raw.shape[0], seq_len + 1)
-        yield {"tokens": tok[:, :-1], "targets": tok[:, 1:]}
+    try:
+        for raw in ds:
+            tok = raw.view(np.int32).reshape(raw.shape[0], seq_len + 1)
+            yield {"tokens": tok[:, :-1], "targets": tok[:, 1:]}
+    finally:
+        # Runs on generator close/GC too, so an abandoned iterator (e.g.
+        # Prefetcher torn down mid-epoch) stops the native worker thread.
+        ds.close()
 
 
 def write_token_shard(path: str, tokens: np.ndarray) -> int:

@@ -78,6 +78,11 @@ class TrainConfig:
     # unset, synthetic batches (the tf_cnn_benchmarks default) are used.
     data_path: str | None = None
     shuffle_buffer: int = 0
+    # xprof trace window (runtime/profiler.py): capture steps
+    # [profile_start_step, profile_start_step + profile_steps).
+    profile_dir: str | None = None
+    profile_start_step: int = 2
+    profile_steps: int = 3
 
     @classmethod
     def from_dict(cls, d: dict) -> "TrainConfig":
@@ -357,17 +362,7 @@ class Trainer:
                            "step_time_s": float("nan"),
                            "examples_per_sec": 0.0, "mfu": 0.0, "final": {}}
 
-        if cfg.data_path:
-            # Real data: background host->device prefetch overlaps the
-            # upload of batch N+1 with compute of batch N.
-            from kubeflow_tpu.runtime.data import Prefetcher
-
-            data = Prefetcher(
-                self.data_iter(),
-                next(iter(jax.tree.leaves(self.batch_shardings))),
-            )
-        else:
-            data = self._device_iter(self.data_iter())
+        data = None
         kind = next(iter(self.mesh.devices.flat)).device_kind
         meter = rt_metrics.StepMeter(self.flops_per_step(), self.mesh.devices.size, kind)
         last = {}
@@ -381,9 +376,29 @@ class Trainer:
                 if ckpt.save(gstep, st):
                     last_saved = gstep
 
+        from kubeflow_tpu.runtime.profiler import TraceWindow
+
+        trace = TraceWindow(cfg.profile_dir, cfg.profile_start_step,
+                            cfg.profile_steps)
+
         ok = False
         try:
+            # Data construction inside the try: its failure modes (no
+            # shards match the glob, native loader required but missing)
+            # must still close the checkpointer on unwind.
+            if cfg.data_path:
+                # Real data: background host->device prefetch overlaps the
+                # upload of batch N+1 with compute of batch N.
+                from kubeflow_tpu.runtime.data import Prefetcher
+
+                data = Prefetcher(
+                    self.data_iter(),
+                    next(iter(jax.tree.leaves(self.batch_shardings))),
+                )
+            else:
+                data = self._device_iter(self.data_iter())
             for i in range(steps - start_step):
+                trace.step(start_step + i)
                 batch = next(data)
                 if i == 0:
                     # Step 0 pays XLA compile; keep it out of the meter window
@@ -422,6 +437,7 @@ class Trainer:
                     callback(i, m)
             ok = True
         finally:
+            trace.stop()
             if hasattr(data, "close"):
                 data.close()  # stop the prefetch thread
             if ckpt:

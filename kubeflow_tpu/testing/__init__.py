@@ -1,0 +1,18 @@
+"""CI/E2E harness: the `testing/` tier of the platform (SURVEY.md §4).
+
+The reference drives E2E through Prow -> Argo workflow DAGs
+(testing/workflows/components/kfctl_go_test.jsonnet) whose steps run
+pytest suites emitting junit XML for Gubernator/testgrid. This package
+is the same capability in-tree: a workflow DAG runner (workflow.py),
+junit emission (junit.py), and readiness/condition waiters (waiters.py)
+— usable both hermetically against the fake cluster and against a real
+one.
+"""
+
+from kubeflow_tpu.testing.junit import TestCase, TestSuite  # noqa: F401
+from kubeflow_tpu.testing.waiters import (  # noqa: F401
+    wait_for,
+    wait_for_condition,
+    wait_for_deployments_ready,
+)
+from kubeflow_tpu.testing.workflow import Step, Workflow  # noqa: F401

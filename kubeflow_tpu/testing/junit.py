@@ -1,0 +1,96 @@
+"""junit XML artifacts — the Gubernator/testgrid contract.
+
+Every reference E2E emits junit XML (test_tf_serving.py:139-143; katib
+via kubeflow.testing's test_helper). Same schema here: a <testsuite>
+of <testcase> elements with failure text and timing, written atomically
+so a killed run never leaves a truncated artifact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+from xml.sax.saxutils import escape
+
+
+@dataclasses.dataclass
+class TestCase:
+    __test__ = False  # not a pytest class
+
+    name: str
+    class_name: str = ""
+    time_s: float = 0.0
+    failure: str | None = None
+    skipped: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.failure is None
+
+
+@dataclasses.dataclass
+class TestSuite:
+    __test__ = False  # not a pytest class
+
+    name: str
+    cases: list[TestCase] = dataclasses.field(default_factory=list)
+
+    def case(self, name: str, class_name: str = "") -> "_CaseTimer":
+        return _CaseTimer(self, name, class_name)
+
+    @property
+    def failures(self) -> int:
+        return sum(1 for c in self.cases if c.failure is not None)
+
+    def to_xml(self) -> str:
+        total_t = sum(c.time_s for c in self.cases)
+        skipped = sum(1 for c in self.cases if c.skipped is not None)
+        out = [
+            '<?xml version="1.0" encoding="utf-8"?>',
+            f'<testsuite name="{escape(self.name)}" tests="{len(self.cases)}" '
+            f'failures="{self.failures}" skipped="{skipped}" '
+            f'time="{total_t:.3f}">',
+        ]
+        for c in self.cases:
+            attrs = f'name="{escape(c.name)}" time="{c.time_s:.3f}"'
+            if c.class_name:
+                attrs += f' classname="{escape(c.class_name)}"'
+            if c.failure is None and c.skipped is None:
+                out.append(f"  <testcase {attrs}/>")
+            else:
+                out.append(f"  <testcase {attrs}>")
+                if c.failure is not None:
+                    out.append(f"    <failure>{escape(c.failure)}</failure>")
+                if c.skipped is not None:
+                    out.append(f"    <skipped>{escape(c.skipped)}</skipped>")
+                out.append("  </testcase>")
+        out.append("</testsuite>")
+        return "\n".join(out)
+
+    def write(self, path: str) -> str:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_xml())
+        os.replace(tmp, path)
+        return path
+
+
+class _CaseTimer:
+    """`with suite.case("deploy"):` — records timing and failure text."""
+
+    def __init__(self, suite: TestSuite, name: str, class_name: str):
+        self.suite = suite
+        self.tc = TestCase(name=name, class_name=class_name)
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self.tc
+
+    def __exit__(self, etype, e, tb):
+        self.tc.time_s = time.monotonic() - self._t0
+        if e is not None:
+            self.tc.failure = f"{etype.__name__}: {e}"
+        self.suite.cases.append(self.tc)
+        return False  # propagate

@@ -1,0 +1,85 @@
+"""Readiness/condition waiters.
+
+Mirrors the reference's poll-with-timeout utilities:
+- wait_for_deployment.py / kf_is_ready_test.py:76 (Deployments ready),
+- katib_studyjob_test.py:128-194 wait_for_condition (CRD status
+  conditions with timeout and per-poll logging).
+
+A `clock`/`sleep` injection point keeps hermetic tests instant.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable
+
+log = logging.getLogger("kubeflow_tpu.testing")
+
+
+class WaitTimeout(TimeoutError):
+    pass
+
+
+def wait_for(predicate: Callable[[], bool], *, timeout_s: float = 300.0,
+             poll_s: float = 2.0, desc: str = "condition",
+             clock=time.monotonic, sleep=time.sleep) -> None:
+    deadline = clock() + timeout_s
+    while True:
+        if predicate():
+            return
+        if clock() >= deadline:
+            raise WaitTimeout(f"timed out after {timeout_s}s waiting for {desc}")
+        sleep(poll_s)
+
+
+def wait_for_condition(client, api_version: str, kind: str, name: str,
+                       namespace: str | None, expected: tuple[str, ...],
+                       *, timeout_s: float = 300.0, poll_s: float = 2.0,
+                       clock=time.monotonic, sleep=time.sleep) -> dict:
+    """Wait until the object's status.conditions contains any `expected`
+    type with status True; returns the object (katib shape)."""
+    found: dict = {}
+
+    def check() -> bool:
+        nonlocal found
+        obj = client.get_or_none(api_version, kind, name, namespace)
+        if obj is None:
+            return False
+        for cond in (obj.get("status") or {}).get("conditions") or []:
+            if cond.get("type") in expected and str(cond.get("status")) == "True":
+                found = obj
+                return True
+        return False
+
+    wait_for(check, timeout_s=timeout_s, poll_s=poll_s,
+             desc=f"{kind} {name} condition in {expected}",
+             clock=clock, sleep=sleep)
+    return found
+
+
+def wait_for_deployments_ready(client, namespace: str, names: list[str] | None = None,
+                               *, timeout_s: float = 300.0, poll_s: float = 2.0,
+                               clock=time.monotonic, sleep=time.sleep) -> None:
+    """kf_is_ready_test.py:76 equivalent: every (named) Deployment in the
+    namespace has readyReplicas == spec.replicas."""
+
+    def ready() -> bool:
+        deps = client.list("apps/v1", "Deployment", namespace=namespace)
+        if names is not None:
+            have = {d["metadata"]["name"] for d in deps}
+            if not set(names) <= have:
+                return False
+            deps = [d for d in deps if d["metadata"]["name"] in names]
+        if not deps:
+            return False
+        for d in deps:
+            want = (d.get("spec") or {}).get("replicas", 1)
+            got = (d.get("status") or {}).get("readyReplicas", 0)
+            if got < want:
+                return False
+        return True
+
+    wait_for(ready, timeout_s=timeout_s, poll_s=poll_s,
+             desc=f"deployments ready in {namespace}",
+             clock=clock, sleep=sleep)

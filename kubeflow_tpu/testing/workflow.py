@@ -1,0 +1,169 @@
+"""Workflow DAG runner — the Argo-workflow equivalent.
+
+The reference expresses E2E as Argo DAGs in jsonnet
+(testing/workflows/components/kfctl_go_test.jsonnet): steps with
+dependencies, a per-step deadline (50 min, :94), an artifacts directory,
+and exit-handler steps (copy-artifacts, teardown) that run regardless of
+DAG outcome. This runner provides that shape as plain Python:
+
+    wf = Workflow("e2e", artifacts_dir=...)
+    wf.step("checkout", fn)
+    wf.step("build", fn, deps=["checkout"])
+    wf.step("deploy", fn, deps=["build"])
+    wf.exit_handler("teardown", fn)
+    result = wf.run()
+
+Independent steps run concurrently (thread pool — steps are IO/subprocess
+bound like the reference's). Each step's outcome lands in a junit
+TestSuite for the testgrid contract.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import dataclasses
+import logging
+import time
+from typing import Any, Callable
+
+from kubeflow_tpu.testing.junit import TestSuite
+
+log = logging.getLogger("kubeflow_tpu.testing")
+
+DEFAULT_STEP_DEADLINE_S = 3000.0  # kfctl_go_test.jsonnet:94
+
+
+@dataclasses.dataclass
+class Step:
+    name: str
+    fn: Callable[["Context"], Any]
+    deps: list[str] = dataclasses.field(default_factory=list)
+    deadline_s: float = DEFAULT_STEP_DEADLINE_S
+    # filled by run():
+    status: str = "Pending"   # Pending | Running | Succeeded | Failed | Skipped
+    error: str | None = None
+    output: Any = None
+    time_s: float = 0.0
+
+
+@dataclasses.dataclass
+class Context:
+    """Passed to every step fn: shared scratch + artifact sink."""
+
+    artifacts_dir: str | None = None
+    values: dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def put(self, key: str, value: Any) -> None:
+        self.values[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.values.get(key, default)
+
+
+class Workflow:
+    def __init__(self, name: str, artifacts_dir: str | None = None,
+                 max_workers: int = 8):
+        self.name = name
+        self.ctx = Context(artifacts_dir=artifacts_dir)
+        self.steps: dict[str, Step] = {}
+        self.exit_handlers: list[Step] = []
+        self.max_workers = max_workers
+
+    def step(self, name: str, fn: Callable, deps: list[str] | None = None,
+             deadline_s: float = DEFAULT_STEP_DEADLINE_S) -> Step:
+        if name in self.steps:
+            raise ValueError(f"duplicate step {name!r}")
+        for d in deps or []:
+            if d not in self.steps:
+                raise ValueError(f"step {name!r} depends on unknown {d!r}")
+        s = Step(name, fn, list(deps or []), deadline_s)
+        self.steps[name] = s
+        return s
+
+    def exit_handler(self, name: str, fn: Callable,
+                     deadline_s: float = DEFAULT_STEP_DEADLINE_S) -> Step:
+        """Always runs after the DAG, success or failure (Argo onExit:
+        copy-artifacts + teardown, kfctl_go_test.jsonnet:351)."""
+        s = Step(name, fn, [], deadline_s)
+        self.exit_handlers.append(s)
+        return s
+
+    # -- execution ----------------------------------------------------------
+
+    def _run_step(self, s: Step) -> None:
+        s.status = "Running"
+        t0 = time.monotonic()
+        try:
+            with cf.ThreadPoolExecutor(max_workers=1) as one:
+                fut = one.submit(s.fn, self.ctx)
+                s.output = fut.result(timeout=s.deadline_s)
+            s.status = "Succeeded"
+        except cf.TimeoutError:
+            s.status = "Failed"
+            s.error = f"deadline {s.deadline_s}s exceeded"
+        except Exception as e:  # recorded, not raised: DAG semantics
+            s.status = "Failed"
+            s.error = f"{type(e).__name__}: {e}"
+        finally:
+            s.time_s = time.monotonic() - t0
+            log.info("step %s: %s (%.1fs)%s", s.name, s.status, s.time_s,
+                     f" — {s.error}" if s.error else "")
+
+    def run(self) -> "WorkflowResult":
+        pending = dict(self.steps)
+        done: dict[str, Step] = {}
+        with cf.ThreadPoolExecutor(max_workers=self.max_workers) as pool:
+            futures: dict[cf.Future, Step] = {}
+            while pending or futures:
+                # schedule every step whose deps are all Succeeded
+                for name in list(pending):
+                    s = pending[name]
+                    dep_steps = [done.get(d) for d in s.deps]
+                    if any(d and d.status in ("Failed", "Skipped") for d in dep_steps):
+                        s.status = "Skipped"
+                        s.error = "upstream failed"
+                        done[name] = pending.pop(name)
+                        continue
+                    if all(d and d.status == "Succeeded" for d in dep_steps) or not s.deps:
+                        futures[pool.submit(self._run_step, s)] = s
+                        pending.pop(name)
+                if not futures:
+                    if pending:  # only skipped steps remained
+                        continue
+                    break
+                finished, _ = cf.wait(list(futures),
+                                      return_when=cf.FIRST_COMPLETED)
+                for f in finished:
+                    s = futures.pop(f)
+                    done[s.name] = s
+        for h in self.exit_handlers:
+            self._run_step(h)
+        return WorkflowResult(self)
+
+
+class WorkflowResult:
+    def __init__(self, wf: Workflow):
+        self.workflow = wf
+        self.steps = dict(wf.steps)
+        self.exit_handlers = list(wf.exit_handlers)
+
+    @property
+    def succeeded(self) -> bool:
+        return all(s.status == "Succeeded" for s in self.steps.values())
+
+    def junit(self) -> TestSuite:
+        suite = TestSuite(self.workflow.name)
+        for s in list(self.steps.values()) + self.exit_handlers:
+            fail = None
+            if s.status == "Failed":
+                fail = s.error or "failed"
+            skip = s.error if s.status == "Skipped" else None
+            from kubeflow_tpu.testing.junit import TestCase
+
+            suite.cases.append(TestCase(
+                name=s.name, class_name=self.workflow.name,
+                time_s=s.time_s, failure=fail, skipped=skip))
+        return suite
+
+    def write_junit(self, path: str) -> str:
+        return self.junit().write(path)

@@ -1,0 +1,36 @@
+"""echo-server: request-reflection demo/test service.
+
+Mirrors components/echo-server/main.py (Flask one-file app used by the
+platform's smoke tests): replies with the request's method, path, query,
+headers and body so E2E tests can assert what reached the backend
+through the gateway/auth chain.
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import HttpReq, Router
+
+
+def _echo(req: HttpReq):
+    return {
+        "method": req.method,
+        "path": req.path,
+        "query": req.query,
+        "headers": dict(req.headers),
+        "body": req.body.decode(errors="replace"),
+        "user": req.user or req.header("kubeflow-userid") or None,
+    }
+
+
+def router() -> Router:
+    r = Router("echo")
+    for method in ("GET", "POST", "PUT", "DELETE"):
+        r.route(method, "/", _echo)
+        r.route(method, "/{path}", _echo)
+    httpd.add_health_routes(r)
+    return r
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080) -> httpd.HttpService:
+    return httpd.HttpService(router(), host, port)

@@ -1,0 +1,36 @@
+"""https-redirect: HTTP->HTTPS 301 helper.
+
+Mirrors components/https-redirect/main.py: any request is answered with
+a permanent redirect to the same host+path over https (used in front of
+ingresses that only terminate TLS on one port).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import HttpReq, HttpResp, Router
+
+
+def _redirect(req: HttpReq):
+    host = req.header("host", "localhost")
+    # Strip a port: the https endpoint is the default 443.
+    host = host.rsplit(":", 1)[0] if ":" in host else host
+    qs = ""
+    if req.query:
+        pairs = [f"{k}={v}" for k, vs in req.query.items() for v in vs]
+        qs = "?" + "&".join(pairs)
+    return HttpResp(301, b"", "text/plain",
+                    {"Location": f"https://{host}{req.path}{qs}"})
+
+
+def router() -> Router:
+    r = Router("https-redirect")
+    for method in ("GET", "POST", "PUT", "DELETE"):
+        r.route(method, "/", _redirect)
+        r.route(method, "/{path}", _redirect)
+    httpd.add_health_routes(r)
+    return r
+
+
+def serve(host: str = "0.0.0.0", port: int = 8080) -> httpd.HttpService:
+    return httpd.HttpService(router(), host, port)

@@ -1,0 +1,91 @@
+"""kflogin: the login page paired with the gatekeeper authservice.
+
+Mirrors components/kflogin (React app, src/login.js + src/App.js): a
+browser form that POSTs {username, password} to the gatekeeper and, on
+success, forwards the Set-Cookie and bounces the user back to the
+original destination. Here the page is served directly (no node build
+step) and the credential POST is proxied server-side to the gatekeeper's
+/login endpoint so the cookie lands on the platform domain.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import urllib.request
+
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import HttpReq, HttpResp, Router
+
+log = logging.getLogger("kubeflow_tpu.kflogin")
+
+_PAGE = b"""<!doctype html>
+<html><head><title>kubeflow-tpu login</title></head>
+<body>
+<h2>Log in</h2>
+<form id="f">
+  <label>Username <input name="username" autocomplete="username"></label><br>
+  <label>Password <input name="password" type="password"
+         autocomplete="current-password"></label><br>
+  <button type="submit">Login</button>
+</form>
+<p id="msg"></p>
+<script>
+document.getElementById('f').addEventListener('submit', async (e) => {
+  e.preventDefault();
+  const data = Object.fromEntries(new FormData(e.target).entries());
+  const r = await fetch('apikflogin', {
+    method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify(data),
+  });
+  if (r.ok) {
+    const to = new URLSearchParams(location.search).get('rd') || '/';
+    location.assign(to);
+  } else {
+    document.getElementById('msg').textContent = 'login failed';
+  }
+});
+</script>
+</body></html>
+"""
+
+
+class KfLogin:
+    def __init__(self, gatekeeper_url: str = "http://127.0.0.1:8085",
+                 auth_server=None):
+        """auth_server: in-process gatekeeper AuthServer (tests / bundled
+        deployments); otherwise credentials are proxied to gatekeeper_url."""
+        self.gatekeeper_url = gatekeeper_url.rstrip("/")
+        self.auth_server = auth_server
+
+    def page(self, req: HttpReq):
+        return HttpResp(200, _PAGE, "text/html")
+
+    def do_login(self, req: HttpReq):
+        if self.auth_server is not None:
+            return self.auth_server.login(req)
+        r = urllib.request.Request(
+            self.gatekeeper_url + "/login",
+            data=req.body or json.dumps({}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(r, timeout=10) as resp:
+                cookie = resp.headers.get("Set-Cookie", "")
+                return HttpResp(200, resp.read(),
+                                headers={"Set-Cookie": cookie} if cookie else {})
+        except urllib.error.HTTPError as e:
+            return HttpResp(e.code, e.read())
+
+    def router(self) -> Router:
+        r = Router("kflogin")
+        r.route("GET", "/kflogin", self.page)
+        r.route("GET", "/", self.page)
+        r.route("POST", "/apikflogin", self.do_login)
+        httpd.add_health_routes(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8084) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)

@@ -1,0 +1,53 @@
+"""static-config-server: serves a platform config document.
+
+Mirrors components/static-config-server (Go): a single config payload
+(platform endpoints, links, build info) served at /config for the
+dashboard and CLIs to consume. Config comes from a JSON/YAML file or an
+inline dict; reloaded on mtime change so a ConfigMap update propagates
+without a restart.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import HttpReq, Router
+
+
+class StaticConfigServer:
+    def __init__(self, config: dict | None = None, path: str | None = None):
+        if (config is None) == (path is None):
+            raise ValueError("exactly one of config / path required")
+        self._config = config
+        self._path = path
+        self._mtime = 0.0
+        if path:
+            self._load()
+
+    def _load(self) -> None:
+        with open(self._path) as f:
+            text = f.read()
+        try:
+            self._config = json.loads(text)
+        except json.JSONDecodeError:
+            from kubeflow_tpu.utils import yaml_lite
+
+            self._config = yaml_lite.loads(text)
+        self._mtime = os.path.getmtime(self._path)
+
+    def get_config(self, req: HttpReq):
+        if self._path and os.path.getmtime(self._path) != self._mtime:
+            self._load()
+        return self._config
+
+    def router(self) -> Router:
+        r = Router("static-config")
+        r.route("GET", "/config", self.get_config)
+        r.route("GET", "/", self.get_config)
+        httpd.add_health_routes(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8080) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)

@@ -1,0 +1,59 @@
+"""Release tooling tests: image inventory, command rendering, and the
+build->push->manifest DAG run hermetically with a recording runner."""
+
+import json
+import os
+
+from kubeflow_tpu.release import IMAGES, ImageSpec, build_commands, release_workflow
+from kubeflow_tpu.release.releaser import image_ref, push_commands
+
+
+def test_image_inventory_files_exist():
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    for spec in IMAGES:
+        assert os.path.exists(os.path.join(repo, spec.context, spec.dockerfile)), spec
+
+
+def test_build_command_rendering():
+    spec = ImageSpec("jax-notebook-tpu", ".", "images/notebook/Dockerfile",
+                     (("JAX_EXTRA", "tpu"),))
+    [cmd] = build_commands(spec, "gcr.io/kf-tpu", "v1")
+    assert cmd[:4] == ["docker", "build", "-t", "gcr.io/kf-tpu/jax-notebook-tpu:v1"]
+    assert "--build-arg" in cmd and "JAX_EXTRA=tpu" in cmd
+    assert cmd[-1] == "."
+    [push] = push_commands(spec, "gcr.io/kf-tpu", "v1")
+    assert push == ["docker", "push", "gcr.io/kf-tpu/jax-notebook-tpu:v1"]
+
+
+def test_release_workflow_dag(tmp_path):
+    ran = []
+    wf = release_workflow("reg.local/kf", "v0", runner=ran.append,
+                          artifacts_dir=str(tmp_path))
+    res = wf.run()
+    assert res.succeeded, {k: s.error for k, s in res.steps.items()}
+    builds = [c for c in ran if c[1] == "build"]
+    pushes = [c for c in ran if c[1] == "push"]
+    assert len(builds) == len(IMAGES) and len(pushes) == len(IMAGES)
+    # every push happens after its build (ran list is append-ordered)
+    for spec in IMAGES:
+        ref = image_ref(spec, "reg.local/kf", "v0")
+        b = next(i for i, c in enumerate(ran) if c[1] == "build" and ref in c)
+        p = next(i for i, c in enumerate(ran) if c[1] == "push" and ref in c)
+        assert b < p
+    manifest = json.load(open(tmp_path / "release-v0.json"))
+    assert len(manifest["images"]) == len(IMAGES)
+
+
+def test_release_workflow_build_failure_skips_push(tmp_path):
+    def runner(cmd):
+        if cmd[1] == "build" and "jaxrt" in cmd[3]:
+            raise RuntimeError("build broke")
+
+    wf = release_workflow("reg.local/kf", "v0", runner=runner,
+                          artifacts_dir=str(tmp_path))
+    res = wf.run()
+    assert not res.succeeded
+    assert res.steps["build-jaxrt"].status == "Failed"
+    assert res.steps["push-jaxrt"].status == "Skipped"
+    assert res.steps["release-manifest"].status == "Skipped"
+    assert res.steps["push-platform"].status == "Succeeded"

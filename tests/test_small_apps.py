@@ -1,0 +1,138 @@
+"""Small platform services: echo, https-redirect, static-config, kflogin,
+and the shared crud_backend package (SURVEY.md §2.3)."""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.utils.httpd import HttpReq
+from kubeflow_tpu.webapps import crud_backend as cb
+from kubeflow_tpu.webapps import echo, https_redirect, kflogin, static_config
+
+USER = "alice@example.com"
+
+
+def mkreq(method, path, user=USER, body=None, query=None, headers=None):
+    h = dict(headers or {})
+    if user:
+        h["kubeflow-userid"] = user
+    b = json.dumps(body).encode() if body is not None else b""
+    return HttpReq(method=method, path=path, params={}, query=query or {},
+                   headers=h, body=b)
+
+
+def J(resp):
+    assert resp.status < 300, resp.body
+    return json.loads(resp.body)
+
+
+def test_echo_reflects_request():
+    r = echo.router()
+    out = J(r.dispatch(mkreq("POST", "/anything", body={"x": 1},
+                             query={"q": ["v"]})))
+    assert out["method"] == "POST"
+    assert out["path"] == "/anything"
+    assert out["query"] == {"q": ["v"]}
+    assert json.loads(out["body"]) == {"x": 1}
+    assert out["user"] == USER
+
+
+def test_https_redirect_preserves_host_and_path():
+    r = https_redirect.router()
+    resp = r.dispatch(mkreq("GET", "/a", headers={"host": "kf.example.com:80"},
+                            query={"x": ["1"]}))
+    assert resp.status == 301
+    assert resp.headers["Location"] == "https://kf.example.com/a?x=1"
+
+
+def test_static_config_inline_and_file(tmp_path):
+    s = static_config.StaticConfigServer(config={"platform": "tpu"})
+    assert J(s.router().dispatch(mkreq("GET", "/config"))) == {"platform": "tpu"}
+
+    p = tmp_path / "cfg.json"
+    p.write_text('{"a": 1}')
+    s2 = static_config.StaticConfigServer(path=str(p))
+    assert J(s2.router().dispatch(mkreq("GET", "/config"))) == {"a": 1}
+    with pytest.raises(ValueError):
+        static_config.StaticConfigServer()
+
+
+def test_kflogin_page_and_inprocess_login():
+    from kubeflow_tpu.control.gatekeeper.auth import AuthServer, pwhash
+
+    auth = AuthServer(username="admin", passhash=pwhash("pw", "s"), salt="s")
+    app = kflogin.KfLogin(auth_server=auth)
+    r = app.router()
+    page = r.dispatch(mkreq("GET", "/kflogin", user=None))
+    assert page.status == 200 and b"<form" in page.body
+
+    ok = r.dispatch(mkreq("POST", "/apikflogin", user=None,
+                          body={"username": "admin", "password": "pw"}))
+    assert ok.status == 200 and "kubeflow-auth=" in ok.headers["Set-Cookie"]
+    bad = r.dispatch(mkreq("POST", "/apikflogin", user=None,
+                           body={"username": "admin", "password": "nope"}))
+    assert bad.status == 401
+
+
+class TestCrudBackend:
+    @pytest.fixture()
+    def cluster(self):
+        from kubeflow_tpu.control.profile import types as PT
+
+        c = FakeCluster()
+        c.create(ob.new_object("v1", "Namespace", "team-a"))
+        c.create(ob.new_object("kubeflow.org/v1", "Profile", "team-a",
+                               spec={"owner": USER}))
+        c.create(ob.new_object(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            "user-bob-clusterrole-view", namespace="team-a",
+            annotations={PT.ANNO_USER: "bob", PT.ANNO_ROLE: "view"}))
+        c.create(ob.new_object("v1", "PersistentVolumeClaim", "data",
+                               namespace="team-a"))
+        return c
+
+    @pytest.fixture()
+    def router(self, cluster):
+        backend = cb.CrudBackend(cluster, cb.Authorizer(cluster))
+        return backend.router()
+
+    def test_owner_lists_and_creates(self, router):
+        out = J(router.dispatch(mkreq("GET", "/api/namespaces/team-a/pvcs")))
+        assert out["success"] and len(out["pvcs"]) == 1
+        out = J(router.dispatch(mkreq(
+            "POST", "/api/namespaces/team-a/pvcs",
+            body={"metadata": {"name": "new"},
+                  "spec": {"resources": {"requests": {"storage": "1Gi"}}}})))
+        assert out["pvc"]["metadata"]["name"] == "new"
+
+    def test_viewer_reads_but_cannot_write(self, router):
+        out = J(router.dispatch(mkreq("GET", "/api/namespaces/team-a/pvcs",
+                                      user="bob")))
+        assert out["success"]
+        resp = router.dispatch(mkreq(
+            "DELETE", "/api/namespaces/team-a/pvcs/data", user="bob"))
+        assert resp.status == 403
+
+    def test_stranger_denied_and_anonymous_401(self, router):
+        assert router.dispatch(
+            mkreq("GET", "/api/namespaces/team-a/pvcs", user="eve")).status == 403
+        assert router.dispatch(
+            mkreq("GET", "/api/namespaces/team-a/pvcs", user=None)).status == 401
+
+    def test_secret_names_only(self, cluster, router):
+        secret = ob.new_object("v1", "Secret", "tok", namespace="team-a")
+        secret["data"] = {"k": "dmFsdWU="}
+        cluster.create(secret)
+        out = J(router.dispatch(mkreq("GET", "/api/namespaces/team-a/secrets")))
+        assert out["secrets"] == ["tok"]
+        assert "dmFsdWU" not in json.dumps(out)
+
+    def test_delete_pvc(self, router):
+        out = J(router.dispatch(mkreq(
+            "DELETE", "/api/namespaces/team-a/pvcs/data")))
+        assert out["success"]
+        resp = router.dispatch(mkreq(
+            "DELETE", "/api/namespaces/team-a/pvcs/data"))
+        assert resp.status == 404
