@@ -78,12 +78,25 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """[B, H, W, C] -> [B, H/b, W/b, b*b*C] pixel-shuffle."""
+    b, h, w, c = x.shape
+    x = x.reshape(b, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        b, h // block, w // block, block * block * c)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     block_cls: ModuleDef
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
+    # "conv7" (canonical 7x7/s2) or "space_to_depth": the MLPerf TPU stem —
+    # a 3-channel 7x7 conv uses 3/128 of the MXU's input width; reshaping
+    # the image to [H/2, W/2, 12] and convolving 4x4/s1 (same receptive
+    # field and output shape) quadruples the contraction width.
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -99,7 +112,14 @@ class ResNet(nn.Module):
         act = nn.relu
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1), name="conv_init")(x)
+        elif self.stem == "conv7":
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}: "
+                             "expected 'conv7' or 'space_to_depth'")
         x = norm(name="bn_init")(x)
         x = act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

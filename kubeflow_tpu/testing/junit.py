@@ -11,7 +11,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import time
-from xml.sax.saxutils import escape
+from xml.sax.saxutils import escape, quoteattr
 
 
 @dataclasses.dataclass
@@ -48,14 +48,14 @@ class TestSuite:
         skipped = sum(1 for c in self.cases if c.skipped is not None)
         out = [
             '<?xml version="1.0" encoding="utf-8"?>',
-            f'<testsuite name="{escape(self.name)}" tests="{len(self.cases)}" '
+            f'<testsuite name={quoteattr(self.name)} tests="{len(self.cases)}" '
             f'failures="{self.failures}" skipped="{skipped}" '
             f'time="{total_t:.3f}">',
         ]
         for c in self.cases:
-            attrs = f'name="{escape(c.name)}" time="{c.time_s:.3f}"'
+            attrs = f'name={quoteattr(c.name)} time="{c.time_s:.3f}"'
             if c.class_name:
-                attrs += f' classname="{escape(c.class_name)}"'
+                attrs += f" classname={quoteattr(c.class_name)}"
             if c.failure is None and c.skipped is None:
                 out.append(f"  <testcase {attrs}/>")
             else:

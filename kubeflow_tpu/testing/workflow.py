@@ -91,23 +91,41 @@ class Workflow:
     # -- execution ----------------------------------------------------------
 
     def _run_step(self, s: Step) -> None:
+        import threading
+
         s.status = "Running"
         t0 = time.monotonic()
-        try:
-            with cf.ThreadPoolExecutor(max_workers=1) as one:
-                fut = one.submit(s.fn, self.ctx)
-                s.output = fut.result(timeout=s.deadline_s)
-            s.status = "Succeeded"
-        except cf.TimeoutError:
+        box: dict = {}
+
+        def target():
+            try:
+                box["output"] = s.fn(self.ctx)
+            except Exception as e:
+                box["error"] = e
+
+        # Daemon thread + join(timeout), NOT an executor: executor shutdown
+        # waits for the fn, so a hung step would hang the whole DAG past
+        # its deadline. A step that outlives its deadline is marked Failed
+        # and abandoned (Python can't kill a thread; the daemon flag keeps
+        # it from blocking process exit — Argo's activeDeadlineSeconds pod
+        # kill is the real-cluster analogue).
+        t = threading.Thread(target=target, daemon=True,
+                             name=f"wf-step-{s.name}")
+        t.start()
+        t.join(timeout=s.deadline_s)
+        if t.is_alive():
             s.status = "Failed"
             s.error = f"deadline {s.deadline_s}s exceeded"
-        except Exception as e:  # recorded, not raised: DAG semantics
+        elif "error" in box:
             s.status = "Failed"
+            e = box["error"]
             s.error = f"{type(e).__name__}: {e}"
-        finally:
-            s.time_s = time.monotonic() - t0
-            log.info("step %s: %s (%.1fs)%s", s.name, s.status, s.time_s,
-                     f" — {s.error}" if s.error else "")
+        else:
+            s.output = box.get("output")
+            s.status = "Succeeded"
+        s.time_s = time.monotonic() - t0
+        log.info("step %s: %s (%.1fs)%s", s.name, s.status, s.time_s,
+                 f" — {s.error}" if s.error else "")
 
     def run(self) -> "WorkflowResult":
         pending = dict(self.steps)

@@ -42,6 +42,10 @@ def main(argv: list[str] | None = None) -> int:
         sp.add_argument("--server", default="", help="apiserver URL (default: in-cluster)")
         sp.add_argument("--dry-run", action="store_true",
                         help="apply against an in-memory cluster and print")
+        sp.add_argument("--state-repo", default="",
+                        help="git remote to persist/read deployment state "
+                             "(ksServer SaveAppToRepo analogue)")
+        sp.add_argument("--state-branch", default="main")
 
     sps = sub.add_parser("server", help="REST deployment plane")
     sps.add_argument("--port", type=int, default=8080)
@@ -93,10 +97,29 @@ def main(argv: list[str] | None = None) -> int:
         conds = {c["type"]: c["status"]
                  for c in (obj.get("status") or {}).get("conditions", [])}
         print(f"applied {cfg.name}: {conds}")
+        if args.state_repo and args.dry_run:
+            print("dry-run: not pushing state to "
+                  f"{args.state_repo}", file=sys.stderr)
+        elif args.state_repo:
+            from kubeflow_tpu.tpctl import manifests
+            from kubeflow_tpu.tpctl.staterepo import StateRepo
+
+            with StateRepo(args.state_repo, branch=args.state_branch) as repo:
+                sha = repo.save_deployment(
+                    cfg.name, cfg.dump(),
+                    manifests_yaml=yaml.safe_dump_all(manifests.render(cfg),
+                                                      sort_keys=False))
+            print(f"state pushed to {args.state_repo} @ {sha[:12]}")
         return 0
     if args.cmd == "delete":
         coord.delete(cfg)
         print(f"deleted {cfg.name}")
+        if args.state_repo and not args.dry_run:
+            from kubeflow_tpu.tpctl.staterepo import StateRepo
+
+            with StateRepo(args.state_repo, branch=args.state_branch) as repo:
+                if repo.delete_deployment(cfg.name):
+                    print(f"state removed from {args.state_repo}")
         return 0
     return 2
 

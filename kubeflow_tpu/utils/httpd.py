@@ -60,7 +60,10 @@ Handler = Callable[[HttpReq], Any]
 
 
 def _compile(pattern: str) -> re.Pattern:
-    rx = re.sub(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}", r"(?P<\1>[^/]+)", pattern)
+    # {name} captures one path segment; {name*} captures the rest of the
+    # path including slashes (catch-all routes: redirect/echo services).
+    rx = re.sub(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\*\}", r"(?P<\1>.+)", pattern)
+    rx = re.sub(r"\{([a-zA-Z_][a-zA-Z0-9_]*)\}", r"(?P<\1>[^/]+)", rx)
     return re.compile("^" + rx + "$")
 
 

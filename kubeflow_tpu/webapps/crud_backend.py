@@ -46,7 +46,7 @@ class Authorizer:
     admin, profile ownership, or contributor RoleBindings (user/role
     annotations, kfam/bindings.go:168 List semantics)."""
 
-    WRITE_VERBS = ("create", "update", "patch", "delete")
+    READ_VERBS = ("get", "list", "watch")
 
     def __init__(self, client, cluster_admin: str | None = None):
         self.client = client
@@ -72,7 +72,7 @@ class Authorizer:
         roles = self._roles(user, namespace)
         if "admin" in roles or "edit" in roles:
             return
-        if verb in ("get", "list", "watch") and "view" in roles:
+        if verb in self.READ_VERBS and "view" in roles:
             return
         raise ApiHttpError(403, f"{user} cannot {verb} in {namespace}")
 
@@ -93,6 +93,9 @@ class CrudBackend:
     # -- api/ wrappers ------------------------------------------------------
 
     def list_namespaces(self, req: HttpReq):
+        # Cluster-scoped, so no per-namespace authz — but still
+        # authenticated: anonymous callers must not enumerate tenants.
+        authn_user(req, required=self.authz is not None)
         items = self.client.list("v1", "Namespace")
         return success(namespaces=[o["metadata"]["name"] for o in items])
 
@@ -134,6 +137,7 @@ class CrudBackend:
         return success(events=items)
 
     def list_storageclasses(self, req: HttpReq):
+        authn_user(req, required=self.authz is not None)
         items = self.client.list("storage.k8s.io/v1", "StorageClass")
         return success(storageClasses=[o["metadata"]["name"] for o in items])
 

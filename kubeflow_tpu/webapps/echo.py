@@ -25,10 +25,10 @@ def _echo(req: HttpReq):
 
 def router() -> Router:
     r = Router("echo")
+    httpd.add_health_routes(r)  # before the catch-all: first match wins
     for method in ("GET", "POST", "PUT", "DELETE"):
         r.route(method, "/", _echo)
-        r.route(method, "/{path}", _echo)
-    httpd.add_health_routes(r)
+        r.route(method, "/{path*}", _echo)
     return r
 
 

@@ -12,23 +12,27 @@ from kubeflow_tpu.utils.httpd import HttpReq, HttpResp, Router
 
 
 def _redirect(req: HttpReq):
+    from urllib.parse import urlencode
+
     host = req.header("host", "localhost")
     # Strip a port: the https endpoint is the default 443.
     host = host.rsplit(":", 1)[0] if ":" in host else host
     qs = ""
     if req.query:
-        pairs = [f"{k}={v}" for k, vs in req.query.items() for v in vs]
-        qs = "?" + "&".join(pairs)
+        # re-encode: parsed values are decoded, and raw interpolation
+        # would corrupt values containing '&'/'='/'%'.
+        pairs = [(k, v) for k, vs in req.query.items() for v in vs]
+        qs = "?" + urlencode(pairs)
     return HttpResp(301, b"", "text/plain",
                     {"Location": f"https://{host}{req.path}{qs}"})
 
 
 def router() -> Router:
     r = Router("https-redirect")
+    httpd.add_health_routes(r)  # before the catch-all: first match wins
     for method in ("GET", "POST", "PUT", "DELETE"):
         r.route(method, "/", _redirect)
-        r.route(method, "/{path}", _redirect)
-    httpd.add_health_routes(r)
+        r.route(method, "/{path*}", _redirect)
     return r
 
 

@@ -208,3 +208,32 @@ class TestHermeticE2E:
                                for k, s in res.steps.items()}
         p = res.write_junit(os.path.join(str(tmp_path), "junit_01.xml"))
         assert os.path.exists(p)
+
+
+def test_junit_quotes_in_names(tmp_path):
+    s = TestSuite('suite "q"')
+    with s.case('deploy "prod"'):
+        pass
+    root = ET.parse(s.write(str(tmp_path / "q.xml"))).getroot()
+    assert root.get("name") == 'suite "q"'
+    assert root.find("testcase").get("name") == 'deploy "prod"'
+
+
+def test_workflow_hung_step_does_not_hang_dag():
+    import threading
+    import time
+
+    release = threading.Event()
+
+    def hung(ctx):
+        release.wait(30)  # simulates a truly stuck subprocess
+
+    wf = Workflow("hung")
+    wf.step("stuck", hung, deadline_s=0.3)
+    t0 = time.monotonic()
+    res = wf.run()
+    elapsed = time.monotonic() - t0
+    release.set()
+    assert res.steps["stuck"].status == "Failed"
+    assert "deadline" in res.steps["stuck"].error
+    assert elapsed < 5, f"run() blocked {elapsed:.1f}s past the deadline"

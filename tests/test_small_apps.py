@@ -136,3 +136,35 @@ class TestCrudBackend:
         resp = router.dispatch(mkreq(
             "DELETE", "/api/namespaces/team-a/pvcs/data"))
         assert resp.status == 404
+
+
+def test_echo_and_redirect_multi_segment_paths():
+    r = echo.router()
+    out = J(r.dispatch(mkreq("GET", "/notebook/team-a/my-nb/")))
+    assert out["path"] == "/notebook/team-a/my-nb/"
+    # health endpoints are not swallowed by the catch-all
+    assert J(r.dispatch(mkreq("GET", "/healthz"))) == {"status": "ok"}
+
+    rr = https_redirect.router()
+    resp = rr.dispatch(mkreq("GET", "/notebook/team-a/my-nb/",
+                             headers={"host": "kf.example.com"}))
+    assert resp.status == 301
+    assert resp.headers["Location"].endswith("/notebook/team-a/my-nb/")
+
+
+def test_redirect_reencodes_query_values():
+    r = https_redirect.router()
+    resp = r.dispatch(mkreq("GET", "/a", headers={"host": "kf.corp"},
+                            query={"next": ["/x?y=1&z=2"]}))
+    assert resp.status == 301
+    assert resp.headers["Location"] == \
+        "https://kf.corp/a?next=%2Fx%3Fy%3D1%26z%3D2"
+
+
+def test_crud_cluster_scoped_routes_require_identity(cluster=None):
+    c = FakeCluster()
+    backend = cb.CrudBackend(c, cb.Authorizer(c))
+    r = backend.router()
+    assert r.dispatch(mkreq("GET", "/api/namespaces", user=None)).status == 401
+    assert r.dispatch(mkreq("GET", "/api/storageclasses", user=None)).status == 401
+    assert J(r.dispatch(mkreq("GET", "/api/namespaces")))["success"]

@@ -1,0 +1,63 @@
+"""StateRepo: deployment-state git persistence with rebase-retry push
+(reference: sourceRepos_test.go / ksServer SaveAppToRepo semantics),
+exercised against a local bare repo."""
+
+import subprocess
+
+import pytest
+
+from kubeflow_tpu.tpctl.staterepo import GitError, StateRepo
+
+
+@pytest.fixture()
+def bare_remote(tmp_path):
+    remote = tmp_path / "state.git"
+    subprocess.run(["git", "init", "--bare", "-b", "main", str(remote)],
+                   check=True, capture_output=True)
+    return str(remote)
+
+
+def test_save_load_roundtrip(bare_remote):
+    with StateRepo(bare_remote) as repo:
+        sha = repo.save_deployment("kf-prod", "name: kf-prod\n",
+                                   manifests_yaml="kind: Namespace\n")
+        assert len(sha) == 40
+    # fresh clone (new object) sees the pushed state
+    with StateRepo(bare_remote) as repo2:
+        assert repo2.load_deployment("kf-prod") == "name: kf-prod\n"
+        assert repo2.list_deployments() == ["kf-prod"]
+
+
+def test_unchanged_save_is_noop(bare_remote):
+    with StateRepo(bare_remote) as repo:
+        sha1 = repo.save_deployment("a", "x: 1\n")
+        sha2 = repo.save_deployment("a", "x: 1\n")
+        assert sha1 == sha2
+
+
+def test_concurrent_writer_rebase(bare_remote):
+    # Writer B pushes between A's clone and A's push; A must rebase+retry.
+    a = StateRepo(bare_remote)
+    a.clone()
+    with StateRepo(bare_remote) as b:
+        b.save_deployment("from-b", "b: 1\n")
+    sha = a.save_deployment("from-a", "a: 1\n", sleep=lambda *_: None)
+    assert sha
+    a.close()
+    with StateRepo(bare_remote) as c:
+        assert c.list_deployments() == ["from-a", "from-b"]
+
+
+def test_missing_deployment_raises(bare_remote):
+    with StateRepo(bare_remote) as repo:
+        with pytest.raises(FileNotFoundError):
+            repo.load_deployment("nope")
+
+
+def test_delete_deployment(bare_remote):
+    with StateRepo(bare_remote) as repo:
+        repo.save_deployment("gone", "x: 1\n")
+        assert repo.delete_deployment("gone") is True
+        assert repo.delete_deployment("gone") is False
+    with StateRepo(bare_remote) as repo2:
+        assert repo2.list_deployments() == []

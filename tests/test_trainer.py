@@ -71,3 +71,27 @@ def test_eval_step(devices8):
 def test_config_rejects_unknown_keys():
     with pytest.raises(ValueError):
         TrainConfig.from_dict({"modell": "resnet50"})
+
+
+def test_resnet_space_to_depth_stem_trains(devices8):
+    # The MLPerf TPU stem variant must train the same as conv7.
+    trainer = Trainer(tiny_resnet_cfg(
+        model_kwargs={"stem": "space_to_depth"}, total_steps=3))
+    state, summary = trainer.fit(steps=3)
+    assert jnp.isfinite(summary["final"]["loss"])
+    assert int(state.step) == 3
+
+
+def test_space_to_depth_shape():
+    import numpy as np
+
+    from kubeflow_tpu.models.resnet import space_to_depth
+
+    x = jnp.arange(2 * 4 * 4 * 3).reshape(2, 4, 4, 3).astype(jnp.float32)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 2, 2, 12)
+    # block (0,0) of image 0 = pixels (0,0),(0,1),(1,0),(1,1) channels-first
+    np.testing.assert_array_equal(
+        np.asarray(y[0, 0, 0]),
+        np.concatenate([np.asarray(x[0, 0, 0]), np.asarray(x[0, 0, 1]),
+                        np.asarray(x[0, 1, 0]), np.asarray(x[0, 1, 1])]))
