@@ -65,7 +65,7 @@ class KfamService:
         prof = self.client.get_or_none(PT.API_VERSION, PT.KIND, namespace)
         if prof is None:
             return None
-        return ((prof.get("spec") or {}).get("owner") or {}).get("name")
+        return PT.owner_name(prof)
 
     def is_owner_or_admin(self, user: str, namespace: str) -> bool:
         if self.is_cluster_admin(user):

@@ -83,7 +83,7 @@ class ProfileReconciler(Reconciler):
 
     def generate_namespace(self, profile: dict) -> dict:
         name = ob.meta(profile)["name"]
-        owner = ((profile.get("spec") or {}).get("owner") or {}).get("name", "")
+        owner = T.owner_name(profile) or ""
         return ob.new_object(
             "v1", "Namespace", name,
             labels={
@@ -120,7 +120,7 @@ class ProfileReconciler(Reconciler):
     def generate_owner_rolebinding(self, profile: dict) -> dict:
         """namespaceAdmin (:218-239): owner -> kubeflow-admin."""
         ns = ob.meta(profile)["name"]
-        owner = ((profile.get("spec") or {}).get("owner") or {}).get("name", "")
+        owner = T.owner_name(profile) or ""
         rb = ob.new_object(
             "rbac.authorization.k8s.io/v1", "RoleBinding", "namespaceAdmin", ns,
             annotations={T.ANNO_USER: owner, T.ANNO_ROLE: "admin"},
@@ -142,7 +142,7 @@ class ProfileReconciler(Reconciler):
         """The istio-rbac ServiceRole/Binding capability (:190) expressed
         as one AuthorizationPolicy: allow the owner + in-ns principals."""
         ns = ob.meta(profile)["name"]
-        owner = ((profile.get("spec") or {}).get("owner") or {}).get("name", "")
+        owner = T.owner_name(profile) or ""
         pol = ob.new_object(
             "security.istio.io/v1beta1", "AuthorizationPolicy", "ns-owner-access", ns,
             annotations={T.ANNO_USER: owner, T.ANNO_ROLE: "admin"},
@@ -174,7 +174,7 @@ class ProfileReconciler(Reconciler):
         # namespace, with ownership conflict rejection (:168-186)
         ns_name = m["name"]
         existing = client.get_or_none("v1", "Namespace", ns_name)
-        owner = ((profile.get("spec") or {}).get("owner") or {}).get("name", "")
+        owner = T.owner_name(profile) or ""
         if existing is not None:
             anno_owner = ob.annotations_of(existing).get("owner")
             owned_by_us = any(

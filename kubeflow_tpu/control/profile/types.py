@@ -23,6 +23,16 @@ ANNO_USER = "user"
 ANNO_ROLE = "role"
 
 
+def owner_name(profile: dict) -> str | None:
+    """The owning user of a Profile. Canonical spec.owner is a Subject
+    dict ({kind, name}, profile_types.go:38); a bare string is accepted
+    for convenience."""
+    owner = (profile.get("spec") or {}).get("owner")
+    if isinstance(owner, dict):
+        return owner.get("name")
+    return owner
+
+
 def new_profile(
     name: str,
     owner: str,

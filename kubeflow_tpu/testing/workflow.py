@@ -100,8 +100,8 @@ class Workflow:
         def target():
             try:
                 box["output"] = s.fn(self.ctx)
-            except Exception as e:
-                box["error"] = e
+            except BaseException as e:  # incl. SystemExit from CLI wrappers:
+                box["error"] = e        # anything non-returning is a failure
 
         # Daemon thread + join(timeout), NOT an executor: executor shutdown
         # waits for the fn, so a hung step would hang the whole DAG past

@@ -46,6 +46,10 @@ def main(argv: list[str] | None = None) -> int:
                         help="git remote to persist/read deployment state "
                              "(ksServer SaveAppToRepo analogue)")
         sp.add_argument("--state-branch", default="main")
+        sp.add_argument("--url", default="",
+                        help="tpctl server URL: go through the REST plane "
+                             "(kfctlClient flow) instead of applying "
+                             "in-process")
 
     sps = sub.add_parser("server", help="REST deployment plane")
     sps.add_argument("--port", type=int, default=8080)
@@ -89,6 +93,36 @@ def main(argv: list[str] | None = None) -> int:
         from kubeflow_tpu.tpctl import manifests
 
         print(yaml.safe_dump_all(manifests.render(cfg), sort_keys=False), end="")
+        return 0
+
+    if getattr(args, "url", ""):
+        # REST-plane mode supports apply only (the server exposes
+        # create/get); anything else must not silently fall through to
+        # the in-process path against a possibly different cluster.
+        if args.cmd != "apply":
+            p.error("--url is only supported with 'apply'")
+        if args.dry_run:
+            p.error("--url and --dry-run are mutually exclusive (the "
+                    "server would perform a real deployment)")
+        from kubeflow_tpu.tpctl.client import TpctlClient
+
+        client = TpctlClient(args.url)
+        if not client.check_access():
+            print(f"cannot reach tpctl server at {args.url}", file=sys.stderr)
+            return 1
+        status = client.apply_and_wait(cfg)
+        print(f"applied {cfg.name} via {args.url}: "
+              f"{ {c['type']: c['status'] for c in status['conditions']} }")
+        if args.state_repo:
+            from kubeflow_tpu.tpctl import manifests
+            from kubeflow_tpu.tpctl.staterepo import StateRepo
+
+            with StateRepo(args.state_repo, branch=args.state_branch) as repo:
+                sha = repo.save_deployment(
+                    cfg.name, cfg.dump(),
+                    manifests_yaml=yaml.safe_dump_all(manifests.render(cfg),
+                                                      sort_keys=False))
+            print(f"state pushed to {args.state_repo} @ {sha[:12]}")
         return 0
 
     coord = Coordinator(_client(args))

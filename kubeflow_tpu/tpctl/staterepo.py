@@ -55,8 +55,13 @@ class StateRepo:
         self.remote = remote
         self.branch = branch
         self.author = author
-        name, _, email = author.partition(" <")
-        self._ident = (name, email.rstrip(">"))
+        name, sep, email = author.partition(" <")
+        email = email.rstrip(">")
+        if not sep or not name or not email:
+            raise ValueError(
+                f"author must be 'Name <email>' form, got {author!r} "
+                "(git rejects empty idents at commit time)")
+        self._ident = (name, email)
         self._dir: str | None = None
 
     # -- lifecycle ----------------------------------------------------------

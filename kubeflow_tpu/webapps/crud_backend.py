@@ -57,7 +57,7 @@ class Authorizer:
 
         roles: set[str] = set()
         prof = self.client.get_or_none("kubeflow.org/v1", "Profile", namespace)
-        if prof and (prof.get("spec") or {}).get("owner") == user:
+        if prof and PT.owner_name(prof) == user:
             roles.add("admin")
         for rb in self.client.list("rbac.authorization.k8s.io/v1",
                                    "RoleBinding", namespace=namespace):

@@ -102,7 +102,7 @@ class Dashboard:
 
     def _owned_profiles(self, user: str) -> list[dict]:
         return [p for p in self.client.list(PT.API_VERSION, PT.KIND)
-                if ((p.get("spec") or {}).get("owner") or {}).get("name") == user]
+                if PT.owner_name(p) == user]
 
     def _member_namespaces(self, user: str) -> list[dict]:
         """Owned + contributed (kfam binding) namespaces with roles."""
@@ -197,6 +197,10 @@ class Dashboard:
         r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
+        # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)
+        from kubeflow_tpu.webapps.dashboard_ui import add_ui_routes
+
+        add_ui_routes(r)
         httpd.add_health_routes(r)
         httpd.add_metrics_route(r)
         return r

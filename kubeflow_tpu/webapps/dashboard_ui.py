@@ -1,0 +1,168 @@
+"""Central-dashboard frontend: the browser UI over the dashboard API.
+
+The reference ships a Polymer 3 SPA (centraldashboard/public/components/
+dashboard-view.js, namespace-selector.js, notebooks-card.js,
+resource-chart.js, manage-users-view.js, registration-page.js) behind an
+Express server. Here the same views are one dependency-free page served
+by the dashboard backend itself: namespace selector, registration flow
+(workgroup exists/create), activity feed, contributor management and a
+resource chart, all driven by the `/api/workgroup/*`, `/api/activities`
+and `/api/metrics` endpoints (webapps/dashboard.py).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.utils.httpd import HttpReq, HttpResp
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>kubeflow-tpu</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f5f6f8; }
+  header { background: #1a73e8; color: #fff; padding: 10px 20px;
+           display: flex; align-items: center; gap: 16px; }
+  header h1 { font-size: 18px; margin: 0; flex: 1; }
+  select, button, input { font-size: 14px; padding: 6px 10px;
+                          border-radius: 4px; border: 1px solid #ccc; }
+  button { background: #fff; cursor: pointer; }
+  main { display: grid; grid-template-columns: 1fr 1fr; gap: 16px;
+         padding: 20px; max-width: 1100px; margin: auto; }
+  .card { background: #fff; border-radius: 8px; padding: 16px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.15); }
+  .card h2 { margin: 0 0 10px; font-size: 15px; color: #333; }
+  ul { margin: 0; padding-left: 18px; }
+  li { margin: 3px 0; font-size: 13px; }
+  #register { grid-column: 1 / -1; display: none; }
+  .muted { color: #777; font-size: 12px; }
+  svg { width: 100%; height: 120px; }
+</style>
+</head>
+<body>
+<header>
+  <h1>kubeflow-tpu</h1>
+  <span class="muted" id="user"></span>
+  <select id="ns" title="namespace"></select>
+</header>
+<main>
+  <div class="card" id="register">
+    <h2>Welcome — create your workspace</h2>
+    <p class="muted">No namespace is registered for your account yet.</p>
+    <input id="reg-ns" placeholder="namespace name">
+    <button id="reg-btn">Create namespace</button>
+    <p id="reg-msg" class="muted"></p>
+  </div>
+  <div class="card">
+    <h2>Activity</h2>
+    <ul id="activities"><li class="muted">select a namespace</li></ul>
+  </div>
+  <div class="card">
+    <h2>Contributors</h2>
+    <ul id="contributors"></ul>
+    <p class="muted">Managed via the access-management (KFAM) API.</p>
+  </div>
+  <div class="card">
+    <h2>Cluster TPU utilization</h2>
+    <svg id="chart" viewBox="0 0 300 100" preserveAspectRatio="none"></svg>
+    <p class="muted" id="chart-note"></p>
+  </div>
+  <div class="card">
+    <h2>Platform</h2>
+    <ul id="envinfo"></ul>
+  </div>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const api = (p) => fetch(p).then(r => { if (!r.ok) throw r; return r.json(); });
+
+async function loadEnv() {
+  const info = await api('/api/workgroup/env-info');
+  $('user').textContent = info.user || '';
+  const ul = $('envinfo');
+  ul.innerHTML = '';
+  for (const [k, v] of Object.entries(info.platform || {})) {
+    const li = document.createElement('li');
+    li.textContent = k + ': ' + v;
+    ul.appendChild(li);
+  }
+  const sel = $('ns');
+  sel.innerHTML = '';
+  for (const ns of info.namespaces || []) {
+    const o = document.createElement('option');
+    o.value = o.textContent = typeof ns === 'string' ? ns : ns.namespace;
+    sel.appendChild(o);
+  }
+  if (!(info.namespaces || []).length) {
+    $('register').style.display = 'block';
+  } else {
+    await loadNamespace(sel.value);
+  }
+}
+
+async function loadNamespace(ns) {
+  const acts = await api('/api/activities/' + ns).catch(() => ({events: []}));
+  const ul = $('activities');
+  ul.innerHTML = '';
+  for (const a of (acts.events || []).slice(0, 12)) {
+    const li = document.createElement('li');
+    li.textContent = (a.lastTimestamp || '') + ' ' + (a.reason || '') + ': ' + (a.message || '');
+    ul.appendChild(li);
+  }
+  if (!ul.children.length) ul.innerHTML = '<li class="muted">no events</li>';
+  const contribs = await api('/api/workgroup/get-contributors/' + ns)
+    .catch(() => ({contributors: []}));
+  const cl = $('contributors');
+  cl.innerHTML = '';
+  for (const c of contribs.contributors || []) {
+    const li = document.createElement('li');
+    li.textContent = typeof c === 'string' ? c : (c.user + ' (' + c.role + ')');
+    cl.appendChild(li);
+  }
+  if (!cl.children.length) cl.innerHTML = '<li class="muted">owner only</li>';
+}
+
+async function loadChart() {
+  try {
+    const m = await api('/api/metrics/tpu-chips');
+    const pts = (m.values || []).map(p => (typeof p === 'object' ? (p.value ?? 0) : p));
+    if (!pts.length) { $('chart-note').textContent = 'no samples'; return; }
+    const max = Math.max(...pts, 1);
+    const step = 300 / Math.max(pts.length - 1, 1);
+    const d = pts.map((v, i) =>
+      (i ? 'L' : 'M') + (i * step).toFixed(1) + ',' +
+      (100 - v / max * 90).toFixed(1)).join(' ');
+    $('chart').innerHTML =
+      '<path d="' + d + '" fill="none" stroke="#1a73e8" stroke-width="2"/>';
+    $('chart-note').textContent = m.note || '';
+  } catch (e) { $('chart-note').textContent = 'metrics unavailable'; }
+}
+
+$('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
+$('reg-btn').addEventListener('click', async () => {
+  const ns = $('reg-ns').value.trim();
+  if (!ns) return;
+  const r = await fetch('/api/workgroup/create', {
+    method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify({namespace: ns}),
+  });
+  $('reg-msg').textContent = r.ok ? 'created — reloading…' : 'failed: ' + r.status;
+  if (r.ok) setTimeout(() => location.reload(), 800);
+});
+
+loadEnv().catch(e => { $('user').textContent = 'not signed in'; });
+loadChart();
+</script>
+</body>
+</html>
+"""
+
+
+def page(req: HttpReq) -> HttpResp:
+    return HttpResp(200, PAGE.encode(), "text/html")
+
+
+def add_ui_routes(router) -> None:
+    router.route("GET", "/", page)
+    router.route("GET", "/dashboard", page)

@@ -15,8 +15,13 @@ def _redirect(req: HttpReq):
     from urllib.parse import urlencode
 
     host = req.header("host", "localhost")
-    # Strip a port: the https endpoint is the default 443.
-    host = host.rsplit(":", 1)[0] if ":" in host else host
+    # Strip a port: the https endpoint is the default 443. Bracketed IPv6
+    # hosts contain ':' without a port — only strip after the bracket.
+    if host.startswith("["):
+        end = host.find("]")
+        host = host[:end + 1] if end != -1 else host
+    elif ":" in host:
+        host = host.rsplit(":", 1)[0]
     qs = ""
     if req.query:
         # re-encode: parsed values are decoded, and raw interpolation

@@ -202,3 +202,31 @@ class TestCli:
         assert main(["example"]) == 0
         cfg = TpuDef.from_dict(yaml.safe_load(capsys.readouterr().out))
         assert cfg.name == "kubeflow-tpu"
+
+
+class TestHttpClient:
+    """kfctlClient flow: create over HTTP, poll to Available
+    (bootstrap/cmd/kfctlClient/main.go:141, run :59)."""
+
+    def test_apply_and_wait_over_http(self, cfg):
+        import threading
+
+        from kubeflow_tpu.tpctl.client import TpctlClient
+
+        cluster = FakeCluster()
+        srv = TpctlServer(cluster)
+        svc = srv.serve(host="127.0.0.1", port=0)
+        threading.Thread(target=svc.serve_forever, daemon=True).start()
+        client = TpctlClient(f"http://127.0.0.1:{svc.port}")
+        assert client.check_access()
+        status = client.apply_and_wait(cfg, timeout_s=30, poll_s=0.05)
+        conds = {c["type"]: c["status"] for c in status["conditions"]}
+        assert conds.get(COND_AVAILABLE) == "True"
+        # the worker actually applied manifests to the backing cluster
+        assert cluster.list("apps/v1", "Deployment", namespace="kubeflow")
+
+    def test_wait_times_out_cleanly(self):
+        from kubeflow_tpu.tpctl.client import TpctlClient
+
+        client = TpctlClient("http://127.0.0.1:1")  # nothing listening
+        assert not client.check_access()

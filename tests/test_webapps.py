@@ -210,3 +210,14 @@ class TestDashboard:
     def test_unauthenticated_401(self, dash):
         _, r = dash
         assert r.dispatch(mkreq("GET", "/api/workgroup/exists", user=None)).status == 401
+
+
+def test_dashboard_serves_ui(cluster):
+    from kubeflow_tpu.webapps.dashboard import Dashboard
+
+    r = Dashboard(cluster).router()
+    page = r.dispatch(mkreq("GET", "/"))
+    assert page.status == 200 and page.content_type == "text/html"
+    assert b"kubeflow-tpu" in page.body and b"/api/workgroup/env-info" in page.body
+    # API routes still reachable alongside the UI route
+    assert r.dispatch(mkreq("GET", "/api/workgroup/env-info")).status < 500
