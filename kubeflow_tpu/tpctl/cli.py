@@ -29,6 +29,25 @@ def _client(args):
     return RestClient(base_url=args.server or None)
 
 
+def _push_state(args, cfg: TpuDef) -> None:
+    """Persist the applied TpuDef + rendered manifests to --state-repo
+    (no-op without the flag or on --dry-run)."""
+    if not args.state_repo or args.dry_run:
+        if args.state_repo:
+            print(f"dry-run: not pushing state to {args.state_repo}",
+                  file=sys.stderr)
+        return
+    from kubeflow_tpu.tpctl import manifests
+    from kubeflow_tpu.tpctl.staterepo import StateRepo
+
+    with StateRepo(args.state_repo, branch=args.state_branch) as repo:
+        sha = repo.save_deployment(
+            cfg.name, cfg.dump(),
+            manifests_yaml=yaml.safe_dump_all(manifests.render(cfg),
+                                              sort_keys=False))
+    print(f"state pushed to {args.state_repo} @ {sha[:12]}")
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser("tpctl", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -77,6 +96,15 @@ def main(argv: list[str] | None = None) -> int:
             pass
         return 0
 
+    # --url validation FIRST: every non-apply subcommand must reject it
+    # rather than silently fall through to the in-process path against a
+    # possibly different cluster.
+    if getattr(args, "url", "") and args.cmd != "apply":
+        p.error("--url is only supported with 'apply'")
+    if getattr(args, "url", "") and getattr(args, "dry_run", False):
+        p.error("--url and --dry-run are mutually exclusive (the "
+                "server would perform a real deployment)")
+
     if args.cmd == "status":
         coord = Coordinator(_client(args))
         obj = coord.status(args.name)
@@ -96,14 +124,6 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if getattr(args, "url", ""):
-        # REST-plane mode supports apply only (the server exposes
-        # create/get); anything else must not silently fall through to
-        # the in-process path against a possibly different cluster.
-        if args.cmd != "apply":
-            p.error("--url is only supported with 'apply'")
-        if args.dry_run:
-            p.error("--url and --dry-run are mutually exclusive (the "
-                    "server would perform a real deployment)")
         from kubeflow_tpu.tpctl.client import TpctlClient
 
         client = TpctlClient(args.url)
@@ -113,16 +133,7 @@ def main(argv: list[str] | None = None) -> int:
         status = client.apply_and_wait(cfg)
         print(f"applied {cfg.name} via {args.url}: "
               f"{ {c['type']: c['status'] for c in status['conditions']} }")
-        if args.state_repo:
-            from kubeflow_tpu.tpctl import manifests
-            from kubeflow_tpu.tpctl.staterepo import StateRepo
-
-            with StateRepo(args.state_repo, branch=args.state_branch) as repo:
-                sha = repo.save_deployment(
-                    cfg.name, cfg.dump(),
-                    manifests_yaml=yaml.safe_dump_all(manifests.render(cfg),
-                                                      sort_keys=False))
-            print(f"state pushed to {args.state_repo} @ {sha[:12]}")
+        _push_state(args, cfg)
         return 0
 
     coord = Coordinator(_client(args))
@@ -131,19 +142,7 @@ def main(argv: list[str] | None = None) -> int:
         conds = {c["type"]: c["status"]
                  for c in (obj.get("status") or {}).get("conditions", [])}
         print(f"applied {cfg.name}: {conds}")
-        if args.state_repo and args.dry_run:
-            print("dry-run: not pushing state to "
-                  f"{args.state_repo}", file=sys.stderr)
-        elif args.state_repo:
-            from kubeflow_tpu.tpctl import manifests
-            from kubeflow_tpu.tpctl.staterepo import StateRepo
-
-            with StateRepo(args.state_repo, branch=args.state_branch) as repo:
-                sha = repo.save_deployment(
-                    cfg.name, cfg.dump(),
-                    manifests_yaml=yaml.safe_dump_all(manifests.render(cfg),
-                                                      sort_keys=False))
-            print(f"state pushed to {args.state_repo} @ {sha[:12]}")
+        _push_state(args, cfg)
         return 0
     if args.cmd == "delete":
         coord.delete(cfg)

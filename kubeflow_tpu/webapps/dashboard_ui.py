@@ -125,7 +125,8 @@ async function loadNamespace(ns) {
 async function loadChart() {
   try {
     const m = await api('/api/metrics/tpu-chips');
-    const pts = (m.values || []).map(p => (typeof p === 'object' ? (p.value ?? 0) : p));
+    const pts = (m.values || []).map(p =>
+      (typeof p === 'object' ? Number(p.chips ?? p.value ?? 0) : Number(p)));
     if (!pts.length) { $('chart-note').textContent = 'no samples'; return; }
     const max = Math.max(...pts, 1);
     const step = 300 / Math.max(pts.length - 1, 1);

@@ -232,6 +232,10 @@ class JupyterWebApp:
         r.route("POST", "/api/namespaces/{ns}/pvcs", self.post_pvc)
         r.route("GET", "/api/namespaces/{ns}/poddefaults", self.get_poddefaults)
         r.route("GET", "/api/storageclasses", self.get_storageclasses)
+        # browser spawner UI (the JWA frontend equivalent, webapps/jwa_ui.py)
+        from kubeflow_tpu.webapps.jwa_ui import add_ui_routes
+
+        add_ui_routes(r)
         httpd.add_health_routes(r)
         httpd.add_metrics_route(r)
         return r

@@ -1,0 +1,161 @@
+"""Jupyter-web-app frontend: the notebook spawner UI.
+
+The reference JWA ships an Angular/JS frontend (jupyter-web-app/frontend)
+over its Flask backend; this is the same spawner as one dependency-free
+page served by the backend itself: notebook list with status/connect/
+delete, and a create form (name/image/cpu/memory/TPU chips) that POSTs
+the form shape `webapps/jwa.py` expects (`notebook_from_form`). TPU
+resources replace the reference's GPU dropdown (the utils.py:262 swap
+point, surfaced in the UI).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.utils.httpd import HttpReq, HttpResp
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>Notebooks — kubeflow-tpu</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f5f6f8; }
+  header { background: #1a73e8; color: #fff; padding: 10px 20px;
+           display: flex; gap: 16px; align-items: center; }
+  header h1 { font-size: 18px; margin: 0; flex: 1; }
+  main { max-width: 950px; margin: 20px auto; display: grid; gap: 16px; }
+  .card { background: #fff; border-radius: 8px; padding: 16px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.15); }
+  table { width: 100%; border-collapse: collapse; font-size: 14px; }
+  th, td { text-align: left; padding: 6px 8px; border-bottom: 1px solid #eee; }
+  select, input, button { font-size: 14px; padding: 6px 8px; margin: 2px 0;
+                          border: 1px solid #ccc; border-radius: 4px; }
+  button { cursor: pointer; background: #fff; }
+  .primary { background: #1a73e8; color: #fff; border: none; }
+  .muted { color: #777; font-size: 12px; }
+  form { display: grid; grid-template-columns: repeat(3, 1fr); gap: 8px; }
+  form label { display: flex; flex-direction: column; font-size: 12px;
+               color: #555; }
+</style>
+</head>
+<body>
+<header>
+  <h1>Notebooks</h1>
+  <select id="ns"></select>
+</header>
+<main>
+  <div class="card">
+    <h2>New notebook</h2>
+    <form id="spawn">
+      <label>Name <input name="name" required></label>
+      <label>Image <select name="image" id="images"></select></label>
+      <label>CPU <input name="cpu" value="0.5"></label>
+      <label>Memory <input name="memory" value="1Gi"></label>
+      <label>TPU chips <select name="tpu" id="tpus"></select></label>
+      <label>&nbsp;<button class="primary" type="submit">Launch</button></label>
+    </form>
+    <p class="muted" id="msg"></p>
+  </div>
+  <div class="card">
+    <h2>Running</h2>
+    <table>
+      <thead><tr><th>Name</th><th>Status</th><th>Image</th><th></th></tr></thead>
+      <tbody id="list"><tr><td class="muted" colspan="4">loading</td></tr></tbody>
+    </table>
+  </div>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const api = (p, opt) => fetch(p, opt).then(r => {
+  if (!r.ok) throw new Error('HTTP ' + r.status);
+  return r.json();
+});
+
+let config = {};
+
+async function init() {
+  config = (await api('/api/config')).config || {};
+  for (const img of (config.image?.options || [])) {
+    const o = document.createElement('option');
+    o.value = o.textContent = img;
+    $('images').appendChild(o);
+  }
+  for (const n of (config.tpu?.options || [0])) {
+    const o = document.createElement('option');
+    o.value = o.textContent = n;
+    $('tpus').appendChild(o);
+  }
+  const nss = (await api('/api/namespaces')).namespaces || [];
+  for (const ns of nss) {
+    const o = document.createElement('option');
+    o.value = o.textContent = ns;
+    $('ns').appendChild(o);
+  }
+  if (nss.length) await refresh();
+}
+
+async function refresh() {
+  const ns = $('ns').value;
+  const out = await api('/api/namespaces/' + ns + '/notebooks');
+  const tb = $('list');
+  tb.innerHTML = '';
+  for (const nb of out.notebooks || []) {
+    // DOM-built rows: names/images are never interpolated into HTML
+    const tr = document.createElement('tr');
+    for (const text of [nb.name, (nb.status && nb.status.phase) || 'unknown',
+                        nb.image || '']) {
+      const td = document.createElement('td');
+      td.textContent = text;
+      tr.appendChild(td);
+    }
+    const td = document.createElement('td');
+    const a = document.createElement('a');
+    a.href = '/notebook/' + encodeURIComponent(ns) + '/' +
+             encodeURIComponent(nb.name) + '/';
+    a.textContent = 'connect';
+    const del = document.createElement('button');
+    del.textContent = 'delete';
+    del.addEventListener('click', async () => {
+      await fetch('/api/namespaces/' + encodeURIComponent(ns) +
+                  '/notebooks/' + encodeURIComponent(nb.name),
+                  {method: 'DELETE'});
+      refresh();
+    });
+    td.append(a, ' ', del);
+    tr.appendChild(td);
+    tb.appendChild(tr);
+  }
+  if (!tb.children.length)
+    tb.innerHTML = '<tr><td class="muted" colspan="4">none</td></tr>';
+}
+
+$('ns').addEventListener('change', refresh);
+$('spawn').addEventListener('submit', async (e) => {
+  e.preventDefault();
+  const ns = $('ns').value;
+  const form = Object.fromEntries(new FormData(e.target).entries());
+  form.tpu = parseInt(form.tpu || '0', 10);
+  const r = await fetch('/api/namespaces/' + ns + '/notebooks', {
+    method: 'POST',
+    headers: {'Content-Type': 'application/json'},
+    body: JSON.stringify(form),
+  });
+  $('msg').textContent = r.ok ? 'created' : 'failed: HTTP ' + r.status;
+  if (r.ok) refresh();
+});
+
+init().catch(e => { $('msg').textContent = String(e); });
+setInterval(() => refresh().catch(() => {}), 10000);
+</script>
+</body>
+</html>
+"""
+
+
+def page(req: HttpReq) -> HttpResp:
+    return HttpResp(200, PAGE.encode(), "text/html")
+
+
+def add_ui_routes(router) -> None:
+    router.route("GET", "/", page)
+    router.route("GET", "/spawner", page)

@@ -1,0 +1,51 @@
+"""Static-hygiene tier — the testing/test_flake8.py analogue (SURVEY.md
+§4 tier 3). No flake8 in the image, so the checks are stdlib: every
+module compiles, no debugger hooks or conflict markers ship, public
+modules carry docstrings."""
+
+import ast
+import os
+import pathlib
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kubeflow_tpu"
+
+PY_FILES = sorted(
+    p for p in PACKAGE.rglob("*.py")
+    if "__pycache__" not in p.parts
+) + [REPO / "bench.py", REPO / "__graft_entry__.py"]
+
+
+@pytest.mark.parametrize("path", PY_FILES, ids=lambda p: str(p.relative_to(REPO)))
+def test_module_is_clean(path):
+    src = path.read_text()
+    tree = ast.parse(src, filename=str(path))  # syntax gate
+
+    for marker in ("<<" + "<<<<<", ">>" + ">>>>>"):  # conflict markers
+        assert marker not in src, f"{path}: merge conflict marker"
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = getattr(fn, "id", getattr(fn, "attr", ""))
+            assert name != "breakpoint", f"{path}:{node.lineno}: breakpoint()"
+            assert not (name == "set_trace"), f"{path}:{node.lineno}: pdb hook"
+
+
+@pytest.mark.parametrize(
+    "path",
+    [p for p in PY_FILES if p.name != "__main__.py"],
+    ids=lambda p: str(p.relative_to(REPO)),
+)
+def test_module_has_docstring(path):
+    tree = ast.parse(path.read_text())
+    assert ast.get_docstring(tree), f"{path}: missing module docstring"
+
+
+def test_no_reference_tree_imports():
+    """The build must be standalone: nothing may import from or open
+    /root/reference (the read-only upstream)."""
+    for p in PY_FILES:
+        assert "/root/reference" not in p.read_text(), p

@@ -225,8 +225,25 @@ class TestHttpClient:
         # the worker actually applied manifests to the backing cluster
         assert cluster.list("apps/v1", "Deployment", namespace="kubeflow")
 
-    def test_wait_times_out_cleanly(self):
+    def test_check_access_false_when_down(self):
         from kubeflow_tpu.tpctl.client import TpctlClient
 
         client = TpctlClient("http://127.0.0.1:1")  # nothing listening
         assert not client.check_access()
+
+    def test_wait_times_out_cleanly(self):
+        # Live server, but the deployment never exists: the poll loop must
+        # raise TimeoutError at the fake-clock deadline, not spin or hang.
+        import threading
+
+        from kubeflow_tpu.tpctl.client import TpctlClient
+
+        srv = TpctlServer(FakeCluster())
+        svc = srv.serve(host="127.0.0.1", port=0)
+        threading.Thread(target=svc.serve_forever, daemon=True).start()
+        client = TpctlClient(f"http://127.0.0.1:{svc.port}")
+        t = [0.0]
+        with pytest.raises(TimeoutError):
+            client.wait_available("never-created", timeout_s=10, poll_s=1,
+                                  clock=lambda: t[0],
+                                  sleep=lambda s: t.__setitem__(0, t[0] + s))

@@ -221,3 +221,13 @@ def test_dashboard_serves_ui(cluster):
     assert b"kubeflow-tpu" in page.body and b"/api/workgroup/env-info" in page.body
     # API routes still reachable alongside the UI route
     assert r.dispatch(mkreq("GET", "/api/workgroup/env-info")).status < 500
+
+
+def test_jwa_serves_spawner_ui(cluster):
+    from kubeflow_tpu.webapps.jwa import JupyterWebApp
+
+    r = JupyterWebApp(cluster).router()
+    page = r.dispatch(mkreq("GET", "/"))
+    assert page.status == 200 and page.content_type == "text/html"
+    assert b"/api/config" in page.body and b"TPU chips" in page.body
+    assert r.dispatch(mkreq("GET", "/api/config")).status == 200
