@@ -10,10 +10,11 @@ The hot op of the transformer path, built for the MXU:
 - Causal blocks above the diagonal are predicated off with `@pl.when`
   (skipped entirely, ~2x speedup), diagonal blocks masked with
   `broadcasted_iota` (TPU needs >=2D iota).
-- Backward uses the saved logsumexp (the flash trick) and recomputes
-  probabilities blockwise under `lax.scan`, so it is also O(L) memory;
-  einsum formulation keeps it on the MXU. A fully fused Pallas backward
-  is a planned optimization.
+- Backward is fused Pallas too: a dq kernel (accumulates over kv blocks)
+  and a dk/dv kernel (accumulates over q blocks), both recomputing
+  probabilities from the saved logsumexp (the flash trick) so memory is
+  O(BLOCK_Q x BLOCK_K); all matmuls on the MXU in f32. A blockwise XLA
+  backward (`_flash_bwd_xla`) remains as the differential-test oracle.
 
 On non-TPU platforms the kernel runs in Pallas interpret mode (tests on
 the virtual CPU mesh exercise the same code path).
@@ -42,6 +43,34 @@ NEG_INF = -1e30
 
 def _interpret_default() -> bool:
     return jax.default_backend() not in ("tpu",)
+
+
+def _vmem_spec(shape, imap) -> "pl.BlockSpec":
+    return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
+
+
+def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
+                    block_q, block_k, qi, ki, offset):
+    """Shared backward block math: recompute probabilities from the saved
+    lse and form ds = p * (dp - delta) * scale. Used by BOTH backward
+    kernels so the masking/scaling convention can never diverge between
+    dq and dk/dv."""
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * scale                                          # [BQ, BK]
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
+        s = jnp.where(mask, s, NEG_INF)
+    p = jnp.exp(s - lse_row[:, None])                  # [BQ, BK]
+    dp = jax.lax.dot_general(
+        g, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    ds = p * (dp - delta_row[:, None]) * scale
+    return p, ds
 
 
 # --------------------------------------------------------------------------
@@ -124,8 +153,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         pltpu.VMEM((block_q, 1), jnp.float32),   # running sum
         pltpu.VMEM((block_q, d), jnp.float32),   # output accumulator
     ]
-    mem = pltpu.VMEM
-    bs = lambda shape, imap: pl.BlockSpec(shape, imap, memory_space=mem)  # noqa: E731
+    bs = _vmem_spec
 
     out, lse = pl.pallas_call(
         kernel,
@@ -150,10 +178,150 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 
 
 # --------------------------------------------------------------------------
-# backward (blockwise XLA, O(L) memory via saved lse)
+# backward: fused Pallas kernels (dq; dk/dv), with the saved-lse flash
+# trick — probabilities are recomputed blockwise, memory stays
+# O(BLOCK_Q x BLOCK_K). Two kernels because the two gradients accumulate
+# over different grid axes (dq over kv blocks, dk/dv over q blocks);
+# each keeps its accumulator in VMEM scratch across the sequential inner
+# grid dimension, exactly like the forward.
 # --------------------------------------------------------------------------
 
-def _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k):
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
+                   acc_s, *, scale, causal, block_q, block_k, offset):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_s[:] = jnp.zeros_like(acc_s)
+
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+
+    @pl.when(run)
+    def _compute():
+        k = k_ref[0]                                   # [BK, D]
+        _, ds = _recompute_p_ds(
+            q_ref[0], k, v_ref[0], g_ref[0], lse_ref[0], delta_ref[0],
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            qi=qi, ki=ki, offset=offset)
+        acc_s[:] = acc_s[:] + jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_s, dv_s, *,
+                    scale, causal, block_q, block_k, offset):
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_s[:] = jnp.zeros_like(dk_s)
+        dv_s[:] = jnp.zeros_like(dv_s)
+
+    run = True
+    if causal:
+        # any row of this q block may attend into this kv block
+        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                                   # [BQ, D]
+        g = g_ref[0]
+        p, ds = _recompute_p_ds(
+            q, k_ref[0], v_ref[0], g, lse_ref[0], delta_ref[0],
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            qi=qi, ki=ki, offset=offset)
+        dv_s[:] = dv_s[:] + jax.lax.dot_general(
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                              # [BK, D]
+        dk_s[:] = dk_s[:] + jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
+                      interpret):
+    """Fused backward: q,k,v,out,g [BH, L, D]; lse [BH, L]."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    nq = pl.cdiv(lq, block_q)
+    nk = pl.cdiv(lk, block_k)
+    offset = lk - lq
+    # delta_i = sum_d(do_i * o_i): one cheap rowwise reduction in XLA.
+    delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+
+    bs = _vmem_spec
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(bh, nq, nk),
+        in_specs=[
+            bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+            bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # g
+            bs((1, block_q), lambda b, i, j: (b, i)),         # lse
+            bs((1, block_q), lambda b, i, j: (b, i)),         # delta
+        ],
+        out_specs=bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, offset=offset),
+        grid=(bh, nk, nq),
+        in_specs=[
+            bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+            bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # g
+            bs((1, block_q), lambda b, j, i: (b, i)),         # lse
+            bs((1, block_q), lambda b, j, i: (b, i)),         # delta
+        ],
+        out_specs=[
+            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, lk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------------
+# backward (blockwise XLA fallback / differential-test oracle)
+# --------------------------------------------------------------------------
+
+def _flash_bwd_xla(q, k, v, out, lse, g, scale, causal, block_k):
     """Recompute-p backward. All [BH, L, D]; lse [BH, L]."""
     f32 = jnp.float32
     qf, kf, vf, gf = (x.astype(f32) for x in (q, k, v, g))
@@ -209,7 +377,8 @@ def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
-    return _flash_bwd(q, k, v, out, lse, g, scale, causal, block_k)
+    return _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
+                             block_q, block_k, _interpret_default())
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
