@@ -111,7 +111,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, decode_index=None):
         cfg = self.cfg
         init = nn.initializers.normal(0.02)
         dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
@@ -129,7 +129,34 @@ class Attention(nn.Module):
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
 
-        if cfg.attention_impl == "ring":
+        if decode_index is not None:
+            # KV-cache decode: x is the single new token [B, 1, ...]; write
+            # its K/V at decode_index and attend q against the full cache
+            # with a <=index mask. Cache layout [B, max_seq, Hkv, D].
+            b = x.shape[0]
+            ck = self.variable(
+                "cache", "cached_key",
+                lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
+                                   cfg.head_dim), cfg.dtype))
+            cv = self.variable(
+                "cache", "cached_value",
+                lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
+                                   cfg.head_dim), cfg.dtype))
+            idx = jnp.asarray(decode_index, jnp.int32)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            kf = jnp.repeat(ck.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
+            vf = jnp.repeat(cv.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
+            logits = jnp.einsum(
+                "bqhd,bkhd->bhqk", q, kf,
+                preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
+            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= idx
+            logits = jnp.where(mask, logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vf.dtype), vf)
+        elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 
             assert segment_ids is None, "ring attention does not take segment_ids yet"
@@ -188,10 +215,11 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None):
+    def __call__(self, x, positions, segment_ids=None, decode_index=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions, segment_ids
+            RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions,
+            segment_ids, decode_index
         )
         if self.use_moe:
             from kubeflow_tpu.ops.moe import MoEBlock
@@ -226,7 +254,8 @@ class TransformerLM(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True, segment_ids=None):
+    def __call__(self, tokens, train: bool = True, segment_ids=None,
+                 decode_index=None):
         cfg = self.cfg
         del train  # no dropout in the speed-run configuration
         emb = self.param(
@@ -237,6 +266,25 @@ class TransformerLM(nn.Module):
         )
         x = jnp.asarray(emb, cfg.dtype)[tokens]
         x = shard(x, HIDDEN_SPEC)
+        if decode_index is not None:
+            # KV-cache decode step: tokens [B, 1] at absolute position
+            # decode_index (runtime/generate.py drives this).
+            if cfg.pipeline_stages > 1:
+                raise ValueError("decode is not supported under pipeline "
+                                 "parallelism yet")
+            positions = jnp.broadcast_to(
+                jnp.asarray(decode_index, jnp.int32), tokens.shape)
+            for i in range(cfg.n_layers):
+                use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+                x = Block(cfg, use_moe=use_moe, name=f"layer_{i}")(
+                    x, positions, None, decode_index)
+            x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+            return nn.DenseGeneral(
+                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
+                kernel_init=_part(nn.initializers.normal(0.02),
+                                  (AXIS_FSDP, AXIS_MODEL)),
+                name="lm_head",
+            )(x.astype(jnp.float32))
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
