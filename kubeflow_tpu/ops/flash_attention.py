@@ -129,7 +129,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
     def _finalize():
         l = jnp.maximum(l_s[:], 1e-20)
         o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
-        lse_ref[0] = (m_s[:] + jnp.log(l))[:, 0]
+        lse_ref[0, 0] = (m_s[:] + jnp.log(l))[:, 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
@@ -165,16 +165,19 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            bs((1, block_q), lambda b, i, j: (b, i)),
+            # lse rides as [BH, 1, L] so the block's trailing dims are
+            # (1, block_q) — legal under Mosaic's (8, 128) tiling rule
+            # (1 == the full middle dim; block_q % 128 == 0).
+            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
-            jax.ShapeDtypeStruct((bh, lq), jnp.float32),
+            jax.ShapeDtypeStruct((bh, 1, lq), jnp.float32),
         ],
         scratch_shapes=scratch,
         interpret=interpret,
     )(q, k, v)
-    return out, lse
+    return out, lse.reshape(bh, lq)
 
 
 # --------------------------------------------------------------------------
@@ -204,7 +207,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
     def _compute():
         k = k_ref[0]                                   # [BK, D]
         _, ds = _recompute_p_ds(
-            q_ref[0], k, v_ref[0], g_ref[0], lse_ref[0], delta_ref[0],
+            q_ref[0], k, v_ref[0], g_ref[0], lse_ref[0, 0], delta_ref[0, 0],
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, ki=ki, offset=offset)
         acc_s[:] = acc_s[:] + jax.lax.dot_general(
@@ -239,7 +242,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         q = q_ref[0]                                   # [BQ, D]
         g = g_ref[0]
         p, ds = _recompute_p_ds(
-            q, k_ref[0], v_ref[0], g, lse_ref[0], delta_ref[0],
+            q, k_ref[0], v_ref[0], g, lse_ref[0, 0], delta_ref[0, 0],
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, ki=ki, offset=offset)
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
@@ -266,7 +269,10 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     nk = pl.cdiv(lk, block_k)
     offset = lk - lq
     # delta_i = sum_d(do_i * o_i): one cheap rowwise reduction in XLA.
+    # lse/delta ride as [BH, 1, L] for Mosaic's (8, 128) tiling rule.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    delta = delta.reshape(bh, 1, lq)
+    lse = lse.reshape(bh, 1, lq)
 
     bs = _vmem_spec
 
@@ -279,8 +285,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
             bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
             bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # g
-            bs((1, block_q), lambda b, i, j: (b, i)),         # lse
-            bs((1, block_q), lambda b, i, j: (b, i)),         # delta
+            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
+            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
         ],
         out_specs=bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
@@ -297,8 +303,8 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
             bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # g
-            bs((1, block_q), lambda b, j, i: (b, i)),         # lse
-            bs((1, block_q), lambda b, j, i: (b, i)),         # delta
+            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
+            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
         ],
         out_specs=[
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),
