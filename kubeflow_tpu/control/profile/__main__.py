@@ -1,9 +1,14 @@
 from kubeflow_tpu.control.mains import run_controller
 from kubeflow_tpu.control.profile.controller import WorkloadIdentityPlugin, build_controller
+from kubeflow_tpu.control.profile.plugin_irsa import IrsaPlugin
 
 run_controller(
     "profile-controller",
     lambda client, args: build_controller(
-        client, plugins={"WorkloadIdentity": WorkloadIdentityPlugin()}
+        client,
+        plugins={
+            "WorkloadIdentity": WorkloadIdentityPlugin(),
+            IrsaPlugin.KIND: IrsaPlugin(),
+        },
     ),
 )

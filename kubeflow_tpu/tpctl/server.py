@@ -80,8 +80,11 @@ class TpctlServer:
     # -- endpoints ----------------------------------------------------------
 
     def create(self, req: HttpReq):
-        body = req.json() or {}
-        cfg = TpuDef.from_dict(body)
+        try:
+            body = req.json() or {}
+            cfg = TpuDef.from_dict(body)
+        except (ValueError, TypeError) as e:  # malformed JSON / bad TpuDef
+            raise ApiHttpError(400, f"invalid TpuDef: {e}")
         with self._lock:
             w = self.workers.get(cfg.name)
             if w is None:
@@ -107,11 +110,18 @@ class TpctlServer:
             "error": w.error if w else None,
         }
 
+    def openapi(self, req: HttpReq):
+        from kubeflow_tpu.tpctl.apispec import openapi
+
+        return openapi()
+
     def router(self) -> Router:
         r = Router("tpctl")
         r.route("POST", "/tpctl/apps/v1/create", self.create)
         r.route("POST", "/tpctl/apps/v1/get", self.get)
         r.route("GET", "/tpctl/apps/v1/get", self.get)
+        # machine-readable contract (bootstrap/api/swagger.yaml analogue)
+        r.route("GET", "/tpctl/apps/v1/openapi.json", self.openapi)
         httpd.add_health_routes(r)
         httpd.add_metrics_route(r)
         return r

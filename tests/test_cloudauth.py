@@ -1,0 +1,194 @@
+"""tpctl cloud-auth plumbing (reference: tokenSource.go:35-75,
+gcpUtils.go:60-180, initHandler.go:33; test fidelity of
+tokenSource_test.go + gcpUtils_test.go)."""
+
+import threading
+
+import pytest
+
+from kubeflow_tpu.tpctl.cloudauth import (
+    IAM_ADMIN_ROLE,
+    SET_IAM_POLICY_PERMISSION,
+    ProjectLocks,
+    RefreshableTokenSource,
+    bind_role,
+    check_project_access,
+    prepare_account,
+    update_policy,
+)
+
+
+class FakeCrm:
+    def __init__(self, valid_tokens=("good",), fail_times=0):
+        self.valid = set(valid_tokens)
+        self.fail_times = fail_times
+        self.calls = 0
+        self.policies: dict[str, dict] = {}
+        self.set_calls: list[tuple[str, dict]] = []
+
+    def test_iam_permissions(self, project, token, permissions):
+        self.calls += 1
+        if self.fail_times > 0:
+            self.fail_times -= 1
+            raise ConnectionError("transient")
+        return list(permissions) if token in self.valid else []
+
+    def get_iam_policy(self, project, token):
+        import copy
+        return copy.deepcopy(self.policies.setdefault(project, {"bindings": []}))
+
+    def set_iam_policy(self, project, token, policy):
+        self.policies[project] = policy
+        self.set_calls.append((project, policy))
+
+
+class TestCheckProjectAccess:
+    def test_valid_token(self):
+        assert check_project_access("p", "good", FakeCrm()) is True
+
+    def test_insufficient_token_returns_false_immediately(self):
+        crm = FakeCrm()
+        assert check_project_access("p", "bad", crm) is False
+        assert crm.calls == 1  # clean denial: no retries
+
+    def test_transient_errors_retried_with_backoff(self):
+        # gcpUtils.go:150-155: exponential backoff on API errors
+        crm = FakeCrm(fail_times=2)
+        sleeps = []
+        assert check_project_access("p", "good", crm,
+                                    sleep=sleeps.append) is True
+        assert crm.calls == 3
+        assert sleeps == [2.0, 4.0]
+
+    def test_backoff_budget_exhausted(self):
+        crm = FakeCrm(fail_times=1000)
+        sleeps = []
+        assert check_project_access("p", "good", crm,
+                                    sleep=sleeps.append) is False
+        assert sum(sleeps) <= 60.0
+
+
+class TestRefreshableTokenSource:
+    def test_requires_project(self):
+        with pytest.raises(ValueError):
+            RefreshableTokenSource("", FakeCrm())
+
+    def test_refresh_validates_then_swaps(self):
+        ts = RefreshableTokenSource("p", FakeCrm())
+        assert ts.token() is None
+        ts.refresh("good")
+        assert ts.token() == "good"
+
+    def test_empty_token_rejected(self):
+        # tokenSource.go:53-55
+        ts = RefreshableTokenSource("p", FakeCrm())
+        with pytest.raises(ValueError):
+            ts.refresh("")
+
+    def test_invalid_token_keeps_current(self):
+        # tokenSource.go:62-67: failed validation leaves the old token
+        ts = RefreshableTokenSource("p", FakeCrm())
+        ts.refresh("good")
+        with pytest.raises(PermissionError):
+            ts.refresh("bad")
+        assert ts.token() == "good"
+
+
+class TestPrepareAccount:
+    # gcpUtils.go:60-68
+    def test_service_account(self):
+        assert prepare_account("x@p.iam.gserviceaccount.com") == \
+            "serviceAccount:x@p.iam.gserviceaccount.com"
+
+    def test_support_group(self):
+        assert prepare_account("google-kubeflow-support@google.com") == \
+            "group:google-kubeflow-support@google.com"
+
+    def test_plain_user(self):
+        assert prepare_account("alice@example.com") == "user:alice@example.com"
+
+
+class TestUpdatePolicy:
+    CONF = [{"members": ["set-kubeflow-iap-account"],
+             "roles": ["roles/iap.httpsResourceAccessor"]}]
+
+    def test_add_binding_with_placeholder_substitution(self):
+        # gcpUtils.go:80-87 placeholder mapping
+        policy = {"bindings": [{"role": "roles/viewer",
+                                "members": ["user:bob@example.com"]}]}
+        out = update_policy(policy, self.CONF, cluster="kf", project="p",
+                            email="alice@example.com", action="add")
+        roles = {b["role"]: sorted(b["members"]) for b in out["bindings"]}
+        assert roles["roles/viewer"] == ["user:bob@example.com"]
+        assert roles["roles/iap.httpsResourceAccessor"] == ["user:alice@example.com"]
+
+    def test_add_is_idempotent(self):
+        policy = {"bindings": [{"role": "roles/iap.httpsResourceAccessor",
+                                "members": ["user:alice@example.com"]}]}
+        out = update_policy(policy, self.CONF, cluster="kf", project="p",
+                            email="alice@example.com", action="add")
+        [b] = [b for b in out["bindings"]
+               if b["role"] == "roles/iap.httpsResourceAccessor"]
+        assert b["members"] == ["user:alice@example.com"]
+
+    def test_remove_action_deletes_member(self):
+        # gcpUtils.go:99-104: action=remove flips the member off
+        policy = {"bindings": [{"role": "roles/iap.httpsResourceAccessor",
+                                "members": ["user:alice@example.com",
+                                            "user:bob@example.com"]}]}
+        out = update_policy(policy, self.CONF, cluster="kf", project="p",
+                            email="alice@example.com", action="remove")
+        [b] = [b for b in out["bindings"]
+               if b["role"] == "roles/iap.httpsResourceAccessor"]
+        assert b["members"] == ["user:bob@example.com"]
+
+    def test_role_emptied_by_remove_is_dropped(self):
+        policy = {"bindings": [{"role": "roles/iap.httpsResourceAccessor",
+                                "members": ["user:alice@example.com"]}]}
+        out = update_policy(policy, self.CONF, cluster="kf", project="p",
+                            email="alice@example.com", action="remove")
+        assert out["bindings"] == []
+
+    def test_service_account_placeholders(self):
+        conf = [{"members": ["set-kubeflow-admin-service-account",
+                             "set-kubeflow-vm-service-account"],
+                 "roles": ["roles/editor"]}]
+        out = update_policy({"bindings": []}, conf, cluster="kf", project="p",
+                            email="e@x.com", action="add")
+        [b] = out["bindings"]
+        assert sorted(b["members"]) == [
+            "serviceAccount:kf-admin@p.iam.gserviceaccount.com",
+            "serviceAccount:kf-vm@p.iam.gserviceaccount.com"]
+
+
+class TestBindRole:
+    def test_grants_admin_role(self):
+        # initHandler.go:24: <projectNumber>@cloudservices.gserviceaccount.com
+        crm = FakeCrm()
+        bind_role("p", "good", "123@cloudservices.gserviceaccount.com", crm)
+        [b] = crm.policies["p"]["bindings"]
+        assert b["role"] == IAM_ADMIN_ROLE
+        assert b["members"] == ["serviceAccount:123@cloudservices.gserviceaccount.com"]
+
+    def test_idempotent(self):
+        crm = FakeCrm()
+        for _ in range(2):
+            bind_role("p", "good", "123@cloudservices.gserviceaccount.com", crm)
+        assert len(crm.set_calls) == 1
+
+    def test_concurrent_binds_serialize_per_project(self):
+        # ksServer.go:44-47: policy read-modify-write races are guarded by
+        # the per-project lock; 8 concurrent binds must not lose updates.
+        crm = FakeCrm()
+        locks = ProjectLocks()
+        threads = [threading.Thread(
+            target=bind_role,
+            args=("p", "good", f"sa{i}@cloudservices.gserviceaccount.com", crm),
+            kwargs={"locks": locks}) for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        members = {m for b in crm.policies["p"]["bindings"]
+                   for m in b["members"]}
+        assert len(members) == 8

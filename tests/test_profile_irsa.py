@@ -1,0 +1,218 @@
+"""AWS IRSA profile plugin (reference: plugin_iam.go:27-284, tests at the
+fidelity of plugin_iam_test.go:1-302)."""
+
+import json
+import urllib.parse
+
+import pytest
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.profile import types as PT
+from kubeflow_tpu.control.profile.controller import build_controller
+from kubeflow_tpu.control.profile.plugin_irsa import (
+    ANNOTATION,
+    DEFAULT_AUDIENCE,
+    KIND,
+    ConditionExistsError,
+    IrsaPlugin,
+    add_service_account_in_assume_role_policy,
+    issuer_url_from_provider_arn,
+    make_assume_role_with_web_identity_policy_document,
+    make_policy_document,
+    remove_service_account_in_assume_role_policy,
+    role_name_from_arn,
+)
+from kubeflow_tpu.control.runtime import seed_controller
+
+ISSUER = ("oidc.beta.us-west-2.wesley.amazonaws.com/id/"
+          "50D94CFC65139194EDC21891B611EF72")
+PROVIDER_ARN = f"arn:aws:iam::34892524:oidc-provider/{ISSUER}"
+ROLE_ARN = "arn:aws:iam::34892524:role/s3-reader"
+
+
+def policy(subjects=None) -> str:
+    """A trust policy like plugin_iam_test.go's fixtures."""
+    equals = {f"{ISSUER}:aud": [DEFAULT_AUDIENCE]}
+    if subjects is not None:
+        equals[f"{ISSUER}:sub"] = subjects
+    return json.dumps({
+        "Version": "2012-10-17",
+        "Statement": [{
+            "Effect": "Allow",
+            "Principal": {"Federated": PROVIDER_ARN},
+            "Action": "sts:AssumeRoleWithWebIdentity",
+            "Condition": {"StringEquals": equals},
+        }],
+    })
+
+
+def subjects_of(policy_json: str) -> list:
+    doc = json.loads(policy_json)
+    cond = doc["Statement"][0]["Condition"]["StringEquals"]
+    return cond.get(f"{ISSUER}:sub", [])
+
+
+# ---- pure policy surgery (plugin_iam_test.go:67-302 analogues) -----------
+
+class TestArnParsing:
+    def test_issuer_url_from_provider_arn(self):
+        # plugin_iam_test.go:52-57
+        assert issuer_url_from_provider_arn(PROVIDER_ARN) == ISSUER
+
+    def test_role_name_from_arn(self):
+        # plugin_iam_test.go:59-64
+        assert role_name_from_arn("arn:aws:iam::34892524:role/test-iam-role") \
+            == "test-iam-role"
+
+
+class TestPolicyDocumentSurgery:
+    def test_add_first_subject(self):
+        # plugin_iam_test.go:67-110: no :sub key yet -> created with the
+        # new subject, audience preserved.
+        out = add_service_account_in_assume_role_policy(policy(), "ns1", "sa1")
+        doc = json.loads(out)
+        stmt = doc["Statement"][0]
+        assert doc["Version"] == "2012-10-17"
+        assert stmt["Action"] == "sts:AssumeRoleWithWebIdentity"
+        assert stmt["Principal"]["Federated"] == PROVIDER_ARN
+        cond = stmt["Condition"]["StringEquals"]
+        assert cond[f"{ISSUER}:aud"] == [DEFAULT_AUDIENCE]
+        assert cond[f"{ISSUER}:sub"] == ["system:serviceaccount:ns1:sa1"]
+
+    def test_add_preserves_existing_subjects(self):
+        # plugin_iam_test.go second case: existing subjects stay.
+        out = add_service_account_in_assume_role_policy(
+            policy(["system:serviceaccount:ns0:sa0"]), "ns1", "sa1")
+        assert subjects_of(out) == ["system:serviceaccount:ns0:sa0",
+                                    "system:serviceaccount:ns1:sa1"]
+
+    def test_add_duplicate_raises_condition_exists(self):
+        # plugin_iam.go:154-157: present subject -> ConditionExistError,
+        # caller skips the AWS update.
+        with pytest.raises(ConditionExistsError):
+            add_service_account_in_assume_role_policy(
+                policy(["system:serviceaccount:ns1:sa1"]), "ns1", "sa1")
+
+    def test_remove_subject(self):
+        out = remove_service_account_in_assume_role_policy(
+            policy(["system:serviceaccount:ns0:sa0",
+                    "system:serviceaccount:ns1:sa1"]), "ns1", "sa1")
+        assert subjects_of(out) == ["system:serviceaccount:ns0:sa0"]
+
+    def test_remove_last_subject_drops_sub_key(self):
+        # plugin_iam.go:213-227: empty list would serialize as null/[] and
+        # break AWS policy validation -> the :sub key is omitted.
+        out = remove_service_account_in_assume_role_policy(
+            policy(["system:serviceaccount:ns1:sa1"]), "ns1", "sa1")
+        cond = json.loads(out)["Statement"][0]["Condition"]["StringEquals"]
+        assert f"{ISSUER}:sub" not in cond
+        assert cond[f"{ISSUER}:aud"] == [DEFAULT_AUDIENCE]
+
+    def test_remove_absent_subject_is_noop_on_list(self):
+        out = remove_service_account_in_assume_role_policy(
+            policy(["system:serviceaccount:ns0:sa0"]), "ns1", "sa1")
+        assert subjects_of(out) == ["system:serviceaccount:ns0:sa0"]
+
+    def test_policy_document_builders(self):
+        # plugin_iam.go:250-267
+        stmt = make_assume_role_with_web_identity_policy_document(
+            PROVIDER_ARN, {"StringEquals": {}})
+        doc = make_policy_document(stmt)
+        assert doc["Version"] == "2012-10-17"
+        assert doc["Statement"] == [stmt]
+
+    def test_no_statements_rejected(self):
+        with pytest.raises(ValueError):
+            add_service_account_in_assume_role_policy(
+                json.dumps({"Version": "2012-10-17", "Statement": []}),
+                "ns1", "sa1")
+
+
+# ---- plugin against the profile controller -------------------------------
+
+class FakeIamBackend:
+    """Stores trust policies URL-quoted, as the AWS API returns them
+    (plugin_iam.go:85)."""
+
+    def __init__(self, roles: dict[str, str]):
+        self.roles = {n: urllib.parse.quote(p) for n, p in roles.items()}
+        self.updates: list[tuple[str, str]] = []
+
+    def get_assume_role_policy(self, role_name: str) -> str:
+        return self.roles[role_name]
+
+    def update_assume_role_policy(self, role_name: str, policy_json: str) -> None:
+        self.roles[role_name] = urllib.parse.quote(policy_json)
+        self.updates.append((role_name, policy_json))
+
+    def decoded(self, role_name: str) -> str:
+        return urllib.parse.unquote(self.roles[role_name])
+
+
+def make_world(initial_policy: str):
+    cluster = FakeCluster()
+    iam = FakeIamBackend({"s3-reader": initial_policy})
+    ctl = seed_controller(build_controller(
+        cluster, plugins={KIND: IrsaPlugin(iam_backend=iam)}))
+    return cluster, ctl, iam
+
+
+def drain(ctl):
+    for _ in range(4):
+        ctl.run_until_idle(advance_delayed=True)
+
+
+def irsa_profile(name="team-aws", owner="alice@example.com"):
+    return PT.new_profile(name, owner, plugins=[
+        {"kind": KIND, "spec": {"awsIamRole": ROLE_ARN}},
+    ])
+
+
+class TestIrsaPluginReconcile:
+    def test_apply_annotates_sa_and_updates_trust_policy(self, ):
+        cluster, ctl, iam = make_world(policy())
+        cluster.create(irsa_profile())
+        drain(ctl)
+        sa = cluster.get("v1", "ServiceAccount", PT.SA_EDITOR, "team-aws")
+        assert ob.annotations_of(sa)[ANNOTATION] == ROLE_ARN
+        assert subjects_of(iam.decoded("s3-reader")) == [
+            "system:serviceaccount:team-aws:default-editor"]
+
+    def test_reapply_is_idempotent(self):
+        # Second reconcile finds the subject present -> no second AWS call.
+        cluster, ctl, iam = make_world(policy())
+        cluster.create(irsa_profile())
+        drain(ctl)
+        n_updates = len(iam.updates)
+        from kubeflow_tpu.control.runtime import Request
+        ctl.enqueue(Request(name="team-aws", namespace=None))
+        drain(ctl)
+        assert len(iam.updates) == n_updates
+
+    def test_revoke_on_delete_removes_annotation_and_subject(self):
+        cluster, ctl, iam = make_world(policy())
+        cluster.create(irsa_profile())
+        drain(ctl)
+        cluster.delete(PT.API_VERSION, PT.KIND, "team-aws")
+        drain(ctl)
+        # subject gone, :sub key dropped (it was the only one)
+        cond = json.loads(iam.decoded("s3-reader"))[
+            "Statement"][0]["Condition"]["StringEquals"]
+        assert f"{ISSUER}:sub" not in cond
+
+    def test_other_namespace_subjects_survive_revoke(self):
+        cluster, ctl, iam = make_world(
+            policy(["system:serviceaccount:other:default-editor"]))
+        cluster.create(irsa_profile())
+        drain(ctl)
+        cluster.delete(PT.API_VERSION, PT.KIND, "team-aws")
+        drain(ctl)
+        assert subjects_of(iam.decoded("s3-reader")) == [
+            "system:serviceaccount:other:default-editor"]
+
+    def test_profile_without_irsa_plugin_never_touches_iam(self):
+        cluster, ctl, iam = make_world(policy())
+        cluster.create(PT.new_profile("plain", "bob@example.com"))
+        drain(ctl)
+        assert iam.updates == []
