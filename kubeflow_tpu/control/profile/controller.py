@@ -36,6 +36,15 @@ class Plugin(Protocol):
     def revoke(self, client, profile: dict) -> None: ...
 
 
+def plugin_spec_field(profile: dict, kind: str, field: str) -> str | None:
+    """Extract one field from the profile's plugin spec of the given kind
+    (shared by all cloud-credential plugins)."""
+    for p in (profile.get("spec") or {}).get("plugins") or []:
+        if p.get("kind") == kind:
+            return (p.get("spec") or {}).get(field)
+    return None
+
+
 class WorkloadIdentityPlugin:
     """GCP Workload Identity binding (plugin_workload_identity.go:32-156).
 
@@ -51,10 +60,7 @@ class WorkloadIdentityPlugin:
         self.iam = iam_backend  # .bind(gsa, ksa), .unbind(gsa, ksa)
 
     def _gsa(self, profile: dict) -> str | None:
-        for p in (profile.get("spec") or {}).get("plugins") or []:
-            if p.get("kind") == self.KIND:
-                return (p.get("spec") or {}).get("gcpServiceAccount")
-        return None
+        return plugin_spec_field(profile, self.KIND, "gcpServiceAccount")
 
     def apply(self, client, profile: dict) -> None:
         gsa = self._gsa(profile)

