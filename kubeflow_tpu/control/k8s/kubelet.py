@@ -98,7 +98,13 @@ class LocalPodExecutor:
         self.label_selector = label_selector
         self.env_hook = env_hook
         self.cwd = cwd
-        self._procs: dict[tuple[str, str], subprocess.Popen] = {}
+        # key -> (pod uid, process). The uid is the pod's identity: a
+        # gang restart recreates a pod under the same name, and the old
+        # incarnation's process must be reaped before the new one runs
+        # (kubelet semantics — otherwise a relaunched jax.distributed
+        # worker can reach the previous incarnation's coordinator and
+        # die with "connected with a different incarnation").
+        self._procs: dict[tuple[str, str], tuple[str, subprocess.Popen]] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
 
@@ -113,38 +119,29 @@ class LocalPodExecutor:
         return env
 
     def poll_once(self) -> None:
-        """Launch new pods; harvest finished processes."""
+        """Reap stale/finished processes, then launch new pods.
+
+        Reaping runs first so that a gang-restarted pod set (delete +
+        recreate under the same names) has its previous incarnation's
+        processes killed — and the coordinator port released — before
+        the new gang launches in the same pass.
+        """
         pods = self.cluster.list("v1", "Pod", label_selector=self.label_selector)
         with self._lock:
-            seen = set()
-            for pod in pods:
-                m = ob.meta(pod)
-                key = (m.get("namespace") or "default", m["name"])
-                seen.add(key)
-                phase = (pod.get("status") or {}).get("phase", "Pending")
-                if phase == "Pending" and key not in self._procs:
-                    c = pod["spec"]["containers"][0]
-                    cmd = list(c.get("command") or []) + list(c.get("args") or [])
-                    log.info("exec pod %s: %s", m["name"], " ".join(cmd))
-                    proc = subprocess.Popen(
-                        cmd,
-                        env=self._pod_env(pod),
-                        cwd=self.cwd,
-                        stdout=subprocess.PIPE,
-                        stderr=subprocess.STDOUT,
-                    )
-                    self._procs[key] = proc
-                    _set_phase(self.cluster, pod, "Running", startTime=ob.now_iso())
-            # harvest
-            for key, proc in list(self._procs.items()):
+            # -- harvest / reap ------------------------------------------
+            for key, (uid, proc) in list(self._procs.items()):
                 ns, name = key
                 rc = proc.poll()
                 pod = self.cluster.get_or_none("v1", "Pod", name, ns)
-                if pod is None:
-                    # pod deleted (gang restart): kill the process
+                if pod is None or ob.meta(pod).get("uid") != uid:
+                    # pod deleted or replaced by a new incarnation (gang
+                    # restart): kill + reap; never touch the new pod's
+                    # status from the old process's exit code.
                     if rc is None:
                         proc.kill()
-                        proc.wait(timeout=10)
+                    proc.wait(timeout=10)
+                    if proc.stdout:
+                        proc.stdout.close()
                     del self._procs[key]
                     continue
                 if rc is None:
@@ -163,6 +160,41 @@ class LocalPodExecutor:
                                                      "message": out[-500:]}},
                         }],
                     )
+            # -- launch --------------------------------------------------
+            for pod in pods:
+                m = ob.meta(pod)
+                key = (m.get("namespace") or "default", m["name"])
+                phase = (pod.get("status") or {}).get("phase", "Pending")
+                if phase == "Pending" and key not in self._procs:
+                    c = pod["spec"]["containers"][0]
+                    cmd = list(c.get("command") or []) + list(c.get("args") or [])
+                    log.info("exec pod %s: %s", m["name"], " ".join(cmd))
+                    proc = subprocess.Popen(
+                        cmd,
+                        env=self._pod_env(pod),
+                        cwd=self.cwd,
+                        stdout=subprocess.PIPE,
+                        stderr=subprocess.STDOUT,
+                    )
+                    self._procs[key] = (m.get("uid", ""), proc)
+                    _set_phase(self.cluster, pod, "Running", startTime=ob.now_iso())
+
+    def alive_count(self) -> int:
+        """Number of tracked worker processes still running."""
+        with self._lock:
+            return sum(1 for _uid, p in self._procs.values() if p.poll() is None)
+
+    def kill_pod(self, name: str, namespace: str = "default",
+                 sig: int | None = None) -> bool:
+        """SIGKILL the process backing a pod (fault injection for e2e
+        tests — the hermetic stand-in for a preempted TPU worker).
+        Returns False when no live process backs that pod."""
+        with self._lock:
+            entry = self._procs.get((namespace, name))
+            if entry is None or entry[1].poll() is not None:
+                return False
+            entry[1].kill()
+            return True
 
     def run_until_settled(self, timeout: float = 120.0, poll: float = 0.2) -> None:
         """Poll until no tracked process is alive and no Pending pods remain."""
@@ -182,7 +214,7 @@ class LocalPodExecutor:
     def shutdown(self) -> None:
         self._stop.set()
         with self._lock:
-            for proc in self._procs.values():
+            for _uid, proc in self._procs.values():
                 if proc.poll() is None:
                     proc.kill()
             self._procs.clear()

@@ -40,3 +40,11 @@ def devices8():
     devs = jax.devices()
     assert len(devs) >= 8, f"expected 8 virtual devices, got {len(devs)}"
     return devs
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process e2e tests (gang worlds, real subprocesses); "
+        "run explicitly or via the full suite",
+    )

@@ -1,0 +1,75 @@
+"""Worker payload for the multi-process gang e2e test.
+
+What a real JAXJob training container does (the launcher contract,
+reference tf-cnn/launcher.py:59-93): join the jax.distributed world from
+JAXJOB_* env, build a process-spanning mesh, train with checkpointing,
+exit 0. Run by LocalPodExecutor as an actual subprocess.
+
+Env knobs (set by the test through the pod spec / env_hook):
+  GANG_CKPT_DIR     shared orbax checkpoint dir
+  GANG_TOTAL_STEPS  global step target
+  GANG_STEP_DELAY_S per-step sleep so the test can kill a worker mid-run
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# sitecustomize may have pre-registered a TPU backend; force cpu the same
+# way tests/conftest.py does.
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.parallel.dist import initialize_from_env  # noqa: E402
+
+
+def main() -> int:
+    dist = initialize_from_env()
+    assert jax.device_count() == dist.num_processes, \
+        (jax.device_count(), dist.num_processes)
+
+    import time
+
+    from kubeflow_tpu.parallel.mesh import MeshSpec
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    delay = float(os.environ.get("GANG_STEP_DELAY_S", "0"))
+    cfg = TrainConfig.from_dict(dict(
+        model="transformer-test",
+        task="lm",
+        global_batch=2 * dist.num_processes,
+        seq_len=16,
+        vocab_size=64,
+        mesh=MeshSpec(data=dist.num_processes),
+        optimizer="adamw",
+        learning_rate=1e-3,
+        total_steps=int(os.environ["GANG_TOTAL_STEPS"]),
+        warmup_steps=1,
+        checkpoint_dir=os.environ["GANG_CKPT_DIR"],
+        checkpoint_every=1,
+        log_every=10**9,
+    ))
+    trainer = Trainer(cfg)
+    cb = (lambda i, m: time.sleep(delay)) if delay else None
+    state, summary = trainer.fit(callback=cb)
+    line = json.dumps({"rank": dist.process_id,
+                       "start_step": summary["start_step"],
+                       "final_step": int(state.step),
+                       "loss": summary["final"].get("loss")})
+    print(line, flush=True)
+    # Also append to a shared log so the test can assert per-run
+    # start_steps (stdout is swallowed by the executor on success).
+    log_path = os.environ.get("GANG_LOG")
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
