@@ -32,6 +32,11 @@ class BertConfig:
     max_seq_len: int = 512
     num_classes: int = 2          # classification head size
     dtype: Any = jnp.bfloat16
+    # "reference" | "ring": ring routes bidirectional attention through
+    # ops.ring_attention over the mesh's seq axis (the BERT long-context
+    # SP path). Ring ignores the padding mask, so it requires full-length
+    # (unpadded) sequences — the long-context pretraining regime.
+    attention_impl: str = "reference"
 
 
 class EncoderBlock(nn.Module):
@@ -51,7 +56,12 @@ class EncoderBlock(nn.Module):
             kernel_init=part(init, (AXIS_FSDP, None, AXIS_MODEL, None)), name="qkv",
         )(y)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        att = reference_attention(q, k, v, causal=False, segment_ids=mask)
+        if cfg.attention_impl == "ring":
+            from kubeflow_tpu.ops.ring_attention import ring_attention
+
+            att = ring_attention(q, k, v, causal=False)
+        else:
+            att = reference_attention(q, k, v, causal=False, segment_ids=mask)
         att = nn.DenseGeneral(
             cfg.d_model, axis=(-2, -1), use_bias=False, dtype=cfg.dtype,
             kernel_init=part(init, (AXIS_MODEL, None, AXIS_FSDP)), name="o",
@@ -90,14 +100,30 @@ class BertEncoder(nn.Module):
         L = tokens.shape[1]
         x = jnp.asarray(emb, cfg.dtype)[tokens] + jnp.asarray(pos_emb[:L], cfg.dtype)
         x = shard(x, HIDDEN_SPEC)
-        # attention mask from padding (token 0 = [PAD]); segment ids 1/0
-        mask = (tokens != 0).astype(jnp.int32)
+        # attention mask from padding (token 0 = [PAD]); segment ids 1/0.
+        # The ring SP path attends over everything (no padding in the
+        # long-context pretraining regime), so no mask is materialized.
+        mask = None if cfg.attention_impl == "ring" \
+            else (tokens != 0).astype(jnp.int32)
         for i in range(cfg.n_layers):
             x = EncoderBlock(cfg, name=f"layer_{i}")(x, mask)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         # [CLS] pooling (position 0) → classifier, f32
         cls = x[:, 0].astype(jnp.float32)
         return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(cls)
+
+    def flops_per_token(self, seq_len: int | None = None) -> float:
+        """Train FLOPs per token (2*MAC convention, 6*N + bidirectional
+        attention term — same accounting as TransformerLM, unhalved
+        because there is no causal mask)."""
+        cfg = self.cfg
+        per_layer = 4 * cfg.d_model * cfg.d_model + 2 * cfg.d_model * cfg.d_ff
+        # no embedding term: BERT only gathers from the table (no output
+        # -vocab matmul), so it contributes no matmul FLOPs
+        flops = 6.0 * cfg.n_layers * per_layer
+        if seq_len:
+            flops += 12.0 * cfg.n_layers * cfg.d_model * seq_len
+        return flops
 
 
 def _build(**kw) -> BertEncoder:

@@ -154,6 +154,63 @@ def resnet101(**kw) -> ResNet:
     return ResNet(stage_sizes=[3, 4, 23, 3], block_cls=BottleneckBlock, **kw)
 
 
-# FLOPs per image at 224x224, fwd only (standard literature numbers);
-# used by the MFU meter. Train step ≈ 3x (fwd + 2x bwd).
+# FLOPs per image at 224x224, fwd only (standard literature number);
+# kept as the sanity anchor for fwd_flops() below.
 RESNET50_FWD_FLOPS_224 = 4.1e9
+
+_STAGES = {
+    "resnet18": ([2, 2, 2, 2], "basic"),
+    "resnet50": ([3, 4, 6, 3], "bottleneck"),
+    "resnet101": ([3, 4, 23, 3], "bottleneck"),
+}
+
+
+def fwd_flops(model: str, image_size: int = 224, num_classes: int = 1000,
+              num_filters: int = 64, stem: str = "conv7") -> float:
+    """Analytic forward FLOPs per image (2*MACs, convs + head dense —
+    the literature convention; BN/relu/pool excluded).
+
+    Replaces the hardcoded per-model ratio table the MFU meter used; the
+    number is derived from the actual architecture, so resnet18/101 and
+    non-224 image sizes are exact rather than scaled guesses.
+    """
+    if model not in _STAGES:
+        raise ValueError(f"unknown resnet variant {model!r}")
+    stage_sizes, kind = _STAGES[model]
+
+    flops = 0.0
+
+    def conv(h, w, cin, cout, k, stride=1):
+        nonlocal flops
+        ho, wo = -(-h // stride), -(-w // stride)   # SAME padding
+        flops += 2.0 * ho * wo * k * k * cin * cout
+        return ho, wo
+
+    h = w = image_size
+    if stem == "space_to_depth":
+        # image -> [H/2, W/2, 12], then 4x4/s1 conv (same output shape
+        # as conv7/s2: the MLPerf stem trades a wider contraction for
+        # slightly more FLOPs)
+        h, w = h // 2, w // 2
+        h, w = conv(h, w, 12, num_filters, 4, 1)
+    else:
+        h, w = conv(h, w, 3, num_filters, 7, 2)
+    h, w = -(-h // 2), -(-w // 2)                   # 3x3/s2 maxpool
+    cin = num_filters
+    for i, n_blocks in enumerate(stage_sizes):
+        f = num_filters * 2 ** i
+        out_ch = f * 4 if kind == "bottleneck" else f
+        for j in range(n_blocks):
+            stride = 2 if i > 0 and j == 0 else 1
+            if kind == "bottleneck":                # v1.5: stride on 3x3
+                conv(h, w, cin, f, 1)
+                h, w = conv(h, w, f, f, 3, stride)
+                conv(h, w, f, out_ch, 1)
+            else:
+                h, w = conv(h, w, cin, f, 3, stride)
+                conv(h, w, f, f, 3)
+            if cin != out_ch or stride != 1:        # projection shortcut
+                flops += 2.0 * h * w * cin * out_ch
+            cin = out_ch
+    flops += 2.0 * cin * num_classes                # head dense
+    return flops

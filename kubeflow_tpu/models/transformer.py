@@ -326,14 +326,24 @@ class TransformerLM(nn.Module):
         )(x.astype(jnp.float32))
         return logits
 
-    def flops_per_token(self) -> float:
-        """6*N approximation using dense param count."""
+    def flops_per_token(self, seq_len: int | None = None) -> float:
+        """Train FLOPs per token: 6*N over the dense params, plus the
+        attention score/value matmuls when seq_len is given — per token
+        per layer that's 12*h*d_head*T (QK^T + PV, fwd+bwd), halved for
+        causal masking (the PaLM-appendix accounting)."""
         cfg = self.cfg
         attn = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
-        mlp = 3 * cfg.d_model * cfg.d_ff
-        per_layer = attn + mlp
+        mlp = 3 * cfg.d_model * cfg.d_ff          # SwiGLU: gate+up+down
+        n_moe = (cfg.n_layers // cfg.moe_every) if cfg.moe_every else 0
+        n_dense = cfg.n_layers - n_moe
+        # MoE layer: top_k expert MLPs execute per token, plus the router
+        moe = cfg.expert_top_k * mlp + cfg.d_model * cfg.n_experts
         emb = cfg.vocab_size * cfg.d_model
-        return 6.0 * (cfg.n_layers * per_layer + 2 * emb)
+        flops = 6.0 * (cfg.n_layers * attn + n_dense * mlp + n_moe * moe
+                       + 2 * emb)
+        if seq_len:
+            flops += 12.0 * cfg.n_layers * cfg.n_heads * cfg.head_dim * seq_len / 2
+        return flops
 
 
 def _build(name: str, **overrides):

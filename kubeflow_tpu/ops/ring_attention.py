@@ -38,7 +38,7 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def _block_attn(q, k, v, row_ids, col_ids, scale):
+def _block_attn(q, k, v, row_ids, col_ids, scale, causal):
     """One block pair: returns (unnormalized out, row max, row sum)."""
     h = q.shape[2]
     if k.shape[2] != h:
@@ -46,13 +46,15 @@ def _block_attn(q, k, v, row_ids, col_ids, scale):
         v = jnp.repeat(v, h // v.shape[2], axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
-    mask = row_ids[:, None] >= col_ids[None, :]  # causal, global indices
-    logits = jnp.where(mask[None, None], logits, NEG_INF)
+    if causal:
+        mask = row_ids[:, None] >= col_ids[None, :]  # causal, global indices
+        logits = jnp.where(mask[None, None], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                       # [b,h,q]
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(logits - m_safe[..., None])
-    p = jnp.where(mask[None, None], p, 0.0)
+    if causal:
+        p = jnp.where(mask[None, None], p, 0.0)
     l = jnp.sum(p, axis=-1)                            # [b,h,q]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                    preferred_element_type=jnp.float32)
@@ -68,18 +70,18 @@ def ring_attention(
     mesh: Mesh | None = None,
     causal: bool = True,
 ) -> jax.Array:
-    """Causal attention over seq-sharded [B, L, H, D] arrays.
+    """Exact attention over seq-sharded [B, L, H, D] arrays.
 
+    ``causal=False`` gives the bidirectional (BERT-style) long-context
+    path: same ring rotation and streaming softmax, no block masking.
     Falls back to single-block reference attention when the mesh has no
     `seq` axis (so the same model code runs on any mesh spec).
     """
-    if not causal:
-        raise NotImplementedError("ring attention is causal-only for now")
     mesh = mesh or _current_mesh()
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         from kubeflow_tpu.ops.attention import reference_attention
 
-        return reference_attention(q, k, v, causal=True)
+        return reference_attention(q, k, v, causal=causal)
 
     n_ring = mesh.shape[axis_name]
     scale = q.shape[-1] ** -0.5
@@ -117,7 +119,8 @@ def ring_attention(
         def accumulate(o, m, l, k_cur, v_cur, i):
             src = (seq_idx - i) % n_ring           # owner of current K/V block
             col_ids = src * l_block + jnp.arange(k_cur.shape[1])
-            o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids, scale)
+            o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids,
+                                        scale, causal)
             m_new = jnp.maximum(m, m_i)
             alpha = jnp.exp(m - m_new)             # rescale old accumulator
             beta = jnp.exp(m_i - m_new)

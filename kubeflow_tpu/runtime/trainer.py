@@ -316,17 +316,27 @@ class Trainer:
             return self._eval_step(state, batch)
 
     def flops_per_step(self) -> float:
-        """Analytic train-step FLOPs (fwd*3) for the MFU meter."""
+        """Analytic train-step FLOPs for the MFU meter.
+
+        Convention: multiply and add count separately (2*MACs), matching
+        peak_flops' spec-sheet convention — feeding MAC counts (the
+        fvcore/"4.1 GFLOPs resnet50" number) into a 2*MAC peak silently
+        halves MFU. Train = 3x fwd (dgrad + wgrad each ~ fwd).
+        """
         cfg = self.cfg
         if cfg.model.startswith("resnet"):
-            from kubeflow_tpu.models.resnet import RESNET50_FWD_FLOPS_224
+            from kubeflow_tpu.models.resnet import fwd_flops
 
-            scale = {"resnet18": 1.8e9 / 4.1e9, "resnet50": 1.0, "resnet101": 7.6e9 / 4.1e9}.get(
-                cfg.model, 1.0
-            )
-            per_image = RESNET50_FWD_FLOPS_224 * scale * (cfg.image_size / 224) ** 2
+            per_image = fwd_flops(
+                cfg.model, image_size=cfg.image_size,
+                num_classes=cfg.num_classes,
+                num_filters=cfg.model_kwargs.get("num_filters", 64),
+                stem=cfg.model_kwargs.get("stem", "conv7"))
             return 3.0 * per_image * cfg.global_batch
-        # transformer: 6 * N_params * tokens
+        if hasattr(self.model, "flops_per_token"):
+            per_token = self.model.flops_per_token(seq_len=cfg.seq_len)
+            return per_token * cfg.global_batch * cfg.seq_len
+        # fallback: dense 6*N per token
         return 6.0 * self.n_params * cfg.global_batch * cfg.seq_len
 
     def fit(self, steps: int | None = None, state: TrainState | None = None,
