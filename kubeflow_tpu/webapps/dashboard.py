@@ -19,7 +19,9 @@ endpoints (:314).
 
 from __future__ import annotations
 
+import json
 import logging
+import re
 from typing import Protocol
 
 from kubeflow_tpu.control.k8s import objects as ob
@@ -31,6 +33,8 @@ from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
 log = logging.getLogger("kubeflow_tpu.dashboard")
 
 USER_HEADER = "kubeflow-userid"
+# api_workgroup.ts EMAIL_RGX: contributor identities must look like email
+EMAIL_RGX = re.compile(r"^[^\s@]+@[^\s@]+\.[^\s@]+$")
 
 
 class MetricsService(Protocol):
@@ -158,6 +162,38 @@ class Dashboard:
                 contributors.append(annos[PT.ANNO_USER])
         return {"contributors": sorted(set(contributors))}
 
+    def _contributor_action(self, req: HttpReq, action: str):
+        """add/remove-contributor (api_workgroup.ts:189-235): validate,
+        proxy to KFAM's binding API with the caller's identity, return
+        the refreshed contributor list."""
+        ns = req.params["namespace"]
+        self._user(req)
+        body = req.json() or {}
+        contributor = body.get("contributor")
+        if not contributor:
+            raise ApiHttpError(400, "missing contributor field")
+        if not EMAIL_RGX.match(contributor):
+            raise ApiHttpError(
+                400, "contributor doesn't look like a valid email address")
+        binding = json.dumps({
+            "user": {"kind": "User", "name": contributor},
+            "referredNamespace": ns,
+            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+        }).encode()
+        proxied = HttpReq(method="POST", path="", params={}, query={},
+                          headers=dict(req.headers), body=binding)
+        if action == "create":
+            self.kfam.create_binding(proxied)
+        else:
+            self.kfam.delete_binding(proxied)
+        return self.get_contributors(req)
+
+    def add_contributor(self, req: HttpReq):
+        return self._contributor_action(req, "create")
+
+    def remove_contributor(self, req: HttpReq):
+        return self._contributor_action(req, "delete")
+
     def nuke_self(self, req: HttpReq):
         """Delete every profile the user owns (:324)."""
         user = self._user(req)
@@ -194,6 +230,10 @@ class Dashboard:
         r.route("GET", "/api/workgroup/get-all-namespaces", self.get_all_namespaces)
         r.route("GET", "/api/workgroup/get-contributors/{namespace}",
                 self.get_contributors)
+        r.route("POST", "/api/workgroup/add-contributor/{namespace}",
+                self.add_contributor)
+        r.route("DELETE", "/api/workgroup/remove-contributor/{namespace}",
+                self.remove_contributor)
         r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)

@@ -1,13 +1,19 @@
 """Central-dashboard frontend: the browser UI over the dashboard API.
 
-The reference ships a Polymer 3 SPA (centraldashboard/public/components/
-dashboard-view.js, namespace-selector.js, notebooks-card.js,
-resource-chart.js, manage-users-view.js, registration-page.js) behind an
-Express server. Here the same views are one dependency-free page served
-by the dashboard backend itself: namespace selector, registration flow
-(workgroup exists/create), activity feed, contributor management and a
-resource chart, all driven by the `/api/workgroup/*`, `/api/activities`
-and `/api/metrics` endpoints (webapps/dashboard.py).
+The reference ships a Polymer 3 SPA (centraldashboard/public/components/:
+dashboard-view.js, namespace-selector.js, resource-chart.js,
+manage-users-view.js, registration-page.js) behind an Express server.
+Here the same views are one dependency-free page served by the dashboard
+backend itself:
+
+- registration-page.js -> a multi-step walkthrough (welcome -> choose a
+  RFC-1123-validated namespace -> confirm -> provisioning -> done)
+- manage-users-view.js -> the Contributors view: list, add (email
+  -validated), remove — wired to /api/workgroup/{add,remove}-contributor
+- resource-chart.js -> tabbed bar charts over /api/metrics/{type}
+  (tpu-chips / node-cpu / node-memory)
+- dashboard-view.js activity feed -> /api/activities/{ns} with event
+  -type badges and auto-refresh
 """
 
 from __future__ import annotations
@@ -27,6 +33,8 @@ PAGE = """<!doctype html>
   select, button, input { font-size: 14px; padding: 6px 10px;
                           border-radius: 4px; border: 1px solid #ccc; }
   button { background: #fff; cursor: pointer; }
+  button.primary { background: #1a73e8; color: #fff; border-color: #1a73e8; }
+  button:disabled { opacity: .5; cursor: default; }
   main { display: grid; grid-template-columns: 1fr 1fr; gap: 16px;
          padding: 20px; max-width: 1100px; margin: auto; }
   .card { background: #fff; border-radius: 8px; padding: 16px;
@@ -34,9 +42,26 @@ PAGE = """<!doctype html>
   .card h2 { margin: 0 0 10px; font-size: 15px; color: #333; }
   ul { margin: 0; padding-left: 18px; }
   li { margin: 3px 0; font-size: 13px; }
-  #register { grid-column: 1 / -1; display: none; }
   .muted { color: #777; font-size: 12px; }
-  svg { width: 100%; height: 120px; }
+  .error { color: #c5221f; font-size: 12px; }
+  svg { width: 100%; height: 140px; }
+  .badge { display: inline-block; border-radius: 3px; padding: 0 6px;
+           font-size: 11px; color: #fff; background: #5f6368; }
+  .badge.Warning { background: #e37400; }
+  .contrib { display: flex; align-items: center; gap: 8px; margin: 4px 0;
+             font-size: 13px; }
+  .contrib button { font-size: 11px; padding: 2px 8px; }
+  .tabs { display: flex; gap: 6px; margin-bottom: 8px; }
+  .tabs button.active { background: #e8f0fe; border-color: #1a73e8;
+                        color: #1a73e8; }
+  /* registration walkthrough (registration-page.js analogue) */
+  #register { grid-column: 1 / -1; display: none; }
+  .step { display: none; }
+  .step.active { display: block; }
+  .stepdots { margin-bottom: 12px; }
+  .stepdots span { display: inline-block; width: 10px; height: 10px;
+                   border-radius: 50%; background: #dadce0; margin-right: 6px; }
+  .stepdots span.done { background: #1a73e8; }
 </style>
 </head>
 <body>
@@ -47,11 +72,40 @@ PAGE = """<!doctype html>
 </header>
 <main>
   <div class="card" id="register">
-    <h2>Welcome — create your workspace</h2>
-    <p class="muted">No namespace is registered for your account yet.</p>
-    <input id="reg-ns" placeholder="namespace name">
-    <button id="reg-btn">Create namespace</button>
-    <p id="reg-msg" class="muted"></p>
+    <div class="stepdots" id="dots"></div>
+    <div class="step" data-step="0">
+      <h2>Welcome to Kubeflow on TPU</h2>
+      <p class="muted">Your account has no workspace yet. This short
+        walkthrough provisions a namespace with service accounts, RBAC
+        and a TPU resource quota.</p>
+      <button class="primary" id="reg-start">Start setup</button>
+    </div>
+    <div class="step" data-step="1">
+      <h2>Name your namespace</h2>
+      <input id="reg-ns" placeholder="e.g. team-ml" autocomplete="off">
+      <p class="error" id="reg-err"></p>
+      <p class="muted">Lowercase letters, digits and dashes; must start
+        and end alphanumeric (RFC 1123).</p>
+      <button id="reg-back1">Back</button>
+      <button class="primary" id="reg-next" disabled>Next</button>
+    </div>
+    <div class="step" data-step="2">
+      <h2>Confirm</h2>
+      <p>Namespace <b id="reg-confirm-name"></b> will be created and owned
+        by <b id="reg-confirm-user"></b>.</p>
+      <button id="reg-back2">Back</button>
+      <button class="primary" id="reg-create">Create workspace</button>
+    </div>
+    <div class="step" data-step="3">
+      <h2>Provisioning…</h2>
+      <p class="muted" id="reg-msg">Creating profile and waiting for the
+        controller…</p>
+    </div>
+    <div class="step" data-step="4">
+      <h2>All set 🎉</h2>
+      <p class="muted">Your workspace is ready.</p>
+      <button class="primary" onclick="location.reload()">Open dashboard</button>
+    </div>
   </div>
   <div class="card">
     <h2>Activity</h2>
@@ -59,12 +113,23 @@ PAGE = """<!doctype html>
   </div>
   <div class="card">
     <h2>Contributors</h2>
-    <ul id="contributors"></ul>
-    <p class="muted">Managed via the access-management (KFAM) API.</p>
+    <div id="contributors"></div>
+    <div class="contrib">
+      <input id="contrib-email" placeholder="teammate@example.com">
+      <button class="primary" id="contrib-add">Add</button>
+    </div>
+    <p class="error" id="contrib-err"></p>
+    <p class="muted">Contributors get the kubeflow-edit role via the
+      access-management (KFAM) API.</p>
   </div>
   <div class="card">
-    <h2>Cluster TPU utilization</h2>
-    <svg id="chart" viewBox="0 0 300 100" preserveAspectRatio="none"></svg>
+    <h2>Cluster resources</h2>
+    <div class="tabs" id="metric-tabs">
+      <button data-m="tpu-chips" class="active">TPU chips</button>
+      <button data-m="node-cpu">CPU</button>
+      <button data-m="node-memory">Memory</button>
+    </div>
+    <svg id="chart" viewBox="0 0 300 110" preserveAspectRatio="none"></svg>
     <p class="muted" id="chart-note"></p>
   </div>
   <div class="card">
@@ -74,8 +139,50 @@ PAGE = """<!doctype html>
 </main>
 <script>
 const $ = (id) => document.getElementById(id);
-const api = (p) => fetch(p).then(r => { if (!r.ok) throw r; return r.json(); });
+const api = (p, opt) => fetch(p, opt).then(async r => {
+  if (!r.ok) throw new Error((await r.json().catch(() => ({}))).error || r.status);
+  return r.json();
+});
+const NS_RGX = /^[a-z0-9]([-a-z0-9]*[a-z0-9])?$/;
+let currentNs = null;
 
+/* ---- registration walkthrough ---- */
+let regStep = 0;
+function showStep(i) {
+  regStep = i;
+  document.querySelectorAll('#register .step').forEach(s =>
+    s.classList.toggle('active', Number(s.dataset.step) === i));
+  $('dots').innerHTML = [0,1,2,3,4].map(j =>
+    `<span class="${j <= i ? 'done' : ''}"></span>`).join('');
+}
+$('reg-start').addEventListener('click', () => showStep(1));
+$('reg-back1').addEventListener('click', () => showStep(0));
+$('reg-back2').addEventListener('click', () => showStep(1));
+$('reg-ns').addEventListener('input', () => {
+  const v = $('reg-ns').value.trim();
+  const ok = NS_RGX.test(v) && v.length <= 63;
+  $('reg-err').textContent = v && !ok ? 'invalid namespace name' : '';
+  $('reg-next').disabled = !ok;
+});
+$('reg-next').addEventListener('click', () => {
+  $('reg-confirm-name').textContent = $('reg-ns').value.trim();
+  $('reg-confirm-user').textContent = $('user').textContent;
+  showStep(2);
+});
+$('reg-create').addEventListener('click', async () => {
+  showStep(3);
+  try {
+    await api('/api/workgroup/create', {
+      method: 'POST', headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({namespace: $('reg-ns').value.trim()}),
+    });
+    showStep(4);
+  } catch (e) {
+    $('reg-msg').textContent = 'failed: ' + e.message;
+  }
+});
+
+/* ---- env + namespace selector ---- */
 async function loadEnv() {
   const info = await api('/api/workgroup/env-info');
   $('user').textContent = info.user || '';
@@ -95,65 +202,136 @@ async function loadEnv() {
   }
   if (!(info.namespaces || []).length) {
     $('register').style.display = 'block';
+    showStep(0);
   } else {
-    await loadNamespace(sel.value);
+    currentNs = sel.value;
+    await loadNamespace(currentNs);
   }
 }
 
-async function loadNamespace(ns) {
+/* ---- activity feed ---- */
+async function loadActivities(ns) {
   const acts = await api('/api/activities/' + ns).catch(() => ({events: []}));
   const ul = $('activities');
   ul.innerHTML = '';
   for (const a of (acts.events || []).slice(0, 12)) {
+    // DOM-built rows: event fields are namespace-contributor data and
+    // must never be interpolated into HTML (stored-XSS vector)
     const li = document.createElement('li');
-    li.textContent = (a.lastTimestamp || '') + ' ' + (a.reason || '') + ': ' + (a.message || '');
+    const badge = document.createElement('span');
+    badge.className = 'badge' + (a.type === 'Warning' ? ' Warning' : '');
+    badge.textContent = a.reason || 'Event';
+    const ts = document.createElement('span');
+    ts.className = 'muted';
+    ts.textContent = ' ' + (a.lastTimestamp || '');
+    li.appendChild(badge);
+    li.appendChild(document.createTextNode(' ' + (a.message || '')));
+    li.appendChild(ts);
     ul.appendChild(li);
   }
   if (!ul.children.length) ul.innerHTML = '<li class="muted">no events</li>';
-  const contribs = await api('/api/workgroup/get-contributors/' + ns)
-    .catch(() => ({contributors: []}));
-  const cl = $('contributors');
-  cl.innerHTML = '';
-  for (const c of contribs.contributors || []) {
-    const li = document.createElement('li');
-    li.textContent = typeof c === 'string' ? c : (c.user + ' (' + c.role + ')');
-    cl.appendChild(li);
+}
+
+/* ---- contributors (manage-users-view.js analogue) ---- */
+function renderContributors(list) {
+  const box = $('contributors');
+  box.innerHTML = '';
+  for (const c of list) {
+    const email = typeof c === 'string' ? c : c.user;
+    const row = document.createElement('div');
+    row.className = 'contrib';
+    const rm = document.createElement('button');
+    rm.textContent = 'Remove';
+    rm.addEventListener('click', async () => {
+      $('contrib-err').textContent = '';
+      try {
+        const out = await api('/api/workgroup/remove-contributor/' + currentNs, {
+          method: 'DELETE', headers: {'Content-Type': 'application/json'},
+          body: JSON.stringify({contributor: email}),
+        });
+        renderContributors(out.contributors || []);
+      } catch (e) { $('contrib-err').textContent = e.message; }
+    });
+    const label = document.createElement('span');
+    label.textContent = email;
+    row.appendChild(label);
+    row.appendChild(rm);
+    box.appendChild(row);
   }
-  if (!cl.children.length) cl.innerHTML = '<li class="muted">owner only</li>';
+  if (!list.length) box.innerHTML = '<p class="muted">owner only</p>';
 }
-
-async function loadChart() {
+async function loadContributors(ns) {
+  const out = await api('/api/workgroup/get-contributors/' + ns)
+    .catch(() => ({contributors: []}));
+  renderContributors(out.contributors || []);
+}
+$('contrib-add').addEventListener('click', async () => {
+  $('contrib-err').textContent = '';
   try {
-    const m = await api('/api/metrics/tpu-chips');
-    const pts = (m.values || []).map(p =>
-      (typeof p === 'object' ? Number(p.chips ?? p.value ?? 0) : Number(p)));
-    if (!pts.length) { $('chart-note').textContent = 'no samples'; return; }
-    const max = Math.max(...pts, 1);
-    const step = 300 / Math.max(pts.length - 1, 1);
-    const d = pts.map((v, i) =>
-      (i ? 'L' : 'M') + (i * step).toFixed(1) + ',' +
-      (100 - v / max * 90).toFixed(1)).join(' ');
-    $('chart').innerHTML =
-      '<path d="' + d + '" fill="none" stroke="#1a73e8" stroke-width="2"/>';
-    $('chart-note').textContent = m.note || '';
-  } catch (e) { $('chart-note').textContent = 'metrics unavailable'; }
-}
-
-$('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
-$('reg-btn').addEventListener('click', async () => {
-  const ns = $('reg-ns').value.trim();
-  if (!ns) return;
-  const r = await fetch('/api/workgroup/create', {
-    method: 'POST',
-    headers: {'Content-Type': 'application/json'},
-    body: JSON.stringify({namespace: ns}),
-  });
-  $('reg-msg').textContent = r.ok ? 'created — reloading…' : 'failed: ' + r.status;
-  if (r.ok) setTimeout(() => location.reload(), 800);
+    const out = await api('/api/workgroup/add-contributor/' + currentNs, {
+      method: 'POST', headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({contributor: $('contrib-email').value.trim()}),
+    });
+    $('contrib-email').value = '';
+    renderContributors(out.contributors || []);
+  } catch (e) { $('contrib-err').textContent = e.message; }
 });
 
+async function loadNamespace(ns) {
+  currentNs = ns;
+  await Promise.all([loadActivities(ns), loadContributors(ns)]);
+}
+
+/* ---- resource charts (resource-chart.js analogue) ---- */
+let metric = 'tpu-chips';
+const QTY_SUFFIX = {Ki: 2**10, Mi: 2**20, Gi: 2**30, Ti: 2**40,
+                    k: 1e3, M: 1e6, G: 1e9, T: 1e12, m: 1e-3};
+function parseQty(v) {
+  // Kubernetes quantity strings: "16", "16Gi", "3977500Ki", "500m"
+  if (typeof v === 'number') return v;
+  const m = /^([0-9.]+)\\s*([A-Za-z]*)$/.exec(String(v || ''));
+  if (!m) return 0;
+  return Number(m[1]) * (QTY_SUFFIX[m[2]] || 1);
+}
+async function loadChart() {
+  try {
+    const m = await api('/api/metrics/' + metric);
+    const rows = (m.values || []).map(v => ({
+      label: v.node || '',
+      value: parseQty(v.chips ?? v.capacity ?? v.value ?? 0),
+      extra: v.accelerator || '',
+    }));
+    const svg = $('chart');
+    if (!rows.length) {
+      svg.innerHTML = '';
+      $('chart-note').textContent = 'no nodes report this resource';
+      return;
+    }
+    const max = Math.max(...rows.map(r => r.value), 1);
+    const bw = 300 / rows.length;
+    svg.innerHTML = rows.map((r, i) => {
+      const h = r.value / max * 90;
+      return `<rect x="${(i * bw + 2).toFixed(1)}" y="${(100 - h).toFixed(1)}"` +
+        ` width="${(bw - 4).toFixed(1)}" height="${h.toFixed(1)}"` +
+        ` fill="#1a73e8"><title>${r.label}: ${r.value}</title></rect>`;
+    }).join('');
+    $('chart-note').textContent = rows.map(r =>
+      r.label + '=' + r.value + (r.extra ? ' (' + r.extra + ')' : '')).join('  ');
+  } catch (e) { $('chart-note').textContent = 'metrics unavailable'; }
+}
+$('metric-tabs').addEventListener('click', (e) => {
+  if (e.target.dataset.m) {
+    metric = e.target.dataset.m;
+    document.querySelectorAll('#metric-tabs button').forEach(b =>
+      b.classList.toggle('active', b === e.target));
+    loadChart();
+  }
+});
+
+$('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
 loadEnv().catch(e => { $('user').textContent = 'not signed in'; });
 loadChart();
+setInterval(() => { if (currentNs) loadActivities(currentNs); }, 15000);
 </script>
 </body>
 </html>

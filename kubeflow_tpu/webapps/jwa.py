@@ -87,8 +87,14 @@ def notebook_from_form(namespace: str, form: dict) -> dict:
             {"name": "workspace", "mountPath": ws.get("mountPath", NT.HOME_DIR)}]
         pod_spec["volumes"] = [
             {"name": "workspace", "persistentVolumeClaim": {"claimName": claim}}]
+    # Form labels go on the CR *and* the pod template: PodDefault
+    # "configurations" match pod labels (filter_poddefaults), so a label
+    # only on the Notebook metadata would make the feature a silent no-op.
+    pod_labels = (nb["spec"]["template"].setdefault("metadata", {})
+                  .setdefault("labels", {}))
     for k, v in (form.get("labels") or {}).items():
         ob.set_label(nb, k, v)
+        pod_labels[k] = v
     return nb
 
 
@@ -147,7 +153,11 @@ class JupyterWebApp:
         items = self.client.list("kubeflow.org/v1alpha1", "PodDefault", namespace=ns)
         return {"poddefaults": [
             {"name": ob.meta(p)["name"],
-             "desc": (p.get("spec") or {}).get("desc", ob.meta(p)["name"])}
+             "desc": (p.get("spec") or {}).get("desc", ob.meta(p)["name"]),
+             # the labels a pod needs to match this PodDefault's selector —
+             # the spawner's "configurations" control applies them
+             "matchLabels": (((p.get("spec") or {}).get("selector") or {})
+                             .get("matchLabels") or {})}
             for p in items]}
 
     def get_storageclasses(self, req: HttpReq):

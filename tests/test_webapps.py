@@ -125,7 +125,8 @@ class TestJwa:
         cluster.create(new_poddefault("tpu-access", "team-a", desc="Mount TPU libs"))
         pds = J(r.dispatch(mkreq("GET",
                                  "/api/namespaces/team-a/poddefaults")))["poddefaults"]
-        assert pds == [{"name": "tpu-access", "desc": "Mount TPU libs"}]
+        assert pds == [{"name": "tpu-access", "desc": "Mount TPU libs",
+                        "matchLabels": {}}]
 
 
 class TestDashboard:
@@ -231,3 +232,170 @@ def test_jwa_serves_spawner_ui(cluster):
     assert page.status == 200 and page.content_type == "text/html"
     assert b"/api/config" in page.body and b"TPU chips" in page.body
     assert r.dispatch(mkreq("GET", "/api/config")).status == 200
+
+
+class TestContributorManagement:
+    """add/remove-contributor (api_workgroup.ts:189-235,380-385)."""
+
+    @pytest.fixture()
+    def world(self, cluster):
+        kfam = KfamService(cluster, cluster_admin="root@example.com")
+        r = Dashboard(cluster, kfam=kfam).router()
+        # alice owns the namespace (KFAM authz checks profile ownership)
+        J(r.dispatch(mkreq("POST", "/api/workgroup/create",
+                           body={"namespace": "alice"})))
+        return cluster, r
+
+    def test_add_contributor_creates_binding_and_returns_list(self, world):
+        cluster, r = world
+        out = J(r.dispatch(mkreq(
+            "POST", "/api/workgroup/add-contributor/alice",
+            body={"contributor": "bob@example.com"})))
+        assert out["contributors"] == ["bob@example.com"]
+        rbs = cluster.list("rbac.authorization.k8s.io/v1", "RoleBinding",
+                           namespace="alice")
+        assert any(ob.annotations_of(rb).get(PT.ANNO_USER) == "bob@example.com"
+                   for rb in rbs)
+
+    def test_remove_contributor(self, world):
+        cluster, r = world
+        J(r.dispatch(mkreq("POST", "/api/workgroup/add-contributor/alice",
+                           body={"contributor": "bob@example.com"})))
+        out = J(r.dispatch(mkreq(
+            "DELETE", "/api/workgroup/remove-contributor/alice",
+            body={"contributor": "bob@example.com"})))
+        assert out["contributors"] == []
+
+    def test_invalid_email_rejected(self, world):
+        _, r = world
+        resp = r.dispatch(mkreq("POST", "/api/workgroup/add-contributor/alice",
+                                body={"contributor": "not-an-email"}))
+        assert resp.status == 400
+        resp = r.dispatch(mkreq("POST", "/api/workgroup/add-contributor/alice",
+                                body={}))
+        assert resp.status == 400
+
+    def test_non_owner_cannot_add(self, world):
+        _, r = world
+        resp = r.dispatch(mkreq("POST", "/api/workgroup/add-contributor/alice",
+                                user="mallory@example.com",
+                                body={"contributor": "bob@example.com"}))
+        assert resp.status == 403
+
+    def test_cluster_admin_can_manage_any_namespace(self, world):
+        _, r = world
+        out = J(r.dispatch(mkreq(
+            "POST", "/api/workgroup/add-contributor/alice",
+            user="root@example.com",
+            body={"contributor": "bob@example.com"})))
+        assert out["contributors"] == ["bob@example.com"]
+
+
+class TestDashboardUiDom:
+    """DOM-level assertions on the served SPA (the reference's Polymer
+    component tests' shape: registration-page, manage-users-view,
+    resource-chart are all present and wired)."""
+
+    @pytest.fixture()
+    def page(self, cluster):
+        r = Dashboard(cluster).router()
+        resp = r.dispatch(mkreq("GET", "/"))
+        assert resp.status == 200 and resp.content_type == "text/html"
+        return resp.body.decode()
+
+    def test_registration_walkthrough_steps(self, page):
+        # five steps, dots, RFC-1123 live validation, create wiring
+        for frag in ('data-step="0"', 'data-step="4"', 'id="dots"',
+                     "NS_RGX", "/api/workgroup/create"):
+            assert frag in page, frag
+
+    def test_manage_contributors_view(self, page):
+        for frag in ("add-contributor", "remove-contributor",
+                     'id="contrib-email"', 'id="contrib-add"'):
+            assert frag in page, frag
+
+    def test_resource_chart_tabs(self, page):
+        for frag in ('data-m="tpu-chips"', 'data-m="node-cpu"',
+                     'data-m="node-memory"', "/api/metrics/"):
+            assert frag in page, frag
+
+    def test_activity_feed_wiring(self, page):
+        assert "/api/activities/" in page
+        assert "badge" in page
+
+
+class TestJwaUiDom:
+    """DOM-level assertions on the spawner page: volume section,
+    configurations, stop/start controls all present and wired."""
+
+    @pytest.fixture()
+    def page(self, cluster):
+        r = JupyterWebApp(cluster).router()
+        resp = r.dispatch(mkreq("GET", "/spawner"))
+        assert resp.status == 200 and resp.content_type == "text/html"
+        return resp.body.decode()
+
+    def test_volume_section(self, page):
+        for frag in ('id="vol-mode"', 'id="pvcs"', "/pvcs",
+                     'id="vol-size"', 'id="vol-mount"'):
+            assert frag in page, frag
+
+    def test_configurations_section(self, page):
+        for frag in ('id="poddefaults"', "/poddefaults", "matchLabels"):
+            assert frag in page, frag
+
+    def test_stop_start_and_delete_controls(self, page):
+        assert "PATCH" in page and "stopped" in page
+        assert "DELETE" in page or "'delete'" in page
+
+    def test_poddefaults_expose_match_labels(self, cluster):
+        cluster.create(new_poddefault(
+            "add-secret", "team-a", selector={"matchLabels": {"use-secret": "true"}},
+            desc="Mount the team secret"))
+        r = JupyterWebApp(cluster).router()
+        out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/poddefaults")))
+        [pd] = out["poddefaults"]
+        assert pd["matchLabels"] == {"use-secret": "true"}
+
+
+def test_configuration_labels_reach_pod_template_and_webhook():
+    """End-to-end: spawner 'configurations' -> notebook labels -> STS pod
+    template -> PodDefault admission injection. Guards against the
+    labels-only-on-CR no-op failure mode."""
+    from kubeflow_tpu.control.notebook.controller import (
+        build_controller as build_nb_controller,
+    )
+    from kubeflow_tpu.control.poddefault import PodDefaultMutator
+    from kubeflow_tpu.control.runtime import seed_controller
+    from kubeflow_tpu.webapps.jwa import notebook_from_form
+
+    cluster = FakeCluster()
+    cluster.create(ob.new_object("v1", "Namespace", "team-a"))
+    pd = new_poddefault("tpu-libs", "team-a",
+                        selector={"matchLabels": {"tpu-libs": "true"}},
+                        desc="Mount libtpu")
+    pd["spec"]["env"] = [{"name": "TPU_LIBRARY_PATH", "value": "/lib/libtpu.so"}]
+    cluster.create(pd)
+    mutator = PodDefaultMutator(cluster)
+    cluster.add_admission_hook(mutator.admission_hook)
+
+    # what the spawner form submits when the configuration is checked
+    nb = notebook_from_form("team-a", {
+        "name": "my-nb", "labels": {"tpu-libs": "true"}})
+    # pod-template labels present (not just CR metadata)
+    assert nb["spec"]["template"]["metadata"]["labels"]["tpu-libs"] == "true"
+    cluster.create(nb)
+    ctl = seed_controller(build_nb_controller(cluster))
+    for _ in range(4):
+        ctl.run_until_idle(advance_delayed=True)
+    sts = cluster.get("apps/v1", "StatefulSet", "my-nb", "team-a")
+    tmpl = sts["spec"]["template"]
+    assert tmpl["metadata"]["labels"]["tpu-libs"] == "true"
+    # a pod created from that template gets the PodDefault injection
+    pod = ob.new_object("v1", "Pod", "my-nb-0", "team-a",
+                        labels=tmpl["metadata"]["labels"],
+                        spec=ob.deep_copy(tmpl["spec"]))
+    created = cluster.create(pod)
+    env = {e["name"]: e.get("value")
+           for e in created["spec"]["containers"][0].get("env", [])}
+    assert env.get("TPU_LIBRARY_PATH") == "/lib/libtpu.so"
