@@ -169,16 +169,29 @@ class Dashboard:
         ns = req.params["namespace"]
         self._user(req)
         body = req.json() or {}
+        if not isinstance(body, dict):
+            raise ApiHttpError(400, "request body must be a JSON object")
         contributor = body.get("contributor")
-        if not contributor:
+        if not contributor or not isinstance(contributor, str):
             raise ApiHttpError(400, "missing contributor field")
         if not EMAIL_RGX.match(contributor):
             raise ApiHttpError(
                 400, "contributor doesn't look like a valid email address")
+        role = "edit"
+        if action != "create":
+            # remove must target the binding's actual role (a contributor
+            # may hold kubeflow-view etc.), not assume edit
+            for rb in self.client.list("rbac.authorization.k8s.io/v1",
+                                       "RoleBinding", namespace=ns):
+                annos = ob.annotations_of(rb)
+                if annos.get(PT.ANNO_USER) == contributor \
+                        and annos.get(PT.ANNO_ROLE):
+                    role = annos[PT.ANNO_ROLE]
+                    break
         binding = json.dumps({
             "user": {"kind": "User", "name": contributor},
             "referredNamespace": ns,
-            "roleRef": {"kind": "ClusterRole", "name": "kubeflow-edit"},
+            "roleRef": {"kind": "ClusterRole", "name": f"kubeflow-{role}"},
         }).encode()
         proxied = HttpReq(method="POST", path="", params={}, query={},
                           headers=dict(req.headers), body=binding)

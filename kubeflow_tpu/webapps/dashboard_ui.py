@@ -100,6 +100,7 @@ PAGE = """<!doctype html>
       <h2>Provisioning…</h2>
       <p class="muted" id="reg-msg">Creating profile and waiting for the
         controller…</p>
+      <button id="reg-retry" style="display:none">Back</button>
     </div>
     <div class="step" data-step="4">
       <h2>All set 🎉</h2>
@@ -169,6 +170,11 @@ $('reg-next').addEventListener('click', () => {
   $('reg-confirm-user').textContent = $('user').textContent;
   showStep(2);
 });
+$('reg-retry').addEventListener('click', () => {
+  $('reg-retry').style.display = 'none';
+  $('reg-msg').textContent = 'Creating profile and waiting for the controller…';
+  showStep(1);
+});
 $('reg-create').addEventListener('click', async () => {
   showStep(3);
   try {
@@ -178,7 +184,9 @@ $('reg-create').addEventListener('click', async () => {
     });
     showStep(4);
   } catch (e) {
+    // dead-end guard: surface the error and offer a way back to step 1
     $('reg-msg').textContent = 'failed: ' + e.message;
+    $('reg-retry').style.display = '';
   }
 });
 

@@ -238,18 +238,24 @@ $('spawn').addEventListener('submit', async (e) => {
   const mode = $('vol-mode').value;
   if (mode === 'new') {
     // create the PVC first, then attach (reference post_pvc flow);
-    // abort on failure so the notebook never mounts a missing claim
+    // 409 = claim already exists from an earlier attempt -> reuse it,
+    // any other failure aborts so the notebook never mounts a missing
+    // claim
     const claim = 'workspace-' + form.name;
     const pr = await fetch('/api/namespaces/' + ns + '/pvcs', {
       method: 'POST', headers: {'Content-Type': 'application/json'},
       body: JSON.stringify({name: claim, size: $('vol-size').value}),
     });
-    if (!pr.ok) {
+    if (!pr.ok && pr.status !== 409) {
       $('msg').textContent = 'volume create failed: HTTP ' + pr.status;
       return;
     }
     form.workspaceVolume = {name: claim, mountPath: $('vol-mount').value};
   } else if (mode === 'existing') {
+    if (!$('pvcs').value) {
+      $('msg').textContent = 'no existing volume to attach in this namespace';
+      return;
+    }
     form.workspaceVolume = {name: $('pvcs').value,
                             mountPath: $('vol-mount').value};
   }

@@ -1,0 +1,90 @@
+"""Notebook image version matrix + contrib (reference:
+tensorflow-notebook-image/versions 30-variant layout + components/contrib)."""
+
+import os
+
+from kubeflow_tpu.release.image_matrix import (
+    CONTRIB_STACKS,
+    NOTEBOOK_JAX_VERSIONS,
+    all_images,
+    contrib_images,
+    notebook_matrix,
+    render_versions,
+)
+from kubeflow_tpu.release.releaser import (
+    IMAGES,
+    build_commands,
+    release_workflow,
+)
+
+
+class TestMatrix:
+    def test_every_version_gets_cpu_and_tpu_variants(self):
+        specs = notebook_matrix()
+        assert len(specs) == len(NOTEBOOK_JAX_VERSIONS) * 2
+        names = {s.name for s in specs}
+        for v in NOTEBOOK_JAX_VERSIONS:
+            assert f"jax-notebook-jax-{v}" in names       # cpu
+            assert f"jax-notebook-jax-{v}-tpu" in names   # tpu
+
+    def test_build_args_pin_version_and_variant(self):
+        [spec] = [s for s in notebook_matrix()
+                  if s.name == "jax-notebook-jax-0.7-tpu"]
+        [cmd] = build_commands(spec, "gcr.io/kf", "v1")
+        assert "--build-arg" in cmd
+        assert "JAX_VERSION=0.7" in cmd and "JAX_EXTRA=tpu" in cmd
+
+    def test_contrib_images_layer_extra_pip(self):
+        specs = contrib_images()
+        assert {s.name for s in specs} == {
+            "jax-notebook-" + n for n in CONTRIB_STACKS}
+        for s in specs:
+            args = dict(s.build_args)
+            assert args["EXTRA_PIP"] == CONTRIB_STACKS[
+                s.name.removeprefix("jax-notebook-")]
+
+    def test_all_images_includes_core_matrix_and_contrib(self):
+        every = all_images()
+        names = [s.name for s in every]
+        assert len(names) == len(set(names))  # no duplicate image names
+        for s in IMAGES:
+            assert s.name in names
+        assert len(every) == len(IMAGES) + len(notebook_matrix()) + \
+            len(contrib_images())
+
+    def test_release_workflow_builds_the_whole_matrix(self):
+        ran = []
+        wf = release_workflow("gcr.io/kf", "v1", images=all_images(),
+                              runner=lambda cmd: ran.append(cmd), push=False)
+        wf.run()
+        builds = [c for c in ran if c[:2] == ["docker", "build"]]
+        assert len(builds) == len(all_images())
+
+
+class TestRenderVersions(object):
+    def test_renders_pinned_stub_per_variant(self, tmp_path):
+        # copy the real parent Dockerfile into a scratch tree
+        src = os.path.join(os.path.dirname(__file__), "..", "images",
+                           "notebook", "Dockerfile")
+        d = tmp_path / "images" / "notebook"
+        d.mkdir(parents=True)
+        (d / "Dockerfile").write_text(open(src).read())
+        written = render_versions(str(tmp_path))
+        assert len(written) == len(NOTEBOOK_JAX_VERSIONS) * 2 + \
+            len(CONTRIB_STACKS)
+        pinned = (tmp_path / "images" / "notebook" / "versions" /
+                  "jax-0.6-tpu" / "Dockerfile").read_text()
+        assert "ARG JAX_VERSION=0.6" in pinned
+        assert "ARG JAX_EXTRA=tpu" in pinned
+        llm = (tmp_path / "images" / "notebook" / "versions" / "llm" /
+               "Dockerfile").read_text()
+        assert 'ARG EXTRA_PIP="transformers datasets sentencepiece"' in llm
+
+    def test_repo_tree_matrix_is_current(self):
+        """The committed versions/ tree matches the generator (like the
+        reference keeping versions/ in sync with its template)."""
+        root = os.path.join(os.path.dirname(__file__), "..")
+        vdir = os.path.join(root, "images", "notebook", "versions")
+        assert os.path.isdir(vdir), "run render_versions to materialize"
+        expected = len(NOTEBOOK_JAX_VERSIONS) * 2 + len(CONTRIB_STACKS)
+        assert len(os.listdir(vdir)) == expected

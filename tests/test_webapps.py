@@ -399,3 +399,39 @@ def test_configuration_labels_reach_pod_template_and_webhook():
     env = {e["name"]: e.get("value")
            for e in created["spec"]["containers"][0].get("env", [])}
     assert env.get("TPU_LIBRARY_PATH") == "/lib/libtpu.so"
+
+
+class TestContributorEdgeCases:
+    @pytest.fixture()
+    def world(self, cluster):
+        kfam = KfamService(cluster, cluster_admin="root@example.com")
+        r = Dashboard(cluster, kfam=kfam).router()
+        J(r.dispatch(mkreq("POST", "/api/workgroup/create",
+                           body={"namespace": "alice"})))
+        return cluster, r
+
+    def test_non_string_contributor_is_400_not_500(self, world):
+        _, r = world
+        for bad in (123, True, ["x"],):
+            resp = r.dispatch(mkreq(
+                "POST", "/api/workgroup/add-contributor/alice",
+                body={"contributor": bad}))
+            assert resp.status == 400, bad
+        resp = r.dispatch(mkreq("POST", "/api/workgroup/add-contributor/alice",
+                                body=["not", "a", "dict"]))
+        assert resp.status == 400
+
+    def test_remove_uses_the_bindings_actual_role(self, world):
+        """A kubeflow-view contributor must be removable, not just edit."""
+        cluster, r = world
+        from kubeflow_tpu.control.kfam.service import binding_name
+        rb = ob.new_object(
+            "rbac.authorization.k8s.io/v1", "RoleBinding",
+            binding_name("carol@example.com", "view"), "alice",
+            annotations={PT.ANNO_USER: "carol@example.com",
+                         PT.ANNO_ROLE: "view"})
+        cluster.create(rb)
+        out = J(r.dispatch(mkreq(
+            "DELETE", "/api/workgroup/remove-contributor/alice",
+            body={"contributor": "carol@example.com"})))
+        assert out["contributors"] == []
