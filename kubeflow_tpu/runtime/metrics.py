@@ -27,12 +27,33 @@ PEAK_FLOPS = {
 }
 _DEFAULT_PEAK = 197e12
 
+# Peak HBM bandwidth per chip (bytes/s), same doc tables (v4: 1.2TB/s,
+# v5e: 819GB/s, v5p: 2.77TB/s, v6e: 1.64TB/s). Drives the roofline
+# fields bench.py reports next to MFU.
+PEAK_HBM_BW = {
+    "TPU v4": 1.2e12,
+    "TPU v5 lite": 819e9,
+    "TPU v5": 2.77e12,
+    "TPU v5p": 2.77e12,
+    "TPU v6 lite": 1.64e12,
+    "TPU v6e": 1.64e12,
+}
+_DEFAULT_BW = 819e9
 
-def peak_flops(device_kind: str) -> float:
-    for prefix, val in sorted(PEAK_FLOPS.items(), key=lambda kv: -len(kv[0])):
+
+def _lookup(table: dict, device_kind: str, default: float) -> float:
+    for prefix, val in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if device_kind.startswith(prefix):
             return val
-    return _DEFAULT_PEAK
+    return default
+
+
+def peak_flops(device_kind: str) -> float:
+    return _lookup(PEAK_FLOPS, device_kind, _DEFAULT_PEAK)
+
+
+def peak_hbm_bw(device_kind: str) -> float:
+    return _lookup(PEAK_HBM_BW, device_kind, _DEFAULT_BW)
 
 
 class StepMeter:
