@@ -71,11 +71,41 @@ class _Worker:
 
 
 class TpctlServer:
-    def __init__(self, client, ttl_s: float = DEFAULT_TTL_S):
+    def __init__(self, client, ttl_s: float = DEFAULT_TTL_S,
+                 crm_backend=None):
         self.client = client
         self.ttl_s = ttl_s
         self.workers: dict[str, _Worker] = {}
         self._lock = threading.Lock()
+        # Cloud-credential validity gate (kfctlServer.go:519/:545): when a
+        # cloudauth.CrmBackend is provided, cloud-platform deployments
+        # must carry a bearer token that grants setIamPolicy on the
+        # project, and the per-project RefreshableTokenSource is kept
+        # fresh for later platform calls.
+        self.crm = crm_backend
+        self._token_sources: dict[str, object] = {}
+
+    def _check_cloud_access(self, req: HttpReq, cfg: TpuDef) -> None:
+        if self.crm is None or cfg.platform == "existing":
+            return
+        from kubeflow_tpu.tpctl import cloudauth
+
+        if not cfg.project:
+            raise ApiHttpError(400, "cloud platform deployments require "
+                               "spec.platform.project")
+        auth = req.header("authorization") or ""
+        token = auth.split(" ", 1)[1] if auth.lower().startswith("bearer ") else ""
+        if not token:
+            raise ApiHttpError(401, "cloud platform deployments require a "
+                               "bearer token")
+        ts = self._token_sources.get(cfg.project)
+        if ts is None:
+            ts = cloudauth.RefreshableTokenSource(cfg.project, self.crm)
+            self._token_sources[cfg.project] = ts
+        try:
+            ts.refresh(token)  # validates via CheckProjectAccess
+        except (PermissionError, ValueError) as e:
+            raise ApiHttpError(403, str(e))
 
     # -- endpoints ----------------------------------------------------------
 
@@ -87,6 +117,7 @@ class TpctlServer:
             cfg = TpuDef.from_dict(body)
         except (ValueError, TypeError) as e:  # malformed JSON / bad TpuDef
             raise ApiHttpError(400, f"invalid TpuDef: {e}")
+        self._check_cloud_access(req, cfg)
         with self._lock:
             w = self.workers.get(cfg.name)
             if w is None:

@@ -192,3 +192,54 @@ class TestBindRole:
         members = {m for b in crm.policies["p"]["bindings"]
                    for m in b["members"]}
         assert len(members) == 8
+
+
+class TestTpctlCloudGate:
+    """The kfctlServer.go:519/:545 validity gate wired into tpctl create."""
+
+    def _req(self, platform="gke-tpu", project="proj-1", token="good"):
+        import json as _json
+
+        from kubeflow_tpu.utils.httpd import HttpReq
+        body = {"metadata": {"name": "d1"},
+                "spec": {"platform": {"kind": platform, "project": project,
+                                      "zone": "us-central2-b"}}}
+        headers = {"authorization": f"Bearer {token}"} if token else {}
+        return HttpReq(method="POST", path="/tpctl/apps/v1/create", params={},
+                       query={}, headers=headers,
+                       body=_json.dumps(body).encode())
+
+    def _server(self, crm):
+        from kubeflow_tpu.control.k8s.fake import FakeCluster
+        from kubeflow_tpu.tpctl.server import TpctlServer
+        return TpctlServer(FakeCluster(), crm_backend=crm)
+
+    def test_existing_platform_needs_no_token(self):
+        srv = self._server(FakeCrm())
+        resp = srv.router().dispatch(self._req(platform="existing", token=None))
+        assert resp.status == 200
+
+    def test_cloud_platform_without_token_is_401(self):
+        srv = self._server(FakeCrm())
+        assert srv.router().dispatch(self._req(token=None)).status == 401
+
+    def test_insufficient_token_is_403(self):
+        srv = self._server(FakeCrm(valid_tokens=("other",)))
+        assert srv.router().dispatch(self._req(token="bad")).status == 403
+
+    def test_valid_token_enqueues_and_caches_source(self):
+        crm = FakeCrm()
+        srv = self._server(crm)
+        resp = srv.router().dispatch(self._req())
+        assert resp.status == 200
+        assert srv._token_sources["proj-1"].token() == "good"
+
+    def test_missing_project_is_400(self):
+        srv = self._server(FakeCrm())
+        assert srv.router().dispatch(self._req(project="")).status == 400
+
+    def test_no_backend_means_no_gate(self):
+        from kubeflow_tpu.control.k8s.fake import FakeCluster
+        from kubeflow_tpu.tpctl.server import TpctlServer
+        srv = TpctlServer(FakeCluster())
+        assert srv.router().dispatch(self._req(token=None)).status == 200
