@@ -75,6 +75,14 @@ def main(argv: list[str] | None = None) -> int:
     sps.add_argument("--mode", default="router", choices=("router", "worker"))
     sps.add_argument("--dry-run", action="store_true")
     sps.add_argument("--server", default="")
+    sps.add_argument("--cloud-auth-gate", action="store_true",
+                     help="require a bearer token with setIamPolicy on the "
+                          "target project for cloud-platform deployments "
+                          "(validated against cloudresourcemanager)")
+    sps.add_argument("--crm-endpoint",
+                     default="https://cloudresourcemanager.googleapis.com/v1",
+                     help="cloudresourcemanager endpoint (private-access "
+                          "VPCs / tests)")
 
     spe = sub.add_parser("example", help="print an example TpuDef")
 
@@ -87,7 +95,12 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "server":
         from kubeflow_tpu.tpctl.server import TpctlServer
 
-        srv = TpctlServer(_client(args))
+        crm = None
+        if args.cloud_auth_gate:
+            from kubeflow_tpu.tpctl.cloudauth import HttpCrmBackend
+
+            crm = HttpCrmBackend(endpoint=args.crm_endpoint)
+        srv = TpctlServer(_client(args), crm_backend=crm)
         svc = srv.serve(port=args.port)
         print(f"tpctl server listening on :{svc.port}")
         try:

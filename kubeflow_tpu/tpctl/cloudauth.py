@@ -60,7 +60,10 @@ def check_project_access(
 
     Retries transient backend errors with exponential backoff
     (gcpUtils.go:150-155: 2s initial, 5s cap, 1min budget). A clean
-    "permission not granted" answer returns False immediately.
+    "permission not granted" answer returns False immediately; an
+    exhausted retry budget re-raises the last backend error — a CRM
+    outage is not a credentials verdict (the reference's CheckProjectAccess
+    likewise returns (false, err), and callers branch on err).
     """
     deadline = max_elapsed
     interval = initial_interval
@@ -72,7 +75,7 @@ def check_project_access(
             return SET_IAM_POLICY_PERMISSION in granted
         except Exception:
             if elapsed + interval > deadline:
-                return False
+                raise
             sleep(interval)
             elapsed += interval
             interval = min(interval * 2, max_interval)
@@ -159,6 +162,47 @@ def update_policy(current_policy: dict, iam_bindings: list[dict],
     out = dict(current_policy)
     out["bindings"] = new_bindings
     return out
+
+
+class HttpCrmBackend:
+    """cloudresourcemanager REST backend (stdlib urllib, no SDK).
+
+    The production CrmBackend: POSTs testIamPermissions /
+    getIamPolicy / setIamPolicy with the caller's bearer token. The
+    endpoint is overridable for hermetic tests and private-access VPCs.
+    """
+
+    DEFAULT_ENDPOINT = "https://cloudresourcemanager.googleapis.com/v1"
+
+    def __init__(self, endpoint: str = DEFAULT_ENDPOINT, timeout: float = 15.0):
+        self.endpoint = endpoint.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path: str, token: str, payload: dict) -> dict:
+        import json
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.endpoint}/{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json",
+                     "Authorization": f"Bearer {token}"},
+        )
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read() or b"{}")
+
+    def test_iam_permissions(self, project: str, token: str,
+                             permissions: list[str]) -> list[str]:
+        out = self._post(f"projects/{project}:testIamPermissions", token,
+                         {"permissions": permissions})
+        return out.get("permissions", [])
+
+    def get_iam_policy(self, project: str, token: str) -> dict:
+        return self._post(f"projects/{project}:getIamPolicy", token, {})
+
+    def set_iam_policy(self, project: str, token: str, policy: dict) -> None:
+        self._post(f"projects/{project}:setIamPolicy", token,
+                   {"policy": policy})
 
 
 class ProjectLocks:

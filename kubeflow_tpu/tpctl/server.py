@@ -71,12 +71,18 @@ class _Worker:
 
 
 class TpctlServer:
+    # Request-path access checks get a short retry budget: a create
+    # handler must not pin a server thread for the offline-job default
+    # of 60s (cloudauth.check_project_access) during a CRM outage.
+    ACCESS_CHECK_BUDGET_S = 8.0
+
     def __init__(self, client, ttl_s: float = DEFAULT_TTL_S,
-                 crm_backend=None):
+                 crm_backend=None, coordinator_factory=None):
         self.client = client
         self.ttl_s = ttl_s
         self.workers: dict[str, _Worker] = {}
         self._lock = threading.Lock()
+        self._coordinator = coordinator_factory or (lambda: Coordinator(self.client))
         # Cloud-credential validity gate (kfctlServer.go:519/:545): when a
         # cloudauth.CrmBackend is provided, cloud-platform deployments
         # must carry a bearer token that grants setIamPolicy on the
@@ -88,6 +94,8 @@ class TpctlServer:
     def _check_cloud_access(self, req: HttpReq, cfg: TpuDef) -> None:
         if self.crm is None or cfg.platform == "existing":
             return
+        import functools
+
         from kubeflow_tpu.tpctl import cloudauth
 
         if not cfg.project:
@@ -98,14 +106,21 @@ class TpctlServer:
         if not token:
             raise ApiHttpError(401, "cloud platform deployments require a "
                                "bearer token")
-        ts = self._token_sources.get(cfg.project)
-        if ts is None:
-            ts = cloudauth.RefreshableTokenSource(cfg.project, self.crm)
-            self._token_sources[cfg.project] = ts
+        checker = functools.partial(cloudauth.check_project_access,
+                                    max_elapsed=self.ACCESS_CHECK_BUDGET_S)
+        with self._lock:
+            ts = self._token_sources.get(cfg.project)
+            if ts is None:
+                ts = cloudauth.RefreshableTokenSource(
+                    cfg.project, self.crm, checker=checker)
+                self._token_sources[cfg.project] = ts
         try:
             ts.refresh(token)  # validates via CheckProjectAccess
         except (PermissionError, ValueError) as e:
             raise ApiHttpError(403, str(e))
+        except Exception as e:  # CRM outage is not a credentials verdict
+            raise ApiHttpError(
+                503, f"cloud access check unavailable: {e}")
 
     # -- endpoints ----------------------------------------------------------
 
@@ -121,7 +136,7 @@ class TpctlServer:
         with self._lock:
             w = self.workers.get(cfg.name)
             if w is None:
-                w = self.workers[cfg.name] = _Worker(cfg.name, Coordinator(self.client))
+                w = self.workers[cfg.name] = _Worker(cfg.name, self._coordinator())
             w.submit(cfg)
         return 200, {"name": cfg.name, "status": "enqueued"}
 
@@ -134,7 +149,7 @@ class TpctlServer:
             w = self.workers.get(name)
             if w:
                 w.last_request = time.monotonic()
-        obj = Coordinator(self.client).status(name)
+        obj = self._coordinator().status(name)
         if obj is None and (w is None or w.error is None):
             raise ApiHttpError(404, f"deployment {name} not found")
         return {

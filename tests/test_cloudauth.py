@@ -60,11 +60,13 @@ class TestCheckProjectAccess:
         assert crm.calls == 3
         assert sleeps == [2.0, 4.0]
 
-    def test_backoff_budget_exhausted(self):
+    def test_backoff_budget_exhausted_raises(self):
+        # an exhausted budget re-raises the backend error: a CRM outage
+        # must not read as a credentials verdict
         crm = FakeCrm(fail_times=1000)
         sleeps = []
-        assert check_project_access("p", "good", crm,
-                                    sleep=sleeps.append) is False
+        with pytest.raises(ConnectionError):
+            check_project_access("p", "good", crm, sleep=sleeps.append)
         assert sum(sleeps) <= 60.0
 
 
@@ -211,8 +213,14 @@ class TestTpctlCloudGate:
 
     def _server(self, crm):
         from kubeflow_tpu.control.k8s.fake import FakeCluster
+        from kubeflow_tpu.tpctl.apply import Coordinator, ExistingCluster
         from kubeflow_tpu.tpctl.server import TpctlServer
-        return TpctlServer(FakeCluster(), crm_backend=crm)
+        cluster = FakeCluster()
+        # stub platform provider: gate tests must never shell out to a
+        # real gcloud (GkeTpuPlatform.apply would)
+        factory = lambda: Coordinator(cluster, provider=ExistingCluster())
+        return TpctlServer(cluster, crm_backend=crm,
+                           coordinator_factory=factory)
 
     def test_existing_platform_needs_no_token(self):
         srv = self._server(FakeCrm())
@@ -239,7 +247,15 @@ class TestTpctlCloudGate:
         assert srv.router().dispatch(self._req(project="")).status == 400
 
     def test_no_backend_means_no_gate(self):
-        from kubeflow_tpu.control.k8s.fake import FakeCluster
-        from kubeflow_tpu.tpctl.server import TpctlServer
-        srv = TpctlServer(FakeCluster())
+        srv = self._server(None)
         assert srv.router().dispatch(self._req(token=None)).status == 200
+
+    def test_crm_outage_is_503_not_403(self):
+        srv = self._server(FakeCrm(fail_times=1000))
+        srv_cls = type(srv)
+        old = srv_cls.ACCESS_CHECK_BUDGET_S
+        srv_cls.ACCESS_CHECK_BUDGET_S = 0.0  # no sleeping in tests
+        try:
+            assert srv.router().dispatch(self._req()).status == 503
+        finally:
+            srv_cls.ACCESS_CHECK_BUDGET_S = old
