@@ -51,20 +51,71 @@ def image_ref(spec: ImageSpec, registry: str, tag: str) -> str:
     return f"{registry}/{spec.name}:{tag}"
 
 
+def _build_args(spec: ImageSpec, tags: list[str],
+                cache_from: str | None = None) -> list[str]:
+    """Shared docker-build argv assembly (build_commands +
+    cloudbuild_manifest must never diverge)."""
+    args = ["build"]
+    for t in tags:
+        args += ["-t", t]
+    args += ["-f", spec.dockerfile]
+    if cache_from:
+        args += ["--cache-from", cache_from]
+    for k, v in spec.build_args:
+        args += ["--build-arg", f"{k}={v}"]
+    args.append(spec.context)
+    return args
+
+
 def build_commands(spec: ImageSpec, registry: str, tag: str,
                    tool: str = "docker") -> list[list[str]]:
     """The build command line(s) for one image (push is separate)."""
-    ref = image_ref(spec, registry, tag)
-    cmd = [tool, "build", "-t", ref, "-f", spec.dockerfile]
-    for k, v in spec.build_args:
-        cmd += ["--build-arg", f"{k}={v}"]
-    cmd.append(spec.context)
-    return [cmd]
+    return [[tool] + _build_args(spec, [image_ref(spec, registry, tag)])]
 
 
 def push_commands(spec: ImageSpec, registry: str, tag: str,
                   tool: str = "docker") -> list[list[str]]:
     return [[tool, "push", image_ref(spec, registry, tag)]]
+
+
+def cloudbuild_manifest(
+    images: tuple[ImageSpec, ...],
+    registry: str,
+    tag: str,
+    *,
+    use_image_cache: bool = False,
+    latest_tag: str = "latest",
+) -> dict:
+    """Cloud Build config for the image set — tools/gcb/template.libsonnet
+    rebuilt as data. Per image: optional cache pull (waitFor: ['-'] so
+    pulls start immediately, subGraphTemplate's pullStep), a build step
+    (--cache-from when caching), and a push list via `images`.
+    """
+    steps = []
+    out_images = []
+    for spec in images:
+        ref = image_ref(spec, registry, tag)
+        latest = image_ref(spec, registry, latest_tag)
+        out_images += [ref, latest]
+        if use_image_cache:
+            steps.append({
+                "id": f"pull-{spec.name}",
+                "name": "gcr.io/cloud-builders/docker",
+                "entrypoint": "bash",  # tolerate a missing cache image
+                "args": ["-c", f"docker pull {latest} || exit 0"],
+                "waitFor": ["-"],
+            })
+        steps.append({
+            "id": f"build-{spec.name}",
+            "name": "gcr.io/cloud-builders/docker",
+            "args": _build_args(spec, [ref, latest],
+                                cache_from=latest if use_image_cache else None),
+            # a step with no waitFor waits for ALL previous steps; images
+            # are independent, so builds must parallelize in both modes
+            "waitFor": [f"pull-{spec.name}"] if use_image_cache else ["-"],
+        })
+    return {"steps": steps, "images": out_images,
+            "timeout": "3600s"}
 
 
 def git_tag(repo_dir: str = ".") -> str:

@@ -33,6 +33,12 @@ SET_IAM_POLICY_PERMISSION = "resourcemanager.projects.setIamPolicy"
 IAM_ADMIN_ROLE = "roles/owner"  # ksServer IAM_ADMIN_ROLE analogue
 
 
+def is_auth_rejection(e: Exception) -> bool:
+    """True when a backend error is a definitive credentials verdict
+    (HTTP 401/403 — e.g. urllib.error.HTTPError.code), not an outage."""
+    return getattr(e, "code", None) in (401, 403)
+
+
 class CrmBackend(Protocol):
     """The cloudresourcemanager slice the tpctl plane needs."""
 
@@ -55,29 +61,33 @@ def check_project_access(
     initial_interval: float = 2.0,
     max_interval: float = 5.0,
     sleep: Callable[[float], None] = time.sleep,
+    clock: Callable[[], float] = time.monotonic,
 ) -> bool:
     """True when the token holds setIamPolicy on the project.
 
     Retries transient backend errors with exponential backoff
     (gcpUtils.go:150-155: 2s initial, 5s cap, 1min budget). A clean
-    "permission not granted" answer returns False immediately; an
-    exhausted retry budget re-raises the last backend error — a CRM
-    outage is not a credentials verdict (the reference's CheckProjectAccess
-    likewise returns (false, err), and callers branch on err).
+    "permission not granted" answer — including a definitive HTTP
+    401/403 from the backend — returns False immediately; an exhausted
+    retry budget re-raises the last backend error, because a CRM outage
+    is not a credentials verdict (the reference's CheckProjectAccess
+    likewise returns (false, err), and callers branch on err). The
+    budget is wall-clock (backend call time counts), so callers' thread
+    -pinning bounds hold even when the backend hangs to its timeout.
     """
-    deadline = max_elapsed
+    start = clock()
     interval = initial_interval
-    elapsed = 0.0
     while True:
         try:
             granted = backend.test_iam_permissions(
                 project, token, [SET_IAM_POLICY_PERMISSION])
             return SET_IAM_POLICY_PERMISSION in granted
-        except Exception:
-            if elapsed + interval > deadline:
+        except Exception as e:
+            if is_auth_rejection(e):
+                return False  # 401/403 IS the verdict, not an outage
+            if clock() - start + interval > max_elapsed:
                 raise
             sleep(interval)
-            elapsed += interval
             interval = min(interval * 2, max_interval)
 
 

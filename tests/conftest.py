@@ -29,6 +29,19 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# Persistent XLA compilation cache: many tests build Trainers over the
+# same tiny models, and each new jit closure recompiles identical HLO.
+# The disk cache turns those (and repeat suite runs) into ~ms loads.
+# Pure speedup: never a hard dependency (read-only HOME just skips it).
+try:
+    _cache_dir = os.environ.get("KFTPU_TEST_JAX_CACHE",
+                                os.path.expanduser("~/.cache/kftpu-test-jax"))
+    os.makedirs(_cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except OSError:
+    pass
 
 import pytest  # noqa: E402
 

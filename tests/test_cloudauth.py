@@ -61,13 +61,34 @@ class TestCheckProjectAccess:
         assert sleeps == [2.0, 4.0]
 
     def test_backoff_budget_exhausted_raises(self):
-        # an exhausted budget re-raises the backend error: a CRM outage
-        # must not read as a credentials verdict
+        # an exhausted WALL-CLOCK budget re-raises the backend error: a
+        # CRM outage must not read as a credentials verdict, and slow
+        # backend calls count against the budget (thread-pinning bound)
         crm = FakeCrm(fail_times=1000)
-        sleeps = []
+        now = [0.0]
+        def clock():
+            now[0] += 20.0  # each backend call burns 20s of wall clock
+            return now[0]
+        calls = []
         with pytest.raises(ConnectionError):
-            check_project_access("p", "good", crm, sleep=sleeps.append)
-        assert sum(sleeps) <= 60.0
+            check_project_access("p", "good", crm, sleep=calls.append,
+                                 clock=clock)
+        assert crm.calls - 1 <= 4  # budget exhausts after a few calls
+
+    def test_auth_rejection_is_a_verdict_not_an_outage(self):
+        # HTTP 401/403 from the backend -> immediate False, no retries
+        class DenyCrm:
+            def __init__(self):
+                self.calls = 0
+            def test_iam_permissions(self, project, token, permissions):
+                self.calls += 1
+                err = ConnectionError("401 unauthorized")
+                err.code = 401
+                raise err
+        crm = DenyCrm()
+        assert check_project_access("p", "tok", crm,
+                                    sleep=lambda s: None) is False
+        assert crm.calls == 1
 
 
 class TestRefreshableTokenSource:

@@ -1,0 +1,120 @@
+"""Ops tooling bucket (reference: tools/gcb/template.libsonnet,
+scripts/gke/iam_patch.py)."""
+
+import pytest
+
+from kubeflow_tpu.release.releaser import IMAGES, cloudbuild_manifest
+from kubeflow_tpu.tpctl.iam_patch import load_bindings, patch_iam_policy
+
+
+class FlakyCrm:
+    """set_iam_policy fails `fail` times (concurrent-editor conflicts)."""
+
+    def __init__(self, fail: int = 0):
+        self.fail = fail
+        self.policy = {"bindings": [], "etag": "e0"}
+        self.sets = 0
+
+    def test_iam_permissions(self, project, token, permissions):
+        return list(permissions)
+
+    def get_iam_policy(self, project, token):
+        import copy
+        return copy.deepcopy(self.policy)
+
+    def set_iam_policy(self, project, token, policy):
+        self.sets += 1
+        if self.fail > 0:
+            self.fail -= 1
+            raise ConnectionError("409 concurrent policy change")
+        self.policy = policy
+
+
+BINDINGS = [{"members": ["set-kubeflow-iap-account"],
+             "roles": ["roles/iap.httpsResourceAccessor"]}]
+
+
+class TestIamPatch:
+    def test_auth_rejection_not_retried(self):
+        class Denied(FlakyCrm):
+            def set_iam_policy(self, project, token, policy):
+                err = ConnectionError("403 forbidden")
+                err.code = 403
+                raise err
+        with pytest.raises(ConnectionError):
+            patch_iam_policy("p", "tok", BINDINGS, Denied(), action="add",
+                             email="a@b.co", sleep=lambda s: None)
+
+    def test_zero_retries_rejected(self):
+        with pytest.raises(ValueError):
+            patch_iam_policy("p", "tok", BINDINGS, FlakyCrm(), retries=0,
+                             email="a@b.co")
+
+    def test_add_then_remove_roundtrip(self):
+        crm = FlakyCrm()
+        out = patch_iam_policy("p", "tok", BINDINGS, crm, action="add",
+                               email="a@b.co")
+        assert out["bindings"] == [{
+            "role": "roles/iap.httpsResourceAccessor",
+            "members": ["user:a@b.co"]}]
+        out = patch_iam_policy("p", "tok", BINDINGS, crm, action="remove",
+                               email="a@b.co")
+        assert out["bindings"] == []
+
+    def test_retries_on_set_conflict(self):
+        # iam_patch.py's retry loop: re-read + re-merge on conflict
+        crm = FlakyCrm(fail=2)
+        sleeps = []
+        patch_iam_policy("p", "tok", BINDINGS, crm, action="add",
+                         email="a@b.co", sleep=sleeps.append)
+        assert crm.sets == 3 and len(sleeps) == 2
+
+    def test_retries_exhausted_reraises(self):
+        crm = FlakyCrm(fail=99)
+        with pytest.raises(ConnectionError):
+            patch_iam_policy("p", "tok", BINDINGS, crm, action="add",
+                             email="a@b.co", retries=2, sleep=lambda s: None)
+
+    def test_invalid_action_rejected(self):
+        with pytest.raises(ValueError):
+            patch_iam_policy("p", "tok", BINDINGS, FlakyCrm(),
+                             action="replace")
+
+    def test_load_bindings(self, tmp_path):
+        f = tmp_path / "b.yaml"
+        f.write_text(
+            "bindings:\n"
+            "  - members: [user:x@y.co]\n"
+            "    roles: [roles/viewer]\n")
+        assert load_bindings(str(f)) == [
+            {"members": ["user:x@y.co"], "roles": ["roles/viewer"]}]
+        bad = tmp_path / "bad.yaml"
+        bad.write_text("nope: 1\n")
+        with pytest.raises(ValueError):
+            load_bindings(str(bad))
+
+
+class TestCloudBuildManifest:
+    def test_steps_and_images_per_spec(self):
+        doc = cloudbuild_manifest(IMAGES, "gcr.io/kf", "v1")
+        build_ids = [s["id"] for s in doc["steps"]]
+        assert build_ids == [f"build-{s.name}" for s in IMAGES]
+        # independent images parallelize: no step waits for all-previous
+        assert all(s["waitFor"] == ["-"] for s in doc["steps"])
+        assert f"gcr.io/kf/{IMAGES[0].name}:v1" in doc["images"]
+        assert f"gcr.io/kf/{IMAGES[0].name}:latest" in doc["images"]
+
+    def test_image_cache_adds_pull_steps(self):
+        # template.libsonnet pullStep: waitFor '-' so pulls parallelize
+        doc = cloudbuild_manifest(IMAGES[:1], "gcr.io/kf", "v1",
+                                  use_image_cache=True)
+        pull, build = doc["steps"]
+        assert pull["id"] == f"pull-{IMAGES[0].name}"
+        assert pull["waitFor"] == ["-"]
+        assert "--cache-from" in build["args"]
+        assert build["waitFor"] == [pull["id"]]
+
+    def test_build_args_propagate(self):
+        [nb] = [s for s in IMAGES if s.name == "jax-notebook-tpu"]
+        doc = cloudbuild_manifest((nb,), "gcr.io/kf", "v1")
+        assert "JAX_EXTRA=tpu" in doc["steps"][0]["args"][-2]  # --build-arg v
