@@ -61,3 +61,23 @@ def pytest_configure(config):
         "slow: multi-process e2e tests (gang worlds, real subprocesses); "
         "run explicitly or via the full suite",
     )
+
+
+def pytest_collection_modifyitems(config, items):
+    """Default tier: deselect `slow` tests — but never when the user
+    passed an explicit -m expression, or named the test's file directly
+    (pytest tests/test_gang_e2e.py must run its tests)."""
+    if config.option.markexpr:
+        return
+    explicit = {
+        os.path.abspath(a.split("::")[0])
+        for a in config.args
+        if a.split("::")[0].endswith(".py")
+    }
+    deselected = [
+        it for it in items
+        if "slow" in it.keywords and str(it.fspath) not in explicit
+    ]
+    if deselected:
+        config.hook.pytest_deselected(items=deselected)
+        items[:] = [it for it in items if it not in set(deselected)]

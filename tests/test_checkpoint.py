@@ -18,7 +18,9 @@ def resnet_cfg(tmp=None, **over):
         model="resnet18",
         task="classification",
         global_batch=8,
-        image_size=32,
+        # 16px: checkpoint semantics don't depend on conv cost, and the
+        # resnet steps dominate this file's wall time at 32px
+        image_size=16,
         num_classes=10,
         mesh=MeshSpec(data=8),
         total_steps=4,

@@ -66,8 +66,10 @@ def test_ring_gradients_flow(devices8):
     def loss_ref(q, k, v):
         return reference_attention(q, k, v, causal=True).sum()
 
-    g_ring = jax.grad(loss_ring)(q, k, v)
-    g_ref = jax.grad(loss_ref)(q, k, v)
+    # jit the grads: un-jitted execution compiles op-by-op and is the
+    # dominant cost of this test on the virtual mesh
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref))(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), atol=1e-4, rtol=1e-4)
 
 
@@ -123,8 +125,8 @@ def test_noncausal_ring_gradients(devices8):
     def loss_ref(q, k, v):
         return reference_attention(q, k, v, causal=False).sum()
 
-    g_ring = jax.grad(loss_ring)(q, k, v)
-    g_ref = jax.grad(loss_ref)(q, k, v)
+    g_ring = jax.jit(jax.grad(loss_ring))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref))(q, k, v)
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref),
                                atol=1e-4, rtol=1e-4)
 
