@@ -65,6 +65,7 @@ def load_config(path: str) -> dict:
 
 def run_builtin_trainer(cfg_dict: dict) -> int:
     from kubeflow_tpu.runtime import metrics as rt_metrics
+    from kubeflow_tpu.runtime.preemption import EX_TEMPFAIL, PreemptionNotice
     from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
 
     metrics_port = int(os.environ.get("JAXRT_METRICS_PORT", "9100"))
@@ -74,9 +75,12 @@ def run_builtin_trainer(cfg_dict: dict) -> int:
         log.warning("metrics port %d busy; metrics endpoint disabled", metrics_port)
     cfg = TrainConfig.from_dict(cfg_dict)
     trainer = Trainer(cfg)
-    _, summary = trainer.fit()
-    print(json.dumps({"summary": summary}))
-    return 0
+    # SIGTERM (pod eviction / TPU maintenance) => checkpoint + EX_TEMPFAIL
+    # so the JAXJob controller gang-restarts and resumes.
+    notice = PreemptionNotice().install()
+    _, summary = trainer.fit(stop=notice)
+    print(json.dumps({"summary": summary}), flush=True)
+    return EX_TEMPFAIL if summary.get("preempted") else 0
 
 
 def run_user_command(argv: list[str]) -> int:

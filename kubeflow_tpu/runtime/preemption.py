@@ -1,0 +1,61 @@
+"""Graceful TPU preemption/maintenance handling.
+
+SURVEY.md §5 lists slice preemption as a hard part with no reference
+precedent (the reference's failure story is per-replica restartPolicy).
+The TPU-native answer: when the platform warns a worker (SIGTERM from
+the kubelet on pod eviction; GKE sends it ahead of TPU maintenance),
+the trainer finishes the in-flight step, force-saves a checkpoint, and
+exits EX_TEMPFAIL — the JAXJob controller then gang-restarts the job,
+which resumes from that checkpoint instead of losing the interval since
+the last periodic save.
+
+Usage (wired by the launcher):
+    notice = PreemptionNotice().install()
+    state, summary = trainer.fit(stop=notice)
+    if summary.get("preempted"):
+        sys.exit(EX_TEMPFAIL)
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+
+log = logging.getLogger("kubeflow_tpu.preemption")
+
+# A preempted worker must NOT exit 0 (the controller would count it
+# Succeeded) nor look like a crash-only failure: EX_TEMPFAIL is the
+# conventional "transient, retry me" exit status.
+EX_TEMPFAIL = 75
+
+
+class PreemptionNotice:
+    """Callable flag set by SIGTERM (and available for tests/manual
+    triggering via .trigger())."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._prev_handler = None
+
+    def install(self, signum: int = signal.SIGTERM) -> "PreemptionNotice":
+        """Install the signal handler (main thread only — launcher entry).
+        Chains to any previously installed handler."""
+        prev = signal.getsignal(signum)
+
+        def handler(sig, frame):
+            log.warning("preemption notice (signal %d): will checkpoint "
+                        "and exit after the current step", sig)
+            self._event.set()
+            if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
+                prev(sig, frame)
+
+        self._prev_handler = prev
+        signal.signal(signum, handler)
+        return self
+
+    def trigger(self) -> None:
+        self._event.set()
+
+    def __call__(self) -> bool:
+        return self._event.is_set()

@@ -340,12 +340,19 @@ class Trainer:
         return 6.0 * self.n_params * cfg.global_batch * cfg.seq_len
 
     def fit(self, steps: int | None = None, state: TrainState | None = None,
-            callback: Callable[[int, dict], None] | None = None) -> tuple[TrainState, dict]:
+            callback: Callable[[int, dict], None] | None = None,
+            stop: Callable[[], bool] | None = None) -> tuple[TrainState, dict]:
         """Run the training loop; returns final state + summary metrics.
 
         `steps` is the global step target: on a gang restart with
         cfg.checkpoint_dir set, training resumes from the latest orbax
         checkpoint and runs only the remaining steps.
+
+        `stop` is polled once per step (e.g. runtime.preemption's
+        SIGTERM notice): when it returns True the loop force-saves a
+        checkpoint and returns early with summary["preempted"]=True, so
+        a gang restart resumes from the interrupted step instead of the
+        last periodic save.
         """
         cfg = self.cfg
         steps = steps or cfg.total_steps
@@ -392,6 +399,7 @@ class Trainer:
                             cfg.profile_steps)
 
         ok = False
+        preempted = False
         try:
             # Data construction inside the try: its failure modes (no
             # shards match the glob, native loader required but missing)
@@ -408,6 +416,16 @@ class Trainer:
             else:
                 data = self._device_iter(self.data_iter())
             for i in range(steps - start_step):
+                if stop is not None and stop():
+                    # preemption notice: persist progress and leave — the
+                    # gang restart resumes from exactly this step
+                    preempted = True
+                    if ckpt and int(state.step) != last_saved:
+                        if ckpt.save(int(state.step), state, force=True):
+                            last_saved = int(state.step)
+                    log.warning("preempted at step %d: checkpoint saved, "
+                                "exiting early", int(state.step))
+                    break
                 trace.step(start_step + i)
                 batch = next(data)
                 if i == 0:
@@ -468,4 +486,6 @@ class Trainer:
             "mfu": meter.mfu,
             "final": last,
         }
+        if preempted:
+            summary["preempted"] = True
         return state, summary

@@ -80,4 +80,5 @@ def pytest_collection_modifyitems(config, items):
     ]
     if deselected:
         config.hook.pytest_deselected(items=deselected)
-        items[:] = [it for it in items if it not in set(deselected)]
+        dropped = set(deselected)
+        items[:] = [it for it in items if it not in dropped]
