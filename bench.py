@@ -118,16 +118,18 @@ def run_lm(args, devs):
     kind = devs[0].device_kind
     cfg = TrainConfig.from_dict(dict(
         model=args.lm_model,
-        model_kwargs={"attention_impl": "flash", "max_seq_len": args.seq_len},
+        model_kwargs={"attention_impl": args.lm_attention,
+                      "max_seq_len": args.seq_len},
         task="lm",
         global_batch=args.lm_batch,
         seq_len=args.seq_len,
         vocab_size=32000,
         mesh=MeshSpec(data=len(devs)),
-        optimizer="adamw",
+        optimizer=args.lm_optimizer,
         learning_rate=3e-4,
         total_steps=args.steps,
         warmup_steps=5,
+        remat=args.lm_remat,
         log_every=10**9,
     ))
     trainer = Trainer(cfg)
@@ -145,12 +147,14 @@ def run_lm(args, devs):
     meter._times.append(dt)
     return {
         "model": args.lm_model,
-        "attention": "flash",
+        "attention": args.lm_attention,
         "tokens_per_sec": round(tokens / dt),
         "step_time_ms": round(dt * 1e3, 2),
         "seq_len": args.seq_len,
         "global_batch": args.lm_batch,
         "mfu": round(meter.mfu, 4),
+        "optimizer": args.lm_optimizer,
+        "remat": args.lm_remat,
         "n_params_m": round(trainer.n_params / 1e6, 1),
     }
 
@@ -171,6 +175,12 @@ def main() -> int:
                    choices=["resnet", "lm", "both"])
     p.add_argument("--lm-model", default="gpt-125m")
     p.add_argument("--lm-batch", type=int, default=8)
+    p.add_argument("--lm-attention", default="flash",
+                   choices=["flash", "reference"])
+    p.add_argument("--lm-optimizer", default="adamw",
+                   choices=["adamw", "adafactor", "sgdm"])
+    p.add_argument("--lm-remat", action="store_true",
+                   help="rematerialize the forward (fits larger models)")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--budget-s", type=float, default=1500.0,
                    help="wall-clock budget; the lm extra is skipped when "

@@ -186,14 +186,19 @@ class LocalPodExecutor:
 
     def kill_pod(self, name: str, namespace: str = "default",
                  sig: int | None = None) -> bool:
-        """SIGKILL the process backing a pod (fault injection for e2e
+        """Signal the process backing a pod (fault injection for e2e
         tests — the hermetic stand-in for a preempted TPU worker).
+        Default SIGKILL = hard node loss; sig=SIGTERM = the kubelet's
+        graceful-eviction notice ahead of TPU maintenance.
         Returns False when no live process backs that pod."""
         with self._lock:
             entry = self._procs.get((namespace, name))
             if entry is None or entry[1].poll() is not None:
                 return False
-            entry[1].kill()
+            if sig is None:
+                entry[1].kill()
+            else:
+                entry[1].send_signal(sig)
             return True
 
     def run_until_settled(self, timeout: float = 120.0, poll: float = 0.2) -> None:

@@ -373,6 +373,27 @@ def gpt_125m(**kw) -> TransformerLM:
     return _build("gpt-125m", **base)
 
 
+@register_model("gpt-350m")
+def gpt_350m(**kw) -> TransformerLM:
+    """GPT-3 Medium shape (d=1024, L=24). With the SwiGLU MLP this lands
+    ~430M actual params; the name tracks the family spec, flops_per_token
+    tracks the real architecture."""
+    base = dict(d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
+                head_dim=64, d_ff=4096)
+    base.update(kw)
+    return _build("gpt-350m", **base)
+
+
+@register_model("gpt-760m")
+def gpt_760m(**kw) -> TransformerLM:
+    """GPT-3 Large shape, head_dim kept at 64 (24 heads) so attention
+    matmuls tile the 128-lane MXU cleanly."""
+    base = dict(d_model=1536, n_layers=24, n_heads=24, n_kv_heads=24,
+                head_dim=64, d_ff=6144)
+    base.update(kw)
+    return _build("gpt-760m", **base)
+
+
 @register_model("llama-1b")
 def llama_1b(**kw) -> TransformerLM:
     base = dict(d_model=2048, n_layers=16, n_heads=32, n_kv_heads=8, head_dim=64, d_ff=8192)

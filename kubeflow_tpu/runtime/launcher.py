@@ -13,8 +13,10 @@ This launcher:
     openmpi sidecar's /proc/driver/nvidia/version poll, controller.py:73-90);
   - runs either a built-in trainer (--config JSON/YAML → TrainConfig) or a
     user command;
-  - exits 0/1 — gang restart semantics belong to the JAXJob controller,
-    not to a sleep loop in the pod.
+  - exits 0 on success, 1 on failure, and EX_TEMPFAIL (75) when a
+    SIGTERM preemption notice made the trainer checkpoint and leave
+    early — the JAXJob controller reads 75 as "gang-restart me, resume
+    from the checkpoint", not as a crash. No sleep loop in the pod.
 
 Usage:
     python -m kubeflow_tpu.runtime.launcher --config cfg.yaml

@@ -118,6 +118,16 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         )
     if cfg.optimizer == "adamw":
         return optax.adamw(sched, b1=0.9, b2=0.95, weight_decay=cfg.weight_decay)
+    if cfg.optimizer == "adafactor":
+        # The TPU-native memory-light optimizer (T5 lineage): second moment
+        # factored into row+col statistics, so optimizer state is ~0 bytes
+        # per param instead of 8 — what lets llama-1b-class models train on
+        # a single 16 GB v5e chip (BASELINE.md round-2 note).
+        return optax.adafactor(
+            learning_rate=sched,
+            multiply_by_parameter_scale=True,
+            weight_decay_rate=cfg.weight_decay or None,
+        )
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
@@ -339,6 +349,25 @@ class Trainer:
         # fallback: dense 6*N per token
         return 6.0 * self.n_params * cfg.global_batch * cfg.seq_len
 
+    @staticmethod
+    def _gang_agreed_stop(local_stop: Callable[[], bool]) -> Callable[[], bool]:
+        """Collective agreement on the stop flag. SIGTERM lands on gang
+        workers at different instants, but orbax saves of mesh-sharded
+        arrays are collective — every process must break at the SAME
+        step. Each poll all-gathers the local flag across processes (a
+        matched collective, since every worker polls once per step); any
+        worker's notice stops the whole gang at that step."""
+        from jax.experimental import multihost_utils
+
+        import numpy as np
+
+        def agreed() -> bool:
+            flags = multihost_utils.process_allgather(
+                np.asarray(bool(local_stop())))
+            return bool(np.any(flags))
+
+        return agreed
+
     def fit(self, steps: int | None = None, state: TrainState | None = None,
             callback: Callable[[int, dict], None] | None = None,
             stop: Callable[[], bool] | None = None) -> tuple[TrainState, dict]:
@@ -357,6 +386,8 @@ class Trainer:
         cfg = self.cfg
         steps = steps or cfg.total_steps
         state = state or self.init_state()
+        if stop is not None and jax.process_count() > 1:
+            stop = self._gang_agreed_stop(stop)
 
         ckpt = None
         if cfg.checkpoint_dir:
@@ -376,7 +407,7 @@ class Trainer:
             if ckpt:
                 ckpt.close()
             return state, {"steps": steps, "start_step": start_step,
-                           "step_time_s": float("nan"),
+                           "step_time_s": None,
                            "examples_per_sec": 0.0, "mfu": 0.0, "final": {}}
 
         data = None
@@ -420,8 +451,12 @@ class Trainer:
                     # preemption notice: persist progress and leave — the
                     # gang restart resumes from exactly this step
                     preempted = True
+                    # force=False: if this step already exists on disk
+                    # (resume=N then preempted again before N+1), keep it —
+                    # force's delete-then-save would open a window where
+                    # the only durable checkpoint is gone
                     if ckpt and int(state.step) != last_saved:
-                        if ckpt.save(int(state.step), state, force=True):
+                        if ckpt.save(int(state.step), state):
                             last_saved = int(state.step)
                     log.warning("preempted at step %d: checkpoint saved, "
                                 "exiting early", int(state.step))
@@ -469,22 +504,34 @@ class Trainer:
             if hasattr(data, "close"):
                 data.close()  # stop the prefetch thread
             if ckpt:
-                # Final save only on success (skip if the loop just saved
-                # this step); always close so queued async saves finish
-                # durably even when unwinding on an exception.
-                if ok and int(state.step) != last_saved:
+                # Final save only on a completed (not preempted) run: the
+                # stop branch already persisted the preempted step, and a
+                # force=True save here would reopen the delete-then-save
+                # window on the checkpoint it resumed from. Always close so
+                # queued async saves finish durably even when unwinding on
+                # an exception.
+                if ok and not preempted and int(state.step) != last_saved:
                     ckpt.save(int(state.step), state, force=True)
                 ckpt.close()
-        if meter.steps == 0:
+        import math as _math
+
+        if meter.steps == 0 and _math.isfinite(first_dt):
             # single-step run: only the compile step exists to report
             meter._times.append(first_dt)
+
+        def _finite(x: float):
+            # summary is json.dumps'ed by the launcher and parsed by
+            # controllers; bare NaN is not valid JSON, so a run preempted
+            # before any step completed reports null instead
+            return x if _math.isfinite(x) else None
+
         summary = {
             "steps": steps,
             "start_step": start_step,
-            "step_time_s": meter.step_time,
-            "examples_per_sec": meter.throughput(cfg.global_batch),
-            "mfu": meter.mfu,
-            "final": last,
+            "step_time_s": _finite(meter.step_time),
+            "examples_per_sec": _finite(meter.throughput(cfg.global_batch)),
+            "mfu": _finite(meter.mfu),
+            "final": {k: _finite(v) for k, v in last.items()},
         }
         if preempted:
             summary["preempted"] = True

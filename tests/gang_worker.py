@@ -56,10 +56,17 @@ def main() -> int:
     ))
     trainer = Trainer(cfg)
     cb = (lambda i, m: time.sleep(delay)) if delay else None
-    state, summary = trainer.fit(callback=cb)
+    # Same SIGTERM contract as the launcher: checkpoint + EX_TEMPFAIL.
+    # The trainer turns the per-worker notice into a gang-agreed stop
+    # (all ranks break at the same step) when num_processes > 1.
+    from kubeflow_tpu.runtime.preemption import EX_TEMPFAIL, PreemptionNotice
+
+    notice = PreemptionNotice().install()
+    state, summary = trainer.fit(callback=cb, stop=notice)
     line = json.dumps({"rank": dist.process_id,
                        "start_step": summary["start_step"],
                        "final_step": int(state.step),
+                       "preempted": bool(summary.get("preempted", False)),
                        "loss": summary["final"].get("loss")})
     print(line, flush=True)
     # Also append to a shared log so the test can assert per-run
@@ -68,7 +75,7 @@ def main() -> int:
     if log_path:
         with open(log_path, "a") as f:
             f.write(line + "\n")
-    return 0
+    return EX_TEMPFAIL if summary.get("preempted") else 0
 
 
 if __name__ == "__main__":

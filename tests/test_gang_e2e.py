@@ -140,3 +140,51 @@ class TestGangE2E:
         assert {r["rank"] for r in finished} == {0, 1}
         # the relaunched gang resumed from the checkpoint, not step 0
         assert all(r["start_step"] > 0 for r in finished), finished
+
+    def test_sigterm_one_worker_gang_agrees_and_resumes_exactly(
+            self, tmp_path):
+        """Graceful slice preemption: SIGTERM lands on ONE worker only;
+        the trainer's gang-agreed stop makes BOTH ranks checkpoint at
+        the same step and exit EX_TEMPFAIL, and the restarted gang
+        resumes from exactly that step — zero lost progress (vs the
+        SIGKILL test, which can only resume from the last periodic
+        save)."""
+        import signal as _signal
+
+        total = 14
+        cluster, ctl, executor, gang_log = make_world(
+            tmp_path, total_steps=total, step_delay=0.5)
+        cluster.create(JT.new_jaxjob(
+            "gang", replicas=2, max_restarts=3,
+            command=[sys.executable, WORKER]))
+        try:
+            drive(cluster, ctl, executor, timeout=60,
+                  until=lambda j: executor.alive_count() == 2)
+            ckpt_dir = tmp_path / "ckpt"
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                executor.poll_once()
+                ctl.run_until_idle(advance_delayed=True)
+                if any(p.is_dir() and (p / "_CHECKPOINT_METADATA").exists()
+                       for p in ckpt_dir.glob("*")):
+                    break
+                time.sleep(0.2)
+            assert executor.kill_pod("gang-worker-0", sig=_signal.SIGTERM)
+
+            job = drive(cluster, ctl, executor, timeout=240,
+                        until=lambda j: ob.cond_is_true(j, JT.COND_SUCCEEDED))
+        finally:
+            executor.shutdown()
+        runs = runs_from(gang_log)
+        preempted = [r for r in runs if r.get("preempted")]
+        # the agreement propagated rank 0's notice to rank 1: both ranks
+        # stopped, at the same step
+        assert {r["rank"] for r in preempted} == {0, 1}, runs
+        stop_steps = {r["final_step"] for r in preempted}
+        assert len(stop_steps) == 1, preempted
+        stop_step = stop_steps.pop()
+        assert 0 < stop_step < total
+        finished = [r for r in runs if r["final_step"] == total]
+        assert {r["rank"] for r in finished} == {0, 1}
+        # exact resume: the restart lost nothing
+        assert all(r["start_step"] == stop_step for r in finished), runs

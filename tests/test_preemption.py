@@ -131,3 +131,51 @@ def test_sigterm_in_launcher_exits_tempfail(tmp_path):
     ck = Checkpointer(str(tmp_path / "ckpt"))
     assert ck.latest_step() is not None and ck.latest_step() > 0
     ck.close()
+
+
+def test_preempt_before_first_step_yields_valid_json_summary(tmp_path, devices8):
+    """Preemption can land before any step completes; the summary must
+    still be json.dumps-able with strict parsers (no bare NaN)."""
+    notice = PreemptionNotice()
+    notice.trigger()  # already preempted at loop entry
+    trainer = Trainer(lm_cfg(tmp_path))
+    state, summary = trainer.fit(stop=notice)
+    assert summary["preempted"] is True
+    parsed = json.loads(json.dumps({"summary": summary}, allow_nan=False))
+    assert parsed["summary"]["step_time_s"] is None
+
+
+def test_resume_then_preempt_keeps_existing_checkpoint(tmp_path, devices8):
+    """A second preemption before the first post-resume step must not
+    delete-and-rewrite the checkpoint it resumed from (force=True's
+    delete-then-save window would leave zero durable checkpoints if the
+    grace period expired mid-save)."""
+    trainer = Trainer(lm_cfg(tmp_path))
+    notice = PreemptionNotice()
+
+    def cb(i, m):
+        if i == 2:
+            notice.trigger()
+
+    state, summary = trainer.fit(callback=cb, stop=notice)
+    step = int(state.step)
+    ck = Checkpointer(str(tmp_path))
+    assert ck.latest_step() == step
+    ck.close()
+    # fingerprint the finalized checkpoint: a delete-then-rewrite would
+    # change the metadata file's mtime even if latest_step() ends up equal
+    meta = next(p for p in tmp_path.glob("*/_CHECKPOINT_METADATA"))
+    before = (meta.stat().st_mtime_ns, meta.stat().st_ino)
+
+    # gang restart resumes at `step`, preempted again immediately
+    notice2 = PreemptionNotice()
+    notice2.trigger()
+    trainer2 = Trainer(lm_cfg(tmp_path))
+    state2, summary2 = trainer2.fit(stop=notice2)
+    assert summary2["preempted"] is True
+    assert int(state2.step) == step
+    ck2 = Checkpointer(str(tmp_path))
+    assert ck2.latest_step() == step
+    ck2.close()
+    assert (meta.stat().st_mtime_ns, meta.stat().st_ino) == before, \
+        "checkpoint was rewritten, not kept untouched"

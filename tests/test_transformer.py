@@ -74,3 +74,31 @@ def test_bert_forward(devices8):
     logits = model.apply(meta.unbox(variables), tokens, train=False)
     assert logits.shape == (2, 2)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_lm_adafactor_training(devices8):
+    """adafactor (factored second moment) trains and keeps optimizer state
+    sublinear in params — the memory-light path that fits llama-1b on one
+    16 GB chip."""
+    # dims must exceed adafactor's min_dim_size_to_factor (128) for the
+    # second moment to actually factor into row+col stats
+    kw = dict(model_kwargs={"d_model": 256, "d_ff": 512, "head_dim": 64})
+    t_adam = Trainer(lm_cfg(optimizer="adamw", total_steps=3, **kw))
+    t_af = Trainer(lm_cfg(optimizer="adafactor", total_steps=3, **kw))
+    _, summary = t_af.fit(steps=3)
+    assert np.isfinite(summary["final"]["loss"])
+
+    def state_bytes(tr):
+        st = tr.init_state()
+        return sum(x.size * x.dtype.itemsize for x in jax.tree.leaves(st.opt_state))
+
+    assert state_bytes(t_af) < 0.25 * state_bytes(t_adam)
+
+
+def test_midsize_gpt_configs_build():
+    """gpt-350m / gpt-760m registry entries produce consistent configs and
+    analytic FLOPs (used by the bench MFU meter)."""
+    for name, d in [("gpt-350m", 1024), ("gpt-760m", 1536)]:
+        m = get_model(name, vocab_size=512, n_layers=2, max_seq_len=64)
+        assert m.cfg.d_model == d
+        assert m.flops_per_token(seq_len=64) > 6 * 2 * 3 * d * m.cfg.d_ff
