@@ -215,6 +215,37 @@ class Dashboard:
             self.client.delete(PT.API_VERSION, PT.KIND, ob.meta(p)["name"])
         return 200, {"message": f"deleted {len(victims)} profiles"}
 
+    # -- notebooks card (notebooks-card.js analogue) ------------------------
+
+    def notebooks(self, req: HttpReq):
+        """List Notebook CRs in a namespace with connect URLs — what the
+        reference dashboard's notebooks-card renders (notebooks-card.js,
+        backed by k8s_service.ts)."""
+        from kubeflow_tpu.control.notebook import types as NT
+
+        self._user(req)
+        ns = req.params["namespace"]
+        out = []
+        for nb in self.client.list(NT.API_VERSION, NT.KIND, namespace=ns):
+            m = ob.meta(nb)
+            containers = ((((nb.get("spec") or {}).get("template") or {})
+                           .get("spec") or {}).get("containers") or [{}])
+            cstate = (nb.get("status") or {}).get("containerState") or {}
+            # containerState has exactly one of running/waiting/terminated
+            phase = next(iter(cstate.keys()), "unknown")
+            stopped = NT.STOP_ANNOTATION in ob.annotations_of(nb)
+            limits = (containers[0].get("resources") or {}).get("limits") or {}
+            out.append({
+                "name": m["name"],
+                "namespace": ns,
+                "image": containers[0].get("image", ""),
+                "status": "stopped" if stopped else phase,
+                "tpu_chips": limits.get(NT.RESOURCE_TPU, 0),
+                # the VirtualService route prefix (notebook_controller.go:386)
+                "connect": f"/notebook/{ns}/{m['name']}/",
+            })
+        return {"notebooks": sorted(out, key=lambda n: n["name"])}
+
     # -- activity + metrics -------------------------------------------------
 
     def activities(self, req: HttpReq):
@@ -248,6 +279,7 @@ class Dashboard:
         r.route("DELETE", "/api/workgroup/remove-contributor/{namespace}",
                 self.remove_contributor)
         r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
+        r.route("GET", "/api/namespaces/{namespace}/notebooks", self.notebooks)
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)

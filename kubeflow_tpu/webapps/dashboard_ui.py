@@ -14,6 +14,11 @@ backend itself:
   (tpu-chips / node-cpu / node-memory)
 - dashboard-view.js activity feed -> /api/activities/{ns} with event
   -type badges and auto-refresh
+- notebooks-card.js -> per-namespace notebook list with status badge and
+  Connect link (/api/namespaces/{ns}/notebooks)
+- main-page.js + iframe-container.js -> hash-routed app nav embedding
+  the Jupyter spawner and Tensorboards in an iframe
+- not-found-view.js -> unknown hash routes render a 404 view
 """
 
 from __future__ import annotations
@@ -62,14 +67,42 @@ PAGE = """<!doctype html>
   .stepdots span { display: inline-block; width: 10px; height: 10px;
                    border-radius: 50%; background: #dadce0; margin-right: 6px; }
   .stepdots span.done { background: #1a73e8; }
+  /* app nav + iframe embedding (main-page.js / iframe-container.js) */
+  nav#appnav { display: flex; gap: 4px; }
+  nav#appnav a { color: #fff; text-decoration: none; padding: 4px 10px;
+                 border-radius: 4px; font-size: 13px; opacity: .85; }
+  nav#appnav a.active { background: rgba(255,255,255,.2); opacity: 1; }
+  #iframe-view iframe { width: 100%; border: 0;
+                        height: calc(100vh - 60px); display: block; }
+  table.nbs { width: 100%; border-collapse: collapse; font-size: 13px; }
+  table.nbs td, table.nbs th { text-align: left; padding: 4px 6px;
+                               border-bottom: 1px solid #eee; }
+  .badge.running { background: #188038; }
+  .badge.waiting { background: #e37400; }
+  .badge.stopped, .badge.terminated { background: #5f6368; }
 </style>
 </head>
 <body>
 <header>
   <h1>kubeflow-tpu</h1>
+  <nav id="appnav">
+    <a href="#/" class="active">Dashboard</a>
+    <a href="#/notebooks">Notebooks</a>
+    <a href="#/tensorboards">Tensorboards</a>
+  </nav>
   <span class="muted" id="user"></span>
   <select id="ns" title="namespace"></select>
 </header>
+<div id="iframe-view" style="display:none">
+  <iframe id="app-frame" title="embedded app"></iframe>
+</div>
+<div id="notfound-view" style="display:none">
+  <div class="card" style="margin:40px auto;max-width:400px;text-align:center">
+    <h2>Page not found</h2>
+    <p class="muted" id="notfound-path"></p>
+    <a href="#/">Back to the dashboard</a>
+  </div>
+</div>
 <main>
   <div class="card" id="register">
     <div class="stepdots" id="dots"></div>
@@ -107,6 +140,12 @@ PAGE = """<!doctype html>
       <p class="muted">Your workspace is ready.</p>
       <button class="primary" onclick="location.reload()">Open dashboard</button>
     </div>
+  </div>
+  <div class="card">
+    <h2>Notebooks</h2>
+    <table class="nbs"><tbody id="notebooks">
+      <tr><td class="muted">select a namespace</td></tr>
+    </tbody></table>
   </div>
   <div class="card">
     <h2>Activity</h2>
@@ -285,10 +324,66 @@ $('contrib-add').addEventListener('click', async () => {
   } catch (e) { $('contrib-err').textContent = e.message; }
 });
 
+/* ---- notebooks card (notebooks-card.js analogue) ---- */
+async function loadNotebooks(ns) {
+  const out = await api('/api/namespaces/' + ns + '/notebooks')
+    .catch(() => ({notebooks: []}));
+  const tb = $('notebooks');
+  tb.innerHTML = '';
+  for (const nb of out.notebooks || []) {
+    // DOM-built rows: notebook names are user data, never HTML
+    const tr = document.createElement('tr');
+    const name = document.createElement('td');
+    name.textContent = nb.name;
+    const status = document.createElement('td');
+    const badge = document.createElement('span');
+    badge.className = 'badge ' + (nb.status || 'unknown');
+    badge.textContent = nb.status || 'unknown';
+    status.appendChild(badge);
+    const chips = document.createElement('td');
+    chips.textContent = nb.tpu_chips ? nb.tpu_chips + ' TPU' : '';
+    const link = document.createElement('td');
+    const a = document.createElement('a');
+    a.href = nb.connect;
+    a.textContent = 'Connect';
+    link.appendChild(a);
+    tr.append(name, status, chips, link);
+    tb.appendChild(tr);
+  }
+  if (!tb.children.length)
+    tb.innerHTML = '<tr><td class="muted">no notebooks — create one under ' +
+      'the Notebooks tab</td></tr>';
+}
+
 async function loadNamespace(ns) {
   currentNs = ns;
-  await Promise.all([loadActivities(ns), loadContributors(ns)]);
+  route();  // re-point an embedded app iframe at the selected namespace
+  await Promise.all([loadActivities(ns), loadContributors(ns),
+                     loadNotebooks(ns)]);
 }
+
+/* ---- hash routing: main-page.js + iframe-container.js + not-found ---- */
+const APP_ROUTES = {
+  '#/notebooks': '/jupyter/',
+  '#/tensorboards': '/tensorboards/',
+};
+function route() {
+  const h = location.hash || '#/';
+  const main = document.querySelector('main');
+  const known = h === '#/' || h in APP_ROUTES;
+  main.style.display = h === '#/' ? '' : 'none';
+  $('iframe-view').style.display = h in APP_ROUTES ? '' : 'none';
+  $('notfound-view').style.display = known ? 'none' : '';
+  if (h in APP_ROUTES) {
+    const src = APP_ROUTES[h] + '?ns=' + encodeURIComponent(currentNs || '');
+    if ($('app-frame').getAttribute('src') !== src)
+      $('app-frame').setAttribute('src', src);
+  }
+  if (!known) $('notfound-path').textContent = h;
+  document.querySelectorAll('#appnav a').forEach(a =>
+    a.classList.toggle('active', a.getAttribute('href') === h));
+}
+window.addEventListener('hashchange', route);
 
 /* ---- resource charts (resource-chart.js analogue) ---- */
 let metric = 'tpu-chips';
@@ -339,7 +434,13 @@ $('metric-tabs').addEventListener('click', (e) => {
 $('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
 loadEnv().catch(e => { $('user').textContent = 'not signed in'; });
 loadChart();
-setInterval(() => { if (currentNs) loadActivities(currentNs); }, 15000);
+route();
+setInterval(() => {
+  if (currentNs && (location.hash || '#/') === '#/') {
+    loadActivities(currentNs);
+    loadNotebooks(currentNs);
+  }
+}, 15000);
 </script>
 </body>
 </html>

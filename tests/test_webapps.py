@@ -435,3 +435,62 @@ class TestContributorEdgeCases:
             "DELETE", "/api/workgroup/remove-contributor/alice",
             body={"contributor": "carol@example.com"})))
         assert out["contributors"] == []
+
+
+class TestNotebooksCard:
+    """/api/namespaces/{ns}/notebooks — the notebooks-card.js data source."""
+
+    def test_lists_notebooks_with_status_and_connect_url(self, cluster):
+        r = Dashboard(cluster).router()
+        nb = NT.new_notebook("my-nb", "team-a", tpu_chips=4)
+        cluster.create(nb)
+        stored = cluster.get(NT.API_VERSION, NT.KIND, "my-nb", "team-a")
+        stored.setdefault("status", {})["containerState"] = \
+            {"running": {"startedAt": "2026-07-30T00:00:00Z"}}
+        cluster.update(stored)
+        out = J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/notebooks")))
+        [row] = out["notebooks"]
+        assert row["name"] == "my-nb"
+        assert row["status"] == "running"
+        assert row["tpu_chips"] == 4
+        assert row["connect"] == "/notebook/team-a/my-nb/"
+
+    def test_stopped_annotation_wins_over_container_state(self, cluster):
+        r = Dashboard(cluster).router()
+        nb = NT.new_notebook("idle-nb", "team-a")
+        ob.set_annotation(nb, NT.STOP_ANNOTATION, "2026-07-30T00:00:00Z")
+        cluster.create(nb)
+        out = J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/notebooks")))
+        assert out["notebooks"][0]["status"] == "stopped"
+
+    def test_requires_identity(self, cluster):
+        r = Dashboard(cluster).router()
+        resp = r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/notebooks", user=None))
+        assert resp.status == 401
+
+
+def test_dashboard_ui_has_nav_and_notebook_card(cluster):
+    """The SPA page carries the nav/iframe/not-found views and the
+    notebooks card markup (main-page.js / iframe-container.js /
+    not-found-view.js / notebooks-card.js analogues)."""
+    r = Dashboard(cluster).router()
+    page = r.dispatch(mkreq("GET", "/")).body
+    for marker in (b'id="appnav"', b'id="app-frame"', b'id="notfound-view"',
+                   b'id="notebooks"', b"/api/namespaces/",
+                   b"#/tensorboards"):
+        assert marker in page, marker
+
+
+def test_notebooks_listing_survives_null_template_spec(cluster):
+    """preserve-unknown-fields CRDs admit spec.template.spec: null; one
+    malformed notebook must not 500 the whole namespace listing."""
+    r = Dashboard(cluster).router()
+    bad = ob.new_object(NT.API_VERSION, NT.KIND, "bad-nb", "team-a")
+    bad["spec"] = {"template": {"spec": None}}
+    cluster.create(bad)
+    out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/notebooks")))
+    assert out["notebooks"][0]["name"] == "bad-nb"
+    assert out["notebooks"][0]["image"] == ""
