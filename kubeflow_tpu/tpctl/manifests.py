@@ -50,6 +50,27 @@ def _service(name: str, ns: str, port: int, target: int) -> dict:
     )
 
 
+def _app_virtualservice(name: str, ns: str, prefix: str) -> dict:
+    """Gateway route for a platform web app (reference pattern: each web
+    app ships a VirtualService matching its URL prefix on the shared
+    kubeflow gateway; per-resource routes like /notebook/<ns>/<name>/ are
+    added by the controllers, not here)."""
+    http: dict = {
+        "match": [{"uri": {"prefix": prefix}}],
+        "route": [{"destination": {
+            "host": f"{name}.{ns}.svc.cluster.local",
+            "port": {"number": 80}}}],
+    }
+    if prefix != "/":
+        # apps are served at their own root; strip the gateway prefix
+        http["rewrite"] = {"uri": "/"}
+    return ob.new_object(
+        "networking.istio.io/v1alpha3", "VirtualService", name, ns,
+        spec={"hosts": ["*"], "gateways": ["kubeflow/kubeflow-gateway"],
+              "http": [http]},
+    )
+
+
 def _clusterrole(name: str, rules: list[dict]) -> dict:
     cr = ob.new_object("rbac.authorization.k8s.io/v1", "ClusterRole", name)
     cr["rules"] = rules
@@ -144,8 +165,19 @@ def render(cfg: TpuDef) -> list[dict]:
         "gatekeeper": (["python", "-m", "kubeflow_tpu.control.gatekeeper"], 8085),
         "centraldashboard": (["python", "-m", "kubeflow_tpu.webapps.dashboard_main"], 8082),
         "jupyter-web-app": (["python", "-m", "kubeflow_tpu.webapps.jwa_main"], 5000),
+        "tensorboards-web-app": (
+            ["python", "-m", "kubeflow_tpu.webapps.tensorboards_main"], 5005),
         "serving": (["python", "-m", "kubeflow_tpu.serving"], 8500),
         "metric-collector": (["python", "-m", "kubeflow_tpu.metric_collector"], 8088),
+    }
+    # gateway route prefix per web app — the VirtualServices that make the
+    # dashboard's iframe paths (/jupyter/, /tensorboards/) resolve through
+    # the platform gateway (reference ships the same per-app VS routing;
+    # without it the iframe tabs would 404 against the dashboard origin)
+    app_prefixes = {
+        "centraldashboard": "/",
+        "jupyter-web-app": "/jupyter/",
+        "tensorboards-web-app": "/tensorboards/",
     }
     for name, (cmd, port) in services.items():
         if name not in apps:
@@ -153,6 +185,8 @@ def render(cfg: TpuDef) -> list[dict]:
         out.append(_deployment(name, ns, img("platform"), args=cmd, port=port,
                                sa="kubeflow-controller"))
         out.append(_service(name, ns, 80, port))
+        if cfg.use_istio and name in app_prefixes:
+            out.append(_app_virtualservice(name, ns, app_prefixes[name]))
 
     for patch in cfg.overlays:
         _apply_overlay(out, patch)

@@ -39,6 +39,7 @@ ALL_COMPONENTS = (
     "gatekeeper",
     "centraldashboard",
     "jupyter-web-app",
+    "tensorboards-web-app",
     "serving",
     "metric-collector",
 )

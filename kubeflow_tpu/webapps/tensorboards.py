@@ -1,0 +1,227 @@
+"""Tensorboards CRUD web app — the first crud_backend consumer.
+
+The reference factors next-gen CRUD apps onto the shared
+crud-web-apps/common backend (SURVEY.md §2.3); the Tensorboard CRD
+(tensorboard-controller, SURVEY.md §2.2) had no web app in the
+snapshot. This closes that gap the crud_backend way: standard resource
+routes (namespaces/PVCs/events) from the shared package plus
+Tensorboard-specific CRUD, serving the listing the dashboard's
+Tensorboards tab embeds.
+
+Routes (crud_backend envelope {success, status, ...}):
+  GET    /api/namespaces/{ns}/tensorboards
+  POST   /api/namespaces/{ns}/tensorboards        {name, logspath}
+  DELETE /api/namespaces/{ns}/tensorboards/{name}
+Connect URLs follow the controller's VirtualService prefix
+(/tensorboard/<ns>/<name>/, tensorboard_controller.go:228 analogue).
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.tensorboard import API_VERSION, KIND, new_tensorboard
+from kubeflow_tpu.control.tensorboard.controller import is_cloud_path
+from kubeflow_tpu.utils import httpd
+from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
+from kubeflow_tpu.webapps.crud_backend import Authorizer, CrudBackend, success
+
+log = logging.getLogger("kubeflow_tpu.tensorboards")
+
+NAME_RGX = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+class TensorboardsApp:
+    """CRUD app = shared backend + Tensorboard-specific routes + UI."""
+
+    def __init__(self, client, authz: Authorizer | None = None):
+        self.client = client
+        self.crud = CrudBackend(client, authz)
+
+    # -- handlers -----------------------------------------------------------
+
+    def _phase(self, tb: dict) -> str:
+        for c in (tb.get("status") or {}).get("conditions", []):
+            if c.get("type") == "Ready":
+                return "ready" if c.get("status") == "True" else "waiting"
+        return "waiting"
+
+    def list_tensorboards(self, req: HttpReq):
+        ns = req.params["namespace"]
+        self.crud._auth(req, "list", ns)
+        rows = []
+        for tb in self.client.list(API_VERSION, KIND, namespace=ns):
+            m = ob.meta(tb)
+            logspath = (tb.get("spec") or {}).get("logspath", "")
+            rows.append({
+                "name": m["name"],
+                "namespace": ns,
+                "logspath": logspath,
+                "storage": "cloud" if is_cloud_path(logspath) else "pvc",
+                "phase": self._phase(tb),
+                "connect": f"/tensorboard/{ns}/{m['name']}/",
+            })
+        return success(tensorboards=sorted(rows, key=lambda r: r["name"]))
+
+    def create_tensorboard(self, req: HttpReq):
+        ns = req.params["namespace"]
+        self.crud._auth(req, "create", ns)
+        body = req.json() or {}
+        if not isinstance(body, dict):
+            raise ApiHttpError(400, "request body must be a JSON object")
+        name = body.get("name") or ""
+        logspath = body.get("logspath") or ""
+        if not isinstance(name, str) or not NAME_RGX.match(name) \
+                or len(name) > 63:
+            raise ApiHttpError(400, f"invalid tensorboard name {name!r}")
+        # non-cloud paths become a volumeMount mountPath, which the
+        # apiserver requires to be absolute
+        if not isinstance(logspath, str) or not logspath or not (
+                is_cloud_path(logspath) or logspath.startswith("/")):
+            raise ApiHttpError(400, "logspath must be gs://, s3:// or an "
+                                    "absolute PVC-backed path")
+        try:
+            self.client.create(new_tensorboard(name, ns, logspath=logspath))
+        except ob.Conflict:
+            raise ApiHttpError(409, f"tensorboard {name} already exists")
+        log.info("created tensorboard %s/%s logspath=%s", ns, name, logspath)
+        return success(name=name)
+
+    def delete_tensorboard(self, req: HttpReq):
+        ns, name = req.params["namespace"], req.params["name"]
+        self.crud._auth(req, "delete", ns)
+        try:
+            self.client.delete(API_VERSION, KIND, name, ns)
+        except ob.NotFound:
+            raise ApiHttpError(404, f"tensorboard {name} not found")
+        return success(name=name)
+
+    # -- wiring -------------------------------------------------------------
+
+    def router(self) -> Router:
+        r = Router("tensorboards")
+        self.crud.add_routes(r)
+        r.route("GET", "/api/namespaces/{namespace}/tensorboards",
+                self.list_tensorboards)
+        r.route("POST", "/api/namespaces/{namespace}/tensorboards",
+                self.create_tensorboard)
+        r.route("DELETE", "/api/namespaces/{namespace}/tensorboards/{name}",
+                self.delete_tensorboard)
+        r.route("GET", "/", self.page)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 5005) -> httpd.HttpService:
+        return httpd.HttpService(self.router(), host, port)
+
+    # -- UI -----------------------------------------------------------------
+
+    def page(self, req: HttpReq):
+        return httpd.HttpResp(200, PAGE.encode(), "text/html")
+
+
+PAGE = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>Tensorboards</title>
+<style>
+  body { font-family: system-ui, sans-serif; margin: 0; background: #f5f6f8; }
+  main { max-width: 760px; margin: 24px auto; padding: 0 16px; }
+  .card { background: #fff; border-radius: 8px; padding: 16px;
+          box-shadow: 0 1px 3px rgba(0,0,0,.15); margin-bottom: 16px; }
+  h2 { margin: 0 0 10px; font-size: 15px; color: #333; }
+  input, button { font-size: 14px; padding: 6px 10px; border-radius: 4px;
+                  border: 1px solid #ccc; }
+  button.primary { background: #1a73e8; color: #fff; border-color: #1a73e8;
+                   cursor: pointer; }
+  table { width: 100%; border-collapse: collapse; font-size: 13px; }
+  td, th { text-align: left; padding: 5px 6px; border-bottom: 1px solid #eee; }
+  .badge { display: inline-block; border-radius: 3px; padding: 0 6px;
+           font-size: 11px; color: #fff; background: #e37400; }
+  .badge.ready { background: #188038; }
+  .muted { color: #777; font-size: 12px; }
+  .error { color: #c5221f; font-size: 12px; }
+</style>
+</head>
+<body>
+<main>
+  <div class="card">
+    <h2>New tensorboard</h2>
+    <input id="name" placeholder="name">
+    <input id="logspath" placeholder="gs://bucket/logs or /pvc/path" size="34">
+    <button class="primary" id="create">Create</button>
+    <p class="error" id="err"></p>
+    <p class="muted">Cloud paths (gs://, s3://) stream directly; other
+      paths mount the namespace PVC.</p>
+  </div>
+  <div class="card">
+    <h2>Tensorboards</h2>
+    <table><tbody id="rows"><tr><td class="muted">loading…</td></tr></tbody>
+    </table>
+  </div>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const ns = new URLSearchParams(location.search).get('ns') || 'default';
+const api = (p, opt) => fetch(p, opt).then(async r => {
+  const j = await r.json().catch(() => ({}));
+  if (!r.ok) throw new Error(j.error || r.status);
+  return j;
+});
+async function load() {
+  const out = await api('/api/namespaces/' + ns + '/tensorboards')
+    .catch(() => ({tensorboards: []}));
+  const tb = $('rows');
+  tb.innerHTML = '';
+  for (const t of out.tensorboards || []) {
+    // DOM-built rows: names/paths are user data, never raw HTML
+    const tr = document.createElement('tr');
+    const name = document.createElement('td');
+    name.textContent = t.name;
+    const path = document.createElement('td');
+    path.textContent = t.logspath;
+    path.className = 'muted';
+    const phase = document.createElement('td');
+    const badge = document.createElement('span');
+    badge.className = 'badge ' + t.phase;
+    badge.textContent = t.phase;
+    phase.appendChild(badge);
+    const act = document.createElement('td');
+    const open = document.createElement('a');
+    open.href = t.connect; open.textContent = 'Open';
+    const del = document.createElement('button');
+    del.textContent = 'Delete';
+    del.addEventListener('click', async () => {
+      await api('/api/namespaces/' + ns + '/tensorboards/' + t.name,
+                {method: 'DELETE'}).catch(e => { $('err').textContent = e.message; });
+      load();
+    });
+    act.append(open, document.createTextNode(' '), del);
+    tr.append(name, path, phase, act);
+    tb.appendChild(tr);
+  }
+  if (!tb.children.length)
+    tb.innerHTML = '<tr><td class="muted">none yet</td></tr>';
+}
+$('create').addEventListener('click', async () => {
+  $('err').textContent = '';
+  try {
+    await api('/api/namespaces/' + ns + '/tensorboards', {
+      method: 'POST', headers: {'Content-Type': 'application/json'},
+      body: JSON.stringify({name: $('name').value.trim(),
+                            logspath: $('logspath').value.trim()}),
+    });
+    $('name').value = ''; $('logspath').value = '';
+    load();
+  } catch (e) { $('err').textContent = e.message; }
+});
+load();
+setInterval(load, 15000);
+</script>
+</body>
+</html>
+"""

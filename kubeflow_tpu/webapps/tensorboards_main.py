@@ -1,0 +1,20 @@
+"""Entry: python -m kubeflow_tpu.webapps.tensorboards_main."""
+import argparse
+
+import os
+
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.webapps.crud_backend import Authorizer
+from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
+
+p = argparse.ArgumentParser("tensorboards")
+p.add_argument("--port", type=int, default=5005)
+p.add_argument("--apiserver", default="")
+args = p.parse_args()
+client = RestClient(base_url=args.apiserver or None)
+# authz always on in the deployed service: profile owner/contributor
+# roles gate every verb (tests construct the app the same way)
+authz = Authorizer(client, cluster_admin=os.environ.get("CLUSTER_ADMIN") or None)
+svc = TensorboardsApp(client, authz).serve(port=args.port)
+print(f"tensorboards on :{svc.port}")
+svc.serve_forever()

@@ -494,3 +494,117 @@ def test_notebooks_listing_survives_null_template_spec(cluster):
     out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/notebooks")))
     assert out["notebooks"][0]["name"] == "bad-nb"
     assert out["notebooks"][0]["image"] == ""
+
+
+class TestTensorboardsApp:
+    """Tensorboards CRUD web app on crud_backend (the next-gen CRUD-app
+    pattern of components/crud-web-apps; Tensorboard semantics from
+    tensorboard-controller)."""
+
+    @pytest.fixture()
+    def app(self, cluster):
+        from kubeflow_tpu.webapps.crud_backend import Authorizer
+        from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
+
+        cluster.create(PT.new_profile("team-a", USER))
+        authz = Authorizer(cluster)
+        return cluster, TensorboardsApp(cluster, authz).router()
+
+    def test_create_list_delete_lifecycle(self, app):
+        cluster, r = app
+        out = J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                                 body={"name": "tb1",
+                                       "logspath": "gs://bucket/logs"})))
+        assert out["success"] is True
+        rows = J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/tensorboards")))["tensorboards"]
+        [row] = rows
+        assert row["storage"] == "cloud"
+        assert row["phase"] == "waiting"
+        assert row["connect"] == "/tensorboard/team-a/tb1/"
+        # controller marks Ready -> phase flips
+        from kubeflow_tpu.control.tensorboard import API_VERSION, KIND
+        tb = cluster.get(API_VERSION, KIND, "tb1", "team-a")
+        ob.cond_set(tb, "Ready", "True", "DeploymentReady")
+        cluster.update_status(tb)
+        rows = J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/tensorboards")))["tensorboards"]
+        assert rows[0]["phase"] == "ready"
+        J(r.dispatch(mkreq("DELETE",
+                           "/api/namespaces/team-a/tensorboards/tb1")))
+        assert J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/tensorboards")))["tensorboards"] == []
+
+    def test_validation_and_conflicts(self, app):
+        _, r = app
+        assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                                body={"name": "Bad_Name",
+                                      "logspath": "gs://x"})).status == 400
+        assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                                body={"name": "tb1"})).status == 400
+        ok = {"name": "tb1", "logspath": "/pvc/logs"}
+        J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                           body=ok)))
+        assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                                body=ok)).status == 409
+        assert r.dispatch(mkreq(
+            "DELETE", "/api/namespaces/team-a/tensorboards/nope")).status == 404
+        # pvc path reported as pvc storage
+        rows = J(r.dispatch(mkreq(
+            "GET", "/api/namespaces/team-a/tensorboards")))["tensorboards"]
+        assert rows[0]["storage"] == "pvc"
+
+    def test_authz_denies_stranger(self, app):
+        _, r = app
+        resp = r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                                body={"name": "tb2", "logspath": "gs://x"},
+                                user="mallory@example.com"))
+        assert resp.status == 403
+        assert r.dispatch(mkreq("GET", "/api/namespaces/team-a/tensorboards",
+                                user=None)).status == 401
+
+    def test_shared_crud_routes_present(self, app):
+        _, r = app
+        assert J(r.dispatch(mkreq("GET", "/api/namespaces")))  # crud_backend
+        page = r.dispatch(mkreq("GET", "/"))
+        assert page.status == 200
+        assert b"New tensorboard" in page.body and b"/tensorboards" in page.body
+
+
+def test_tensorboard_validation_rejects_relative_path_and_nonstring(cluster):
+    from kubeflow_tpu.webapps.tensorboards import TensorboardsApp
+
+    r = TensorboardsApp(cluster).router()
+    # relative logspath would render a non-absolute mountPath the
+    # apiserver rejects — must 400, not create a stuck tensorboard
+    assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                            body={"name": "tb", "logspath": "my/logs"})
+                      ).status == 400
+    assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                            body={"name": 123, "logspath": "gs://x"})
+                      ).status == 400
+    assert r.dispatch(mkreq("POST", "/api/namespaces/team-a/tensorboards",
+                            body={"name": "tb", "logspath": 9})
+                      ).status == 400
+
+
+def test_manifests_route_webapp_prefixes_through_gateway():
+    """The dashboard iframes /jupyter/ and /tensorboards/; the platform
+    manifests must ship gateway VirtualServices for those prefixes (and
+    the dashboard catch-all) or the tabs 404."""
+    from kubeflow_tpu.tpctl.manifests import render
+    from kubeflow_tpu.tpctl.tpudef import TpuDef
+
+    objs = render(TpuDef(use_istio=True))
+    vs = {ob.meta(o)["name"]: o for o in objs
+          if o.get("kind") == "VirtualService"}
+    for name, prefix in [("centraldashboard", "/"),
+                         ("jupyter-web-app", "/jupyter/"),
+                         ("tensorboards-web-app", "/tensorboards/")]:
+        http = vs[name]["spec"]["http"][0]
+        assert http["match"][0]["uri"]["prefix"] == prefix
+        assert name in http["route"][0]["destination"]["host"]
+        assert (prefix == "/") == ("rewrite" not in http)
+    # istio off -> no webapp VirtualServices rendered
+    objs_plain = render(TpuDef(use_istio=False))
+    assert not [o for o in objs_plain if o.get("kind") == "VirtualService"]
