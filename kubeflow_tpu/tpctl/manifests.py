@@ -50,24 +50,31 @@ def _service(name: str, ns: str, port: int, target: int) -> dict:
     )
 
 
-def _app_virtualservice(name: str, ns: str, prefix: str) -> dict:
-    """Gateway route for a platform web app (reference pattern: each web
-    app ships a VirtualService matching its URL prefix on the shared
-    kubeflow gateway; per-resource routes like /notebook/<ns>/<name>/ are
-    added by the controllers, not here)."""
-    http: dict = {
-        "match": [{"uri": {"prefix": prefix}}],
-        "route": [{"destination": {
-            "host": f"{name}.{ns}.svc.cluster.local",
-            "port": {"number": 80}}}],
-    }
-    if prefix != "/":
-        # apps are served at their own root; strip the gateway prefix
-        http["rewrite"] = {"uri": "/"}
+def _webapps_virtualservice(ns: str, prefixes: dict[str, str]) -> dict:
+    """ONE gateway VirtualService carrying every web-app prefix route,
+    most-specific first. A single VS (vs one per app) because Istio's
+    merge order across VirtualServices on the same host is
+    non-deterministic — a separate '/' catch-all could shadow /jupyter/.
+    Per-resource routes (/notebook/<ns>/<name>/) are added by the
+    controllers, not here."""
+    http = []
+    for name, prefix in sorted(prefixes.items(),
+                               key=lambda kv: -len(kv[1])):
+        rule: dict = {
+            "match": [{"uri": {"prefix": prefix}}],
+            "route": [{"destination": {
+                "host": f"{name}.{ns}.svc.cluster.local",
+                "port": {"number": 80}}}],
+        }
+        if prefix != "/":
+            # apps are served at their own root; strip the gateway prefix
+            rule["rewrite"] = {"uri": "/"}
+        http.append(rule)
     return ob.new_object(
-        "networking.istio.io/v1alpha3", "VirtualService", name, ns,
+        "networking.istio.io/v1alpha3", "VirtualService",
+        "kubeflow-webapps", ns,
         spec={"hosts": ["*"], "gateways": ["kubeflow/kubeflow-gateway"],
-              "http": [http]},
+              "http": http},
     )
 
 
@@ -185,8 +192,9 @@ def render(cfg: TpuDef) -> list[dict]:
         out.append(_deployment(name, ns, img("platform"), args=cmd, port=port,
                                sa="kubeflow-controller"))
         out.append(_service(name, ns, 80, port))
-        if cfg.use_istio and name in app_prefixes:
-            out.append(_app_virtualservice(name, ns, app_prefixes[name]))
+    routed = {n: p for n, p in app_prefixes.items() if n in apps}
+    if cfg.use_istio and routed:
+        out.append(_webapps_virtualservice(ns, routed))
 
     for patch in cfg.overlays:
         _apply_overlay(out, patch)

@@ -7,7 +7,12 @@ E2E polls exactly that (:128-194). Spec shape follows the katib
 v1alpha1 StudyJob the test submits: objective + parameter space +
 suggestion algorithm + trial template.
 
-Search algorithms: grid and random (the two the reference example used).
+Search algorithms: grid and random (the two the reference example used),
+plus the two the katib of that era shipped beyond them: bayesian
+optimization (here a TPE-flavored exploit/explore sampler over completed
+trials — no GP dependency) and hyperband-style successive halving
+(budget rungs with top-1/eta promotion; the trial template receives the
+rung budget through ``${budget}``).
 Trial metrics: trials publish their objective through the
 ``studyjob.kubeflow.org/objective-value`` annotation on their JAXJob
 (written by jaxrt's launcher via its summary line, or by the test); an
@@ -134,6 +139,108 @@ def random_suggestions(parameters: list[dict], max_trials: int, seed: int = 0) -
     return out
 
 
+def bayes_suggestions(parameters: list[dict], max_trials: int, seed: int,
+                      observations: list[dict] | None = None,
+                      goal: str = "minimize") -> list[dict]:
+    """Sequential model-based search, TPE-flavored: the first quarter of
+    the budget explores uniformly; afterwards each suggestion either
+    perturbs a random top-quartile observed config (exploit, sigma =
+    1/8 of the range, decaying with trial index) or samples uniformly
+    (explore, 20%). Launched trials pin their params in an annotation,
+    so re-deriving the tail as observations arrive is safe."""
+    out = random_suggestions(parameters, max_trials, seed)
+    obs = [o for o in (observations or []) if o.get("objective") is not None]
+    if len(obs) < 2:
+        return out
+    obs = sorted(obs, key=lambda o: o["objective"],
+                 reverse=(goal == "maximize"))
+    top = [o["parameters"] for o in obs[:max(1, len(obs) // 4)]]
+    n_init = max(2, max_trials // 4)
+    for i in range(max(n_init, len(obs)), max_trials):
+        rng_i = _random.Random((seed, i, len(obs)).__hash__())
+        if rng_i.random() < 0.2:
+            continue  # keep the uniform-explore sample from the skeleton
+        base = rng_i.choice(top)
+        pick = {}
+        for p in parameters:
+            name = p["name"]
+            ptype = p.get("parameterType", p.get("type", "categorical"))
+            feas = p.get("feasible") or {}
+            anchor = base.get(name)
+            if ptype in ("categorical", "discrete"):
+                choices = list(feas.get("list") or p.get("list"))
+                pick[name] = anchor if (anchor in choices
+                                        and rng_i.random() < 0.8) \
+                    else rng_i.choice(choices)
+                continue
+            lo, hi = float(feas.get("min", 0.0)), float(feas.get("max", 1.0))
+            sigma = (hi - lo) / 8.0 / (1.0 + i / max(max_trials, 1))
+            try:
+                center = float(anchor)
+            except (TypeError, ValueError):
+                center = (lo + hi) / 2
+            val = min(hi, max(lo, rng_i.gauss(center, sigma)))
+            pick[name] = int(round(val)) if ptype == "int" else val
+        out[i] = pick
+    return out
+
+
+def sha_rungs(algo: dict) -> tuple[list[int], int]:
+    """Budget ladder for successive halving: minBudget * eta^r up to
+    maxBudget; returns (rungs, eta)."""
+    eta = max(2, int(algo.get("reduction", 2)))
+    bmin = max(1, int(algo.get("minBudget", 1)))
+    bmax = max(bmin, int(algo.get("maxBudget", bmin * eta ** 2)))
+    rungs = [bmin]
+    while rungs[-1] * eta <= bmax:
+        rungs.append(rungs[-1] * eta)
+    return rungs, eta
+
+
+def sha_bracket(max_trials: int, rungs: list[int], eta: int) -> int:
+    """Initial rung size n0 so the WHOLE bracket (rung r runs
+    max(1, n0 // eta^r) trials — the promotion rule below) stays within
+    maxTrialCount: katib's cap is total trials, not initial configs."""
+    for n0 in range(max(1, max_trials), 1, -1):
+        total, n = 0, n0
+        for _ in rungs:
+            total += n
+            n = max(1, n // eta)
+        if total <= max_trials:
+            return n0
+    return 1
+
+
+def sha_suggestions(parameters: list[dict], max_trials: int, seed: int,
+                    observations: list[dict] | None = None,
+                    goal: str = "minimize",
+                    algo: dict | None = None) -> list[dict]:
+    """Hyperband-style successive halving (single bracket): the largest
+    n0 whose full bracket fits maxTrialCount runs at the smallest
+    budget; when a rung completes, the top 1/eta configs re-run at
+    eta x the budget. Every suggestion carries a ``budget`` param for
+    the trial template's ``${budget}`` token."""
+    rungs, eta = sha_rungs(algo or {})
+    n0 = sha_bracket(max_trials, rungs, eta)
+    out = [dict(c, budget=rungs[0])
+           for c in random_suggestions(parameters, n0, seed)]
+    obs = [o for o in (observations or []) if o.get("objective") is not None]
+    for r in range(1, len(rungs)):
+        prev_budget = rungs[r - 1]
+        expected = len([s for s in out if s["budget"] == prev_budget])
+        done_prev = [o for o in obs
+                     if int(o["parameters"].get("budget", -1)) == prev_budget]
+        if len(done_prev) < expected:
+            break  # rung still running; promotions appear when it drains
+        keep = max(1, expected // eta)
+        done_prev.sort(key=lambda o: o["objective"],
+                       reverse=(goal == "maximize"))
+        for o in done_prev[:keep]:
+            cfg = {k: v for k, v in o["parameters"].items() if k != "budget"}
+            out.append(dict(cfg, budget=rungs[r]))
+    return out
+
+
 def _substitute(obj: Any, params: dict) -> Any:
     """${param} substitution in the trial template (katib's
     go-template analogue). Full-string matches keep native types."""
@@ -167,17 +274,27 @@ class StudyJobReconciler(Reconciler):
     def __init__(self, collector: Callable[[dict], float | None] = default_collector):
         self.collector = collector
 
-    def _suggestions(self, study: dict) -> list[dict]:
+    def _suggestions(self, study: dict,
+                     observations: list[dict] | None = None) -> list[dict]:
         spec = study["spec"]
-        algo = (spec.get("algorithm") or {}).get("algorithmName", "grid")
+        algo_spec = spec.get("algorithm") or {}
+        algo = algo_spec.get("algorithmName", "grid")
         max_trials = spec.get("maxTrialCount", 4)
         params = spec.get("parameters") or []
+        seed = algo_spec.get("seed", 0)
+        goal = (spec.get("objective") or {}).get("type", "minimize")
         if algo == "random":
-            seed = (spec.get("algorithm") or {}).get("seed", 0)
             return random_suggestions(params, max_trials, seed)
         if algo == "grid":
             return grid_suggestions(params, max_trials)
-        raise ValueError(f"unknown algorithmName {algo!r} (grid|random)")
+        if algo in ("bayesianoptimization", "bayes"):
+            return bayes_suggestions(params, max_trials, seed,
+                                     observations, goal)
+        if algo in ("hyperband", "successivehalving"):
+            return sha_suggestions(params, max_trials, seed,
+                                   observations, goal, algo_spec)
+        raise ValueError(f"unknown algorithmName {algo!r} "
+                         "(grid|random|bayesianoptimization|hyperband)")
 
     def trial_name(self, study: dict, idx: int) -> str:
         return f"{ob.meta(study)['name']}-trial-{idx}"
@@ -212,12 +329,6 @@ class StudyJobReconciler(Reconciler):
             return None
 
         spec = study["spec"]
-        try:
-            suggestions = self._suggestions(study)
-        except ValueError as e:
-            ob.cond_set(study, COND_FAILED, "True", "BadAlgorithm", str(e))
-            client.update_status(study)
-            return None
         parallel = spec.get("parallelTrialCount", 2)
 
         trials = client.list(
@@ -243,6 +354,15 @@ class StudyJobReconciler(Reconciler):
                 n_failed += 1
             else:
                 n_active += 1
+
+        # sequential algorithms (bayes, successive halving) grow/refine the
+        # suggestion list from completed-trial observations
+        try:
+            suggestions = self._suggestions(study, results)
+        except ValueError as e:
+            ob.cond_set(study, COND_FAILED, "True", "BadAlgorithm", str(e))
+            client.update_status(study)
+            return None
 
         # launch next trials up to parallelism
         next_idx = max(by_idx) + 1 if by_idx else 0

@@ -110,7 +110,7 @@ let config = {};
 let podDefaults = [];
 
 async function init() {
-  config = (await api('/api/config')).config || {};
+  config = (await api('api/config')).config || {};
   for (const img of (config.image?.options || [])) {
     const o = document.createElement('option');
     o.value = o.textContent = img;
@@ -121,7 +121,7 @@ async function init() {
     o.value = o.textContent = n;
     $('tpus').appendChild(o);
   }
-  const nss = (await api('/api/namespaces')).namespaces || [];
+  const nss = (await api('api/namespaces')).namespaces || [];
   for (const ns of nss) {
     const o = document.createElement('option');
     o.value = o.textContent = ns;
@@ -136,7 +136,7 @@ async function nsChanged() {
 }
 
 async function loadPvcs(ns) {
-  const out = await api('/api/namespaces/' + ns + '/pvcs').catch(() => ({pvcs: []}));
+  const out = await api('api/namespaces/' + ns + '/pvcs').catch(() => ({pvcs: []}));
   const sel = $('pvcs');
   sel.innerHTML = '';
   for (const p of out.pvcs || []) {
@@ -148,7 +148,7 @@ async function loadPvcs(ns) {
 }
 
 async function loadPodDefaults(ns) {
-  const out = await api('/api/namespaces/' + ns + '/poddefaults')
+  const out = await api('api/namespaces/' + ns + '/poddefaults')
     .catch(() => ({poddefaults: []}));
   podDefaults = out.poddefaults || [];
   const box = $('poddefaults');
@@ -179,7 +179,7 @@ $('vol-mode').addEventListener('change', () => {
 
 async function refresh() {
   const ns = $('ns').value;
-  const out = await api('/api/namespaces/' + ns + '/notebooks');
+  const out = await api('api/namespaces/' + ns + '/notebooks');
   const tb = $('list');
   tb.innerHTML = '';
   for (const nb of out.notebooks || []) {
@@ -205,7 +205,7 @@ async function refresh() {
     const toggle = document.createElement('button');
     toggle.textContent = stopped ? 'start' : 'stop';
     toggle.addEventListener('click', async () => {
-      await fetch('/api/namespaces/' + encodeURIComponent(ns) +
+      await fetch('api/namespaces/' + encodeURIComponent(ns) +
                   '/notebooks/' + encodeURIComponent(nb.name), {
         method: 'PATCH',
         headers: {'Content-Type': 'application/json'},
@@ -216,7 +216,7 @@ async function refresh() {
     const del = document.createElement('button');
     del.textContent = 'delete';
     del.addEventListener('click', async () => {
-      await fetch('/api/namespaces/' + encodeURIComponent(ns) +
+      await fetch('api/namespaces/' + encodeURIComponent(ns) +
                   '/notebooks/' + encodeURIComponent(nb.name),
                   {method: 'DELETE'});
       refresh();
@@ -242,7 +242,7 @@ $('spawn').addEventListener('submit', async (e) => {
     // any other failure aborts so the notebook never mounts a missing
     // claim
     const claim = 'workspace-' + form.name;
-    const pr = await fetch('/api/namespaces/' + ns + '/pvcs', {
+    const pr = await fetch('api/namespaces/' + ns + '/pvcs', {
       method: 'POST', headers: {'Content-Type': 'application/json'},
       body: JSON.stringify({name: claim, size: $('vol-size').value}),
     });
@@ -266,7 +266,7 @@ $('spawn').addEventListener('submit', async (e) => {
     Object.assign(labels, (pd && pd.matchLabels) || {});
   });
   if (Object.keys(labels).length) form.labels = labels;
-  const r = await fetch('/api/namespaces/' + ns + '/notebooks', {
+  const r = await fetch('api/namespaces/' + ns + '/notebooks', {
     method: 'POST',
     headers: {'Content-Type': 'application/json'},
     body: JSON.stringify(form),

@@ -173,7 +173,7 @@ const api = (p, opt) => fetch(p, opt).then(async r => {
   return j;
 });
 async function load() {
-  const out = await api('/api/namespaces/' + ns + '/tensorboards')
+  const out = await api('api/namespaces/' + ns + '/tensorboards')
     .catch(() => ({tensorboards: []}));
   const tb = $('rows');
   tb.innerHTML = '';
@@ -196,7 +196,7 @@ async function load() {
     const del = document.createElement('button');
     del.textContent = 'Delete';
     del.addEventListener('click', async () => {
-      await api('/api/namespaces/' + ns + '/tensorboards/' + t.name,
+      await api('api/namespaces/' + ns + '/tensorboards/' + t.name,
                 {method: 'DELETE'}).catch(e => { $('err').textContent = e.message; });
       load();
     });
@@ -210,7 +210,7 @@ async function load() {
 $('create').addEventListener('click', async () => {
   $('err').textContent = '';
   try {
-    await api('/api/namespaces/' + ns + '/tensorboards', {
+    await api('api/namespaces/' + ns + '/tensorboards', {
       method: 'POST', headers: {'Content-Type': 'application/json'},
       body: JSON.stringify({name: $('name').value.trim(),
                             logspath: $('logspath').value.trim()}),

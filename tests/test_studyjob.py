@@ -144,7 +144,8 @@ class TestSweepLifecycle:
 
     def test_bad_algorithm_fails(self, world):
         cluster, study_ctl, _, _ = world
-        sj = SJ.new_studyjob("sweep", algorithm="bayes", parameters=PARAMS)
+        sj = SJ.new_studyjob("sweep", algorithm="simulated-annealing",
+                             parameters=PARAMS)
         cluster.create(sj)
         drain(study_ctl)
         study = cluster.get(SJ.API_VERSION, SJ.KIND, "sweep", "default")
@@ -159,3 +160,118 @@ class TestSweepLifecycle:
         assert cluster.list(JT.API_VERSION, JT.KIND, namespace="default")
         cluster.delete(SJ.API_VERSION, SJ.KIND, "sweep", "default")
         assert cluster.list(JT.API_VERSION, JT.KIND, namespace="default") == []
+
+
+class TestBayes:
+    def test_explores_then_exploits_near_best(self):
+        """With observations strongly favoring lr~=0.012, the refined
+        tail clusters nearer that anchor than uniform sampling."""
+        params = [{"name": "lr", "parameterType": "double",
+                   "feasible": {"min": 0.0, "max": 1.0}}]
+        obs = [{"parameters": {"lr": x}, "objective": (x - 0.012) ** 2}
+               for x in (0.012, 0.3, 0.6, 0.9)]
+        out = SJ.bayes_suggestions(params, 16, seed=3,
+                                   observations=obs, goal="minimize")
+        uniform = SJ.random_suggestions(params, 16, seed=3)
+        tail = [s["lr"] for s in out[8:]]
+        utail = [s["lr"] for s in uniform[8:]]
+        assert all(0.0 <= v <= 1.0 for v in tail)
+        mean = lambda vs: sum(vs) / len(vs)  # noqa: E731
+        assert mean([abs(v - 0.012) for v in tail]) < \
+            mean([abs(v - 0.012) for v in utail])
+
+    def test_without_observations_falls_back_to_random(self):
+        params = [{"name": "lr", "parameterType": "double",
+                   "feasible": {"min": 0.0, "max": 1.0}}]
+        assert SJ.bayes_suggestions(params, 5, seed=1) == \
+            SJ.random_suggestions(params, 5, seed=1)
+
+    def test_full_bayes_sweep_succeeds(self, world):
+        cluster, study_ctl, jaxjob_ctl, kubelet = world
+        cluster.create(SJ.new_studyjob(
+            "sweep", algorithm="bayesianoptimization", parameters=PARAMS,
+            trial_template=TRIAL_TEMPLATE, max_trials=5, parallel_trials=1))
+        study = TestSweepLifecycle().run_all_trials(
+            cluster, study_ctl, jaxjob_ctl, kubelet,
+            objective=lambda p: (p["lr"] - 0.02) ** 2)
+        assert ob.cond_is_true(study, SJ.COND_SUCCEEDED)
+        assert study["status"]["trials"]["completed"] == 5
+        assert study["status"]["bestTrial"]["objective"] is not None
+
+
+class TestSuccessiveHalving:
+    ALGO_PARAMS = [{"name": "lr", "parameterType": "double",
+                    "feasible": {"min": 0.01, "max": 0.03, "steps": 3}}]
+    BUDGET_TEMPLATE = {
+        "spec": {
+            "replicas": 1,
+            "template": {"spec": {"containers": [{
+                "name": "jax", "image": "kubeflow-tpu/jaxrt:latest",
+                "command": ["python", "-m", "kubeflow_tpu.runtime.launcher",
+                            "--learning-rate=${lr}",
+                            "--total-steps=${budget}"],
+            }]}},
+        }
+    }
+
+    def test_rung_ladder(self):
+        rungs, eta = SJ.sha_rungs({"minBudget": 10, "maxBudget": 90,
+                                   "reduction": 3})
+        assert rungs == [10, 30, 90] and eta == 3
+
+    def test_bracket_respects_max_trial_cap(self):
+        # rungs [5, 10]: n0=4 -> 4+2=6 total; maxTrialCount is the cap
+        assert SJ.sha_bracket(6, [5, 10], 2) == 4
+        assert SJ.sha_bracket(4, [5, 10], 2) == 3  # 3+1=4
+        assert SJ.sha_bracket(1, [5, 10, 20], 2) == 1
+
+    def test_promotions_appear_only_when_rung_drains(self):
+        algo = {"minBudget": 5, "maxBudget": 10}
+        first = SJ.sha_suggestions(self.ALGO_PARAMS, 6, seed=0,
+                                   observations=[], algo=algo)
+        assert len(first) == 4 and all(s["budget"] == 5 for s in first)
+        # half the rung done -> still no promotions
+        obs = [{"parameters": dict(s), "objective": s["lr"]}
+               for s in first[:2]]
+        assert len(SJ.sha_suggestions(
+            self.ALGO_PARAMS, 6, seed=0, observations=obs, algo=algo)) == 4
+        # full rung done -> top half promoted to budget 10
+        obs = [{"parameters": dict(s), "objective": s["lr"]} for s in first]
+        out = SJ.sha_suggestions(self.ALGO_PARAMS, 6, seed=0,
+                                 observations=obs, algo=algo)
+        assert len(out) == 6  # never exceeds maxTrialCount
+        promoted = [s for s in out if s["budget"] == 10]
+        assert len(promoted) == 2
+        best_lrs = sorted(s["lr"] for s in first)[:2]
+        assert sorted(s["lr"] for s in promoted) == best_lrs
+
+    def test_full_sha_sweep_promotes_and_substitutes_budget(self, world):
+        cluster, study_ctl, jaxjob_ctl, kubelet = world
+        sj = SJ.new_studyjob(
+            "sweep", algorithm="hyperband", parameters=self.ALGO_PARAMS,
+            trial_template=self.BUDGET_TEMPLATE,
+            max_trials=4, parallel_trials=4)
+        sj["spec"]["algorithm"].update({"minBudget": 5, "maxBudget": 20,
+                                        "reduction": 2})
+        cluster.create(sj)
+        study = TestSweepLifecycle().run_all_trials(
+            cluster, study_ctl, jaxjob_ctl, kubelet,
+            objective=lambda p: p["lr"] / p["budget"])
+        assert ob.cond_is_true(study, SJ.COND_SUCCEEDED)
+        # maxTrialCount=4 caps the bracket: 2 at budget 5, 1 promoted to
+        # 10, 1 promoted to 20
+        assert study["status"]["trials"]["completed"] == 4
+        best = study["status"]["bestTrial"]
+        assert best["parameters"]["budget"] == 20
+        # ${budget} reached the trial command line
+        import json as _json
+        jobs = cluster.list(JT.API_VERSION, JT.KIND, namespace="default")
+        budgets = set()
+        for j in jobs:
+            cmd = j["spec"]["template"]["spec"]["containers"][0]["command"]
+            flag = [c for c in cmd if c.startswith("--total-steps=")][0]
+            budgets.add(int(flag.split("=")[1]))
+            p = _json.loads(ob.annotations_of(j)[
+                "studyjob.kubeflow.org/parameters"])
+            assert int(flag.split("=")[1]) == p["budget"]
+        assert budgets == {5, 10, 20}
