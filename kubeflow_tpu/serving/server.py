@@ -32,15 +32,22 @@ log = logging.getLogger("kubeflow_tpu.serving")
 @dataclass
 class ServedModel:
     """One versioned model: predict_fn maps a batched np array / dict of
-    arrays to predictions."""
+    arrays to predictions. batch_window_ms > 0 turns on cross-request
+    micro-batching: concurrent /predict calls within the window coalesce
+    into ONE padded device call (each jit dispatch has fixed overhead and
+    the MXU wants large batches; serving traffic is many small
+    requests — the TPU-native answer is coalescing, not more threads)."""
 
     name: str
     predict_fn: Callable[[Any], Any]
     version: int = 1
     signature: dict = field(default_factory=dict)
     pad_batches: bool = True
+    batch_window_ms: float = 0.0
+    max_batch: int = 64
+    _batcher: "MicroBatcher | None" = field(default=None, repr=False)
 
-    def predict(self, instances: list) -> list:
+    def _predict_now(self, instances: list) -> list:
         batch = _stack(instances)
         n = _batch_size(batch)
         if self.pad_batches:
@@ -49,6 +56,135 @@ class ServedModel:
             padded = batch
         out = self.predict_fn(padded)
         return _unstack(out, n)
+
+    def __post_init__(self):
+        # constructed eagerly (not lazily) so concurrent first requests
+        # can't race a lazy init
+        if self.batch_window_ms > 0:
+            self._batcher = MicroBatcher(
+                self._predict_now, max_batch=self.max_batch,
+                max_wait_ms=self.batch_window_ms)
+
+    def predict(self, instances: list) -> list:
+        if not instances:
+            raise ApiHttpError(400, "instances must be non-empty")
+        if self._batcher is not None:
+            return self._batcher.submit(instances)
+        return self._predict_now(instances)
+
+    def close(self) -> None:
+        if self._batcher is not None:
+            self._batcher.close()
+
+
+class _Pending:
+    __slots__ = ("instances", "event", "result", "error")
+
+    def __init__(self, instances: list):
+        self.instances = instances
+        self.event = threading.Event()
+        self.result: list | None = None
+        self.error: BaseException | None = None
+
+
+class MicroBatcher:
+    """Coalesces concurrent predict calls into single batched calls.
+
+    A worker thread blocks for the first pending request, then keeps
+    collecting arrivals until max_wait_ms elapses or max_batch instances
+    are queued, concatenates all instance lists into one call of
+    `fn(instances) -> results`, and scatters the per-request slices
+    back. Errors from fn propagate to every caller in that batch."""
+
+    def __init__(self, fn: Callable[[list], list], max_batch: int = 64,
+                 max_wait_ms: float = 5.0):
+        import queue as _queue
+
+        self.fn = fn
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1e3
+        self._q: "_queue.Queue[_Pending | None]" = _queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._carry: _Pending | None = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="serving-microbatch")
+        self._thread.start()
+
+    def submit(self, instances: list) -> list:
+        p = _Pending(instances)
+        # enqueue under the same lock close() takes to set _closed, so
+        # every pending lands strictly before the shutdown sentinel (a
+        # request behind the sentinel would block its caller forever)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("MicroBatcher is closed")
+            self._q.put(p)
+        p.event.wait()
+        if p.error is not None:
+            raise p.error
+        return p.result  # type: ignore[return-value]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(None)
+        self._thread.join(timeout=5)
+
+    def _run(self) -> None:
+        import queue as _queue
+        import time as _time
+
+        while True:
+            head = self._carry or self._q.get()
+            self._carry = None
+            if head is None:
+                return
+            group = [head]
+            total = len(head.instances)
+            deadline = _time.monotonic() + self.max_wait
+            stop = False
+            while total < self.max_batch:
+                timeout = deadline - _time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except _queue.Empty:
+                    break
+                if nxt is None:
+                    stop = True
+                    break
+                if total + len(nxt.instances) > self.max_batch:
+                    # would overshoot the device-batch cap (and pow2
+                    # padding would amplify it) — start the next group
+                    self._carry = nxt
+                    break
+                group.append(nxt)
+                total += len(nxt.instances)
+            self._dispatch(group)
+            if stop:
+                if self._carry is not None:
+                    self._dispatch([self._carry])
+                    self._carry = None
+                return
+
+    def _dispatch(self, group: list[_Pending]) -> None:
+        flat = [inst for p in group for inst in p.instances]
+        try:
+            results = self.fn(flat)
+        except BaseException as e:  # noqa: BLE001 - propagate to callers
+            for p in group:
+                p.error = e
+                p.event.set()
+            return
+        off = 0
+        for p in group:
+            p.result = results[off:off + len(p.instances)]
+            off += len(p.instances)
+            p.event.set()
 
 
 def _next_pow2(n: int) -> int:
@@ -99,7 +235,21 @@ class ModelServer:
 
     def register(self, model: ServedModel) -> None:
         with self._lock:
-            self._models.setdefault(model.name, {})[model.version] = model
+            versions = self._models.setdefault(model.name, {})
+            old = versions.get(model.version)
+            versions[model.version] = model
+        if old is not None:
+            # hot-swap: release the replaced model's micro-batch worker
+            # (and with it the old predict closure) instead of leaking
+            # one thread per reload
+            old.close()
+
+    def close(self) -> None:
+        """Shut down every model's micro-batch worker (service exit)."""
+        with self._lock:
+            models = [m for vs in self._models.values() for m in vs.values()]
+        for m in models:
+            m.close()
 
     def _get(self, name: str, version: int | None = None) -> ServedModel:
         versions = self._models.get(name)
@@ -232,7 +382,10 @@ def main() -> None:  # pragma: no cover - container entry
                                               checkpoint_dir=ckpt or args.checkpoint_dir))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)
-    svc.serve_forever()
+    try:
+        svc.serve_forever()
+    finally:
+        server.close()
 
 
 if __name__ == "__main__":  # pragma: no cover

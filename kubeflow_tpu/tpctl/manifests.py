@@ -60,8 +60,20 @@ def _webapps_virtualservice(ns: str, prefixes: dict[str, str]) -> dict:
     http = []
     for name, prefix in sorted(prefixes.items(),
                                key=lambda kv: -len(kv[1])):
+        if prefix == "/":
+            # the dashboard must NOT get a '/' prefix catch-all: the
+            # notebook/tensorboard controllers create per-resource
+            # VirtualServices (/notebook/<ns>/<name>/) on the same host,
+            # and Istio's cross-VS merge order could let a catch-all
+            # shadow them. Enumerate the dashboard's own surfaces
+            # instead; unknown paths 404 at the gateway, deterministically.
+            match = [{"uri": {"exact": "/"}},
+                     {"uri": {"prefix": "/dashboard"}},
+                     {"uri": {"prefix": "/api/"}}]
+        else:
+            match = [{"uri": {"prefix": prefix}}]
         rule: dict = {
-            "match": [{"uri": {"prefix": prefix}}],
+            "match": match,
             "route": [{"destination": {
                 "host": f"{name}.{ns}.svc.cluster.local",
                 "port": {"number": 80}}}],

@@ -221,6 +221,9 @@ def sha_suggestions(parameters: list[dict], max_trials: int, seed: int,
     eta x the budget. Every suggestion carries a ``budget`` param for
     the trial template's ``${budget}`` token."""
     rungs, eta = sha_rungs(algo or {})
+    # a ladder longer than the trial budget can't fit even at n0=1 (one
+    # trial per rung): drop the top rungs so the cap always holds
+    rungs = rungs[:max(1, max_trials)]
     n0 = sha_bracket(max_trials, rungs, eta)
     out = [dict(c, budget=rungs[0])
            for c in random_suggestions(parameters, n0, seed)]

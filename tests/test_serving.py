@@ -138,3 +138,149 @@ class TestFlaxServing:
         preds = np.asarray(r.json()["predictions"])
         assert preds.shape == (2, 10)
         np.testing.assert_allclose(preds.sum(axis=-1), 1.0, atol=1e-4)
+
+
+class TestMicroBatching:
+    """Cross-request micro-batching: concurrent predicts coalesce into
+    one padded device call (the TPU-native serving pattern — jit
+    dispatch overhead amortizes, the MXU sees real batches)."""
+
+    def test_concurrent_requests_coalesce(self):
+        import threading
+
+        calls = []
+
+        def fn(instances):
+            calls.append(len(instances))
+            return [x * 2 for x in instances]
+
+        from kubeflow_tpu.serving.server import MicroBatcher
+
+        b = MicroBatcher(fn, max_batch=64, max_wait_ms=150.0)
+        results = {}
+        barrier = threading.Barrier(8)
+
+        def worker(i):
+            barrier.wait()
+            results[i] = b.submit([i, i + 100])
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        b.close()
+        for i in range(8):
+            assert results[i] == [2 * i, 2 * (i + 100)]
+        assert sum(calls) == 16
+        assert len(calls) < 8, f"no coalescing happened: {calls}"
+
+    def test_max_batch_bounds_group_size(self):
+        import threading
+
+        calls = []
+
+        def fn(instances):
+            calls.append(len(instances))
+            return list(instances)
+
+        from kubeflow_tpu.serving.server import MicroBatcher
+
+        b = MicroBatcher(fn, max_batch=4, max_wait_ms=200.0)
+        barrier = threading.Barrier(6)
+
+        def worker(i):
+            barrier.wait()
+            b.submit([i])
+
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(6)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        b.close()
+        assert sum(calls) == 6
+        assert max(calls) <= 4
+
+    def test_errors_propagate_to_all_callers(self):
+        from kubeflow_tpu.serving.server import MicroBatcher
+
+        def fn(instances):
+            raise RuntimeError("boom")
+
+        b = MicroBatcher(fn, max_batch=8, max_wait_ms=10.0)
+        with pytest.raises(RuntimeError, match="boom"):
+            b.submit([1])
+        b.close()
+
+    def test_http_concurrent_predicts_through_one_model_call(self):
+        import threading
+
+        calls = []
+
+        def fn(batch):
+            calls.append(len(batch))
+            return softmax_rows(np.asarray(batch, np.float64))
+
+        srv = ModelServer()
+        srv.register(ServedModel(name="m", predict_fn=fn,
+                                 batch_window_ms=150.0))
+        svc = srv.serve(host="127.0.0.1", port=0)
+        svc.serve_background()
+        url = f"http://127.0.0.1:{svc.port}/v1/models/m:predict"
+        outs = {}
+        barrier = threading.Barrier(4)
+
+        def worker(i):
+            barrier.wait()
+            outs[i] = requests.post(url, json={"instances": [[i, 0.0]]},
+                                    timeout=30).json()
+
+        try:
+            ts = [threading.Thread(target=worker, args=(i,))
+                  for i in range(4)]
+            [t.start() for t in ts]
+            [t.join() for t in ts]
+        finally:
+            svc.shutdown()
+        for i in range(4):
+            got = outs[i]["predictions"][0]
+            want = softmax_rows(np.asarray([[i, 0.0]]))[0]
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+        assert len(calls) < 4, f"requests were not coalesced: {calls}"
+
+
+def test_microbatcher_never_overshoots_max_batch():
+    from kubeflow_tpu.serving.server import MicroBatcher
+
+    import threading
+
+    calls = []
+
+    def fn(instances):
+        calls.append(len(instances))
+        return list(instances)
+
+    b = MicroBatcher(fn, max_batch=4, max_wait_ms=200.0)
+    barrier = threading.Barrier(3)
+
+    def worker(i):
+        barrier.wait()
+        b.submit([i] * 3)  # 3 instances each: 2 would overshoot cap 4
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    b.close()
+    assert sum(calls) == 9
+    assert max(calls) <= 4
+
+
+def test_microbatcher_close_rejects_new_and_drains_pending():
+    from kubeflow_tpu.serving.server import MicroBatcher
+
+    def fn(instances):
+        return list(instances)
+
+    b = MicroBatcher(fn, max_batch=8, max_wait_ms=5.0)
+    assert b.submit([1]) == [1]
+    b.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        b.submit([2])
+    b.close()  # idempotent

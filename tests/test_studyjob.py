@@ -275,3 +275,19 @@ class TestSuccessiveHalving:
                 "studyjob.kubeflow.org/parameters"])
             assert int(flag.split("=")[1]) == p["budget"]
         assert budgets == {5, 10, 20}
+
+
+def test_sha_cap_holds_with_ladder_longer_than_budget():
+    """maxTrialCount=2 with a 3-rung ladder: the top rung is dropped so
+    the total never exceeds the cap (1 trial at each remaining rung)."""
+    params = [{"name": "lr", "parameterType": "double",
+               "feasible": {"min": 0.0, "max": 1.0}}]
+    algo = {"minBudget": 1, "maxBudget": 4, "reduction": 2}
+    out = SJ.sha_suggestions(params, 2, seed=0, observations=[], algo=algo)
+    assert len(out) == 1 and out[0]["budget"] == 1
+    obs = [{"parameters": dict(out[0]), "objective": 0.5}]
+    out2 = SJ.sha_suggestions(params, 2, seed=0, observations=obs, algo=algo)
+    assert len(out2) == 2 and out2[1]["budget"] == 2
+    obs.append({"parameters": dict(out2[1]), "objective": 0.4})
+    out3 = SJ.sha_suggestions(params, 2, seed=0, observations=obs, algo=algo)
+    assert len(out3) == 2  # budget-4 rung dropped: cap respected

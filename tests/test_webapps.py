@@ -604,12 +604,23 @@ def test_manifests_route_webapp_prefixes_through_gateway():
     # '/' catch-all could shadow the app prefixes
     assert ob.meta(vs)["name"] == "kubeflow-webapps"
     rules = vs["spec"]["http"]
-    prefixes = [r["match"][0]["uri"]["prefix"] for r in rules]
-    assert prefixes[-1] == "/" and set(prefixes) == \
-        {"/", "/jupyter/", "/tensorboards/"}
-    for r in rules:
-        prefix = r["match"][0]["uri"]["prefix"]
-        assert (prefix == "/") == ("rewrite" not in r)
+    app_rules = {r["route"][0]["destination"]["host"].split(".")[0]: r
+                 for r in rules}
+    for name in ("jupyter-web-app", "tensorboards-web-app"):
+        assert app_rules[name]["rewrite"] == {"uri": "/"}
+    assert app_rules["jupyter-web-app"]["match"][0]["uri"] == \
+        {"prefix": "/jupyter/"}
+    # the dashboard enumerates its surfaces instead of a '/' prefix
+    # catch-all, which could shadow the controllers' per-resource
+    # /notebook/... VirtualServices under Istio's cross-VS merge order
+    dash = app_rules["centraldashboard"]
+    assert "rewrite" not in dash
+    dash_uris = dash["match"]
+    assert {"uri": {"exact": "/"}} in dash_uris
+    assert {"uri": {"prefix": "/api/"}} in dash_uris
+    assert not any(m["uri"].get("prefix") == "/" for m in dash_uris)
+    # app prefixes come before the dashboard rule
+    assert rules.index(app_rules["jupyter-web-app"]) < rules.index(dash)
     # istio off -> no webapp VirtualServices rendered
     objs_plain = render(TpuDef(use_istio=False))
     assert not [o for o in objs_plain if o.get("kind") == "VirtualService"]
