@@ -255,6 +255,25 @@ class JAXJobReconciler(Reconciler):
                 client.record_event(job, "JAXJobSucceeded", "all workers succeeded")
             return None
 
+        # slice health: a node going NotReady (or tainted for impending
+        # TPU maintenance) under a live gang means the mesh is about to
+        # break — restart proactively and resume from the checkpoint
+        # instead of waiting for pods to crash (SURVEY.md §5 failure
+        # detection; no reference precedent). Checked only AFTER the
+        # completion branch above: a fully-succeeded gang whose node
+        # drains afterwards must stay Succeeded, not be re-run.
+        bad_nodes = self._unhealthy_nodes(client, pods)
+        if bad_nodes and spec.get("restartPolicy", T.RESTART_GANG) == T.RESTART_GANG:
+            if job["status"].get("preemptions", 0) >= spec.get("maxPreemptions", 50):
+                return self._fail(client, job,
+                                  f"unhealthy nodes: {bad_nodes}; "
+                                  "preemption budget exhausted")
+            return self._gang_restart(
+                client, job, pods, reason="SliceUnhealthy",
+                message=f"unhealthy nodes under gang: {bad_nodes}",
+                preemption=True,
+            )
+
         if n_running == replicas:
             if not ob.cond_is_true(job, T.COND_RUNNING):
                 ob.cond_set(job, T.COND_RUNNING, "True", "AllWorkersRunning",
@@ -272,30 +291,97 @@ class JAXJobReconciler(Reconciler):
 
     # -- gang restart -------------------------------------------------------
 
+    @staticmethod
+    def _pod_exit_code(pod: dict) -> int | None:
+        """Exit code of the MAIN container (spec.containers[0] by
+        convention) — a sidecar's exit code must not mask it."""
+        statuses = (pod.get("status") or {}).get("containerStatuses") or []
+        containers = (pod.get("spec") or {}).get("containers") or []
+        main = containers[0].get("name") if containers else None
+        ordered = sorted(statuses, key=lambda cs: cs.get("name") != main)
+        for cs in ordered:
+            term = (cs.get("state") or {}).get("terminated") or {}
+            if "exitCode" in term:
+                return term["exitCode"]
+        return None
+
+    @staticmethod
+    def _pod_preempted(pod: dict) -> bool:
+        """Graceful preemption (main container exited EX_TEMPFAIL) or a
+        kubelet eviction (phase Failed, reason Evicted, often with no
+        containerStatuses at all — a hard node preemption)."""
+        if (pod.get("status") or {}).get("reason") == "Evicted":
+            return True
+        return JAXJobReconciler._pod_exit_code(pod) == T.EXIT_PREEMPTED
+
+    def _unhealthy_nodes(self, client, pods) -> list[str]:
+        """Nodes under gang pods that are NotReady or tainted for
+        impending TPU maintenance. One GET per distinct node."""
+        names = {(p.get("spec") or {}).get("nodeName") for p in pods}
+        names.discard(None)
+        bad: set[str] = set()
+        for node_name in names:
+            node = client.get_or_none("v1", "Node", node_name)
+            if node is None:
+                bad.add(node_name)
+                continue
+            conds = (node.get("status") or {}).get("conditions") or []
+            ready = next((c for c in conds if c.get("type") == "Ready"), None)
+            if ready is not None and ready.get("status") != "True":
+                bad.add(node_name)
+            elif any(t.get("key") == T.TAINT_IMPENDING_TERMINATION
+                     for t in (node.get("spec") or {}).get("taints") or []):
+                bad.add(node_name)
+        return sorted(bad)
+
     def _maybe_restart_or_fail(self, client, job, pods, phases) -> Result | None:
         spec = job["spec"]
         failed = [n for n, ph in phases.items() if ph == "Failed"]
-        if (
-            spec.get("restartPolicy", T.RESTART_GANG) == T.RESTART_GANG
-            and (job["status"].get("restarts", 0) < spec.get("maxRestarts", 3))
-        ):
+        failed_pods = [p for p in pods
+                       if phases.get(ob.meta(p)["name"]) == "Failed"]
+        gang_policy = spec.get("restartPolicy", T.RESTART_GANG) == T.RESTART_GANG
+        # every failure is a preemption (EX_TEMPFAIL or kubelet eviction)
+        # => not a crash: the workers were evicted through no fault of
+        # the job. Preemptions never consume the maxRestarts crash
+        # budget, but a generous maxPreemptions ceiling bounds a
+        # pathological always-75 loop.
+        preempted = bool(failed_pods) and all(
+            self._pod_preempted(p) for p in failed_pods)
+        if gang_policy and preempted:
+            if job["status"].get("preemptions", 0) < spec.get("maxPreemptions", 50):
+                return self._gang_restart(
+                    client, job, pods, reason="WorkerPreempted",
+                    message=f"preempted workers: {failed}",
+                    preemption=True,
+                )
+            return self._fail(client, job,
+                              f"workers preempted: {failed}; "
+                              "preemption budget exhausted")
+        if gang_policy and \
+                job["status"].get("restarts", 0) < spec.get("maxRestarts", 3):
             return self._gang_restart(
                 client, job, pods, reason="WorkerFailed",
                 message=f"failed workers: {failed}",
             )
+        return self._fail(client, job,
+                          f"workers failed: {failed}; restarts exhausted")
+
+    def _fail(self, client, job, message: str) -> None:
         ob.cond_set(job, T.COND_RUNNING, "False", "JobFailed", "")
-        ob.cond_set(job, T.COND_FAILED, "True", "WorkerFailed",
-                    f"workers failed: {failed}; restarts exhausted")
+        ob.cond_set(job, T.COND_FAILED, "True", "WorkerFailed", message)
         client.update_status(job)
         if self.record_events:
-            client.record_event(job, "JAXJobFailed", f"workers failed: {failed}", "Warning")
+            client.record_event(job, "JAXJobFailed", message, "Warning")
         return None
 
-    def _gang_restart(self, client, job, pods, reason: str, message: str) -> Result:
+    def _gang_restart(self, client, job, pods, reason: str, message: str,
+                      preemption: bool = False) -> Result:
         """Delete the whole pod set; next reconcile recreates the gang.
         The TPU-native answer to per-replica restartPolicy: a partially
         restarted jax.distributed world can never re-form a mesh, so the
-        gang restarts as a unit and resumes from the latest checkpoint."""
+        gang restarts as a unit and resumes from the latest checkpoint.
+        preemption=True counts in status.preemptions instead of the
+        status.restarts crash budget."""
         m = ob.meta(job)
         for p in pods:
             try:
@@ -303,10 +389,12 @@ class JAXJobReconciler(Reconciler):
             except ob.NotFound:
                 pass
         job["status"] = job.get("status") or {}
-        job["status"]["restarts"] = job["status"].get("restarts", 0) + 1
+        counter = "preemptions" if preemption else "restarts"
+        job["status"][counter] = job["status"].get(counter, 0) + 1
         ob.cond_set(job, T.COND_RUNNING, "False", reason, "")
         ob.cond_set(job, T.COND_RESTARTING, "True", reason,
-                    f"{message}; gang restart #{job['status']['restarts']}")
+                    f"{message}; gang restart ({counter} "
+                    f"#{job['status'][counter]})")
         client.update_status(job)
         gang_restarts().inc()
         if self.record_events:
@@ -314,8 +402,29 @@ class JAXJobReconciler(Reconciler):
         return Result(requeue_after=0.1)
 
 
+def _node_mapper(client):
+    """A Node event re-enqueues every non-terminal JAXJob: the reconcile
+    pass checks whether the node backing one of its gang pods went
+    unhealthy (slice-health detection). Coarse fan-out, but node events
+    are rare and reconciles are cheap."""
+    from kubeflow_tpu.control.runtime import Request
+
+    def fn(_node: dict) -> list[Request]:
+        reqs = []
+        for j in client.list(T.API_VERSION, T.KIND):
+            if ob.cond_is_true(j, T.COND_SUCCEEDED) or \
+                    ob.cond_is_true(j, T.COND_FAILED):
+                continue
+            m = ob.meta(j)
+            reqs.append(Request(m.get("namespace") or "default", m["name"]))
+        return reqs
+
+    return fn
+
+
 def build_controller(client, record_events: bool = True) -> Controller:
     rec = JAXJobReconciler(record_events=record_events)
     ctl = Controller("jaxjob", client, rec)
     ctl.watches_primary(T.API_VERSION, T.KIND).owns("v1", "Pod").owns("v1", "Service")
+    ctl.maps("v1", "Node", _node_mapper(client))
     return ctl

@@ -50,6 +50,15 @@ DEFAULT_COORDINATOR_PORT = 8476
 RESTART_GANG = "GangOnFailure"
 RESTART_NEVER = "Never"
 
+# The launcher's graceful-preemption exit status (runtime/preemption.py
+# EX_TEMPFAIL): the worker checkpointed and asked for a gang restart.
+# Preemptions are counted in status.preemptions and do NOT consume the
+# maxRestarts crash budget — TPU maintenance can evict a slice many
+# times without the job being at fault.
+EXIT_PREEMPTED = 75
+# GKE taints nodes ahead of TPU maintenance/preemption; treat as unhealthy
+TAINT_IMPENDING_TERMINATION = "cloud.google.com/impending-node-termination"
+
 
 def new_jaxjob(
     name: str,

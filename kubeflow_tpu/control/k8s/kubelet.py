@@ -64,13 +64,15 @@ class FakeKubelet:
         pod = self.cluster.get("v1", "Pod", name, namespace)
         _set_phase(self.cluster, pod, "Succeeded")
 
-    def fail(self, name: str, namespace: str = "default", message: str = "boom") -> None:
+    def fail(self, name: str, namespace: str = "default", message: str = "boom",
+             exit_code: int = 1) -> None:
         pod = self.cluster.get("v1", "Pod", name, namespace)
         _set_phase(
             self.cluster, pod, "Failed",
             containerStatuses=[{
                 "name": "main",
-                "state": {"terminated": {"exitCode": 1, "message": message}},
+                "state": {"terminated": {"exitCode": exit_code,
+                                         "message": message}},
                 "ready": False,
             }],
         )

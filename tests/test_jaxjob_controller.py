@@ -222,3 +222,207 @@ class TestIdempotency:
             for p in cluster.list("v1", "Pod", namespace="default")
         }
         assert pods_before == pods_after
+
+
+class TestPreemptionAwareRestart:
+    """EX_TEMPFAIL (75) = graceful preemption: gang restarts without
+    consuming the maxRestarts crash budget (launcher contract,
+    runtime/preemption.py)."""
+
+    def test_preemption_exit_does_not_burn_restart_budget(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2, max_restarts=1)
+        # preempt the gang more times than maxRestarts allows for crashes
+        for round_ in range(3):
+            drain(ctl)
+            kubelet.step()
+            drain(ctl)
+            for i in range(2):
+                kubelet.fail(worker_name("train", i),
+                             exit_code=T.EXIT_PREEMPTED,
+                             message="preempted")
+            drain(ctl)
+            job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+            assert not ob.cond_is_true(job, T.COND_FAILED), round_
+        assert job["status"]["preemptions"] == 3
+        assert job["status"].get("restarts", 0) == 0
+        # the gang keeps getting recreated
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert len(pods) == 2
+
+    def test_mixed_crash_and_preemption_counts_as_crash(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2, max_restarts=3)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        kubelet.fail(worker_name("train", 0), exit_code=T.EXIT_PREEMPTED)
+        kubelet.fail(worker_name("train", 1), exit_code=1)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["restarts"] == 1
+        assert job["status"].get("preemptions", 0) == 0
+
+
+class TestSliceHealth:
+    """A NotReady or maintenance-tainted node under a running gang
+    triggers a proactive gang restart (counted as preemption)."""
+
+    def _schedule_onto_node(self, cluster, node_name):
+        node = ob.new_object("v1", "Node", node_name)
+        node["status"] = {"conditions": [{"type": "Ready", "status": "True"}]}
+        cluster.create(node)
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            p["spec"]["nodeName"] = node_name
+            cluster.update(p)
+
+    def test_node_not_ready_restarts_gang(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        self._schedule_onto_node(cluster, "tpu-node-0")
+        kubelet.step()
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_RUNNING)
+        # the node goes NotReady (TPU maintenance drain)
+        node = cluster.get("v1", "Node", "tpu-node-0")
+        node["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+        cluster.update_status(node)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["preemptions"] == 1
+        assert ob.cond_is_true(job, T.COND_RESTARTING)
+
+    def test_maintenance_taint_restarts_gang(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        self._schedule_onto_node(cluster, "tpu-node-1")
+        kubelet.step()
+        drain(ctl)
+        node = cluster.get("v1", "Node", "tpu-node-1")
+        node["spec"] = {"taints": [
+            {"key": T.TAINT_IMPENDING_TERMINATION, "effect": "NoSchedule"}]}
+        cluster.update(node)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["preemptions"] == 1
+
+    def test_healthy_node_no_restart(self, world):
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        self._schedule_onto_node(cluster, "tpu-node-2")
+        kubelet.step()
+        drain(ctl)
+        node = cluster.get("v1", "Node", "tpu-node-2")
+        cluster.update(node)  # touch: node event with nothing wrong
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"].get("preemptions", 0) == 0
+        assert ob.cond_is_true(job, T.COND_RUNNING)
+
+
+class TestSliceHealthOrdering:
+    def test_succeeded_gang_on_draining_node_stays_succeeded(self, world):
+        """Node drain right after the workload completes must not re-run
+        the finished job (success branch precedes the health check)."""
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2)
+        drain(ctl)
+        node = ob.new_object("v1", "Node", "tpu-node-9")
+        node["status"] = {"conditions": [{"type": "Ready", "status": "True"}]}
+        cluster.create(node)
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            p["spec"]["nodeName"] = "tpu-node-9"
+            cluster.update(p)
+        kubelet.step()
+        drain(ctl)
+        for i in range(2):
+            kubelet.succeed(worker_name("train", i))
+        # node drains in the same instant the workers finish
+        node = cluster.get("v1", "Node", "tpu-node-9")
+        node["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+        cluster.update_status(node)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_SUCCEEDED)
+        assert job["status"].get("preemptions", 0) == 0
+
+
+class TestPreemptionClassification:
+    def test_eviction_without_container_status_is_preemption(self, world):
+        """Kubelet evictions (reason=Evicted, no containerStatuses) are
+        node preemptions, not crashes — no maxRestarts burn."""
+        cluster, ctl, kubelet = world
+        make_job(cluster, replicas=2, max_restarts=1)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        for i in range(2):
+            pod = cluster.get("v1", "Pod", worker_name("train", i), "default")
+            pod.setdefault("status", {}).update(
+                {"phase": "Failed", "reason": "Evicted",
+                 "containerStatuses": []})
+            cluster.update_status(pod)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"]["preemptions"] == 1
+        assert job["status"].get("restarts", 0) == 0
+
+    def test_sidecar_exit_code_does_not_mask_main(self, world):
+        """Main container crash (exit 1) with a sidecar that terminated
+        75 must classify as crash: main container's code wins."""
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("train", replicas=1)
+        job["spec"]["template"] = {"spec": {"containers": [
+            {"name": "main", "image": "jaxrt"},
+            {"name": "sidecar", "image": "logger"}]}}
+        cluster.create(job)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        pod = cluster.get("v1", "Pod", worker_name("train", 0), "default")
+        pod.setdefault("status", {}).update({
+            "phase": "Failed",
+            "containerStatuses": [
+                {"name": "sidecar",
+                 "state": {"terminated": {"exitCode": T.EXIT_PREEMPTED}}},
+                {"name": "main",
+                 "state": {"terminated": {"exitCode": 1}}},
+            ]})
+        cluster.update_status(pod)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"].get("restarts", 0) == 1
+        assert job["status"].get("preemptions", 0) == 0
+
+    def test_preemption_budget_backstop(self, world):
+        """An always-preempting gang eventually fails instead of
+        restarting forever."""
+        cluster, ctl, kubelet = world
+        job = make_job(cluster, replicas=1, max_restarts=1)
+        job["spec"]["maxPreemptions"] = 2
+        cluster.update(job)
+        for _ in range(4):
+            drain(ctl)
+            kubelet.step()
+            drain(ctl)
+            pods = cluster.list("v1", "Pod", namespace="default")
+            if not pods:
+                break
+            for p in pods:
+                if (p.get("status") or {}).get("phase") == "Running":
+                    kubelet.fail(ob.meta(p)["name"],
+                                 exit_code=T.EXIT_PREEMPTED)
+            drain(ctl)
+            job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+            if ob.cond_is_true(job, T.COND_FAILED):
+                break
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_FAILED)
+        assert job["status"]["preemptions"] == 2
