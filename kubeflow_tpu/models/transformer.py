@@ -111,7 +111,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, decode_index=None):
+    def __call__(self, x, positions, segment_ids=None, decode_index=None,
+                 pad_len=None):
         cfg = self.cfg
         init = nn.initializers.normal(0.02)
         dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
@@ -152,7 +153,13 @@ class Attention(nn.Module):
             logits = jnp.einsum(
                 "bqhd,bkhd->bhqk", q, kf,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
-            mask = jnp.arange(cfg.max_seq_len)[None, None, None, :] <= idx
+            pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
+            mask = pos <= idx
+            if pad_len is not None:
+                # left-padded ragged prompts: positions before each row's
+                # real start are pad garbage and must not be attended to
+                # (RoPE is relative, so masked left-padding is exact)
+                mask = mask & (pos >= pad_len[:, None, None, None])
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
             out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vf.dtype), vf)
@@ -215,11 +222,12 @@ class Block(nn.Module):
     use_moe: bool = False
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids=None, decode_index=None):
+    def __call__(self, x, positions, segment_ids=None, decode_index=None,
+                 pad_len=None):
         cfg = self.cfg
         x = x + Attention(cfg, name="attn")(
             RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions,
-            segment_ids, decode_index
+            segment_ids, decode_index, pad_len
         )
         if self.use_moe:
             from kubeflow_tpu.ops.moe import MoEBlock
@@ -255,7 +263,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, segment_ids=None,
-                 decode_index=None):
+                 decode_index=None, pad_len=None):
         cfg = self.cfg
         del train  # no dropout in the speed-run configuration
         emb = self.param(
@@ -277,7 +285,7 @@ class TransformerLM(nn.Module):
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = Block(cfg, use_moe=use_moe, name=f"layer_{i}")(
-                    x, positions, None, decode_index)
+                    x, positions, None, decode_index, pad_len)
             x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
             return nn.DenseGeneral(
                 cfg.vocab_size, use_bias=False, dtype=jnp.float32,

@@ -48,15 +48,24 @@ def _sample(logits, temperature: float, top_k: int, rng):
                                              "temperature", "top_k"))
 def generate(model, variables, prompt: jax.Array, *,
              max_new_tokens: int, temperature: float = 0.0, top_k: int = 0,
-             seed: int = 0) -> jax.Array:
+             seed: int | jax.Array = 0, pad_len: jax.Array | None = None
+             ) -> jax.Array:
     """Generate `max_new_tokens` continuations.
 
-    prompt: [B, Lp] int32 (full prompt; all rows same length — pad and
-    track lengths host-side for ragged batches). Returns [B, Lp + N].
+    prompt: [B, Lp] int32 (full prompt; all rows same length). For
+    ragged batches, LEFT-pad each row to Lp and pass `pad_len` [B] (the
+    number of pad positions per row): padded positions are masked out of
+    decode attention, and RoPE being relative makes masked left-padding
+    exact. `seed` may be a traced scalar (vary per call for independent
+    samples). Returns [B, Lp + N].
     """
     b, lp = prompt.shape
     params = {"params": variables["params"]}
     cache = init_cache(model, variables, b)
+
+    # kwarg only when needed: models without ragged-prompt support keep
+    # their existing apply signature
+    pad_kw = {} if pad_len is None else {"pad_len": pad_len}
 
     def step(cache, tok_col, idx):
         out, mut = model.apply(
@@ -65,6 +74,7 @@ def generate(model, variables, prompt: jax.Array, *,
             train=False,
             decode_index=idx,
             mutable=["cache"],
+            **pad_kw,
         )
         return mut["cache"], out[:, 0]                 # logits [B, V]
 

@@ -194,13 +194,27 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+def _ragged_ok_asarray(rows: list) -> np.ndarray:
+    """np.asarray, falling back to an object array for ragged rows
+    (e.g. variable-length token prompts — padded later by the model's
+    own host-side handling)."""
+    try:
+        return np.asarray(rows)
+    except ValueError:
+        arr = np.empty(len(rows), dtype=object)
+        for i, r in enumerate(rows):
+            arr[i] = r
+        return arr
+
+
 def _stack(instances: list) -> Any:
     if not instances:
         raise ApiHttpError(400, "instances must be non-empty")
     first = instances[0]
     if isinstance(first, dict):
-        return {k: np.asarray([inst[k] for inst in instances]) for k in first}
-    return np.asarray(instances)
+        return {k: _ragged_ok_asarray([inst[k] for inst in instances])
+                for k in first}
+    return _ragged_ok_asarray(instances)
 
 
 def _batch_size(batch: Any) -> int:
@@ -358,6 +372,81 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
                                   "method_name": "predict"})
 
 
+def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
+                       max_new_tokens: int = 32, temperature: float = 0.0,
+                       top_k: int = 0, seed: int = 0,
+                       checkpoint_dir: str | None = None,
+                       **model_kwargs) -> ServedModel:
+    """Wrap a zoo LM into a generative ServedModel (the transformer-era
+    analogue of the TF-Serving classifier path).
+
+    Request instances are `{"tokens": [int, ...]}` (pre-tokenized
+    prompts); each is left-padded/truncated host-side to the fixed
+    `prompt_len` and decoded with the KV-cache loop
+    (runtime/generate.py) for exactly `max_new_tokens` steps — one
+    compiled program per batch bucket, never per request shape (static
+    shapes are an XLA requirement). Responses carry the new tokens only.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.runtime.generate import generate
+
+    model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
+                      **model_kwargs)
+    variables = None
+    if checkpoint_dir:
+        from kubeflow_tpu.runtime.checkpoint import restore_variables
+
+        variables, step = restore_variables(checkpoint_dir)
+        log.info("model %s: restored variables from %s step %d", name,
+                 checkpoint_dir, step)
+
+    import itertools
+
+    # temperature>0: each request gets a fresh seed (generate() takes it
+    # as a traced scalar, so this does NOT recompile per request);
+    # temperature==0 stays at the fixed seed — greedy is deterministic.
+    request_seed = itertools.count(seed).__next__
+
+    def predict(batch):
+        nonlocal variables
+        toks = batch["tokens"] if isinstance(batch, dict) else batch
+        # host-side ragged handling: LEFT-pad / keep the LAST prompt_len
+        # tokens so the most recent context survives a trim; pad_lens
+        # mask the pad positions out of decode attention (generate.py)
+        vocab = model.cfg.vocab_size
+        rows, pad_lens = [], []
+        for row in np.asarray(toks, dtype=object):
+            row = [int(t) for t in (row if hasattr(row, "__len__") else [row])]
+            bad = [t for t in row if not 0 <= t < vocab]
+            if bad:
+                # JAX gather clamps out-of-range indices silently; a
+                # tokenizer/vocab mismatch must be a 400, not garbage
+                raise ApiHttpError(
+                    400, f"token ids out of range [0, {vocab}): {bad[:5]}")
+            row = row[-prompt_len:]
+            pad_lens.append(prompt_len - len(row))
+            rows.append([0] * (prompt_len - len(row)) + row)
+        prompt = jnp.asarray(rows, jnp.int32)
+        if variables is None:
+            variables = model.init(jax.random.PRNGKey(seed),
+                                   prompt[:, :1], train=False)
+        out = np.asarray(generate(
+            model, variables, prompt, max_new_tokens=max_new_tokens,
+            temperature=temperature, top_k=top_k,
+            seed=request_seed() if temperature > 0 else seed,
+            pad_len=jnp.asarray(pad_lens, jnp.int32)))
+        return out[:, prompt_len:]  # new tokens only
+
+    return ServedModel(
+        name=name, predict_fn=predict, pad_batches=True,
+        signature={"inputs": "tokens", "method_name": "generate",
+                   "prompt_len": prompt_len,
+                   "max_new_tokens": max_new_tokens})
+
+
 def main() -> None:  # pragma: no cover - container entry
     import argparse
 
@@ -368,8 +457,14 @@ def main() -> None:  # pragma: no cover - container entry
     p.add_argument("--checkpoint-dir", default=None,
                    help="orbax checkpoint dir to restore model weights from "
                         "(single --model only; use name=zoo@dir per model)")
+    p.add_argument("--lm", action="append", default=[],
+                   help="generative LM entry: name=zoo_model[@ckpt_dir], "
+                        "e.g. chat=gpt-125m")
+    p.add_argument("--prompt-len", type=int, default=128)
+    p.add_argument("--max-new-tokens", type=int, default=32)
     args = p.parse_args()
-    models = args.model or ["mnist=resnet18"]
+    # default classifier only when nothing at all was requested
+    models = args.model or ([] if args.lm else ["mnist=resnet18"])
     if args.checkpoint_dir and len(models) > 1:
         p.error("--checkpoint-dir applies to exactly one --model; "
                 "use name=zoo@ckpt_dir syntax for multiple models")
@@ -380,6 +475,13 @@ def main() -> None:  # pragma: no cover - container entry
         server.register(serve_flax_classifier(name, zoo or "resnet18",
                                               num_classes=10,
                                               checkpoint_dir=ckpt or args.checkpoint_dir))
+    for spec in args.lm:
+        name, _, zoo = spec.partition("=")
+        zoo, _, ckpt = zoo.partition("@")
+        server.register(serve_lm_generator(
+            name, zoo or "gpt-125m", prompt_len=args.prompt_len,
+            max_new_tokens=args.max_new_tokens,
+            checkpoint_dir=ckpt or None))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)
     try:

@@ -59,3 +59,60 @@ def test_gqa_cache_shapes():
     for leaf in leaves:
         assert leaf.shape[0] == 3 and leaf.shape[1] == 64  # B, max_seq
         assert leaf.shape[2] == 2  # n_kv_heads of transformer-test
+
+
+def test_left_padded_prompt_with_pad_len_matches_unpadded():
+    """Masked left-padding is exact: a row left-padded to Lp with its
+    pad positions masked must generate the same greedy tokens as the
+    same prompt run unpadded (RoPE is relative, pads are invisible)."""
+    model, variables = make_model_and_params()
+    real = jnp.asarray([[7, 3, 11, 5]], jnp.int32)
+    out_ref = generate(model, variables, real, max_new_tokens=6)
+
+    pad = 5
+    padded = jnp.concatenate(
+        [jnp.zeros((1, pad), jnp.int32), real], axis=1)
+    out_pad = generate(model, variables, padded, max_new_tokens=6,
+                       pad_len=jnp.asarray([pad], jnp.int32))
+    np.testing.assert_array_equal(
+        np.asarray(out_ref)[:, 4:], np.asarray(out_pad)[:, 4 + pad:])
+
+    # and WITHOUT the mask the pads leak into attention: the decode
+    # logits differ (argmax may coincide on a tiny model, logits won't)
+    def last_logits(pad_len):
+        cache = init_cache(model, variables, 1)
+        kw = {} if pad_len is None else {"pad_len": pad_len}
+        logits = None
+        for i in range(padded.shape[1]):
+            logits, mut = model.apply(
+                {"params": variables["params"], "cache": cache},
+                padded[:, i:i + 1], train=False, decode_index=i,
+                mutable=["cache"], **kw)
+            cache = mut["cache"]
+        return np.asarray(logits)
+
+    masked = last_logits(jnp.asarray([pad], jnp.int32))
+    unmasked = last_logits(None)
+    assert not np.allclose(masked, unmasked)
+
+
+def test_ragged_batch_rows_match_their_solo_runs():
+    """Different pad_len per row in one batch: each row generates what
+    it would generate alone."""
+    model, variables = make_model_and_params()
+    a = [2, 9, 4]
+    b = [8, 1, 6, 3, 10, 12]
+    lp = 6
+    batch = jnp.asarray([
+        [0] * (lp - len(a)) + a,
+        [0] * (lp - len(b)) + b,
+    ], jnp.int32)
+    pad = jnp.asarray([lp - len(a), lp - len(b)], jnp.int32)
+    out = np.asarray(generate(model, variables, batch, max_new_tokens=4,
+                              pad_len=pad))
+    solo_a = np.asarray(generate(
+        model, variables, jnp.asarray([a], jnp.int32), max_new_tokens=4))
+    solo_b = np.asarray(generate(
+        model, variables, jnp.asarray([b], jnp.int32), max_new_tokens=4))
+    np.testing.assert_array_equal(out[0, lp:], solo_a[0, len(a):])
+    np.testing.assert_array_equal(out[1, lp:], solo_b[0, len(b):])

@@ -284,3 +284,61 @@ def test_microbatcher_close_rejects_new_and_drains_pending():
     with pytest.raises(RuntimeError, match="closed"):
         b.submit([2])
     b.close()  # idempotent
+
+
+class TestLmGeneration:
+    """Generative LM serving: the transformer-era TF-Serving analogue
+    (pre-tokenized prompts in, new tokens out, static shapes)."""
+
+    @pytest.fixture(scope="class")
+    def lm_server(self):
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        srv = ModelServer()
+        srv.register(serve_lm_generator(
+            "tiny-lm", "transformer-test", prompt_len=8, max_new_tokens=4,
+            vocab_size=64))
+        svc = srv.serve(host="127.0.0.1", port=0)
+        svc.serve_background()
+        yield f"http://127.0.0.1:{svc.port}"
+        svc.shutdown()
+        srv.close()
+
+    def test_generates_fixed_new_tokens(self, lm_server):
+        r = requests.post(
+            f"{lm_server}/v1/models/tiny-lm:predict",
+            json={"instances": [{"tokens": [1, 2, 3]},
+                                {"tokens": [4, 5, 6, 7, 8, 9]}]},
+            timeout=120)
+        assert r.status_code == 200, r.text
+        preds = r.json()["predictions"]
+        assert len(preds) == 2
+        for p in preds:
+            assert len(p) == 4  # max_new_tokens
+            assert all(0 <= t < 64 for t in p)
+
+    def test_ragged_and_overlong_prompts(self, lm_server):
+        # an overlong prompt keeps its LAST prompt_len tokens
+        long_prompt = list(range(1, 20))
+        r = requests.post(
+            f"{lm_server}/v1/models/tiny-lm:predict",
+            json={"instances": [{"tokens": long_prompt},
+                                {"tokens": [2]}]},
+            timeout=120)
+        assert r.status_code == 200, r.text
+        assert len(r.json()["predictions"]) == 2
+
+    def test_greedy_is_deterministic(self, lm_server):
+        body = {"instances": [{"tokens": [3, 1, 4, 1, 5]}]}
+        a = requests.post(f"{lm_server}/v1/models/tiny-lm:predict",
+                          json=body, timeout=120).json()
+        b = requests.post(f"{lm_server}/v1/models/tiny-lm:predict",
+                          json=body, timeout=120).json()
+        assert a["predictions"] == b["predictions"]
+
+    def test_metadata_exposes_generation_signature(self, lm_server):
+        meta = requests.get(
+            f"{lm_server}/v1/models/tiny-lm/metadata", timeout=30).json()
+        sig = meta["metadata"]["signature_def"]
+        assert sig["method_name"] == "generate"
+        assert sig["prompt_len"] == 8 and sig["max_new_tokens"] == 4
