@@ -74,9 +74,21 @@ def attention(
         return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     on_tpu = jax.devices()[0].platform == "tpu"
     if impl == "flash" or (impl == "auto" and on_tpu and _flash_supported(q, k)):
-        from kubeflow_tpu.ops.flash_attention import flash_attention
+        import os
 
-        return flash_attention(q, k, v, causal=causal)
+        from kubeflow_tpu.ops.flash_attention import (
+            DEFAULT_BLOCK_Q,
+            DEFAULT_BLOCK_K,
+            flash_attention,
+        )
+
+        # kernel tile sizes, overridable per run for autotuning sweeps
+        # (env read happens at trace time, so a bench process can set
+        # these without any config threading)
+        bq = int(os.environ.get("KFTPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
+        bk = int(os.environ.get("KFTPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
+        return flash_attention(q, k, v, causal=causal,
+                               block_q=bq, block_k=bk)
     return reference_attention(q, k, v, causal=causal)
 
 

@@ -122,3 +122,32 @@ def test_pallas_bwd_matches_xla_oracle():
             np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), atol=2e-4, rtol=2e-4,
                 err_msg=f"d{name} mismatch (causal={causal})")
+
+
+def test_block_size_env_override_reaches_kernel(monkeypatch):
+    """KFTPU_FLASH_BLOCK_Q/K tune the kernel tiles per run (the
+    autotuning sweep hook) — dispatcher passes them through and results
+    stay correct."""
+    from kubeflow_tpu.ops import attention as A
+
+    seen = {}
+    real = __import__("kubeflow_tpu.ops.flash_attention",
+                      fromlist=["flash_attention"]).flash_attention
+
+    def spy(q, k, v, **kw):
+        seen.update(kw)
+        return real(q, k, v, **kw)
+
+    monkeypatch.setattr("kubeflow_tpu.ops.flash_attention.flash_attention",
+                        spy)
+    monkeypatch.setenv("KFTPU_FLASH_BLOCK_Q", "64")
+    monkeypatch.setenv("KFTPU_FLASH_BLOCK_K", "64")
+    rng = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(rng[0], (1, 128, 2, 64), jnp.float32)
+    k = jax.random.normal(rng[1], (1, 128, 2, 64), jnp.float32)
+    v = jax.random.normal(rng[2], (1, 128, 2, 64), jnp.float32)
+    out = A.attention(q, k, v, causal=True, impl="flash")
+    assert seen["block_q"] == 64 and seen["block_k"] == 64
+    want = A.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
