@@ -173,11 +173,14 @@ def main() -> int:
                         "fastest); conv7: the canonical stem")
     p.add_argument("--workload", default="both",
                    choices=["resnet", "lm", "both"])
-    p.add_argument("--lm-model", default="gpt-125m")
+    # defaults = the best measured single-chip operating point
+    # (BASELINE.md round-2 LM sweep: gpt-350m + adafactor beats
+    # gpt-125m + adamw on MFU, and adamw OOMs at this size)
+    p.add_argument("--lm-model", default="gpt-350m")
     p.add_argument("--lm-batch", type=int, default=8)
     p.add_argument("--lm-attention", default="flash",
                    choices=["flash", "reference"])
-    p.add_argument("--lm-optimizer", default="adamw",
+    p.add_argument("--lm-optimizer", default="adafactor",
                    choices=["adamw", "adafactor", "sgdm"])
     p.add_argument("--lm-remat", action="store_true",
                    help="rematerialize the forward (fits larger models)")

@@ -246,6 +246,38 @@ class Dashboard:
             })
         return {"notebooks": sorted(out, key=lambda n: n["name"])}
 
+    # -- training jobs card (TPU-native; the reference dashboard's
+    # workload cards showed notebooks/pipelines — here the training
+    # workload is the JAXJob CRD) --------------------------------------------
+
+    def jaxjobs(self, req: HttpReq):
+        from kubeflow_tpu.control.jaxjob import types as JT
+
+        self._user(req)
+        ns = req.params["namespace"]
+        out = []
+        for j in self.client.list(JT.API_VERSION, JT.KIND, namespace=ns):
+            m = ob.meta(j)
+            st = j.get("status") or {}
+            if ob.cond_is_true(j, JT.COND_SUCCEEDED):
+                phase = "succeeded"
+            elif ob.cond_is_true(j, JT.COND_FAILED):
+                phase = "failed"
+            elif ob.cond_is_true(j, JT.COND_RUNNING):
+                phase = "running"
+            else:
+                phase = "pending"
+            tpu = (j.get("spec") or {}).get("tpu") or {}
+            out.append({
+                "name": m["name"],
+                "phase": phase,
+                "replicas": (j.get("spec") or {}).get("replicas", 1),
+                "chips_per_worker": tpu.get("chipsPerWorker", 0),
+                "restarts": st.get("restarts", 0),
+                "preemptions": st.get("preemptions", 0),
+            })
+        return {"jaxjobs": sorted(out, key=lambda r: r["name"])}
+
     # -- activity + metrics -------------------------------------------------
 
     def activities(self, req: HttpReq):
@@ -280,6 +312,7 @@ class Dashboard:
                 self.remove_contributor)
         r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
         r.route("GET", "/api/namespaces/{namespace}/notebooks", self.notebooks)
+        r.route("GET", "/api/namespaces/{namespace}/jaxjobs", self.jaxjobs)
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)

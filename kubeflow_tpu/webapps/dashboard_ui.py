@@ -148,6 +148,12 @@ PAGE = """<!doctype html>
     </tbody></table>
   </div>
   <div class="card">
+    <h2>Training jobs</h2>
+    <table class="nbs"><tbody id="jaxjobs">
+      <tr><td class="muted">select a namespace</td></tr>
+    </tbody></table>
+  </div>
+  <div class="card">
     <h2>Activity</h2>
     <ul id="activities"><li class="muted">select a namespace</li></ul>
   </div>
@@ -355,11 +361,42 @@ async function loadNotebooks(ns) {
       'the Notebooks tab</td></tr>';
 }
 
+/* ---- training jobs card (JAXJob status at a glance) ---- */
+async function loadJaxjobs(ns) {
+  const out = await api('/api/namespaces/' + ns + '/jaxjobs')
+    .catch(() => ({jaxjobs: []}));
+  const tb = $('jaxjobs');
+  tb.innerHTML = '';
+  for (const j of out.jaxjobs || []) {
+    const tr = document.createElement('tr');
+    const name = document.createElement('td');
+    name.textContent = j.name;
+    const phase = document.createElement('td');
+    const badge = document.createElement('span');
+    badge.className = 'badge ' + (j.phase === 'succeeded' ? 'running' :
+                                  j.phase === 'failed' ? 'Warning' : j.phase);
+    badge.textContent = j.phase;
+    phase.appendChild(badge);
+    const shape = document.createElement('td');
+    shape.className = 'muted';
+    shape.textContent = j.replicas + '×' +
+      (j.chips_per_worker ? j.chips_per_worker + ' chips' : 'cpu');
+    const restarts = document.createElement('td');
+    restarts.className = 'muted';
+    restarts.textContent = (j.restarts ? j.restarts + ' restarts ' : '') +
+      (j.preemptions ? j.preemptions + ' preemptions' : '');
+    tr.append(name, phase, shape, restarts);
+    tb.appendChild(tr);
+  }
+  if (!tb.children.length)
+    tb.innerHTML = '<tr><td class="muted">no training jobs</td></tr>';
+}
+
 async function loadNamespace(ns) {
   currentNs = ns;
   route();  // re-point an embedded app iframe at the selected namespace
   await Promise.all([loadActivities(ns), loadContributors(ns),
-                     loadNotebooks(ns)]);
+                     loadNotebooks(ns), loadJaxjobs(ns)]);
 }
 
 /* ---- hash routing: main-page.js + iframe-container.js + not-found ---- */
@@ -439,6 +476,7 @@ setInterval(() => {
   if (currentNs && (location.hash || '#/') === '#/') {
     loadActivities(currentNs);
     loadNotebooks(currentNs);
+    loadJaxjobs(currentNs);
   }
 }, 15000);
 </script>

@@ -624,3 +624,39 @@ def test_manifests_route_webapp_prefixes_through_gateway():
     # istio off -> no webapp VirtualServices rendered
     objs_plain = render(TpuDef(use_istio=False))
     assert not [o for o in objs_plain if o.get("kind") == "VirtualService"]
+
+
+class TestJaxjobsCard:
+    """/api/namespaces/{ns}/jaxjobs — the dashboard's training-jobs
+    card (TPU-native analogue of the reference's workload cards)."""
+
+    def test_lists_jobs_with_phase_and_counters(self, cluster):
+        from kubeflow_tpu.control.jaxjob import types as JT
+
+        r = Dashboard(cluster).router()
+        job = JT.new_jaxjob("train", namespace="team-a", replicas=4,
+                            accelerator="tpu-v5-lite-podslice",
+                            topology="2x2", chips_per_worker=4)
+        cluster.create(job)
+        stored = cluster.get(JT.API_VERSION, JT.KIND, "train", "team-a")
+        ob.cond_set(stored, JT.COND_RUNNING, "True", "AllWorkersRunning")
+        stored.setdefault("status", {}).update(
+            {"restarts": 1, "preemptions": 2})
+        cluster.update(stored)
+        out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/jaxjobs")))
+        [row] = out["jaxjobs"]
+        assert row["phase"] == "running"
+        assert row["replicas"] == 4
+        assert row["restarts"] == 1 and row["preemptions"] == 2
+
+    def test_terminal_phases(self, cluster):
+        from kubeflow_tpu.control.jaxjob import types as JT
+
+        r = Dashboard(cluster).router()
+        for name, cond in (("ok", JT.COND_SUCCEEDED), ("bad", JT.COND_FAILED)):
+            j = JT.new_jaxjob(name, namespace="team-a")
+            ob.cond_set(j, cond, "True", "x")
+            cluster.create(j)
+        out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/jaxjobs")))
+        phases = {row["name"]: row["phase"] for row in out["jaxjobs"]}
+        assert phases == {"ok": "succeeded", "bad": "failed"}
