@@ -130,6 +130,7 @@ def run_lm(args, devs):
         total_steps=args.steps,
         warmup_steps=5,
         remat=args.lm_remat,
+        remat_policy=args.lm_remat_policy,
         log_every=10**9,
     ))
     trainer = Trainer(cfg)
@@ -184,6 +185,10 @@ def main() -> int:
                    choices=["adamw", "adafactor", "sgdm"])
     p.add_argument("--lm-remat", action="store_true",
                    help="rematerialize the forward (fits larger models)")
+    p.add_argument("--lm-remat-policy", default="dots",
+                   choices=["dots", "full"],
+                   help="dots keeps matmul outputs (cheap recompute); "
+                        "full recomputes everything (min memory)")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--budget-s", type=float, default=1500.0,
                    help="wall-clock budget; the lm extra is skipped when "

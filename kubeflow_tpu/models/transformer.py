@@ -70,6 +70,11 @@ class TransformerConfig:
     dtype: Dtype = jnp.bfloat16
     attention_impl: str = "auto"   # auto | flash | reference | ring | ulysses
     remat: bool = False
+    # "full": nothing_saveable — minimum memory, recompute everything.
+    # "dots": keep matmul outputs, recompute only elementwise — most of
+    # the memory win at a fraction of the recompute tax (the MXU work is
+    # NOT redone; usually the right policy for transformers).
+    remat_policy: str = "full"
     # MoE: every `moe_every`-th block is a mixture layer (0 = dense only)
     moe_every: int = 0
     n_experts: int = 8
@@ -105,6 +110,14 @@ class RMSNorm(nn.Module):
         scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
         y = x32 * jax.lax.rsqrt(jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps)
         return (y * scale).astype(self.dtype)
+
+
+def _remat_policy(cfg: "TransformerConfig"):
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "full":
+        return jax.checkpoint_policies.nothing_saveable
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} (full|dots)")
 
 
 class Attention(nn.Module):
@@ -252,7 +265,7 @@ class Stage(nn.Module):
         positions = jnp.broadcast_to(positions_1d[None, :], x.shape[:2])
         block = Block
         if cfg.remat:
-            block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
+            block = nn.remat(Block, policy=_remat_policy(cfg))
         for p in range(cfg.n_layers // cfg.pipeline_stages):
             x = block(cfg, name=f"block_{p}")(x, positions)
         return x
@@ -319,7 +332,7 @@ class TransformerLM(nn.Module):
         else:
             block = Block
             if cfg.remat:
-                block = nn.remat(Block, policy=jax.checkpoint_policies.nothing_saveable)
+                block = nn.remat(Block, policy=_remat_policy(cfg))
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)

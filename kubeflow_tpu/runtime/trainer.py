@@ -64,6 +64,9 @@ class TrainConfig:
     warmup_steps: int = 100
     total_steps: int = 1000
     remat: bool = False
+    # "full" recomputes everything; "dots" keeps matmul outputs and
+    # recomputes only elementwise (cheaper tax, most of the memory win)
+    remat_policy: str = "full"
     pp_microbatches: int = 4        # pipeline microbatches when mesh.pipe > 1
     aux_loss_weight: float = 0.01   # weight on sowed aux losses (MoE balance)
     seed: int = 0
@@ -254,7 +257,10 @@ class Trainer:
             )
 
         if cfg.remat:
-            forward = jax.checkpoint(forward, policy=jax.checkpoint_policies.nothing_saveable)
+            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+                      if cfg.remat_policy == "dots"
+                      else jax.checkpoint_policies.nothing_saveable)
+            forward = jax.checkpoint(forward, policy=policy)
 
         def loss_fn(params, batch_stats, batch):
             variables = {"params": params, **({"batch_stats": batch_stats} if batch_stats else {})}
