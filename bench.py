@@ -198,6 +198,30 @@ def main() -> int:
 
     logging.basicConfig(level=logging.WARNING)
 
+    # The remote TPU tunnel can be down for hours; backend init then
+    # blocks indefinitely inside C code (SIGALRM can't interrupt it) —
+    # probe device init in a killable subprocess first so a dead tunnel
+    # becomes a fast explicit failure instead of a hung bench.
+    import os
+    import subprocess
+
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=300, capture_output=True, text=True,
+            env=dict(os.environ))
+        probe_err = "" if probe.returncode == 0 else \
+            (probe.stderr or "")[-200:]
+    except subprocess.TimeoutExpired:
+        probe_err = "device init timed out after 300s"
+    if probe_err:
+        print(json.dumps({
+            "metric": f"{args.model}_train_mfu", "unit": "fraction",
+            "value": 0.0, "vs_baseline": 0.0,
+            "error": f"TPU backend unavailable: {probe_err}",
+        }))
+        return 3
+
     import jax
 
     from kubeflow_tpu.runtime.metrics import peak_flops
