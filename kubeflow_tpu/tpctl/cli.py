@@ -48,11 +48,41 @@ def _push_state(args, cfg: TpuDef) -> None:
     print(f"state pushed to {args.state_repo} @ {sha[:12]}")
 
 
+def doctor_report(client, cfg: TpuDef) -> tuple[list[dict], bool]:
+    """Per-component readiness report: for every object the manifest set
+    renders, check presence — and for Deployments, readiness (the
+    hermetic wait_for_kubeflow.py / kf_is_ready_test.py contract:
+    kf_is_ready asserts Deployments ready per platform)."""
+    from kubeflow_tpu.control.k8s import objects as ob
+    from kubeflow_tpu.tpctl import manifests
+
+    rows: list[dict] = []
+    healthy = True
+    for obj in manifests.render(cfg):
+        kind = obj.get("kind")
+        m = ob.meta(obj)
+        ns = m.get("namespace")
+        live = client.get_or_none(obj["apiVersion"], kind, m["name"], ns)
+        row = {"kind": kind, "name": m["name"], "ok": True, "status": "ok"}
+        if live is None:
+            row.update(ok=False, status="missing")
+        elif kind == "Deployment":
+            want = (obj.get("spec") or {}).get("replicas", 1)
+            got = (live.get("status") or {}).get("readyReplicas", 0)
+            if got < want:
+                row.update(ok=False, status="not-ready",
+                           detail=f"{got}/{want} replicas ready")
+        if not row["ok"]:
+            healthy = False
+        rows.append(row)
+    return rows, healthy
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser("tpctl", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
 
-    for name in ("apply", "delete", "status", "generate"):
+    for name in ("apply", "delete", "status", "generate", "doctor"):
         sp = sub.add_parser(name)
         if name != "status":
             sp.add_argument("-f", "--file", help="TpuDef YAML (default: example)")
@@ -126,6 +156,18 @@ def main(argv: list[str] | None = None) -> int:
             return 1
         print(json.dumps(obj.get("status", {}), indent=2))
         return 0
+
+    if args.cmd == "doctor":
+        cfg = (TpuDef.load(args.file) if getattr(args, "file", None)
+               else TpuDef.from_dict(yaml.safe_load(example_yaml())))
+        rows, healthy = doctor_report(_client(args), cfg)
+        for r in rows:
+            mark = "ok " if r["ok"] else "MISSING" if r["status"] == "missing" \
+                else "NOT-READY"
+            print(f"{mark:9s} {r['kind']:32s} {r['name']}"
+                  + (f"  ({r['detail']})" if r.get("detail") else ""))
+        print("platform healthy" if healthy else "platform NOT healthy")
+        return 0 if healthy else 1
 
     cfg = (TpuDef.load(args.file) if getattr(args, "file", None)
            else TpuDef.from_dict(yaml.safe_load(example_yaml())))

@@ -247,3 +247,44 @@ class TestHttpClient:
             client.wait_available("never-created", timeout_s=10, poll_s=1,
                                   clock=lambda: t[0],
                                   sleep=lambda s: t.__setitem__(0, t[0] + s))
+
+
+class TestDoctor:
+    """tpctl doctor — the wait_for_kubeflow/kf_is_ready readiness check
+    as a CLI against the live cluster."""
+
+    def test_reports_missing_then_healthy(self, cfg):
+        from kubeflow_tpu.tpctl.cli import doctor_report
+
+        cluster = FakeCluster()
+        rows, healthy = doctor_report(cluster, cfg)
+        assert not healthy
+        assert all(r["status"] == "missing" for r in rows)
+
+        Coordinator(cluster).apply(cfg)
+        rows, healthy = doctor_report(cluster, cfg)
+        missing = [r for r in rows if r["status"] == "missing"]
+        assert not missing
+        # deployments exist but report 0 ready replicas -> not healthy yet
+        notready = [r for r in rows if r["status"] == "not-ready"]
+        assert notready and not healthy
+        # a controller "starts": readyReplicas catches up
+        for r in notready:
+            d = cluster.get("apps/v1", "Deployment", r["name"], cfg.namespace)
+            d.setdefault("status", {})["readyReplicas"] = \
+                (d.get("spec") or {}).get("replicas", 1)
+            cluster.update_status(d)
+        rows, healthy = doctor_report(cluster, cfg)
+        assert healthy, [r for r in rows if not r["ok"]]
+
+    def test_cli_exit_codes(self, cfg, tmp_path, capsys):
+        from kubeflow_tpu.tpctl import cli
+
+        # dry-run applies to a fresh in-memory cluster; deployments have
+        # no kubelet to become ready -> doctor says unhealthy (rc 1)
+        f = tmp_path / "tpudef.yaml"
+        f.write_text(cfg.dump())
+        rc = cli.main(["doctor", "-f", str(f), "--dry-run"])
+        out = capsys.readouterr().out
+        assert rc == 1
+        assert "platform NOT healthy" in out
