@@ -120,7 +120,35 @@ def validate(job: dict) -> list[str]:
     port = spec.get("coordinatorPort", DEFAULT_COORDINATOR_PORT)
     if not isinstance(port, int) or not (0 < port < 65536):
         errs.append(f"spec.coordinatorPort invalid: {port!r}")
+    errs += _validate_tpu_topology(spec)
     return errs
+
+
+def _validate_tpu_topology(spec: dict) -> list[str]:
+    """Slice-geometry consistency: the topology's chip count must equal
+    replicas x chipsPerWorker, or the gang can never be placed on one
+    slice — catching it at admission beats a forever-Pending pod set."""
+    tpu = spec.get("tpu") or {}
+    topology = tpu.get("topology") or ""
+    chips = tpu.get("chipsPerWorker")
+    if not topology or not chips:
+        return []
+    try:
+        dims = [int(d) for d in topology.lower().split("x")]
+        slice_chips = 1
+        for d in dims:
+            if d < 1:
+                raise ValueError(topology)
+            slice_chips *= d
+    except ValueError:
+        return [f"spec.tpu.topology {topology!r} is not NxM[xK]"]
+    replicas = spec.get("replicas", 1)
+    if isinstance(replicas, int) and replicas >= 1 \
+            and slice_chips != replicas * chips:
+        return [f"spec.tpu.topology {topology} has {slice_chips} chips but "
+                f"replicas x chipsPerWorker = {replicas} x {chips} = "
+                f"{replicas * chips}; the gang cannot tile the slice"]
+    return []
 
 
 def crd_manifest() -> dict:

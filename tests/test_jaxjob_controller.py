@@ -32,9 +32,14 @@ def drain(ctl):
 
 
 def make_job(cluster, **kw):
-    job = T.new_jaxjob("train", replicas=kw.pop("replicas", 4),
+    # slice geometry must be consistent (validate() enforces
+    # replicas x chipsPerWorker == topology chips): 4 chips/worker,
+    # topology sized to the gang
+    replicas = kw.pop("replicas", 4)
+    default_topo = {1: "2x2", 2: "2x4", 3: "3x4", 4: "4x4"}[replicas]
+    job = T.new_jaxjob("train", replicas=replicas,
                        accelerator=kw.pop("accelerator", "tpu-v5-lite-podslice"),
-                       topology=kw.pop("topology", "2x4"), **kw)
+                       topology=kw.pop("topology", default_topo), **kw)
     return cluster.create(job)
 
 
@@ -73,7 +78,7 @@ class TestGangCreation:
         assert limits[T.RESOURCE_TPU] == 4
         sel = pod["spec"]["nodeSelector"]
         assert sel[T.NODESELECTOR_ACCEL] == "tpu-v5-lite-podslice"
-        assert sel[T.NODESELECTOR_TOPOLOGY] == "2x4"
+        assert sel[T.NODESELECTOR_TOPOLOGY] == "2x2"
 
     def test_no_tpu_block_means_no_tpu_resources(self, world):
         cluster, ctl, _ = world
@@ -426,3 +431,44 @@ class TestPreemptionClassification:
         job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
         assert ob.cond_is_true(job, T.COND_FAILED)
         assert job["status"]["preemptions"] == 2
+
+
+class TestTopologyValidation:
+    def test_inconsistent_geometry_fails_fast(self, world):
+        cluster, ctl, _ = world
+        job = T.new_jaxjob("train", replicas=4,
+                           accelerator="tpu-v5-lite-podslice",
+                           topology="2x4", chips_per_worker=4)  # 16 != 8
+        cluster.create(job)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_FAILED)
+        msg = ob.cond_get(job, T.COND_FAILED)["message"]
+        assert "cannot tile the slice" in msg
+
+    def test_malformed_topology_string(self, world):
+        cluster, ctl, _ = world
+        job = T.new_jaxjob("train", replicas=2,
+                           accelerator="tpu-v5-lite-podslice",
+                           topology="2xbad", chips_per_worker=4)
+        cluster.create(job)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert ob.cond_is_true(job, T.COND_FAILED)
+
+    def test_3d_topology_ok(self, world):
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("train", replicas=4,
+                           accelerator="tpu-v4-podslice",
+                           topology="2x2x4", chips_per_worker=4)  # 16 == 16
+        cluster.create(job)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert not ob.cond_is_true(job, T.COND_FAILED)
+
+    def test_no_tpu_block_skips_check(self, world):
+        cluster, ctl, _ = world
+        cluster.create(T.new_jaxjob("train", replicas=3))
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert not ob.cond_is_true(job, T.COND_FAILED)
