@@ -28,6 +28,41 @@ from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
 
 log = logging.getLogger("kubeflow_tpu.serving")
 
+_METRICS: dict = {}
+
+
+def _metric(name, kind, doc, **kw):
+    import prometheus_client as prom  # noqa: F401
+
+    if name not in _METRICS:
+        _METRICS[name] = kind(name, doc, **kw)
+    return _METRICS[name]
+
+
+def predict_latency():
+    import prometheus_client as prom
+
+    return _metric("serving_predict_seconds", prom.Histogram,
+                   "end-to-end predict handler latency",
+                   labelnames=("model",),
+                   buckets=(.005, .01, .025, .05, .1, .25, .5, 1, 2.5, 5, 10))
+
+
+def device_batch_size():
+    import prometheus_client as prom
+
+    return _metric("serving_device_batch_size", prom.Histogram,
+                   "instances per device call after micro-batch coalescing",
+                   labelnames=("model",),
+                   buckets=(1, 2, 4, 8, 16, 32, 64, 128))
+
+
+def predict_errors():
+    import prometheus_client as prom
+
+    return _metric("serving_predict_errors_total", prom.Counter,
+                   "failed predict requests", labelnames=("model",))
+
 
 @dataclass
 class ServedModel:
@@ -50,6 +85,7 @@ class ServedModel:
     def _predict_now(self, instances: list) -> list:
         batch = _stack(instances)
         n = _batch_size(batch)
+        device_batch_size().labels(self.name).observe(n)
         if self.pad_batches:
             padded = _pad_batch(batch, _next_pow2(n))
         else:
@@ -301,13 +337,19 @@ class ModelServer:
         if instances is None:
             raise ApiHttpError(400, 'request body must contain "instances"')
         model = self._get(name, version)
+        import time as _time
+
+        t0 = _time.perf_counter()
         try:
             preds = model.predict(instances)
         except ApiHttpError:
+            predict_errors().labels(name).inc()
             raise
         except Exception as e:
+            predict_errors().labels(name).inc()
             log.exception("predict failed for %s", name)
             raise ApiHttpError(400, f"prediction failed: {e}")
+        predict_latency().labels(name).observe(_time.perf_counter() - t0)
         return {"predictions": preds}
 
     def router(self) -> Router:

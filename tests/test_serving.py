@@ -342,3 +342,18 @@ class TestLmGeneration:
         sig = meta["metadata"]["signature_def"]
         assert sig["method_name"] == "generate"
         assert sig["prompt_len"] == 8 and sig["max_new_tokens"] == 4
+
+
+def test_prometheus_metrics_exported(server):
+    """Per-model predict latency + device batch size + error counters at
+    /metrics (every reference service exports prometheus; the serving
+    hot path now does too)."""
+    srv, url = server
+    requests.post(f"{url}/v1/models/mnist:predict",
+                  json={"instances": [[0.0] * 784]}, timeout=60)
+    requests.post(f"{url}/v1/models/mnist:predict",
+                  json={"instances": "bogus"}, timeout=60)
+    text = requests.get(f"{url}/metrics", timeout=30).text
+    assert 'serving_predict_seconds_count{model="mnist"}' in text
+    assert 'serving_device_batch_size_bucket' in text
+    assert 'serving_predict_errors_total{model="mnist"}' in text
