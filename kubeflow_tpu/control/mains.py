@@ -20,9 +20,16 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
         level=logging.INFO,
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
     )
+    import os
+
     p = argparse.ArgumentParser(f"kubeflow-tpu-{name}")
     p.add_argument("--metrics-port", type=int, default=8080)
     p.add_argument("--apiserver", default="", help="override in-cluster config")
+    p.add_argument(
+        "--enable-leader-election", action="store_true",
+        default=os.environ.get("ENABLE_LEADER_ELECTION", "false").lower() == "true",
+        help="Enable leader election for controller manager. Enabling this "
+             "will ensure there is only one active controller manager.")
     if extra_args:
         extra_args(p)
     args = p.parse_args()
@@ -31,6 +38,17 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
 
     client = RestClient(base_url=args.apiserver or None)
     ctl = build(client, args)
+
+    # --enable-leader-election parity (notebook-controller main.go:51-62):
+    # HA replicas elect one active manager through a coordination Lease
+    elector = None
+    if args.enable_leader_election:
+        from kubeflow_tpu.control.leases import LeaderElector
+
+        elector = LeaderElector(
+            client, f"{name}-controller",
+            namespace=os.environ.get("POD_NAMESPACE", "kubeflow"))
+        ctl.with_leader_election(elector)
 
     import prometheus_client as prom
 
@@ -41,3 +59,5 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     ctl.stop()
+    if elector is not None:
+        elector.release()  # immediate hand-off on clean shutdown

@@ -79,6 +79,7 @@ class Controller:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._streams: list = []
+        self._elector = None  # set by with_leader_election
 
     # -- registration (kubebuilder For/Owns/Watches analogues) -------------
 
@@ -177,15 +178,31 @@ class Controller:
 
     def _worker(self) -> None:
         while not self._stop.is_set():
+            if not self._may_lead():
+                # standby: watches stay subscribed, work queues up, but
+                # nothing reconciles until the lease is ours
+                self._stop.wait(0.5)
+                continue
+            req = None
             with self._cv:
                 timeout = self._pump_delayed()
-                while not self._queue and not self._stop.is_set():
+                if not self._queue:
+                    # bounded wait, then fall through to the outer loop:
+                    # an IDLE leader must keep renewing its lease (the
+                    # elector caches, so this is a local check most
+                    # rounds, one apiserver renew per lease_seconds/3)
                     self._cv.wait(timeout=min(timeout, 0.2) if timeout else 0.2)
-                    timeout = self._pump_delayed()
-                if self._stop.is_set():
-                    return
-                req = next(iter(self._queue))
-                del self._queue[req]
+                    self._pump_delayed()
+                if self._queue:
+                    req = next(iter(self._queue))
+                    del self._queue[req]
+            if req is None:
+                continue
+            if not self._may_lead():
+                # lost the lease between pop and process: hand the item
+                # back rather than reconcile as a deposed leader
+                self.enqueue(req)
+                continue
             self._process_one(req)
 
     def stop(self) -> None:
@@ -213,6 +230,17 @@ class Controller:
                     ):
                         self._dispatch(src, ev.object)
 
+    def with_leader_election(self, elector) -> "Controller":
+        """Only reconcile while holding the lease (the reference's
+        --enable-leader-election manager capability): watches keep
+        accumulating work so a standby is current the moment it takes
+        over, but _process_one runs only on the leader."""
+        self._elector = elector
+        return self
+
+    def _may_lead(self) -> bool:
+        return self._elector is None or self._elector.try_acquire()
+
     def run_until_idle(self, max_rounds: int = 200, advance_delayed: bool = False) -> int:
         """Synchronously drain the queue (and watch events) until no work
         remains. Returns the number of reconciles performed. With
@@ -220,6 +248,9 @@ class Controller:
         per drain (so culling/requeue paths are testable without sleeping).
         """
         done = 0
+        if not self._may_lead():
+            self._drain_streams()  # stay current on standby
+            return 0
         for _ in range(max_rounds):
             self._drain_streams()
             self._pump_delayed()

@@ -1,0 +1,140 @@
+"""Lease-based leader election (reference: controller-runtime managers'
+--enable-leader-election, notebook-controller/main.go:51-62)."""
+
+from kubeflow_tpu.control import leases
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import build_controller
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.leases import LeaderElector
+from kubeflow_tpu.control.runtime import seed_controller
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_electors(cluster, clock, n=2, lease_seconds=15.0):
+    return [LeaderElector(cluster, "jaxjob-controller", identity=f"pod-{i}",
+                          lease_seconds=lease_seconds, clock=clock)
+            for i in range(n)]
+
+
+class TestElection:
+    def test_first_wins_second_stands_by(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make_electors(cluster, clock)
+        assert a.try_acquire() is True
+        assert b.try_acquire() is False
+        assert a.is_leader and not b.is_leader
+        # renewal keeps the lease fresh
+        clock.t += 10
+        assert a.try_acquire() is True
+        clock.t += 10  # 20s since b's view but a renewed at t+10
+        assert b.try_acquire() is False
+
+    def test_expiry_allows_takeover_with_transition_count(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make_electors(cluster, clock)
+        assert a.try_acquire()
+        clock.t += 16  # past leaseDurationSeconds
+        assert b.try_acquire() is True
+        lease = cluster.get(leases.API_VERSION, leases.KIND,
+                            "jaxjob-controller", "kubeflow")
+        assert lease["spec"]["holderIdentity"] == "pod-1"
+        assert lease["spec"]["leaseTransitions"] == 1
+        # the deposed leader notices on its next round
+        assert a.try_acquire() is False
+
+    def test_release_hands_off_immediately(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make_electors(cluster, clock)
+        assert a.try_acquire()
+        a.release()
+        assert not a.is_leader
+        assert b.try_acquire() is True  # no 15s wait
+
+
+class TestControllerFailover:
+    def test_standby_takes_over_reconciling(self):
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make_electors(cluster, clock)
+        active = seed_controller(
+            build_controller(cluster, record_events=False)
+        ).with_leader_election(a)
+        standby = seed_controller(
+            build_controller(cluster, record_events=False)
+        ).with_leader_election(b)
+
+        cluster.create(JT.new_jaxjob("train", replicas=2))
+        assert active.run_until_idle(advance_delayed=True) > 0
+        assert standby.run_until_idle(advance_delayed=True) == 0
+        assert len(cluster.list("v1", "Pod", namespace="default")) == 2
+
+        # leader dies (stops renewing); lease expires; standby reconciles
+        cluster.create(JT.new_jaxjob("train2", replicas=1))
+        clock.t += 16
+        assert standby.run_until_idle(advance_delayed=True) > 0
+        pods = {ob.meta(p)["name"]
+                for p in cluster.list("v1", "Pod", namespace="default")}
+        assert "train2-worker-0" in pods
+
+
+class TestProductionSemantics:
+    def test_lease_wire_types_are_apiserver_compatible(self):
+        """renewTime/acquireTime must be MicroTime RFC3339 strings and
+        leaseDurationSeconds an int — epoch floats would 400 on a real
+        apiserver."""
+        cluster, clock = FakeCluster(), FakeClock()
+        [a] = make_electors(cluster, clock, n=1)
+        assert a.try_acquire()
+        lease = cluster.get(leases.API_VERSION, leases.KIND,
+                            "jaxjob-controller", "kubeflow")
+        spec = lease["spec"]
+        assert isinstance(spec["renewTime"], str) and "T" in spec["renewTime"]
+        assert isinstance(spec["acquireTime"], str)
+        assert isinstance(spec["leaseDurationSeconds"], int)
+        # round-trips through the parser
+        assert leases._from_micro_time(spec["renewTime"]) == clock.t
+
+    def test_held_leadership_is_cached_between_renews(self):
+        """The reconcile hot path must not pay a lease GET+PUT per item:
+        within lease_seconds/3 of the last renew, try_acquire is a local
+        check."""
+        cluster, clock = FakeCluster(), FakeClock()
+
+        calls = {"n": 0}
+        real_get = cluster.get_or_none
+
+        def counting_get(*a, **k):
+            calls["n"] += 1
+            return real_get(*a, **k)
+
+        cluster.get_or_none = counting_get
+        [a] = make_electors(cluster, clock, n=1)
+        assert a.try_acquire()
+        first = calls["n"]
+        for _ in range(20):  # same instant: all cached
+            assert a.try_acquire()
+        assert calls["n"] == first
+        clock.t += 6  # past lease/3 -> one real renew
+        assert a.try_acquire()
+        assert calls["n"] == first + 1
+
+    def test_release_after_conflict_still_frees_the_lease(self):
+        """release() must check the apiserver even when the cached held
+        flag is stale (last round lost a 409), or clean shutdown
+        degrades to a full-expiry failover."""
+        cluster, clock = FakeCluster(), FakeClock()
+        a, b = make_electors(cluster, clock)
+        assert a.try_acquire()
+        a._held = False  # simulate a stale cache after a lost race
+        a.release()
+        lease = cluster.get(leases.API_VERSION, leases.KIND,
+                            "jaxjob-controller", "kubeflow")
+        assert lease["spec"]["renewTime"] is None
+        assert b.try_acquire() is True  # immediate hand-off
