@@ -41,6 +41,7 @@ _KINDS: dict[str, tuple[str, bool]] = {
     "StorageClass": ("storageclasses", True),
     "CustomResourceDefinition": ("customresourcedefinitions", True),
     "MutatingWebhookConfiguration": ("mutatingwebhookconfigurations", True),
+    "Lease": ("leases", False),
     "VirtualService": ("virtualservices", False),
     "Gateway": ("gateways", False),
     # kubeflow_tpu CRDs

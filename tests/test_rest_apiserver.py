@@ -207,3 +207,26 @@ class TestControllerOverHttp:
         env = {e["name"]: e["value"]
                for e in pod["spec"]["containers"][0]["env"]}
         assert env[JT.ENV_NPROC] == "2"
+
+
+class TestLeaderElectionOverHttp:
+    def test_two_electors_through_rest_client(self, server):
+        """Leader election over the real HTTP wire: JSON-serialized
+        MicroTime strings, 409 arbitration between two RestClients."""
+        from kubeflow_tpu.control.k8s.rest import RestClient
+        from kubeflow_tpu.control.leases import LeaderElector
+
+        t = {"now": 5000.0}
+        a = LeaderElector(RestClient(base_url=server.url),
+                          "nb-controller", identity="pod-a",
+                          clock=lambda: t["now"])
+        b = LeaderElector(RestClient(base_url=server.url),
+                          "nb-controller", identity="pod-b",
+                          clock=lambda: t["now"])
+        assert a.try_acquire() is True
+        assert b.try_acquire() is False
+        t["now"] += 16  # expiry -> takeover over HTTP
+        assert b.try_acquire() is True
+        assert a.try_acquire() is False
+        b.release()
+        assert a.try_acquire() is True
