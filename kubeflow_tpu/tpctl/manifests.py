@@ -154,8 +154,17 @@ def render(cfg: TpuDef) -> list[dict]:
         env = {"USE_ISTIO": str(cfg.use_istio).lower()}
         if name == "notebook-controller":
             env.update({"ENABLE_CULLING": "false", "CULL_IDLE_TIME": "1440"})
-        out.append(_deployment(name, ns, img("controller"), args=cmd, env=env,
-                               sa="kubeflow-controller"))
+        replicas = 1
+        if cfg.ha_controllers:
+            # HA control plane: standby replica + Lease leader election
+            # (--enable-leader-election parity, control/leases.py)
+            env["ENABLE_LEADER_ELECTION"] = "true"
+            env["POD_NAMESPACE"] = ns
+            replicas = 2
+        dep = _deployment(name, ns, img("controller"), args=cmd, env=env,
+                          sa="kubeflow-controller")
+        dep["spec"]["replicas"] = replicas
+        out.append(dep)
 
     if "poddefault-webhook" in apps:
         out.append(_deployment(

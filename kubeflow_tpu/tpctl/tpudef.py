@@ -57,6 +57,8 @@ class TpuDef:
     applications: tuple[str, ...] = ALL_COMPONENTS
     image_prefix: str = "kubeflow-tpu"
     use_istio: bool = True
+    # HA control plane: 2 replicas per controller + leader election
+    ha_controllers: bool = False
     overlays: list[dict] = dataclasses.field(default_factory=list)
     raw: dict = dataclasses.field(default_factory=dict, repr=False)
 
@@ -84,6 +86,7 @@ class TpuDef:
             applications=apps or ALL_COMPONENTS,
             image_prefix=spec.get("imagePrefix", "kubeflow-tpu"),
             use_istio=bool(spec.get("useIstio", True)),
+            ha_controllers=bool(spec.get("haControllers", False)),
             overlays=list(spec.get("overlays") or []),
             raw=d,
         )
@@ -116,6 +119,7 @@ class TpuDef:
             "applications": list(self.applications),
             "imagePrefix": self.image_prefix,
             "useIstio": self.use_istio,
+            "haControllers": self.ha_controllers,
             "overlays": self.overlays,
         }
         return obj

@@ -288,3 +288,27 @@ class TestDoctor:
         out = capsys.readouterr().out
         assert rc == 1
         assert "platform NOT healthy" in out
+
+
+def test_ha_controllers_render_leader_election(cfg):
+    cfg.ha_controllers = True
+    objs = manifests.render(cfg)
+    ctl = next(o for o in objs if o.get("kind") == "Deployment"
+               and ob.meta(o)["name"] == "jaxjob-controller")
+    assert ctl["spec"]["replicas"] == 2
+    env = {e["name"]: e["value"]
+           for e in ctl["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert env["ENABLE_LEADER_ELECTION"] == "true"
+    assert env["POD_NAMESPACE"] == cfg.namespace
+    # web apps stay single-replica (stateless; scale separately)
+    dash = next(o for o in objs if o.get("kind") == "Deployment"
+                and ob.meta(o)["name"] == "centraldashboard")
+    assert dash["spec"].get("replicas", 1) == 1
+    # default: no HA knobs
+    cfg.ha_controllers = False
+    objs = manifests.render(cfg)
+    ctl = next(o for o in objs if o.get("kind") == "Deployment"
+               and ob.meta(o)["name"] == "jaxjob-controller")
+    env = {e["name"]: e["value"]
+           for e in ctl["spec"]["template"]["spec"]["containers"][0]["env"]}
+    assert "ENABLE_LEADER_ELECTION" not in env
