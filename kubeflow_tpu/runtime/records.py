@@ -253,3 +253,42 @@ def write_token_shard(path: str, tokens: np.ndarray) -> int:
         raise ValueError(f"tokens must be [n, seq_len+1] int32, got "
                          f"{tokens.shape} {tokens.dtype}")
     return write_records(path, tokens.view(np.uint8).reshape(tokens.shape[0], -1))
+
+
+def write_image_shard(path: str, images: np.ndarray,
+                      labels: np.ndarray) -> int:
+    """Write [n, H, W, C] uint8 images + [n] int32 labels as one
+    KFRecord shard; each record is 4 label bytes followed by the raw
+    image bytes (fixed size, so the native loader needs no schema)."""
+    if images.ndim != 4 or images.dtype != np.uint8:
+        raise ValueError(f"images must be [n,H,W,C] uint8, got "
+                         f"{images.shape} {images.dtype}")
+    labels = np.asarray(labels, np.int32)
+    if labels.shape != (images.shape[0],):
+        raise ValueError(f"labels must be [n], got {labels.shape}")
+    flat = images.reshape(images.shape[0], -1)
+    recs = np.concatenate(
+        [labels[:, None].view(np.uint8).reshape(labels.shape[0], 4), flat],
+        axis=1)
+    return write_records(path, recs)
+
+
+def image_batches(paths: Sequence[str], batch: int, image_size: int, *,
+                  channels: int = 3, shuffle_buffer: int = 0, seed: int = 0,
+                  loop: bool = True) -> Iterator[dict]:
+    """Classification batches from image shards: yields
+    {"image": [b,H,W,C] float32 in [0,1), "label": [b] int32} — the
+    tf.data-equivalent path for the resnet trainer (host decode is just
+    a cast; heavy augmentation belongs upstream of the shard writer)."""
+    rb = 4 + image_size * image_size * channels
+    ds = RecordDataset(paths, batch, record_bytes=rb,
+                       shuffle_buffer=shuffle_buffer, seed=seed, loop=loop)
+    try:
+        for raw in ds:
+            labels = raw[:, :4].copy().view(np.int32).reshape(-1)
+            imgs = raw[:, 4:].reshape(
+                raw.shape[0], image_size, image_size, channels)
+            yield {"image": imgs.astype(np.float32) / 255.0,
+                   "label": labels}
+    finally:
+        ds.close()

@@ -186,15 +186,19 @@ class Trainer:
     def data_iter(self) -> Iterator[dict]:
         cfg = self.cfg
         if cfg.data_path:
-            if cfg.task != "lm":
-                raise ValueError("data_path currently supports lm token shards")
             import glob as _glob
-
-            from kubeflow_tpu.runtime.records import token_batches
 
             paths = sorted(_glob.glob(cfg.data_path))
             if not paths:
                 raise FileNotFoundError(f"no shards match {cfg.data_path!r}")
+            if cfg.task == "classification":
+                from kubeflow_tpu.runtime.records import image_batches
+
+                return image_batches(paths, cfg.global_batch, cfg.image_size,
+                                     shuffle_buffer=cfg.shuffle_buffer,
+                                     seed=cfg.seed, loop=True)
+            from kubeflow_tpu.runtime.records import token_batches
+
             return token_batches(paths, cfg.global_batch, cfg.seq_len,
                                  shuffle_buffer=cfg.shuffle_buffer,
                                  seed=cfg.seed, loop=True)
