@@ -130,3 +130,14 @@ def per_process_slice(batch: dict, num_processes: int, process_id: int) -> dict:
         return a[process_id * per : (process_id + 1) * per]
 
     return jax.tree.map(f, batch)
+
+
+def synthetic_token_classes(batch: int, seq_len: int, vocab: int = 32000,
+                            num_classes: int = 2, seed: int = 0) -> Iterator[dict]:
+    """Sequence-classification batches (BERT fine-tune shape): tokens +
+    one label per sequence."""
+    rng = np.random.default_rng(seed)
+    tok = rng.integers(0, vocab, (batch, seq_len), dtype=np.int32)
+    y = rng.integers(0, num_classes, (batch,), dtype=np.int32)
+    while True:
+        yield {"tokens": tok, "label": y}

@@ -134,6 +134,16 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     raise ValueError(f"unknown optimizer {cfg.optimizer!r}")
 
 
+def _batch_xy(cfg: TrainConfig, batch: dict):
+    """Input/target selection per task. seq_classification = BERT-style
+    fine-tuning: token sequences in, one label per sequence out."""
+    if cfg.task == "classification":
+        return batch["image"], batch["label"]
+    if cfg.task == "seq_classification":
+        return batch["tokens"], batch["label"]
+    return batch["tokens"], batch["targets"]
+
+
 def _xent_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Integer-label cross entropy in f32, shared by classification and LM
     (LM logits are [B, L, V], labels [B, L] — mean over all positions)."""
@@ -155,7 +165,14 @@ class Trainer:
 
     def _model_kwargs(self) -> dict:
         kw = dict(self.cfg.model_kwargs)
-        if self.cfg.task == "classification":
+        if self.cfg.task in ("classification", "seq_classification"):
+            if kw.get("num_classes", self.cfg.num_classes) != self.cfg.num_classes:
+                # the data generator draws labels from cfg.num_classes; a
+                # diverging model head silently yields NaN loss
+                raise ValueError(
+                    f"model_kwargs.num_classes={kw['num_classes']} conflicts "
+                    f"with num_classes={self.cfg.num_classes}; set the "
+                    "top-level num_classes only")
             kw.setdefault("num_classes", self.cfg.num_classes)
         pipe = self.mesh.shape.get(AXIS_PIPELINE, 1)
         if pipe > 1:
@@ -176,6 +193,11 @@ class Trainer:
         if cfg.task == "classification":
             return {
                 "image": jnp.zeros((cfg.global_batch, cfg.image_size, cfg.image_size, 3), jnp.float32),
+                "label": jnp.zeros((cfg.global_batch,), jnp.int32),
+            }
+        if cfg.task == "seq_classification":
+            return {
+                "tokens": jnp.zeros((cfg.global_batch, cfg.seq_len), jnp.int32),
                 "label": jnp.zeros((cfg.global_batch,), jnp.int32),
             }
         return {
@@ -204,6 +226,12 @@ class Trainer:
                                  seed=cfg.seed, loop=True)
         if cfg.task == "classification":
             return synthetic_images(cfg.global_batch, cfg.image_size, cfg.num_classes, cfg.seed)
+        if cfg.task == "seq_classification":
+            from kubeflow_tpu.runtime.data import synthetic_token_classes
+
+            return synthetic_token_classes(cfg.global_batch, cfg.seq_len,
+                                           cfg.vocab_size, cfg.num_classes,
+                                           cfg.seed)
         return synthetic_tokens(cfg.global_batch, cfg.seq_len, cfg.vocab_size, cfg.seed)
 
     def _device_iter(self, it: Iterator[dict]) -> Iterator[dict]:
@@ -268,8 +296,7 @@ class Trainer:
 
         def loss_fn(params, batch_stats, batch):
             variables = {"params": params, **({"batch_stats": batch_stats} if batch_stats else {})}
-            x = batch["image"] if cfg.task == "classification" else batch["tokens"]
-            y = batch["label"] if cfg.task == "classification" else batch["targets"]
+            x, y = _batch_xy(cfg, batch)
             logits, new_vars = forward(variables, x)
             loss = _xent_loss(logits, y)
             # auxiliary losses sowed by modules (e.g. MoE load balancing)
@@ -298,8 +325,7 @@ class Trainer:
         def eval_step(state: TrainState, batch):
             variables = {"params": state.params,
                          **({"batch_stats": state.batch_stats} if state.batch_stats else {})}
-            x = batch["image"] if cfg.task == "classification" else batch["tokens"]
-            y = batch["label"] if cfg.task == "classification" else batch["targets"]
+            x, y = _batch_xy(cfg, batch)
             logits = self.model.apply(variables, x, train=False)
             return {"loss": _xent_loss(logits, y), "accuracy": (logits.argmax(-1) == y).mean()}
 
