@@ -44,6 +44,9 @@ IMAGES: tuple[ImageSpec, ...] = (
     ImageSpec("jax-notebook-tpu", ".", "images/notebook/Dockerfile",
               (("JAX_EXTRA", "tpu"),)),
     ImageSpec("platform", ".", "images/platform/Dockerfile"),
+    # utility images (reference: ingress-setup-image, private-utils)
+    ImageSpec("ingress-setup", ".", "images/ingress-setup/Dockerfile"),
+    ImageSpec("private-utils", ".", "images/private-utils/Dockerfile"),
 )
 
 
