@@ -66,16 +66,18 @@ def process_tpu(container: dict, pod_spec: dict, form: dict) -> None:
             sel["cloud.google.com/gke-tpu-topology"] = tpu["topology"]
 
 
-def notebook_from_form(namespace: str, form: dict) -> dict:
+def notebook_from_form(namespace: str, form: dict,
+                       config: dict | None = None) -> dict:
     """The yaml template + form fill (notebook.yaml:1-25 + app.py:13)."""
     name = form.get("name")
     if not name:
         raise ApiHttpError(400, "notebook form requires 'name'")
+    cfg = config or DEFAULT_CONFIG
     nb = NT.new_notebook(
         name, namespace,
-        image=form.get("image", DEFAULT_CONFIG["image"]["value"]),
-        cpu=str(form.get("cpu", DEFAULT_CONFIG["cpu"]["value"])),
-        memory=form.get("memory", DEFAULT_CONFIG["memory"]["value"]),
+        image=form.get("image", cfg["image"]["value"]),
+        cpu=str(form.get("cpu", cfg["cpu"]["value"])),
+        memory=form.get("memory", cfg["memory"]["value"]),
     )
     pod_spec = nb["spec"]["template"]["spec"]
     container = pod_spec["containers"][0]
@@ -116,9 +118,40 @@ def notebook_status(nb: dict, events: list[dict]) -> dict:
     }
 
 
+def load_spawner_config(path: str | None = None) -> dict:
+    """Admin-editable spawner options (spawner_ui_config.yaml contract:
+    the form's defaults/options come from a YAML file the platform
+    mounts, jupyter-web-app/backend main.py). `path` or $JWA_CONFIG
+    points at the YAML; keys deep-merge over the built-in default so a
+    config can override just one field."""
+    import os
+
+    path = path or os.environ.get("JWA_CONFIG")
+    if not path:
+        return DEFAULT_CONFIG
+    import copy
+
+    import yaml
+
+    with open(path) as f:
+        loaded = yaml.safe_load(f) or {}
+    # spawner_ui_config.yaml nests under spawnerFormDefaults
+    loaded = loaded.get("spawnerFormDefaults", loaded)
+
+    def merge(base, over):
+        out = copy.deepcopy(base)
+        for k, v in over.items():
+            out[k] = merge(out[k], v) if (
+                isinstance(v, dict) and isinstance(out.get(k), dict)) else v
+        return out
+
+    return merge(DEFAULT_CONFIG, loaded)
+
+
 class JupyterWebApp:
-    def __init__(self, client):
+    def __init__(self, client, config: dict | None = None):
         self.client = client
+        self.config = config if config is not None else load_spawner_config()
 
     def _user(self, req: HttpReq) -> str:
         return req.header(USER_HEADER, "anonymous@kubeflow.org")
@@ -126,7 +159,7 @@ class JupyterWebApp:
     # -- GET surfaces -------------------------------------------------------
 
     def get_config(self, req: HttpReq):
-        return {"config": DEFAULT_CONFIG}
+        return {"config": self.config}
 
     def get_namespaces(self, req: HttpReq):
         return {"namespaces": [
@@ -179,7 +212,7 @@ class JupyterWebApp:
 
     def post_notebook(self, req: HttpReq):
         ns = req.params["ns"]
-        nb = notebook_from_form(ns, req.json() or {})
+        nb = notebook_from_form(ns, req.json() or {}, self.config)
         try:
             self.client.create(nb)
         except ob.Conflict:

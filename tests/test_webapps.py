@@ -660,3 +660,36 @@ class TestJaxjobsCard:
         out = J(r.dispatch(mkreq("GET", "/api/namespaces/team-a/jaxjobs")))
         phases = {row["name"]: row["phase"] for row in out["jaxjobs"]}
         assert phases == {"ok": "succeeded", "bad": "failed"}
+
+
+def test_jwa_spawner_config_from_yaml(cluster, tmp_path, monkeypatch):
+    """spawner_ui_config.yaml contract: admin YAML deep-merges over the
+    built-in defaults and drives both /api/config and form fallbacks."""
+    import yaml as _yaml
+
+    from kubeflow_tpu.webapps.jwa import load_spawner_config
+
+    cfg_file = tmp_path / "spawner_ui_config.yaml"
+    cfg_file.write_text(_yaml.safe_dump({
+        "spawnerFormDefaults": {
+            "image": {"value": "corp/jax:2.0"},
+            "memory": {"value": "8Gi"},
+        }}))
+    monkeypatch.setenv("JWA_CONFIG", str(cfg_file))
+    app = JupyterWebApp(cluster)
+    r = app.router()
+    cfg = J(r.dispatch(mkreq("GET", "/api/config")))["config"]
+    assert cfg["image"]["value"] == "corp/jax:2.0"
+    assert cfg["memory"]["value"] == "8Gi"
+    # untouched keys survive the merge
+    assert cfg["tpu"]["options"] == [0, 1, 4, 8]
+    # the overridden default reaches created notebooks
+    J(r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                       body={"name": "nb1"})))
+    nb = cluster.get(NT.API_VERSION, NT.KIND, "nb1", "team-a")
+    assert nb["spec"]["template"]["spec"]["containers"][0]["image"] == \
+        "corp/jax:2.0"
+    # without the env var: pure defaults
+    monkeypatch.delenv("JWA_CONFIG")
+    assert load_spawner_config()["image"]["value"] == \
+        "kubeflow-tpu/jax-notebook:latest"
