@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# LM perf sweep queue (runs when the TPU tunnel is up). Each line is one
+# operating point; results append as JSON lines to tools/lm_sweep.log.
+# See BASELINE.md "Measurement interruption note" for why this exists.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/lm_sweep.log
+run() {
+  echo "### $* $(date -u +%H:%M:%S)" >> "$LOG"
+  timeout 900 python bench.py --workload lm "$@" 2>/dev/null | tail -1 >> "$LOG"
+}
+# gpt-350m adafactor: larger batch; dots-remat A/B
+run --lm-model gpt-350m --lm-optimizer adafactor --lm-batch 16
+run --lm-model gpt-350m --lm-optimizer adafactor --lm-batch 8 --lm-remat --lm-remat-policy dots
+# adamw + dots remat (fits now?)
+run --lm-model gpt-350m --lm-optimizer adamw --lm-batch 8 --lm-remat --lm-remat-policy dots
+# bigger models
+run --lm-model gpt-760m --lm-optimizer adafactor --lm-batch 8
+run --lm-model llama-1b --lm-optimizer adafactor --lm-batch 4 --lm-remat --lm-remat-policy dots
+# flash block-size sweep on the current best config
+for bq in 128 256 512; do
+  for bk in 128 256; do
+    echo "### blocks q=$bq k=$bk" >> "$LOG"
+    KFTPU_FLASH_BLOCK_Q=$bq KFTPU_FLASH_BLOCK_K=$bk \
+      timeout 900 python bench.py --workload lm --lm-model gpt-350m \
+      --lm-optimizer adafactor 2>/dev/null | tail -1 >> "$LOG"
+  done
+done
+echo "### sweep done $(date -u +%H:%M:%S)" >> "$LOG"
