@@ -148,3 +148,44 @@ def test_tpctl_server_concurrent_creates_single_worker_per_name():
             break
         time.sleep(0.05)
     assert conds.get("TpuDefAvailable") == "True", conds
+
+
+def test_leader_election_threaded_single_active():
+    """Two threaded controller managers with electors on one cluster:
+    every JAXJob still converges (exactly one full gang per job — no
+    duplicate pod sets from split-brain), and the workers' concurrent
+    try_acquire calls never error."""
+    from kubeflow_tpu.control.jaxjob import types as JT
+    from kubeflow_tpu.control.jaxjob.controller import build_controller
+    from kubeflow_tpu.control.leases import LeaderElector
+
+    cluster = FakeCluster()
+    electors = [LeaderElector(cluster, "jaxjob-controller",
+                              identity=f"pod-{i}", lease_seconds=2.0)
+                for i in range(2)]
+    ctls = [build_controller(cluster, record_events=False)
+            .with_leader_election(electors[i]) for i in range(2)]
+    for c in ctls:
+        c.run(workers=2)
+    try:
+        for j in range(6):
+            cluster.create(JT.new_jaxjob(f"job-{j}", replicas=2))
+            time.sleep(0.05)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            pods = cluster.list("v1", "Pod")
+            names = sorted(ob.meta(p)["name"] for p in pods)
+            want = sorted(f"job-{j}-worker-{i}"
+                          for j in range(6) for i in range(2))
+            if names == want:
+                break
+            time.sleep(0.2)
+        assert names == want, f"pod set diverged: {names}"
+        # exactly one elector holds the lease
+        assert sum(e.is_leader for e in electors) <= 1
+        lease = cluster.get("coordination.k8s.io/v1", "Lease",
+                            "jaxjob-controller", "kubeflow")
+        assert lease["spec"]["holderIdentity"] in ("pod-0", "pod-1")
+    finally:
+        for c in ctls:
+            c.stop()
