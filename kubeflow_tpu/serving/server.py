@@ -418,6 +418,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        max_new_tokens: int = 32, temperature: float = 0.0,
                        top_k: int = 0, seed: int = 0,
                        checkpoint_dir: str | None = None,
+                       batch_window_ms: float = 0.0, max_batch: int = 64,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -484,6 +485,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     return ServedModel(
         name=name, predict_fn=predict, pad_batches=True,
+        batch_window_ms=batch_window_ms, max_batch=max_batch,
         signature={"inputs": "tokens", "method_name": "generate",
                    "prompt_len": prompt_len,
                    "max_new_tokens": max_new_tokens})
