@@ -313,6 +313,22 @@ class ModelServer:
 
     # -- handlers -----------------------------------------------------------
 
+    def list_models(self, req: HttpReq):
+        """Inventory endpoint: every served model with versions and the
+        signature method (classify vs generate) — what a router or the
+        dashboard needs to enumerate the serving surface."""
+        with self._lock:
+            out = []
+            for name, versions in sorted(self._models.items()):
+                latest = versions[max(versions)]
+                out.append({
+                    "name": name,
+                    "versions": sorted(versions),
+                    "method": latest.signature.get("method_name", "predict"),
+                    "micro_batching": latest.batch_window_ms > 0,
+                })
+        return {"models": out}
+
     def status(self, req: HttpReq):
         name = req.params["model"]
         versions = self._models.get(name)
@@ -358,6 +374,7 @@ class ModelServer:
         r.route("POST", "/v1/models/{model}/versions/{version}:predict", self.predict)
         r.route("GET", "/v1/models/{model}/metadata", self.metadata)
         r.route("GET", "/v1/models/{model}", self.status)
+        r.route("GET", "/v1/models", self.list_models)
         httpd.add_health_routes(r)
         httpd.add_metrics_route(r)
         return r

@@ -408,3 +408,13 @@ def test_lm_generation_with_microbatching_coalesces_and_matches():
     for i in range(len(prompts)):
         assert outs[i]["predictions"] == solos[i]["predictions"], i
     assert max(calls) >= 2, f"no coalescing observed: {calls}"
+
+
+def test_list_models_inventory(server):
+    srv, url = server
+    out = requests.get(f"{url}/v1/models", timeout=30).json()
+    [m] = [x for x in out["models"] if x["name"] == "mnist"]
+    # module-scoped server: other tests may have registered more versions
+    assert 1 in m["versions"] and m["versions"] == sorted(m["versions"])
+    assert m["method"] == "predict"
+    assert m["micro_batching"] is False
