@@ -93,10 +93,24 @@ class ClusterMetricsService:
 
 class Dashboard:
     def __init__(self, client, kfam: KfamService | None = None,
-                 metrics: MetricsService | None = None):
+                 metrics: MetricsService | None = None,
+                 serving_url: str | None = None,
+                 fetch_json=None):
+        import os
+
         self.client = client
         self.kfam = kfam or KfamService(client)
         self.metrics = metrics or ClusterMetricsService(client)
+        self.serving_url = serving_url or os.environ.get(
+            "SERVING_URL", "http://serving.kubeflow.svc")
+        self.fetch_json = fetch_json or self._default_fetch
+
+    @staticmethod
+    def _default_fetch(url: str) -> dict:
+        import urllib.request
+
+        with urllib.request.urlopen(url, timeout=5) as resp:
+            return json.loads(resp.read())
 
     def _user(self, req: HttpReq, required: bool = True) -> str:
         user = req.header(USER_HEADER)
@@ -278,6 +292,19 @@ class Dashboard:
             })
         return {"jaxjobs": sorted(out, key=lambda r: r["name"])}
 
+    # -- serving card --------------------------------------------------------
+
+    def serving_models(self, req: HttpReq):
+        """Proxy the model server's /v1/models inventory; degrade to an
+        empty list with an error note when serving is unreachable (the
+        dashboard must render without every backend up)."""
+        self._user(req)
+        try:
+            out = self.fetch_json(f"{self.serving_url}/v1/models")
+            return {"models": out.get("models", [])}
+        except Exception as e:  # noqa: BLE001 — degrade, don't 500
+            return {"models": [], "error": str(e)[:200]}
+
     # -- activity + metrics -------------------------------------------------
 
     def activities(self, req: HttpReq):
@@ -313,6 +340,7 @@ class Dashboard:
         r.route("DELETE", "/api/workgroup/nuke-self", self.nuke_self)
         r.route("GET", "/api/namespaces/{namespace}/notebooks", self.notebooks)
         r.route("GET", "/api/namespaces/{namespace}/jaxjobs", self.jaxjobs)
+        r.route("GET", "/api/serving/models", self.serving_models)
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)

@@ -179,6 +179,12 @@ PAGE = """<!doctype html>
     <p class="muted" id="chart-note"></p>
   </div>
   <div class="card">
+    <h2>Served models</h2>
+    <table class="nbs"><tbody id="served">
+      <tr><td class="muted">loading…</td></tr>
+    </tbody></table>
+  </div>
+  <div class="card">
     <h2>Platform</h2>
     <ul id="envinfo"></ul>
   </div>
@@ -392,6 +398,31 @@ async function loadJaxjobs(ns) {
     tb.innerHTML = '<tr><td class="muted">no training jobs</td></tr>';
 }
 
+/* ---- served models card ---- */
+async function loadServing() {
+  const out = await api('/api/serving/models').catch(() => ({models: []}));
+  const tb = $('served');
+  tb.innerHTML = '';
+  for (const m of out.models || []) {
+    const tr = document.createElement('tr');
+    const name = document.createElement('td');
+    name.textContent = m.name;
+    const method = document.createElement('td');
+    const badge = document.createElement('span');
+    badge.className = 'badge running';
+    badge.textContent = m.method;
+    method.appendChild(badge);
+    const vers = document.createElement('td');
+    vers.className = 'muted';
+    vers.textContent = 'v' + (m.versions || []).join(', v');
+    tr.append(name, method, vers);
+    tb.appendChild(tr);
+  }
+  if (!tb.children.length)
+    tb.innerHTML = '<tr><td class="muted">' +
+      (out.error ? 'serving unreachable' : 'no models') + '</td></tr>';
+}
+
 async function loadNamespace(ns) {
   currentNs = ns;
   route();  // re-point an embedded app iframe at the selected namespace
@@ -471,6 +502,7 @@ $('metric-tabs').addEventListener('click', (e) => {
 $('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
 loadEnv().catch(e => { $('user').textContent = 'not signed in'; });
 loadChart();
+loadServing();
 route();
 setInterval(() => {
   if (currentNs && (location.hash || '#/') === '#/') {

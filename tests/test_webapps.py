@@ -693,3 +693,26 @@ def test_jwa_spawner_config_from_yaml(cluster, tmp_path, monkeypatch):
     monkeypatch.delenv("JWA_CONFIG")
     assert load_spawner_config()["image"]["value"] == \
         "kubeflow-tpu/jax-notebook:latest"
+
+
+class TestServingCard:
+    def test_proxies_model_inventory(self, cluster):
+        r = Dashboard(cluster, fetch_json=lambda url: {
+            "models": [{"name": "mnist", "versions": [1],
+                        "method": "predict", "micro_batching": False}]
+        }).router()
+        out = J(r.dispatch(mkreq("GET", "/api/serving/models")))
+        assert out["models"][0]["name"] == "mnist"
+
+    def test_degrades_when_serving_unreachable(self, cluster):
+        def boom(url):
+            raise OSError("connection refused")
+
+        r = Dashboard(cluster, fetch_json=boom).router()
+        out = J(r.dispatch(mkreq("GET", "/api/serving/models")))
+        assert out["models"] == [] and "refused" in out["error"]
+
+    def test_requires_identity(self, cluster):
+        r = Dashboard(cluster, fetch_json=lambda u: {"models": []}).router()
+        assert r.dispatch(mkreq("GET", "/api/serving/models",
+                                user=None)).status == 401
