@@ -17,8 +17,16 @@ PY_FILES = sorted(
     if "__pycache__" not in p.parts
 ) + [REPO / "bench.py", REPO / "__graft_entry__.py"]
 
+# the test corpus itself is lint-gated for the syntax/marker/debugger
+# checks (not the docstring rule: test helpers may be terse)
+TEST_FILES = sorted(
+    p for p in (REPO / "tests").rglob("*.py")
+    if "__pycache__" not in p.parts
+)
 
-@pytest.mark.parametrize("path", PY_FILES, ids=lambda p: str(p.relative_to(REPO)))
+
+@pytest.mark.parametrize("path", PY_FILES + TEST_FILES,
+                         ids=lambda p: str(p.relative_to(REPO)))
 def test_module_is_clean(path):
     src = path.read_text()
     tree = ast.parse(src, filename=str(path))  # syntax gate
