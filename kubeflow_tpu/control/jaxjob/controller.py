@@ -298,8 +298,9 @@ class JAXJobReconciler(Reconciler):
         statuses = (pod.get("status") or {}).get("containerStatuses") or []
         containers = (pod.get("spec") or {}).get("containers") or []
         main = containers[0].get("name") if containers else None
-        ordered = sorted(statuses, key=lambda cs: cs.get("name") != main)
-        for cs in ordered:
+        for cs in statuses:
+            if cs.get("name") != main:
+                continue  # a sidecar exiting 75 must not read as preemption
             term = (cs.get("state") or {}).get("terminated") or {}
             if "exitCode" in term:
                 return term["exitCode"]

@@ -67,10 +67,12 @@ class FakeKubelet:
     def fail(self, name: str, namespace: str = "default", message: str = "boom",
              exit_code: int = 1) -> None:
         pod = self.cluster.get("v1", "Pod", name, namespace)
+        containers = (pod.get("spec") or {}).get("containers") or []
+        main = containers[0].get("name", "main") if containers else "main"
         _set_phase(
             self.cluster, pod, "Failed",
             containerStatuses=[{
-                "name": "main",
+                "name": main,
                 "state": {"terminated": {"exitCode": exit_code,
                                          "message": message}},
                 "ready": False,
@@ -157,7 +159,8 @@ class LocalPodExecutor:
                     _set_phase(
                         self.cluster, pod, "Failed",
                         containerStatuses=[{
-                            "name": "main",
+                            "name": pod["spec"]["containers"][0].get(
+                                "name", "main"),
                             "state": {"terminated": {"exitCode": rc,
                                                      "message": out[-500:]}},
                         }],

@@ -227,18 +227,20 @@ def sha_suggestions(parameters: list[dict], max_trials: int, seed: int,
     n0 = sha_bracket(max_trials, rungs, eta)
     out = [dict(c, budget=rungs[0])
            for c in random_suggestions(parameters, n0, seed)]
-    obs = [o for o in (observations or []) if o.get("objective") is not None]
     for r in range(1, len(rungs)):
         prev_budget = rungs[r - 1]
         expected = len([s for s in out if s["budget"] == prev_budget])
-        done_prev = [o for o in obs
+        done_prev = [o for o in (observations or [])
                      if int(o["parameters"].get("budget", -1)) == prev_budget]
         if len(done_prev) < expected:
             break  # rung still running; promotions appear when it drains
-        keep = max(1, expected // eta)
-        done_prev.sort(key=lambda o: o["objective"],
+        # failed / metric-less trials count toward the drain above but are
+        # never promoted: promote the top 1/eta of the *survivors*
+        survivors = [o for o in done_prev if o.get("objective") is not None]
+        keep = min(max(1, expected // eta), len(survivors))
+        survivors.sort(key=lambda o: o["objective"],
                        reverse=(goal == "maximize"))
-        for o in done_prev[:keep]:
+        for o in survivors[:keep]:
             cfg = {k: v for k, v in o["parameters"].items() if k != "budget"}
             out.append(dict(cfg, budget=rungs[r]))
     return out
@@ -343,18 +345,18 @@ class StudyJobReconciler(Reconciler):
         n_done = n_failed = n_active = 0
         results: list[dict] = []
         for idx, t in by_idx.items():
-            if ob.cond_is_true(t, JT.COND_SUCCEEDED):
-                n_done += 1
-                val = self.collector(t)
+            succeeded = ob.cond_is_true(t, JT.COND_SUCCEEDED)
+            if succeeded or ob.cond_is_true(t, JT.COND_FAILED):
+                # failed trials observe objective None: they count toward
+                # rung drain in successive halving but are never promoted
+                n_done, n_failed = n_done + succeeded, n_failed + (not succeeded)
                 results.append({
                     "trial": ob.meta(t)["name"],
                     "parameters": json.loads(
                         ob.annotations_of(t).get(
                             "studyjob.kubeflow.org/parameters", "{}")),
-                    "objective": val,
+                    "objective": self.collector(t) if succeeded else None,
                 })
-            elif ob.cond_is_true(t, JT.COND_FAILED):
-                n_failed += 1
             else:
                 n_active += 1
 

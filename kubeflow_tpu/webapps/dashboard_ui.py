@@ -400,7 +400,8 @@ async function loadJaxjobs(ns) {
 
 /* ---- served models card ---- */
 async function loadServing() {
-  const out = await api('/api/serving/models').catch(() => ({models: []}));
+  const out = await api('/api/serving/models')
+    .catch((e) => ({models: [], error: String(e && e.message || e)}));
   const tb = $('served');
   tb.innerHTML = '';
   for (const m of out.models || []) {

@@ -406,6 +406,33 @@ class TestPreemptionClassification:
         assert job["status"].get("restarts", 0) == 1
         assert job["status"].get("preemptions", 0) == 0
 
+    def test_sidecar_75_with_unterminated_main_is_crash(self, world):
+        """Main container has no terminated record (e.g. OOMKilled with
+        status not yet populated) while a sidecar exited 75: must NOT
+        classify as graceful preemption — the restart budget applies."""
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("train", replicas=1)
+        job["spec"]["template"] = {"spec": {"containers": [
+            {"name": "main", "image": "jaxrt"},
+            {"name": "sidecar", "image": "logger"}]}}
+        cluster.create(job)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        pod = cluster.get("v1", "Pod", worker_name("train", 0), "default")
+        pod.setdefault("status", {}).update({
+            "phase": "Failed",
+            "containerStatuses": [
+                {"name": "sidecar",
+                 "state": {"terminated": {"exitCode": T.EXIT_PREEMPTED}}},
+                {"name": "main", "state": {"waiting": {}}},
+            ]})
+        cluster.update_status(pod)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+        assert job["status"].get("restarts", 0) == 1
+        assert job["status"].get("preemptions", 0) == 0
+
     def test_preemption_budget_backstop(self, world):
         """An always-preempting gang eventually fails instead of
         restarting forever."""

@@ -245,6 +245,28 @@ class TestSuccessiveHalving:
         best_lrs = sorted(s["lr"] for s in first)[:2]
         assert sorted(s["lr"] for s in promoted) == best_lrs
 
+    def test_failed_trials_drain_rung_without_promotion(self):
+        """A rung containing failed (objective-None) trials still drains;
+        promotions come from the survivors only — the bracket must not
+        stall forever nor promote a failed config."""
+        algo = {"minBudget": 5, "maxBudget": 10}
+        first = SJ.sha_suggestions(self.ALGO_PARAMS, 6, seed=0,
+                                   observations=[], algo=algo)
+        obs = [{"parameters": dict(s),
+                "objective": None if i < 3 else s["lr"]}
+               for i, s in enumerate(first)]
+        out = SJ.sha_suggestions(self.ALGO_PARAMS, 6, seed=0,
+                                 observations=obs, algo=algo)
+        promoted = [s for s in out if s["budget"] == 10]
+        # expected//eta = 2 but only 1 survivor -> exactly it is promoted
+        assert [s["lr"] for s in promoted] == [first[3]["lr"]]
+        # all trials failed: rung drains, nothing promoted, no stall
+        obs_all_failed = [{"parameters": dict(s), "objective": None}
+                          for s in first]
+        out = SJ.sha_suggestions(self.ALGO_PARAMS, 6, seed=0,
+                                 observations=obs_all_failed, algo=algo)
+        assert [s for s in out if s["budget"] == 10] == []
+
     def test_full_sha_sweep_promotes_and_substitutes_budget(self, world):
         cluster, study_ctl, jaxjob_ctl, kubelet = world
         sj = SJ.new_studyjob(

@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# Probe the axon TPU tunnel every ~10 min; when it answers, run the queued
+# LM sweep (tools/lm_sweep.sh) exactly once and exit. Writes status lines to
+# tools/tunnel_watch.log so the foreground session can see what happened.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tunnel_watch.log
+echo "watch start $(date -u +%H:%M:%S)" >> "$LOG"
+while true; do
+  if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
+    echo "tunnel UP $(date -u +%H:%M:%S) — launching lm_sweep" >> "$LOG"
+    bash tools/lm_sweep.sh
+    echo "sweep finished $(date -u +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  echo "tunnel down $(date -u +%H:%M:%S)" >> "$LOG"
+  sleep 600
+done
