@@ -1,5 +1,13 @@
-"""Webhook server entry: python -m kubeflow_tpu.control.poddefault."""
+"""Webhook server entry: python -m kubeflow_tpu.control.poddefault.
+
+Serves HTTPS when --certs-dir (or WEBHOOK_CERTS_DIR) is set — the kube
+apiserver refuses plain-HTTP webhook callees, so production manifests
+always set it (tpctl/manifests.py wires the matching caBundle into the
+MutatingWebhookConfiguration). Plain HTTP remains available for local
+debugging only. Reference flags: admission-webhook/main.go:541-542.
+"""
 import argparse
+import os
 
 from kubeflow_tpu.control.k8s.rest import RestClient
 from kubeflow_tpu.control.poddefault import PodDefaultMutator
@@ -7,7 +15,17 @@ from kubeflow_tpu.control.poddefault import PodDefaultMutator
 p = argparse.ArgumentParser("poddefault-webhook")
 p.add_argument("--port", type=int, default=4443)
 p.add_argument("--apiserver", default="")
+p.add_argument("--certs-dir", default=os.environ.get("WEBHOOK_CERTS_DIR", ""),
+               help="serve HTTPS with a bootstrapped CA + cert from this dir")
 args = p.parse_args()
-svc = PodDefaultMutator(RestClient(base_url=args.apiserver or None)).serve(port=args.port)
-print(f"poddefault webhook on :{svc.port}")
+mutator = PodDefaultMutator(RestClient(base_url=args.apiserver or None))
+svc = mutator.serve(port=args.port, certs_dir=args.certs_dir or None)
+print(f"poddefault webhook on :{svc.port} ({'https' if svc.tls else 'http'})")
+if svc.tls:
+    # announce our CA to the apiserver (background: the registration may
+    # be applied after this pod starts; serving must not wait on it)
+    import threading
+
+    threading.Thread(target=mutator.publish_ca_bundle, daemon=True,
+                     name="ca-bundle-publish").start()
 svc.serve_forever()

@@ -23,6 +23,8 @@ import base64
 import copy
 import json
 import logging
+import os
+import time
 
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.utils.httpd import HttpReq, HttpService, Router, json_resp
@@ -177,6 +179,7 @@ class PodDefaultMutator:
 
     def __init__(self, client):
         self.client = client
+        self.certs = None  # set by serve(certs_dir=...)
 
     def lookup(self, namespace: str) -> list[dict]:
         return self.client.list(API_VERSION, KIND, namespace=namespace)
@@ -216,7 +219,12 @@ class PodDefaultMutator:
         return {"apiVersion": "admission.k8s.io/v1", "kind": "AdmissionReview",
                 "response": resp}
 
-    def serve(self, host: str = "0.0.0.0", port: int = 0) -> HttpService:
+    def serve(self, host: str = "0.0.0.0", port: int = 0,
+              certs_dir: str | None = None) -> HttpService:
+        """Serve the AdmissionReview endpoint. With ``certs_dir`` the
+        server speaks HTTPS (bootstrapping a CA + serving cert there if
+        absent) — the only form a kube apiserver will call
+        (main.go:541-542's --tlsCertFile/--tlsKeyFile equivalent)."""
         router = Router("poddefault-webhook")
 
         def handle(req: HttpReq):
@@ -228,7 +236,44 @@ class PodDefaultMutator:
 
         add_health_routes(router)
         add_metrics_route(router)
-        return HttpService(router, host, port)
+        tls = None
+        if certs_dir:
+            from kubeflow_tpu.utils import tlscerts
+
+            self.certs = tlscerts.ensure_certs(
+                certs_dir, "poddefault-webhook",
+                namespace=os.environ.get("POD_NAMESPACE", "kubeflow"))
+            tls = tlscerts.server_context(self.certs.cert, self.certs.key)
+        return HttpService(router, host, port, tls=tls)
+
+    def publish_ca_bundle(self, registration: str = "poddefault-webhook",
+                          retries: int = 60, delay: float = 2.0) -> bool:
+        """Patch this pod's bootstrapped CA into the live
+        MutatingWebhookConfiguration so the apiserver can verify us —
+        the in-cluster replacement for the reference's out-of-band
+        cert-gen step (README.md:66 'caBundle: ...'). Retries because
+        the registration may be applied after the pod starts."""
+        if self.certs is None:
+            return False
+        bundle = self.certs.ca_bundle_b64
+        for attempt in range(retries):
+            try:
+                hook = self.client.get(
+                    "admissionregistration.k8s.io/v1",
+                    "MutatingWebhookConfiguration", registration)
+                changed = False
+                for wh in hook.get("webhooks") or []:
+                    cc = wh.setdefault("clientConfig", {})
+                    if cc.get("caBundle") != bundle:
+                        cc["caBundle"] = bundle
+                        changed = True
+                if changed:
+                    self.client.update(hook)
+                return True
+            except Exception as e:  # registration not applied yet / conflict
+                log.info("caBundle publish attempt %d: %s", attempt + 1, e)
+                time.sleep(delay)
+        return False
 
 
 def _json_patch_diff(old: dict, new: dict) -> list[dict]:

@@ -167,10 +167,24 @@ def render(cfg: TpuDef) -> list[dict]:
         out.append(dep)
 
     if "poddefault-webhook" in apps:
-        out.append(_deployment(
+        # the apiserver only dials webhooks over verified HTTPS
+        # (admission-webhook/main.go:541-542). Certs are NOT rendered here:
+        # the pod self-bootstraps a CA + serving cert in its emptyDir at
+        # startup and patches the live caBundle into this registration
+        # (webhook.py publish_ca_bundle) — keys never touch manifests, the
+        # state repo, or the operator's machine (README.md:66 leaves
+        # caBundle to out-of-band provisioning; ours is in-cluster).
+        dep = _deployment(
             "poddefault-webhook", ns, img("controller"),
             args=["python", "-m", "kubeflow_tpu.control.poddefault"],
-            port=4443, sa="kubeflow-controller"))
+            env={"WEBHOOK_CERTS_DIR": "/etc/webhook/certs",
+                 "POD_NAMESPACE": ns},
+            port=4443, sa="kubeflow-controller")
+        pod = dep["spec"]["template"]["spec"]
+        pod["volumes"] = [{"name": "certs", "emptyDir": {}}]
+        pod["containers"][0]["volumeMounts"] = [{
+            "name": "certs", "mountPath": "/etc/webhook/certs"}]
+        out.append(dep)
         out.append(_service("poddefault-webhook", ns, 443, 4443))
         hook = ob.new_object(
             "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
@@ -179,9 +193,12 @@ def render(cfg: TpuDef) -> list[dict]:
             "name": "poddefault.kubeflow.org",
             "admissionReviewVersions": ["v1"],
             "sideEffects": "None",
-            "clientConfig": {"service": {
-                "name": "poddefault-webhook", "namespace": ns,
-                "path": "/apply-poddefault"}},
+            "clientConfig": {
+                "service": {"name": "poddefault-webhook", "namespace": ns,
+                            "path": "/apply-poddefault", "port": 443},
+                # patched by the pod once its CA exists; empty until then
+                "caBundle": "",
+            },
             "rules": [{"apiGroups": [""], "apiVersions": ["v1"],
                        "operations": ["CREATE"], "resources": ["pods"]}],
             "failurePolicy": "Ignore",

@@ -147,9 +147,15 @@ def add_health_routes(router: Router) -> None:
 
 
 class HttpService:
-    """ThreadingHTTPServer wrapper; serve_background() for tests/embedding."""
+    """ThreadingHTTPServer wrapper; serve_background() for tests/embedding.
 
-    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0):
+    Pass ``tls`` (an ``ssl.SSLContext`` from ``tlscerts.server_context``)
+    to serve HTTPS — required for admission webhooks, where the kube
+    apiserver refuses plain-HTTP callees (admission-webhook/main.go:541-542
+    serves cert/key for the same reason)."""
+
+    def __init__(self, router: Router, host: str = "0.0.0.0", port: int = 0,
+                 tls: "ssl.SSLContext | None" = None):
         self.router = router
         router_ref = router
 
@@ -180,6 +186,10 @@ class HttpService:
                 log.debug("%s %s", self.address_string(), fmt % args)
 
         self._server = ThreadingHTTPServer((host, port), _Handler)
+        if tls is not None:
+            self._server.socket = tls.wrap_socket(
+                self._server.socket, server_side=True)
+        self.tls = tls is not None
         self.port = self._server.server_address[1]
         self._thread: threading.Thread | None = None
 
