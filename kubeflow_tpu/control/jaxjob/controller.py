@@ -105,7 +105,9 @@ class JAXJobReconciler(Reconciler):
     def generate_pod(self, job: dict, index: int) -> dict:
         m = ob.meta(job)
         spec = job["spec"]
-        replicas = spec.get("replicas", 1)
+        total = T.gang_size(spec)
+        per_slice = spec.get("replicas", 1)
+        slices = spec.get("sliceCount", 1)
         tmpl = ob.deep_copy(spec.get("template") or {"spec": {"containers": []}})
         pod_spec = tmpl.setdefault("spec", {})
         pod_spec.setdefault("restartPolicy", "Never")
@@ -113,13 +115,22 @@ class JAXJobReconciler(Reconciler):
         pod_spec["hostname"] = worker_name(m["name"], index)
         pod_spec["subdomain"] = m["name"]
 
+        # contiguous-rank slice assignment: ranks [s*R, (s+1)*R) form slice
+        # s, matching mesh.py's reshape layout for the `dcn` axis
+        slice_id = index // per_slice
         env = [
             {"name": T.ENV_COORD, "value": self.coordinator_address(job)},
-            {"name": T.ENV_NPROC, "value": str(replicas)},
+            {"name": T.ENV_NPROC, "value": str(total)},
             {"name": T.ENV_PID, "value": str(index)},
             {"name": T.ENV_NAME, "value": m["name"]},
             {"name": T.ENV_NAMESPACE, "value": m["namespace"]},
         ]
+        if slices > 1:
+            from kubeflow_tpu.parallel import dist as D
+
+            env += [{"name": k, "value": v} for k, v in sorted(
+                D.slice_env(slices, slice_id,
+                            self.coordinator_address(job)).items())]
         tpu = spec.get("tpu") or {}
         for c in pod_spec.get("containers", []):
             have = {e["name"] for e in c.get("env", [])}
@@ -138,6 +149,8 @@ class JAXJobReconciler(Reconciler):
             T.LABEL_JOB_NAME: m["name"],
             T.LABEL_REPLICA_INDEX: str(index),
         }
+        if slices > 1:
+            labels[T.LABEL_SLICE_INDEX] = str(slice_id)
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -184,7 +197,7 @@ class JAXJobReconciler(Reconciler):
         rh.reconcile_child(client, job, self.generate_service(job))
 
         spec = job["spec"]
-        replicas = spec.get("replicas", 1)
+        replicas = T.gang_size(spec)  # total pods across all slices
         pods = client.list(
             "v1", "Pod", namespace=req.namespace,
             label_selector={"matchLabels": {T.LABEL_JOB_NAME: req.name}},

@@ -32,6 +32,7 @@ COND_FAILED = "Failed"
 # Pod labels (the `notebook-name` analogue, notebook_controller.go:541-563)
 LABEL_JOB_NAME = "jaxjob.kubeflow.org/job-name"
 LABEL_REPLICA_INDEX = "jaxjob.kubeflow.org/replica-index"
+LABEL_SLICE_INDEX = "jaxjob.kubeflow.org/slice-index"
 
 # Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env
 ENV_COORD = "JAXJOB_COORDINATOR_ADDRESS"
@@ -39,6 +40,8 @@ ENV_NPROC = "JAXJOB_NUM_PROCESSES"
 ENV_PID = "JAXJOB_PROCESS_ID"
 ENV_NAME = "JAXJOB_NAME"
 ENV_NAMESPACE = "JAXJOB_NAMESPACE"
+ENV_NUM_SLICES = "JAXJOB_NUM_SLICES"
+ENV_SLICE_ID = "JAXJOB_SLICE_ID"
 
 # GKE TPU scheduling surface (the nvidia.com/gpu swap point —
 # create_job_specs.py:165-170 sets resources.limits["nvidia.com/gpu"])
@@ -60,11 +63,19 @@ EXIT_PREEMPTED = 75
 TAINT_IMPENDING_TERMINATION = "cloud.google.com/impending-node-termination"
 
 
+def gang_size(spec: dict) -> int:
+    """Total worker pods = replicas-per-slice x sliceCount. The whole
+    multislice set is ONE gang and ONE jax.distributed world; the mesh's
+    `dcn` axis spans the slice boundary (parallel/mesh.py)."""
+    return spec.get("replicas", 1) * spec.get("sliceCount", 1)
+
+
 def new_jaxjob(
     name: str,
     namespace: str = "default",
     *,
     replicas: int = 1,
+    slice_count: int = 1,
     image: str = "kubeflow-tpu/jaxrt:latest",
     command: list[str] | None = None,
     accelerator: str | None = None,
@@ -73,7 +84,12 @@ def new_jaxjob(
     restart_policy: str = RESTART_GANG,
     max_restarts: int = 3,
 ) -> dict:
-    """Convenience constructor (the create_job_specs.py analogue)."""
+    """Convenience constructor (the create_job_specs.py analogue).
+
+    ``replicas`` is the worker count PER SLICE; ``slice_count`` > 1 asks
+    for a multislice deployment (the reference's closest analogue is the
+    multi-replica TFJob topology, create_job_specs.py:125-191 — but DCN
+    replaces the PS/gRPC fabric)."""
     spec: dict = {
         "replicas": replicas,
         "template": {
@@ -94,6 +110,8 @@ def new_jaxjob(
         "restartPolicy": restart_policy,
         "maxRestarts": max_restarts,
     }
+    if slice_count > 1:
+        spec["sliceCount"] = slice_count
     if accelerator:
         spec["tpu"] = {
             "accelerator": accelerator,
@@ -110,6 +128,9 @@ def validate(job: dict) -> list[str]:
     replicas = spec.get("replicas", 1)
     if not isinstance(replicas, int) or replicas < 1:
         errs.append(f"spec.replicas must be a positive int, got {replicas!r}")
+    slices = spec.get("sliceCount", 1)
+    if not isinstance(slices, int) or slices < 1:
+        errs.append(f"spec.sliceCount must be a positive int, got {slices!r}")
     tmpl = spec.get("template") or {}
     containers = (tmpl.get("spec") or {}).get("containers") or []
     if not containers:

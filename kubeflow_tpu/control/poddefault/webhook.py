@@ -247,16 +247,22 @@ class PodDefaultMutator:
         return HttpService(router, host, port, tls=tls)
 
     def publish_ca_bundle(self, registration: str = "poddefault-webhook",
-                          retries: int = 60, delay: float = 2.0) -> bool:
+                          retries: int | None = None,
+                          delay: float = 2.0) -> bool:
         """Patch this pod's bootstrapped CA into the live
         MutatingWebhookConfiguration so the apiserver can verify us —
         the in-cluster replacement for the reference's out-of-band
-        cert-gen step (README.md:66 'caBundle: ...'). Retries because
-        the registration may be applied after the pod starts."""
+        cert-gen step (README.md:66 'caBundle: ...'). Retries because the
+        registration may be applied after the pod starts; ``retries=None``
+        (the server default) retries forever with capped backoff — giving
+        up would leave admission silently skipped under
+        failurePolicy: Ignore."""
         if self.certs is None:
             return False
         bundle = self.certs.ca_bundle_b64
-        for attempt in range(retries):
+        attempt = 0
+        while retries is None or attempt < retries:
+            attempt += 1
             try:
                 hook = self.client.get(
                     "admissionregistration.k8s.io/v1",
@@ -271,8 +277,11 @@ class PodDefaultMutator:
                     self.client.update(hook)
                 return True
             except Exception as e:  # registration not applied yet / conflict
-                log.info("caBundle publish attempt %d: %s", attempt + 1, e)
-                time.sleep(delay)
+                level = log.warning if attempt % 30 == 0 else log.info
+                level("caBundle publish attempt %d: %s", attempt, e)
+                time.sleep(min(delay * min(attempt, 8), 15.0))
+        log.error("caBundle never published after %d attempts: admission "
+                  "will be silently skipped (failurePolicy: Ignore)", attempt)
         return False
 
 

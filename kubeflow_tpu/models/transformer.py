@@ -31,19 +31,19 @@ from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.models.registry import register_model
 from kubeflow_tpu.parallel.mesh import (
-    AXIS_DATA,
     AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_MODEL,
     AXIS_SEQ,
+    BATCH_AXES,
 )
 
 Dtype = Any
 
-# Activation sharding: batch over (data, fsdp), sequence over seq, features
-# over model only where the tensor is the "wide" intermediate.
-HIDDEN_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
-WIDE_SPEC = P((AXIS_DATA, AXIS_FSDP), AXIS_SEQ, AXIS_MODEL)
+# Activation sharding: batch over (dcn, data, fsdp), sequence over seq,
+# features over model only where the tensor is the "wide" intermediate.
+HIDDEN_SPEC = P(BATCH_AXES, AXIS_SEQ, None)
+WIDE_SPEC = P(BATCH_AXES, AXIS_SEQ, AXIS_MODEL)
 
 
 def shard(x: jax.Array, spec: P) -> jax.Array:

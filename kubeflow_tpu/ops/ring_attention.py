@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from kubeflow_tpu.parallel.mesh import AXIS_DATA, AXIS_FSDP, AXIS_MODEL, AXIS_SEQ
+from kubeflow_tpu.parallel.mesh import AXIS_MODEL, AXIS_SEQ, BATCH_AXES
 
 NEG_INF = -1e30
 
@@ -101,7 +101,7 @@ def ring_attention(
         v = jnp.repeat(v, h // v.shape[2], axis=2)
     model_size = mesh.shape.get(AXIS_MODEL, 1) if AXIS_MODEL in mesh.axis_names else 1
     head_axis = AXIS_MODEL if h % max(model_size, 1) == 0 and model_size > 1 else None
-    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, head_axis, None)
+    qkv_spec = P(BATCH_AXES, axis_name, head_axis, None)
 
     @functools.partial(
         jax.shard_map,

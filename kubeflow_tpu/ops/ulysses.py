@@ -32,8 +32,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from kubeflow_tpu.parallel.mesh import (
-    AXIS_DATA,
-    AXIS_FSDP,
+    BATCH_AXES,
     AXIS_MODEL,
     AXIS_SEQ,
     current_mesh as _current_mesh,
@@ -82,7 +81,7 @@ def ulysses_attention(
         )
     assert q.shape[1] % sp == 0, (q.shape, sp)
 
-    qkv_spec = P((AXIS_DATA, AXIS_FSDP), axis_name, head_axis, None)
+    qkv_spec = P(BATCH_AXES, axis_name, head_axis, None)
 
     @functools.partial(
         jax.shard_map,

@@ -3,34 +3,29 @@
 TPU-native replacement for the reference's parallelism matrix (SURVEY.md
 §2.5): parameter-server data parallelism and MPI/NCCL allreduce become XLA
 collectives over ICI, compiled into the step function by GSPMD.
+
+Re-exports are lazy (PEP 562): `mesh` imports jax/numpy at module top,
+but the control plane (which runs in a jax-free image) only needs
+`parallel.dist` — `from kubeflow_tpu.parallel import dist` must not drag
+jax in. Tests pin this invariant (test_dist.py).
 """
 
-from kubeflow_tpu.parallel.mesh import (
-    AXIS_DATA,
-    AXIS_EXPERT,
-    AXIS_FSDP,
-    AXIS_MODEL,
-    AXIS_PIPELINE,
-    AXIS_SEQ,
-    MeshSpec,
-    build_mesh,
-)
-from kubeflow_tpu.parallel.dist import (
-    DistConfig,
-    initialize_from_env,
-    is_coordinator,
-)
+_MESH_NAMES = {"AXIS_DATA", "AXIS_DCN", "AXIS_EXPERT", "AXIS_FSDP",
+               "AXIS_MODEL", "AXIS_PIPELINE", "AXIS_SEQ", "BATCH_AXES",
+               "MeshSpec", "build_mesh"}
+_DIST_NAMES = {"DistConfig", "initialize_from_env", "is_coordinator",
+               "slice_env"}
 
-__all__ = [
-    "AXIS_DATA",
-    "AXIS_EXPERT",
-    "AXIS_FSDP",
-    "AXIS_MODEL",
-    "AXIS_PIPELINE",
-    "AXIS_SEQ",
-    "MeshSpec",
-    "build_mesh",
-    "DistConfig",
-    "initialize_from_env",
-    "is_coordinator",
-]
+__all__ = sorted(_MESH_NAMES | _DIST_NAMES)
+
+
+def __getattr__(name: str):
+    if name in _MESH_NAMES:
+        from kubeflow_tpu.parallel import mesh
+
+        return getattr(mesh, name)
+    if name in _DIST_NAMES:
+        from kubeflow_tpu.parallel import dist
+
+        return getattr(dist, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

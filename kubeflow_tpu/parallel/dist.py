@@ -35,7 +35,13 @@ ENV_NPROC = "JAXJOB_NUM_PROCESSES"
 ENV_PID = "JAXJOB_PROCESS_ID"
 ENV_NAME = "JAXJOB_NAME"
 ENV_NAMESPACE = "JAXJOB_NAMESPACE"
+# Multislice (one jax.distributed world spanning several ICI slices wired
+# by DCN). The JAXJob controller injects these alongside the libtpu
+# MEGASCALE_* vars; the mesh's `dcn` axis maps onto the slice boundary.
+ENV_NUM_SLICES = "JAXJOB_NUM_SLICES"
+ENV_SLICE_ID = "JAXJOB_SLICE_ID"
 DEFAULT_COORD_PORT = 8476
+MEGASCALE_PORT = 8080
 
 
 @dataclasses.dataclass(frozen=True)
@@ -45,10 +51,19 @@ class DistConfig:
     process_id: int
     job_name: str = ""
     namespace: str = ""
+    # multislice topology: this process's slice and the slice count; the
+    # `dcn` mesh axis spans slices (slice_id = process_id // procs-per-slice
+    # under the controller's contiguous-rank assignment)
+    num_slices: int = 1
+    slice_id: int = 0
 
     @property
     def distributed(self) -> bool:
         return self.num_processes > 1
+
+    @property
+    def multislice(self) -> bool:
+        return self.num_slices > 1
 
     @classmethod
     def from_env(cls, env: dict[str, str] | None = None) -> "DistConfig":
@@ -64,6 +79,8 @@ class DistConfig:
             process_id=pid,
             job_name=env.get(ENV_NAME, ""),
             namespace=env.get(ENV_NAMESPACE, ""),
+            num_slices=int(env.get(ENV_NUM_SLICES, "1")),
+            slice_id=int(env.get(ENV_SLICE_ID, "0")),
         )
 
     def to_env(self) -> dict[str, str]:
@@ -78,7 +95,28 @@ class DistConfig:
             env[ENV_NAME] = self.job_name
         if self.namespace:
             env[ENV_NAMESPACE] = self.namespace
+        if self.num_slices > 1:
+            env.update(slice_env(self.num_slices, self.slice_id,
+                                 self.coordinator_address))
         return env
+
+
+def slice_env(num_slices: int, slice_id: int,
+              coordinator_address: str | None) -> dict[str, str]:
+    """Multislice env block: the JAXJOB_* contract plus the MEGASCALE_*
+    vars libtpu's DCN transport reads at backend init. The megascale
+    coordinator rides the same host as the jax.distributed one."""
+    env = {
+        ENV_NUM_SLICES: str(num_slices),
+        ENV_SLICE_ID: str(slice_id),
+        "MEGASCALE_NUM_SLICES": str(num_slices),
+        "MEGASCALE_SLICE_ID": str(slice_id),
+        "MEGASCALE_PORT": str(MEGASCALE_PORT),
+    }
+    host = (coordinator_address or "").partition(":")[0]
+    if host:
+        env["MEGASCALE_COORDINATOR_ADDRESS"] = f"{host}:{MEGASCALE_PORT}"
+    return env
 
 
 def wait_for_coordinator(address: str, timeout_s: float = 300.0) -> None:
@@ -111,6 +149,13 @@ def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True)
     an empty TF_CONFIG, launcher.py:64-66).
     """
     cfg = DistConfig.from_env(env)
+    if cfg.multislice:
+        # libtpu reads MEGASCALE_* at backend init; when only the JAXJOB_*
+        # contract is present (bare launch, tests) derive them here so the
+        # DCN transport still configures itself before jax imports
+        for k, v in cfg.to_env().items():
+            if k.startswith("MEGASCALE_"):
+                os.environ.setdefault(k, v)
     if cfg.distributed:
         import jax  # deferred: must happen before any backend init
 

@@ -9,6 +9,10 @@ that torus. This module owns the mapping from a logical parallelism spec
 
 Axis vocabulary (used by models, trainer, and kernels throughout):
 
+- ``dcn``      — the cross-slice axis: data parallelism over the
+                 data-center network on multislice deployments (one
+                 gradient all-reduce per step; the only collective slow
+                 enough for DCN).
 - ``data``     — pure data parallelism (gradient all-reduce).
 - ``fsdp``     — data parallelism with parameter/optimizer sharding
                  (all-gather params, reduce-scatter grads).
@@ -17,11 +21,14 @@ Axis vocabulary (used by models, trainer, and kernels throughout):
 - ``seq``      — sequence/context parallelism (ring attention axis).
 - ``expert``   — expert parallelism for MoE (all-to-all dispatch).
 
-Collectives for `data`/`fsdp` are cheap and tolerate DCN; `model`/`seq`
-collectives are per-layer and must ride ICI. `build_mesh` therefore puts
-the fastest-varying (innermost, ICI-adjacent) device dimension on
-`model`/`seq` and the outermost on `data`, matching the scaling-book
-recipe of "model-parallel inner, data-parallel outer".
+Collectives for `dcn`/`data`/`fsdp` are cheap and tolerate DCN;
+`model`/`seq` collectives are per-layer and must ride ICI. `build_mesh`
+therefore puts the fastest-varying (innermost, ICI-adjacent) device
+dimension on `model`/`seq` and the outermost on `dcn` then `data`,
+matching the scaling-book recipe of "model-parallel inner, data-parallel
+outer, slices outermost". On real multislice hardware the ``dcn`` axis is
+placed with `mesh_utils.create_hybrid_device_mesh` so each slice's
+devices stay ICI-contiguous.
 """
 
 from __future__ import annotations
@@ -35,6 +42,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+AXIS_DCN = "dcn"
 AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_PIPELINE = "pipe"
@@ -43,8 +51,13 @@ AXIS_SEQ = "seq"
 AXIS_MODEL = "model"
 
 # Outer-to-inner physical placement order. Inner axes get ICI-adjacent
-# devices; outer axes may span DCN on multi-slice deployments.
-_AXIS_ORDER = (AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT, AXIS_SEQ, AXIS_MODEL)
+# devices; the outermost (dcn) spans slices on multi-slice deployments.
+_AXIS_ORDER = (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT,
+               AXIS_SEQ, AXIS_MODEL)
+
+# Every batch-sharded PartitionSpec uses this tuple; size-1 axes are free,
+# so single-slice meshes pay nothing for carrying the dcn name.
+BATCH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -57,6 +70,7 @@ class MeshSpec:
     device count at mesh-build time.
     """
 
+    dcn: int = 1
     data: int = -1
     fsdp: int = 1
     pipe: int = 1
@@ -66,7 +80,8 @@ class MeshSpec:
 
     def resolve(self, n_devices: int) -> "MeshSpec":
         """Resolve data=-1 against the device count; validate divisibility."""
-        fixed = self.fsdp * self.pipe * self.expert * self.seq * self.model
+        fixed = (self.dcn * self.fsdp * self.pipe * self.expert * self.seq
+                 * self.model)
         data = self.data
         if data == -1:
             if n_devices % fixed != 0:
@@ -84,6 +99,7 @@ class MeshSpec:
 
     def axis_sizes(self) -> dict[str, int]:
         return {
+            AXIS_DCN: self.dcn,
             AXIS_DATA: self.data,
             AXIS_FSDP: self.fsdp,
             AXIS_PIPELINE: self.pipe,
@@ -95,7 +111,7 @@ class MeshSpec:
     @property
     def batch_axes(self) -> tuple[str, ...]:
         """Axes the global batch is sharded over."""
-        return (AXIS_DATA, AXIS_FSDP)
+        return BATCH_AXES
 
     @classmethod
     def from_dict(cls, d: Mapping[str, Any]) -> "MeshSpec":
@@ -125,19 +141,33 @@ def build_mesh(
     spec = spec.resolve(len(devices))
     sizes = spec.axis_sizes()
     shape = tuple(sizes[a] for a in _AXIS_ORDER)
+    dev_np = np.asarray(devices, dtype=object)
+    if spec.dcn > 1 and all(
+            getattr(d, "slice_index", None) is not None for d in devices):
+        # real multislice hardware: the dcn axis must fall on slice
+        # boundaries so inner axes stay ICI-contiguous. Errors here (dcn
+        # not matching the actual slice count, per-slice shape mismatch)
+        # MUST propagate — a silent reshape would put per-layer
+        # collectives on DCN, an order-of-magnitude slowdown.
+        ici_shape = (1,) + shape[1:]
+        dcn_shape = (spec.dcn,) + (1,) * (len(shape) - 1)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            ici_shape, dcn_shape, devices=dev_np)
+        return Mesh(dev_array, _AXIS_ORDER)
     try:
-        dev_array = mesh_utils.create_device_mesh(
-            shape, devices=np.asarray(devices, dtype=object)
-        )
+        dev_array = mesh_utils.create_device_mesh(shape, devices=dev_np)
     except (ValueError, AssertionError, NotImplementedError):
-        dev_array = np.asarray(devices, dtype=object).reshape(shape)
+        # CPU/interpreter devices (no slice topology): plain reshape keeps
+        # the dcn axis outermost, which is exactly the contiguous-rank
+        # layout the JAXJob controller assigns slices by
+        dev_array = dev_np.reshape(shape)
     return Mesh(dev_array, _AXIS_ORDER)
 
 
 def batch_spec(mesh: Mesh, extra_dims: int = 0) -> P:
     """PartitionSpec for a batch-major array: shard dim 0 over data axes."""
     del mesh
-    return P((AXIS_DATA, AXIS_FSDP), *([None] * extra_dims))
+    return P(BATCH_AXES, *([None] * extra_dims))
 
 
 def batch_sharding(mesh: Mesh, extra_dims: int = 0) -> NamedSharding:
@@ -149,7 +179,7 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def local_batch_size(mesh: Mesh, global_batch: int) -> int:
-    n = mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    n = mesh.shape[AXIS_DCN] * mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
     if global_batch % n:
         raise ValueError(f"global batch {global_batch} not divisible by dp={n}")
     return global_batch // n

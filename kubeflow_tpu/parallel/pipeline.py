@@ -36,15 +36,14 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.parallel.mesh import (
-    AXIS_DATA,
-    AXIS_FSDP,
     AXIS_PIPELINE,
     AXIS_SEQ,
+    BATCH_AXES,
     shard_constraint as _shard,
 )
 
 # Activation-buffer layout: [stage, microbatch, seq, features]
-STATE_SPEC = P(AXIS_PIPELINE, (AXIS_DATA, AXIS_FSDP), AXIS_SEQ, None)
+STATE_SPEC = P(AXIS_PIPELINE, BATCH_AXES, AXIS_SEQ, None)
 
 
 class SPMDPipeline(nn.Module):

@@ -26,6 +26,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from kubeflow_tpu.models.registry import get_model
 from kubeflow_tpu.parallel.mesh import (
     AXIS_DATA,
+    AXIS_DCN,
     AXIS_FSDP,
     AXIS_PIPELINE,
     MeshSpec,
@@ -257,7 +258,8 @@ class Trainer:
         # Init with one row per data-parallel group: parameter shapes don't
         # depend on batch, but the init forward must still satisfy the
         # batch-axis sharding (ring attention shard_maps over it).
-        dp = self.mesh.shape[AXIS_DATA] * self.mesh.shape[AXIS_FSDP]
+        dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
+              * self.mesh.shape[AXIS_FSDP])
         variables = self.model.init(rng, x[:dp], train=True)
         return variables
 

@@ -3,6 +3,8 @@ import threading
 
 import pytest
 
+from kubeflow_tpu.parallel import dist
+
 from kubeflow_tpu.parallel.dist import (
     ENV_COORD,
     ENV_NPROC,
@@ -70,3 +72,74 @@ def test_wait_for_coordinator_success():
 def test_wait_for_coordinator_timeout():
     with pytest.raises(TimeoutError):
         wait_for_coordinator("127.0.0.1:1", timeout_s=0.3)
+
+
+class TestMultislice:
+    """SURVEY §2.5 "DCN across slices": the JAXJOB_NUM_SLICES /
+    JAXJOB_SLICE_ID contract plus the MEGASCALE_* vars libtpu's DCN
+    transport reads."""
+
+    def test_slice_env_block(self):
+        env = dist.slice_env(2, 1, "job-worker-0.job.ns.svc:8476")
+        assert env[dist.ENV_NUM_SLICES] == "2"
+        assert env[dist.ENV_SLICE_ID] == "1"
+        assert env["MEGASCALE_NUM_SLICES"] == "2"
+        assert env["MEGASCALE_SLICE_ID"] == "1"
+        assert env["MEGASCALE_COORDINATOR_ADDRESS"] == \
+            f"job-worker-0.job.ns.svc:{dist.MEGASCALE_PORT}"
+
+    def test_config_roundtrip_with_slices(self):
+        cfg = dist.DistConfig(
+            coordinator_address="c:8476", num_processes=4, process_id=3,
+            num_slices=2, slice_id=1)
+        assert cfg.multislice
+        back = dist.DistConfig.from_env(cfg.to_env())
+        assert back.num_slices == 2 and back.slice_id == 1
+        assert back.num_processes == 4 and back.process_id == 3
+
+    def test_single_slice_emits_no_megascale(self):
+        cfg = dist.DistConfig(
+            coordinator_address="c:8476", num_processes=2, process_id=0)
+        env = cfg.to_env()
+        assert not any(k.startswith("MEGASCALE") for k in env)
+        assert dist.ENV_NUM_SLICES not in env
+
+    def test_initialize_derives_megascale_env(self, monkeypatch):
+        import os
+
+        for k in list(os.environ):
+            if k.startswith("MEGASCALE"):
+                monkeypatch.delenv(k)
+        cfg_env = {dist.ENV_NPROC: "1", dist.ENV_NUM_SLICES: "2",
+                   dist.ENV_SLICE_ID: "1",
+                   dist.ENV_COORD: "coord-host:8476"}
+        try:
+            dist.initialize_from_env(cfg_env)
+            assert os.environ["MEGASCALE_SLICE_ID"] == "1"
+            assert os.environ["MEGASCALE_COORDINATOR_ADDRESS"] == \
+                f"coord-host:{dist.MEGASCALE_PORT}"
+        finally:
+            for k in list(os.environ):
+                if k.startswith("MEGASCALE"):
+                    del os.environ[k]
+
+    def test_dist_import_is_jax_free(self):
+        """The JAXJob controller image has no jax; importing
+        kubeflow_tpu.parallel.dist (as generate_pod does for slice_env)
+        must not pull it in. The lazy parallel/__init__ guards this."""
+        import subprocess
+        import sys
+
+        code = ("import sys\n"
+                "from kubeflow_tpu.parallel import dist\n"
+                "dist.slice_env(2, 1, 'c:8476')\n"
+                "from kubeflow_tpu.control.jaxjob.controller import "
+                "JAXJobReconciler\n"
+                "assert 'jax' not in sys.modules, 'jax leaked into "
+                "the control-plane import graph'\n"
+                "print('jax-free')\n")
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            env={"PATH": "/usr/bin:/bin", "PYTHONPATH": "."})
+        assert out.returncode == 0, out.stderr
+        assert "jax-free" in out.stdout

@@ -21,7 +21,7 @@ def test_all_examples_are_covered_here():
             for p in glob.glob(os.path.join(HERE, "examples", "*.yaml"))}
     covered = {"resnet50.yaml", "gpt-125m.yaml", "longctx-ring.yaml",
                "llama-1b-singlechip.yaml", "tpudef.yaml",
-               "studyjob-sweep.yaml"}
+               "studyjob-sweep.yaml", "multislice-2slice.yaml"}
     assert have == covered, f"new example needs a parse test: {have - covered}"
 
 
@@ -59,3 +59,23 @@ def test_sweep_script_is_valid_bash():
     rc = subprocess.run(["bash", "-n", os.path.join(HERE, "tools",
                                                     "lm_sweep.sh")])
     assert rc.returncode == 0
+
+
+def test_multislice_example_validates_and_builds_mesh():
+    """The JAXJob half must pass CRD validation; the TrainConfig half's
+    dcn mesh must resolve on sliceCount x replicas x chips devices."""
+    from kubeflow_tpu.control.jaxjob import types as JT
+    from kubeflow_tpu.parallel.mesh import MeshSpec
+    from kubeflow_tpu.runtime.trainer import TrainConfig
+
+    with open(os.path.join(HERE, "examples", "multislice-2slice.yaml")) as f:
+        job, train = list(yaml.safe_load_all(f))
+    assert JT.validate(job) == []
+    assert JT.gang_size(job["spec"]) == 4
+    cfg = TrainConfig.from_dict(train)
+    chips = (job["spec"]["sliceCount"] * job["spec"]["replicas"]
+             * job["spec"]["tpu"]["chipsPerWorker"])
+    spec = cfg.mesh if isinstance(cfg.mesh, MeshSpec) else MeshSpec.from_dict(cfg.mesh)
+    resolved = spec.resolve(chips)
+    assert resolved.dcn == job["spec"]["sliceCount"]
+    assert resolved.data * resolved.dcn * resolved.model == chips

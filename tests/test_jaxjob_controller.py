@@ -460,6 +460,77 @@ class TestPreemptionClassification:
         assert job["status"]["preemptions"] == 2
 
 
+class TestMultislice:
+    """spec.sliceCount: one gang, one jax.distributed world, the dcn mesh
+    axis across slices (SURVEY §2.5 'DCN across slices')."""
+
+    def test_gang_spans_slices_with_env_and_labels(self, world):
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("ms", replicas=2, slice_count=2,
+                           accelerator="tpu-v5-lite-podslice",
+                           topology="2x4", chips_per_worker=4)
+        cluster.create(job)
+        drain(ctl)
+        pods = sorted(cluster.list("v1", "Pod", namespace="default"),
+                      key=lambda p: ob.meta(p)["name"])
+        assert len(pods) == 4  # replicas(2) x sliceCount(2), one gang
+        for g, pod in enumerate(pods):
+            env = {e["name"]: e["value"]
+                   for e in pod["spec"]["containers"][0]["env"]}
+            assert env[T.ENV_NPROC] == "4"       # world spans both slices
+            assert env[T.ENV_PID] == str(g)
+            assert env[T.ENV_NUM_SLICES] == "2"
+            assert env[T.ENV_SLICE_ID] == str(g // 2)  # contiguous ranks
+            assert env["MEGASCALE_NUM_SLICES"] == "2"
+            assert env["MEGASCALE_SLICE_ID"] == str(g // 2)
+            assert env["MEGASCALE_COORDINATOR_ADDRESS"].startswith(
+                "ms-worker-0.ms.default.svc:")
+            assert ob.labels_of(pod)[T.LABEL_SLICE_INDEX] == str(g // 2)
+
+    def test_slice_worker_failure_restarts_whole_multislice_gang(self, world):
+        cluster, ctl, kubelet = world
+        job = T.new_jaxjob("ms", replicas=2, slice_count=2,
+                           accelerator="tpu-v5-lite-podslice",
+                           topology="2x4", chips_per_worker=4)
+        job["spec"]["maxRestarts"] = 3
+        cluster.create(job)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        # kill one worker in slice 1 -> ALL FOUR pods restart (gang)
+        kubelet.fail("ms-worker-3", exit_code=1)
+        drain(ctl)
+        kubelet.step()
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "ms", "default")
+        assert job["status"].get("restarts") == 1
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert len(pods) == 4
+
+    def test_per_slice_topology_validation_unchanged(self, world):
+        """replicas is PER SLICE: 2 workers x 4 chips tile a 2x4 slice
+        regardless of sliceCount."""
+        errs = T.validate(T.new_jaxjob(
+            "ms", replicas=2, slice_count=4,
+            accelerator="tpu-v5-lite-podslice", topology="2x4",
+            chips_per_worker=4))
+        assert errs == []
+        errs = T.validate(T.new_jaxjob(
+            "ms", replicas=2, slice_count=2,
+            accelerator="tpu-v5-lite-podslice", topology="4x4",
+            chips_per_worker=4))
+        assert errs  # 2 workers x 4 chips != the 16-chip 4x4 slice
+
+    def test_bad_slice_count_rejected(self, world):
+        cluster, ctl, _ = world
+        job = T.new_jaxjob("ms", replicas=1)
+        job["spec"]["sliceCount"] = 0
+        cluster.create(job)
+        drain(ctl)
+        job = cluster.get(T.API_VERSION, T.KIND, "ms", "default")
+        assert ob.cond_is_true(job, T.COND_FAILED)
+
+
 class TestTopologyValidation:
     def test_inconsistent_geometry_fails_fast(self, world):
         cluster, ctl, _ = world
