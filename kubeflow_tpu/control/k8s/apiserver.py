@@ -93,6 +93,8 @@ class ApiServer:
                  host: str = "127.0.0.1", port: int = 0):
         self.cluster = cluster if cluster is not None else FakeCluster()
         self._shutting_down = False
+        self._drop_generation = 0  # bumped by drop_watches()
+        self.bookmark_interval = 1.0  # seconds of idle between BOOKMARKs
         server_ref = self
 
         class _Handler(BaseHTTPRequestHandler):
@@ -118,6 +120,8 @@ class ApiServer:
                     self._send_json(404, _status(404, str(e), "NotFound"))
                 elif isinstance(e, ob.Conflict):
                     self._send_json(409, _status(409, str(e), "Conflict"))
+                elif isinstance(e, ob.Expired):
+                    self._send_json(410, _status(410, str(e), "Expired"))
                 elif isinstance(e, (ValueError, LookupError, ob.Invalid)):
                     self._send_json(400, _status(400, str(e), "BadRequest"))
                 else:
@@ -161,7 +165,7 @@ class ApiServer:
     def _dispatch(self, h, verb: str, p: _Parsed, q: dict) -> None:
         c = self.cluster
         if verb == "GET" and p.name is None and q.get("watch", ["0"])[0] in ("1", "true"):
-            self._serve_watch(h, p)
+            self._serve_watch(h, p, q)
             return
         if verb == "GET" and p.name is None:
             label = (q.get("labelSelector") or [None])[0]
@@ -169,10 +173,18 @@ class ApiServer:
             fsel = (q.get("fieldSelector") or [None])[0]
             if fsel:
                 fields = dict(kv.split("=", 1) for kv in fsel.split(","))
-            items = c.list(p.api_version, p.kind, p.namespace,
-                           label_selector=label, field_selector=fields)
+            limit = (q.get("limit") or [None])[0]
+            cont = (q.get("continue") or [None])[0]
+            items, next_cont, rv = c.list_page(
+                p.api_version, p.kind, p.namespace,
+                label_selector=label, field_selector=fields,
+                limit=int(limit) if limit else None, continue_token=cont)
+            meta: dict = {"resourceVersion": rv}
+            if next_cont:
+                meta["continue"] = next_cont
             h._send_json(200, {"apiVersion": p.api_version,
-                               "kind": f"{p.kind}List", "items": items})
+                               "kind": f"{p.kind}List", "metadata": meta,
+                               "items": items})
             return
         if verb == "GET":
             h._send_json(200, c.get(p.api_version, p.kind, p.name, p.namespace))
@@ -203,10 +215,25 @@ class ApiServer:
             return
         h._send_json(405, _status(405, f"verb {verb} not supported"))
 
-    def _serve_watch(self, h, p: _Parsed) -> None:
+    def _serve_watch(self, h, p: _Parsed, q: dict | None = None) -> None:
         """Chunked stream of {"type", "object"} JSON lines — the
-        watch wire format RestClient._RestWatchStream consumes."""
-        stream = self.cluster.watch(p.api_version, p.kind, p.namespace)
+        watch wire format RestClient._RestWatchStream consumes.
+
+        Honors ``resourceVersion`` (resume: replay missed events, or 410
+        Gone past the retained window), and ``allowWatchBookmarks``
+        (periodic BOOKMARK events carrying the latest RV so a resumed
+        watch never rewinds further than its last heartbeat)."""
+        q = q or {}
+        since_rv = (q.get("resourceVersion") or [None])[0]
+        bookmarks = (q.get("allowWatchBookmarks") or ["false"])[0] in (
+            "1", "true")
+        try:
+            stream = self.cluster.watch(p.api_version, p.kind, p.namespace,
+                                        since_rv=since_rv)
+        except ob.Expired as e:
+            h._send_json(410, _status(410, str(e), "Expired"))
+            return
+        gen = self._drop_generation
         try:
             h.send_response(200)
             h.send_header("Content-Type", "application/json")
@@ -217,17 +244,41 @@ class ApiServer:
                 h.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                 h.wfile.flush()
 
+            idle = 0.0
             while not self._shutting_down:
+                if gen != self._drop_generation:
+                    break  # test hook: forcibly drop active watch streams
+                # snapshot BEFORE polling: an event that lands during the
+                # poll window is delivered by it; one landing after
+                # postdates this rv — either way the bookmark never
+                # advertises an rv covering an undelivered event
+                rv_snapshot = self.cluster.current_rv
                 ev = stream.poll(timeout=0.1)
                 if ev is None:
+                    idle += 0.1
+                    if bookmarks and idle >= self.bookmark_interval:
+                        idle = 0.0
+                        bm = {"type": "BOOKMARK",
+                              "object": {"apiVersion": p.api_version,
+                                         "kind": p.kind,
+                                         "metadata": {"resourceVersion":
+                                                      rv_snapshot}}}
+                        chunk(json.dumps(bm).encode() + b"\n")
                     continue
+                idle = 0.0
                 line = json.dumps({"type": ev.type, "object": ev.object})
                 chunk(line.encode() + b"\n")
-            chunk(b"")  # terminating chunk on clean shutdown
+            chunk(b"")  # terminating chunk on clean shutdown / drop
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away: normal watch teardown
         finally:
             stream.stop()
+
+    def drop_watches(self) -> None:
+        """Failure injection: terminate every active watch stream (the
+        mid-stream disconnect a real apiserver/LB produces on timeouts);
+        clients must resume from their last seen resourceVersion."""
+        self._drop_generation += 1
 
     # -- lifecycle ----------------------------------------------------------
 

@@ -14,6 +14,7 @@ milliseconds, and the same Client interface retargets a live cluster via
 
 from __future__ import annotations
 
+import collections
 import copy
 import queue
 import threading
@@ -55,7 +56,7 @@ class FakeCluster:
     written once against either backend.
     """
 
-    def __init__(self):
+    def __init__(self, history_limit: int = 1024):
         self._lock = threading.RLock()
         self._store: dict[Key, dict] = {}
         self._rv = 0
@@ -64,6 +65,17 @@ class FakeCluster:
         # Lets tests wire the PodDefault webhook in-process exactly where
         # the real admission chain sits (pod CREATE).
         self._admission: list[Callable[[str, dict], dict]] = []
+        # Bounded change history for watch resume-from-resourceVersion
+        # (etcd's watch cache). When a requested RV falls below the
+        # retained window the watch gets 410 Gone and the client relists —
+        # exactly the real apiserver contract controllers must survive.
+        self._history: collections.deque[tuple[int, WatchEvent]] = \
+            collections.deque(maxlen=history_limit)
+        self._truncated_below = 0  # RVs <= this may be missing from history
+        # Snapshots backing list continue tokens: a paginated list reads a
+        # consistent snapshot even under concurrent writes (etcd MVCC).
+        self._continues: collections.OrderedDict[str, list[dict]] = \
+            collections.OrderedDict()
 
     # -- internals ----------------------------------------------------------
 
@@ -76,6 +88,10 @@ class FakeCluster:
         return Key(obj["apiVersion"], obj["kind"], m.get("namespace") or "", m["name"])
 
     def _notify(self, etype: str, obj: dict) -> None:
+        ev = WatchEvent(etype, ob.deep_copy(obj))
+        if len(self._history) == self._history.maxlen and self._history:
+            self._truncated_below = self._history[0][0]
+        self._history.append((self._rv, ev))
         for w in self._watches:
             if w.closed:
                 continue
@@ -85,6 +101,12 @@ class FakeCluster:
             if w.namespace is not None and w.namespace != ns:
                 continue
             w.q.put(WatchEvent(etype, ob.deep_copy(obj)))
+
+    @property
+    def current_rv(self) -> str:
+        """The cluster's latest resourceVersion (ListMeta.resourceVersion)."""
+        with self._lock:
+            return str(self._rv)
 
     # -- admission ----------------------------------------------------------
 
@@ -143,6 +165,40 @@ class FakeCluster:
             out.sort(key=lambda o: (ob.meta(o).get("namespace") or "", ob.meta(o)["name"]))
             return out
 
+    def list_page(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict | str | None = None,
+        field_selector: dict[str, str] | None = None,
+        limit: int | None = None,
+        continue_token: str | None = None,
+    ) -> tuple[list[dict], str, str]:
+        """Paginated list: (items, continue, resourceVersion).
+
+        A continue token pins the ORIGINAL snapshot, so later pages are
+        consistent with page one even under concurrent writes (the etcd
+        MVCC property kube's limit/continue contract guarantees)."""
+        with self._lock:
+            if continue_token:
+                remaining = self._continues.pop(continue_token, None)
+                if remaining is None:
+                    raise ob.Expired(
+                        f"continue token {continue_token!r} expired")
+            else:
+                remaining = self.list(api_version, kind, namespace,
+                                      label_selector, field_selector)
+            rv = str(self._rv)
+            if limit is None or len(remaining) <= limit:
+                return remaining, "", rv
+            page, rest = remaining[:limit], remaining[limit:]
+            token = uuid.uuid4().hex
+            self._continues[token] = rest
+            while len(self._continues) > 64:  # bound snapshot memory
+                self._continues.popitem(last=False)
+            return page, token, rv
+
     def _update(self, obj: dict, subresource: str | None = None) -> dict:
         with self._lock:
             obj = ob.deep_copy(obj)
@@ -196,6 +252,15 @@ class FakeCluster:
         """dict → JSON merge patch; list → RFC6902 JSON patch."""
         with self._lock:
             cur = self.get(api_version, kind, name, namespace)
+            # a patch carrying metadata.resourceVersion is an optimistic-
+            # concurrency precondition: stale -> 409 (apiserver semantics)
+            claimed = None
+            if isinstance(patch, dict):
+                claimed = (patch.get("metadata") or {}).get("resourceVersion")
+            if claimed and claimed != ob.meta(cur)["resourceVersion"]:
+                raise ob.Conflict(
+                    f"{kind} {name}: patch resourceVersion {claimed} != "
+                    f"{ob.meta(cur)['resourceVersion']} (object was modified)")
             if isinstance(patch, list):
                 new = ob.json_patch(cur, patch)
             else:
@@ -230,6 +295,10 @@ class FakeCluster:
         found = self._store.pop(key, None)
         if found is None:
             return
+        # the DELETED event carries a fresh RV (apiserver semantics) — and
+        # watch resume replays strictly-greater RVs, so reusing the prior
+        # event's RV would silently drop deletions from resumed streams
+        ob.meta(found)["resourceVersion"] = self._next_rv()
         self._notify("DELETED", found)
         self._gc_orphans(found)
 
@@ -274,10 +343,31 @@ class FakeCluster:
     # -- watch --------------------------------------------------------------
 
     def watch(
-        self, api_version: str, kind: str, namespace: str | None = None
+        self, api_version: str, kind: str, namespace: str | None = None,
+        since_rv: str | None = None,
     ) -> "FakeWatchStream":
+        """Subscribe to changes. With ``since_rv``, events AFTER that
+        resourceVersion are replayed first (watch-cache resume); an RV
+        older than the retained history raises 410 Expired and the
+        client must relist."""
         with self._lock:
             w = _Watch(api_version, kind, namespace)
+            if since_rv:
+                rv = int(since_rv)
+                if rv < self._truncated_below:
+                    raise ob.Expired(
+                        f"resourceVersion {since_rv} is too old "
+                        f"(retained history starts at {self._truncated_below})")
+                for ev_rv, ev in self._history:
+                    if ev_rv <= rv:
+                        continue
+                    o = ev.object
+                    if (o["apiVersion"], o["kind"]) != (api_version, kind):
+                        continue
+                    ns = ob.meta(o).get("namespace") or ""
+                    if namespace is not None and namespace != ns:
+                        continue
+                    w.q.put(WatchEvent(ev.type, ob.deep_copy(o)))
             self._watches.append(w)
             return FakeWatchStream(self, w)
 

@@ -39,6 +39,14 @@ class Invalid(ApiError):
     code = 422
 
 
+class Expired(ApiError):
+    """410 Gone: a watch/list resourceVersion older than the server's
+    retained history — the client must relist (client-go's
+    ResourceExpired / informer relist path)."""
+
+    code = 410
+
+
 def now_iso() -> str:
     return (
         datetime.datetime.now(datetime.timezone.utc)

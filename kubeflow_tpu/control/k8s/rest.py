@@ -12,6 +12,7 @@ from __future__ import annotations
 import json
 import os
 import ssl  # noqa: F401  (documents the TLS dependency)
+import time
 from typing import Any
 
 from kubeflow_tpu.control.k8s import objects as ob
@@ -150,6 +151,11 @@ class RestClient:
         except ob.NotFound:
             return None
 
+    # client-go's default list chunk size; page N+1 is fetched with the
+    # server's continue token so large collections never need one
+    # monolithic response
+    list_chunk = 500
+
     def list(
         self,
         api_version: str,
@@ -164,12 +170,42 @@ class RestClient:
             params["labelSelector"] = sel
         if field_selector:
             params["fieldSelector"] = ",".join(f"{k}={v}" for k, v in field_selector.items())
-        out = self._req("GET", self._path(api_version, kind, namespace, None), params=params)
-        items = out.get("items", [])
+        items, _rv = self._list_chunked(api_version, kind, namespace, params)
+        return items
+
+    def _list_chunked(
+        self, api_version: str, kind: str, namespace: str | None,
+        params: dict[str, str],
+    ) -> tuple[list[dict], str]:
+        """Follow limit/continue pages; returns (items, list rv). A 410
+        on a continue token (snapshot expired mid-pagination) restarts
+        the list from scratch, as client-go does."""
+        params = dict(params)
+        if self.list_chunk:
+            params["limit"] = str(self.list_chunk)
+        path = self._path(api_version, kind, namespace, None)
+        items: list[dict] = []
+        rv = ""
+        while True:
+            try:
+                out = self._req("GET", path, params=params)
+            except ob.ApiError as e:
+                if getattr(e, "code", None) == 410 and "continue" in params:
+                    params.pop("continue")
+                    items = []
+                    continue
+                raise
+            items.extend(out.get("items", []))
+            meta = out.get("metadata") or {}
+            rv = meta.get("resourceVersion", rv)
+            cont = meta.get("continue", "")
+            if not cont:
+                break
+            params["continue"] = cont
         for it in items:  # apiserver omits these on list items
             it.setdefault("apiVersion", api_version)
             it.setdefault("kind", kind)
-        return items
+        return items, rv
 
     def update(self, obj: dict) -> dict:
         m = ob.meta(obj)
@@ -241,10 +277,46 @@ class RestClient:
 
 
 class _RestWatchStream:
+    """Reconnecting watch with the conformance behaviors controllers rely
+    on against a real apiserver (notebook_controller.go:519-613's informer
+    machinery provides the same): resume-from-resourceVersion after a
+    dropped connection, BOOKMARK heartbeats so the resume point advances
+    on idle streams, and 410 Gone -> relist. The relist re-yields every
+    live object as MODIFIED (a resync for level-triggered reconcilers)
+    and — informer-style — synthesizes DELETED for objects this stream
+    had seen that vanished during the gap (objects that existed before
+    the stream started are outside its view, as with any watch-from-now)."""
+
     def __init__(self, client: RestClient, api_version: str, kind: str, namespace: str | None):
         self._c = client
         self._args = (api_version, kind, namespace)
         self._closed = False
+        # (namespace, name) of objects this stream has yielded and not
+        # seen deleted — the store the 410 relist diffs against
+        self._known: set[tuple[str, str]] = set()
+
+    @staticmethod
+    def _key(obj: dict) -> tuple[str, str]:
+        m = ob.meta(obj)
+        return (m.get("namespace") or "", m.get("name") or "")
+
+    def _relist(self):
+        from kubeflow_tpu.control.k8s.fake import WatchEvent
+
+        api_version, kind, namespace = self._args
+        items, rv = self._c._list_chunked(api_version, kind, namespace, {})
+        live = set()
+        for it in items:
+            live.add(self._key(it))
+            yield WatchEvent("MODIFIED", it)
+        for gone_ns, gone_name in self._known - live:
+            yield WatchEvent("DELETED", {
+                "apiVersion": api_version, "kind": kind,
+                "metadata": {"name": gone_name,
+                             **({"namespace": gone_ns} if gone_ns else {})},
+            })
+        self._known = live
+        return rv
 
     def __iter__(self):
         from kubeflow_tpu.control.k8s.fake import WatchEvent
@@ -252,13 +324,36 @@ class _RestWatchStream:
         api_version, kind, namespace = self._args
         rv = ""
         while not self._closed:
-            params = {"watch": "1", "allowWatchBookmarks": "false"}
+            params = {"watch": "1", "allowWatchBookmarks": "true"}
             if rv:
                 params["resourceVersion"] = rv
             path = self._c._path(api_version, kind, namespace, None)
-            r = self._c._s.get(
-                self._c.base_url + path, params=params, stream=True, timeout=300
-            )
+            try:
+                r = self._c._s.get(
+                    self._c.base_url + path, params=params, stream=True,
+                    timeout=300)
+            except Exception:
+                if self._closed:
+                    return
+                time.sleep(0.2)
+                continue
+            if r.status_code == 410:
+                # our resume point predates the server's watch cache:
+                # relist and resume from the fresh list's RV. A failed
+                # relist must not kill the stream — retry (the next
+                # reconnect 410s again and lands back here).
+                r.close()
+                try:
+                    gen = self._relist()
+                    while True:
+                        try:
+                            yield next(gen)
+                        except StopIteration as fin:
+                            rv = fin.value or ""
+                            break
+                except ob.ApiError:
+                    time.sleep(0.2)
+                continue
             try:
                 for line in r.iter_lines():
                     if self._closed:
@@ -267,9 +362,16 @@ class _RestWatchStream:
                         continue
                     ev = json.loads(line)
                     obj = ev.get("object", {})
+                    etype = ev.get("type")
                     rv = ob.meta(obj).get("resourceVersion", rv)
-                    if ev.get("type") in ("ADDED", "MODIFIED", "DELETED"):
-                        yield WatchEvent(ev["type"], obj)
+                    if etype == "BOOKMARK":
+                        continue
+                    if etype in ("ADDED", "MODIFIED"):
+                        self._known.add(self._key(obj))
+                    elif etype == "DELETED":
+                        self._known.discard(self._key(obj))
+                    if etype in ("ADDED", "MODIFIED", "DELETED"):
+                        yield WatchEvent(etype, obj)
             except Exception:
                 if self._closed:
                     return

@@ -230,3 +230,231 @@ class TestLeaderElectionOverHttp:
         assert a.try_acquire() is False
         b.release()
         assert a.try_acquire() is True
+
+
+class TestWatchConformance:
+    """The corners real kube-apiservers exercise that VERDICT r2 flagged:
+    resume-after-disconnect, bookmarks, 410 Gone -> relist, paginated
+    lists under concurrent writes, stale-patch 409."""
+
+    def _consume(self, stream, events, stop_at):
+        done = threading.Event()
+
+        def run():
+            for ev in stream:
+                events.append(ev)
+                if len(events) >= stop_at:
+                    done.set()
+                    return
+
+        threading.Thread(target=run, daemon=True).start()
+        return done
+
+    def test_watch_resumes_after_dropped_connection(self, client, server):
+        """Events created while the client is between connections MUST be
+        delivered after reconnect (resume from last resourceVersion)."""
+        stream = client.watch("v1", "ConfigMap", "default")
+        events: list = []
+        done = self._consume(stream, events, stop_at=3)
+        time.sleep(0.3)
+        cm = ob.new_object("v1", "ConfigMap", "a", "default")
+        client.create(cm)
+        for _ in range(100):  # the first event pins the client's rv
+            if events:
+                break
+            time.sleep(0.05)
+        assert events, "watch never delivered the first event"
+        server.drop_watches()  # mid-stream disconnect
+        # these happen while the client has no connection
+        client.create(ob.new_object("v1", "ConfigMap", "b", "default"))
+        client.create(ob.new_object("v1", "ConfigMap", "c", "default"))
+        assert done.wait(10.0), f"saw only {[e.object['metadata']['name'] for e in events]}"
+        stream.stop()
+        names = [e.object["metadata"]["name"] for e in events[:3]]
+        assert names == ["a", "b", "c"]  # nothing lost, nothing duplicated
+
+    def test_bookmarks_advance_resume_point_past_other_kinds(self):
+        """An idle ConfigMap watch must not rewind behind churn on other
+        kinds: BOOKMARKs advance rv past the churn, so after a drop the
+        resume succeeds directly. The tiny history window makes the
+        no-bookmark fallback observable: without bookmarks the resume
+        would 410 -> relist and re-yield 'seen' as a duplicate MODIFIED —
+        the assertion below fails in that world."""
+        cluster = FakeCluster(history_limit=6)
+        srv = ApiServer(cluster).serve_background()
+        srv.bookmark_interval = 0.2
+        try:
+            c = client_for(srv)
+            stream = c.watch("v1", "ConfigMap", "default")
+            events: list = []
+            done = self._consume(stream, events, stop_at=2)
+            time.sleep(0.3)
+            c.create(ob.new_object("v1", "ConfigMap", "seen", "default"))
+            # churn another kind PAST the history window, then idle long
+            # enough for a bookmark carrying the post-churn rv
+            for i in range(8):
+                c.create(ob.new_object("v1", "Secret", f"s{i}", "default"))
+            time.sleep(0.8)
+            srv.drop_watches()
+            c.create(ob.new_object("v1", "ConfigMap", "after", "default"))
+            assert done.wait(10.0)
+            stream.stop()
+            assert [(e.type, e.object["metadata"]["name"])
+                    for e in events[:2]] == \
+                [("ADDED", "seen"), ("ADDED", "after")]
+        finally:
+            srv.shutdown()
+
+    def test_too_old_rv_gets_410_then_relist(self, server):
+        """History window exhausted: the watch must 410 and the client
+        must relist (each live object re-yielded) and keep going."""
+        cluster = FakeCluster(history_limit=4)
+        srv = ApiServer(cluster).serve_background()
+        try:
+            c = client_for(srv)
+            stream = c.watch("v1", "ConfigMap", "default")
+            events: list = []
+
+            def consume_forever():
+                for ev in stream:
+                    events.append(ev)
+
+            threading.Thread(target=consume_forever, daemon=True).start()
+            time.sleep(0.3)
+            c.create(ob.new_object("v1", "ConfigMap", "first", "default"))
+            for _ in range(100):
+                if events:
+                    break
+                time.sleep(0.05)
+            assert events, "watch never delivered the first event"
+            srv.drop_watches()
+            # blow past the 4-event history while disconnected
+            for i in range(8):
+                c.create(ob.new_object("v1", "Secret", f"x{i}", "default"))
+            c.create(ob.new_object("v1", "ConfigMap", "second", "default"))
+            # reconnect -> 410 -> relist: both live ConfigMaps re-yielded
+            seen = threading.Event()
+
+            def wait_for_second():
+                while not any(
+                        e.object["metadata"]["name"] == "second"
+                        for e in events):
+                    time.sleep(0.05)
+                seen.set()
+
+            threading.Thread(target=wait_for_second, daemon=True).start()
+            assert seen.wait(10.0), \
+                f"relist never surfaced: {[e.object['metadata']['name'] for e in events]}"
+            stream.stop()
+            names = {e.object["metadata"]["name"] for e in events}
+            assert {"first", "second"} <= names
+        finally:
+            srv.shutdown()
+
+    def test_relist_synthesizes_deleted_for_gap_deletions(self):
+        """An object the stream had seen that vanishes during a 410 gap
+        must surface as a DELETED event after the relist (informers diff
+        the relist against their store the same way)."""
+        cluster = FakeCluster(history_limit=4)
+        srv = ApiServer(cluster).serve_background()
+        try:
+            c = client_for(srv)
+            stream = c.watch("v1", "ConfigMap", "default")
+            events: list = []
+
+            def consume_forever():
+                for ev in stream:
+                    events.append(ev)
+
+            threading.Thread(target=consume_forever, daemon=True).start()
+            time.sleep(0.3)
+            c.create(ob.new_object("v1", "ConfigMap", "doomed", "default"))
+            c.create(ob.new_object("v1", "ConfigMap", "keeper", "default"))
+            for _ in range(100):
+                if len(events) >= 2:
+                    break
+                time.sleep(0.05)
+            assert len(events) >= 2
+            srv.drop_watches()
+            c.delete("v1", "ConfigMap", "doomed", "default")
+            for i in range(8):  # truncate history past the deletion
+                c.create(ob.new_object("v1", "Secret", f"z{i}", "default"))
+            deleted_seen = threading.Event()
+
+            def wait_deleted():
+                while not any(e.type == "DELETED" and
+                              e.object["metadata"]["name"] == "doomed"
+                              for e in events):
+                    time.sleep(0.05)
+                deleted_seen.set()
+
+            threading.Thread(target=wait_deleted, daemon=True).start()
+            assert deleted_seen.wait(10.0), \
+                f"no DELETED for doomed in {[(e.type, e.object['metadata']['name']) for e in events]}"
+            stream.stop()
+            # the survivor resyncs as MODIFIED, not DELETED
+            assert not any(e.type == "DELETED" and
+                           e.object["metadata"]["name"] == "keeper"
+                           for e in events)
+        finally:
+            srv.shutdown()
+
+
+class TestListPagination:
+    def test_client_follows_continue_tokens(self, client, server):
+        for i in range(7):
+            client.create(ob.new_object("v1", "ConfigMap", f"cm{i}", "default"))
+        client.list_chunk = 3  # force 3 pages
+        items = client.list("v1", "ConfigMap", "default")
+        assert [ob.meta(o)["name"] for o in items] == [f"cm{i}" for i in range(7)]
+        assert all(o.get("kind") == "ConfigMap" for o in items)
+
+    def test_pages_are_snapshot_consistent_under_writes(self, server):
+        """Objects created/deleted between page fetches must not corrupt
+        the pagination: later pages come from the original snapshot."""
+        cluster = server.cluster
+        for i in range(6):
+            cluster.create(ob.new_object("v1", "ConfigMap", f"p{i}", "default"))
+        page1, cont, rv = cluster.list_page("v1", "ConfigMap", "default",
+                                            limit=3)
+        assert [ob.meta(o)["name"] for o in page1] == ["p0", "p1", "p2"]
+        # concurrent writes between pages
+        cluster.create(ob.new_object("v1", "ConfigMap", "p2a", "default"))
+        cluster.delete("v1", "ConfigMap", "p4", "default")
+        page2, cont2, _ = cluster.list_page("v1", "ConfigMap", "default",
+                                            limit=3, continue_token=cont)
+        assert cont2 == ""
+        # the snapshot still shows p4 and not p2a — page1+page2 is exactly
+        # the collection as of the first request
+        assert [ob.meta(o)["name"] for o in page2] == ["p3", "p4", "p5"]
+
+    def test_expired_continue_token_is_410(self, server):
+        cluster = server.cluster
+        for i in range(4):
+            cluster.create(ob.new_object("v1", "ConfigMap", f"q{i}", "default"))
+        _, cont, _ = cluster.list_page("v1", "ConfigMap", "default", limit=2)
+        cluster.list_page("v1", "ConfigMap", "default", limit=2,
+                          continue_token=cont)  # consumes the token
+        with pytest.raises(ob.Expired):
+            cluster.list_page("v1", "ConfigMap", "default", limit=2,
+                              continue_token=cont)
+
+
+class TestStalePatch:
+    def test_patch_with_stale_rv_is_409_over_http(self, client, server):
+        cm = ob.new_object("v1", "ConfigMap", "sp", "default")
+        cm["data"] = {"v": "1"}
+        created = client.create(cm)
+        stale_rv = ob.meta(created)["resourceVersion"]
+        # someone else updates
+        cur = client.get("v1", "ConfigMap", "sp", "default")
+        cur["data"]["v"] = "2"
+        client.update(cur)
+        with pytest.raises(ob.Conflict):
+            client.patch("v1", "ConfigMap", "sp",
+                         {"metadata": {"resourceVersion": stale_rv},
+                          "data": {"v": "3"}}, "default")
+        # without the precondition the patch applies (merge semantics)
+        out = client.patch("v1", "ConfigMap", "sp", {"data": {"v": "3"}},
+                           "default")
+        assert out["data"]["v"] == "3"
