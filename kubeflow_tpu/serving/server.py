@@ -16,6 +16,7 @@ small set of compiled programs instead of recompiling per request size
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 from dataclasses import dataclass, field
@@ -80,6 +81,9 @@ class ServedModel:
     pad_batches: bool = True
     batch_window_ms: float = 0.0
     max_batch: int = 64
+    # minimum padded batch (power of two): mesh-sharded models need the
+    # batch divisible by the product of data-parallel axis sizes
+    pad_multiple: int = 1
     _batcher: "MicroBatcher | None" = field(default=None, repr=False)
 
     def _predict_now(self, instances: list) -> list:
@@ -87,7 +91,7 @@ class ServedModel:
         n = _batch_size(batch)
         device_batch_size().labels(self.name).observe(n)
         if self.pad_batches:
-            padded = _pad_batch(batch, _next_pow2(n))
+            padded = _pad_batch(batch, _next_pow2(max(n, self.pad_multiple)))
         else:
             padded = batch
         out = self.predict_fn(padded)
@@ -387,23 +391,101 @@ class ModelServer:
 # model builders
 
 
+class _ServingMesh:
+    """Mesh-sharded parameter holder for serving (SURVEY north-star: a
+    model too big for one chip's HBM — e.g. llama-1b f32 on v5e — is
+    served by sharding parameters over the mesh: tensor-parallel leaves
+    follow their nn.with_partitioning annotations, the rest fall to the
+    fsdp heuristic in parallel/shardings.py, and GSPMD inserts the
+    activation collectives into one compiled program per shape).
+
+    Variables materialize on the FIRST predict (shardings are inferred
+    from eval_shape of the real input), either restored from orbax and
+    device_put onto their shards, or initialized directly sharded via
+    jit out_shardings — the full replicated tree never exists on any
+    single device.
+    """
+
+    def __init__(self, mesh_spec, seed: int, checkpoint_dir: str | None):
+        from kubeflow_tpu.parallel.mesh import (
+            AXIS_DATA, AXIS_DCN, AXIS_FSDP, build_mesh)
+
+        self.mesh = build_mesh(mesh_spec)
+        self.seed = seed
+        self.checkpoint_dir = checkpoint_dir
+        if checkpoint_dir:
+            # variables materialize lazily, but a missing/empty checkpoint
+            # must fail AT REGISTRATION (crashloop + readiness gate), not
+            # as a 500 on the first request after traffic is routed here
+            from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+            ck = Checkpointer(checkpoint_dir, async_save=False)
+            try:
+                if ck.latest_step() is None:
+                    raise FileNotFoundError(
+                        f"no checkpoint found in {checkpoint_dir}")
+            finally:
+                ck.close()
+        self.variables = None
+        self._lock = threading.Lock()
+        dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
+              * self.mesh.shape[AXIS_FSDP])
+        if dp & (dp - 1):
+            raise ValueError(
+                f"serving mesh data axes product {dp} must be a power of "
+                "two (batches are padded to powers of two)")
+        self.pad_multiple = dp
+
+    def get_variables(self, model, example):
+        import jax
+
+        from kubeflow_tpu.parallel import shardings as S
+
+        with self._lock:
+            if self.variables is not None:
+                return self.variables
+            rng = jax.random.PRNGKey(self.seed)
+            abstract = jax.eval_shape(
+                lambda: model.init(rng, example, train=False))
+            shardings = S.infer_shardings(abstract, self.mesh)
+            if self.checkpoint_dir:
+                from kubeflow_tpu.runtime.checkpoint import restore_variables
+
+                host_vars, step = restore_variables(self.checkpoint_dir)
+                log.info("restored variables from %s step %d (sharded %s)",
+                         self.checkpoint_dir, step, dict(self.mesh.shape))
+                self.variables = jax.device_put(S.unbox(host_vars), shardings)
+            else:
+                with self.mesh:
+                    self.variables = jax.jit(
+                        lambda r: S.unbox(model.init(r, example, train=False)),
+                        out_shardings=shardings)(rng)
+            return self.variables
+
+
 def serve_flax_classifier(name: str, model_name: str, input_key: str | None = None,
                           seed: int = 0, checkpoint_dir: str | None = None,
+                          mesh: "Any | None" = None,
                           **model_kwargs) -> ServedModel:
     """Wrap a zoo model into a ServedModel with a jitted softmax head.
     With `checkpoint_dir`, weights come from the latest orbax training
     checkpoint (runtime.checkpoint.restore_variables) — the analogue of
     TF-Serving pointing at an exported SavedModel; otherwise they are
     randomly initialized and the serving contract is shape/latency-
-    exercised, matching the reference's mnist golden-compare approach."""
+    exercised, matching the reference's mnist golden-compare approach.
+
+    With `mesh` (a MeshSpec/dict), parameters are sharded over the device
+    mesh (tensor parallelism + fsdp heuristic) and every predict runs as
+    one GSPMD program across it."""
     import jax
     import jax.numpy as jnp
 
     from kubeflow_tpu.models.registry import get_model
 
     model = get_model(model_name, **model_kwargs)
+    sm = _ServingMesh(mesh, seed, checkpoint_dir) if mesh is not None else None
     params = None
-    if checkpoint_dir:
+    if sm is None and checkpoint_dir:
         from kubeflow_tpu.runtime.checkpoint import restore_variables
 
         params, step = restore_variables(checkpoint_dir)
@@ -421,12 +503,18 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
         nonlocal params
         x = batch[input_key] if input_key and isinstance(batch, dict) else batch
         x = jnp.asarray(x, jnp.float32)
-        if params is None:
-            state["rng"] = jax.random.PRNGKey(seed)
-            params = model.init(state["rng"], x, train=False)
-        return np.asarray(fwd(params, x))
+        if sm is not None:
+            use_params = sm.get_variables(model, x)
+        else:
+            if params is None:
+                state["rng"] = jax.random.PRNGKey(seed)
+                params = model.init(state["rng"], x, train=False)
+            use_params = params
+        with (sm.mesh if sm is not None else contextlib.nullcontext()):
+            return np.asarray(fwd(use_params, x))
 
     return ServedModel(name=name, predict_fn=predict,
+                       pad_multiple=sm.pad_multiple if sm else 1,
                        signature={"inputs": input_key or "array",
                                   "method_name": "predict"})
 
@@ -436,6 +524,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        top_k: int = 0, seed: int = 0,
                        checkpoint_dir: str | None = None,
                        batch_window_ms: float = 0.0, max_batch: int = 64,
+                       mesh: "Any | None" = None,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -455,8 +544,9 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
                       **model_kwargs)
+    sm = _ServingMesh(mesh, seed, checkpoint_dir) if mesh is not None else None
     variables = None
-    if checkpoint_dir:
+    if sm is None and checkpoint_dir:
         from kubeflow_tpu.runtime.checkpoint import restore_variables
 
         variables, step = restore_variables(checkpoint_dir)
@@ -490,22 +580,30 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             pad_lens.append(prompt_len - len(row))
             rows.append([0] * (prompt_len - len(row)) + row)
         prompt = jnp.asarray(rows, jnp.int32)
-        if variables is None:
-            variables = model.init(jax.random.PRNGKey(seed),
-                                   prompt[:, :1], train=False)
-        out = np.asarray(generate(
-            model, variables, prompt, max_new_tokens=max_new_tokens,
-            temperature=temperature, top_k=top_k,
-            seed=request_seed() if temperature > 0 else seed,
-            pad_len=jnp.asarray(pad_lens, jnp.int32)))
+        if sm is not None:
+            use_vars = sm.get_variables(model, prompt[:, :1])
+        else:
+            if variables is None:
+                variables = model.init(jax.random.PRNGKey(seed),
+                                       prompt[:, :1], train=False)
+            use_vars = variables
+        with (sm.mesh if sm is not None else contextlib.nullcontext()):
+            out = np.asarray(generate(
+                model, use_vars, prompt, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k,
+                seed=request_seed() if temperature > 0 else seed,
+                pad_len=jnp.asarray(pad_lens, jnp.int32)))
         return out[:, prompt_len:]  # new tokens only
 
     return ServedModel(
         name=name, predict_fn=predict, pad_batches=True,
         batch_window_ms=batch_window_ms, max_batch=max_batch,
+        pad_multiple=sm.pad_multiple if sm else 1,
         signature={"inputs": "tokens", "method_name": "generate",
                    "prompt_len": prompt_len,
-                   "max_new_tokens": max_new_tokens})
+                   "max_new_tokens": max_new_tokens,
+                   **({"mesh": {k: v for k, v in sm.mesh.shape.items()
+                                if v > 1}} if sm else {})})
 
 
 def main() -> None:  # pragma: no cover - container entry
@@ -523,7 +621,18 @@ def main() -> None:  # pragma: no cover - container entry
                         "e.g. chat=gpt-125m")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--mesh", default=None,
+                   help="shard served params over a mesh, e.g. "
+                        "'model=4,fsdp=2' — required for models whose "
+                        "state exceeds one chip's HBM")
     args = p.parse_args()
+    mesh_spec = None
+    if args.mesh:
+        try:
+            mesh_spec = {k: int(v) for k, v in
+                         (kv.split("=", 1) for kv in args.mesh.split(","))}
+        except ValueError:
+            p.error(f"--mesh must be axis=int[,axis=int...], got {args.mesh!r}")
     # default classifier only when nothing at all was requested
     models = args.model or ([] if args.lm else ["mnist=resnet18"])
     if args.checkpoint_dir and len(models) > 1:
@@ -534,14 +643,14 @@ def main() -> None:  # pragma: no cover - container entry
         name, _, zoo = spec.partition("=")
         zoo, _, ckpt = zoo.partition("@")
         server.register(serve_flax_classifier(name, zoo or "resnet18",
-                                              num_classes=10,
+                                              num_classes=10, mesh=mesh_spec,
                                               checkpoint_dir=ckpt or args.checkpoint_dir))
     for spec in args.lm:
         name, _, zoo = spec.partition("=")
         zoo, _, ckpt = zoo.partition("@")
         server.register(serve_lm_generator(
             name, zoo or "gpt-125m", prompt_len=args.prompt_len,
-            max_new_tokens=args.max_new_tokens,
+            max_new_tokens=args.max_new_tokens, mesh=mesh_spec,
             checkpoint_dir=ckpt or None))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)

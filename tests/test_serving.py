@@ -418,3 +418,121 @@ def test_list_models_inventory(server):
     assert 1 in m["versions"] and m["versions"] == sorted(m["versions"])
     assert m["method"] == "predict"
     assert m["micro_batching"] is False
+
+
+class TestMeshShardedServing:
+    """VERDICT #6: a model whose params are sharded over the device mesh
+    (2 fsdp x 4 model on the virtual 8-device CPU mesh) answers the same
+    REST contract — predict AND generate — with GSPMD inserting the
+    collectives. This is the only way a model too big for one chip's HBM
+    (llama-1b f32 on v5e) is servable at all."""
+
+    MESH = {"fsdp": 2, "model": 4}
+
+    @pytest.fixture(scope="class")
+    def sharded_lm(self):
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        srv = ModelServer()
+        srv.register(serve_lm_generator(
+            "big-lm", "transformer-test", prompt_len=8, max_new_tokens=4,
+            vocab_size=64, mesh=self.MESH))
+        svc = srv.serve(host="127.0.0.1", port=0)
+        svc.serve_background()
+        yield f"http://127.0.0.1:{svc.port}"
+        svc.shutdown()
+        srv.close()
+
+    def test_params_actually_sharded(self):
+        from kubeflow_tpu.models.registry import get_model
+        from kubeflow_tpu.serving.server import _ServingMesh
+
+        import jax.numpy as jnp
+
+        sm = _ServingMesh(self.MESH, seed=0, checkpoint_dir=None)
+        model = get_model("transformer-test", vocab_size=64, max_seq_len=12)
+        variables = sm.get_variables(model, jnp.ones((1, 1), jnp.int32))
+        import jax
+
+        leaves = jax.tree.leaves(variables)
+        sharded = [l for l in leaves
+                   if hasattr(l, "sharding")
+                   and any(s is not None for s in l.sharding.spec)]
+        assert sharded, "no parameter leaf is sharded over the mesh"
+        # at least one leaf rides the tensor-parallel axis
+        assert any("model" in str(l.sharding.spec) for l in sharded)
+
+    def test_generate_over_sharded_mesh_http(self, sharded_lm):
+        r = requests.post(
+            f"{sharded_lm}/v1/models/big-lm:predict",
+            json={"instances": [{"tokens": [1, 2, 3]},
+                                {"tokens": [4, 5, 6, 7]}]},
+            timeout=300)
+        assert r.status_code == 200, r.text
+        preds = r.json()["predictions"]
+        assert len(preds) == 2
+        for p in preds:
+            assert len(p) == 4 and all(0 <= t < 64 for t in p)
+        meta = requests.get(
+            f"{sharded_lm}/v1/models/big-lm/metadata", timeout=30).json()
+        assert meta["metadata"]["signature_def"]["mesh"] == self.MESH
+
+    def test_sharded_matches_unsharded_greedy(self, sharded_lm):
+        """Same seed, same prompt: the 8-way-sharded model must decode
+        the same greedy tokens as the single-device one — sharding is a
+        placement decision, not a numerics change (bf16 aside: this
+        model runs f32 on CPU)."""
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        plain = serve_lm_generator(
+            "ref-lm", "transformer-test", prompt_len=8, max_new_tokens=4,
+            vocab_size=64)
+        body = [{"tokens": [3, 1, 4, 1, 5]}]
+        want = plain.predict(body)
+        r = requests.post(f"{sharded_lm}/v1/models/big-lm:predict",
+                          json={"instances": body}, timeout=300)
+        got = r.json()["predictions"]
+        assert got == [list(map(int, w)) for w in want]
+
+    def test_sharded_classifier_predict(self):
+        from kubeflow_tpu.serving.server import serve_flax_classifier
+
+        import numpy as np
+
+        m = serve_flax_classifier(
+            "cls", "resnet18", mesh=self.MESH, num_classes=10)
+        # resnet has no TP annotations: the fsdp heuristic shards its
+        # large kernels; the 32x32 input keeps the CPU compile cheap
+        out = m.predict([np.zeros((32, 32, 3), np.float32)])
+        assert len(out) == 1 and len(out[0]) == 10
+
+    def test_sharded_restore_from_training_checkpoint(self, tmp_path):
+        """Train 1 step (single-device trainer), then serve the orbax
+        checkpoint SHARDED: restore -> device_put onto shards."""
+        from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        cfg = TrainConfig.from_dict(dict(
+            model="transformer-test", task="lm", global_batch=8,
+            seq_len=12, vocab_size=64,
+            model_kwargs={"vocab_size": 64},  # model head = data vocab
+            total_steps=1, warmup_steps=1,
+            checkpoint_dir=str(tmp_path), checkpoint_every=1))
+        Trainer(cfg).fit(steps=1)
+        m = serve_lm_generator(
+            "ckpt-lm", "transformer-test", prompt_len=8, max_new_tokens=2,
+            vocab_size=64, mesh=self.MESH, checkpoint_dir=str(tmp_path))
+        out = m.predict([{"tokens": [1, 2, 3]}])
+        assert len(out) == 1 and len(out[0]) == 2
+
+
+def test_mesh_with_missing_checkpoint_fails_at_registration(tmp_path):
+    """A bad --checkpoint-dir must crash at register time (readiness
+    gates catch it), not 500 on the first routed request."""
+    from kubeflow_tpu.serving.server import serve_lm_generator
+
+    with pytest.raises(FileNotFoundError):
+        serve_lm_generator(
+            "bad", "transformer-test", prompt_len=8, max_new_tokens=2,
+            vocab_size=64, mesh={"model": 4, "fsdp": 2},
+            checkpoint_dir=str(tmp_path / "empty"))
