@@ -155,7 +155,18 @@ class ApiServer:
             def do_DELETE(self):
                 self._handle("DELETE")
 
-        self._server = ThreadingHTTPServer((host, port), _Handler)
+        class _Server(ThreadingHTTPServer):
+            def handle_error(self, request, client_address):
+                # watch clients disconnect routinely (reconnect loops,
+                # process exit); a reset mid-request-read is not an error
+                import sys as _sys
+
+                etype = _sys.exc_info()[0]
+                if etype in (BrokenPipeError, ConnectionResetError):
+                    return
+                super().handle_error(request, client_address)
+
+        self._server = _Server((host, port), _Handler)
         self.port = self._server.server_address[1]
         self.url = f"http://{host}:{self.port}"
         self._thread: threading.Thread | None = None

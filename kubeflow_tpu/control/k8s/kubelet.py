@@ -97,11 +97,17 @@ class LocalPodExecutor:
         label_selector: dict | None = None,
         env_hook=None,  # fn(pod, env: dict) -> dict
         cwd: str | None = None,
+        node_name: str | None = None,
     ):
         self.cluster = cluster
         self.label_selector = label_selector
         self.env_hook = env_hook
         self.cwd = cwd
+        # scheduler-binding simulation: launched pods get spec.nodeName
+        # so slice-health (node NotReady/taint) paths see real bindings.
+        # Mutable: tests re-point it to model rescheduling onto a healthy
+        # node after a drain.
+        self.node_name = node_name
         # key -> (pod uid, process). The uid is the pod's identity: a
         # gang restart recreates a pod under the same name, and the old
         # incarnation's process must be reaped before the new one runs
@@ -174,6 +180,12 @@ class LocalPodExecutor:
                     c = pod["spec"]["containers"][0]
                     cmd = list(c.get("command") or []) + list(c.get("args") or [])
                     log.info("exec pod %s: %s", m["name"], " ".join(cmd))
+                    if self.node_name and not pod["spec"].get("nodeName"):
+                        fresh = self.cluster.get_or_none("v1", "Pod",
+                                                         m["name"], key[0])
+                        if fresh is not None:
+                            fresh["spec"]["nodeName"] = self.node_name
+                            pod = self.cluster.update(fresh)
                     proc = subprocess.Popen(
                         cmd,
                         env=self._pod_env(pod),

@@ -1,4 +1,15 @@
 from kubeflow_tpu.control.mains import run_controller
-from kubeflow_tpu.control.notebook.controller import build_controller
+from kubeflow_tpu.control.notebook.controller import (
+    RunningNotebooksCollector,
+    build_controller,
+)
 
-run_controller("notebook-controller", lambda client, args: build_controller(client))
+
+def _build(client, args):
+    # live-state notebook_running gauge: scraped at /metrics collection
+    # time from the current STS inventory (metrics.go:95-116)
+    RunningNotebooksCollector(client).register()
+    return build_controller(client)
+
+
+run_controller("notebook-controller", _build)

@@ -41,6 +41,57 @@ def nb_culled():
     return _metric("notebook_culling_total", prom.Counter, "notebooks culled")
 
 
+def nb_culling_timestamp():
+    return _metric("last_notebook_culling_timestamp_seconds", prom.Gauge,
+                   "Timestamp of the last notebook culling in seconds")
+
+
+class RunningNotebooksCollector:
+    """Live-state `notebook_running` gauge: scraped from the CURRENT
+    StatefulSet inventory at every /metrics collection, not from
+    controller event counters — restart-proof and drift-proof, exactly
+    metrics.go:95-116's scrape(). An STS counts when its pod template
+    carries notebook-name == its own name (the shape generate_statefulset
+    produces)."""
+
+    def __init__(self, client):
+        self.client = client
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        g = GaugeMetricFamily(
+            "notebook_running", "Current running notebooks in the cluster",
+            labels=["namespace"])
+        try:
+            # server-side filter: only notebook-owned STS (the controller
+            # labels the STS object itself); template labels re-checked
+            # below for metrics.go parity
+            stss = self.client.list(
+                "apps/v1", "StatefulSet",
+                label_selector={"matchExpressions": [
+                    {"key": T.LABEL_NOTEBOOK_NAME, "operator": "Exists"}]})
+        except Exception as e:  # apiserver unreachable: emit nothing, not 0s
+            log.warning("notebook_running scrape failed: %s", e)
+            return [g]
+        counts: dict[str, int] = {}
+        for sts in stss:
+            tmpl_labels = (((sts.get("spec") or {}).get("template") or {})
+                           .get("metadata") or {}).get("labels") or {}
+            if tmpl_labels.get(T.LABEL_NOTEBOOK_NAME) == ob.meta(sts)["name"]:
+                ns = ob.meta(sts).get("namespace") or "default"
+                counts[ns] = counts.get(ns, 0) + 1
+        for ns, v in sorted(counts.items()):
+            g.add_metric([ns], v)
+        return [g]
+
+    def register(self, registry=None) -> "RunningNotebooksCollector":
+        import prometheus_client
+
+        (registry or prometheus_client.REGISTRY).register(self)
+        return self
+
+
 def use_istio() -> bool:
     return os.environ.get("USE_ISTIO", "false").lower() == "true"
 
@@ -180,6 +231,7 @@ class NotebookReconciler(Reconciler):
                 culler.set_stop_annotation(fresh)
                 client.update(fresh)
                 nb_culled().inc()
+                nb_culling_timestamp().set_to_current_time()
                 client.record_event(fresh, "Culling", "notebook idle; scaling to zero")
                 return Result(requeue_after=0.0)
             return Result(requeue_after=culler.requeue_seconds())

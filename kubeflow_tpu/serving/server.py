@@ -413,19 +413,20 @@ class _ServingMesh:
         self.mesh = build_mesh(mesh_spec)
         self.seed = seed
         self.checkpoint_dir = checkpoint_dir
+        self._host_vars = None
         if checkpoint_dir:
-            # variables materialize lazily, but a missing/empty checkpoint
-            # must fail AT REGISTRATION (crashloop + readiness gate), not
-            # as a 500 on the first request after traffic is routed here
-            from kubeflow_tpu.runtime.checkpoint import Checkpointer
+            # a missing/corrupt/unreadable checkpoint must fail AT
+            # REGISTRATION (crashloop + readiness gate), not as a 500 on
+            # the first request after traffic is routed here: restore the
+            # host tree eagerly; device placement onto shards stays lazy.
+            # (Builders that know their input shape — the LM generator —
+            # additionally materialize eagerly, catching shape mismatch
+            # at registration too.)
+            from kubeflow_tpu.runtime.checkpoint import restore_variables
 
-            ck = Checkpointer(checkpoint_dir, async_save=False)
-            try:
-                if ck.latest_step() is None:
-                    raise FileNotFoundError(
-                        f"no checkpoint found in {checkpoint_dir}")
-            finally:
-                ck.close()
+            self._host_vars, step = restore_variables(checkpoint_dir)
+            log.info("restored variables from %s step %d (sharding over %s)",
+                     checkpoint_dir, step, dict(self.mesh.shape))
         self.variables = None
         self._lock = threading.Lock()
         dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
@@ -448,13 +449,10 @@ class _ServingMesh:
             abstract = jax.eval_shape(
                 lambda: model.init(rng, example, train=False))
             shardings = S.infer_shardings(abstract, self.mesh)
-            if self.checkpoint_dir:
-                from kubeflow_tpu.runtime.checkpoint import restore_variables
-
-                host_vars, step = restore_variables(self.checkpoint_dir)
-                log.info("restored variables from %s step %d (sharded %s)",
-                         self.checkpoint_dir, step, dict(self.mesh.shape))
-                self.variables = jax.device_put(S.unbox(host_vars), shardings)
+            if self._host_vars is not None:
+                self.variables = jax.device_put(
+                    S.unbox(self._host_vars), shardings)
+                self._host_vars = None  # free the host copy
             else:
                 with self.mesh:
                     self.variables = jax.jit(
@@ -545,6 +543,11 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
     model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
                       **model_kwargs)
     sm = _ServingMesh(mesh, seed, checkpoint_dir) if mesh is not None else None
+    if sm is not None and checkpoint_dir:
+        # input shape is known here: materialize now so a shape-mismatched
+        # checkpoint (wrong model/vocab) crashes registration, not the
+        # first routed request
+        sm.get_variables(model, jnp.zeros((1, 1), jnp.int32))
     variables = None
     if sm is None and checkpoint_dir:
         from kubeflow_tpu.runtime.checkpoint import restore_variables
