@@ -413,20 +413,24 @@ class _ServingMesh:
         self.mesh = build_mesh(mesh_spec)
         self.seed = seed
         self.checkpoint_dir = checkpoint_dir
-        self._host_vars = None
         if checkpoint_dir:
-            # a missing/corrupt/unreadable checkpoint must fail AT
-            # REGISTRATION (crashloop + readiness gate), not as a 500 on
-            # the first request after traffic is routed here: restore the
-            # host tree eagerly; device placement onto shards stays lazy.
-            # (Builders that know their input shape — the LM generator —
-            # additionally materialize eagerly, catching shape mismatch
-            # at registration too.)
-            from kubeflow_tpu.runtime.checkpoint import restore_variables
+            # a missing/empty checkpoint must fail AT REGISTRATION
+            # (crashloop + readiness gate), not as a 500 on the first
+            # routed request. A cheap latest_step probe only — NOT a full
+            # restore: pinning every registered model's unsharded host
+            # tree until its first request would multiply host RSS.
+            # Builders that know their input shape (the LM generator)
+            # materialize eagerly right after construction, catching
+            # corrupt/shape-mismatched checkpoints at registration too.
+            from kubeflow_tpu.runtime.checkpoint import Checkpointer
 
-            self._host_vars, step = restore_variables(checkpoint_dir)
-            log.info("restored variables from %s step %d (sharding over %s)",
-                     checkpoint_dir, step, dict(self.mesh.shape))
+            ck = Checkpointer(checkpoint_dir, async_save=False)
+            try:
+                if ck.latest_step() is None:
+                    raise FileNotFoundError(
+                        f"no checkpoint found in {checkpoint_dir}")
+            finally:
+                ck.close()
         self.variables = None
         self._lock = threading.Lock()
         dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
@@ -449,10 +453,13 @@ class _ServingMesh:
             abstract = jax.eval_shape(
                 lambda: model.init(rng, example, train=False))
             shardings = S.infer_shardings(abstract, self.mesh)
-            if self._host_vars is not None:
-                self.variables = jax.device_put(
-                    S.unbox(self._host_vars), shardings)
-                self._host_vars = None  # free the host copy
+            if self.checkpoint_dir:
+                from kubeflow_tpu.runtime.checkpoint import restore_variables
+
+                host_vars, step = restore_variables(self.checkpoint_dir)
+                log.info("restored variables from %s step %d (sharded %s)",
+                         self.checkpoint_dir, step, dict(self.mesh.shape))
+                self.variables = jax.device_put(S.unbox(host_vars), shardings)
             else:
                 with self.mesh:
                     self.variables = jax.jit(

@@ -1,0 +1,35 @@
+"""Kubernetes object-name validation shared by every CR-creating endpoint.
+
+Browser-side checks (dashboard NS_RGX, spawner form) are advisory; a real
+apiserver rejects non-RFC1123 metadata.name with an opaque 422, so the
+backends validate up front and answer a clean 400. One validator, used by
+JWA, the dashboard workgroup API, and the tensorboards CRUD app.
+"""
+
+from __future__ import annotations
+
+import re
+
+_DNS1123 = re.compile(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?")
+
+
+def is_dns1123(name: object) -> bool:
+    return (isinstance(name, str) and 0 < len(name) <= 63
+            and _DNS1123.fullmatch(name) is not None)
+
+
+def require_dns1123(name: object, what: str = "name") -> str:
+    from kubeflow_tpu.utils.httpd import ApiHttpError
+
+    if not is_dns1123(name):
+        raise ApiHttpError(
+            400, f"invalid {what} {name!r}: must be lowercase RFC-1123 "
+                 "(letters, digits, '-'; max 63 chars)")
+    return name  # type: ignore[return-value]
+
+
+def sanitize_dns1123(raw: str, fallback: str = "user") -> str:
+    """Best-effort conversion of free-form text (e.g. an email localpart)
+    into a valid name — for server-derived defaults, never user input."""
+    s = re.sub(r"[^a-z0-9-]", "-", raw.lower()).strip("-")[:63].strip("-")
+    return s if is_dns1123(s) else fallback

@@ -139,9 +139,16 @@ class Dashboard:
                 "hasWorkgroup": bool(self._owned_profiles(user))}
 
     def create(self, req: HttpReq):
+        from kubeflow_tpu.utils.names import require_dns1123, sanitize_dns1123
+
         user = self._user(req)
         body = req.json() or {}
-        name = body.get("namespace") or user.split("@")[0].replace(".", "-")
+        name = body.get("namespace")
+        if name:
+            # client-side NS_RGX is advisory; a real apiserver would 422
+            require_dns1123(name, "namespace")
+        else:
+            name = sanitize_dns1123(user.split("@")[0])
         prof = PT.new_profile(name, user)
         try:
             self.client.create(prof)

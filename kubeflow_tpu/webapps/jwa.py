@@ -66,6 +66,12 @@ def process_tpu(container: dict, pod_spec: dict, form: dict) -> None:
             sel["cloud.google.com/gke-tpu-topology"] = tpu["topology"]
 
 
+# server-side name validation (found by the jsdom UI harness: the spawner
+# accepted 'Invalid Name!'): the browser form is advisory; a real
+# apiserver rejects non-RFC1123 metadata.name opaquely, so 400 up front
+from kubeflow_tpu.utils.names import require_dns1123 as _require_dns1123
+
+
 def notebook_from_form(namespace: str, form: dict,
                        config: dict | None = None) -> dict:
     """The yaml template + form fill (notebook.yaml:1-25 + app.py:13)."""
@@ -212,7 +218,9 @@ class JupyterWebApp:
 
     def post_notebook(self, req: HttpReq):
         ns = req.params["ns"]
-        nb = notebook_from_form(ns, req.json() or {}, self.config)
+        form = req.json() or {}
+        _require_dns1123(form.get("name", ""))
+        nb = notebook_from_form(ns, form, self.config)
         try:
             self.client.create(nb)
         except ob.Conflict:
@@ -224,6 +232,7 @@ class JupyterWebApp:
     def post_pvc(self, req: HttpReq):
         ns = req.params["ns"]
         form = req.json() or {}
+        _require_dns1123(form.get("name", "workspace"))
         pvc = ob.new_object(
             "v1", "PersistentVolumeClaim", form.get("name", "workspace"), ns,
             spec={
