@@ -417,21 +417,24 @@ class JAXJobReconciler(Reconciler):
 
 
 def _node_mapper(client):
-    """A Node event re-enqueues every non-terminal JAXJob: the reconcile
-    pass checks whether the node backing one of its gang pods went
-    unhealthy (slice-health detection). Coarse fan-out, but node events
-    are rare and reconciles are cheap."""
+    """A Node event re-enqueues exactly the JAXJobs with gang pods ON
+    that node (slice-health detection): one server-side-filtered pod
+    list (fieldSelector spec.nodeName — the same index kube-scheduler
+    and kubelet queries use) instead of fanning out to every job in the
+    cluster. O(pods-on-node), the right shape for a real cluster."""
     from kubeflow_tpu.control.runtime import Request
 
-    def fn(_node: dict) -> list[Request]:
-        reqs = []
-        for j in client.list(T.API_VERSION, T.KIND):
-            if ob.cond_is_true(j, T.COND_SUCCEEDED) or \
-                    ob.cond_is_true(j, T.COND_FAILED):
-                continue
-            m = ob.meta(j)
-            reqs.append(Request(m.get("namespace") or "default", m["name"]))
-        return reqs
+    def fn(node: dict) -> list[Request]:
+        name = ob.meta(node).get("name")
+        if not name:
+            return []
+        reqs = set()
+        for p in client.list("v1", "Pod",
+                             field_selector={"spec.nodeName": name}):
+            job = ob.labels_of(p).get(T.LABEL_JOB_NAME)
+            if job:
+                reqs.add((ob.meta(p).get("namespace") or "default", job))
+        return [Request(ns, job) for ns, job in sorted(reqs)]
 
     return fn
 

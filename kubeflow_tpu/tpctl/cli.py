@@ -105,6 +105,11 @@ def main(argv: list[str] | None = None) -> int:
     sps.add_argument("--mode", default="router", choices=("router", "worker"))
     sps.add_argument("--dry-run", action="store_true")
     sps.add_argument("--server", default="")
+    sps.add_argument("--isolation", default="thread",
+                     choices=("thread", "subprocess"),
+                     help="subprocess: each deployment's applies run in a "
+                          "child tpctl process (router.go:275 StatefulSet-"
+                          "per-deployment isolation); requires --server")
     sps.add_argument("--cloud-auth-gate", action="store_true",
                      help="require a bearer token with setIamPolicy on the "
                           "target project for cloud-platform deployments "
@@ -130,7 +135,17 @@ def main(argv: list[str] | None = None) -> int:
             from kubeflow_tpu.tpctl.cloudauth import HttpCrmBackend
 
             crm = HttpCrmBackend(endpoint=args.crm_endpoint)
-        srv = TpctlServer(_client(args), crm_backend=crm)
+        if args.isolation == "subprocess" and not args.server:
+            p.error("--isolation subprocess requires --server (the child "
+                    "tpctl processes dial the apiserver directly)")
+        if args.isolation == "subprocess" and args.dry_run:
+            # child applies would mutate the REAL apiserver while the
+            # server's own status reads hit the in-memory fake
+            p.error("--isolation subprocess and --dry-run are mutually "
+                    "exclusive")
+        srv = TpctlServer(_client(args), crm_backend=crm,
+                          isolation=args.isolation,
+                          apiserver_url=args.server)
         svc = srv.serve(port=args.port)
         print(f"tpctl server listening on :{svc.port}")
         try:

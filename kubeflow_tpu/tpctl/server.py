@@ -35,7 +35,7 @@ DEFAULT_TTL_S = 3600.0
 class _Worker:
     """Per-deployment worker: owns a queue (cap 10, kfctlServer.go:87)."""
 
-    def __init__(self, name: str, coordinator: Coordinator):
+    def __init__(self, name: str, coordinator: Coordinator | None):
         self.name = name
         self.coordinator = coordinator
         self.q: "queue.Queue[TpuDef]" = queue.Queue(maxsize=10)
@@ -46,13 +46,16 @@ class _Worker:
                                        name=f"tpctl-worker-{name}")
         self.thread.start()
 
+    def _apply(self, cfg: TpuDef) -> None:
+        self.coordinator.apply(cfg)
+
     def _run(self):
         while True:
             cfg = self.q.get()
             if cfg is None:
                 return
             try:
-                self.coordinator.apply(cfg)
+                self._apply(cfg)
                 self.error = None
             except Exception as e:
                 log.exception("deployment %s failed", self.name)
@@ -70,6 +73,60 @@ class _Worker:
         self.q.put(cfg)
 
 
+class _SubprocessWorker(_Worker):
+    """Per-deployment OS-process isolation: the apply runs in a child
+    `tpctl apply` process against the apiserver, so a poisoned apply —
+    segfault in a native dep, OOM kill, runaway memory — takes down one
+    deployment's worker, never the REST plane. This is the
+    StatefulSet-pod-per-deployment isolation of router.go:275-357 with a
+    subprocess standing in for the pod; the thread mode keeps the
+    capability without the isolation for hermetic/dry-run servers."""
+
+    APPLY_TIMEOUT_S = 1800.0
+
+    def __init__(self, name: str, apiserver_url: str):
+        self.apiserver_url = apiserver_url
+        self.last_pid: int | None = None
+        super().__init__(name, coordinator=None)
+
+    def _apply(self, cfg: TpuDef) -> None:
+        import subprocess
+        import sys
+        import tempfile
+
+        with tempfile.NamedTemporaryFile(
+                "w", suffix=".yaml", prefix=f"tpudef-{self.name}-",
+                delete=False) as f:
+            f.write(cfg.dump())
+            path = f.name
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "kubeflow_tpu.tpctl.cli", "apply",
+                 "-f", path, "--server", self.apiserver_url],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+            self.last_pid = proc.pid
+            try:
+                out, _ = proc.communicate(timeout=self.APPLY_TIMEOUT_S)
+            except subprocess.TimeoutExpired:
+                # communicate() does NOT kill on timeout: an orphaned
+                # child would keep mutating the cluster while the next
+                # queue item spawns a concurrent apply for the same
+                # deployment — kill and reap before surfacing the error
+                proc.kill()
+                proc.communicate()
+                raise RuntimeError(
+                    f"apply subprocess killed after "
+                    f"{self.APPLY_TIMEOUT_S:.0f}s timeout")
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"apply subprocess exited {proc.returncode}: "
+                    f"{(out or '').strip()[-500:]}")
+        finally:
+            import os
+
+            os.unlink(path)
+
+
 class TpctlServer:
     # Request-path access checks get a short retry budget: a create
     # handler must not pin a server thread for the offline-job default
@@ -77,9 +134,18 @@ class TpctlServer:
     ACCESS_CHECK_BUDGET_S = 8.0
 
     def __init__(self, client, ttl_s: float = DEFAULT_TTL_S,
-                 crm_backend=None, coordinator_factory=None):
+                 crm_backend=None, coordinator_factory=None,
+                 isolation: str = "thread", apiserver_url: str = ""):
+        if isolation not in ("thread", "subprocess"):
+            raise ValueError(f"isolation must be thread|subprocess, "
+                             f"got {isolation!r}")
+        if isolation == "subprocess" and not apiserver_url:
+            raise ValueError("subprocess isolation needs apiserver_url "
+                             "(the child tpctl process dials it)")
         self.client = client
         self.ttl_s = ttl_s
+        self.isolation = isolation
+        self.apiserver_url = apiserver_url
         self.workers: dict[str, _Worker] = {}
         self._lock = threading.Lock()
         self._coordinator = coordinator_factory or (lambda: Coordinator(self.client))
@@ -136,7 +202,11 @@ class TpctlServer:
         with self._lock:
             w = self.workers.get(cfg.name)
             if w is None:
-                w = self.workers[cfg.name] = _Worker(cfg.name, self._coordinator())
+                if self.isolation == "subprocess":
+                    w = _SubprocessWorker(cfg.name, self.apiserver_url)
+                else:
+                    w = _Worker(cfg.name, self._coordinator())
+                self.workers[cfg.name] = w
             w.submit(cfg)
         return 200, {"name": cfg.name, "status": "enqueued"}
 

@@ -570,3 +570,26 @@ class TestTopologyValidation:
         drain(ctl)
         job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
         assert not ob.cond_is_true(job, T.COND_FAILED)
+
+
+def test_node_mapper_indexes_by_node_not_full_fanout(world):
+    """A Node event must enqueue exactly the jobs with gang pods ON that
+    node (fieldSelector spec.nodeName), not every job in the cluster."""
+    from kubeflow_tpu.control.jaxjob.controller import _node_mapper
+
+    cluster, ctl, kubelet = world
+    make_job(cluster, replicas=1)          # "train"
+    job2 = T.new_jaxjob("other", replicas=1,
+                        accelerator="tpu-v5-lite-podslice",
+                        topology="2x2", chips_per_worker=4)
+    cluster.create(job2)
+    drain(ctl)
+    # bind train's pod to node-a, other's to node-b
+    for jobname, node in [("train", "node-a"), ("other", "node-b")]:
+        pod = cluster.get("v1", "Pod", worker_name(jobname, 0), "default")
+        pod["spec"]["nodeName"] = node
+        cluster.update(pod)
+    mapper = _node_mapper(cluster)
+    reqs = mapper(ob.new_object("v1", "Node", "node-a"))
+    assert [(r.namespace, r.name) for r in reqs] == [("default", "train")]
+    assert mapper(ob.new_object("v1", "Node", "node-c")) == []

@@ -3,6 +3,7 @@ kfctlServer_test.go, router_test.go, server_test.go shapes; idempotency
 contract of testing/kfctl/kfctl_second_apply.py)."""
 
 import json
+import time
 
 import pytest
 import yaml
@@ -312,3 +313,83 @@ def test_ha_controllers_render_leader_election(cfg):
     env = {e["name"]: e["value"]
            for e in ctl["spec"]["template"]["spec"]["containers"][0]["env"]}
     assert "ENABLE_LEADER_ELECTION" not in env
+
+
+class TestSubprocessIsolation:
+    """router.go:275-357 parity: per-deployment OS-process isolation —
+    a poisoned apply kills one child, never the REST plane."""
+
+    def test_subprocess_worker_applies_through_child_process(self):
+        import requests as rq
+
+        from kubeflow_tpu.control.k8s.apiserver import ApiServer, client_for
+        from kubeflow_tpu.tpctl.server import TpctlServer
+
+        api = ApiServer().serve_background()
+        try:
+            srv = TpctlServer(client_for(api), isolation="subprocess",
+                              apiserver_url=api.url)
+            svc = srv.serve(host="127.0.0.1", port=0).serve_background()
+            body = {"metadata": {"name": "iso-dep"},
+                    "spec": {"applications": ["crds"]}}
+            r = rq.post(f"http://127.0.0.1:{svc.port}/tpctl/apps/v1/create",
+                        json=body, timeout=10)
+            assert r.status_code == 200, r.text
+            deadline = time.monotonic() + 60
+            w = srv.workers["iso-dep"]
+            while time.monotonic() < deadline:
+                g = rq.post(f"http://127.0.0.1:{svc.port}/tpctl/apps/v1/get",
+                            json={"name": "iso-dep"}, timeout=10)
+                if w.error or (g.status_code == 200
+                               and (g.json().get("conditions")
+                                    or g.json().get("status"))):
+                    break
+                time.sleep(0.5)
+            assert w.error is None, w.error
+            assert w.last_pid is not None  # a real child process ran
+            # the child's apply landed in the shared apiserver
+            from kubeflow_tpu.tpctl.tpudef import API_VERSION as TAV
+            tpu = api.cluster.get(TAV, "TpuDef",
+                                  "iso-dep")
+            assert tpu is not None
+            svc.shutdown()
+        finally:
+            api.shutdown()
+
+    def test_poisoned_apply_kills_child_not_server(self):
+        import requests as rq
+
+        from kubeflow_tpu.control.k8s.apiserver import ApiServer, client_for
+        from kubeflow_tpu.tpctl.server import TpctlServer, _SubprocessWorker
+
+        api = ApiServer().serve_background()
+        try:
+            srv = TpctlServer(client_for(api), isolation="subprocess",
+                              apiserver_url="http://127.0.0.1:1")  # dead
+            svc = srv.serve(host="127.0.0.1", port=0).serve_background()
+            r = rq.post(f"http://127.0.0.1:{svc.port}/tpctl/apps/v1/create",
+                        json={"metadata": {"name": "doomed"},
+                              "spec": {"applications": ["crds"]}}, timeout=10)
+            assert r.status_code == 200
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                if srv.workers["doomed"].error:
+                    break
+                time.sleep(0.5)
+            assert srv.workers["doomed"].error, "child failure not surfaced"
+            # the REST plane survived and serves other deployments
+            r2 = rq.post(f"http://127.0.0.1:{svc.port}/tpctl/apps/v1/get",
+                         json={"name": "doomed"}, timeout=10)
+            assert r2.status_code == 200
+            assert "exited" in r2.json().get("error", "") or \
+                r2.json().get("error")
+            svc.shutdown()
+        finally:
+            api.shutdown()
+
+    def test_subprocess_isolation_requires_apiserver_url(self):
+        from kubeflow_tpu.control.k8s.fake import FakeCluster
+        from kubeflow_tpu.tpctl.server import TpctlServer
+
+        with pytest.raises(ValueError):
+            TpctlServer(FakeCluster(), isolation="subprocess")
