@@ -70,7 +70,16 @@ class _Worker:
                                "different spec; delete it first")
         self.current_spec = spec
         self.last_request = time.monotonic()
-        self.q.put(cfg)
+        try:
+            # never block: submit() runs under the server-wide lock, and a
+            # blocking put on a full queue would freeze the whole REST
+            # plane for up to one apply duration (30 min in subprocess
+            # mode). A full queue is backpressure — tell the client.
+            self.q.put_nowait(cfg)
+        except queue.Full:
+            raise ApiHttpError(
+                429, f"deployment {self.name} has {self.q.maxsize} applies "
+                     "queued; retry later")
 
 
 class _SubprocessWorker(_Worker):

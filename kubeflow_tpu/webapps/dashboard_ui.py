@@ -169,6 +169,25 @@ PAGE = """<!doctype html>
       access-management (KFAM) API.</p>
   </div>
   <div class="card">
+    <h2>Workgroup settings</h2>
+    <div id="admin-ns" style="display:none">
+      <h3>All namespaces <span class="muted">(cluster admin)</span></h3>
+      <ul id="all-ns"></ul>
+    </div>
+    <div class="danger">
+      <h3>Danger zone</h3>
+      <p class="muted">Deletes every workgroup you own; namespaces and
+        their workloads are garbage-collected by the profile controller.</p>
+      <button id="nuke-btn">Delete my workgroups…</button>
+      <span id="nuke-confirm" style="display:none">
+        Really delete everything?
+        <button id="nuke-yes" class="warn">Yes, delete</button>
+        <button id="nuke-no">Cancel</button>
+      </span>
+      <p class="muted" id="nuke-msg"></p>
+    </div>
+  </div>
+  <div class="card">
     <h2>Cluster resources</h2>
     <div class="tabs" id="metric-tabs">
       <button data-m="tpu-chips" class="active">TPU chips</button>
@@ -324,6 +343,44 @@ async function loadContributors(ns) {
     .catch(() => ({contributors: []}));
   renderContributors(out.contributors || []);
 }
+/* ---- workgroup settings: admin all-namespaces + nuke-self ---- */
+async function loadAdminNamespaces() {
+  // 403 for non-admins: the card stays hidden (namespace-selector's
+  // all-namespaces view is an admin affordance in the reference)
+  try {
+    const out = await api('/api/workgroup/get-all-namespaces');
+    const ul = $('all-ns');
+    ul.innerHTML = '';
+    for (const ns of out.namespaces || []) {
+      const li = document.createElement('li');
+      li.textContent = ns;
+      ul.appendChild(li);
+    }
+    $('admin-ns').style.display = 'block';
+  } catch (e) { /* not an admin */ }
+}
+$('nuke-btn').addEventListener('click', () => {
+  $('nuke-confirm').style.display = '';
+  $('nuke-btn').style.display = 'none';
+});
+$('nuke-no').addEventListener('click', () => {
+  $('nuke-confirm').style.display = 'none';
+  $('nuke-btn').style.display = '';
+});
+$('nuke-yes').addEventListener('click', async () => {
+  try {
+    const out = await api('/api/workgroup/nuke-self', {method: 'DELETE'});
+    $('nuke-msg').textContent = out.message || 'deleted';
+    $('nuke-confirm').style.display = 'none';
+    $('nuke-btn').style.display = '';
+  } catch (e) {
+    $('nuke-msg').textContent = 'failed: ' + e.message;
+    return;
+  }
+  // deletion succeeded: a refresh failure must not overwrite that fact
+  await loadEnv().catch(() => {});
+});
+
 $('contrib-add').addEventListener('click', async () => {
   $('contrib-err').textContent = '';
   try {
@@ -502,6 +559,7 @@ $('metric-tabs').addEventListener('click', (e) => {
 
 $('ns').addEventListener('change', (e) => loadNamespace(e.target.value));
 loadEnv().catch(e => { $('user').textContent = 'not signed in'; });
+loadAdminNamespaces();
 loadChart();
 loadServing();
 route();

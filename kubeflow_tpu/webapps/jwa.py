@@ -155,9 +155,21 @@ def load_spawner_config(path: str | None = None) -> dict:
 
 
 class JupyterWebApp:
-    def __init__(self, client, config: dict | None = None):
+    def __init__(self, client, config: dict | None = None,
+                 flavor: str | None = None):
+        from kubeflow_tpu.webapps.jwa_flavors import (
+            SnapshotFlavor, select_flavor)
+
         self.client = client
         self.config = config if config is not None else load_spawner_config()
+        # UI-flavor dispatch (reference main.py:12-29 UI=default|rok);
+        # the TPU build's non-default flavor is object-store snapshots.
+        # Explicit args validate through the same gate as $UI: an unknown
+        # flavor fails loudly, never silently degrades to default.
+        self.flavor_name = select_flavor(
+            {"UI": flavor} if flavor is not None else None)
+        self.flavor = (SnapshotFlavor(self)
+                       if self.flavor_name == "snapshot" else None)
 
     def _user(self, req: HttpReq) -> str:
         return req.header(USER_HEADER, "anonymous@kubeflow.org")
@@ -221,6 +233,8 @@ class JupyterWebApp:
         form = req.json() or {}
         _require_dns1123(form.get("name", ""))
         nb = notebook_from_form(ns, form, self.config)
+        if self.flavor is not None:  # flavor POST override (rok/app.py:56)
+            nb = self.flavor.mutate_notebook(nb, form)
         try:
             self.client.create(nb)
         except ob.Conflict:
@@ -288,6 +302,8 @@ class JupyterWebApp:
         from kubeflow_tpu.webapps.jwa_ui import add_ui_routes
 
         add_ui_routes(r)
+        if self.flavor is not None:
+            self.flavor.add_routes(r)
         httpd.add_health_routes(r)
         httpd.add_metrics_route(r)
         return r

@@ -393,3 +393,26 @@ class TestSubprocessIsolation:
 
         with pytest.raises(ValueError):
             TpctlServer(FakeCluster(), isolation="subprocess")
+
+
+def test_full_worker_queue_is_429_not_deadlock():
+    """submit() runs under the server lock: a full queue must answer 429
+    immediately, never block the REST plane for an apply duration."""
+    import threading as _t
+
+    from kubeflow_tpu.tpctl.server import _Worker
+    from kubeflow_tpu.utils.httpd import ApiHttpError
+
+    gate = _t.Event()
+
+    class _Blocked:
+        def apply(self, cfg):
+            gate.wait(30)
+
+    w = _Worker("jam", _Blocked())
+    cfg = TpuDef(name="jam", applications=("crds",))
+    with pytest.raises(ApiHttpError) as ei:
+        for _ in range(12):  # queue cap 10 + the in-flight one
+            w.submit(cfg)
+    assert ei.value.status == 429
+    gate.set()

@@ -302,3 +302,46 @@ class TestBackendNameValidation:
 
         assert sanitize_dns1123("Alice.B") == "alice-b"
         assert sanitize_dns1123("---") == "user"
+
+
+class TestWorkgroupSettingsCard:
+    """Admin all-namespaces view + the nuke-self danger-zone flow
+    (reference: namespace-selector all-namespaces + manage-workgroup)."""
+
+    def test_admin_sees_all_namespaces_list(self):
+        cluster = FakeCluster()
+        kfam = KfamService(cluster, cluster_admin=USER)  # alice IS admin
+        for n in ("team-a", "team-b"):
+            cluster.create(ob.new_object("v1", "Namespace", n))
+        b = Browser(Dashboard(cluster, kfam=kfam).router())
+        b.default_headers["kubeflow-userid"] = USER
+        b.load(DASH_PAGE)
+        assert b.by_id("admin-ns").style.get("display") == "block"
+        assert "team-a" in b.by_id("all-ns").textContent
+        assert "team-b" in b.by_id("all-ns").textContent
+
+    def test_non_admin_card_stays_hidden(self):
+        cluster = FakeCluster()
+        b = dash_browser(cluster)  # admin is root@, not alice
+        b.load(DASH_PAGE)
+        assert b.by_id("admin-ns").style.get("display") in (None, "none")
+
+    def test_nuke_flow_requires_confirmation_and_deletes_profiles(self):
+        cluster = FakeCluster()
+        b = dash_browser(cluster)
+        prof = ob.new_object(PT.API_VERSION, PT.KIND, "alice-ns")
+        prof["spec"] = {"owner": {"kind": "User", "name": USER}}
+        cluster.create(prof)
+        cluster.create(ob.new_object("v1", "Namespace", "alice-ns"))
+        b.load(DASH_PAGE)
+        # cancel path: nothing deleted
+        b.click("nuke-btn")
+        assert b.by_id("nuke-confirm").style.get("display") == ""
+        b.click("nuke-no")
+        assert cluster.get_or_none(PT.API_VERSION, PT.KIND, "alice-ns")
+        # confirm path: profiles gone, UI returns to the walkthrough
+        b.click("nuke-btn")
+        b.click("nuke-yes")
+        assert cluster.get_or_none(PT.API_VERSION, PT.KIND, "alice-ns") is None
+        assert "deleted 1" in b.text("nuke-msg")
+        assert b.by_id("register").style.get("display") == "block"

@@ -716,3 +716,73 @@ class TestServingCard:
         r = Dashboard(cluster, fetch_json=lambda u: {"models": []}).router()
         assert r.dispatch(mkreq("GET", "/api/serving/models",
                                 user=None)).status == 401
+
+
+class TestJwaFlavors:
+    """UI-flavor dispatch (reference main.py:12-29 UI=default|rok): the
+    snapshot flavor overrides the notebook POST and adds the token
+    endpoint, reshaped from Rok block snapshots to object storage."""
+
+    def _app(self, flavor="snapshot"):
+        from kubeflow_tpu.webapps.jwa import JupyterWebApp
+
+        cluster = FakeCluster()
+        return cluster, JupyterWebApp(cluster, flavor=flavor).router()
+
+    def test_unknown_flavor_fails_loudly(self, monkeypatch):
+        from kubeflow_tpu.webapps.jwa_flavors import select_flavor
+
+        with pytest.raises(ValueError):
+            select_flavor({"UI": "nope"})
+        assert select_flavor({}) == "default"
+        assert select_flavor({"UI": "snapshot"}) == "snapshot"
+
+    def test_snapshot_url_annotates_notebook(self):
+        from kubeflow_tpu.webapps.jwa_flavors import ANNO_SNAPSHOT_SRC
+
+        cluster, r = self._app()
+        resp = r.dispatch(mkreq(
+            "POST", "/api/namespaces/team-a/notebooks",
+            body={"name": "snap-nb", "snapshotUrl": "gs://bkt/ws/alice/"}))
+        assert resp.status == 200, resp.body
+        nb = cluster.get("kubeflow.org/v1beta1", "Notebook", "snap-nb",
+                         "team-a")
+        assert ob.annotations_of(nb)[ANNO_SNAPSHOT_SRC] == "gs://bkt/ws/alice/"
+
+    def test_bad_snapshot_url_is_400(self):
+        cluster, r = self._app()
+        resp = r.dispatch(mkreq(
+            "POST", "/api/namespaces/team-a/notebooks",
+            body={"name": "snap-nb", "snapshotUrl": "http://evil"}))
+        assert resp.status == 400
+        assert not cluster.list("kubeflow.org/v1beta1", "Notebook",
+                                namespace="team-a")
+
+    def test_token_endpoint_reads_secret(self):
+        import base64
+
+        cluster, r = self._app()
+        out = J(r.dispatch(mkreq(
+            "GET", "/api/snapshot/namespaces/team-a/token")))
+        assert out["success"] is False and out["token"]["value"] == ""
+        sec = ob.new_object("v1", "Secret", "snapshot-access", "team-a")
+        sec["data"] = {"token": base64.b64encode(b"s3cret").decode()}
+        cluster.create(sec)
+        out = J(r.dispatch(mkreq(
+            "GET", "/api/snapshot/namespaces/team-a/token")))
+        assert out["success"] is True
+        assert out["token"]["value"] == "s3cret"
+
+    def test_default_flavor_has_no_snapshot_surface(self):
+        cluster, r = self._app(flavor="default")
+        resp = r.dispatch(mkreq(
+            "GET", "/api/snapshot/namespaces/team-a/token"))
+        assert resp.status == 404
+        # snapshotUrl silently ignored (no annotation) on default flavor
+        r.dispatch(mkreq("POST", "/api/namespaces/team-a/notebooks",
+                         body={"name": "plain", "snapshotUrl": "gs://x/"}))
+        nb = cluster.get("kubeflow.org/v1beta1", "Notebook", "plain",
+                         "team-a")
+        from kubeflow_tpu.webapps.jwa_flavors import ANNO_SNAPSHOT_SRC
+
+        assert ANNO_SNAPSHOT_SRC not in ob.annotations_of(nb)
