@@ -345,3 +345,40 @@ class TestWorkgroupSettingsCard:
         assert cluster.get_or_none(PT.API_VERSION, PT.KIND, "alice-ns") is None
         assert "deleted 1" in b.text("nuke-msg")
         assert b.by_id("register").style.get("display") == "block"
+
+
+class TestDashboardNavigation:
+    """Hash routing + iframe app embedding (the reference SPA's
+    iframe-based app navigation, main-page.js routing)."""
+
+    def test_hash_routes_to_iframe_and_back(self):
+        cluster = FakeCluster()
+        b = dash_browser(cluster)
+        prof = ob.new_object(PT.API_VERSION, PT.KIND, "alice-ns")
+        prof["spec"] = {"owner": {"kind": "User", "name": USER}}
+        cluster.create(prof)
+        cluster.create(ob.new_object("v1", "Namespace", "alice-ns"))
+        b.load(DASH_PAGE)
+        main = b.document.querySelector("main")
+        assert main.style.get("display") in (None, "")
+        # navigate to an embedded app route
+        routes = b.eval("Object.keys(APP_ROUTES)")
+        assert routes, "dashboard defines no APP_ROUTES"
+        target = routes[0]
+        b.set_hash(target)
+        assert main.style.get("display") == "none"
+        frame = b.by_id("app-frame")
+        assert frame.getAttribute("src")
+        assert "ns=alice-ns" in frame.getAttribute("src")
+        # active nav link follows the hash
+        active = [a.getAttribute("href")
+                  for a in b.document.querySelectorAll("#appnav a")
+                  if "active" in a.className.split()]
+        assert active == [target]
+        # unknown route -> 404 view, never a blank page
+        b.set_hash("#/bogus")
+        assert b.by_id("notfound-view").style.get("display") == ""
+        assert b.text("notfound-path") == "#/bogus"
+        # home again
+        b.set_hash("#/")
+        assert main.style.get("display") == ""
