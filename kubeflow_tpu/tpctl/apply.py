@@ -67,33 +67,139 @@ class ExistingCluster(PlatformProvider):
 
 class GkeTpuPlatform(PlatformProvider):
     """TPU node-pool provisioning via gcloud (the DM/kfctl-gcp analogue).
-    Command construction is testable; execution requires gcloud."""
+
+    The gcloud CLI contract is pinned by an offline stateful test double
+    (tests/test_gcloud_double.py runs a fake `gcloud` on PATH through the
+    REAL subprocess path), so every command here is executed in CI, not
+    just string-asserted:
+
+    - describe-before-create/delete makes apply and delete idempotent
+      (re-applies and double-deletes are normal coordinator behavior);
+    - machine type derives from the accelerator;
+    - multi-host slices pass --tpu-topology and the host count that GKE
+      requires (num-nodes = chips / chips-per-host).
+    """
+
+    # accelerator -> (machine type, chips per host)
+    MACHINE_TYPES = {
+        "tpu-v4-podslice": ("ct4p-hightpu-4t", 4),
+        "tpu-v5-lite-podslice": ("ct5lp-hightpu-4t", 4),
+        "tpu-v5p-slice": ("ct5p-hightpu-4t", 4),
+        "tpu-v6e-slice": ("ct6e-standard-4t", 4),
+    }
 
     def __init__(self, runner=subprocess.run):
         self.runner = runner
 
+    @staticmethod
+    def _chips(topology: str) -> int:
+        n = 1
+        for d in (topology or "1").lower().split("x"):
+            n *= int(d)
+        return n
+
+    def _machine(self, cfg: TpuDef) -> tuple[str, int]:
+        if cfg.accelerator not in self.MACHINE_TYPES:
+            raise ValueError(
+                f"unknown TPU accelerator {cfg.accelerator!r}; known: "
+                f"{sorted(self.MACHINE_TYPES)} (a typo here would "
+                "provision the wrong TPU generation)")
+        machine, per_host = self.MACHINE_TYPES[cfg.accelerator]
+        hosts = max(1, self._chips(cfg.topology) // per_host)
+        return machine, hosts
+
+    def _scope(self, cfg: TpuDef) -> list[str]:
+        return [f"--project={cfg.project}", f"--zone={cfg.zone}",
+                f"--cluster={cfg.name}"]
+
+    def _run(self, cmd: list[str]) -> None:
+        """check=True with stderr preserved: CalledProcessError's message
+        omits captured output, and 'Insufficient quota ...' must reach
+        the operator's Degraded condition, not vanish."""
+        r = self.runner(cmd, check=False, capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(cmd[:4])} failed rc={r.returncode}: "
+                f"{(r.stderr or r.stdout or '').strip()[-500:]}")
+
+    def describe_pool(self, cfg: TpuDef) -> dict | None:
+        """The live pool document, None if absent. Any OTHER describe
+        failure (expired credentials, network, API outage) raises — an
+        auth error must never read as 'pool already gone'."""
+        r = self.runner(
+            ["gcloud", "container", "node-pools", "describe",
+             f"{cfg.name}-tpu", *self._scope(cfg), "--format=json"],
+            check=False, capture_output=True, text=True)
+        if r.returncode == 0:
+            import json as _json
+
+            try:
+                return _json.loads(r.stdout) or {}
+            except ValueError:
+                return {}
+        err = (r.stderr or r.stdout or "").lower()
+        if "not found" in err or "404" in err:
+            return None
+        raise RuntimeError(
+            f"gcloud describe failed rc={r.returncode}: "
+            f"{(r.stderr or '').strip()[-500:]}")
+
+    def pool_exists(self, cfg: TpuDef) -> bool:
+        return self.describe_pool(cfg) is not None
+
     def commands(self, cfg: TpuDef) -> list[list[str]]:
-        return [[
+        machine, hosts = self._machine(cfg)
+        cmd = [
             "gcloud", "container", "node-pools", "create", f"{cfg.name}-tpu",
-            f"--project={cfg.project}", f"--zone={cfg.zone}",
-            f"--cluster={cfg.name}",
-            f"--machine-type=ct5lp-hightpu-4t",
-            "--num-nodes=1",
+            *self._scope(cfg),
+            f"--machine-type={machine}",
+            f"--num-nodes={hosts}",
             f"--node-labels=cloud.google.com/gke-tpu-accelerator={cfg.accelerator},"
             f"cloud.google.com/gke-tpu-topology={cfg.topology}",
-        ]]
+        ]
+        if hosts > 1:
+            # multi-host slice: GKE needs the physical topology to wire
+            # ICI across the hosts
+            cmd.append(f"--tpu-topology={cfg.topology}")
+        return [cmd]
 
     def apply(self, cfg: TpuDef) -> None:
+        live = self.describe_pool(cfg)
+        if live is not None:
+            # idempotent only if the live pool MATCHES the spec: silently
+            # keeping a stale 2x4 pool under a 4x4 TpuDef would report
+            # Available while the workload can never schedule
+            machine, hosts = self._machine(cfg)
+            config = live.get("config") or {}
+            drift = []
+            if config.get("machineType") not in (None, machine):
+                drift.append(f"machineType {config['machineType']} "
+                             f"!= {machine}")
+            live_topo = (config.get("labels") or {}).get(
+                "cloud.google.com/gke-tpu-topology")
+            if live_topo not in (None, cfg.topology):
+                drift.append(f"topology {live_topo} != {cfg.topology}")
+            if live.get("initialNodeCount") not in (None, hosts):
+                drift.append(f"hosts {live['initialNodeCount']} != {hosts}")
+            if drift:
+                raise RuntimeError(
+                    f"node pool {cfg.name}-tpu exists with a different "
+                    f"shape ({'; '.join(drift)}); delete it before "
+                    "re-applying the changed TpuDef")
+            log.info("node pool %s-tpu exists and matches; skipping create",
+                     cfg.name)
+            return
         for cmd in self.commands(cfg):
             log.info("platform exec: %s", " ".join(cmd))
-            self.runner(cmd, check=True)
+            self._run(cmd)
 
     def delete(self, cfg: TpuDef) -> None:
-        self.runner([
+        if self.describe_pool(cfg) is None:
+            return  # genuinely gone: delete is idempotent
+        self._run([
             "gcloud", "container", "node-pools", "delete", f"{cfg.name}-tpu",
-            f"--project={cfg.project}", f"--zone={cfg.zone}",
-            f"--cluster={cfg.name}", "--quiet",
-        ], check=True)
+            *self._scope(cfg), "--quiet",
+        ])
 
 
 PROVIDERS = {"existing": ExistingCluster, "gke-tpu": GkeTpuPlatform}

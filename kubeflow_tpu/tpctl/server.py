@@ -265,7 +265,13 @@ class TpctlServer:
         with self._lock:
             for name, w in list(self.workers.items()):
                 if now - w.last_request > self.ttl_s:
-                    w.q.put(None)
+                    try:
+                        # never block under the server lock: a full queue
+                        # means the worker is busy, i.e. NOT idle — skip
+                        # it this round rather than freeze the REST plane
+                        w.q.put_nowait(None)
+                    except queue.Full:
+                        continue
                     del self.workers[name]
                     reaped.append(name)
         return reaped
