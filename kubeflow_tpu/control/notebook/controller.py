@@ -41,6 +41,11 @@ def nb_culled():
     return _metric("notebook_culling_total", prom.Counter, "notebooks culled")
 
 
+def nb_create_failed():
+    return _metric("notebook_create_failed_total", prom.Counter,
+                   "Total failure times of creating notebooks")
+
+
 def nb_culling_timestamp():
     return _metric("last_notebook_culling_timestamp_seconds", prom.Gauge,
                    "Timestamp of the last notebook culling in seconds")
@@ -193,7 +198,13 @@ class NotebookReconciler(Reconciler):
         if first_seen:
             nb_created().inc()
 
-        rh.reconcile_child(client, nb, self.generate_statefulset(nb))
+        try:
+            rh.reconcile_child(client, nb, self.generate_statefulset(nb))
+        except Exception:
+            # metrics.go:41 notebook_create_failed_total; the reconcile
+            # error still propagates so the workqueue retries with backoff
+            nb_create_failed().inc()
+            raise
         rh.reconcile_child(client, nb, self.generate_service(nb))
         if use_istio():
             rh.reconcile_child(client, nb, self.generate_virtual_service(nb))

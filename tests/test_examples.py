@@ -3,8 +3,10 @@ otherwise (the reference's testing/test_jsonnet.py evaluated every
 jsonnet for the same reason)."""
 
 import glob
+import json
 import os
 import subprocess
+import sys
 
 import yaml
 
@@ -79,3 +81,86 @@ def test_multislice_example_validates_and_builds_mesh():
     resolved = spec.resolve(chips)
     assert resolved.dcn == job["spec"]["sliceCount"]
     assert resolved.data * resolved.dcn * resolved.model == chips
+
+
+class TestLmPromotion:
+    """The sweep->bench promotion loop: only measured-better configs ever
+    change the headline LM defaults (tools/promote_best.py + bench.py
+    --lm-best auto)."""
+
+    def _log(self, tmp_path, entries):
+        p = tmp_path / "lm_sweep.log"
+        lines = []
+        for lm in entries:
+            lines.append("### header noise")
+            lines.append(json.dumps({"metric": "x", "lm": lm}))
+        p.write_text("\n".join(lines) + "\n")
+        return p
+
+    def _run(self, tmp_path, monkeypatch):
+        import tools.promote_best as pb
+
+        monkeypatch.setattr(pb, "HERE", str(tmp_path))
+        monkeypatch.setattr(sys, "argv",
+                            ["promote", str(tmp_path / "lm_sweep.log")])
+        pb.main()
+        best = tmp_path / "lm_best.json"
+        return json.loads(best.read_text()) if best.exists() else None
+
+    def test_promotes_only_above_verified_floor(self, tmp_path, monkeypatch):
+        log = self._log(tmp_path, [
+            {"model": "gpt-350m", "mfu": 0.19, "optimizer": "adafactor",
+             "global_batch": 8, "remat": False},
+            {"model": "gpt-760m", "mfu": 0.31, "optimizer": "adafactor",
+             "global_batch": 8, "remat": True, "remat_policy": "dots",
+             "kftpu_flash_block_q": "256"},
+            {"model": "llama-1b", "mfu": 0.27, "optimizer": "adafactor",
+             "global_batch": 4, "remat": True},
+        ])
+        best = self._run(tmp_path, monkeypatch)
+        assert best and best["model"] == "gpt-760m" and best["mfu"] == 0.31
+
+    def test_nothing_beats_floor_means_no_file(self, tmp_path, monkeypatch):
+        self._log(tmp_path, [
+            {"model": "gpt-125m", "mfu": 0.18, "optimizer": "adamw",
+             "global_batch": 8, "remat": False}])
+        assert self._run(tmp_path, monkeypatch) is None
+
+    def test_bench_applies_promotion_file(self, tmp_path, monkeypatch):
+        """bench.py --lm-best auto adopts the promoted config when no
+        explicit --lm-* flag is present — and never when one is."""
+        import argparse
+        import importlib
+
+        monkeypatch.syspath_prepend(str(HERE))
+        bench = importlib.import_module("bench")
+        best = {"model": "gpt-760m", "global_batch": 8,
+                "optimizer": "adafactor", "remat": True,
+                "remat_policy": "dots", "kftpu_flash_block_q": "256",
+                "mfu": 0.31}
+        bp = tmp_path / "lm_best.json"
+        bp.write_text(json.dumps(best))
+
+        def mkargs():
+            return argparse.Namespace(
+                lm_best="auto", lm_model="gpt-350m", lm_batch=8,
+                lm_optimizer="adafactor", lm_remat=False,
+                lm_remat_policy="dots")
+
+        monkeypatch.delenv("KFTPU_FLASH_BLOCK_Q", raising=False)
+        args = mkargs()
+        src = bench.apply_lm_promotion(args, ["--workload", "lm"],
+                                       best_path=str(bp))
+        assert src == "tools/lm_best.json"
+        assert args.lm_model == "gpt-760m" and args.lm_remat is True
+        assert os.environ.pop("KFTPU_FLASH_BLOCK_Q") == "256"
+        # explicit flags always win
+        args = mkargs()
+        src = bench.apply_lm_promotion(
+            args, ["--workload", "lm", "--lm-model", "gpt-350m"],
+            best_path=str(bp))
+        assert src == "flags" and args.lm_model == "gpt-350m"
+        # malformed promotion file: safe defaults
+        bp.write_text("{broken")
+        args = mkargs()
+        assert bench.apply_lm_promotion(args, [], best_path=str(bp)) == "flags"

@@ -224,3 +224,23 @@ class TestRunningNotebooksCollector:
         nb = cluster.get(T.API_VERSION, T.KIND, "idle-nb", "default")
         assert culler.is_stopped(nb)
         assert nb_culling_timestamp()._value.get() > before
+
+
+def test_create_failure_counter_increments_and_error_propagates():
+    from kubeflow_tpu.control.notebook.controller import nb_create_failed
+
+    class _Refusing(FakeCluster):
+        def create(self, obj):
+            if obj.get("kind") == "StatefulSet":
+                raise ob.ApiError("quota exceeded")
+            return super().create(obj)
+
+    cluster = _Refusing()
+    ctl = seed_controller(build_controller(cluster))
+    before = nb_create_failed()._value.get()
+    cluster.create(T.new_notebook("doomed", "default"))
+    ctl.run_until_idle(advance_delayed=True)
+    assert nb_create_failed()._value.get() > before
+    # the workqueue kept retrying (error propagated, not swallowed)
+    assert cluster.get_or_none("apps/v1", "StatefulSet", "doomed",
+                               "default") is None
