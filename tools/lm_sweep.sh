@@ -14,9 +14,14 @@ run --lm-model gpt-350m --lm-optimizer adafactor --lm-batch 16
 run --lm-model gpt-350m --lm-optimizer adafactor --lm-batch 8 --lm-remat --lm-remat-policy dots
 # adamw + dots remat (fits now?)
 run --lm-model gpt-350m --lm-optimizer adamw --lm-batch 8 --lm-remat --lm-remat-policy dots
-# bigger models
+# bigger models (higher arithmetic intensity = the path past 20% MFU;
+# adafactor frees the optimizer-state HBM that blocks them under adamw)
 run --lm-model gpt-760m --lm-optimizer adafactor --lm-batch 8
+run --lm-model gpt-760m --lm-optimizer adafactor --lm-batch 16
+run --lm-model gpt-760m --lm-optimizer adafactor --lm-batch 8 --lm-remat --lm-remat-policy dots
 run --lm-model llama-1b --lm-optimizer adafactor --lm-batch 4 --lm-remat --lm-remat-policy dots
+run --lm-model llama-1b --lm-optimizer adafactor --lm-batch 8 --lm-remat --lm-remat-policy dots
+run --lm-model llama-1b --lm-optimizer adafactor --lm-batch 8 --lm-remat --lm-remat-policy full
 # flash block-size sweep on the current best config
 for bq in 128 256 512; do
   for bk in 128 256; do

@@ -10,7 +10,11 @@ while true; do
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
     echo "tunnel UP $(date -u +%H:%M:%S) — launching lm_sweep" >> "$LOG"
     bash tools/lm_sweep.sh
-    echo "sweep finished $(date -u +%H:%M:%S)" >> "$LOG"
+    echo "sweep finished $(date -u +%H:%M:%S) — validating promoted bench" >> "$LOG"
+    # full headline run at the (possibly promoted) defaults: proves the
+    # promotion end-to-end on hardware and leaves a fresh JSON in the log
+    timeout 1600 python bench.py >> "$LOG" 2>&1
+    echo "bench validation done $(date -u +%H:%M:%S)" >> "$LOG"
     exit 0
   fi
   echo "tunnel down $(date -u +%H:%M:%S)" >> "$LOG"
