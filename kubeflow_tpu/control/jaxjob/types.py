@@ -34,14 +34,19 @@ LABEL_JOB_NAME = "jaxjob.kubeflow.org/job-name"
 LABEL_REPLICA_INDEX = "jaxjob.kubeflow.org/replica-index"
 LABEL_SLICE_INDEX = "jaxjob.kubeflow.org/slice-index"
 
-# Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env
-ENV_COORD = "JAXJOB_COORDINATOR_ADDRESS"
-ENV_NPROC = "JAXJOB_NUM_PROCESSES"
-ENV_PID = "JAXJOB_PROCESS_ID"
-ENV_NAME = "JAXJOB_NAME"
-ENV_NAMESPACE = "JAXJOB_NAMESPACE"
-ENV_NUM_SLICES = "JAXJOB_NUM_SLICES"
-ENV_SLICE_ID = "JAXJOB_SLICE_ID"
+# Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env.
+# Re-exported from dist (ONE authoritative spelling of the wire contract);
+# the import is jax-free — parallel/__init__ is lazy exactly so the
+# control plane can import dist, and test_dist.py pins that property.
+from kubeflow_tpu.parallel.dist import (  # noqa: E402
+    ENV_COORD,
+    ENV_NAME,
+    ENV_NAMESPACE,
+    ENV_NPROC,
+    ENV_NUM_SLICES,
+    ENV_PID,
+    ENV_SLICE_ID,
+)
 
 # GKE TPU scheduling surface (the nvidia.com/gpu swap point —
 # create_job_specs.py:165-170 sets resources.limits["nvidia.com/gpu"])

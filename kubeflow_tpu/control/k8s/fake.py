@@ -182,19 +182,24 @@ class FakeCluster:
         MVCC property kube's limit/continue contract guarantees)."""
         with self._lock:
             if continue_token:
-                remaining = self._continues.pop(continue_token, None)
-                if remaining is None:
+                entry = self._continues.pop(continue_token, None)
+                if entry is None:
                     raise ob.Expired(
                         f"continue token {continue_token!r} expired")
+                remaining, rv = entry
             else:
                 remaining = self.list(api_version, kind, namespace,
                                       label_selector, field_selector)
-            rv = str(self._rv)
+                rv = str(self._rv)
+            # every page reports the SNAPSHOT's rv, not the current one:
+            # a watch resumed from a paginated list's rv must replay
+            # events for objects created mid-pagination (they are absent
+            # from the snapshot) — the real apiserver's contract
             if limit is None or len(remaining) <= limit:
                 return remaining, "", rv
             page, rest = remaining[:limit], remaining[limit:]
             token = uuid.uuid4().hex
-            self._continues[token] = rest
+            self._continues[token] = (rest, rv)
             while len(self._continues) > 64:  # bound snapshot memory
                 self._continues.popitem(last=False)
             return page, token, rv

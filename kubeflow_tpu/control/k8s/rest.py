@@ -291,9 +291,14 @@ class _RestWatchStream:
         self._c = client
         self._args = (api_version, kind, namespace)
         self._closed = False
-        # (namespace, name) of objects this stream has yielded and not
-        # seen deleted — the store the 410 relist diffs against
-        self._known: set[tuple[str, str]] = set()
+        # last-known FULL object per (ns, name) this stream has yielded
+        # and not seen deleted — the informer store the 410 relist diffs
+        # against. Synthesized DELETED events must carry the full last
+        # state (labels, ownerReferences): owner/label mappers in the
+        # controllers read them, and a bare {name} event would be
+        # silently dropped (client-go's DeletedFinalStateUnknown exists
+        # for exactly this).
+        self._known: dict[tuple[str, str], dict] = {}
 
     @staticmethod
     def _key(obj: dict) -> tuple[str, str]:
@@ -305,16 +310,13 @@ class _RestWatchStream:
 
         api_version, kind, namespace = self._args
         items, rv = self._c._list_chunked(api_version, kind, namespace, {})
-        live = set()
+        live: dict[tuple[str, str], dict] = {}
         for it in items:
-            live.add(self._key(it))
+            live[self._key(it)] = it
             yield WatchEvent("MODIFIED", it)
-        for gone_ns, gone_name in self._known - live:
-            yield WatchEvent("DELETED", {
-                "apiVersion": api_version, "kind": kind,
-                "metadata": {"name": gone_name,
-                             **({"namespace": gone_ns} if gone_ns else {})},
-            })
+        for key, last_state in self._known.items():
+            if key not in live:
+                yield WatchEvent("DELETED", last_state)
         self._known = live
         return rv
 
@@ -367,9 +369,9 @@ class _RestWatchStream:
                     if etype == "BOOKMARK":
                         continue
                     if etype in ("ADDED", "MODIFIED"):
-                        self._known.add(self._key(obj))
+                        self._known[self._key(obj)] = obj
                     elif etype == "DELETED":
-                        self._known.discard(self._key(obj))
+                        self._known.pop(self._key(obj), None)
                     if etype in ("ADDED", "MODIFIED", "DELETED"):
                         yield WatchEvent(etype, obj)
             except Exception:

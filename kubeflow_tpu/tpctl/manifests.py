@@ -41,11 +41,15 @@ def _deployment(name: str, ns: str, image: str, *, args: list[str] | None = None
     )
 
 
-def _service(name: str, ns: str, port: int, target: int) -> dict:
+def _service(name: str, ns: str, port: int, target: int,
+             scheme: str = "http") -> dict:
+    # the port-name prefix drives Istio protocol selection: a TLS backend
+    # behind an 'http-' port would have its ClientHello parsed as
+    # plaintext by a mesh sidecar
     return ob.new_object(
         "v1", "Service", name, ns,
         spec={"selector": {"app": name},
-              "ports": [{"name": f"http-{name}", "port": port,
+              "ports": [{"name": f"{scheme}-{name}", "port": port,
                          "targetPort": target}]},
     )
 
@@ -185,7 +189,8 @@ def render(cfg: TpuDef) -> list[dict]:
         pod["containers"][0]["volumeMounts"] = [{
             "name": "certs", "mountPath": "/etc/webhook/certs"}]
         out.append(dep)
-        out.append(_service("poddefault-webhook", ns, 443, 4443))
+        out.append(_service("poddefault-webhook", ns, 443, 4443,
+                            scheme="https"))
         hook = ob.new_object(
             "admissionregistration.k8s.io/v1", "MutatingWebhookConfiguration",
             "poddefault-webhook")

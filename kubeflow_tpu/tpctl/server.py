@@ -42,6 +42,14 @@ class _Worker:
         self.last_request = time.monotonic()
         self.current_spec: dict | None = None
         self.error: str | None = None
+        # applies submitted but not yet finished (queued + in flight):
+        # incremented under the server lock in submit(), decremented by
+        # the worker thread when an apply completes. GC must never reap
+        # a worker with pending > 0 — a reaped-but-still-applying worker
+        # plus a fresh one for the same name would break the
+        # per-deployment serialization this class exists to provide.
+        self.pending = 0
+        self._plock = threading.Lock()
         self.thread = threading.Thread(target=self._run, daemon=True,
                                        name=f"tpctl-worker-{name}")
         self.thread.start()
@@ -60,6 +68,9 @@ class _Worker:
             except Exception as e:
                 log.exception("deployment %s failed", self.name)
                 self.error = str(e)
+            finally:
+                with self._plock:
+                    self.pending -= 1
 
     def submit(self, cfg: TpuDef) -> None:
         spec = cfg.to_object()["spec"]
@@ -80,6 +91,13 @@ class _Worker:
             raise ApiHttpError(
                 429, f"deployment {self.name} has {self.q.maxsize} applies "
                      "queued; retry later")
+        with self._plock:
+            self.pending += 1
+
+    @property
+    def busy(self) -> bool:
+        with self._plock:
+            return self.pending > 0
 
 
 class _SubprocessWorker(_Worker):
@@ -265,12 +283,16 @@ class TpctlServer:
         with self._lock:
             for name, w in list(self.workers.items()):
                 if now - w.last_request > self.ttl_s:
+                    # idle means NOTHING pending: a worker with queued or
+                    # in-flight applies must keep its identity (reaping
+                    # it would let a re-submit start a SECOND concurrent
+                    # apply for the same deployment). submit() holds the
+                    # same lock, so pending can't grow under us.
+                    if w.busy:
+                        continue
                     try:
-                        # never block under the server lock: a full queue
-                        # means the worker is busy, i.e. NOT idle — skip
-                        # it this round rather than freeze the REST plane
                         w.q.put_nowait(None)
-                    except queue.Full:
+                    except queue.Full:  # defensive; empty when not busy
                         continue
                     del self.workers[name]
                     reaped.append(name)

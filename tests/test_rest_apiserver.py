@@ -368,7 +368,9 @@ class TestWatchConformance:
 
             threading.Thread(target=consume_forever, daemon=True).start()
             time.sleep(0.3)
-            c.create(ob.new_object("v1", "ConfigMap", "doomed", "default"))
+            doomed = ob.new_object("v1", "ConfigMap", "doomed", "default",
+                                   labels={"owner-label": "gang-a"})
+            c.create(doomed)
             c.create(ob.new_object("v1", "ConfigMap", "keeper", "default"))
             for _ in range(100):
                 if len(events) >= 2:
@@ -396,6 +398,13 @@ class TestWatchConformance:
             assert not any(e.type == "DELETED" and
                            e.object["metadata"]["name"] == "keeper"
                            for e in events)
+            # informer semantics: the synthesized DELETED carries the
+            # LAST-KNOWN full object (labels/ownerRefs) so secondary
+            # mappers still resolve the owning CR
+            deleted = next(e for e in events if e.type == "DELETED" and
+                           e.object["metadata"]["name"] == "doomed")
+            assert deleted.object["metadata"].get("labels", {}).get(
+                "owner-label") == "gang-a"
         finally:
             srv.shutdown()
 
@@ -458,3 +467,28 @@ class TestStalePatch:
         out = client.patch("v1", "ConfigMap", "sp", {"data": {"v": "3"}},
                            "default")
         assert out["data"]["v"] == "3"
+
+
+def test_continue_pages_report_snapshot_rv(server):
+    """A watch resumed from a paginated list's rv must see objects
+    created mid-pagination: every page carries the SNAPSHOT's rv."""
+    cluster = server.cluster
+    for i in range(6):
+        cluster.create(ob.new_object("v1", "ConfigMap", f"s{i}", "default"))
+    page1, cont, rv1 = cluster.list_page("v1", "ConfigMap", "default",
+                                         limit=4)
+    cluster.create(ob.new_object("v1", "ConfigMap", "mid-pagination",
+                                 "default"))
+    _page2, _cont2, rv2 = cluster.list_page("v1", "ConfigMap", "default",
+                                            limit=4, continue_token=cont)
+    assert rv2 == rv1  # pinned, NOT the post-creation current rv
+    # resuming a watch from that rv replays the mid-pagination creation
+    stream = cluster.watch("v1", "ConfigMap", "default", since_rv=rv2)
+    names = []
+    while True:
+        ev = stream.poll()
+        if ev is None:
+            break
+        names.append(ev.object["metadata"]["name"])
+    stream.stop()
+    assert "mid-pagination" in names

@@ -416,3 +416,40 @@ def test_full_worker_queue_is_429_not_deadlock():
             w.submit(cfg)
     assert ei.value.status == 429
     gate.set()
+
+
+def test_gc_never_reaps_a_busy_worker():
+    """A worker with queued or in-flight applies keeps its identity past
+    TTL: reaping it would let a re-submit run a second concurrent apply
+    for the same deployment."""
+    import threading as _t
+
+    from kubeflow_tpu.tpctl.server import TpctlServer
+
+    gate = _t.Event()
+    started = _t.Event()
+
+    class _Slow:
+        def apply(self, cfg):
+            started.set()
+            gate.wait(30)
+
+    srv = TpctlServer(FakeCluster(), ttl_s=0.01,
+                      coordinator_factory=lambda: _Slow())
+    from kubeflow_tpu.utils.httpd import HttpReq
+
+    body = json.dumps({"metadata": {"name": "busy"},
+                       "spec": {"applications": ["crds"]}}).encode()
+    req = HttpReq(method="POST", path="/tpctl/apps/v1/create", params={},
+                  query={}, headers={}, body=body)
+    srv.create(req)
+    assert started.wait(10)
+    time.sleep(0.05)  # past the ttl while the apply is in flight
+    assert srv.gc_once() == []  # busy: NOT reaped
+    w = srv.workers["busy"]
+    gate.set()
+    for _ in range(100):
+        if not w.busy:
+            break
+        time.sleep(0.05)
+    assert srv.gc_once() == ["busy"]  # idle now: reaped
