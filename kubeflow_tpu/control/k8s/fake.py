@@ -74,7 +74,8 @@ class FakeCluster:
         self._truncated_below = 0  # RVs <= this may be missing from history
         # Snapshots backing list continue tokens: a paginated list reads a
         # consistent snapshot even under concurrent writes (etcd MVCC).
-        self._continues: collections.OrderedDict[str, list[dict]] = \
+        self._continues: collections.OrderedDict[
+            str, tuple[list[dict], str]] = \
             collections.OrderedDict()
 
     # -- internals ----------------------------------------------------------

@@ -1926,7 +1926,8 @@ class Browser:
         self.routers: list[tuple[str, Any]] = []
         if router is not None:
             self.routers.append(("", router))
-        self.location = JSObject({"hash": "", "href": "/", "pathname": "/"})
+        self.location = JSObject({"hash": "", "href": "/", "pathname": "/",
+                                  "search": ""})
         self.window = Element("#window", self.document)
         self.timers: list[tuple[float, Any]] = []    # intervals: refire
         self.timeouts: list[tuple[float, Any]] = []  # one-shots: fire once
@@ -2134,6 +2135,23 @@ class Browser:
         def _error_ctor(message=""):
             return new_error(js_str(message))
 
+        class _URLSearchParams:
+            def __init__(self, qs=""):
+                from urllib.parse import parse_qs
+
+                self._q = parse_qs(js_str(qs).lstrip("?"),
+                                   keep_blank_values=True)
+
+            def get(self, key):
+                vals = self._q.get(js_str(key))
+                return vals[0] if vals else None
+
+            def getAll(self, key):
+                return self._q.get(js_str(key), [])
+
+            def has(self, key):
+                return js_str(key) in self._q
+
         for name, val in {
             "document": doc,
             "window": self.window,
@@ -2154,6 +2172,7 @@ class Browser:
                                "from": lambda v: list(v)}),
             "Error": _error_ctor,
             "FormData": FormData,
+            "URLSearchParams": _URLSearchParams,
             "parseInt": lambda s, base=10: _parse_int(s, base),
             "parseFloat": lambda s: js_num(s),
             "isNaN": lambda v: js_num(v) != js_num(v),

@@ -382,3 +382,50 @@ class TestDashboardNavigation:
         # home again
         b.set_hash("#/")
         assert main.style.get("display") == ""
+
+
+class TestTensorboardsUi:
+    """The Tensorboards CRUD app's page executed end to end (a consumer
+    of crud_backend the reference never shipped a frontend for)."""
+
+    def _browser(self):
+        from kubeflow_tpu.webapps.tensorboards import PAGE, TensorboardsApp
+
+        cluster = FakeCluster()
+        cluster.create(ob.new_object("v1", "Namespace", "team-a"))
+        b = Browser(TensorboardsApp(cluster).router())
+        b.default_headers["kubeflow-userid"] = USER
+        b.location["search"] = "?ns=team-a"
+        b.load(PAGE)
+        return cluster, b
+
+    def test_create_list_delete_roundtrip(self):
+        cluster, b = self._browser()
+        assert "none yet" in b.by_id("rows").textContent
+        b.by_id("name").value = "exp1"
+        b.by_id("logspath").value = "gs://bkt/logs"
+        b.click("create")
+        tb = cluster.get("tensorboard.kubeflow.org/v1alpha1", "Tensorboard",
+                         "exp1", "team-a")
+        assert tb["spec"]["logspath"] == "gs://bkt/logs"
+        assert "exp1" in b.by_id("rows").textContent
+        # delete through the row button the JS built
+        btns = b.by_id("rows").querySelectorAll("button")
+        assert len(btns) == 1
+        btns[0].click()
+        assert cluster.get_or_none("tensorboard.kubeflow.org/v1alpha1",
+                                   "Tensorboard", "exp1", "team-a") is None
+        assert "none yet" in b.by_id("rows").textContent
+
+    def test_invalid_inputs_surface_backend_errors(self):
+        cluster, b = self._browser()
+        b.by_id("name").value = "Bad Name!"
+        b.by_id("logspath").value = "gs://bkt/logs"
+        b.click("create")
+        assert "invalid" in b.text("err")
+        b.by_id("name").value = "ok-name"
+        b.by_id("logspath").value = "relative/path"
+        b.click("create")
+        assert b.text("err")  # logspath must be cloud or absolute
+        assert not cluster.list("tensorboard.kubeflow.org/v1alpha1",
+                                "Tensorboard", namespace="team-a")
