@@ -108,6 +108,16 @@ class JSPromise:
         return JSPromise(error=e)
 
 
+def _raise_if_rejected(v):
+    """An unhandled rejected promise must FAIL the test, not vanish:
+    async handlers/timers/top-level chains dominate the UI code, and a
+    swallowed rejection is silent mis-execution — the harness's worst
+    failure mode."""
+    if isinstance(v, JSPromise) and v.rejected:
+        raise JSThrow(v.error)
+    return v
+
+
 # ---------------------------------------------------------------------------
 # lexer
 
@@ -773,6 +783,10 @@ def js_str(v) -> str:
     if isinstance(v, float):
         if v != v:
             return "NaN"
+        if v == float("inf"):
+            return "Infinity"
+        if v == float("-inf"):
+            return "-Infinity"
         if v == int(v):
             return str(int(v))
         return repr(v)
@@ -812,6 +826,8 @@ def js_eq_loose(a, b) -> bool:
         return True
     if a is None or a is undefined or b is None or b is undefined:
         return False
+    if isinstance(a, (dict, list)) or isinstance(b, (dict, list)):
+        return a is b  # loose == on two objects is still identity in JS
     if type(a) is type(b) or (isinstance(a, (int, float))
                               and isinstance(b, (int, float))):
         return a == b
@@ -860,8 +876,8 @@ class Interpreter:
         if callable(fn) and not isinstance(fn, JSFunction):
             return fn(*args)
         env = Env(fn.env)
-        env.declare("this", this)
-        if not fn.is_arrow:
+        if not fn.is_arrow:  # arrows keep the LEXICAL this
+            env.declare("this", this)
             env.declare("arguments", list(args))
         for i, (pname, default, rest) in enumerate(fn.params):
             if rest:
@@ -906,7 +922,7 @@ class Interpreter:
             for s in node[1]:
                 self.exec(s, benv)
         elif op == "expr":
-            self.eval(node[1], env)
+            _raise_if_rejected(self.eval(node[1], env))
         elif op == "var":
             for target, init in node[2]:
                 v = self.eval(init, env) if init is not None else undefined
@@ -1003,8 +1019,7 @@ class Interpreter:
             return "".join(js_str(self.eval(p, env)) for p in node[1])
         if op == "regex":
             body, flags = node[1]
-            pyflags = _re.IGNORECASE if "i" in flags else 0
-            return JSRegExp(body, pyflags)
+            return JSRegExp(body, flags)
         if op == "array":
             out = []
             for item in node[1]:
@@ -1052,7 +1067,11 @@ class Interpreter:
             try:
                 v = self.eval(node[1], env)
             except JSThrow:
-                return "undefined"
+                # JS only special-cases an unresolvable *reference*;
+                # typeof obj.missing.deep must propagate the TypeError
+                if node[1][0] == "name":
+                    return "undefined"
+                raise
             if v is undefined:
                 return "undefined"
             if v is None:
@@ -1143,7 +1162,11 @@ class Interpreter:
             return js_num(a) / d
         if op == "%":
             d = js_num(b)
-            return float("nan") if d == 0 else js_num(a) % d
+            if d == 0:
+                return float("nan")
+            import math
+
+            return math.fmod(js_num(a), d)  # JS takes the dividend's sign
         if op == "**":
             return js_num(a) ** js_num(b)
         if op == "===":
@@ -1173,6 +1196,10 @@ class Interpreter:
         if isinstance(a, bool) != isinstance(b, bool):
             return False
         if a is undefined or a is None or b is undefined or b is None:
+            return a is b
+        # JS === is reference identity for objects/arrays/functions
+        if isinstance(a, (dict, list, JSFunction)) or \
+                isinstance(b, (dict, list, JSFunction)):
             return a is b
         return a == b
 
@@ -1267,7 +1294,18 @@ class Interpreter:
 class JSRegExp:
     def __init__(self, body, flags):
         self.source = body
-        self._rx = _re.compile(_js_regex_to_py(body), flags)
+        if isinstance(flags, str):
+            unknown = set(flags) - set("gims")
+            if unknown:
+                raise JSError(f"unsupported regex flags {''.join(unknown)!r}")
+            self.global_ = "g" in flags
+            pyflags = (_re.IGNORECASE if "i" in flags else 0) | \
+                (_re.MULTILINE if "m" in flags else 0) | \
+                (_re.DOTALL if "s" in flags else 0)
+        else:  # legacy int flags
+            self.global_ = False
+            pyflags = flags
+        self._rx = _re.compile(_js_regex_to_py(body), pyflags)
 
     def test(self, s=""):
         return self._rx.search(js_str(s)) is not None
@@ -1301,13 +1339,16 @@ def _string_member(s: str, name):
         "indexOf": lambda sub="": s.find(js_str(sub)),
         "slice": lambda a=0, b=None: s[_slice(a, b, len(s))],
         "substring": lambda a=0, b=None: s[_slice(a, b, len(s))],
-        "split": lambda sep=undefined: (
-            list(s) if sep is undefined else s.split(js_str(sep))),
+        "split": lambda sep=undefined: _js_split(s, sep),
         "replace": lambda pat, rep: (
-            pat._rx.sub(js_str(rep), s, count=1)
+            pat._rx.sub(_js_replacement(rep), s,
+                        count=0 if pat.global_ else 1)
             if isinstance(pat, JSRegExp) else s.replace(js_str(pat),
                                                         js_str(rep), 1)),
-        "replaceAll": lambda pat, rep: s.replace(js_str(pat), js_str(rep)),
+        "replaceAll": lambda pat, rep: (
+            pat._rx.sub(_js_replacement(rep), s)
+            if isinstance(pat, JSRegExp)
+            else s.replace(js_str(pat), js_str(rep))),
         "charAt": lambda i=0: s[int(i)] if 0 <= int(i) < len(s) else "",
         "repeat": lambda k: s * int(k),
         "padStart": lambda w, c=" ": s.rjust(int(w), js_str(c)),
@@ -1318,6 +1359,24 @@ def _string_member(s: str, name):
     if name in table:
         return table[name]
     return undefined
+
+
+def _js_split(s: str, sep):
+    if sep is undefined:
+        return [s]  # JS no-arg split does NOT char the string
+    if isinstance(sep, JSRegExp):
+        return sep._rx.split(s)
+    sep = js_str(sep)
+    if sep == "":
+        return list(s)
+    return s.split(sep)
+
+
+def _js_replacement(rep) -> str:
+    """JS $n/$& replacement tokens -> Python re templates."""
+    out = _re.sub(r"\$(\d+)", r"\\\1", js_str(rep))
+    out = out.replace("$&", "\\g<0>")
+    return out
 
 
 def _slice(a, b, n):
@@ -1357,8 +1416,10 @@ def _array_member(arr: list, name, interp):
                               for i, v in enumerate(arr)),
         "every": lambda f: all(js_truthy(call(f, v, i))
                                for i, v in enumerate(arr)),
-        "includes": lambda v: v in arr,
-        "indexOf": lambda v: arr.index(v) if v in arr else -1,
+        "includes": lambda v: any(Interpreter._strict_eq(x, v) for x in arr),
+        "indexOf": lambda v: next(
+            (i for i, x in enumerate(arr)
+             if Interpreter._strict_eq(x, v)), -1),
         "join": lambda sep=",": js_str(sep).join(
             "" if v is undefined or v is None else js_str(v) for v in arr),
         "slice": lambda a=0, b=None: arr[_slice(a, b, len(arr))],
@@ -1724,7 +1785,9 @@ class Element:
         node = self
         while node is not None:  # bubble
             for fn in list(node._listeners.get(etype, [])):
-                fn.call([event]) if isinstance(fn, JSFunction) else fn(event)
+                r = (fn.call([event]) if isinstance(fn, JSFunction)
+                     else fn(event))
+                _raise_if_rejected(r)  # broken async handler = test fails
             node = node.parent
         return True
 
@@ -1929,8 +1992,9 @@ class Browser:
         self.location = JSObject({"hash": "", "href": "/", "pathname": "/",
                                   "search": ""})
         self.window = Element("#window", self.document)
-        self.timers: list[tuple[float, Any]] = []    # intervals: refire
-        self.timeouts: list[tuple[float, Any]] = []  # one-shots: fire once
+        self.timers: dict[int, Any] = {}    # id -> interval fn (refire)
+        self.timeouts: dict[int, Any] = {}  # id -> one-shot fn (fire once)
+        self._timer_seq = 0
         self.console: list[str] = []
         self.requests: list[tuple[str, str]] = []  # (method, path) log
         # headers an auth proxy (gatekeeper/IAP) would inject on every
@@ -2026,10 +2090,16 @@ class Browser:
         return self
 
     def eval(self, js_expr: str):
-        """Evaluate an expression in page context (test assertions)."""
+        """Evaluate an expression in page context (test assertions).
+        Trailing tokens are an error — a truncated assertion must never
+        pass vacuously."""
         interp = self._interpreter()
-        ast = Parser(tokenize(js_expr)).expression()
-        return interp.eval(ast, self._genv)
+        parser = Parser(tokenize(js_expr))
+        ast = parser.expression()
+        if not parser.at("eof"):
+            raise JSError(
+                f"trailing tokens after expression: {parser.peek()!r}")
+        return _raise_if_rejected(interp.eval(ast, self._genv))
 
     # -- user actions -------------------------------------------------------
 
@@ -2067,17 +2137,21 @@ class Browser:
         self.location["hash"] = js_str(value)
         ev = JSObject({"type": "hashchange"})
         for fn in self.window._listeners.get("hashchange", []):
-            fn.call([ev]) if isinstance(fn, JSFunction) else fn(ev)
+            _raise_if_rejected(
+                fn.call([ev]) if isinstance(fn, JSFunction) else fn(ev))
         return self
 
     def fire_timers(self):
-        """Run every interval callback once and drain pending one-shot
-        timeouts (they never refire — setTimeout semantics)."""
-        for _delay, fn in list(self.timers):
-            fn.call([]) if isinstance(fn, JSFunction) else fn()
-        pending, self.timeouts = self.timeouts, []
-        for _delay, fn in pending:
-            fn.call([]) if isinstance(fn, JSFunction) else fn()
+        """Run every live interval callback once and drain pending
+        one-shot timeouts (they never refire — setTimeout semantics).
+        Rejected async callbacks raise: a broken timer must fail tests."""
+        for fn in list(self.timers.values()):
+            _raise_if_rejected(
+                fn.call([]) if isinstance(fn, JSFunction) else fn())
+        pending, self.timeouts = self.timeouts, {}
+        for fn in pending.values():
+            _raise_if_rejected(
+                fn.call([]) if isinstance(fn, JSFunction) else fn())
         return self
 
     def text(self, eid) -> str:
@@ -2095,12 +2169,19 @@ class Browser:
         doc = self.document
 
         def _set_interval(fn, delay=0, *a):
-            self.timers.append((js_num(delay), fn))
-            return len(self.timers)
+            self._timer_seq += 1
+            self.timers[self._timer_seq] = fn
+            return self._timer_seq
 
         def _set_timeout(fn, delay=0, *a):
-            self.timeouts.append((js_num(delay), fn))
-            return -len(self.timeouts)  # ids disjoint from intervals
+            self._timer_seq += 1
+            self.timeouts[self._timer_seq] = fn
+            return self._timer_seq
+
+        def _clear(tid=None):
+            # a cancelled timer must NOT fire in fire_timers
+            self.timers.pop(tid, None)
+            self.timeouts.pop(tid, None)
 
         def _console_log(*a):
             self.console.append(" ".join(js_str(x) for x in a))
@@ -2178,8 +2259,8 @@ class Browser:
             "isNaN": lambda v: js_num(v) != js_num(v),
             "setInterval": _set_interval,
             "setTimeout": _set_timeout,
-            "clearInterval": lambda *a: undefined,
-            "clearTimeout": lambda *a: undefined,
+            "clearInterval": _clear,
+            "clearTimeout": _clear,
             "encodeURIComponent": _encode_uri,
             "decodeURIComponent": lambda s: __import__(
                 "urllib.parse", fromlist=["unquote"]).unquote(js_str(s)),

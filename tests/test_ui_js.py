@@ -429,3 +429,96 @@ class TestTensorboardsUi:
         assert b.text("err")  # logspath must be cloud or absolute
         assert not cluster.list("tensorboard.kubeflow.org/v1alpha1",
                                 "Tensorboard", namespace="team-a")
+
+
+class TestHarnessSemantics:
+    """JS-semantics corners where silent divergence from a browser would
+    make UI tests lie (found by the jsdom-focused review)."""
+
+    def _out(self, js):
+        b = Browser()
+        b.load('<div id="out"></div>', run_scripts=False)
+        b.run(js)
+        return b, b.text("out")
+
+    def test_reference_identity_equality(self):
+        _, out = self._out("""
+          const a = [1, 2], b = [1, 2], o = {x: 1}, p = {x: 1};
+          document.getElementById('out').textContent =
+            [a === b, a === a, o === p, o == p, [o].includes(p),
+             [o].includes(o)].join(',');
+        """)
+        assert out == "false,true,false,false,false,true"
+
+    def test_unhandled_async_rejection_fails_the_test(self):
+        from kubeflow_tpu.testing.jsdom import JSThrow
+
+        b = Browser()
+        b.load('<button id="go"></button>', run_scripts=False)
+        b.run("""
+          document.getElementById('go').addEventListener('click',
+            async () => { throw new Error('broken handler'); });
+        """)
+        with pytest.raises(JSThrow, match="broken handler"):
+            b.click("go")
+        # top-level rejected chain also surfaces
+        with pytest.raises(JSThrow, match="boom"):
+            b.run("Promise.reject(new Error('boom'));")
+
+    def test_cleared_timers_do_not_fire(self):
+        b = Browser()
+        b.load('<div id="out">0</div>', run_scripts=False)
+        b.run("""
+          let n = 0;
+          const keep = setInterval(() => { n += 1; }, 1000);
+          const kill = setInterval(() => { n += 100; }, 1000);
+          clearInterval(kill);
+          const once = setTimeout(() => { n += 10; }, 50);
+          const never = setTimeout(() => { n += 1000; }, 50);
+          clearTimeout(never);
+          document.getElementById('out').textContent = 'armed';
+          setInterval(() => {
+            document.getElementById('out').textContent = String(n); }, 1);
+        """)
+        b.fire_timers()  # intervals render before timeouts drain
+        assert b.text("out") == "1"  # keep fired; cleared interval didn't
+        b.fire_timers()
+        # n = keep(1) + once(10) + keep(1) = 12: the one-shot fired
+        # exactly once, nothing cleared ever fired
+        assert b.text("out") == "12"
+
+    def test_regex_global_flag_and_groups(self):
+        _, out = self._out("""
+          const s = 'a-a-a'.replace(/a/g, 'b');
+          const t = 'v1.2'.replace(/(\\d+)\\.(\\d+)/, '$2:$1');
+          document.getElementById('out').textContent = s + ' ' + t;
+        """)
+        assert out == "b-b-b v2:1"
+
+    def test_split_and_modulo_and_infinity(self):
+        _, out = self._out("""
+          document.getElementById('out').textContent =
+            ['a b'.split().length, 'abc'.split('').join('|'),
+             'a, b,c'.split(/,\\s*/).join('+'),
+             (-5) % 3, '' + 1 / 0].join(' ');
+        """)
+        assert out == "1 a|b|c a+b+c -2 Infinity"
+
+    def test_eval_rejects_trailing_tokens(self):
+        from kubeflow_tpu.testing.jsdom import JSError
+
+        b = Browser()
+        b.load("<div></div>", run_scripts=False)
+        with pytest.raises(JSError, match="trailing"):
+            b.eval("1 + 1 garbage")
+
+    def test_typeof_propagates_real_errors(self):
+        from kubeflow_tpu.testing.jsdom import JSThrow
+
+        b = Browser()
+        b.load('<div id="out"></div>', run_scripts=False)
+        b.run("""document.getElementById('out').textContent =
+                   typeof neverDeclared;""")
+        assert b.text("out") == "undefined"
+        with pytest.raises(JSThrow):
+            b.run("const o = {}; typeof o.missing.deep;")
