@@ -157,17 +157,28 @@ class Attention(nn.Module):
                 lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
                                    cfg.head_dim), cfg.dtype))
             idx = jnp.asarray(decode_index, jnp.int32)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            if idx.ndim == 0:
+                ck.value = jax.lax.dynamic_update_slice(
+                    ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+                cv.value = jax.lax.dynamic_update_slice(
+                    cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            else:
+                # per-row positions (continuous batching: every slot is at
+                # its own decode index): one-hot scatter along seq — a
+                # [B, S] elementwise select per layer, the static-shape
+                # way to write B different positions in one program
+                hot = (jnp.arange(cfg.max_seq_len)[None, :]
+                       == idx[:, None])[:, :, None, None]
+                ck.value = jnp.where(hot, k.astype(cfg.dtype), ck.value)
+                cv.value = jnp.where(hot, v.astype(cfg.dtype), cv.value)
             kf = jnp.repeat(ck.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
             vf = jnp.repeat(cv.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
             logits = jnp.einsum(
                 "bqhd,bkhd->bhqk", q, kf,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
             pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
-            mask = pos <= idx
+            mask = pos <= (idx if idx.ndim == 0
+                           else idx[:, None, None, None])
             if pad_len is not None:
                 # left-padded ragged prompts: positions before each row's
                 # real start are pad garbage and must not be attended to
@@ -293,8 +304,11 @@ class TransformerLM(nn.Module):
             if cfg.pipeline_stages > 1:
                 raise ValueError("decode is not supported under pipeline "
                                  "parallelism yet")
-            positions = jnp.broadcast_to(
-                jnp.asarray(decode_index, jnp.int32), tokens.shape)
+            idx = jnp.asarray(decode_index, jnp.int32)
+            # scalar: whole batch at one position (generate.py's loop);
+            # vector [B]: per-row positions (continuous batching slots)
+            positions = (jnp.broadcast_to(idx, tokens.shape)
+                         if idx.ndim == 0 else idx[:, None])
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = Block(cfg, use_moe=use_moe, name=f"layer_{i}")(

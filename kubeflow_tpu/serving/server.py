@@ -530,6 +530,8 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        checkpoint_dir: str | None = None,
                        batch_window_ms: float = 0.0, max_batch: int = 64,
                        mesh: "Any | None" = None,
+                       continuous_batching: bool = False,
+                       decode_slots: int = 8,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -570,9 +572,10 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
     # temperature==0 stays at the fixed seed — greedy is deterministic.
     request_seed = itertools.count(seed).__next__
 
-    def predict(batch):
-        nonlocal variables
-        toks = batch["tokens"] if isinstance(batch, dict) else batch
+    decoder_box: list = []  # lazy SlotDecoder (needs materialized vars)
+    _decoder_lock = threading.Lock()
+
+    def _validated_rows(toks):
         # host-side ragged handling: LEFT-pad / keep the LAST prompt_len
         # tokens so the most recent context survives a trim; pad_lens
         # mask the pad positions out of decode attention (generate.py)
@@ -589,6 +592,41 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             row = row[-prompt_len:]
             pad_lens.append(prompt_len - len(row))
             rows.append([0] * (prompt_len - len(row)) + row)
+        return rows, pad_lens
+
+    def predict(batch):
+        nonlocal variables
+        toks = batch["tokens"] if isinstance(batch, dict) else batch
+        rows, pad_lens = _validated_rows(toks)
+        if continuous_batching:
+            # slot-based lockstep decode: rows join the shared decoder at
+            # step boundaries and finish independently — a long
+            # generation never blocks a short one (serving/continuous.py)
+            from kubeflow_tpu.serving.continuous import SlotDecoder
+
+            with _decoder_lock:  # concurrent first requests: one decoder
+                if not decoder_box:
+                    use_vars = (sm.get_variables(
+                        model, jnp.zeros((1, 1), jnp.int32))
+                        if sm is not None
+                        else variables or model.init(
+                            jax.random.PRNGKey(seed),
+                            jnp.zeros((1, 1), jnp.int32), train=False))
+                    decoder_box.append(SlotDecoder(
+                        model, use_vars, slots=decode_slots,
+                        prompt_len=prompt_len,
+                        max_new_tokens=max_new_tokens,
+                        temperature=temperature, top_k=top_k, seed=seed,
+                        mesh=sm.mesh if sm is not None else None))
+            dec = decoder_box[0]
+            if len(rows) == 1:  # hot path: no thread churn per request
+                outs = [dec.submit_padded(rows[0], pad_lens[0])]
+            else:
+                import concurrent.futures as cf
+
+                with cf.ThreadPoolExecutor(max_workers=len(rows)) as pool:
+                    outs = list(pool.map(dec.submit_padded, rows, pad_lens))
+            return np.asarray(outs, dtype=np.int64)
         prompt = jnp.asarray(rows, jnp.int32)
         if sm is not None:
             use_vars = sm.get_variables(model, prompt[:, :1])
@@ -605,15 +643,31 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                 pad_len=jnp.asarray(pad_lens, jnp.int32)))
         return out[:, prompt_len:]  # new tokens only
 
-    return ServedModel(
-        name=name, predict_fn=predict, pad_batches=True,
+    served = ServedModel(
+        name=name, predict_fn=predict,
+        # the slot decoder handles raggedness natively; pow2 padding
+        # would just decode phantom rows
+        pad_batches=not continuous_batching,
         batch_window_ms=batch_window_ms, max_batch=max_batch,
         pad_multiple=sm.pad_multiple if sm else 1,
         signature={"inputs": "tokens", "method_name": "generate",
                    "prompt_len": prompt_len,
                    "max_new_tokens": max_new_tokens,
+                   **({"continuous_batching": True,
+                       "decode_slots": decode_slots}
+                      if continuous_batching else {}),
                    **({"mesh": {k: v for k, v in sm.mesh.shape.items()
                                 if v > 1}} if sm else {})})
+    if continuous_batching:
+        orig_close = served.close
+
+        def _close():
+            if decoder_box:
+                decoder_box[0].close()
+            orig_close()
+
+        served.close = _close  # type: ignore[method-assign]
+    return served
 
 
 def main() -> None:  # pragma: no cover - container entry
@@ -631,6 +685,10 @@ def main() -> None:  # pragma: no cover - container entry
                         "e.g. chat=gpt-125m")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--continuous-batching", action="store_true",
+                   help="slot-based lockstep decode: requests join at any "
+                        "step boundary and finish independently")
+    p.add_argument("--decode-slots", type=int, default=8)
     p.add_argument("--mesh", default=None,
                    help="shard served params over a mesh, e.g. "
                         "'model=4,fsdp=2' — required for models whose "
@@ -661,6 +719,8 @@ def main() -> None:  # pragma: no cover - container entry
         server.register(serve_lm_generator(
             name, zoo or "gpt-125m", prompt_len=args.prompt_len,
             max_new_tokens=args.max_new_tokens, mesh=mesh_spec,
+            continuous_batching=args.continuous_batching,
+            decode_slots=args.decode_slots,
             checkpoint_dir=ckpt or None))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)

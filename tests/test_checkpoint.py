@@ -111,3 +111,40 @@ def test_restore_latest_none_on_empty_dir(tmp_path, devices8):
     t = Trainer(resnet_cfg())
     assert ck.restore_latest(t.init_state()) is None
     ck.close()
+
+
+class TestElasticResume:
+    """Elastic world size: a gang restarted with a DIFFERENT parallelism
+    layout (TPU maintenance shrank the slice; a bigger slice came back)
+    must resume the same orbax checkpoint — restore reshards to the new
+    mesh (global shapes are layout-independent; sharding is a compiler
+    input, not checkpoint state)."""
+
+    def _fit(self, tmp_path, mesh_spec, steps, total):
+        from kubeflow_tpu.parallel.mesh import MeshSpec
+        from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+        cfg = TrainConfig.from_dict(dict(
+            model="transformer-test", task="lm", global_batch=8,
+            seq_len=16, vocab_size=64,
+            model_kwargs={"vocab_size": 64},
+            mesh=mesh_spec, optimizer="adamw", learning_rate=1e-3,
+            total_steps=total, warmup_steps=1,
+            checkpoint_dir=str(tmp_path), checkpoint_every=1))
+        return Trainer(cfg).fit(steps=steps)
+
+    def test_resume_across_different_dp_tp_layouts(self, tmp_path):
+        from kubeflow_tpu.parallel.mesh import MeshSpec
+
+        # train 2 steps on dp=8
+        _, s1 = self._fit(tmp_path, MeshSpec(data=8), steps=2, total=4)
+        assert s1["start_step"] == 0
+        # "slice shrank": resume the SAME checkpoint on dp=2 x tp=4
+        _, s2 = self._fit(tmp_path, MeshSpec(data=2, model=4), steps=3,
+                          total=4)
+        assert s2["start_step"] == 2, s2
+        # "bigger slice returned": dp=4 x fsdp=2 finishes the run
+        _, s3 = self._fit(tmp_path, MeshSpec(data=4, fsdp=2), steps=4,
+                          total=4)
+        assert s3["start_step"] == 3, s3
+        assert np.isfinite(s3["final"]["loss"])
