@@ -200,7 +200,10 @@ class SlotDecoder:
         ctx = self.mesh if self.mesh is not None else None
         while not self._stop:
             try:
-                # admit pending requests into free slots (step boundary)
+                # admit pending requests into free slots (step boundary).
+                # With ACTIVE slots decoding, admit at most one prefill
+                # per tick: a burst of arrivals must not stall in-flight
+                # generations for burst_size x prefill_time.
                 while self._free and not self._pending.empty():
                     prompt, pad, ev, sink = self._pending.get_nowait()
                     s = self._free.pop()
@@ -218,6 +221,12 @@ class SlotDecoder:
                         self._free.append(s)
                         sink.append(e)
                         ev.set()
+                    if owners:
+                        # live check: the request just admitted (and any
+                        # already mid-generation) gets a decode tick
+                        # before the next prefill — time-to-first-token
+                        # stays ~1 prefill even for an idle-decoder burst
+                        break
                 self._active = len(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)
