@@ -406,13 +406,15 @@ class _ServingMesh:
     single device.
     """
 
-    def __init__(self, mesh_spec, seed: int, checkpoint_dir: str | None):
+    def __init__(self, mesh_spec, seed: int, checkpoint_dir: str | None,
+                 param_dtype: str | None = None):
         from kubeflow_tpu.parallel.mesh import (
             AXIS_DATA, AXIS_DCN, AXIS_FSDP, build_mesh)
 
         self.mesh = build_mesh(mesh_spec)
         self.seed = seed
         self.checkpoint_dir = checkpoint_dir
+        self.param_dtype = param_dtype
         if checkpoint_dir:
             # a missing/empty checkpoint must fail AT REGISTRATION
             # (crashloop + readiness gate), not as a 500 on the first
@@ -459,12 +461,18 @@ class _ServingMesh:
                 host_vars, step = restore_variables(self.checkpoint_dir)
                 log.info("restored variables from %s step %d (sharded %s)",
                          self.checkpoint_dir, step, dict(self.mesh.shape))
+                if self.param_dtype:
+                    host_vars = cast_params(host_vars, self.param_dtype)
                 self.variables = jax.device_put(S.unbox(host_vars), shardings)
             else:
                 with self.mesh:
+                    def init_fn(r):
+                        v = S.unbox(model.init(r, example, train=False))
+                        return (cast_params(v, self.param_dtype)
+                                if self.param_dtype else v)
+
                     self.variables = jax.jit(
-                        lambda r: S.unbox(model.init(r, example, train=False)),
-                        out_shardings=shardings)(rng)
+                        init_fn, out_shardings=shardings)(rng)
             return self.variables
 
 
@@ -524,6 +532,23 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
                                   "method_name": "predict"})
 
 
+def cast_params(variables, dtype):
+    """Inference-time parameter cast (f32 training checkpoints -> bf16
+    serving): KV-cache decode is HBM-bandwidth-bound on WEIGHT reads, so
+    halving weight bytes is the single biggest single-chip decode lever.
+    Floating leaves only; integer leaves pass
+    through untouched."""
+    import jax
+    import jax.numpy as jnp
+
+    def leaf(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree.map(leaf, variables)
+
+
 def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        max_new_tokens: int = 32, temperature: float = 0.0,
                        top_k: int = 0, seed: int = 0,
@@ -532,6 +557,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        mesh: "Any | None" = None,
                        continuous_batching: bool = False,
                        decode_slots: int = 8,
+                       param_dtype: str | None = None,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -551,7 +577,8 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
                       **model_kwargs)
-    sm = _ServingMesh(mesh, seed, checkpoint_dir) if mesh is not None else None
+    sm = (_ServingMesh(mesh, seed, checkpoint_dir, param_dtype=param_dtype)
+          if mesh is not None else None)
     if sm is not None and checkpoint_dir:
         # input shape is known here: materialize now so a shape-mismatched
         # checkpoint (wrong model/vocab) crashes registration, not the
@@ -562,8 +589,16 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
         from kubeflow_tpu.runtime.checkpoint import restore_variables
 
         variables, step = restore_variables(checkpoint_dir)
+        if param_dtype:
+            variables = cast_params(variables, param_dtype)
         log.info("model %s: restored variables from %s step %d", name,
                  checkpoint_dir, step)
+
+    def _materialize(prompt_col):
+        """Non-mesh variables: lazy init + optional serving cast — the
+        ONE place uncast f32 weights could otherwise leak from."""
+        v = model.init(jax.random.PRNGKey(seed), prompt_col, train=False)
+        return cast_params(v, param_dtype) if param_dtype else v
 
     import itertools
 
@@ -606,12 +641,12 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
             with _decoder_lock:  # concurrent first requests: one decoder
                 if not decoder_box:
-                    use_vars = (sm.get_variables(
-                        model, jnp.zeros((1, 1), jnp.int32))
-                        if sm is not None
-                        else variables or model.init(
-                            jax.random.PRNGKey(seed),
-                            jnp.zeros((1, 1), jnp.int32), train=False))
+                    if sm is not None:
+                        use_vars = sm.get_variables(
+                            model, jnp.zeros((1, 1), jnp.int32))
+                    else:
+                        use_vars = variables or _materialize(
+                            jnp.zeros((1, 1), jnp.int32))
                     decoder_box.append(SlotDecoder(
                         model, use_vars, slots=decode_slots,
                         prompt_len=prompt_len,
@@ -632,8 +667,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             use_vars = sm.get_variables(model, prompt[:, :1])
         else:
             if variables is None:
-                variables = model.init(jax.random.PRNGKey(seed),
-                                       prompt[:, :1], train=False)
+                variables = _materialize(prompt[:, :1])
             use_vars = variables
         with (sm.mesh if sm is not None else contextlib.nullcontext()):
             out = np.asarray(generate(
@@ -656,6 +690,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                    **({"continuous_batching": True,
                        "decode_slots": decode_slots}
                       if continuous_batching else {}),
+                   **({"param_dtype": param_dtype} if param_dtype else {}),
                    **({"mesh": {k: v for k, v in sm.mesh.shape.items()
                                 if v > 1}} if sm else {})})
     if continuous_batching:
@@ -685,6 +720,10 @@ def main() -> None:  # pragma: no cover - container entry
                         "e.g. chat=gpt-125m")
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--param-dtype", default=None,
+                   choices=["bfloat16", "float32"],
+                   help="cast served LM parameters (bfloat16 halves the "
+                        "weight HBM reads that dominate decode)")
     p.add_argument("--continuous-batching", action="store_true",
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
@@ -721,6 +760,7 @@ def main() -> None:  # pragma: no cover - container entry
             max_new_tokens=args.max_new_tokens, mesh=mesh_spec,
             continuous_batching=args.continuous_batching,
             decode_slots=args.decode_slots,
+            param_dtype=args.param_dtype,
             checkpoint_dir=ckpt or None))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)

@@ -536,3 +536,58 @@ def test_mesh_with_missing_checkpoint_fails_at_registration(tmp_path):
             "bad", "transformer-test", prompt_len=8, max_new_tokens=2,
             vocab_size=64, mesh={"model": 4, "fsdp": 2},
             checkpoint_dir=str(tmp_path / "empty"))
+
+
+class TestParamDtypeCasting:
+    """Inference-time bf16 weight casting: decode is HBM-bound on weight
+    reads, so halving weight bytes is the single-chip decode lever."""
+
+    def test_served_params_are_cast_and_generation_valid(self, tmp_path):
+        from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        cfg = TrainConfig.from_dict(dict(
+            model="transformer-test", task="lm", global_batch=8,
+            seq_len=12, vocab_size=64, model_kwargs={"vocab_size": 64},
+            total_steps=1, warmup_steps=1,
+            checkpoint_dir=str(tmp_path), checkpoint_every=1))
+        Trainer(cfg).fit(steps=1)
+        m = serve_lm_generator(
+            "bf16-lm", "transformer-test", prompt_len=8, max_new_tokens=3,
+            vocab_size=64, checkpoint_dir=str(tmp_path),
+            param_dtype="bfloat16")
+        out = m.predict([{"tokens": [1, 2, 3]}])
+        assert len(out) == 1 and len(out[0]) == 3
+
+    def test_cast_params_floats_only(self):
+        import jax.numpy as jnp
+        import numpy as np
+
+        from kubeflow_tpu.serving.server import cast_params
+
+        tree = {"w": jnp.ones((4,), jnp.float32),
+                "ids": jnp.arange(4, dtype=jnp.int32)}
+        out = cast_params(tree, "bfloat16")
+        assert out["w"].dtype == jnp.bfloat16
+        assert out["ids"].dtype == jnp.int32
+        np.testing.assert_allclose(np.asarray(out["w"], np.float32),
+                                   np.ones(4))
+
+    def test_mesh_sharded_cast(self):
+        import jax
+
+        from kubeflow_tpu.models.registry import get_model
+        from kubeflow_tpu.serving.server import _ServingMesh
+
+        import jax.numpy as jnp
+
+        sm = _ServingMesh({"fsdp": 2, "model": 4}, seed=0,
+                          checkpoint_dir=None, param_dtype="bfloat16")
+        model = get_model("transformer-test", vocab_size=64, max_seq_len=12)
+        variables = sm.get_variables(model, jnp.ones((1, 1), jnp.int32))
+        leaves = jax.tree.leaves(variables)
+        floats = [l for l in leaves if jnp.issubdtype(l.dtype, jnp.floating)]
+        assert floats and all(l.dtype == jnp.bfloat16 for l in floats)
+        # still sharded over the mesh
+        assert any(any(s is not None for s in l.sharding.spec)
+                   for l in floats)
