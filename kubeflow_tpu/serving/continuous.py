@@ -60,24 +60,28 @@ class SlotDecoder:
 
         params = {"params": variables["params"]}
 
-        # -- compiled: batch-1 prefill (scan the prompt into a fresh
-        #    single-row cache; the result is scattered into a slot) ------
-        def _prefill(prompt_row, pad_len_row):
-            cache1 = init_cache(model, variables, 1)
+        # -- compiled: batch-K prefill (scan K prompts into a fresh
+        #    K-row cache; rows are then scattered into free slots). K is
+        #    a static batch size — one compile per size in _PREFILL_SIZES,
+        #    so an idle-decoder burst prefills together instead of
+        #    paying burst_size serial scans. ----------------------------
+        def _prefill(prompts_kp, pad_lens_k):
+            k = prompts_kp.shape[0]
+            cache_k = init_cache(model, variables, k)
 
             def tick(carry, xs):
                 cache, _ = carry
-                tok, idx = xs
+                tok_col, idx = xs
                 out, mut = model.apply(
-                    params | {"cache": cache}, tok[None, None], train=False,
-                    decode_index=idx, mutable=["cache"],
-                    pad_len=pad_len_row[None])
+                    params | {"cache": cache}, tok_col[:, None],
+                    train=False, decode_index=idx, mutable=["cache"],
+                    pad_len=pad_lens_k)
                 return (mut["cache"], out[:, 0]), None
 
-            (cache1, logits), _ = jax.lax.scan(
-                tick, (cache1, jnp.zeros((1, cfg_vocab), jnp.float32)),
-                (prompt_row, jnp.arange(self.P)))
-            return cache1, logits[0]
+            (cache_k, logits), _ = jax.lax.scan(
+                tick, (cache_k, jnp.zeros((k, cfg_vocab), jnp.float32)),
+                (prompts_kp.T, jnp.arange(self.P)))
+            return cache_k, logits
 
         self._prefill = jax.jit(_prefill)
 
@@ -139,6 +143,10 @@ class SlotDecoder:
             jnp.zeros((self.S,), jnp.int32),            # pad_len
             jax.random.PRNGKey(seed),
         )
+        # prefill batch sizes we're willing to compile (smallest >= the
+        # waiting count is used; idle bursts prefill together)
+        self._PREFILL_SIZES = tuple(sorted(
+            {n for n in (1, 2, 4, 8, 16, 32) if n < self.S} | {self.S}))
         self._free: list[int] = list(range(self.S))
         self._pending: "queue.Queue[tuple]" = queue.Queue()
         # guards the _stop flag vs submit(): an enqueue must strictly
@@ -201,32 +209,61 @@ class SlotDecoder:
         while not self._stop:
             try:
                 # admit pending requests into free slots (step boundary).
-                # With ACTIVE slots decoding, admit at most one prefill
-                # per tick: a burst of arrivals must not stall in-flight
-                # generations for burst_size x prefill_time.
-                while self._free and not self._pending.empty():
-                    prompt, pad, ev, sink = self._pending.get_nowait()
-                    s = self._free.pop()
+                # Idle decoder: take a BATCH of waiting prompts (padded
+                # up to the next supported prefill size) so an idle
+                # burst prefills together instead of serially. Anything
+                # mid-generation: admit at most ONE per tick — a burst
+                # must not stall in-flight decodes.
+                if self._free and not self._pending.empty():
+                    want = 1 if owners else len(self._free)
+                    batch = []
+                    while len(batch) < want and not self._pending.empty():
+                        batch.append(self._pending.get_nowait())
+                    k = next(n for n in self._PREFILL_SIZES
+                             if n >= len(batch))
+                    prompts = np.zeros((k, self.P), np.int32)
+                    pads = np.zeros((k,), np.int32)
+                    bad = []
+                    for i, (prompt, pad, ev, sink) in enumerate(batch):
+                        try:
+                            # a wrong-length row (submit_padded trusts its
+                            # caller) must fail THAT caller, not poison
+                            # the batch or hang anyone on a never-set event
+                            prompts[i] = prompt
+                            pads[i] = pad
+                        except ValueError as e:
+                            prompts[i] = 0
+                            sink.append(e)
+                            ev.set()
+                            bad.append(i)
+                    batch = [m for i, m in enumerate(batch)
+                             if i not in bad]
+                    if not batch:
+                        continue
                     try:
                         with (ctx or contextlib.nullcontext()):
-                            cache1, logits = self._prefill(
-                                jnp.asarray(prompt),
-                                jnp.asarray(pad, jnp.int32))
-                            self.state = self._install(
-                                self.state, cache1, logits,
-                                jnp.asarray(s, jnp.int32),
-                                jnp.asarray(pad, jnp.int32))
-                        owners[s] = (ev, sink)
-                    except Exception as e:  # surface to the caller
-                        self._free.append(s)
-                        sink.append(e)
-                        ev.set()
-                    if owners:
-                        # live check: the request just admitted (and any
-                        # already mid-generation) gets a decode tick
-                        # before the next prefill — time-to-first-token
-                        # stays ~1 prefill even for an idle-decoder burst
-                        break
+                            cache_k, logits_k = self._prefill(
+                                jnp.asarray(prompts), jnp.asarray(pads))
+                    except Exception as e:  # whole batch fails together
+                        for _p, _pad, ev, sink in batch:
+                            sink.append(e)
+                            ev.set()
+                    else:
+                        for i, (_p, pad, ev, sink) in enumerate(batch):
+                            s = self._free.pop()
+                            try:
+                                with (ctx or contextlib.nullcontext()):
+                                    row = self._jax.tree.map(
+                                        lambda a, i=i: a[i:i + 1], cache_k)
+                                    self.state = self._install(
+                                        self.state, row, logits_k[i],
+                                        jnp.asarray(s, jnp.int32),
+                                        jnp.asarray(pad, jnp.int32))
+                                owners[s] = (ev, sink)
+                            except Exception as e:  # this row only
+                                self._free.append(s)
+                                sink.append(e)
+                                ev.set()
                 self._active = len(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)
