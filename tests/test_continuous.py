@@ -264,3 +264,34 @@ class TestSchedulingFairness:
                 assert not (a == "P" and b == "P"), trace
         finally:
             dec.close()
+
+
+def test_serve_bench_tool_runs_both_modes():
+    """tools/serve_bench.py: the serving-side ledger must emit one valid
+    JSON line per mode (plumbing check; numbers come from TPU runs)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    code = (
+        "import sys, jax, importlib.util\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "sys.argv = ['sb', '--model', 'transformer-test', '--vocab-size',"
+        " '64', '--prompt-len', '8', '--max-new-tokens', '3',"
+        " '--requests', '6', '--concurrency', '2', '--slots', '2',"
+        " '--param-dtype', '']\n"
+        "spec = importlib.util.spec_from_file_location("
+        "'sb', 'tools/serve_bench.py')\n"
+        "m = importlib.util.module_from_spec(spec)\n"
+        "spec.loader.exec_module(m)\n"
+        "sys.exit(m.main())\n")
+    r = subprocess.run([sys.executable, "-c", code], cwd=here,
+                       capture_output=True, text=True, timeout=400)
+    assert r.returncode == 0, r.stderr[-500:]
+    lines = [json.loads(ln) for ln in r.stdout.splitlines()
+             if ln.startswith("{")]
+    assert {d["mode"] for d in lines} == {"micro", "continuous"}
+    for d in lines:
+        assert d["tokens_per_sec"] > 0 and d["p50_ms"] > 0

@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""Serving benchmark: decode throughput + latency percentiles under load.
+
+Drives the in-process serving stack (no HTTP overhead) with a Poisson-ish
+open-loop arrival stream of pre-tokenized prompts and reports ONE JSON
+line per mode:
+
+  {"mode": "continuous", "tokens_per_sec": ..., "p50_ms": ...,
+   "p95_ms": ..., "requests": N, "slots": S, ...}
+
+Modes: `micro` (MicroBatcher + whole-batch generate) vs `continuous`
+(slot decoder). Run on real TPU for the numbers that matter; runs on the
+CPU mesh for plumbing validation. The training headline stays bench.py;
+this is the serving-side ledger (reference had none — TF-Serving was an
+integration, never measured in-tree).
+
+  python tools/serve_bench.py --model gpt-350m --param-dtype bfloat16 \\
+      --prompt-len 512 --max-new-tokens 64 --requests 64 --concurrency 16
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+
+def run_mode(mode: str, args) -> dict:
+    from kubeflow_tpu.serving.server import serve_lm_generator
+
+    served = serve_lm_generator(
+        "bench", args.model, prompt_len=args.prompt_len,
+        max_new_tokens=args.max_new_tokens,
+        continuous_batching=(mode == "continuous"),
+        decode_slots=args.slots,
+        batch_window_ms=(args.window_ms if mode == "micro" else 0.0),
+        param_dtype=args.param_dtype or None,
+        mesh=args.mesh or None,
+        vocab_size=args.vocab_size)
+    try:
+        rng = __import__("random").Random(0)
+        prompts = [[rng.randrange(1, args.vocab_size)
+                    for _ in range(rng.randrange(4, args.prompt_len))]
+                   for _ in range(args.requests)]
+        # warmup: compile every program before the measured window
+        served.predict([{"tokens": prompts[0]}])
+
+        latencies: list[float] = []
+        lat_lock = threading.Lock()
+        sem = threading.Semaphore(args.concurrency)
+        threads = []
+
+        def one(p):
+            t0 = time.perf_counter()
+            served.predict([{"tokens": p}])
+            dt = time.perf_counter() - t0
+            with lat_lock:
+                latencies.append(dt)
+            sem.release()
+
+        t_start = time.perf_counter()
+        for p in prompts:
+            sem.acquire()  # closed-loop at `concurrency` outstanding
+            th = threading.Thread(target=one, args=(p,))
+            th.start()
+            threads.append(th)
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t_start
+        latencies.sort()
+
+        def pct(q):
+            return round(
+                latencies[min(len(latencies) - 1,
+                              int(q * len(latencies)))] * 1e3, 1)
+
+        return {
+            "mode": mode,
+            "requests": args.requests,
+            "concurrency": args.concurrency,
+            "slots": args.slots,
+            "tokens_per_sec": round(
+                args.requests * args.max_new_tokens / wall, 1),
+            "requests_per_sec": round(args.requests / wall, 2),
+            "p50_ms": pct(0.50),
+            "p95_ms": pct(0.95),
+            "p99_ms": pct(0.99),
+            "wall_s": round(wall, 2),
+            "model": args.model,
+            "max_new_tokens": args.max_new_tokens,
+            "param_dtype": args.param_dtype or "f32",
+        }
+    finally:
+        served.close()
+
+
+def main() -> int:
+    p = argparse.ArgumentParser("serve_bench")
+    p.add_argument("--model", default="gpt-350m")
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--prompt-len", type=int, default=512)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--slots", type=int, default=16)
+    p.add_argument("--window-ms", type=float, default=5.0,
+                   help="micro-batching window for the micro mode")
+    p.add_argument("--param-dtype", default="bfloat16",
+                   choices=["bfloat16", "float32", ""])
+    p.add_argument("--mesh", default="",
+                   help="axis=n[,axis=n...] to shard the served params")
+    p.add_argument("--modes", default="micro,continuous")
+    args = p.parse_args()
+    if args.mesh:
+        args.mesh = {k: int(v) for k, v in
+                     (kv.split("=", 1) for kv in args.mesh.split(","))}
+    for mode in args.modes.split(","):
+        print(json.dumps(run_mode(mode.strip(), args)), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
