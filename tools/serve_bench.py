@@ -44,8 +44,15 @@ def run_mode(mode: str, args) -> dict:
         prompts = [[rng.randrange(1, args.vocab_size)
                     for _ in range(rng.randrange(4, args.prompt_len))]
                    for _ in range(args.requests)]
-        # warmup: compile every program before the measured window
-        served.predict([{"tokens": prompts[0]}])
+        # warmup: compile every program the measured window can hit —
+        # micro-batching dispatches pow2-padded GROUPS, so warm each
+        # pow2 batch size up to the concurrency cap (otherwise first-
+        # compile latencies pollute the percentiles)
+        k = 1
+        while k <= max(1, args.concurrency):
+            served.predict([{"tokens": prompts[i % len(prompts)]}
+                            for i in range(k)])
+            k *= 2
 
         latencies: list[float] = []
         lat_lock = threading.Lock()
