@@ -23,14 +23,49 @@ import jax
 import jax.numpy as jnp
 
 
-def init_cache(model, params, batch: int) -> Any:
-    """Zero KV caches shaped for `batch` rows (eval_shape: no FLOPs)."""
+def init_cache(model, batch: int) -> Any:
+    """Zero KV caches shaped for `batch` rows (eval_shape: no FLOPs).
+    Shapes come from the model config alone, never from live params."""
     tok1 = jnp.zeros((batch, 1), jnp.int32)
     shapes = jax.eval_shape(
         lambda: model.init(jax.random.PRNGKey(0), tok1, decode_index=0)
     )
     return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype),
                         shapes.get("cache", {}))
+
+
+def check_decode_geometry(model, prompt_len: int, max_new_tokens: int) -> None:
+    """Decode past max_seq_len is silent garbage (the scalar cache write
+    clamps; the vector one-hot write drops) — refuse the geometry up
+    front, identically for generate() and the slot decoder."""
+    limit = model.cfg.max_seq_len
+    if prompt_len + max_new_tokens > limit:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {prompt_len + max_new_tokens} "
+            f"exceeds the model's max_seq_len {limit}")
+
+
+def prefill_scan(model, params, cache, prompts, pad_len):
+    """Scan a [B, P] prompt through the KV cache one position per tick
+    (cache-correct by construction); returns (cache, last_logits [B,V]).
+    The ONE prefill implementation — generate() and the slot decoder
+    must never drift apart here."""
+    b, lp = prompts.shape
+
+    def tick(carry, xs):
+        cache, _ = carry
+        tok_col, idx = xs
+        out, mut = model.apply(
+            params | {"cache": cache}, tok_col[:, None], train=False,
+            decode_index=idx, mutable=["cache"],
+            **({} if pad_len is None else {"pad_len": pad_len}))
+        return (mut["cache"], out[:, 0]), None
+
+    (cache, logits), _ = jax.lax.scan(
+        tick,
+        (cache, jnp.zeros((b, model.cfg.vocab_size), jnp.float32)),
+        (prompts.T, jnp.arange(lp)))
+    return cache, logits
 
 
 def _sample(logits, temperature: float, top_k: int, rng):
@@ -60,8 +95,9 @@ def generate(model, variables, prompt: jax.Array, *,
     samples). Returns [B, Lp + N].
     """
     b, lp = prompt.shape
+    check_decode_geometry(model, lp, max_new_tokens)
     params = {"params": variables["params"]}
-    cache = init_cache(model, variables, b)
+    cache = init_cache(model, b)
 
     # kwarg only when needed: models without ragged-prompt support keep
     # their existing apply signature
@@ -78,18 +114,7 @@ def generate(model, variables, prompt: jax.Array, *,
         )
         return mut["cache"], out[:, 0]                 # logits [B, V]
 
-    # prefill: scan the prompt through the cache, keep the last logits
-    def prefill_tick(carry, xs):
-        cache, _ = carry
-        tok_col, idx = xs
-        cache, logits = step(cache, tok_col, idx)
-        return (cache, logits), None
-
-    (cache, logits), _ = jax.lax.scan(
-        prefill_tick,
-        (cache, jnp.zeros((b, model.cfg.vocab_size), jnp.float32)),
-        (prompt.T, jnp.arange(lp)),
-    )
+    cache, logits = prefill_scan(model, params, cache, prompt, pad_len)
 
     # decode: sample, feed back
     rng = jax.random.PRNGKey(seed)

@@ -46,8 +46,10 @@ class SlotDecoder:
         import jax
         import jax.numpy as jnp
 
-        from kubeflow_tpu.runtime.generate import init_cache
+        from kubeflow_tpu.runtime.generate import (
+            check_decode_geometry, init_cache, prefill_scan)
 
+        check_decode_geometry(model, prompt_len, max_new_tokens)
         self.model = model
         self.variables = variables
         self.S = slots
@@ -60,59 +62,62 @@ class SlotDecoder:
 
         params = {"params": variables["params"]}
 
-        # -- compiled: batch-K prefill (scan K prompts into a fresh
-        #    K-row cache; rows are then scattered into free slots). K is
-        #    a static batch size — one compile per size in _PREFILL_SIZES,
-        #    so an idle-decoder burst prefills together instead of
-        #    paying burst_size serial scans. ----------------------------
+        # -- compiled: batch-K prefill (the ONE prefill implementation,
+        #    shared with generate(): runtime/generate.py prefill_scan).
+        #    K is a static batch size — one compile per size in
+        #    _PREFILL_SIZES, so an idle-decoder burst prefills together
+        #    instead of paying burst_size serial scans. ------------------
         def _prefill(prompts_kp, pad_lens_k):
-            k = prompts_kp.shape[0]
-            cache_k = init_cache(model, variables, k)
-
-            def tick(carry, xs):
-                cache, _ = carry
-                tok_col, idx = xs
-                out, mut = model.apply(
-                    params | {"cache": cache}, tok_col[:, None],
-                    train=False, decode_index=idx, mutable=["cache"],
-                    pad_len=pad_lens_k)
-                return (mut["cache"], out[:, 0]), None
-
-            (cache_k, logits), _ = jax.lax.scan(
-                tick, (cache_k, jnp.zeros((k, cfg_vocab), jnp.float32)),
-                (prompts_kp.T, jnp.arange(self.P)))
-            return cache_k, logits
+            cache_k = init_cache(model, prompts_kp.shape[0])
+            return prefill_scan(model, params, cache_k, prompts_kp,
+                                pad_lens_k)
 
         self._prefill = jax.jit(_prefill)
 
-        # -- compiled: install a prefilled row into slot s ---------------
-        def _install(state, cache1, logits, s, pad_len_val):
-            cache, last, pos, ncol, remaining, out, pads, rng = state
-            cache = jax.tree.map(
-                lambda big, one: jax.lax.dynamic_update_slice(
-                    big, one.astype(big.dtype),
-                    (s,) + (0,) * (big.ndim - 1)),
-                cache, cache1)
-            last = jax.lax.dynamic_update_slice(last, logits[None], (s, 0))
-            pos = _set1(jnp, pos, s, self.P)
-            ncol = _set1(jnp, ncol, s, 0)
-            remaining = _set1(jnp, remaining, s, self.N)
-            out = jax.lax.dynamic_update_slice(
-                out, jnp.zeros((1, self.N), jnp.int32), (s, 0))
-            pads = _set1(jnp, pads, s, pad_len_val)
-            return (cache, last, pos, ncol, remaining, out, pads, rng)
+        # -- compiled: install K prefilled rows into K slots in ONE
+        #    program (K static, unrolled; slot ids traced) --------------
+        def _install(state, cache_k, logits_k, slots_k, pads_k):
+            cache, last, pos, remaining, out, pads, rng = state
+            k = logits_k.shape[0]
+            for i in range(k):  # static unroll: K is a compile-time size
+                si = slots_k[i]
+                cache = jax.tree.map(
+                    lambda big, kk, i=i, si=si: jax.lax.dynamic_update_slice(
+                        big, kk[i:i + 1].astype(big.dtype),
+                        (si,) + (0,) * (big.ndim - 1)),
+                    cache, cache_k)
+                last = jax.lax.dynamic_update_slice(
+                    last, logits_k[i][None], (si, 0))
+                pos = _set1(jnp, pos, si, self.P)
+                remaining = _set1(jnp, remaining, si, self.N)
+                out = jax.lax.dynamic_update_slice(
+                    out, jnp.zeros((1, self.N), jnp.int32), (si, 0))
+                pads = _set1(jnp, pads, si, pads_k[i])
+            return (cache, last, pos, remaining, out, pads, rng)
 
         self._install = jax.jit(_install, donate_argnums=(0,))
 
+        # -- compiled: deactivate slots (dummy prefill targets) ----------
+        def _clear_slots(state, slots_k):
+            cache, last, pos, remaining, out, pads, rng = state
+            clear = (jnp.arange(self.S)[:, None]
+                     == slots_k[None, :]).any(axis=1)
+            remaining = jnp.where(clear, 0, remaining)
+            return (cache, last, pos, remaining, out, pads, rng)
+
+        self._clear_slots = jax.jit(_clear_slots, donate_argnums=(0,))
+
         # -- compiled: one lockstep decode tick for all S slots ----------
         def _step(state):
-            cache, last, pos, ncol, remaining, out, pads, rng = state
+            cache, last, pos, remaining, out, pads, rng = state
             from kubeflow_tpu.runtime.generate import _sample
 
             active = remaining > 0
             rng, sub = jax.random.split(rng)
             tok = _sample(last, temperature, top_k, sub)
             # record the sampled token at each active slot's next column
+            # (column index = tokens generated so far = N - remaining)
+            ncol = self.N - remaining
             hot = (jnp.arange(self.N)[None, :] == ncol[:, None]) \
                 & active[:, None]
             out = jnp.where(hot, tok[:, None], out)
@@ -124,25 +129,27 @@ class SlotDecoder:
                 params | {"cache": cache}, tok[:, None], train=False,
                 decode_index=pos, mutable=["cache"], pad_len=pads)
             pos = jnp.where(active, pos + 1, pos)
-            ncol = jnp.where(active, ncol + 1, ncol)
             remaining = jnp.where(active, remaining - 1, remaining)
             last = jnp.where(active[:, None], logits_next[:, 0], last)
-            return (mut["cache"], last, pos, ncol, remaining, out, pads,
-                    rng), active
+            return (mut["cache"], last, pos, remaining, out, pads, rng)
 
         self._step = jax.jit(_step, donate_argnums=(0,))
 
-        # -- device state ------------------------------------------------
-        self.state = (
-            init_cache(model, variables, self.S),
-            jnp.zeros((self.S, cfg_vocab), jnp.float32),
-            jnp.zeros((self.S,), jnp.int32),            # pos
-            jnp.zeros((self.S,), jnp.int32),            # ncol
-            jnp.zeros((self.S,), jnp.int32),            # remaining
-            jnp.zeros((self.S, self.N), jnp.int32),     # out
-            jnp.zeros((self.S,), jnp.int32),            # pad_len
-            jax.random.PRNGKey(seed),
-        )
+        # -- device state (rebuildable: a failed donated call leaves the
+        #    old buffers dead, so recovery re-creates from scratch) ------
+        def _fresh_state():
+            return (
+                init_cache(model, self.S),
+                jnp.zeros((self.S, cfg_vocab), jnp.float32),
+                jnp.zeros((self.S,), jnp.int32),            # pos
+                jnp.zeros((self.S,), jnp.int32),            # remaining
+                jnp.zeros((self.S, self.N), jnp.int32),     # out
+                jnp.zeros((self.S,), jnp.int32),            # pad_len
+                jax.random.PRNGKey(seed),
+            )
+
+        self._fresh_state = _fresh_state
+        self.state = _fresh_state()
         # prefill batch sizes we're willing to compile (smallest >= the
         # waiting count is used; idle bursts prefill together)
         self._PREFILL_SIZES = tuple(sorted(
@@ -206,89 +213,113 @@ class SlotDecoder:
         jnp = self._jnp
         owners: dict[int, tuple[threading.Event, list]] = {}
         ctx = self.mesh if self.mesh is not None else None
+
+        def fail_all(err, batch=()):
+            """Poison every waiter and REBUILD device state: after a
+            failed donated call the old buffers are dead — continuing on
+            them would turn the decoder into a zombie that errors every
+            future request while still accepting submits."""
+            for _p, _pad, ev, sink in batch:
+                sink.append(err)
+                ev.set()
+            for s_, (ev, sink) in list(owners.items()):
+                sink.append(err)
+                ev.set()
+            owners.clear()
+            self._free = list(range(self.S))
+            self.state = self._fresh_state()
+
         while not self._stop:
             try:
                 # admit pending requests into free slots (step boundary).
                 # Idle decoder: take a BATCH of waiting prompts (padded
                 # up to the next supported prefill size) so an idle
-                # burst prefills together instead of serially. Anything
-                # mid-generation: admit at most ONE per tick — a burst
-                # must not stall in-flight decodes.
+                # burst prefills together. Anything mid-generation:
+                # admit at most ONE per tick — a burst must not stall
+                # in-flight decodes.
                 if self._free and not self._pending.empty():
                     want = 1 if owners else len(self._free)
                     batch = []
                     while len(batch) < want and not self._pending.empty():
                         batch.append(self._pending.get_nowait())
-                    k = next(n for n in self._PREFILL_SIZES
-                             if n >= len(batch))
-                    prompts = np.zeros((k, self.P), np.int32)
-                    pads = np.zeros((k,), np.int32)
-                    bad = []
-                    for i, (prompt, pad, ev, sink) in enumerate(batch):
-                        try:
-                            # a wrong-length row (submit_padded trusts its
-                            # caller) must fail THAT caller, not poison
-                            # the batch or hang anyone on a never-set event
+                    # validate rows FIRST; a wrong-length row (the
+                    # submit_padded caller's bug) fails THAT caller only
+                    # and never enters the batch, so row indices below
+                    # stay aligned with the prefill outputs
+                    valid = []
+                    for prompt, pad, ev, sink in batch:
+                        if prompt.shape != (self.P,):
+                            sink.append(ValueError(
+                                f"padded row must have length {self.P}, "
+                                f"got {prompt.shape}"))
+                            ev.set()
+                        else:
+                            valid.append((prompt, pad, ev, sink))
+                    batch = valid
+                    if batch:
+                        k = next(n for n in self._PREFILL_SIZES
+                                 if n >= len(batch))
+                        prompts = np.zeros((k, self.P), np.int32)
+                        pads = np.zeros((k,), np.int32)
+                        for i, (prompt, pad, _ev, _sink) in enumerate(batch):
                             prompts[i] = prompt
                             pads[i] = pad
-                        except ValueError as e:
-                            prompts[i] = 0
-                            sink.append(e)
-                            ev.set()
-                            bad.append(i)
-                    batch = [m for i, m in enumerate(batch)
-                             if i not in bad]
-                    if not batch:
-                        continue
-                    try:
-                        with (ctx or contextlib.nullcontext()):
-                            cache_k, logits_k = self._prefill(
-                                jnp.asarray(prompts), jnp.asarray(pads))
-                    except Exception as e:  # whole batch fails together
-                        for _p, _pad, ev, sink in batch:
-                            sink.append(e)
-                            ev.set()
-                    else:
-                        for i, (_p, pad, ev, sink) in enumerate(batch):
-                            s = self._free.pop()
-                            try:
-                                with (ctx or contextlib.nullcontext()):
-                                    row = self._jax.tree.map(
-                                        lambda a, i=i: a[i:i + 1], cache_k)
-                                    self.state = self._install(
-                                        self.state, row, logits_k[i],
-                                        jnp.asarray(s, jnp.int32),
-                                        jnp.asarray(pad, jnp.int32))
-                                owners[s] = (ev, sink)
-                            except Exception as e:  # this row only
-                                self._free.append(s)
-                                sink.append(e)
-                                ev.set()
+                        slots = [self._free.pop()
+                                 for _ in range(len(batch))]
+                        # dummy rows (k > len(batch)) target REMAINING
+                        # free slots: they hold no generation, and any
+                        # future real install fully overwrites the row.
+                        # Idle admission guarantees enough free slots
+                        # (batch <= free == S >= k); active admission is
+                        # always k == batch == 1.
+                        dummies = self._free[:k - len(slots)]
+                        pad_slots = slots + dummies
+                        assert len(pad_slots) == k, (k, slots, dummies)
+                        try:
+                            with (ctx or contextlib.nullcontext()):
+                                cache_k, logits_k = self._prefill(
+                                    jnp.asarray(prompts), jnp.asarray(pads))
+                                new_state = self._install(
+                                    self.state, cache_k, logits_k,
+                                    jnp.asarray(pad_slots, jnp.int32),
+                                    jnp.asarray(pads))
+                        except Exception as e:
+                            self._free.extend(slots)
+                            fail_all(e, batch)
+                        else:
+                            self.state = new_state
+                            # dummy installs left remaining>0 on their
+                            # free slots: zero them so the step loop
+                            # never decodes an unowned slot
+                            if dummies:
+                                self.state = self._clear_slots(
+                                    self.state,
+                                    jnp.asarray(dummies, jnp.int32))
+                            for s_, (prompt, pad, ev, sink) in zip(
+                                    slots, batch):
+                                owners[s_] = (ev, sink)
                 self._active = len(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
                     continue
                 with (ctx or contextlib.nullcontext()):
-                    self.state, was_active = self._step(self.state)
-                remaining = np.asarray(self.state[4])
+                    self.state = self._step(self.state)
+                remaining = np.asarray(self.state[3])
                 out = None
-                for s in list(owners):
-                    if remaining[s] <= 0:
+                for s_ in list(owners):
+                    if remaining[s_] <= 0:
                         if out is None:  # one readback per tick, lazily
-                            out = np.asarray(self.state[5])
-                        ev, sink = owners.pop(s)
-                        sink.extend(int(t) for t in out[s])
+                            out = np.asarray(self.state[4])
+                        ev, sink = owners.pop(s_)
+                        sink.extend(int(t) for t in out[s_])
                         ev.set()
-                        self._free.append(s)
+                        self._free.append(s_)
                 self._active = len(owners)
-            except Exception as e:  # a broken step poisons all waiters
+            except Exception as e:  # a broken step: poison + rebuild
                 log.exception("slot-decoder loop failed")
-                for s, (ev, sink) in list(owners.items()):
-                    sink.append(e)
-                    ev.set()
-                    self._free.append(s)
-                owners.clear()
+                fail_all(e)
+                self._active = 0
         # shutdown: fail any stragglers
         for ev, sink in list(owners.values()):
             sink.append(RuntimeError("decoder shut down"))

@@ -295,3 +295,95 @@ def test_serve_bench_tool_runs_both_modes():
     assert {d["mode"] for d in lines} == {"micro", "continuous"}
     for d in lines:
         assert d["tokens_per_sec"] > 0 and d["p50_ms"] > 0
+
+
+class TestFailureContainment:
+    """The high-effort decode review's findings, pinned."""
+
+    def test_malformed_row_in_burst_fails_only_its_caller(self, lm):
+        """A wrong-length submit_padded row must fail THAT caller; valid
+        co-batched requests get THEIR OWN continuations (row/prefill
+        alignment survives the drop)."""
+        from kubeflow_tpu.serving.continuous import SlotDecoder
+
+        model, variables = lm
+        dec = SlotDecoder(model, variables, slots=4, prompt_len=8,
+                          max_new_tokens=3)
+        try:
+            held, dec._free = dec._free, []  # queue the burst together
+            results: dict = {}
+
+            def good(i):
+                results[i] = dec.submit([i + 1, i + 2])
+
+            def bad():
+                try:
+                    dec.submit_padded([1, 2, 3], 0)  # wrong length
+                    results["bad"] = "no error"
+                except ValueError:
+                    results["bad"] = "valueerror"
+
+            threads = [threading.Thread(target=bad)] + [
+                threading.Thread(target=good, args=(i,)) for i in range(3)]
+            for t in threads:
+                t.start()
+            import time as _time
+
+            _time.sleep(0.3)
+            dec._free = held
+            for t in threads:
+                t.join(timeout=120)
+            assert results["bad"] == "valueerror"
+            for i in range(3):
+                assert results[i] == reference_generate(
+                    model, variables, [i + 1, i + 2], max_new=3), i
+        finally:
+            dec.close()
+
+    def test_step_failure_recovers_instead_of_zombie(self, lm):
+        """A runtime failure in the donated step poisons in-flight
+        requests ONCE and the decoder rebuilds: later submits succeed
+        (no permanent zombie serving errors forever)."""
+        from kubeflow_tpu.serving.continuous import SlotDecoder
+
+        model, variables = lm
+        dec = SlotDecoder(model, variables, slots=2, prompt_len=8,
+                          max_new_tokens=3)
+        try:
+            real_step = dec._step
+            blew = []
+
+            def exploding_step(state):
+                if not blew:
+                    blew.append(1)
+                    # simulate the donation: the failed call consumed
+                    # the input buffers before dying
+                    import jax
+
+                    jax.tree.map(lambda a: a.delete(), state)
+                    raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
+                return real_step(state)
+
+            dec._step = exploding_step
+            with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+                dec.submit([1, 2, 3])
+            # rebuilt: the very next request decodes correctly
+            assert dec.submit([1, 2, 3]) == reference_generate(
+                model, variables, [1, 2, 3], max_new=3)
+        finally:
+            dec.close()
+
+    def test_geometry_past_max_seq_len_is_refused(self, lm):
+        from kubeflow_tpu.serving.continuous import SlotDecoder
+
+        model, variables = lm  # max_seq_len = 16
+        with pytest.raises(ValueError, match="max_seq_len"):
+            SlotDecoder(model, variables, slots=2, prompt_len=12,
+                        max_new_tokens=8)
+        import jax.numpy as jnp
+
+        from kubeflow_tpu.runtime.generate import generate
+
+        with pytest.raises(ValueError, match="max_seq_len"):
+            generate(model, variables, jnp.ones((1, 12), jnp.int32),
+                     max_new_tokens=8)

@@ -53,7 +53,7 @@ def test_sampling_is_seeded_and_in_range():
 
 def test_gqa_cache_shapes():
     model, variables = make_model_and_params()
-    cache = init_cache(model, variables, batch=3)
+    cache = init_cache(model, batch=3)
     leaves = jax.tree.leaves(cache)
     assert leaves, "no cache variables created"
     for leaf in leaves:
@@ -80,7 +80,7 @@ def test_left_padded_prompt_with_pad_len_matches_unpadded():
     # and WITHOUT the mask the pads leak into attention: the decode
     # logits differ (argmax may coincide on a tiny model, logits won't)
     def last_logits(pad_len):
-        cache = init_cache(model, variables, 1)
+        cache = init_cache(model, 1)
         kw = {} if pad_len is None else {"pad_len": pad_len}
         logits = None
         for i in range(padded.shape[1]):
