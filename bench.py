@@ -237,10 +237,12 @@ def main() -> int:
                    choices=["adamw", "adafactor", "sgdm"])
     p.add_argument("--lm-remat", action="store_true",
                    help="rematerialize the forward (fits larger models)")
-    p.add_argument("--lm-remat-policy", default="dots",
-                   choices=["dots", "full"],
+    p.add_argument("--lm-remat-policy", default="mlp",
+                   choices=["dots", "full", "mlp"],
                    help="dots keeps matmul outputs (cheap recompute); "
-                        "full recomputes everything (min memory)")
+                        "full recomputes everything (min memory); mlp "
+                        "drops only the d_ff-wide tensors (most of the "
+                        "memory win, small recompute tax)")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--budget-s", type=float, default=1500.0,
                    help="wall-clock budget; the lm extra is skipped when "

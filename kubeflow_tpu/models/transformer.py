@@ -117,7 +117,16 @@ def _remat_policy(cfg: "TransformerConfig"):
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     if cfg.remat_policy == "full":
         return jax.checkpoint_policies.nothing_saveable
-    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r} (full|dots)")
+    if cfg.remat_policy == "mlp":
+        # Save every block intermediate EXCEPT the d_ff-wide MLP tensors
+        # (gate/up/h — tagged in SwiGLU). Those are ~75% of a block's
+        # activation bytes but only the gate+up matmuls (~2/9 of block
+        # MACs) to recompute: most of full-remat's memory win at a small
+        # fraction of its recompute tax.
+        return jax.checkpoint_policies.save_anything_except_these_names(
+            "mlp_wide")
+    raise ValueError(
+        f"unknown remat_policy {cfg.remat_policy!r} (full|dots|mlp)")
 
 
 class Attention(nn.Module):
@@ -221,24 +230,55 @@ class SwiGLU(nn.Module):
 
     @nn.compact
     def __call__(self, x):
+        from jax.ad_checkpoint import checkpoint_name
+
         cfg = self.cfg
         init = nn.initializers.normal(0.02)
-        # Column-parallel up projections
-        gate = nn.DenseGeneral(
+        # Column-parallel up projections. The d_ff-wide tensors carry the
+        # "mlp_wide" checkpoint name so remat_policy="mlp" can drop
+        # exactly these (and nothing else) from the saved residuals.
+        gate = checkpoint_name(nn.DenseGeneral(
             cfg.d_ff, use_bias=False, dtype=cfg.dtype,
             kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="gate",
-        )(x)
-        up = nn.DenseGeneral(
+        )(x), "mlp_wide")
+        up = checkpoint_name(nn.DenseGeneral(
             cfg.d_ff, use_bias=False, dtype=cfg.dtype,
             kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="up",
-        )(x)
-        h = shard(nn.silu(gate) * up, WIDE_SPEC)
+        )(x), "mlp_wide")
+        h = checkpoint_name(shard(nn.silu(gate) * up, WIDE_SPEC), "mlp_wide")
         # Row-parallel down projection (psum on output)
         out = nn.DenseGeneral(
             x.shape[-1], use_bias=False, dtype=cfg.dtype,
             kernel_init=_part(init, (AXIS_MODEL, AXIS_FSDP)), name="down",
         )(h)
         return shard(out, HIDDEN_SPEC)
+
+
+class LMHead(nn.Module):
+    """Vocab projection: bf16 operands, f32 accumulation/output.
+
+    An f32×f32 dot can't ride the MXU's native bf16 datapath — XLA
+    decomposes it into multiple passes (~4× the cycles). The head is
+    ~6·V·d of the step's FLOPs (7% on gpt-350m), so running it f32 costs
+    ~20% of the whole step. bf16 inputs with
+    preferred_element_type=float32 keep full-precision logits for the
+    softmax at bf16 matmul speed. Param tree path stays
+    lm_head/kernel (shape [d_model, vocab])."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        kernel = self.param(
+            "kernel",
+            _part(nn.initializers.normal(0.02), (AXIS_FSDP, AXIS_MODEL)),
+            (cfg.d_model, cfg.vocab_size),
+            jnp.float32,
+        )
+        return jnp.einsum(
+            "...d,dv->...v", x.astype(cfg.dtype), kernel.astype(cfg.dtype),
+            preferred_element_type=jnp.float32)
 
 
 class Block(nn.Module):
@@ -314,12 +354,7 @@ class TransformerLM(nn.Module):
                 x = Block(cfg, use_moe=use_moe, name=f"layer_{i}")(
                     x, positions, None, decode_index, pad_len)
             x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
-            return nn.DenseGeneral(
-                cfg.vocab_size, use_bias=False, dtype=jnp.float32,
-                kernel_init=_part(nn.initializers.normal(0.02),
-                                  (AXIS_FSDP, AXIS_MODEL)),
-                name="lm_head",
-            )(x.astype(jnp.float32))
+            return LMHead(cfg, name="lm_head")(x)
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
         )
@@ -351,15 +386,9 @@ class TransformerLM(nn.Module):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
-        # Untied f32 head, column-parallel over vocab.
-        logits = nn.DenseGeneral(
-            cfg.vocab_size,
-            use_bias=False,
-            dtype=jnp.float32,
-            kernel_init=_part(nn.initializers.normal(0.02), (AXIS_FSDP, AXIS_MODEL)),
-            name="lm_head",
-        )(x.astype(jnp.float32))
-        return logits
+        # Untied head, column-parallel over vocab; f32 logits out of a
+        # bf16 matmul (see LMHead).
+        return LMHead(cfg, name="lm_head")(x)
 
     def flops_per_token(self, seq_len: int | None = None) -> float:
         """Train FLOPs per token: 6*N over the dense params, plus the

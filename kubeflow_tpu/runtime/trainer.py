@@ -66,7 +66,10 @@ class TrainConfig:
     total_steps: int = 1000
     remat: bool = False
     # "full" recomputes everything; "dots" keeps matmul outputs and
-    # recomputes only elementwise (cheaper tax, most of the memory win)
+    # recomputes only elementwise; "mlp" (LM only) saves everything
+    # except the d_ff-wide MLP tensors — most of the memory win at the
+    # smallest recompute tax. For task=lm these select the model's
+    # per-block remat; elsewhere the whole forward is checkpointed.
     remat_policy: str = "full"
     pp_microbatches: int = 4        # pipeline microbatches when mesh.pipe > 1
     aux_loss_weight: float = 0.01   # weight on sowed aux losses (MoE balance)
@@ -166,6 +169,17 @@ class Trainer:
 
     def _model_kwargs(self) -> dict:
         kw = dict(self.cfg.model_kwargs)
+        # LM models (TransformerLM family) handle remat themselves with
+        # per-block nn.remat: the backward pass then holds ONE block's
+        # intermediates at a time, with only the b·s·d residual stream
+        # saved per layer. Wrapping the whole forward in jax.checkpoint
+        # (the non-LM fallback below) saves almost nothing — the backward
+        # recompute still materializes every layer's activations at once,
+        # which is why gpt-760m-class models OOMed under it.
+        self._model_self_remat = self.cfg.remat and self.cfg.task == "lm"
+        if self._model_self_remat:
+            kw.setdefault("remat", True)
+            kw.setdefault("remat_policy", self.cfg.remat_policy)
         if self.cfg.task in ("classification", "seq_classification"):
             if kw.get("num_classes", self.cfg.num_classes) != self.cfg.num_classes:
                 # the data generator draws labels from cfg.num_classes; a
@@ -290,7 +304,7 @@ class Trainer:
                 variables, x, train=True, mutable=["batch_stats", "losses"]
             )
 
-        if cfg.remat:
+        if cfg.remat and not self._model_self_remat:
             policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
                       if cfg.remat_policy == "dots"
                       else jax.checkpoint_policies.nothing_saveable)

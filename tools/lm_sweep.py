@@ -1,0 +1,137 @@
+"""LM perf sweep runner: measure every queued operating point, record
+EVERY outcome (including OOMs) to tools/lm_sweep.log, promote the best.
+
+Replaces the original lm_sweep.sh loop, whose `2>/dev/null | tail -1`
+silently dropped failed points: `bench.py --workload lm` re-raises on
+failure (bench.py main: workload=="lm" has no error-JSON fallback), so an
+OOM produced no stdout and the log recorded nothing — the round-2 queue
+looked "unrun" when in fact most points had failed. Here each point
+appends one JSON line: bench's own output on success, or
+{"point": ..., "rc": ..., "oom": ..., "error": <stderr tail>} on failure,
+so the ledger distinguishes "didn't fit" from "never measured".
+
+Usage: python tools/lm_sweep.py [--log PATH] [--timeout SECS]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+# The queue. Ordered so the validation points (do the round-3 model/remat
+# changes reproduce and beat the round-2 ledger?) run before the
+# larger-model frontier, and kernel block tuning runs last on a known-
+# good config. Every point uses adafactor: round 2 established that
+# adamw's 8 bytes/param optimizer state is what OOMs larger-than-350m
+# models on one 16 GB v5e (BASELINE.md).
+POINTS: list[dict] = [
+    # -- validation: round-2 best, now with the bf16-matmul LM head
+    dict(model="gpt-350m", batch=8),
+    # -- bigger batch via selective remat (d_ff-wide tensors dropped)
+    dict(model="gpt-350m", batch=16, remat="mlp"),
+    dict(model="gpt-350m", batch=32, remat="mlp"),
+    # -- gpt-760m frontier: arithmetic intensity grows with d_model
+    dict(model="gpt-760m", batch=8, remat="mlp"),
+    dict(model="gpt-760m", batch=16, remat="mlp"),
+    dict(model="gpt-760m", batch=16, remat="full"),
+    dict(model="gpt-760m", batch=32, remat="full"),
+    # -- llama-1b: the judge's round-3 target class
+    dict(model="llama-1b", batch=8, remat="mlp"),
+    dict(model="llama-1b", batch=16, remat="mlp"),
+    dict(model="llama-1b", batch=16, remat="full"),
+    dict(model="llama-1b", batch=32, remat="full"),
+]
+
+# Flash-attention block grid, applied to the best point found above.
+BLOCK_GRID = [(256, 256), (256, 512), (512, 256), (512, 512), (128, 256)]
+
+
+def bench_cmd(point: dict) -> list[str]:
+    cmd = [sys.executable, "bench.py", "--workload", "lm",
+           "--lm-model", point["model"],
+           "--lm-batch", str(point["batch"]),
+           "--lm-optimizer", point.get("optimizer", "adafactor")]
+    if point.get("remat"):
+        cmd += ["--lm-remat", "--lm-remat-policy", point["remat"]]
+    return cmd
+
+
+def run_point(point: dict, log, timeout: float, env=None) -> dict | None:
+    """Run one bench point; append its outcome line; return the lm dict
+    on success."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.run(
+            bench_cmd(point), cwd=REPO, timeout=timeout,
+            capture_output=True, text=True,
+            env={**os.environ, **(env or {})})
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc, out = -1, (e.stdout or "")
+        err = (e.stderr or "") + f"\n[timeout after {timeout:.0f}s]"
+    secs = round(time.monotonic() - t0, 1)
+    last = out.strip().splitlines()[-1] if out.strip() else ""
+    record: dict | None = None
+    if rc == 0 and last.startswith("{"):
+        try:
+            record = json.loads(last)
+        except ValueError:
+            record = None
+    if record is not None:
+        record["sweep_secs"] = secs
+        log.write(json.dumps(record) + "\n")
+        log.flush()
+        return record.get("lm")
+    oom = "RESOURCE_EXHAUSTED" in err or "Out of memory" in err
+    log.write(json.dumps({
+        "point": point, "rc": rc, "secs": secs, "oom": oom,
+        "error": err.strip()[-400:],
+    }) + "\n")
+    log.flush()
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--log", default=os.path.join(HERE, "lm_sweep.log"))
+    ap.add_argument("--timeout", type=float, default=900.0)
+    ap.add_argument("--skip-blocks", action="store_true",
+                    help="skip the flash block grid stage")
+    args = ap.parse_args()
+
+    best: dict | None = None
+    best_point: dict | None = None
+    with open(args.log, "a") as log:
+        log.write(json.dumps({"sweep_start": time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.gmtime())}) + "\n")
+        for point in POINTS:
+            print("point:", point, flush=True)
+            lm = run_point(point, log, args.timeout)
+            print("  ->", (f"mfu={lm['mfu']:.4f} {lm['tokens_per_sec']} tok/s"
+                           if lm else "FAILED (see log)"), flush=True)
+            if lm and (best is None or lm["mfu"] > best["mfu"]):
+                best, best_point = lm, point
+        if best_point is not None and not args.skip_blocks:
+            for bq, bk in BLOCK_GRID:
+                print(f"blocks q={bq} k={bk} on {best_point}", flush=True)
+                lm = run_point(best_point, log, args.timeout, env={
+                    "KFTPU_FLASH_BLOCK_Q": str(bq),
+                    "KFTPU_FLASH_BLOCK_K": str(bk)})
+                print("  ->", (f"mfu={lm['mfu']:.4f}" if lm else "FAILED"),
+                      flush=True)
+        log.write(json.dumps({"sweep_done": time.strftime(
+            "%Y-%m-%d %H:%M:%S", time.gmtime())}) + "\n")
+    rc = subprocess.call([sys.executable,
+                          os.path.join(HERE, "promote_best.py"), args.log])
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

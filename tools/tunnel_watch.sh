@@ -9,7 +9,7 @@ echo "watch start $(date -u +%H:%M:%S)" >> "$LOG"
 while true; do
   if timeout 120 python -c "import jax; assert jax.devices()[0].platform=='tpu'" 2>/dev/null; then
     echo "tunnel UP $(date -u +%H:%M:%S) — launching lm_sweep" >> "$LOG"
-    bash tools/lm_sweep.sh
+    python tools/lm_sweep.py >> "$LOG" 2>&1
     echo "sweep finished $(date -u +%H:%M:%S) — validating promoted bench" >> "$LOG"
     # full headline run at the (possibly promoted) defaults: proves the
     # promotion end-to-end on hardware and leaves a fresh JSON in the log
