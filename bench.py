@@ -132,6 +132,7 @@ def run_lm(args, devs):
         warmup_steps=5,
         remat=args.lm_remat,
         remat_policy=args.lm_remat_policy,
+        xent_chunks=args.lm_xent_chunks,
         log_every=10**9,
     ))
     trainer = Trainer(cfg)
@@ -158,6 +159,7 @@ def run_lm(args, devs):
         "optimizer": args.lm_optimizer,
         "remat": args.lm_remat,
         "remat_policy": args.lm_remat_policy,
+        "xent_chunks": args.lm_xent_chunks,
         "n_params_m": round(trainer.n_params / 1e6, 1),
     }
     # echo the kernel-tuning env so sweep logs are self-describing and
@@ -171,7 +173,8 @@ def run_lm(args, devs):
 # the operating-point flags: any of these given explicitly disables the
 # promotion file (budget/choice knobs like --lm-min-budget-s do NOT)
 _LM_POINT_FLAGS = ("--lm-model", "--lm-batch", "--lm-optimizer",
-                   "--lm-remat", "--lm-remat-policy", "--lm-attention")
+                   "--lm-remat", "--lm-remat-policy", "--lm-attention",
+                   "--lm-xent-chunks")
 
 
 def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
@@ -198,6 +201,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
         optimizer = str(best.get("optimizer", args.lm_optimizer))
         remat = bool(best.get("remat", args.lm_remat))
         policy = str(best.get("remat_policy", args.lm_remat_policy))
+        xent_chunks = int(best.get("xent_chunks", args.lm_xent_chunks) or 0)
         blocks = {var.upper(): str(best[var])
                   for var in ("kftpu_flash_block_q", "kftpu_flash_block_k")
                   if best.get(var)}
@@ -208,6 +212,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
     args.lm_optimizer = optimizer
     args.lm_remat = remat
     args.lm_remat_policy = policy
+    args.lm_xent_chunks = xent_chunks
     os.environ.update(blocks)
     return "tools/lm_best.json"
 
@@ -243,6 +248,12 @@ def main() -> int:
                         "full recomputes everything (min memory); mlp "
                         "drops only the d_ff-wide tensors (most of the "
                         "memory win, small recompute tax)")
+    p.add_argument("--lm-xent-chunks", type=int, default=0,
+                   help="compute the LM head + cross-entropy in this many "
+                        "sequence chunks (ops/xent.py): the [B, L, V] "
+                        "logits tensor never materializes, freeing GBs of "
+                        "activation memory at large batch; 0 = classic "
+                        "full-logits loss")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--budget-s", type=float, default=1500.0,
                    help="wall-clock budget; the lm extra is skipped when "

@@ -327,7 +327,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, segment_ids=None,
-                 decode_index=None, pad_len=None):
+                 decode_index=None, pad_len=None, return_hidden=False):
         cfg = self.cfg
         del train  # no dropout in the speed-run configuration
         emb = self.param(
@@ -386,6 +386,12 @@ class TransformerLM(nn.Module):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
+        if return_hidden:
+            # Chunked-loss path (ops.xent.chunked_lm_xent): the caller
+            # projects through lm_head/kernel chunk-by-chunk so the
+            # [B, L, V] logits tensor never materializes. LMHead params
+            # still exist (init runs with return_hidden=False).
+            return x
         # Untied head, column-parallel over vocab; f32 logits out of a
         # bf16 matmul (see LMHead).
         return LMHead(cfg, name="lm_head")(x)

@@ -73,6 +73,11 @@ class TrainConfig:
     remat_policy: str = "full"
     pp_microbatches: int = 4        # pipeline microbatches when mesh.pipe > 1
     aux_loss_weight: float = 0.01   # weight on sowed aux losses (MoE balance)
+    # LM only: compute the head + cross-entropy in this many sequence
+    # chunks (ops/xent.py) so the [B, L, V] logits tensor never
+    # materializes — frees GBs of activation memory at large batch.
+    # 0/1 = classic full-logits loss.
+    xent_chunks: int = 0
     seed: int = 0
     log_every: int = 20
     # orbax checkpoint/resume (SURVEY.md §5): async saves + resume-from-
@@ -163,6 +168,9 @@ class Trainer:
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
         log.info("trainer mesh: %s", mesh_summary(self.mesh))
+        # LM models remat per-block inside the model (see _model_kwargs);
+        # everything else gets whole-forward jax.checkpoint in _build.
+        self._model_self_remat = cfg.remat and cfg.task == "lm"
         self.model = get_model(cfg.model, **self._model_kwargs())
         self.tx = make_optimizer(cfg)
         self._build()
@@ -173,10 +181,9 @@ class Trainer:
         # per-block nn.remat: the backward pass then holds ONE block's
         # intermediates at a time, with only the b·s·d residual stream
         # saved per layer. Wrapping the whole forward in jax.checkpoint
-        # (the non-LM fallback below) saves almost nothing — the backward
-        # recompute still materializes every layer's activations at once,
-        # which is why gpt-760m-class models OOMed under it.
-        self._model_self_remat = self.cfg.remat and self.cfg.task == "lm"
+        # (the non-LM fallback in _build) saves almost nothing — the
+        # backward recompute still materializes every layer's activations
+        # at once, which is why gpt-760m-class models OOMed under it.
         if self._model_self_remat:
             kw.setdefault("remat", True)
             kw.setdefault("remat_policy", self.cfg.remat_policy)
@@ -305,21 +312,55 @@ class Trainer:
             )
 
         if cfg.remat and not self._model_self_remat:
-            policy = (jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-                      if cfg.remat_policy == "dots"
-                      else jax.checkpoint_policies.nothing_saveable)
+            if cfg.remat_policy == "dots":
+                policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            elif cfg.remat_policy == "full":
+                policy = jax.checkpoint_policies.nothing_saveable
+            else:
+                # "mlp" (and anything else) is a per-block LM policy; a
+                # silent fallback to full recompute here would look like a
+                # mysterious step-time regression instead of a config error
+                raise ValueError(
+                    f"remat_policy {cfg.remat_policy!r} is not supported for "
+                    f"task={cfg.task!r} (whole-forward remat takes dots|full)")
             forward = jax.checkpoint(forward, policy=policy)
+
+        chunked_head = cfg.task == "lm" and cfg.xent_chunks > 1
+        if chunked_head:
+            from kubeflow_tpu.ops.xent import chunked_lm_xent
+
+            # same operand dtype as LMHead's matmul (bf16 on the standard
+            # configs; f32 models stay exact)
+            head_dtype = getattr(
+                getattr(self.model, "cfg", None), "dtype", jnp.bfloat16)
+
+            def forward_hidden(variables, x):
+                return self.model.apply(
+                    variables, x, train=True, return_hidden=True,
+                    mutable=["batch_stats", "losses"])
+
+            def chunked_loss_acc(params, hidden, y):
+                return chunked_lm_xent(
+                    hidden, params["lm_head"]["kernel"], y, cfg.xent_chunks,
+                    compute_dtype=head_dtype)
 
         def loss_fn(params, batch_stats, batch):
             variables = {"params": params, **({"batch_stats": batch_stats} if batch_stats else {})}
             x, y = _batch_xy(cfg, batch)
-            logits, new_vars = forward(variables, x)
-            loss = _xent_loss(logits, y)
+            if chunked_head:
+                # Head + loss chunked over sequence (ops/xent.py): the
+                # [B, L, V] logits tensor never materializes; lm_head
+                # kernel grads flow through the chunk scan directly.
+                hidden, new_vars = forward_hidden(variables, x)
+                loss, acc = chunked_loss_acc(params, hidden, y)
+            else:
+                logits, new_vars = forward(variables, x)
+                loss = _xent_loss(logits, y)
+                acc = (logits.argmax(-1) == y).mean()
             # auxiliary losses sowed by modules (e.g. MoE load balancing)
             aux_leaves = jax.tree.leaves(new_vars.get("losses", {}))
             if aux_leaves:
                 loss = loss + cfg.aux_loss_weight * sum(a.mean() for a in aux_leaves)
-            acc = (logits.argmax(-1) == y).mean()
             return loss, (new_vars.get("batch_stats", {}), acc)
 
         def train_step(state: TrainState, batch):
@@ -342,6 +383,13 @@ class Trainer:
             variables = {"params": state.params,
                          **({"batch_stats": state.batch_stats} if state.batch_stats else {})}
             x, y = _batch_xy(cfg, batch)
+            if chunked_head:
+                # a config that only FITS because training chunks the head
+                # must not OOM on its first eval
+                hidden = self.model.apply(variables, x, train=False,
+                                          return_hidden=True)
+                loss, acc = chunked_loss_acc(state.params, hidden, y)
+                return {"loss": loss, "accuracy": acc}
             logits = self.model.apply(variables, x, train=False)
             return {"loss": _xent_loss(logits, y), "accuracy": (logits.argmax(-1) == y).mean()}
 

@@ -57,10 +57,19 @@ def test_studyjob_example_is_schedulable():
     assert JT._validate_tpu_topology(spec["trialTemplate"]["spec"]) == []
 
 
-def test_sweep_script_is_valid_bash():
-    rc = subprocess.run(["bash", "-n", os.path.join(HERE, "tools",
-                                                    "lm_sweep.sh")])
-    assert rc.returncode == 0
+def test_sweep_queue_builds_valid_bench_commands():
+    """Every queued sweep point must translate to a bench.py invocation
+    whose flags bench.py actually defines (the queue and the CLI drift
+    independently)."""
+    from tools.lm_sweep import BLOCK_GRID, POINTS, bench_cmd
+
+    src = open(os.path.join(HERE, "bench.py")).read()
+    for point in POINTS + [dict(POINTS[0], xent_chunks=8)]:
+        cmd = bench_cmd(point)
+        assert cmd[1] == "bench.py"
+        for flag in [a for a in cmd[2:] if a.startswith("--")]:
+            assert f'"{flag}"' in src, f"{flag} not a bench.py flag"
+    assert all(len(pair) == 2 for pair in BLOCK_GRID)
 
 
 def test_multislice_example_validates_and_builds_mesh():
@@ -145,7 +154,7 @@ class TestLmPromotion:
             return argparse.Namespace(
                 lm_best="auto", lm_model="gpt-350m", lm_batch=8,
                 lm_optimizer="adafactor", lm_remat=False,
-                lm_remat_policy="dots")
+                lm_remat_policy="dots", lm_xent_chunks=0)
 
         monkeypatch.delenv("KFTPU_FLASH_BLOCK_Q", raising=False)
         args = mkargs()

@@ -49,6 +49,21 @@ POINTS: list[dict] = [
     dict(model="llama-1b", batch=32, remat="full"),
 ]
 
+# Phase 2 (--phase2): chunked head cross-entropy (ops/xent.py). Phase-1
+# hardware showed every batch>=16 point OOMs on the [B, L, V] logits +
+# dlogits pair (4.2 GB at bs16) — chunking removes exactly that tensor,
+# so these re-run the failed frontier with xent_chunks=8.
+PHASE2_POINTS: list[dict] = [
+    dict(model="gpt-350m", batch=16, remat="mlp", xent_chunks=8),
+    dict(model="gpt-350m", batch=32, remat="mlp", xent_chunks=8),
+    dict(model="gpt-350m", batch=16, xent_chunks=8),
+    dict(model="gpt-760m", batch=16, remat="mlp", xent_chunks=8),
+    dict(model="gpt-760m", batch=32, remat="mlp", xent_chunks=8),
+    dict(model="llama-1b", batch=16, remat="mlp", xent_chunks=8),
+    dict(model="llama-1b", batch=32, remat="mlp", xent_chunks=8),
+    dict(model="llama-1b", batch=32, remat="full", xent_chunks=8),
+]
+
 # Flash-attention block grid, applied to the best point found above.
 BLOCK_GRID = [(256, 256), (256, 512), (512, 256), (512, 512), (128, 256)]
 
@@ -60,6 +75,8 @@ def bench_cmd(point: dict) -> list[str]:
            "--lm-optimizer", point.get("optimizer", "adafactor")]
     if point.get("remat"):
         cmd += ["--lm-remat", "--lm-remat-policy", point["remat"]]
+    if point.get("xent_chunks"):
+        cmd += ["--lm-xent-chunks", str(point["xent_chunks"])]
     return cmd
 
 
@@ -89,10 +106,17 @@ def run_point(point: dict, log, timeout: float, env=None) -> dict | None:
         log.write(json.dumps(record) + "\n")
         log.flush()
         return record.get("lm")
-    oom = "RESOURCE_EXHAUSTED" in err or "Out of memory" in err
+    # Allocation-dump markers too: the axon backend's OOM detail can be
+    # pages long and the canonical keyword scrolls out of any fixed tail.
+    oom = any(m in err for m in (
+        "RESOURCE_EXHAUSTED", "Out of memory", "Allocation type: HLO temp",
+        "exceeds the memory available"))
+    # bench.py's fail-fast paths (e.g. dead tunnel) print their error
+    # JSON to STDOUT and leave stderr empty — keep both tails so the
+    # ledger stays actionable for every failure mode.
     log.write(json.dumps({
         "point": point, "rc": rc, "secs": secs, "oom": oom,
-        "error": err.strip()[-400:],
+        "error": err.strip()[-400:] or out.strip()[-400:],
     }) + "\n")
     log.flush()
     return None
@@ -104,6 +128,8 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--skip-blocks", action="store_true",
                     help="skip the flash block grid stage")
+    ap.add_argument("--phase2", action="store_true",
+                    help="run the chunked-xent PHASE2_POINTS queue instead")
     args = ap.parse_args()
 
     best: dict | None = None
@@ -111,7 +137,7 @@ def main() -> int:
     with open(args.log, "a") as log:
         log.write(json.dumps({"sweep_start": time.strftime(
             "%Y-%m-%d %H:%M:%S", time.gmtime())}) + "\n")
-        for point in POINTS:
+        for point in (PHASE2_POINTS if args.phase2 else POINTS):
             print("point:", point, flush=True)
             lm = run_point(point, log, args.timeout)
             print("  ->", (f"mfu={lm['mfu']:.4f} {lm['tokens_per_sec']} tok/s"

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # Probe the axon TPU tunnel every ~10 min; when it answers, run the queued
-# LM sweep (tools/lm_sweep.sh) exactly once and exit. Writes status lines to
+# LM sweep (tools/lm_sweep.py) exactly once and exit. Writes status lines to
 # tools/tunnel_watch.log so the foreground session can see what happened.
 set -u
 cd "$(dirname "$0")/.."
