@@ -133,6 +133,7 @@ def run_lm(args, devs):
         remat=args.lm_remat,
         remat_policy=args.lm_remat_policy,
         xent_chunks=args.lm_xent_chunks,
+        grad_accum_steps=args.lm_grad_accum,
         log_every=10**9,
     ))
     trainer = Trainer(cfg)
@@ -160,6 +161,7 @@ def run_lm(args, devs):
         "remat": args.lm_remat,
         "remat_policy": args.lm_remat_policy,
         "xent_chunks": args.lm_xent_chunks,
+        "grad_accum": args.lm_grad_accum,
         "n_params_m": round(trainer.n_params / 1e6, 1),
     }
     # echo the kernel-tuning env so sweep logs are self-describing and
@@ -174,7 +176,7 @@ def run_lm(args, devs):
 # promotion file (budget/choice knobs like --lm-min-budget-s do NOT)
 _LM_POINT_FLAGS = ("--lm-model", "--lm-batch", "--lm-optimizer",
                    "--lm-remat", "--lm-remat-policy", "--lm-attention",
-                   "--lm-xent-chunks")
+                   "--lm-xent-chunks", "--lm-grad-accum")
 
 
 def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
@@ -202,6 +204,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
         remat = bool(best.get("remat", args.lm_remat))
         policy = str(best.get("remat_policy", args.lm_remat_policy))
         xent_chunks = int(best.get("xent_chunks", args.lm_xent_chunks) or 0)
+        grad_accum = int(best.get("grad_accum", args.lm_grad_accum) or 0)
         blocks = {var.upper(): str(best[var])
                   for var in ("kftpu_flash_block_q", "kftpu_flash_block_k")
                   if best.get(var)}
@@ -213,6 +216,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
     args.lm_remat = remat
     args.lm_remat_policy = policy
     args.lm_xent_chunks = xent_chunks
+    args.lm_grad_accum = grad_accum
     os.environ.update(blocks)
     return "tools/lm_best.json"
 
@@ -254,6 +258,10 @@ def main() -> int:
                         "logits tensor never materializes, freeing GBs of "
                         "activation memory at large batch; 0 = classic "
                         "full-logits loss")
+    p.add_argument("--lm-grad-accum", type=int, default=0,
+                   help="split each step into this many microbatches "
+                        "(lax.scan) with one averaged optimizer update; "
+                        "activation memory scales with the microbatch")
     p.add_argument("--seq-len", type=int, default=2048)
     p.add_argument("--budget-s", type=float, default=1500.0,
                    help="wall-clock budget; the lm extra is skipped when "

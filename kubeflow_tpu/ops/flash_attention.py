@@ -36,8 +36,14 @@ except ImportError:  # pragma: no cover
     pltpu = None
     _HAS_PLTPU = False
 
-DEFAULT_BLOCK_Q = 128
-DEFAULT_BLOCK_K = 128
+# Hardware-swept defaults (BASELINE.md round 3): on a v5e, 512x512
+# blocks more than double train MFU vs 128x128 (llama-1b bs16 seq2048:
+# 0.227 -> 0.467) — bigger blocks amortize the per-block HBM re-reads of
+# K/V across 4x more MXU work and still fit VMEM comfortably. Blocks
+# clamp to the sequence length, so short-seq callers are unaffected;
+# override per-run with KFTPU_FLASH_BLOCK_Q/K.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
 NEG_INF = -1e30
 
 
@@ -409,8 +415,15 @@ def flash_attention(
         rep = h // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
+    # Clamp to the sequence, then halve until the block divides it (not
+    # below the 128-lane tile): a 640-token sequence runs at block 128
+    # instead of erroring against the swept 512 default.
     block_q = min(block_q, lq)
+    while block_q > 128 and lq % block_q:
+        block_q //= 2
     block_k = min(block_k, lk)
+    while block_k > 128 and lk % block_k:
+        block_k //= 2
     if lq % block_q or lk % block_k:
         raise ValueError(
             f"sequence lengths ({lq}, {lk}) must be multiples of the block "

@@ -78,6 +78,11 @@ class TrainConfig:
     # materializes — frees GBs of activation memory at large batch.
     # 0/1 = classic full-logits loss.
     xent_chunks: int = 0
+    # Split each step's batch into this many microbatches, lax.scan the
+    # forward+backward over them and apply ONE averaged optimizer update:
+    # activation memory scales with the microbatch while the optimizer
+    # sees the full global batch. 0/1 = single-shot step.
+    grad_accum_steps: int = 0
     seed: int = 0
     log_every: int = 20
     # orbax checkpoint/resume (SURVEY.md §5): async saves + resume-from-
@@ -273,15 +278,18 @@ class Trainer:
 
     # ---- build jitted fns ------------------------------------------------
 
+    def _dp_size(self) -> int:
+        """Ways the batch axis is sharded (dcn * data * fsdp)."""
+        return (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
+                * self.mesh.shape[AXIS_FSDP])
+
     def _init_fn(self, rng):
         batch = self._example_batch()
         x = batch["image"] if self.cfg.task == "classification" else batch["tokens"]
         # Init with one row per data-parallel group: parameter shapes don't
         # depend on batch, but the init forward must still satisfy the
         # batch-axis sharding (ring attention shard_maps over it).
-        dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
-              * self.mesh.shape[AXIS_FSDP])
-        variables = self.model.init(rng, x[:dp], train=True)
+        variables = self.model.init(rng, x[:self._dp_size()], train=True)
         return variables
 
     def _build(self) -> None:
@@ -363,10 +371,35 @@ class Trainer:
                 loss = loss + cfg.aux_loss_weight * sum(a.mean() for a in aux_leaves)
             return loss, (new_vars.get("batch_stats", {}), acc)
 
-        def train_step(state: TrainState, batch):
-            (loss, (new_stats, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                state.params, state.batch_stats, batch
-            )
+        accum = max(1, cfg.grad_accum_steps)
+        if accum > 1:
+            if cfg.global_batch % accum:
+                raise ValueError(
+                    f"global_batch {cfg.global_batch} not divisible by "
+                    f"grad_accum_steps {accum}")
+            dp = self._dp_size()
+            if (cfg.global_batch // accum) % dp:
+                raise ValueError(
+                    f"microbatch {cfg.global_batch // accum} not divisible "
+                    f"by the {dp}-way batch sharding (dcn*data*fsdp)")
+            if (mesh.shape.get(AXIS_PIPELINE, 1) > 1
+                    and (cfg.global_batch // accum) % cfg.pp_microbatches):
+                raise ValueError(
+                    f"microbatch {cfg.global_batch // accum} not divisible "
+                    f"by pp_microbatches {cfg.pp_microbatches} (each scanned "
+                    "microbatch is re-split by the pipeline)")
+
+        def _microbatches(batch):
+            """[B, ...] -> [accum, B/accum, ...] with a STRIDED row split:
+            row r lands in microbatch r % accum, so each microbatch draws
+            evenly from every device's contiguous batch shard (a block
+            split would put whole microbatches on a subset of the mesh)."""
+            return jax.tree.map(
+                lambda a: a.reshape(
+                    (a.shape[0] // accum, accum) + a.shape[1:]).swapaxes(0, 1),
+                batch)
+
+        def _apply_update(state, grads, new_stats, loss, acc):
             updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
@@ -377,7 +410,33 @@ class Trainer:
             )
             return new_state, {"loss": loss, "accuracy": acc}
 
-        self._train_step = jax.jit(train_step, donate_argnums=(0,))
+        def train_step(state: TrainState, batch):
+            (loss, (new_stats, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                state.params, state.batch_stats, batch
+            )
+            return _apply_update(state, grads, new_stats, loss, acc)
+
+        def train_step_accum(state: TrainState, batch):
+            def body(carry, microbatch):
+                stats, g_sum, loss_sum, acc_sum = carry
+                (loss, (new_stats, acc)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(state.params, stats, microbatch)
+                return (new_stats, jax.tree.map(jnp.add, g_sum, grads),
+                        loss_sum + loss, acc_sum + acc), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (new_stats, g_sum, loss_sum, acc_sum), _ = jax.lax.scan(
+                body,
+                (state.batch_stats, zeros, jnp.float32(0.0), jnp.float32(0.0)),
+                _microbatches(batch))
+            # equal-size microbatches: averaging per-microbatch means IS
+            # the full-batch mean (loss, accuracy, and gradients alike)
+            grads = jax.tree.map(lambda g: g / accum, g_sum)
+            return _apply_update(state, grads, new_stats,
+                                 loss_sum / accum, acc_sum / accum)
+
+        self._train_step = jax.jit(
+            train_step_accum if accum > 1 else train_step, donate_argnums=(0,))
 
         def eval_step(state: TrainState, batch):
             variables = {"params": state.params,

@@ -61,10 +61,11 @@ def test_sweep_queue_builds_valid_bench_commands():
     """Every queued sweep point must translate to a bench.py invocation
     whose flags bench.py actually defines (the queue and the CLI drift
     independently)."""
-    from tools.lm_sweep import BLOCK_GRID, POINTS, bench_cmd
+    from tools.lm_sweep import BLOCK_GRID, PHASE2_POINTS, POINTS, bench_cmd
 
     src = open(os.path.join(HERE, "bench.py")).read()
-    for point in POINTS + [dict(POINTS[0], xent_chunks=8)]:
+    for point in (POINTS + PHASE2_POINTS
+                  + [dict(POINTS[0], xent_chunks=8, grad_accum=2)]):
         cmd = bench_cmd(point)
         assert cmd[1] == "bench.py"
         for flag in [a for a in cmd[2:] if a.startswith("--")]:
@@ -154,7 +155,7 @@ class TestLmPromotion:
             return argparse.Namespace(
                 lm_best="auto", lm_model="gpt-350m", lm_batch=8,
                 lm_optimizer="adafactor", lm_remat=False,
-                lm_remat_policy="dots", lm_xent_chunks=0)
+                lm_remat_policy="dots", lm_xent_chunks=0, lm_grad_accum=0)
 
         monkeypatch.delenv("KFTPU_FLASH_BLOCK_Q", raising=False)
         args = mkargs()

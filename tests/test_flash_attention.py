@@ -45,6 +45,17 @@ def test_flash_rejects_ragged_lengths():
         flash_attention(q, k, v, block_q=64, block_k=64)
 
 
+def test_flash_block_fallback_for_non_multiple_lengths():
+    """Lengths that are multiples of 128 but not of the swept 512
+    default (640, 896, ...) must halve the block down to a divisor
+    instead of raising — the %128 support gate admits them."""
+    q, k, v = make_qkv(l=640)
+    want = reference_attention(q, k, v, causal=True)
+    got = flash_attention(q, k, v, causal=True)  # default 512 blocks
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
 def test_flash_gradients_match_reference():
     q, k, v = make_qkv(b=1, l=128, h=2, hk=2, d=64)
 

@@ -1,0 +1,73 @@
+"""Gradient accumulation (TrainConfig.grad_accum_steps): a step that
+scans k microbatches with one averaged update must equal the single-shot
+full-batch step bit-for-bit in math (f32 model), and the strided split
+must reject geometries that break the batch sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel.mesh import MeshSpec
+from kubeflow_tpu.runtime.data import shard_batch
+from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+
+def _lm_cfg(**kw):
+    base = dict(
+        model="transformer-test",
+        model_kwargs={"dtype": jnp.float32},
+        task="lm",
+        global_batch=8,
+        seq_len=32,
+        vocab_size=256,
+        mesh=MeshSpec(data=2, model=4),
+        optimizer="adafactor",
+        learning_rate=1e-3,
+        total_steps=3,
+        warmup_steps=1,
+        log_every=10**9,
+    )
+    base.update(kw)
+    return TrainConfig.from_dict(base)
+
+
+def _one_step(cfg):
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    batch = shard_batch(next(trainer.data_iter()),
+                        next(iter(jax.tree.leaves(trainer.batch_shardings))))
+    state, m = trainer.train_step(state, batch)
+    return float(m["loss"]), float(m["accuracy"]), state.params
+
+
+def test_accum_step_equals_full_batch_step():
+    loss1, acc1, params1 = _one_step(_lm_cfg())
+    loss2, acc2, params2 = _one_step(_lm_cfg(grad_accum_steps=4))
+    np.testing.assert_allclose(loss2, loss1, rtol=1e-5)
+    np.testing.assert_allclose(acc2, acc1, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        params2, params1)
+
+
+def test_accum_composes_with_chunked_xent():
+    loss1, acc1, params1 = _one_step(_lm_cfg())
+    loss2, acc2, params2 = _one_step(
+        _lm_cfg(grad_accum_steps=2, xent_chunks=4))
+    np.testing.assert_allclose(loss2, loss1, rtol=1e-5)
+    np.testing.assert_allclose(acc2, acc1, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        params2, params1)
+
+
+def test_rejects_indivisible_accum():
+    with pytest.raises(ValueError, match="not divisible by"):
+        Trainer(_lm_cfg(grad_accum_steps=3))
+
+
+def test_rejects_microbatch_smaller_than_dp():
+    # 8 / 8 = microbatch of 1 row over a 2-way batch sharding
+    with pytest.raises(ValueError, match="batch sharding"):
+        Trainer(_lm_cfg(grad_accum_steps=8, mesh=MeshSpec(data=8)))

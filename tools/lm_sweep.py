@@ -65,7 +65,11 @@ PHASE2_POINTS: list[dict] = [
 ]
 
 # Flash-attention block grid, applied to the best point found above.
-BLOCK_GRID = [(256, 256), (256, 512), (512, 256), (512, 512), (128, 256)]
+# Phase-1 hardware: 128/128 0.227 < 256/256 0.368 < 256/512 0.434 <
+# 512/512 0.467 (llama-1b bs16) — monotone in block area so far, so the
+# grid now probes past the new 512/512 default.
+BLOCK_GRID = [(512, 1024), (1024, 512), (1024, 1024), (512, 2048),
+              (2048, 2048)]
 
 
 def bench_cmd(point: dict) -> list[str]:
@@ -77,6 +81,8 @@ def bench_cmd(point: dict) -> list[str]:
         cmd += ["--lm-remat", "--lm-remat-policy", point["remat"]]
     if point.get("xent_chunks"):
         cmd += ["--lm-xent-chunks", str(point["xent_chunks"])]
+    if point.get("grad_accum"):
+        cmd += ["--lm-grad-accum", str(point["grad_accum"])]
     return cmd
 
 

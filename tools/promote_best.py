@@ -1,6 +1,6 @@
 """Promote the LM sweep's best measured operating point to the bench default.
 
-Parses tools/lm_sweep.log (JSON lines appended by lm_sweep.sh, each the
+Parses tools/lm_sweep.log (JSON lines appended by lm_sweep.py, each the
 output of `bench.py --workload lm ...` whose `lm` dict is self-describing)
 and writes tools/lm_best.json when a config beats BOTH the current
 promotion file and the hard floor of the last hand-verified default
