@@ -61,10 +61,11 @@ def test_sweep_queue_builds_valid_bench_commands():
     """Every queued sweep point must translate to a bench.py invocation
     whose flags bench.py actually defines (the queue and the CLI drift
     independently)."""
-    from tools.lm_sweep import BLOCK_GRID, PHASE2_POINTS, POINTS, bench_cmd
+    from tools.lm_sweep import (BLOCK_GRID, PHASE2_POINTS, PHASE3_POINTS,
+                                POINTS, bench_cmd)
 
     src = open(os.path.join(HERE, "bench.py")).read()
-    for point in (POINTS + PHASE2_POINTS
+    for point in (POINTS + PHASE2_POINTS + PHASE3_POINTS
                   + [dict(POINTS[0], xent_chunks=8, grad_accum=2)]):
         cmd = bench_cmd(point)
         assert cmd[1] == "bench.py"

@@ -64,6 +64,25 @@ PHASE2_POINTS: list[dict] = [
     dict(model="llama-1b", batch=32, remat="full", xent_chunks=8),
 ]
 
+# Phase 3 (--phase3): gradient accumulation. Full remat (phase-1 best,
+# 0.467 MFU) re-runs the whole forward in backward — a 2N/8N recompute
+# tax. Accumulating over small microbatches keeps per-microbatch
+# activations small enough for the cheap "mlp" policy (or none), so the
+# tax drops to ~2/9 of block MACs (or zero) while the optimizer still
+# sees the full global batch.
+PHASE3_POINTS: list[dict] = [
+    dict(model="llama-1b", batch=16, grad_accum=4, remat="mlp", xent_chunks=8),
+    dict(model="llama-1b", batch=32, grad_accum=8, remat="mlp", xent_chunks=8),
+    dict(model="llama-1b", batch=16, grad_accum=4, xent_chunks=8),
+    dict(model="gpt-760m", batch=16, grad_accum=4, remat="mlp", xent_chunks=8),
+    dict(model="gpt-760m", batch=16, grad_accum=2, remat="mlp", xent_chunks=8),
+    dict(model="gpt-350m", batch=16, grad_accum=2, remat="mlp", xent_chunks=8),
+    dict(model="gpt-350m", batch=32, grad_accum=4, remat="mlp", xent_chunks=8),
+    # diagnostics: how much of the block win transfers to the small model
+    dict(model="gpt-350m", batch=8, xent_chunks=8),
+    dict(model="gpt-760m", batch=8, xent_chunks=8),
+]
+
 # Flash-attention block grid, applied to the best point found above.
 # Phase-1 hardware: 128/128 0.227 < 256/256 0.368 < 256/512 0.434 <
 # 512/512 0.467 (llama-1b bs16) — monotone in block area so far, so the
@@ -136,6 +155,8 @@ def main() -> int:
                     help="skip the flash block grid stage")
     ap.add_argument("--phase2", action="store_true",
                     help="run the chunked-xent PHASE2_POINTS queue instead")
+    ap.add_argument("--phase3", action="store_true",
+                    help="run the grad-accum PHASE3_POINTS queue instead")
     args = ap.parse_args()
 
     best: dict | None = None
@@ -143,7 +164,12 @@ def main() -> int:
     with open(args.log, "a") as log:
         log.write(json.dumps({"sweep_start": time.strftime(
             "%Y-%m-%d %H:%M:%S", time.gmtime())}) + "\n")
-        for point in (PHASE2_POINTS if args.phase2 else POINTS):
+        queue = POINTS
+        if args.phase2:
+            queue = PHASE2_POINTS
+        if args.phase3:
+            queue = PHASE3_POINTS
+        for point in queue:
             print("point:", point, flush=True)
             lm = run_point(point, log, args.timeout)
             print("  ->", (f"mfu={lm['mfu']:.4f} {lm['tokens_per_sec']} tok/s"
