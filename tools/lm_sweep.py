@@ -116,8 +116,12 @@ def run_point(point: dict, log, timeout: float, env=None) -> dict | None:
             env={**os.environ, **(env or {})})
         rc, out, err = proc.returncode, proc.stdout, proc.stderr
     except subprocess.TimeoutExpired as e:
-        rc, out = -1, (e.stdout or "")
-        err = (e.stderr or "") + f"\n[timeout after {timeout:.0f}s]"
+        # TimeoutExpired carries BYTES even under text=True
+        def _s(x):
+            return x.decode(errors="replace") if isinstance(x, bytes) else (x or "")
+
+        rc, out = -1, _s(e.stdout)
+        err = _s(e.stderr) + f"\n[timeout after {timeout:.0f}s]"
     secs = round(time.monotonic() - t0, 1)
     last = out.strip().splitlines()[-1] if out.strip() else ""
     record: dict | None = None
@@ -153,10 +157,11 @@ def main() -> int:
     ap.add_argument("--timeout", type=float, default=900.0)
     ap.add_argument("--skip-blocks", action="store_true",
                     help="skip the flash block grid stage")
-    ap.add_argument("--phase2", action="store_true",
-                    help="run the chunked-xent PHASE2_POINTS queue instead")
-    ap.add_argument("--phase3", action="store_true",
-                    help="run the grad-accum PHASE3_POINTS queue instead")
+    phase = ap.add_mutually_exclusive_group()
+    phase.add_argument("--phase2", action="store_true",
+                       help="run the chunked-xent PHASE2_POINTS queue instead")
+    phase.add_argument("--phase3", action="store_true",
+                       help="run the grad-accum PHASE3_POINTS queue instead")
     args = ap.parse_args()
 
     best: dict | None = None
