@@ -67,10 +67,11 @@ def attention(
 ) -> jax.Array:
     """Dispatching attention. impl: auto | flash | reference.
 
-    segment_ids (sequence-packing masks) force the reference path — the
-    Pallas kernel doesn't take them yet.
+    segment_ids (sequence-packing masks) run through the Pallas kernel
+    too — the reference path's [B, H, L, L] scores are unusable at
+    training lengths (58 GB at seq 2048, BASELINE.md round 2).
     """
-    if impl == "reference" or segment_ids is not None:
+    if impl == "reference":
         return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
     on_tpu = jax.devices()[0].platform == "tpu"
     if impl == "flash" or (impl == "auto" and on_tpu and _flash_supported(q, k)):
@@ -88,8 +89,9 @@ def attention(
         bq = int(os.environ.get("KFTPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
         bk = int(os.environ.get("KFTPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
         return flash_attention(q, k, v, causal=causal,
-                               block_q=bq, block_k=bk)
-    return reference_attention(q, k, v, causal=causal)
+                               block_q=bq, block_k=bk,
+                               segment_ids=segment_ids)
+    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
 
 
 def _flash_supported(q: jax.Array, k: jax.Array) -> bool:

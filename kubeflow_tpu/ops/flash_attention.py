@@ -10,6 +10,9 @@ The hot op of the transformer path, built for the MXU:
 - Causal blocks above the diagonal are predicated off with `@pl.when`
   (skipped entirely, ~2x speedup), diagonal blocks masked with
   `broadcasted_iota` (TPU needs >=2D iota).
+- Sequence packing: optional per-position segment ids mask q->k pairs
+  across document boundaries inside the same kernels (a separate
+  custom_vjp variant, so the unsegmented hot path is untouched).
 - Backward is fused Pallas too: a dq kernel (accumulates over kv blocks)
   and a dk/dv kernel (accumulates over q blocks), both recomputing
   probabilities from the saved logsumexp (the flash trick) so memory is
@@ -55,8 +58,24 @@ def _vmem_spec(shape, imap) -> "pl.BlockSpec":
     return pl.BlockSpec(shape, imap, memory_space=pltpu.VMEM)
 
 
+def _block_mask(*, causal, block_q, block_k, qi, ki, offset,
+                qseg_row=None, kseg_row=None):
+    """The block's combined validity mask: causal diagonal and/or
+    segment equality (sequence packing). None = nothing masked."""
+    mask = None
+    if causal:
+        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
+    if qseg_row is not None:
+        seg = qseg_row[:, None] == kseg_row[None, :]   # [BQ, BK]
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
 def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
-                    block_q, block_k, qi, ki, offset):
+                    block_q, block_k, qi, ki, offset,
+                    qseg_row=None, kseg_row=None):
     """Shared backward block math: recompute probabilities from the saved
     lse and form ds = p * (dp - delta) * scale. Used by BOTH backward
     kernels so the masking/scaling convention can never diverge between
@@ -65,10 +84,10 @@ def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32,
     ) * scale                                          # [BQ, BK]
-    if causal:
-        rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
+    mask = _block_mask(causal=causal, block_q=block_q, block_k=block_k,
+                       qi=qi, ki=ki, offset=offset,
+                       qseg_row=qseg_row, kseg_row=kseg_row)
+    if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse_row[:, None])                  # [BQ, BK]
     dp = jax.lax.dot_general(
@@ -83,12 +102,17 @@ def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
 # forward kernel
 # --------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
-                scale: float, causal: bool, block_q: int, block_k: int,
-                offset: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
+                block_k: int, offset: int, has_seg: bool):
     # offset = lk - lq: causality is end-aligned (query row i may attend
     # keys <= i + offset), matching reference_attention's tril(k=lk-lq) —
     # the KV-cache decode / chunked-prefill convention.
+    if has_seg:
+        (q_ref, k_ref, v_ref, qseg_ref, kseg_ref,
+         o_ref, lse_ref, m_s, l_s, acc_s) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -113,10 +137,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale                                      # [BQ, BK]
-        if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-            mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
+        mask = _block_mask(
+            causal=causal, block_q=block_q, block_k=block_k,
+            qi=qi, ki=ki, offset=offset,
+            qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
+        if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_s[:]                                # [BQ, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -138,16 +164,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s, *,
         lse_ref[0, 0] = (m_s[:] + jnp.log(l))[:, 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    """q,k,v: [BH, L, D] (kv already repeated to q heads)."""
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
+               qseg=None, kseg=None):
+    """q,k,v: [BH, L, D] (kv already repeated to q heads); qseg/kseg:
+    optional [BH, 1, L] int32 segment ids (sequence packing)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     nq = pl.cdiv(lq, block_q)
     nk = pl.cdiv(lk, block_k)
+    has_seg = qseg is not None
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=lk - lq,
+        block_q=block_q, block_k=block_k, offset=lk - lq, has_seg=has_seg,
     )
     if not _HAS_PLTPU:
         raise ImportError(
@@ -161,14 +190,23 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
     ]
     bs = _vmem_spec
 
+    in_specs = [
+        bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        in_specs += [
+            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+            bs((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+        ]
+        operands += [qseg, kseg]
+
     out, lse = pl.pallas_call(
         kernel,
         grid=(bh, nq, nk),
-        in_specs=[
-            bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
             # lse rides as [BH, 1, L] so the block's trailing dims are
@@ -182,7 +220,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
         ],
         scratch_shapes=scratch,
         interpret=interpret,
-    )(q, k, v)
+    )(*operands)
     return out, lse.reshape(bh, lq)
 
 
@@ -195,8 +233,14 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
 # grid dimension, exactly like the forward.
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
-                   acc_s, *, scale, causal, block_q, block_k, offset):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dq_ref, acc_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         dq_ref, acc_s) = refs
+        qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -215,7 +259,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         _, ds = _recompute_p_ds(
             q_ref[0], k, v_ref[0], g_ref[0], lse_ref[0, 0], delta_ref[0, 0],
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            qi=qi, ki=ki, offset=offset)
+            qi=qi, ki=ki, offset=offset,
+            qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
         acc_s[:] = acc_s[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -226,9 +272,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_s, dv_s, *,
-                    scale, causal, block_q, block_k, offset):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
+    if has_seg:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
+    else:
+        (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_s, dv_s) = refs
+        qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -250,7 +301,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         p, ds = _recompute_p_ds(
             q, k_ref[0], v_ref[0], g, lse_ref[0, 0], delta_ref[0, 0],
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-            qi=qi, ki=ki, offset=offset)
+            qi=qi, ki=ki, offset=offset,
+            qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -267,13 +320,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                      interpret):
-    """Fused backward: q,k,v,out,g [BH, L, D]; lse [BH, L]."""
+                      interpret, qseg=None, kseg=None):
+    """Fused backward: q,k,v,out,g [BH, L, D]; lse [BH, L]; qseg/kseg
+    optional [BH, 1, L] int32."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     nq = pl.cdiv(lq, block_q)
     nk = pl.cdiv(lk, block_k)
     offset = lk - lq
+    has_seg = qseg is not None
     # delta_i = sum_d(do_i * o_i): one cheap rowwise reduction in XLA.
     # lse/delta ride as [BH, 1, L] for Mosaic's (8, 128) tiling rule.
     delta = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
@@ -282,36 +337,56 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
 
     bs = _vmem_spec
 
+    dq_specs = [
+        bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
+        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # g
+        bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
+        bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
+    ]
+    dq_operands = [q, k, v, g, lse, delta]
+    if has_seg:
+        dq_specs += [
+            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # qseg
+            bs((1, 1, block_k), lambda b, i, j: (b, 0, j)),   # kseg
+        ]
+        dq_operands += [qseg, kseg]
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, offset=offset),
+                          block_q=block_q, block_k=block_k, offset=offset,
+                          has_seg=has_seg),
         grid=(bh, nq, nk),
-        in_specs=[
-            bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
-            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
-            bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
-            bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # g
-            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
-            bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
-        ],
+        in_specs=dq_specs,
         out_specs=bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dq_operands)
+
+    dkv_specs = [
+        bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+        bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
+        bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
+        bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # g
+        bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
+        bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
+    ]
+    dkv_operands = [q, k, v, g, lse, delta]
+    if has_seg:
+        dkv_specs += [
+            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # qseg
+            bs((1, 1, block_k), lambda b, j, i: (b, 0, j)),   # kseg
+        ]
+        dkv_operands += [qseg, kseg]
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, offset=offset),
+                          block_q=block_q, block_k=block_k, offset=offset,
+                          has_seg=has_seg),
         grid=(bh, nk, nq),
-        in_specs=[
-            bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
-            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
-            bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-            bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # g
-            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
-            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),
@@ -325,7 +400,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, g, lse, delta)
+    )(*dkv_operands)
     return dq, dk, dv
 
 
@@ -374,23 +449,33 @@ def _flash_bwd_xla(q, k, v, out, lse, g, scale, causal, block_k):
 # public API
 # --------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, scale, causal, block_q, block_k):
-    interpret = _interpret_default()
-    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
+# qseg/kseg are None (empty pytrees) on the unsegmented hot path —
+# has_seg resolves statically at trace time, so the compiled kernel is
+# bit-identical to the pre-segments one.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, qseg, kseg, scale, causal, block_q, block_k):
+    out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                        _interpret_default(), qseg=qseg, kseg=kseg)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal, block_q, block_k):
-    interpret = _interpret_default()
-    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+def _flash_vjp_fwd(q, k, v, qseg, kseg, scale, causal, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
+                          _interpret_default(), qseg=qseg, kseg=kseg)
+    return out, (q, k, v, qseg, kseg, out, lse)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
-    q, k, v, out, lse = res
-    return _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal,
-                             block_q, block_k, _interpret_default())
+    import numpy as np
+
+    q, k, v, qseg, kseg, out, lse = res
+    dq, dk, dv = _flash_bwd_pallas(
+        q, k, v, out, lse, g, scale, causal, block_q, block_k,
+        _interpret_default(), qseg=qseg, kseg=kseg)
+    # integer segment ids take float0 cotangents (None stays None)
+    zero = lambda a: (None if a is None  # noqa: E731
+                      else np.zeros(a.shape, jax.dtypes.float0))
+    return dq, dk, dv, zero(qseg), zero(kseg)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
@@ -405,8 +490,15 @@ def flash_attention(
     scale: float | None = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
+    segment_ids: jax.Array | None = None,
+    kv_segment_ids: jax.Array | None = None,
 ) -> jax.Array:
-    """Fused attention. [B, L, H, D] in / out; GQA via fewer KV heads."""
+    """Fused attention. [B, L, H, D] in / out; GQA via fewer KV heads.
+
+    segment_ids: optional [B, L] int32 sequence-packing ids — query i
+    attends key j only when their ids match (on top of causality), so
+    one row can carry several packed documents without cross-attention.
+    kv_segment_ids defaults to segment_ids (self-attention)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = scale if scale is not None else d ** -0.5
@@ -433,5 +525,19 @@ def flash_attention(
     qt = q.transpose(0, 2, 1, 3).reshape(b * h, lq, d)
     kt = k.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
     vt = v.transpose(0, 2, 1, 3).reshape(b * h, lk, d)
-    out = _flash(qt, kt, vt, scale, causal, block_q, block_k)
+    qseg = kseg = None
+    if kv_segment_ids is not None and segment_ids is None:
+        raise ValueError(
+            "kv_segment_ids without segment_ids — key-side masking would "
+            "be silently dropped; pass the query ids too")
+    if segment_ids is not None:
+        if kv_segment_ids is None:
+            kv_segment_ids = segment_ids
+        # [B, L] -> [B*H, 1, L]: per-head copies of the per-batch ids
+        # (int32, ~1 MB at bench shapes — negligible next to K/V).
+        qseg = jnp.repeat(segment_ids.astype(jnp.int32)[:, None], h, axis=1
+                          ).reshape(b * h, 1, lq)
+        kseg = jnp.repeat(kv_segment_ids.astype(jnp.int32)[:, None], h, axis=1
+                          ).reshape(b * h, 1, lk)
+    out = _flash(qt, kt, vt, qseg, kseg, scale, causal, block_q, block_k)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)

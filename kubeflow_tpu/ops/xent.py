@@ -49,10 +49,11 @@ def chunked_lm_xent(hidden: jax.Array, kernel: jax.Array,
         tests use f32 to compare exactly against the unchunked oracle).
 
     Returns:
-      (loss, accuracy): scalar f32 mean NLL over B*L positions and the
-      argmax hit-rate, identical (up to dtype noise) to
+      (loss, accuracy): scalar f32 mean NLL over the valid positions and
+      the argmax hit-rate, identical (up to dtype noise) to
       ``optax.softmax_cross_entropy_with_integer_labels`` over full
-      logits followed by ``(logits.argmax(-1) == labels).mean()``.
+      logits followed by a masked argmax hit-rate. Negative labels are
+      ignored (packed-batch padding / document boundaries).
     """
     b, l, d = hidden.shape
     if l % n_chunks:
@@ -65,10 +66,14 @@ def chunked_lm_xent(hidden: jax.Array, kernel: jax.Array,
             "bld,dv->blv", x.astype(compute_dtype),
             kernel.astype(compute_dtype),
             preferred_element_type=jnp.float32)
+        valid = y >= 0
+        y_safe = jnp.maximum(y, 0)
         lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        correct = jnp.take_along_axis(logits, y[..., None], axis=-1)[..., 0]
-        hits = jnp.sum(logits.argmax(-1) == y)
-        return jnp.sum(lse - correct), hits
+        correct = jnp.take_along_axis(
+            logits, y_safe[..., None], axis=-1)[..., 0]
+        hits = jnp.sum((logits.argmax(-1) == y) & valid)
+        return (jnp.sum((lse - correct) * valid), hits,
+                jnp.sum(valid.astype(jnp.int32)))
 
     # bwd recomputes the chunk's logits from (x, kernel) instead of saving
     # them: the whole point of the op.
@@ -79,11 +84,11 @@ def chunked_lm_xent(hidden: jax.Array, kernel: jax.Array,
     yc = labels.reshape(b, n_chunks, c).transpose(1, 0, 2)
 
     def body(carry, xy):
-        loss_sum, hit_sum = carry
-        ls, h = chunk_fn(*xy)
-        return (loss_sum + ls, hit_sum + h), None
+        loss_sum, hit_sum, n_sum = carry
+        ls, h, n = chunk_fn(*xy)
+        return (loss_sum + ls, hit_sum + h, n_sum + n), None
 
-    (loss_sum, hit_sum), _ = jax.lax.scan(
-        body, (jnp.float32(0.0), jnp.int32(0)), (hc, yc))
-    n = b * l
+    (loss_sum, hit_sum, n_sum), _ = jax.lax.scan(
+        body, (jnp.float32(0.0), jnp.int32(0), jnp.int32(0)), (hc, yc))
+    n = jnp.maximum(n_sum, 1).astype(jnp.float32)
     return loss_sum / n, hit_sum.astype(jnp.float32) / n

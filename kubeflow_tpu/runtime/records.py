@@ -231,20 +231,81 @@ class RecordDataset:
 
 def token_batches(paths: Sequence[str], batch: int, seq_len: int, *,
                   shuffle_buffer: int = 0, seed: int = 0,
-                  loop: bool = True) -> Iterator[dict]:
+                  loop: bool = True, segmented: bool = False) -> Iterator[dict]:
     """LM batches from token shards: records are (seq_len+1) int32 tokens;
-    yields {"tokens": [b, L], "targets": [b, L]} (next-token shift)."""
-    rb = (seq_len + 1) * 4
+    yields {"tokens": [b, L], "targets": [b, L]} (next-token shift).
+
+    segmented=True reads packed shards (write_packed_token_shard): each
+    record carries tokens AND per-position segment ids, the batch gains
+    "segment_ids", and targets at padding or document boundaries are -1
+    (the loss-ignore convention the trainer's cross entropy applies)."""
+    width = 2 if segmented else 1
+    rb = width * (seq_len + 1) * 4
     ds = RecordDataset(paths, batch, record_bytes=rb,
                        shuffle_buffer=shuffle_buffer, seed=seed, loop=loop)
     try:
         for raw in ds:
-            tok = raw.view(np.int32).reshape(raw.shape[0], seq_len + 1)
-            yield {"tokens": tok[:, :-1], "targets": tok[:, 1:]}
+            row = raw.view(np.int32).reshape(raw.shape[0], width, seq_len + 1)
+            tok = row[:, 0]
+            if not segmented:
+                yield {"tokens": tok[:, :-1], "targets": tok[:, 1:]}
+                continue
+            seg = row[:, 1]
+            # target t+1 trains only within one real document: padding
+            # (seg 0) and the first token of the NEXT document are not
+            # predictions of the current one
+            valid = (seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] > 0)
+            yield {"tokens": tok[:, :-1],
+                   "targets": np.where(valid, tok[:, 1:], -1),
+                   "segment_ids": seg[:, :-1]}
     finally:
         # Runs on generator close/GC too, so an abandoned iterator (e.g.
         # Prefetcher torn down mid-epoch) stops the native worker thread.
         ds.close()
+
+
+def pack_documents(docs: Sequence[np.ndarray], seq_len: int,
+                   pad_id: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Greedy best-fit packing of variable-length token documents into
+    [n, seq_len+1] rows + matching 1-based segment ids (0 = padding).
+
+    Documents longer than a row are split into row-size pieces (each
+    piece its own segment occurrence); short documents share rows, the
+    flash kernel's segment mask keeping their attention separate.
+    Each piece goes to the open row with the SMALLEST remaining capacity
+    that still fits (best-fit via a bisect on sorted remainders) —
+    O(n log n) placement, so corpus-scale packing stays minutes, not the
+    hours a linear scan over all open rows would take."""
+    import bisect
+
+    cap = seq_len + 1
+    rows: list[list[np.ndarray]] = []
+    remainders: list[tuple[int, int]] = []  # sorted (remaining, row_idx)
+    for doc in docs:
+        doc = np.asarray(doc, np.int32).ravel()
+        if doc.size == 0:
+            continue
+        for piece_at in range(0, doc.size, cap):
+            piece = doc[piece_at:piece_at + cap]
+            i = bisect.bisect_left(remainders, (piece.size, -1))
+            if i < len(remainders):
+                remaining, r = remainders.pop(i)
+                rows[r].append(piece)
+                remaining -= piece.size
+            else:
+                rows.append([piece])
+                r, remaining = len(rows) - 1, cap - piece.size
+            if remaining:
+                bisect.insort(remainders, (remaining, r))
+    tokens = np.full((len(rows), cap), pad_id, np.int32)
+    seg = np.zeros((len(rows), cap), np.int32)
+    for r, pieces in enumerate(rows):
+        at = 0
+        for s, piece in enumerate(pieces, start=1):
+            tokens[r, at:at + piece.size] = piece
+            seg[r, at:at + piece.size] = s
+            at += piece.size
+    return tokens, seg
 
 
 def write_token_shard(path: str, tokens: np.ndarray) -> int:
@@ -253,6 +314,19 @@ def write_token_shard(path: str, tokens: np.ndarray) -> int:
         raise ValueError(f"tokens must be [n, seq_len+1] int32, got "
                          f"{tokens.shape} {tokens.dtype}")
     return write_records(path, tokens.view(np.uint8).reshape(tokens.shape[0], -1))
+
+
+def write_packed_token_shard(path: str, tokens: np.ndarray,
+                             segment_ids: np.ndarray) -> int:
+    """Write packed rows (pack_documents output) as a KFRecord shard:
+    each record is (seq_len+1) tokens followed by (seq_len+1) segment
+    ids, both int32 — fixed-size, so the native loader needs no schema."""
+    if tokens.shape != segment_ids.shape or tokens.ndim != 2:
+        raise ValueError(f"tokens/segment_ids must be matching [n, L+1], "
+                         f"got {tokens.shape} vs {segment_ids.shape}")
+    recs = np.concatenate([tokens.astype(np.int32),
+                           segment_ids.astype(np.int32)], axis=1)
+    return write_records(path, recs.view(np.uint8).reshape(recs.shape[0], -1))
 
 
 def write_image_shard(path: str, images: np.ndarray,

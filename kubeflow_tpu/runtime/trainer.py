@@ -95,6 +95,10 @@ class TrainConfig:
     # unset, synthetic batches (the tf_cnn_benchmarks default) are used.
     data_path: str | None = None
     shuffle_buffer: int = 0
+    # LM shards written by write_packed_token_shard: batches gain
+    # segment_ids (flash masks cross-document attention) and -1 targets
+    # at padding/boundaries (ignored by the loss).
+    packed_data: bool = False
     # xprof trace window (runtime/profiler.py): capture steps
     # [profile_start_step, profile_start_step + profile_steps).
     profile_dir: str | None = None
@@ -158,12 +162,22 @@ def _batch_xy(cfg: TrainConfig, batch: dict):
     return batch["tokens"], batch["targets"]
 
 
+def _masked_accuracy(pred: jax.Array, labels: jax.Array) -> jax.Array:
+    """argmax hit-rate over valid (non-negative) labels only."""
+    valid = labels >= 0
+    return (jnp.sum((pred == labels) & valid)
+            / jnp.maximum(jnp.sum(valid), 1))
+
+
 def _xent_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     """Integer-label cross entropy in f32, shared by classification and LM
-    (LM logits are [B, L, V], labels [B, L] — mean over all positions)."""
-    return optax.softmax_cross_entropy_with_integer_labels(
-        logits.astype(jnp.float32), labels
-    ).mean()
+    (LM logits are [B, L, V], labels [B, L] — mean over all positions).
+    Negative labels are ignored (packed-batch padding / document
+    boundaries, records.token_batches segmented mode)."""
+    valid = labels >= 0
+    ce = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0))
+    return jnp.sum(ce * valid) / jnp.maximum(jnp.sum(valid), 1)
 
 
 class Trainer:
@@ -250,7 +264,8 @@ class Trainer:
 
             return token_batches(paths, cfg.global_batch, cfg.seq_len,
                                  shuffle_buffer=cfg.shuffle_buffer,
-                                 seed=cfg.seed, loop=True)
+                                 seed=cfg.seed, loop=True,
+                                 segmented=cfg.packed_data)
         if cfg.task == "classification":
             return synthetic_images(cfg.global_batch, cfg.image_size, cfg.num_classes, cfg.seed)
         if cfg.task == "seq_classification":
@@ -313,10 +328,14 @@ class Trainer:
         )
 
         # Positional-only closure so jax.checkpoint sees pure pytree args
-        # (it rejects string kwargs like mutable=[...]).
-        def forward(variables, x):
+        # (it rejects string kwargs like mutable=[...]). seg is the
+        # optional [B, L] sequence-packing ids (LM batches only) — the
+        # flash kernel masks cross-document attention from them.
+        def forward(variables, x, seg=None):
+            kw = {"segment_ids": seg} if seg is not None else {}
             return self.model.apply(
-                variables, x, train=True, mutable=["batch_stats", "losses"]
+                variables, x, train=True, mutable=["batch_stats", "losses"],
+                **kw
             )
 
         if cfg.remat and not self._model_self_remat:
@@ -342,10 +361,11 @@ class Trainer:
             head_dtype = getattr(
                 getattr(self.model, "cfg", None), "dtype", jnp.bfloat16)
 
-            def forward_hidden(variables, x):
+            def forward_hidden(variables, x, seg=None):
+                kw = {"segment_ids": seg} if seg is not None else {}
                 return self.model.apply(
                     variables, x, train=True, return_hidden=True,
-                    mutable=["batch_stats", "losses"])
+                    mutable=["batch_stats", "losses"], **kw)
 
             def chunked_loss_acc(params, hidden, y):
                 return chunked_lm_xent(
@@ -355,21 +375,27 @@ class Trainer:
         def loss_fn(params, batch_stats, batch):
             variables = {"params": params, **({"batch_stats": batch_stats} if batch_stats else {})}
             x, y = _batch_xy(cfg, batch)
+            # optional packed-sequence ids ride in the batch dict (LM only)
+            seg = batch.get("segment_ids") if cfg.task == "lm" else None
             if chunked_head:
                 # Head + loss chunked over sequence (ops/xent.py): the
                 # [B, L, V] logits tensor never materializes; lm_head
                 # kernel grads flow through the chunk scan directly.
-                hidden, new_vars = forward_hidden(variables, x)
+                hidden, new_vars = forward_hidden(variables, x, seg)
                 loss, acc = chunked_loss_acc(params, hidden, y)
             else:
-                logits, new_vars = forward(variables, x)
+                logits, new_vars = forward(variables, x, seg)
                 loss = _xent_loss(logits, y)
-                acc = (logits.argmax(-1) == y).mean()
+                acc = _masked_accuracy(logits.argmax(-1), y)
             # auxiliary losses sowed by modules (e.g. MoE load balancing)
             aux_leaves = jax.tree.leaves(new_vars.get("losses", {}))
             if aux_leaves:
                 loss = loss + cfg.aux_loss_weight * sum(a.mean() for a in aux_leaves)
-            return loss, (new_vars.get("batch_stats", {}), acc)
+            # valid-position count: the weight grad accumulation must use
+            # so packed microbatches with uneven -1 masking still combine
+            # into the exact full-batch token-weighted mean
+            n_valid = jnp.sum(y >= 0)
+            return loss, (new_vars.get("batch_stats", {}), acc, n_valid)
 
         accum = max(1, cfg.grad_accum_steps)
         if accum > 1:
@@ -411,29 +437,39 @@ class Trainer:
             return new_state, {"loss": loss, "accuracy": acc}
 
         def train_step(state: TrainState, batch):
-            (loss, (new_stats, acc)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, (new_stats, acc, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                 state.params, state.batch_stats, batch
             )
             return _apply_update(state, grads, new_stats, loss, acc)
 
         def train_step_accum(state: TrainState, batch):
+            # Per-microbatch losses are means over that microbatch's VALID
+            # positions; packed batches (-1 targets) can distribute them
+            # unevenly, so the combine weights each microbatch by its
+            # valid count — making accum == one big batch EXACTLY, not
+            # just for uniform masking.
             def body(carry, microbatch):
-                stats, g_sum, loss_sum, acc_sum = carry
-                (loss, (new_stats, acc)), grads = jax.value_and_grad(
+                stats, g_sum, loss_sum, acc_sum, n_sum = carry
+                (loss, (new_stats, acc, n)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(state.params, stats, microbatch)
-                return (new_stats, jax.tree.map(jnp.add, g_sum, grads),
-                        loss_sum + loss, acc_sum + acc), None
+                w = n.astype(jnp.float32)
+                return (new_stats,
+                        jax.tree.map(lambda a, g: a + g * w, g_sum, grads),
+                        loss_sum + loss * w, acc_sum + acc * w,
+                        n_sum + w), None
 
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
-            (new_stats, g_sum, loss_sum, acc_sum), _ = jax.lax.scan(
+            zeros = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
+            (new_stats, g_sum, loss_sum, acc_sum, n_sum), _ = jax.lax.scan(
                 body,
-                (state.batch_stats, zeros, jnp.float32(0.0), jnp.float32(0.0)),
+                (state.batch_stats, zeros, jnp.float32(0.0),
+                 jnp.float32(0.0), jnp.float32(0.0)),
                 _microbatches(batch))
-            # equal-size microbatches: averaging per-microbatch means IS
-            # the full-batch mean (loss, accuracy, and gradients alike)
-            grads = jax.tree.map(lambda g: g / accum, g_sum)
+            n = jnp.maximum(n_sum, 1.0)
+            grads = jax.tree.map(
+                lambda g, p: (g / n).astype(p.dtype), g_sum, state.params)
             return _apply_update(state, grads, new_stats,
-                                 loss_sum / accum, acc_sum / accum)
+                                 loss_sum / n, acc_sum / n)
 
         self._train_step = jax.jit(
             train_step_accum if accum > 1 else train_step, donate_argnums=(0,))
@@ -442,15 +478,18 @@ class Trainer:
             variables = {"params": state.params,
                          **({"batch_stats": state.batch_stats} if state.batch_stats else {})}
             x, y = _batch_xy(cfg, batch)
+            seg = batch.get("segment_ids") if cfg.task == "lm" else None
+            kw = {"segment_ids": seg} if seg is not None else {}
             if chunked_head:
                 # a config that only FITS because training chunks the head
                 # must not OOM on its first eval
                 hidden = self.model.apply(variables, x, train=False,
-                                          return_hidden=True)
+                                          return_hidden=True, **kw)
                 loss, acc = chunked_loss_acc(state.params, hidden, y)
                 return {"loss": loss, "accuracy": acc}
-            logits = self.model.apply(variables, x, train=False)
-            return {"loss": _xent_loss(logits, y), "accuracy": (logits.argmax(-1) == y).mean()}
+            logits = self.model.apply(variables, x, train=False, **kw)
+            return {"loss": _xent_loss(logits, y),
+                    "accuracy": _masked_accuracy(logits.argmax(-1), y)}
 
         self._eval_step = jax.jit(eval_step)
 

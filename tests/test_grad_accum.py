@@ -71,3 +71,31 @@ def test_rejects_microbatch_smaller_than_dp():
     # 8 / 8 = microbatch of 1 row over a 2-way batch sharding
     with pytest.raises(ValueError, match="batch sharding"):
         Trainer(_lm_cfg(grad_accum_steps=8, mesh=MeshSpec(data=8)))
+
+
+def test_accum_equals_full_batch_with_uneven_masking():
+    """Packed batches put -1 (ignored) targets unevenly across rows; the
+    accumulation combine must weight microbatches by valid-token count so
+    accum == one big batch stays EXACT (a mean-of-means would not)."""
+    import numpy as np
+
+    cfg1 = _lm_cfg()
+    cfg2 = _lm_cfg(grad_accum_steps=4)
+    out = {}
+    for name, cfg in [("full", cfg1), ("accum", cfg2)]:
+        trainer = Trainer(cfg)
+        state = trainer.init_state()
+        sharding = next(iter(jax.tree.leaves(trainer.batch_shardings)))
+        batch = dict(shard_batch(next(trainer.data_iter()), sharding))
+        # rows 0-3 keep 4 valid targets, rows 4-7 keep all 32
+        tgt = np.array(batch["targets"])  # mutable copy
+        tgt[:4, 4:] = -1
+        batch["targets"] = shard_batch({"t": jnp.asarray(tgt)},
+                                       sharding)["t"]
+        state, m = trainer.train_step(state, batch)
+        out[name] = (float(m["loss"]), float(m["accuracy"]), state.params)
+    np.testing.assert_allclose(out["accum"][0], out["full"][0], rtol=1e-5)
+    np.testing.assert_allclose(out["accum"][1], out["full"][1], rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        out["accum"][2], out["full"][2])

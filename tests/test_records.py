@@ -180,3 +180,59 @@ class TestImageShards:
         trainer = Trainer(cfg)
         _, summary = trainer.fit(steps=2)
         assert np.isfinite(summary["final"]["loss"])
+
+
+# ---- sequence packing --------------------------------------------------
+
+
+class TestPacking:
+    def test_pack_documents_greedy_and_segments(self):
+        import numpy as np
+
+        from kubeflow_tpu.runtime.records import pack_documents
+
+        docs = [np.arange(1, 6), np.arange(10, 13), np.arange(20, 24)]
+        tokens, seg = pack_documents(docs, seq_len=8)  # rows of 9
+        assert tokens.shape == seg.shape and tokens.shape[1] == 9
+        # doc 1 (5 toks) + doc 2 (3 toks) fit one row; doc 3 goes next
+        assert (tokens[0, :5] == np.arange(1, 6)).all()
+        assert (seg[0, :5] == 1).all()
+        assert (tokens[0, 5:8] == np.arange(10, 13)).all()
+        assert (seg[0, 5:8] == 2).all()
+        assert seg[0, 8] == 0  # tail padding
+        assert (seg[1, :4] == 1).all() and seg[1, 4] == 0
+
+    def test_pack_documents_splits_long_docs(self):
+        import numpy as np
+
+        from kubeflow_tpu.runtime.records import pack_documents
+
+        tokens, seg = pack_documents([np.arange(20)], seq_len=8)
+        # 20 tokens over rows of 9: pieces 9 + 9 + 2
+        flat = tokens[seg > 0]
+        assert (np.sort(flat) == np.arange(20)).all()
+
+    def test_packed_shard_roundtrip_and_boundary_targets(self, tmp_path):
+        import numpy as np
+
+        from kubeflow_tpu.runtime.records import (
+            pack_documents, token_batches, write_packed_token_shard)
+
+        docs = [np.arange(1, 6), np.arange(10, 14), np.arange(20, 29)]
+        tokens, seg = pack_documents(docs, seq_len=8)
+        p = str(tmp_path / "packed-0.kfr")
+        write_packed_token_shard(p, tokens, seg)
+        batch = next(token_batches([p], batch=tokens.shape[0], seq_len=8,
+                                   loop=False, segmented=True))
+        assert set(batch) == {"tokens", "targets", "segment_ids"}
+        tok, tgt, s = (batch[k] for k in ("tokens", "targets", "segment_ids"))
+        assert tok.shape == tgt.shape == s.shape
+        # inside a document: next-token shift; at the boundary to another
+        # document or into padding: -1 (ignored by the loss)
+        for r in range(tok.shape[0]):
+            for t in range(tok.shape[1]):
+                same_doc = (seg[r, t + 1] == seg[r, t]) and seg[r, t + 1] > 0
+                if same_doc:
+                    assert tgt[r, t] == tokens[r, t + 1]
+                else:
+                    assert tgt[r, t] == -1
