@@ -446,8 +446,11 @@ class Trainer:
             # Per-microbatch losses are means over that microbatch's VALID
             # positions; packed batches (-1 targets) can distribute them
             # unevenly, so the combine weights each microbatch by its
-            # valid count — making accum == one big batch EXACTLY, not
-            # just for uniform masking.
+            # valid count — making the cross-entropy term == one big
+            # batch EXACTLY, not just for uniform masking. Auxiliary
+            # losses (MoE balance) are token-weighted too — deliberate:
+            # a microbatch whose router saw more real tokens exerts
+            # proportionally more balancing pressure.
             def body(carry, microbatch):
                 stats, g_sum, loss_sum, acc_sum, n_sum = carry
                 (loss, (new_stats, acc, n)), grads = jax.value_and_grad(

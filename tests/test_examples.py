@@ -23,7 +23,8 @@ def test_all_examples_are_covered_here():
             for p in glob.glob(os.path.join(HERE, "examples", "*.yaml"))}
     covered = {"resnet50.yaml", "gpt-125m.yaml", "longctx-ring.yaml",
                "llama-1b-singlechip.yaml", "tpudef.yaml",
-               "studyjob-sweep.yaml", "multislice-2slice.yaml"}
+               "studyjob-sweep.yaml", "multislice-2slice.yaml",
+               "packed-pretrain.yaml"}
     assert have == covered, f"new example needs a parse test: {have - covered}"
 
 
@@ -31,9 +32,10 @@ def test_trainconfig_examples_parse():
     from kubeflow_tpu.runtime.trainer import TrainConfig
 
     for name in ("resnet50.yaml", "gpt-125m.yaml", "longctx-ring.yaml",
-                 "llama-1b-singlechip.yaml"):
+                 "llama-1b-singlechip.yaml", "packed-pretrain.yaml"):
         cfg = TrainConfig.from_dict(_load(name))
         assert cfg.total_steps > 0, name
+    assert TrainConfig.from_dict(_load("packed-pretrain.yaml")).packed_data
 
 
 def test_tpudef_example_parses():
@@ -62,10 +64,10 @@ def test_sweep_queue_builds_valid_bench_commands():
     whose flags bench.py actually defines (the queue and the CLI drift
     independently)."""
     from tools.lm_sweep import (BLOCK_GRID, PHASE2_POINTS, PHASE3_POINTS,
-                                POINTS, bench_cmd)
+                                PHASE4_POINTS, POINTS, bench_cmd)
 
     src = open(os.path.join(HERE, "bench.py")).read()
-    for point in (POINTS + PHASE2_POINTS + PHASE3_POINTS
+    for point in (POINTS + PHASE2_POINTS + PHASE3_POINTS + PHASE4_POINTS
                   + [dict(POINTS[0], xent_chunks=8, grad_accum=2)]):
         cmd = bench_cmd(point)
         assert cmd[1] == "bench.py"

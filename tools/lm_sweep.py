@@ -83,6 +83,20 @@ PHASE3_POINTS: list[dict] = [
     dict(model="gpt-760m", batch=8, xent_chunks=8),
 ]
 
+# Phase 4 (--phase4): the post-0.49 frontier. Chunked CE + the 512
+# block defaults opened configs phases 1-3 never measured: mid-size
+# batches under full remat, gpt-760m (which OOMed unchunked), and the
+# small-model diagnostic.
+PHASE4_POINTS: list[dict] = [
+    dict(model="llama-1b", batch=16, remat="full", xent_chunks=8),
+    dict(model="gpt-350m", batch=16, remat="full", xent_chunks=8),
+    dict(model="gpt-350m", batch=16, remat="mlp", xent_chunks=16),
+    dict(model="gpt-760m", batch=8, remat="mlp", xent_chunks=8),
+    dict(model="gpt-760m", batch=8, remat="full", xent_chunks=8),
+    dict(model="gpt-760m", batch=16, remat="full", xent_chunks=8),
+    dict(model="gpt-125m", batch=16, xent_chunks=8),
+]
+
 # Flash-attention block grid, applied to the best point found above.
 # Phase-1 hardware: 128/128 0.227 < 256/256 0.368 < 256/512 0.434 <
 # 512/512 0.467 (llama-1b bs16) — monotone in block area so far, so the
@@ -162,6 +176,8 @@ def main() -> int:
                        help="run the chunked-xent PHASE2_POINTS queue instead")
     phase.add_argument("--phase3", action="store_true",
                        help="run the grad-accum PHASE3_POINTS queue instead")
+    phase.add_argument("--phase4", action="store_true",
+                       help="run the post-0.49-frontier PHASE4_POINTS queue")
     args = ap.parse_args()
 
     best: dict | None = None
@@ -172,8 +188,10 @@ def main() -> int:
         queue = POINTS
         if args.phase2:
             queue = PHASE2_POINTS
-        if args.phase3:
+        elif args.phase3:
             queue = PHASE3_POINTS
+        elif args.phase4:
+            queue = PHASE4_POINTS
         for point in queue:
             print("point:", point, flush=True)
             lm = run_point(point, log, args.timeout)

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# One-shot follow-up for the next tunnel-up window: run the op
+# microbenchmark (attributes the remaining MFU gap) and then a full
+# validation bench.py (ResNet + the promoted LM operating point) so the
+# round closes with a driver-reproducible headline even if nobody is
+# watching. Probes every ~5 min; exits after one successful pass.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tunnel_followup.log
+while true; do
+  if timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "tunnel UP $(date -u +%H:%M:%S) — phase4 sweep, microbench, bench" >> "$LOG"
+    timeout 14400 python tools/lm_sweep.py --phase4 >> "$LOG" 2>&1
+    echo "--- microbench $(date -u +%H:%M:%S)" >> "$LOG"
+    timeout 2400 python tools/op_microbench.py --batch 8 --seq 2048 \
+      >> "$LOG" 2>&1
+    echo "--- validation bench $(date -u +%H:%M:%S)" >> "$LOG"
+    timeout 2400 python bench.py >> "$LOG" 2>&1
+    echo "done $(date -u +%H:%M:%S)" >> "$LOG"
+    exit 0
+  fi
+  echo "tunnel down $(date -u +%H:%M:%S)" >> "$LOG"
+  sleep 290
+done
