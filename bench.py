@@ -199,6 +199,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
         if not isinstance(best, dict):
             raise ValueError("promotion file must be a JSON object")
         model = str(best.get("model", args.lm_model))
+        attention = str(best.get("attention", args.lm_attention))
         batch = int(best.get("global_batch", args.lm_batch))
         optimizer = str(best.get("optimizer", args.lm_optimizer))
         remat = bool(best.get("remat", args.lm_remat))
@@ -211,6 +212,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
     except (ValueError, TypeError, OSError):
         return "flags"  # malformed promotion file: keep the safe defaults
     args.lm_model = model
+    args.lm_attention = attention
     args.lm_batch = batch
     args.lm_optimizer = optimizer
     args.lm_remat = remat

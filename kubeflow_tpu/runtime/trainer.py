@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import os
 import time
 from functools import partial
 from typing import Any, Callable, Iterator
@@ -99,6 +100,23 @@ class TrainConfig:
     # segment_ids (flash masks cross-document attention) and -1 targets
     # at padding/boundaries (ignored by the loss).
     packed_data: bool = False
+    # Periodic held-out evaluation (the reference's estimator
+    # train_and_evaluate pattern): every eval_every train steps run
+    # eval_steps batches from eval_data_path (same shard format as
+    # data_path) and log the averaged metrics (+ perplexity for LM).
+    # When eval_data_path is unset, eval falls back to the TRAINING
+    # source reshuffled at a shifted seed — a smoke eval, not held-out;
+    # point eval_data_path at real validation shards for generalization
+    # numbers. 0 = no eval.
+    eval_every: int = 0
+    eval_steps: int = 8
+    eval_data_path: str | None = None
+    # Flash-attention kernel tiles, so a swept operating point is
+    # reproducible from the config alone (0 = kernel default /
+    # KFTPU_FLASH_BLOCK_Q/K env). Exported as those env vars at trainer
+    # build — the same trace-time hook the autotuning sweeps use.
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     # xprof trace window (runtime/profiler.py): capture steps
     # [profile_start_step, profile_start_step + profile_steps).
     profile_dir: str | None = None
@@ -190,6 +208,10 @@ class Trainer:
         # LM models remat per-block inside the model (see _model_kwargs);
         # everything else gets whole-forward jax.checkpoint in _build.
         self._model_self_remat = cfg.remat and cfg.task == "lm"
+        if cfg.flash_block_q:
+            os.environ["KFTPU_FLASH_BLOCK_Q"] = str(cfg.flash_block_q)
+        if cfg.flash_block_k:
+            os.environ["KFTPU_FLASH_BLOCK_K"] = str(cfg.flash_block_k)
         self.model = get_model(cfg.model, **self._model_kwargs())
         self.tx = make_optimizer(cfg)
         self._build()
@@ -246,35 +268,45 @@ class Trainer:
             "targets": jnp.zeros((cfg.global_batch, cfg.seq_len), jnp.int32),
         }
 
-    def data_iter(self) -> Iterator[dict]:
+    def data_iter(self, data_path: str | None = None,
+                  seed: int | None = None) -> Iterator[dict]:
         cfg = self.cfg
-        if cfg.data_path:
+        data_path = data_path if data_path is not None else cfg.data_path
+        seed = seed if seed is not None else cfg.seed
+        if data_path:
             import glob as _glob
 
-            paths = sorted(_glob.glob(cfg.data_path))
+            paths = sorted(_glob.glob(data_path))
             if not paths:
-                raise FileNotFoundError(f"no shards match {cfg.data_path!r}")
+                raise FileNotFoundError(f"no shards match {data_path!r}")
             if cfg.task == "classification":
                 from kubeflow_tpu.runtime.records import image_batches
 
                 return image_batches(paths, cfg.global_batch, cfg.image_size,
                                      shuffle_buffer=cfg.shuffle_buffer,
-                                     seed=cfg.seed, loop=True)
+                                     seed=seed, loop=True)
             from kubeflow_tpu.runtime.records import token_batches
 
             return token_batches(paths, cfg.global_batch, cfg.seq_len,
                                  shuffle_buffer=cfg.shuffle_buffer,
-                                 seed=cfg.seed, loop=True,
+                                 seed=seed, loop=True,
                                  segmented=cfg.packed_data)
         if cfg.task == "classification":
-            return synthetic_images(cfg.global_batch, cfg.image_size, cfg.num_classes, cfg.seed)
+            return synthetic_images(cfg.global_batch, cfg.image_size, cfg.num_classes, seed)
         if cfg.task == "seq_classification":
             from kubeflow_tpu.runtime.data import synthetic_token_classes
 
             return synthetic_token_classes(cfg.global_batch, cfg.seq_len,
                                            cfg.vocab_size, cfg.num_classes,
-                                           cfg.seed)
-        return synthetic_tokens(cfg.global_batch, cfg.seq_len, cfg.vocab_size, cfg.seed)
+                                           seed)
+        return synthetic_tokens(cfg.global_batch, cfg.seq_len, cfg.vocab_size, seed)
+
+    def eval_data_iter(self) -> Iterator[dict]:
+        """Held-out batches: eval_data_path shards when given, else the
+        training source at a shifted seed (different shuffle/draw)."""
+        cfg = self.cfg
+        return self.data_iter(data_path=cfg.eval_data_path or cfg.data_path,
+                              seed=cfg.seed + 1)
 
     def _device_iter(self, it: Iterator[dict]) -> Iterator[dict]:
         """Device-put each distinct host batch once. The synthetic
@@ -625,6 +657,35 @@ class Trainer:
                 if ckpt.save(gstep, st):
                     last_saved = gstep
 
+        eval_iter = None
+        last_eval: dict = {}
+
+        def maybe_eval(gstep: int, st) -> None:
+            # train_and_evaluate parity: average eval_steps held-out
+            # batches; perplexity for LM (exp of the masked mean NLL).
+            # The iterator builds lazily INSIDE fit's try so a bad
+            # eval_data_path still closes the checkpointer on unwind.
+            nonlocal eval_iter, last_eval
+            if not (cfg.eval_every and gstep % cfg.eval_every == 0):
+                return
+            if eval_iter is None:
+                eval_iter = iter(self.eval_data_iter())
+            import math as _m
+
+            sums: dict = {}
+            for _ in range(max(1, cfg.eval_steps)):
+                m = self.eval_step(st, next(eval_iter))
+                for k, v in m.items():
+                    sums[k] = sums.get(k, 0.0) + float(v)
+            last_eval = {k: v / max(1, cfg.eval_steps) for k, v in sums.items()}
+            if cfg.task == "lm":
+                last_eval["perplexity"] = _m.exp(min(last_eval["loss"], 30.0))
+            for k, v in last_eval.items():
+                rt_metrics.REGISTRY.gauge(f"jaxrt_eval_{k}", v,
+                                          f"held-out eval {k}")
+            log.info("eval @ step %d: %s", gstep,
+                     " ".join(f"{k}={v:.4f}" for k, v in sorted(last_eval.items())))
+
         from kubeflow_tpu.runtime.profiler import TraceWindow
 
         trace = TraceWindow(cfg.profile_dir, cfg.profile_start_step,
@@ -674,6 +735,7 @@ class Trainer:
                     log.info("first step (incl. compile): %.2fs", first_dt)
                     last = {k: float(v) for k, v in m.items()}
                     maybe_save(start_step + 1, state)
+                    maybe_eval(start_step + 1, state)
                     if callback:
                         callback(i, m)
                     continue
@@ -697,6 +759,7 @@ class Trainer:
                         meter.mfu * 100,
                     )
                 maybe_save(start_step + i + 1, state)
+                maybe_eval(start_step + i + 1, state)
                 if callback:
                     callback(i, m)
             ok = True
@@ -736,4 +799,6 @@ class Trainer:
         }
         if preempted:
             summary["preempted"] = True
+        if last_eval:
+            summary["eval"] = {k: _finite(v) for k, v in last_eval.items()}
         return state, summary

@@ -35,7 +35,11 @@ def test_trainconfig_examples_parse():
                  "llama-1b-singlechip.yaml", "packed-pretrain.yaml"):
         cfg = TrainConfig.from_dict(_load(name))
         assert cfg.total_steps > 0, name
-    assert TrainConfig.from_dict(_load("packed-pretrain.yaml")).packed_data
+        if name == "packed-pretrain.yaml":
+            assert cfg.packed_data
+        if name == "llama-1b-singlechip.yaml":
+            # the measured operating point must be config-reproducible
+            assert cfg.flash_block_q == 1024 and cfg.xent_chunks == 8
 
 
 def test_tpudef_example_parses():
@@ -158,7 +162,8 @@ class TestLmPromotion:
             return argparse.Namespace(
                 lm_best="auto", lm_model="gpt-350m", lm_batch=8,
                 lm_optimizer="adafactor", lm_remat=False,
-                lm_remat_policy="dots", lm_xent_chunks=0, lm_grad_accum=0)
+                lm_remat_policy="dots", lm_xent_chunks=0, lm_grad_accum=0,
+                lm_attention="flash")
 
         monkeypatch.delenv("KFTPU_FLASH_BLOCK_Q", raising=False)
         args = mkargs()

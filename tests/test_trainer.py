@@ -131,3 +131,35 @@ def test_remat_dots_policy_trains_and_matches_no_remat(devices8):
     from kubeflow_tpu.models.transformer import TransformerConfig, _remat_policy
     with _pytest.raises(ValueError, match="remat_policy"):
         _remat_policy(TransformerConfig(remat_policy="bogus"))
+
+
+def test_periodic_eval_in_fit():
+    """eval_every runs held-out eval during fit (train_and_evaluate
+    parity): metrics land in the summary with LM perplexity = exp(loss),
+    and the eval gauges reach the Prometheus registry."""
+    import math
+
+    from kubeflow_tpu.runtime import metrics as rt_metrics
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    cfg = TrainConfig.from_dict(dict(
+        model="transformer-test",
+        task="lm",
+        global_batch=8,
+        seq_len=16,
+        vocab_size=128,
+        mesh=MeshSpec(data=8),
+        optimizer="adafactor",
+        learning_rate=1e-3,
+        total_steps=4,
+        warmup_steps=1,
+        log_every=10**9,
+        eval_every=2,
+        eval_steps=2,
+    ))
+    _, summary = Trainer(cfg).fit()
+    ev = summary["eval"]
+    assert set(ev) >= {"loss", "accuracy", "perplexity"}
+    assert math.isclose(ev["perplexity"], math.exp(ev["loss"]), rel_tol=1e-6)
+    scrape = rt_metrics.REGISTRY.render()
+    assert "jaxrt_eval_loss" in scrape and "jaxrt_eval_perplexity" in scrape
