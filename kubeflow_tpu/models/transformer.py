@@ -69,6 +69,11 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: Dtype = jnp.bfloat16
     attention_impl: str = "auto"   # auto | flash | reference | ring | ulysses
+    # Flash kernel tiles (0 = KFTPU_FLASH_BLOCK_Q/K env, else the swept
+    # default): explicit here so a measured operating point reproduces
+    # from config alone, with no process-global state.
+    flash_block_q: int = 0
+    flash_block_k: int = 0
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
     # "dots": keep matmul outputs, recompute only elementwise — most of
@@ -199,18 +204,20 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 
-            assert segment_ids is None, "ring attention does not take segment_ids yet"
-            out = ring_attention(q, k, v, axis_name=AXIS_SEQ)
+            out = ring_attention(q, k, v, axis_name=AXIS_SEQ,
+                                 segment_ids=segment_ids)
         elif cfg.attention_impl == "ulysses":
             from kubeflow_tpu.ops.ulysses import ulysses_attention
 
-            assert segment_ids is None, "ulysses attention does not take segment_ids yet"
-            out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ)
+            out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ,
+                                    segment_ids=segment_ids)
         else:
             from kubeflow_tpu.ops.attention import attention
 
             out = attention(
-                q, k, v, causal=True, impl=cfg.attention_impl, segment_ids=segment_ids
+                q, k, v, causal=True, impl=cfg.attention_impl,
+                segment_ids=segment_ids,
+                block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
             )
         # Row-parallel output projection: contraction dim sharded over
         # `model` — GSPMD inserts the all-reduce here.

@@ -55,7 +55,8 @@ def reference_attention(
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "impl"))
+@functools.partial(jax.jit,
+                   static_argnames=("causal", "impl", "block_q", "block_k"))
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -64,6 +65,8 @@ def attention(
     causal: bool = True,
     impl: str = "auto",
     segment_ids: jax.Array | None = None,
+    block_q: int = 0,
+    block_k: int = 0,
 ) -> jax.Array:
     """Dispatching attention. impl: auto | flash | reference.
 
@@ -83,11 +86,13 @@ def attention(
             flash_attention,
         )
 
-        # kernel tile sizes, overridable per run for autotuning sweeps
-        # (env read happens at trace time, so a bench process can set
-        # these without any config threading)
-        bq = int(os.environ.get("KFTPU_FLASH_BLOCK_Q", DEFAULT_BLOCK_Q))
-        bk = int(os.environ.get("KFTPU_FLASH_BLOCK_K", DEFAULT_BLOCK_K))
+        # kernel tile sizes: explicit args win (config-plumbed operating
+        # points), else the env override (autotuning sweeps set it per
+        # subprocess; read at trace time), else the swept default
+        bq = block_q or int(os.environ.get("KFTPU_FLASH_BLOCK_Q",
+                                           DEFAULT_BLOCK_Q))
+        bk = block_k or int(os.environ.get("KFTPU_FLASH_BLOCK_K",
+                                           DEFAULT_BLOCK_K))
         return flash_attention(q, k, v, causal=causal,
                                block_q=bq, block_k=bk,
                                segment_ids=segment_ids)

@@ -38,23 +38,33 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
     return [(i, (i + 1) % n) for i in range(n)]
 
 
-def _block_attn(q, k, v, row_ids, col_ids, scale, causal):
-    """One block pair: returns (unnormalized out, row max, row sum)."""
+def _block_attn(q, k, v, row_ids, col_ids, scale, causal,
+                qseg=None, kseg=None):
+    """One block pair: returns (unnormalized out, row max, row sum).
+    qseg/kseg: optional [b, lq]/[b, lk] packing ids — cross-document
+    pairs are masked like causal violations."""
     h = q.shape[2]
     if k.shape[2] != h:
         k = jnp.repeat(k, h // k.shape[2], axis=2)
         v = jnp.repeat(v, h // v.shape[2], axis=2)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    mask = None                                        # [b, q, k] or None
     if causal:
-        mask = row_ids[:, None] >= col_ids[None, :]  # causal, global indices
-        logits = jnp.where(mask[None, None], logits, NEG_INF)
+        mask = jnp.broadcast_to(
+            row_ids[:, None] >= col_ids[None, :],      # global indices
+            (q.shape[0],) + (row_ids.shape[0], col_ids.shape[0]))
+    if qseg is not None:
+        seg = qseg[:, :, None] == kseg[:, None, :]
+        mask = seg if mask is None else mask & seg
+    if mask is not None:
+        logits = jnp.where(mask[:, None], logits, NEG_INF)
     m = jnp.max(logits, axis=-1)                       # [b,h,q]
     # guard fully-masked rows: exp(NEG_INF - NEG_INF) would be 1
     m_safe = jnp.maximum(m, -1e29)
     p = jnp.exp(logits - m_safe[..., None])
-    if causal:
-        p = jnp.where(mask[None, None], p, 0.0)
+    if mask is not None:
+        p = jnp.where(mask[:, None], p, 0.0)
     l = jnp.sum(p, axis=-1)                            # [b,h,q]
     o = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
                    preferred_element_type=jnp.float32)
@@ -69,11 +79,14 @@ def ring_attention(
     axis_name: str = AXIS_SEQ,
     mesh: Mesh | None = None,
     causal: bool = True,
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Exact attention over seq-sharded [B, L, H, D] arrays.
 
     ``causal=False`` gives the bidirectional (BERT-style) long-context
     path: same ring rotation and streaming softmax, no block masking.
+    ``segment_ids`` ([B, L], sharded over `seq` like Q/K/V) mask packed
+    documents apart; the K-side ids rotate around the ring with K/V.
     Falls back to single-block reference attention when the mesh has no
     `seq` axis (so the same model code runs on any mesh spec).
     """
@@ -81,7 +94,8 @@ def ring_attention(
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         from kubeflow_tpu.ops.attention import reference_attention
 
-        return reference_attention(q, k, v, causal=causal)
+        return reference_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids)
 
     n_ring = mesh.shape[axis_name]
     scale = q.shape[-1] ** -0.5
@@ -102,25 +116,30 @@ def ring_attention(
     model_size = mesh.shape.get(AXIS_MODEL, 1) if AXIS_MODEL in mesh.axis_names else 1
     head_axis = AXIS_MODEL if h % max(model_size, 1) == 0 and model_size > 1 else None
     qkv_spec = P(BATCH_AXES, axis_name, head_axis, None)
+    seg_spec = P(BATCH_AXES, axis_name)
+    has_seg = segment_ids is not None
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec)
+        + ((seg_spec,) if has_seg else ()),
         out_specs=qkv_spec,
         check_vma=False,
     )
-    def _ring(q_blk, k_blk, v_blk):
+    def _ring(q_blk, k_blk, v_blk, *maybe_seg):
+        seg_blk = maybe_seg[0] if has_seg else None
         seq_idx = jax.lax.axis_index(axis_name)
         b, lq, h, d = q_blk.shape
         row_ids = seq_idx * l_block + jnp.arange(lq)
         perm = _ring_perm(n_ring)
 
-        def accumulate(o, m, l, k_cur, v_cur, i):
+        def accumulate(o, m, l, k_cur, v_cur, kseg_cur, i):
             src = (seq_idx - i) % n_ring           # owner of current K/V block
             col_ids = src * l_block + jnp.arange(k_cur.shape[1])
             o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids,
-                                        scale, causal)
+                                        scale, causal,
+                                        qseg=seg_blk, kseg=kseg_cur)
             m_new = jnp.maximum(m, m_i)
             alpha = jnp.exp(m - m_new)             # rescale old accumulator
             beta = jnp.exp(m_i - m_new)
@@ -130,23 +149,28 @@ def ring_attention(
             return o_new, m_new, l_new
 
         def step(carry, i):
-            o, m, l, k_cur, v_cur = carry
-            o, m, l = accumulate(o, m, l, k_cur, v_cur, i)
+            o, m, l, k_cur, v_cur, kseg_cur = carry
+            o, m, l = accumulate(o, m, l, k_cur, v_cur, kseg_cur, i)
             k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
             v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-            return (o, m, l, k_nxt, v_nxt), None
+            # the K-side packing ids travel WITH their K/V block
+            kseg_nxt = (jax.lax.ppermute(kseg_cur, axis_name, perm)
+                        if has_seg else kseg_cur)
+            return (o, m, l, k_nxt, v_nxt, kseg_nxt), None
 
         o0 = jnp.zeros((b, lq, h, d), jnp.float32)
         m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, h, lq), jnp.float32)
+        kseg0 = seg_blk if has_seg else jnp.zeros((b, 1), jnp.int32)
         # scan the first n_ring-1 rotations; peel the last block so its
         # K/V are not ppermuted onward (that transfer is never read).
-        (o, m, l, k_last, v_last), _ = jax.lax.scan(
-            step, (o0, m0, l0, k_blk, v_blk), jnp.arange(n_ring - 1)
+        (o, m, l, k_last, v_last, kseg_last), _ = jax.lax.scan(
+            step, (o0, m0, l0, k_blk, v_blk, kseg0), jnp.arange(n_ring - 1)
         )
-        o, m, l = accumulate(o, m, l, k_last, v_last, n_ring - 1)
+        o, m, l = accumulate(o, m, l, k_last, v_last, kseg_last, n_ring - 1)
         l = jnp.maximum(l, 1e-20)
         out = o / l[..., None].transpose(0, 2, 1, 3)
         return out.astype(q_blk.dtype)
 
-    return _ring(q, k, v)
+    args = (q, k, v) + ((segment_ids,) if has_seg else ())
+    return _ring(*args)

@@ -48,18 +48,23 @@ def ulysses_attention(
     mesh: Mesh | None = None,
     causal: bool = True,
     impl: str = "auto",
+    segment_ids: jax.Array | None = None,
 ) -> jax.Array:
     """Causal attention over seq-sharded [B, L, H, D] via head all-to-all.
 
     Requires heads-per-device (H / model-axis) divisible by the seq-axis
-    size. Falls back to the dispatching local attention when the mesh has
-    no `seq` axis, so the same model code runs on any mesh spec.
+    size. ``segment_ids`` ([B, L], seq-sharded) support packed
+    sequences: each device all-gathers the ids (int32, tiny next to
+    K/V) and the local flash kernel masks cross-document pairs. Falls
+    back to the dispatching local attention when the mesh has no `seq`
+    axis, so the same model code runs on any mesh spec.
     """
     mesh = mesh or _current_mesh()
     if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
         from kubeflow_tpu.ops.attention import attention
 
-        return attention(q, k, v, causal=causal, impl=impl)
+        return attention(q, k, v, causal=causal, impl=impl,
+                         segment_ids=segment_ids)
 
     sp = mesh.shape[axis_name]
     h = q.shape[2]
@@ -82,15 +87,18 @@ def ulysses_attention(
     assert q.shape[1] % sp == 0, (q.shape, sp)
 
     qkv_spec = P(BATCH_AXES, axis_name, head_axis, None)
+    seg_spec = P(BATCH_AXES, axis_name)
+    has_seg = segment_ids is not None
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec),
+        in_specs=(qkv_spec, qkv_spec, qkv_spec)
+        + ((seg_spec,) if has_seg else ()),
         out_specs=qkv_spec,
         check_vma=False,
     )
-    def _ulysses(q_blk, k_blk, v_blk):
+    def _ulysses(q_blk, k_blk, v_blk, *maybe_seg):
         # [b, L/sp, h_loc, d] -> [b, L, h_loc/sp, d]: gather sequence,
         # scatter heads. tiled=True keeps the named axes merged in-place.
         a2a = functools.partial(
@@ -99,13 +107,20 @@ def ulysses_attention(
         q_g = a2a(q_blk, split_axis=2, concat_axis=1)
         k_g = a2a(k_blk, split_axis=2, concat_axis=1)
         v_g = a2a(v_blk, split_axis=2, concat_axis=1)
+        seg_full = None
+        if has_seg:
+            # attention is over the FULL sequence here: gather the ids
+            seg_full = jax.lax.all_gather(
+                maybe_seg[0], axis_name, axis=1, tiled=True)
 
         from kubeflow_tpu.ops.attention import attention
 
-        out = attention(q_g, k_g, v_g, causal=causal, impl=impl)
+        out = attention(q_g, k_g, v_g, causal=causal, impl=impl,
+                        segment_ids=seg_full)
 
         # [b, L, h_loc/sp, d] -> [b, L/sp, h_loc, d]: scatter sequence,
         # gather heads.
         return a2a(out, split_axis=1, concat_axis=2)
 
-    return _ulysses(q, k, v)
+    args = (q, k, v) + ((segment_ids,) if has_seg else ())
+    return _ulysses(*args)

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import os
 import time
 from functools import partial
 from typing import Any, Callable, Iterator
@@ -113,8 +112,8 @@ class TrainConfig:
     eval_data_path: str | None = None
     # Flash-attention kernel tiles, so a swept operating point is
     # reproducible from the config alone (0 = kernel default /
-    # KFTPU_FLASH_BLOCK_Q/K env). Exported as those env vars at trainer
-    # build — the same trace-time hook the autotuning sweeps use.
+    # KFTPU_FLASH_BLOCK_Q/K env). Forwarded into the LM model's config —
+    # explicit plumbing, no process-global state.
     flash_block_q: int = 0
     flash_block_k: int = 0
     # xprof trace window (runtime/profiler.py): capture steps
@@ -208,10 +207,6 @@ class Trainer:
         # LM models remat per-block inside the model (see _model_kwargs);
         # everything else gets whole-forward jax.checkpoint in _build.
         self._model_self_remat = cfg.remat and cfg.task == "lm"
-        if cfg.flash_block_q:
-            os.environ["KFTPU_FLASH_BLOCK_Q"] = str(cfg.flash_block_q)
-        if cfg.flash_block_k:
-            os.environ["KFTPU_FLASH_BLOCK_K"] = str(cfg.flash_block_k)
         self.model = get_model(cfg.model, **self._model_kwargs())
         self.tx = make_optimizer(cfg)
         self._build()
@@ -228,6 +223,11 @@ class Trainer:
         if self._model_self_remat:
             kw.setdefault("remat", True)
             kw.setdefault("remat_policy", self.cfg.remat_policy)
+        if self.cfg.task == "lm":
+            if self.cfg.flash_block_q:
+                kw.setdefault("flash_block_q", self.cfg.flash_block_q)
+            if self.cfg.flash_block_k:
+                kw.setdefault("flash_block_k", self.cfg.flash_block_k)
         if self.cfg.task in ("classification", "seq_classification"):
             if kw.get("num_classes", self.cfg.num_classes) != self.cfg.num_classes:
                 # the data generator draws labels from cfg.num_classes; a
@@ -657,26 +657,32 @@ class Trainer:
                 if ckpt.save(gstep, st):
                     last_saved = gstep
 
-        eval_iter = None
         last_eval: dict = {}
 
         def maybe_eval(gstep: int, st) -> None:
             # train_and_evaluate parity: average eval_steps held-out
             # batches; perplexity for LM (exp of the masked mean NLL).
-            # The iterator builds lazily INSIDE fit's try so a bad
+            # A FRESH iterator per eval scores the same leading window of
+            # the eval set every time, so the metric is comparable across
+            # steps (a persistent iterator would score disjoint slices).
+            # Building it here — inside fit's try — also means a bad
             # eval_data_path still closes the checkpointer on unwind.
-            nonlocal eval_iter, last_eval
+            nonlocal last_eval
             if not (cfg.eval_every and gstep % cfg.eval_every == 0):
                 return
-            if eval_iter is None:
-                eval_iter = iter(self.eval_data_iter())
+            eval_iter = iter(self.eval_data_iter())
             import math as _m
 
             sums: dict = {}
-            for _ in range(max(1, cfg.eval_steps)):
-                m = self.eval_step(st, next(eval_iter))
-                for k, v in m.items():
-                    sums[k] = sums.get(k, 0.0) + float(v)
+            try:
+                for _ in range(max(1, cfg.eval_steps)):
+                    m = self.eval_step(st, next(eval_iter))
+                    for k, v in m.items():
+                        sums[k] = sums.get(k, 0.0) + float(v)
+            finally:
+                # shard-backed iterators hold a native reader thread
+                if hasattr(eval_iter, "close"):
+                    eval_iter.close()
             last_eval = {k: v / max(1, cfg.eval_steps) for k, v in sums.items()}
             if cfg.task == "lm":
                 last_eval["perplexity"] = _m.exp(min(last_eval["loss"], 30.0))

@@ -163,3 +163,43 @@ def test_periodic_eval_in_fit():
     assert math.isclose(ev["perplexity"], math.exp(ev["loss"]), rel_tol=1e-6)
     scrape = rt_metrics.REGISTRY.render()
     assert "jaxrt_eval_loss" in scrape and "jaxrt_eval_perplexity" in scrape
+
+
+def test_flash_blocks_plumb_from_config(monkeypatch):
+    """TrainConfig.flash_block_q/k must reach the flash kernel call —
+    the measured-operating-point reproducibility guarantee (no env vars,
+    no process-global state)."""
+    import kubeflow_tpu.ops.flash_attention as fa
+    from kubeflow_tpu.runtime.data import shard_batch
+    from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+    seen = {}
+    real = fa.flash_attention
+
+    def spy(q, k, v, **kw):
+        seen["block_q"] = kw.get("block_q")
+        seen["block_k"] = kw.get("block_k")
+        return real(q, k, v, **kw)
+
+    monkeypatch.setattr(fa, "flash_attention", spy)
+    cfg = TrainConfig.from_dict(dict(
+        model="transformer-test",
+        model_kwargs={"attention_impl": "flash"},
+        task="lm",
+        global_batch=8,
+        seq_len=32,
+        vocab_size=128,
+        mesh=MeshSpec(data=8),
+        optimizer="sgdm",
+        learning_rate=1e-2,
+        total_steps=1,
+        warmup_steps=1,
+        flash_block_q=32,
+        flash_block_k=16,
+    ))
+    trainer = Trainer(cfg)
+    state = trainer.init_state()
+    batch = shard_batch(next(trainer.data_iter()),
+                        next(iter(jax.tree.leaves(trainer.batch_shardings))))
+    trainer.train_step(state, batch)
+    assert seen == {"block_q": 32, "block_k": 16}
