@@ -210,7 +210,9 @@ class Attention(nn.Module):
             from kubeflow_tpu.ops.ulysses import ulysses_attention
 
             out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ,
-                                    segment_ids=segment_ids)
+                                    segment_ids=segment_ids,
+                                    block_q=cfg.flash_block_q,
+                                    block_k=cfg.flash_block_k)
         else:
             from kubeflow_tpu.ops.attention import attention
 
@@ -484,3 +486,16 @@ def moe_test(**kw) -> TransformerLM:
                 head_dim=16, d_ff=128, moe_every=2, n_experts=4, expert_top_k=2)
     base.update(kw)
     return _build("moe-test", **base)
+
+
+@register_model("gpt-moe-8e")
+def gpt_moe_8e(**kw) -> TransformerLM:
+    """Benchmark-scale MoE: gpt-350m backbone with 8 experts (top-2)
+    every second layer — ~1.6B total params, ~550M active per token.
+    Single chip measures the dispatch/combine overhead (EP=1, all
+    experts local); the `expert` mesh axis shards them across chips."""
+    base = dict(d_model=1024, n_layers=24, n_heads=16, n_kv_heads=16,
+                head_dim=64, d_ff=4096, moe_every=2, n_experts=8,
+                expert_top_k=2)
+    base.update(kw)
+    return _build("gpt-moe-8e", **base)

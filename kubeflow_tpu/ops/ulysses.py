@@ -49,6 +49,8 @@ def ulysses_attention(
     causal: bool = True,
     impl: str = "auto",
     segment_ids: jax.Array | None = None,
+    block_q: int = 0,
+    block_k: int = 0,
 ) -> jax.Array:
     """Causal attention over seq-sharded [B, L, H, D] via head all-to-all.
 
@@ -64,7 +66,8 @@ def ulysses_attention(
         from kubeflow_tpu.ops.attention import attention
 
         return attention(q, k, v, causal=causal, impl=impl,
-                         segment_ids=segment_ids)
+                         segment_ids=segment_ids,
+                         block_q=block_q, block_k=block_k)
 
     sp = mesh.shape[axis_name]
     h = q.shape[2]
@@ -116,7 +119,8 @@ def ulysses_attention(
         from kubeflow_tpu.ops.attention import attention
 
         out = attention(q_g, k_g, v_g, causal=causal, impl=impl,
-                        segment_ids=seg_full)
+                        segment_ids=seg_full,
+                        block_q=block_q, block_k=block_k)
 
         # [b, L, h_loc/sp, d] -> [b, L/sp, h_loc, d]: scatter sequence,
         # gather heads.

@@ -95,6 +95,10 @@ PHASE4_POINTS: list[dict] = [
     dict(model="gpt-760m", batch=8, remat="full", xent_chunks=8),
     dict(model="gpt-760m", batch=16, remat="full", xent_chunks=8),
     dict(model="gpt-125m", batch=16, xent_chunks=8),
+    # EP story: measured MoE dispatch overhead on one chip (experts
+    # local); ~1.6B total / ~550M active params with adafactor
+    dict(model="gpt-moe-8e", batch=8, remat="mlp", xent_chunks=8),
+    dict(model="gpt-moe-8e", batch=8, remat="full", xent_chunks=8),
 ]
 
 # Flash-attention block grid, applied to the best point found above.
