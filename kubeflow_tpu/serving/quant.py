@@ -1,0 +1,95 @@
+"""Weight-only int8 quantization for serving.
+
+KV-cache decode is HBM-bandwidth-bound on WEIGHT reads (the batch is
+small; every step streams the full parameter set). Serving already
+halves that traffic with the bf16 cast (server.cast_params); int8
+halves it AGAIN: each >=2-D kernel is stored as int8 with a per-output-
+channel f32 scale, and the dequantize (one multiply) happens inside the
+jitted decode step where XLA fuses it into the consumer matmul — HBM
+holds and streams int8, the MXU still sees bf16 operands.
+
+Symmetric per-channel quantization (scale = amax/127 over all axes but
+the last) is the standard quality-safe weight-only recipe: activations
+stay bf16, so there is no calibration step and the error per channel is
+bounded by half an int8 ulp of that channel's largest weight.
+
+Usage (serving/server.py wires this behind param_dtype="int8"):
+
+    qvars = quantize_params(variables)
+    qmodel = QuantizedModel(model)
+    generate(qmodel, qvars, ...)   # dequant inside the jit
+
+The reference has no quantized serving (its TF-Serving path ships f32
+SavedModels; testing/test_tf_serving.py asserts numeric tolerance, not
+dtype) — this is TPU-native headroom on top of the contract.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Marker keys of a quantized leaf. A dict so the pytree structure stays
+# transparent to jax (checkpoint/save, device_put, jit all just work).
+_QKEYS = frozenset({"int8", "scale"})
+
+
+def _is_qleaf(node: Any) -> bool:
+    return isinstance(node, dict) and set(node) == _QKEYS
+
+
+def quantize_params(variables: Any, min_size: int = 4096) -> Any:
+    """int8-quantize every floating leaf with ndim >= 2 and at least
+    ``min_size`` elements (norm scales / biases stay exact — they are a
+    rounding error of total bytes but matter for quality)."""
+
+    def leaf(x):
+        if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
+                and x.ndim >= 2 and x.size >= min_size):
+            return x
+        xf = jnp.asarray(x, jnp.float32)
+        axes = tuple(range(x.ndim - 1))  # per-output-channel (last axis)
+        amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
+        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+        return {"int8": q, "scale": scale.astype(jnp.float32)}
+
+    return jax.tree.map(leaf, variables)
+
+
+def dequantize_params(variables: Any, dtype=jnp.bfloat16) -> Any:
+    """Inverse of quantize_params: int8 * scale in f32, cast to
+    ``dtype``. Called INSIDE jit so the bf16 tensors are fusion fodder,
+    not HBM residents."""
+
+    def leaf(node):
+        if _is_qleaf(node):
+            return (node["int8"].astype(jnp.float32)
+                    * node["scale"]).astype(dtype)
+        return node
+
+    return jax.tree.map(leaf, variables, is_leaf=_is_qleaf)
+
+
+class QuantizedModel:
+    """Duck-typed model wrapper: dequantizes the variables right inside
+    whatever jit traces ``apply``. generate()/SlotDecoder/serving code
+    only touch ``apply`` and ``cfg``, so quantization needs no changes
+    there."""
+
+    def __init__(self, model: Any, dtype=jnp.bfloat16):
+        self._model = model
+        self._dtype = dtype
+
+    @property
+    def cfg(self):
+        return self._model.cfg
+
+    def apply(self, variables, *args, **kwargs):
+        return self._model.apply(
+            dequantize_params(variables, self._dtype), *args, **kwargs)
+
+    def init(self, *args, **kwargs):
+        return self._model.init(*args, **kwargs)

@@ -532,6 +532,16 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
                                   "method_name": "predict"})
 
 
+def _prepare_serving_params(variables, param_dtype):
+    """Serving-time weight preparation: 'int8' quantizes (weight-only,
+    serving/quant.py), any other dtype casts, None passes through."""
+    if param_dtype == "int8":
+        from kubeflow_tpu.serving.quant import quantize_params
+
+        return quantize_params(variables)
+    return cast_params(variables, param_dtype) if param_dtype else variables
+
+
 def cast_params(variables, dtype):
     """Inference-time parameter cast (f32 training checkpoints -> bf16
     serving): KV-cache decode is HBM-bandwidth-bound on WEIGHT reads, so
@@ -540,6 +550,13 @@ def cast_params(variables, dtype):
     through untouched."""
     import jax
     import jax.numpy as jnp
+
+    if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+        # astype(int8) would silently truncate weights to garbage; int8
+        # serving goes through _prepare_serving_params -> quantize_params
+        raise ValueError(
+            f"cast_params target must be floating, got {dtype!r} "
+            "(use param_dtype='int8' via _prepare_serving_params)")
 
     def leaf(x):
         if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
@@ -577,6 +594,16 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
                       **model_kwargs)
+    quantized = param_dtype == "int8"
+    if quantized and mesh is not None:
+        raise ValueError("param_dtype='int8' serving is single-chip for "
+                         "now (mesh-sharded weights stay bf16)")
+    if quantized:
+        # weight-only int8 (serving/quant.py): HBM streams int8, the
+        # dequant fuses into the decode matmuls inside jit
+        from kubeflow_tpu.serving.quant import QuantizedModel
+
+        model = QuantizedModel(model)
     sm = (_ServingMesh(mesh, seed, checkpoint_dir, param_dtype=param_dtype)
           if mesh is not None else None)
     if sm is not None and checkpoint_dir:
@@ -589,16 +616,15 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
         from kubeflow_tpu.runtime.checkpoint import restore_variables
 
         variables, step = restore_variables(checkpoint_dir)
-        if param_dtype:
-            variables = cast_params(variables, param_dtype)
+        variables = _prepare_serving_params(variables, param_dtype)
         log.info("model %s: restored variables from %s step %d", name,
                  checkpoint_dir, step)
 
     def _materialize(prompt_col):
-        """Non-mesh variables: lazy init + optional serving cast — the
+        """Non-mesh variables: lazy init + serving cast/quantize — the
         ONE place uncast f32 weights could otherwise leak from."""
         v = model.init(jax.random.PRNGKey(seed), prompt_col, train=False)
-        return cast_params(v, param_dtype) if param_dtype else v
+        return _prepare_serving_params(v, param_dtype)
 
     import itertools
 

@@ -22,9 +22,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import threading
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run_mode(mode: str, args) -> dict:
@@ -115,7 +118,7 @@ def main() -> int:
     p.add_argument("--window-ms", type=float, default=5.0,
                    help="micro-batching window for the micro mode")
     p.add_argument("--param-dtype", default="bfloat16",
-                   choices=["bfloat16", "float32", ""])
+                   choices=["bfloat16", "float32", "int8", ""])
     p.add_argument("--mesh", default="",
                    help="axis=n[,axis=n...] to shard the served params")
     p.add_argument("--modes", default="micro,continuous")

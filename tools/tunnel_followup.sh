@@ -16,6 +16,11 @@ while true; do
       >> "$LOG" 2>&1
     echo "--- validation bench $(date -u +%H:%M:%S)" >> "$LOG"
     timeout 2400 python bench.py >> "$LOG" 2>&1
+    echo "--- serving bf16 vs int8 $(date -u +%H:%M:%S)" >> "$LOG"
+    timeout 1800 python tools/serve_bench.py --modes continuous \
+      --requests 32 --param-dtype bfloat16 >> "$LOG" 2>&1
+    timeout 1800 python tools/serve_bench.py --modes continuous \
+      --requests 32 --param-dtype int8 >> "$LOG" 2>&1
     echo "done $(date -u +%H:%M:%S)" >> "$LOG"
     exit 0
   fi
