@@ -28,6 +28,7 @@ def _load_zoo() -> None:
     import kubeflow_tpu.models.resnet  # noqa: F401
     import kubeflow_tpu.models.transformer  # noqa: F401
     import kubeflow_tpu.models.bert  # noqa: F401
+    import kubeflow_tpu.models.vit  # noqa: F401
 
 
 def get_model(name: str, **kwargs) -> Any:

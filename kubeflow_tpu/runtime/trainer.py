@@ -576,6 +576,8 @@ class Trainer:
                 num_filters=cfg.model_kwargs.get("num_filters", 64),
                 stem=cfg.model_kwargs.get("stem", "conv7"))
             return 3.0 * per_image * cfg.global_batch
+        if hasattr(self.model, "fwd_flops_per_image"):
+            return 3.0 * self.model.fwd_flops_per_image() * cfg.global_batch
         if hasattr(self.model, "flops_per_token"):
             per_token = self.model.flops_per_token(seq_len=cfg.seq_len)
             return per_token * cfg.global_batch * cfg.seq_len

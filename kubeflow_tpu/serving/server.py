@@ -747,9 +747,10 @@ def main() -> None:  # pragma: no cover - container entry
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--param-dtype", default=None,
-                   choices=["bfloat16", "float32"],
+                   choices=["bfloat16", "float32", "int8"],
                    help="cast served LM parameters (bfloat16 halves the "
-                        "weight HBM reads that dominate decode)")
+                        "weight HBM reads that dominate decode; int8 is "
+                        "weight-only quantization, halving them again)")
     p.add_argument("--continuous-batching", action="store_true",
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
