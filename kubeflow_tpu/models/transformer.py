@@ -74,6 +74,10 @@ class TransformerConfig:
     # from config alone, with no process-global state.
     flash_block_q: int = 0
     flash_block_k: int = 0
+    # "auto" stores the decode KV cache in `dtype`; "int8" quantizes it
+    # (per-token-head scales) — at long contexts the cache dominates
+    # decode HBM traffic and int8 halves it.
+    kv_cache_dtype: str = "auto"
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
     # "dots": keep matmul outputs, recompute only elementwise — most of
@@ -161,21 +165,50 @@ class Attention(nn.Module):
             # KV-cache decode: x is the single new token [B, 1, ...]; write
             # its K/V at decode_index and attend q against the full cache
             # with a <=index mask. Cache layout [B, max_seq, Hkv, D].
+            # kv_cache_dtype="int8" stores quantized values + per-token-
+            # head scales: at long contexts the cache (not the weights)
+            # dominates decode HBM traffic, and int8 halves it.
             b = x.shape[0]
+            if cfg.kv_cache_dtype not in ("auto", "int8"):
+                # a typo'd value silently running full-precision would
+                # report an int8 configuration that never happened
+                raise ValueError(
+                    f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r} "
+                    "(auto|int8)")
+            quant = cfg.kv_cache_dtype == "int8"
+            cache_dt = jnp.int8 if quant else cfg.dtype
             ck = self.variable(
                 "cache", "cached_key",
                 lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
-                                   cfg.head_dim), cfg.dtype))
+                                   cfg.head_dim), cache_dt))
             cv = self.variable(
                 "cache", "cached_value",
                 lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
-                                   cfg.head_dim), cfg.dtype))
+                                   cfg.head_dim), cache_dt))
+            if quant:
+                cks = self.variable(
+                    "cache", "cached_key_scale",
+                    lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
+                                       1), jnp.float32))
+                cvs = self.variable(
+                    "cache", "cached_value_scale",
+                    lambda: jnp.zeros((b, cfg.max_seq_len, cfg.n_kv_heads,
+                                       1), jnp.float32))
+
+                from kubeflow_tpu.ops.quantize import symmetric_int8
+
+                k_w, ks_w = symmetric_int8(k, -1)  # per-token-head scale
+                v_w, vs_w = symmetric_int8(v, -1)
+            else:
+                k_w, v_w = k.astype(cfg.dtype), v.astype(cfg.dtype)
             idx = jnp.asarray(decode_index, jnp.int32)
             if idx.ndim == 0:
-                ck.value = jax.lax.dynamic_update_slice(
-                    ck.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-                cv.value = jax.lax.dynamic_update_slice(
-                    cv.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                dus = jax.lax.dynamic_update_slice
+                ck.value = dus(ck.value, k_w, (0, idx, 0, 0))
+                cv.value = dus(cv.value, v_w, (0, idx, 0, 0))
+                if quant:
+                    cks.value = dus(cks.value, ks_w, (0, idx, 0, 0))
+                    cvs.value = dus(cvs.value, vs_w, (0, idx, 0, 0))
             else:
                 # per-row positions (continuous batching: every slot is at
                 # its own decode index): one-hot scatter along seq — a
@@ -183,10 +216,22 @@ class Attention(nn.Module):
                 # way to write B different positions in one program
                 hot = (jnp.arange(cfg.max_seq_len)[None, :]
                        == idx[:, None])[:, :, None, None]
-                ck.value = jnp.where(hot, k.astype(cfg.dtype), ck.value)
-                cv.value = jnp.where(hot, v.astype(cfg.dtype), cv.value)
-            kf = jnp.repeat(ck.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
-            vf = jnp.repeat(cv.value, cfg.n_heads // cfg.n_kv_heads, axis=2)
+                ck.value = jnp.where(hot, k_w, ck.value)
+                cv.value = jnp.where(hot, v_w, cv.value)
+                if quant:
+                    cks.value = jnp.where(hot, ks_w, cks.value)
+                    cvs.value = jnp.where(hot, vs_w, cvs.value)
+            if quant:
+                # dequant fuses into the attention matmuls; HBM streamed
+                # the int8 cache + tiny scales
+                k_all = (ck.value.astype(jnp.float32)
+                         * cks.value).astype(cfg.dtype)
+                v_all = (cv.value.astype(jnp.float32)
+                         * cvs.value).astype(cfg.dtype)
+            else:
+                k_all, v_all = ck.value, cv.value
+            kf = jnp.repeat(k_all, cfg.n_heads // cfg.n_kv_heads, axis=2)
+            vf = jnp.repeat(v_all, cfg.n_heads // cfg.n_kv_heads, axis=2)
             logits = jnp.einsum(
                 "bqhd,bkhd->bhqk", q, kf,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)

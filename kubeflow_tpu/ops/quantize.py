@@ -1,0 +1,28 @@
+"""The symmetric int8 primitive shared by weight quantization
+(serving/quant.py, per-output-channel) and the decode KV cache
+(models/transformer.py, per-token-head): one copy of the
+scale/round/clip recipe so the zero-amax guard and clip range can never
+drift between the two users."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def symmetric_int8(x, reduce_axes) -> tuple:
+    """Quantize ``x`` to int8 with a shared scale per slice.
+
+    Args:
+      x: float array.
+      reduce_axes: axes the amax (and so the scale) is shared over;
+        the scale keeps those axes as size-1 (broadcastable back).
+
+    Returns:
+      (q, scale): int8 values in [-127, 127] and the f32 scale such
+      that ``q * scale ~= x`` (error <= scale/2 per element).
+    """
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)

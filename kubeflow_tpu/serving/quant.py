@@ -45,16 +45,15 @@ def quantize_params(variables: Any, min_size: int = 4096) -> Any:
     ``min_size`` elements (norm scales / biases stay exact — they are a
     rounding error of total bytes but matter for quality)."""
 
+    from kubeflow_tpu.ops.quantize import symmetric_int8
+
     def leaf(x):
         if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and x.ndim >= 2 and x.size >= min_size):
             return x
-        xf = jnp.asarray(x, jnp.float32)
-        axes = tuple(range(x.ndim - 1))  # per-output-channel (last axis)
-        amax = jnp.max(jnp.abs(xf), axis=axes, keepdims=True)
-        scale = jnp.where(amax > 0, amax / 127.0, 1.0)
-        q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-        return {"int8": q, "scale": scale.astype(jnp.float32)}
+        # per-output-channel: scale shared over all axes but the last
+        q, scale = symmetric_int8(x, tuple(range(x.ndim - 1)))
+        return {"int8": q, "scale": scale}
 
     return jax.tree.map(leaf, variables)
 

@@ -751,6 +751,10 @@ def main() -> None:  # pragma: no cover - container entry
                    help="cast served LM parameters (bfloat16 halves the "
                         "weight HBM reads that dominate decode; int8 is "
                         "weight-only quantization, halving them again)")
+    p.add_argument("--kv-cache-dtype", default=None,
+                   choices=["auto", "int8"],
+                   help="int8 quantizes the decode KV cache (per-token-"
+                        "head scales): the long-context decode lever")
     p.add_argument("--continuous-batching", action="store_true",
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
@@ -788,7 +792,9 @@ def main() -> None:  # pragma: no cover - container entry
             continuous_batching=args.continuous_batching,
             decode_slots=args.decode_slots,
             param_dtype=args.param_dtype,
-            checkpoint_dir=ckpt or None))
+            checkpoint_dir=ckpt or None,
+            **({"kv_cache_dtype": args.kv_cache_dtype}
+               if args.kv_cache_dtype else {})))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)
     try:

@@ -41,7 +41,9 @@ def run_mode(mode: str, args) -> dict:
         batch_window_ms=(args.window_ms if mode == "micro" else 0.0),
         param_dtype=args.param_dtype or None,
         mesh=args.mesh or None,
-        vocab_size=args.vocab_size)
+        vocab_size=args.vocab_size,
+        **({"kv_cache_dtype": args.kv_cache_dtype}
+           if args.kv_cache_dtype else {}))
     try:
         rng = __import__("random").Random(0)
         prompts = [[rng.randrange(1, args.vocab_size)
@@ -101,6 +103,8 @@ def run_mode(mode: str, args) -> dict:
             "model": args.model,
             "max_new_tokens": args.max_new_tokens,
             "param_dtype": args.param_dtype or "f32",
+            **({"kv_cache_dtype": args.kv_cache_dtype}
+               if args.kv_cache_dtype else {}),
         }
     finally:
         served.close()
@@ -119,6 +123,10 @@ def main() -> int:
                    help="micro-batching window for the micro mode")
     p.add_argument("--param-dtype", default="bfloat16",
                    choices=["bfloat16", "float32", "int8", ""])
+    p.add_argument("--kv-cache-dtype", default="",
+                   choices=["", "auto", "int8"],
+                   help="int8 quantizes the decode KV cache (per-token-"
+                        "head scales) — the long-context decode lever")
     p.add_argument("--mesh", default="",
                    help="axis=n[,axis=n...] to shard the served params")
     p.add_argument("--modes", default="micro,continuous")

@@ -21,6 +21,8 @@ while true; do
       --requests 32 --param-dtype bfloat16 >> "$LOG" 2>&1
     timeout 1800 python tools/serve_bench.py --modes continuous \
       --requests 32 --param-dtype int8 >> "$LOG" 2>&1
+    timeout 1800 python tools/serve_bench.py --modes continuous \
+      --requests 32 --param-dtype int8 --kv-cache-dtype int8 >> "$LOG" 2>&1
     echo "done $(date -u +%H:%M:%S)" >> "$LOG"
     exit 0
   fi
