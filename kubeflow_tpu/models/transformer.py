@@ -230,22 +230,29 @@ class Attention(nn.Module):
                          * cvs.value).astype(cfg.dtype)
             else:
                 k_all, v_all = ck.value, cv.value
-            kf = jnp.repeat(k_all, cfg.n_heads // cfg.n_kv_heads, axis=2)
-            vf = jnp.repeat(v_all, cfg.n_heads // cfg.n_kv_heads, axis=2)
+            # Grouped-query attention WITHOUT jnp.repeat: expanding K/V
+            # to n_heads would materialize (and stream) a G-times-larger
+            # bf16 tensor every decode step — the exact traffic the int8
+            # cache exists to avoid. Group the query heads instead.
+            g = cfg.n_heads // cfg.n_kv_heads
+            lq = q.shape[1]
+            qg = q.reshape(b, lq, cfg.n_kv_heads, g, cfg.head_dim)
             logits = jnp.einsum(
-                "bqhd,bkhd->bhqk", q, kf,
+                "bqhgd,bshd->bhgqs", qg, k_all,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
-            pos = jnp.arange(cfg.max_seq_len)[None, None, None, :]
+            pos = jnp.arange(cfg.max_seq_len)[None, None, None, None, :]
             mask = pos <= (idx if idx.ndim == 0
-                           else idx[:, None, None, None])
+                           else idx[:, None, None, None, None])
             if pad_len is not None:
                 # left-padded ragged prompts: positions before each row's
                 # real start are pad garbage and must not be attended to
                 # (RoPE is relative, so masked left-padding is exact)
-                mask = mask & (pos >= pad_len[:, None, None, None])
+                mask = mask & (pos >= pad_len[:, None, None, None, None])
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(vf.dtype), vf)
+            out = jnp.einsum(
+                "bhgqs,bshd->bqhgd", probs.astype(v_all.dtype), v_all
+            ).reshape(b, lq, cfg.n_heads, cfg.head_dim)
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 

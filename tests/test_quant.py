@@ -106,9 +106,11 @@ def test_int8_kv_cache_decode_matches_full_precision():
                 jax.tree.map(lambda a: a.dtype, cache))
             assert jnp.int8 in leaves and jnp.float32 in leaves
     # same model weights, same greedy decode; int8 cache noise may flip
-    # a late token on a random tiny model but most must agree
-    agree = (outs["full"] == outs["int8"]).mean()
-    assert agree >= 0.8, (agree, outs)
+    # a late token on a random tiny model but most GENERATED tokens must
+    # agree (the prompt echo is identical by construction — comparing it
+    # would let half the decode output be wrong)
+    agree = (outs["full"][:, 12:] == outs["int8"][:, 12:]).mean()
+    assert agree >= 0.75, (agree, outs)
 
 
 def test_int8_kv_cache_composes_with_int8_weights():
