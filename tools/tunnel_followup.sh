@@ -21,8 +21,15 @@ while true; do
       --requests 32 --param-dtype bfloat16 >> "$LOG" 2>&1
     timeout 1800 python tools/serve_bench.py --modes continuous \
       --requests 32 --param-dtype int8 >> "$LOG" 2>&1
+    # kv-cache A/B on a GQA model with a real cache (llama-1b, 1k
+    # prompts): gpt-350m's cache is too small to show the effect
     timeout 1800 python tools/serve_bench.py --modes continuous \
-      --requests 32 --param-dtype int8 --kv-cache-dtype int8 >> "$LOG" 2>&1
+      --requests 16 --model llama-1b --prompt-len 1024 \
+      --max-new-tokens 32 --slots 8 --param-dtype int8 >> "$LOG" 2>&1
+    timeout 1800 python tools/serve_bench.py --modes continuous \
+      --requests 16 --model llama-1b --prompt-len 1024 \
+      --max-new-tokens 32 --slots 8 --param-dtype int8 \
+      --kv-cache-dtype int8 >> "$LOG" 2>&1
     echo "done $(date -u +%H:%M:%S)" >> "$LOG"
     exit 0
   fi
