@@ -51,6 +51,20 @@ def test_accum_step_equals_full_batch_step():
         params2, params1)
 
 
+def test_accum_under_fsdp_equals_full_batch_step():
+    """The regime accumulation exists for: gradients shard with the
+    fsdp weights, and the accumulated step still equals one big batch."""
+    mesh = MeshSpec(data=2, fsdp=2, model=2)
+    loss1, acc1, params1 = _one_step(_lm_cfg(mesh=mesh))
+    loss2, acc2, params2 = _one_step(
+        _lm_cfg(mesh=mesh, grad_accum_steps=2, xent_chunks=4))
+    np.testing.assert_allclose(loss2, loss1, rtol=1e-5)
+    np.testing.assert_allclose(acc2, acc1, rtol=1e-6)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
+        params2, params1)
+
+
 def test_accum_composes_with_chunked_xent():
     loss1, acc1, params1 = _one_step(_lm_cfg())
     loss2, acc2, params2 = _one_step(
