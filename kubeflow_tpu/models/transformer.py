@@ -241,8 +241,15 @@ class Attention(nn.Module):
                 "bqhgd,bshd->bhgqs", qg, k_all,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
             pos = jnp.arange(cfg.max_seq_len)[None, None, None, None, :]
-            mask = pos <= (idx if idx.ndim == 0
-                           else idx[:, None, None, None, None])
+            if idx.ndim == 0:
+                # chunked decode: query row r sits at absolute position
+                # idx + r and may attend keys <= that (causal within the
+                # chunk; degenerates to pos <= idx at lq == 1)
+                qpos = (idx + jnp.arange(lq, dtype=jnp.int32)
+                        )[None, None, None, :, None]
+                mask = pos <= qpos
+            else:
+                mask = pos <= idx[:, None, None, None, None]
             if pad_len is not None:
                 # left-padded ragged prompts: positions before each row's
                 # real start are pad garbage and must not be attended to
@@ -400,15 +407,19 @@ class TransformerLM(nn.Module):
         x = jnp.asarray(emb, cfg.dtype)[tokens]
         x = shard(x, HIDDEN_SPEC)
         if decode_index is not None:
-            # KV-cache decode step: tokens [B, 1] at absolute position
-            # decode_index (runtime/generate.py drives this).
+            # KV-cache decode step: tokens [B, Lq] starting at absolute
+            # position decode_index (runtime/generate.py drives Lq=1;
+            # speculative verify passes a k-token chunk).
             if cfg.pipeline_stages > 1:
                 raise ValueError("decode is not supported under pipeline "
                                  "parallelism yet")
             idx = jnp.asarray(decode_index, jnp.int32)
-            # scalar: whole batch at one position (generate.py's loop);
-            # vector [B]: per-row positions (continuous batching slots)
-            positions = (jnp.broadcast_to(idx, tokens.shape)
+            # scalar: whole batch starting at one position (generate.py's
+            # loop and chunked/speculative decode);
+            # vector [B]: per-row positions (continuous batching slots,
+            # single-token only)
+            offs = jnp.arange(tokens.shape[1], dtype=jnp.int32)
+            positions = (jnp.broadcast_to(idx + offs, tokens.shape)
                          if idx.ndim == 0 else idx[:, None])
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0

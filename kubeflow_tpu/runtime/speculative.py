@@ -1,0 +1,151 @@
+"""Greedy speculative decoding: a small draft model proposes, the
+target verifies k tokens in ONE forward.
+
+Decode is HBM-bandwidth-bound — each emitted token streams the target's
+full weights. Speculative decoding amortizes that stream over several
+tokens: the draft (e.g. gpt-125m against a llama-1b target) runs k
+cheap autoregressive steps, then the target consumes the whole proposal
+chunk through its KV cache in one multi-position forward
+(models/transformer.py chunked decode_index) and greedily accepts the
+longest matching prefix plus one bonus token from its own logits. With
+greedy acceptance the output is EXACTLY the target's own greedy
+decode — the tests pin token-for-token equality — so speedup is free of
+quality change; acceptance rate only affects throughput.
+
+Cache correctness without rollback: a rejected proposal leaves stale
+KV entries beyond the accept point, but the next round's chunk write
+covers exactly that range before any read (write-then-attend inside one
+apply), and the causal mask hides positions beyond the chunk. So both
+caches self-heal — no rollback bookkeeping, no recompilation (round
+geometry is static; positions are traced scalars).
+
+Reference analogue: none — the reference's serving is TF-Serving
+SavedModels (testing/test_tf_serving.py); this is TPU-native headroom.
+Technique: Leviathan et al., "Fast Inference from Transformers via
+Speculative Decoding" (2023), specialized to greedy acceptance.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeflow_tpu.runtime.generate import init_cache, prefill_scan
+
+
+def _split(variables):
+    params = {k: v for k, v in variables.items() if k != "cache"}
+    return params
+
+
+@functools.partial(jax.jit, static_argnames=("model", "k"))
+def _draft_propose(model, params, cache, cur, n, *, k, pad_len=None):
+    """k greedy draft steps from token `cur` at position `n`.
+    Returns (cache', proposals [B, k])."""
+
+    def tick(carry, _):
+        cache, tok, idx = carry
+        logits, mut = model.apply(
+            params | {"cache": cache}, tok, train=False,
+            decode_index=idx, mutable=["cache"],
+            **({"pad_len": pad_len} if pad_len is not None else {}))
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)[:, None]
+        return (mut["cache"], nxt, idx + 1), nxt[:, 0]
+
+    (cache, _, _), toks = jax.lax.scan(
+        tick, (cache, cur, n), None, length=k)
+    return cache, toks.T  # [B, k]
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _verify_chunk(model, params, cache, chunk, n, pad_len=None):
+    """Target forward over the [B, C] chunk at positions n..n+C-1.
+    Returns (cache', logits [B, C, V])."""
+    logits, mut = model.apply(
+        params | {"cache": cache}, chunk, train=False,
+        decode_index=n, mutable=["cache"],
+        **({"pad_len": pad_len} if pad_len is not None else {}))
+    return mut["cache"], logits
+
+
+@functools.partial(jax.jit, static_argnames=("model",))
+def _prefill(model, params, cache, prompt, pad_len=None):
+    """Jitted prompt prefill (prefill_scan re-traces eagerly; a served
+    request must not pay Python tracing per call)."""
+    return prefill_scan(model, params, cache, prompt, pad_len)
+
+
+def speculative_generate(target, target_vars, draft, draft_vars,
+                         prompt: jax.Array, *, max_new_tokens: int,
+                         k: int = 4, pad_len=None) -> tuple:
+    """Greedy decode of `target` accelerated by `draft`.
+
+    prompt: [1, P] int32 (batch 1: accept lengths are data-dependent, so
+    rows cannot share a round; serve concurrency comes from slots/
+    micro-batching above this). Returns (tokens [1, P+max_new_tokens],
+    stats dict with rounds/accept counts).
+    """
+    if prompt.shape[0] != 1:
+        raise ValueError("speculative_generate is batch-1 "
+                         f"(got batch {prompt.shape[0]}); batch via the "
+                         "serving layer")
+    p_len = prompt.shape[1]
+    for name, m in (("target", target), ("draft", draft)):
+        need = p_len + max_new_tokens + k
+        if m.cfg.max_seq_len < need:
+            raise ValueError(
+                f"{name} max_seq_len {m.cfg.max_seq_len} < prompt + "
+                f"max_new_tokens + k = {need} (the verify chunk may "
+                "write k-1 positions past the last emitted token)")
+    t_params = _split(target_vars)
+    d_params = _split(draft_vars)
+    t_cache, t_logits = _prefill(
+        target, t_params, init_cache(target, 1), prompt, pad_len)
+    d_cache, _ = _prefill(
+        draft, d_params, init_cache(draft, 1), prompt, pad_len)
+
+    # first generated token comes straight from the target's prefill
+    cur = int(np.asarray(jnp.argmax(t_logits, axis=-1))[0])
+    out = [cur]
+    n = p_len  # next write position: `cur` sits at position p_len
+    rounds = 0
+    accepted_total = 0
+    while len(out) < max_new_tokens:
+        d_cache, props = _draft_propose(
+            draft, d_params, d_cache, jnp.full((1, 1), cur, jnp.int32),
+            jnp.int32(n), k=k, pad_len=pad_len)
+        # verify chunk = [cur, d_1 .. d_k] at positions n .. n+k: ALL k
+        # proposals are judged (y_1..y_{k+1}), so a perfect round emits
+        # k+1 tokens from k draft forwards + one verify
+        chunk = jnp.concatenate(
+            [jnp.full((1, 1), cur, jnp.int32), props], axis=1)
+        t_cache, logits = _verify_chunk(
+            target, t_params, t_cache, chunk, jnp.int32(n), pad_len=pad_len)
+        y = np.asarray(jnp.argmax(logits, axis=-1))[0]      # [k+1] targets
+        d = np.asarray(props)[0]                            # [k] proposals
+        a = 0
+        while a < k and d[a] == y[a]:
+            a += 1
+        emitted = list(d[:a]) + [y[a]]                      # a + 1 tokens
+        if a == k:
+            # full accept: the draft never consumed d_k, so its cache
+            # lacks position n+k — heal it with one tick (proposal
+            # discarded) or the hole degrades every later draft round
+            d_cache, _ = _draft_propose(
+                draft, d_params, d_cache,
+                jnp.full((1, 1), int(d[k - 1]), jnp.int32),
+                jnp.int32(n + k), k=1, pad_len=pad_len)
+        out.extend(int(t) for t in emitted)
+        cur = int(emitted[-1])
+        n += a + 1
+        rounds += 1
+        accepted_total += a
+    out = out[:max_new_tokens]
+    tokens = jnp.concatenate(
+        [prompt, jnp.asarray(out, jnp.int32)[None, :]], axis=1)
+    return tokens, {"rounds": rounds, "drafted": rounds * k,
+                    "accepted": accepted_total,
+                    "tokens": len(out)}

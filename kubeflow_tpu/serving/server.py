@@ -575,6 +575,9 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        continuous_batching: bool = False,
                        decode_slots: int = 8,
                        param_dtype: str | None = None,
+                       draft_model: str | None = None,
+                       draft_checkpoint_dir: str | None = None,
+                       draft_k: int = 4,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -592,8 +595,20 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
     from kubeflow_tpu.models.registry import get_model
     from kubeflow_tpu.runtime.generate import generate
 
-    model = get_model(model_name, max_seq_len=prompt_len + max_new_tokens,
-                      **model_kwargs)
+    # speculative decoding needs k positions of verify-chunk headroom
+    seq_budget = prompt_len + max_new_tokens + (draft_k if draft_model else 0)
+    model = get_model(model_name, max_seq_len=seq_budget, **model_kwargs)
+    if draft_model:
+        if continuous_batching:
+            raise ValueError("speculative decoding (draft_model) and "
+                             "continuous batching are mutually exclusive; "
+                             "pick the one that fits the load")
+        if temperature > 0:
+            raise ValueError("speculative decoding is greedy-only "
+                             "(temperature must be 0)")
+        if mesh is not None:
+            raise ValueError("speculative decoding is single-chip for "
+                             "now (no mesh)")
     quantized = param_dtype == "int8"
     if quantized and mesh is not None:
         raise ValueError("param_dtype='int8' serving is single-chip for "
@@ -625,6 +640,27 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
         ONE place uncast f32 weights could otherwise leak from."""
         v = model.init(jax.random.PRNGKey(seed), prompt_col, train=False)
         return _prepare_serving_params(v, param_dtype)
+
+    draft_box: list = []
+
+    def _draft():
+        """Lazy draft model + variables (same cast/quantize treatment
+        as the target)."""
+        if not draft_box:
+            dm = get_model(draft_model, max_seq_len=seq_budget)
+            if quantized:
+                from kubeflow_tpu.serving.quant import QuantizedModel
+
+                dm = QuantizedModel(dm)
+            if draft_checkpoint_dir:
+                from kubeflow_tpu.runtime.checkpoint import restore_variables
+
+                dvars, _ = restore_variables(draft_checkpoint_dir)
+            else:
+                dvars = dm.init(jax.random.PRNGKey(seed + 1),
+                                jnp.zeros((1, 1), jnp.int32), train=False)
+            draft_box.extend([dm, _prepare_serving_params(dvars, param_dtype)])
+        return draft_box[0], draft_box[1]
 
     import itertools
 
@@ -695,6 +731,20 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             if variables is None:
                 variables = _materialize(prompt[:, :1])
             use_vars = variables
+        if draft_model:
+            # speculative: batch-1 rounds per row (accept lengths are
+            # data-dependent); concurrency comes from the micro-batcher
+            from kubeflow_tpu.runtime.speculative import speculative_generate
+
+            dm, dv = _draft()
+            outs = []
+            for r in range(prompt.shape[0]):
+                toks, _ = speculative_generate(
+                    model, use_vars, dm, dv, prompt[r:r + 1],
+                    max_new_tokens=max_new_tokens, k=draft_k,
+                    pad_len=jnp.asarray(pad_lens[r:r + 1], jnp.int32))
+                outs.append(np.asarray(toks)[0])
+            return np.stack(outs)[:, prompt_len:]
         with (sm.mesh if sm is not None else contextlib.nullcontext()):
             out = np.asarray(generate(
                 model, use_vars, prompt, max_new_tokens=max_new_tokens,
@@ -705,9 +755,10 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     served = ServedModel(
         name=name, predict_fn=predict,
-        # the slot decoder handles raggedness natively; pow2 padding
-        # would just decode phantom rows
-        pad_batches=not continuous_batching,
+        # the slot decoder handles raggedness natively, and the
+        # speculative path is sequential batch-1 rounds; pow2 padding
+        # would just decode phantom rows in both
+        pad_batches=not (continuous_batching or draft_model),
         batch_window_ms=batch_window_ms, max_batch=max_batch,
         pad_multiple=sm.pad_multiple if sm else 1,
         signature={"inputs": "tokens", "method_name": "generate",
@@ -717,6 +768,8 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        "decode_slots": decode_slots}
                       if continuous_batching else {}),
                    **({"param_dtype": param_dtype} if param_dtype else {}),
+                   **({"draft_model": draft_model, "draft_k": draft_k}
+                      if draft_model else {}),
                    **({"mesh": {k: v for k, v in sm.mesh.shape.items()
                                 if v > 1}} if sm else {})})
     if continuous_batching:
@@ -755,6 +808,15 @@ def main() -> None:  # pragma: no cover - container entry
                    choices=["auto", "int8"],
                    help="int8 quantizes the decode KV cache (per-token-"
                         "head scales): the long-context decode lever")
+    p.add_argument("--draft-model", default=None,
+                   help="zoo model that drafts k tokens per round for "
+                        "speculative decoding (greedy-exact; e.g. "
+                        "gpt-125m drafting for llama-1b)")
+    p.add_argument("--draft-k", type=int, default=4)
+    p.add_argument("--draft-checkpoint-dir", default=None,
+                   help="orbax checkpoint for the draft model — a "
+                        "randomly initialized draft accepts ~nothing "
+                        "and makes speculative serving SLOWER")
     p.add_argument("--continuous-batching", action="store_true",
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
@@ -793,6 +855,8 @@ def main() -> None:  # pragma: no cover - container entry
             decode_slots=args.decode_slots,
             param_dtype=args.param_dtype,
             checkpoint_dir=ckpt or None,
+            draft_model=args.draft_model, draft_k=args.draft_k,
+            draft_checkpoint_dir=args.draft_checkpoint_dir,
             **({"kv_cache_dtype": args.kv_cache_dtype}
                if args.kv_cache_dtype else {})))
     svc = server.serve(port=args.port)

@@ -1,0 +1,108 @@
+"""Speculative decoding == the target's own greedy decode, exactly.
+
+Greedy acceptance makes equality a THEOREM, not a tolerance: every
+accepted token matched the target argmax and the bonus token IS the
+target argmax — so any token-level difference is a cache/mask/position
+bug. The draft model's quality only moves the stats, never the output.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.models.registry import get_model
+from kubeflow_tpu.runtime.generate import generate
+from kubeflow_tpu.runtime.speculative import speculative_generate
+
+
+def _models(seed_t=0, seed_d=1, **kw):
+    target = get_model("transformer-test", dtype=jnp.float32,
+                       max_seq_len=64, **kw)
+    draft = get_model("transformer-test", dtype=jnp.float32,
+                      max_seq_len=64, n_layers=1, d_model=32, n_heads=2,
+                      n_kv_heads=2, head_dim=16, d_ff=64, **kw)
+    prompt = (jnp.arange(10, dtype=jnp.int32).reshape(1, 10) * 13 + 5) % 250
+    tv = target.init(jax.random.PRNGKey(seed_t), prompt, train=False)
+    dv = draft.init(jax.random.PRNGKey(seed_d), prompt, train=False)
+    return target, tv, draft, dv, prompt
+
+
+@pytest.mark.parametrize("k", [2, 4, 7])
+def test_speculative_equals_target_greedy(k):
+    target, tv, draft, dv, prompt = _models()
+    want = np.asarray(generate(target, tv, prompt, max_new_tokens=16,
+                               temperature=0.0))
+    got, stats = speculative_generate(
+        target, tv, draft, dv, prompt, max_new_tokens=16, k=k)
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert stats["tokens"] == 16
+    assert stats["rounds"] >= 1
+
+
+def test_speculative_with_self_draft_accepts_everything():
+    """Draft == target: every proposal matches, so each round accepts
+    all k proposals and emits k+1 tokens — the acceptance ceiling."""
+    target, tv, _, _, prompt = _models()
+    got, stats = speculative_generate(
+        target, tv, target, tv, prompt, max_new_tokens=12, k=4)
+    want = np.asarray(generate(target, tv, prompt, max_new_tokens=12,
+                               temperature=0.0))
+    np.testing.assert_array_equal(np.asarray(got), want)
+    # perfect draft: every round accepts all k proposals
+    assert stats["accepted"] == stats["rounds"] * 4
+
+
+def test_speculative_with_padded_prompt():
+    target, tv, draft, dv, prompt = _models()
+    pad = jnp.asarray([3], jnp.int32)
+    padded = prompt.at[:, :3].set(0)
+    want = np.asarray(generate(target, tv, padded, max_new_tokens=8,
+                               temperature=0.0, pad_len=pad))
+    got, _ = speculative_generate(
+        target, tv, draft, dv, padded, max_new_tokens=8, k=3, pad_len=pad)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_rejects_batch_and_overflow():
+    target, tv, draft, dv, prompt = _models()
+    with pytest.raises(ValueError, match="batch-1"):
+        speculative_generate(target, tv, draft, dv,
+                             jnp.zeros((2, 8), jnp.int32), max_new_tokens=4)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        speculative_generate(target, tv, draft, dv, prompt,
+                             max_new_tokens=60, k=4)
+
+
+def test_served_speculative_matches_plain_served_generate():
+    """The serving layer's draft_model path must emit the same tokens as
+    the plain served generator (greedy acceptance == target greedy)."""
+    from kubeflow_tpu.serving.server import serve_lm_generator
+
+    common = dict(prompt_len=12, max_new_tokens=8, seed=3)
+    plain = serve_lm_generator("plain", "transformer-test", **common)
+    spec = serve_lm_generator(
+        "spec", "transformer-test", draft_model="transformer-test",
+        draft_k=3, **common)
+    try:
+        reqs = [{"tokens": [9, 8, 7, 6, 5]}, {"tokens": [1, 2, 3]}]
+        want = plain.predict(reqs)
+        got = spec.predict(reqs)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        assert spec.signature["draft_k"] == 3
+    finally:
+        plain.close()
+        spec.close()
+
+
+def test_served_speculative_rejects_bad_combos():
+    from kubeflow_tpu.serving.server import serve_lm_generator
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        serve_lm_generator("x", "transformer-test",
+                           draft_model="transformer-test",
+                           continuous_batching=True)
+    with pytest.raises(ValueError, match="greedy-only"):
+        serve_lm_generator("y", "transformer-test",
+                           draft_model="transformer-test",
+                           temperature=0.7)
