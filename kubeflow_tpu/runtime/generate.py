@@ -3,10 +3,11 @@
 The reference's serving story is TF-Serving REST over exported models;
 for LM families the TPU build needs actual decoding. This is the
 jit-compiled loop: prefill writes the prompt into each layer's KV cache
-one position per `lax.scan` tick (cache-correct by construction), then
-the sampling scan feeds each new token back in. Every step is the
-model's `decode_index` path — [B, 1] tokens against the cached K/V, so
-cost per token is O(L) attention reads instead of O(L^2) recompute.
+in GEMM-shaped position chunks (PREFILL_CHUNK wide; cache-correct by
+construction), then the sampling scan feeds each new token back in.
+Every decode step is the model's `decode_index` path — [B, 1] tokens
+against the cached K/V, so cost per token is O(L) attention reads
+instead of O(L^2) recompute.
 
 Sampling: greedy (temperature=0), temperature softmax, optional top-k
 truncation. Everything is static-shaped: prompts are right-aligned by
@@ -45,11 +46,54 @@ def check_decode_geometry(model, prompt_len: int, max_new_tokens: int) -> None:
             f"exceeds the model's max_seq_len {limit}")
 
 
+# Prefill chunk width: each tick feeds this many positions through the
+# model's chunked decode path. Per-token prefill is a GEMV that
+# re-streams the full weights once PER POSITION; 128-wide chunks make
+# every projection a real GEMM and cut the weight stream ~128x — the
+# dominant term of served prompt latency.
+PREFILL_CHUNK = 128
+
+
 def prefill_scan(model, params, cache, prompts, pad_len):
-    """Scan a [B, P] prompt through the KV cache one position per tick
-    (cache-correct by construction); returns (cache, last_logits [B,V]).
-    The ONE prefill implementation — generate() and the slot decoder
-    must never drift apart here."""
+    """Run a [B, P] prompt through the KV cache in position chunks
+    (cache-correct by construction: each chunk writes its K/V before
+    attending, and the causal mask covers within-chunk order); returns
+    (cache, last_logits [B, V]). Full-width chunks scan; a remainder
+    chunk (P % width) runs as one extra apply, so EVERY prompt length
+    gets GEMM-shaped prefill — never a per-token GEMV tail. The ONE
+    prefill implementation — generate(), the slot decoder, and
+    speculative decode must never drift apart here."""
+    b, lp = prompts.shape
+    c = min(PREFILL_CHUNK, lp)
+    n_full, rem = (lp // c, lp % c) if c else (0, 0)
+    logits = jnp.zeros((b, model.cfg.vocab_size), jnp.float32)
+    pad_kw = {} if pad_len is None else {"pad_len": pad_len}
+
+    def chunk_apply(cache, toks, start):
+        out, mut = model.apply(
+            params | {"cache": cache}, toks, train=False,
+            decode_index=start, mutable=["cache"], **pad_kw)
+        return mut["cache"], out[:, -1]
+
+    if n_full:
+        def tick(carry, xs):
+            cache, _ = carry
+            toks, start = xs
+            return chunk_apply(cache, toks, start), None
+
+        (cache, logits), _ = jax.lax.scan(
+            tick, (cache, logits),
+            (prompts[:, :n_full * c].reshape(b, n_full, c).swapaxes(0, 1),
+             jnp.arange(n_full, dtype=jnp.int32) * c))
+    if rem:
+        cache, logits = chunk_apply(
+            cache, prompts[:, n_full * c:], jnp.int32(n_full * c))
+    return cache, logits
+
+
+def prefill_per_token(model, params, cache, prompts, pad_len):
+    """The original one-position-per-tick prefill, kept as the
+    differential-test oracle for the chunked implementation."""
     b, lp = prompts.shape
 
     def tick(carry, xs):
