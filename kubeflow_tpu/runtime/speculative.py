@@ -99,7 +99,7 @@ def speculative_generate(target, target_vars, draft, draft_vars,
             raise ValueError(
                 f"{name} max_seq_len {m.cfg.max_seq_len} < prompt + "
                 f"max_new_tokens + k = {need} (the verify chunk may "
-                "write k-1 positions past the last emitted token)")
+                "write up to k positions past the last emitted token)")
     t_params = _split(target_vars)
     d_params = _split(draft_vars)
     t_cache, t_logits = _prefill(

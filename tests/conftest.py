@@ -82,3 +82,18 @@ def pytest_collection_modifyitems(config, items):
         config.hook.pytest_deselected(items=deselected)
         dropped = set(deselected)
         items[:] = [it for it in items if it not in dropped]
+
+
+def make_segments(b, l, n_docs, seed=7):
+    """Random monotone sequence-packing ids [b, l] (1-based spans) —
+    shared by the flash/ring/ulysses segment-masking tests."""
+    import numpy as np
+
+    rng = np.random.RandomState(seed)
+    seg = np.zeros((b, l), np.int32)
+    for r in range(b):
+        cuts = np.sort(rng.choice(np.arange(1, l), n_docs - 1, replace=False))
+        seg[r] = np.searchsorted(cuts, np.arange(l), side="right")
+    import jax.numpy as jnp
+
+    return jnp.asarray(seg)

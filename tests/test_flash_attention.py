@@ -167,14 +167,7 @@ def test_block_size_env_override_reaches_kernel(monkeypatch):
 # ---- sequence packing (segment ids) ------------------------------------
 
 
-def _segments(b, l, n_docs, seed=7):
-    """Random monotone packing: each row split into n_docs spans."""
-    rng = np.random.RandomState(seed)
-    seg = np.zeros((b, l), np.int32)
-    for r in range(b):
-        cuts = np.sort(rng.choice(np.arange(1, l), n_docs - 1, replace=False))
-        seg[r] = np.searchsorted(cuts, np.arange(l), side="right")
-    return jnp.asarray(seg)
+from conftest import make_segments as _segments  # noqa: E402
 
 
 @pytest.mark.parametrize("causal", [True, False])

@@ -90,12 +90,9 @@ def test_ulysses_with_segments_matches_reference(devices8):
     and the local attention masks cross-document pairs."""
     from kubeflow_tpu.ops.attention import reference_attention
 
-    rng = np.random.RandomState(7)
-    seg = np.zeros((2, 32), np.int32)
-    for r in range(2):
-        cuts = np.sort(rng.choice(np.arange(1, 32), 2, replace=False))
-        seg[r] = np.searchsorted(cuts, np.arange(32), side="right")
-    seg = jnp.asarray(seg)
+    from conftest import make_segments
+
+    seg = make_segments(2, 32, 3)
     mesh = build_mesh(MeshSpec(data=1, seq=4), devices=jax.devices()[:4])
     q, k, v = make_qkv()
     want = reference_attention(q, k, v, causal=True, segment_ids=seg)
