@@ -63,8 +63,13 @@ def prefill_scan(model, params, cache, prompts, pad_len):
     gets GEMM-shaped prefill — never a per-token GEMV tail. The ONE
     prefill implementation — generate(), the slot decoder, and
     speculative decode must never drift apart here."""
+    import os
+
     b, lp = prompts.shape
-    c = min(PREFILL_CHUNK, lp)
+    # env override (read at trace time) so hardware sweeps can A/B chunk
+    # widths — same hook pattern as KFTPU_FLASH_BLOCK_Q/K
+    width = int(os.environ.get("KFTPU_PREFILL_CHUNK", PREFILL_CHUNK))
+    c = min(max(width, 1), lp)
     n_full, rem = (lp // c, lp % c) if c else (0, 0)
     logits = jnp.zeros((b, model.cfg.vocab_size), jnp.float32)
     pad_kw = {} if pad_len is None else {"pad_len": pad_len}

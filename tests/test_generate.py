@@ -197,3 +197,22 @@ def test_prefill_empty_prompt_is_noop():
     jax.tree.map(lambda a, b: np.testing.assert_array_equal(
         np.asarray(a), np.asarray(b)), cache, cache0)
     assert logits.shape == (1, model.cfg.vocab_size)
+
+
+def test_prefill_chunk_env_override(monkeypatch):
+    """KFTPU_PREFILL_CHUNK forces a width (the hardware A/B hook) and
+    the result still matches the oracle."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.runtime import generate as G
+
+    monkeypatch.setenv("KFTPU_PREFILL_CHUNK", "3")
+    model = get_model("transformer-test", dtype=jnp.float32, max_seq_len=64)
+    prompt = (jnp.arange(20, dtype=jnp.int32).reshape(2, 10) * 3 + 2) % 250
+    variables = model.init(jax.random.PRNGKey(2), prompt, train=False)
+    params = {"params": variables["params"]}
+    _, l_new = G.prefill_scan(
+        model, params, G.init_cache(model, 2), prompt, None)
+    _, l_old = G.prefill_per_token(
+        model, params, G.init_cache(model, 2), prompt, None)
+    np.testing.assert_allclose(np.asarray(l_new), np.asarray(l_old),
+                               rtol=1e-5, atol=1e-5)

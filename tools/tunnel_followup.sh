@@ -17,6 +17,11 @@ while true; do
     echo "--- validation bench $(date -u +%H:%M:%S)" >> "$LOG"
     timeout 2400 python bench.py >> "$LOG" 2>&1
     echo "--- serving bf16 vs int8 $(date -u +%H:%M:%S)" >> "$LOG"
+    # prefill A/B: per-token (old behavior) vs 128-wide chunks
+    KFTPU_PREFILL_CHUNK=1 timeout 1800 python tools/serve_bench.py \
+      --modes micro --requests 16 --param-dtype bfloat16 >> "$LOG" 2>&1
+    timeout 1800 python tools/serve_bench.py \
+      --modes micro --requests 16 --param-dtype bfloat16 >> "$LOG" 2>&1
     timeout 1800 python tools/serve_bench.py --modes continuous \
       --requests 32 --param-dtype bfloat16 >> "$LOG" 2>&1
     timeout 1800 python tools/serve_bench.py --modes continuous \
