@@ -18,6 +18,7 @@ scalar.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Any
 
 import jax
@@ -63,8 +64,6 @@ def prefill_scan(model, params, cache, prompts, pad_len):
     gets GEMM-shaped prefill — never a per-token GEMV tail. The ONE
     prefill implementation — generate(), the slot decoder, and
     speculative decode must never drift apart here."""
-    import os
-
     b, lp = prompts.shape
     # env override (read at trace time) so hardware sweeps can A/B chunk
     # widths — same hook pattern as KFTPU_FLASH_BLOCK_Q/K

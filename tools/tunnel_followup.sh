@@ -18,8 +18,10 @@ while true; do
     timeout 2400 python bench.py >> "$LOG" 2>&1
     echo "--- serving bf16 vs int8 $(date -u +%H:%M:%S)" >> "$LOG"
     # prefill A/B: per-token (old behavior) vs 128-wide chunks
+    echo "--- prefill A/B: KFTPU_PREFILL_CHUNK=1 (per-token)" >> "$LOG"
     KFTPU_PREFILL_CHUNK=1 timeout 1800 python tools/serve_bench.py \
       --modes micro --requests 16 --param-dtype bfloat16 >> "$LOG" 2>&1
+    echo "--- prefill A/B: default 128-wide chunks" >> "$LOG"
     timeout 1800 python tools/serve_bench.py \
       --modes micro --requests 16 --param-dtype bfloat16 >> "$LOG" 2>&1
     timeout 1800 python tools/serve_bench.py --modes continuous \
