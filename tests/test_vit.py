@@ -61,3 +61,32 @@ def test_registry_sizes():
     # fwd flops sanity: ViT-B/16 is ~17.6 GMACs per 224px image, so
     # ~35 GF in the 2*MAC convention the MFU meter uses
     assert 30e9 < b.fwd_flops_per_image() < 40e9
+
+
+def test_vit_serves_through_rest_contract():
+    """The new family must ride the TF-Serving REST contract like every
+    other zoo model (the reference's test_tf_serving.py golden path)."""
+    import json
+    import urllib.request
+
+    from kubeflow_tpu.serving.server import ModelServer, serve_flax_classifier
+
+    server = ModelServer()
+    server.register(serve_flax_classifier(
+        "vit", "vit-test", num_classes=10))
+    svc = server.serve(host="127.0.0.1", port=0)
+    svc.serve_background()
+    try:
+        body = json.dumps({
+            "instances": np.zeros((2, 32, 32, 3), np.float32).tolist()
+        }).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/v1/models/vit:predict",
+            data=body, headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=300).read())
+        preds = np.asarray(out["predictions"])
+        assert preds.shape == (2, 10)
+        np.testing.assert_allclose(preds.sum(axis=-1), 1.0, rtol=1e-4)
+    finally:
+        svc.shutdown()
+        server.close()
