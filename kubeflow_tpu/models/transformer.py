@@ -78,6 +78,13 @@ class TransformerConfig:
     # (per-token-head scales) — at long contexts the cache dominates
     # decode HBM traffic and int8 halves it.
     kv_cache_dtype: str = "auto"
+    # Sliding-window attention (Mistral-style): keys further than
+    # window-1 positions in the past are masked; flash skips the COMPUTE
+    # of blocks left of the window (MXU work O(L * window); their DMA
+    # still runs — see ops/flash_attention.py). 0 = full causal.
+    # Training-path only (flash/reference impls; decode and ring/ulysses
+    # reject it).
+    attention_window: int = 0
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
     # "dots": keep matmul outputs, recompute only elementwise — most of
@@ -175,6 +182,11 @@ class Attention(nn.Module):
                 raise ValueError(
                     f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r} "
                     "(auto|int8)")
+            if cfg.attention_window:
+                # decoding full-cache while training windowed would be a
+                # silent train/serve mismatch
+                raise ValueError("attention_window decode is not "
+                                 "supported yet (train-path only)")
             quant = cfg.kv_cache_dtype == "int8"
             cache_dt = jnp.int8 if quant else cfg.dtype
             ck = self.variable(
@@ -263,11 +275,17 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 
+            if cfg.attention_window:
+                raise ValueError("attention_window is not supported under "
+                                 "ring attention yet")
             out = ring_attention(q, k, v, axis_name=AXIS_SEQ,
                                  segment_ids=segment_ids)
         elif cfg.attention_impl == "ulysses":
             from kubeflow_tpu.ops.ulysses import ulysses_attention
 
+            if cfg.attention_window:
+                raise ValueError("attention_window is not supported under "
+                                 "ulysses attention yet")
             out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ,
                                     segment_ids=segment_ids,
                                     block_q=cfg.flash_block_q,
@@ -279,6 +297,7 @@ class Attention(nn.Module):
                 q, k, v, causal=True, impl=cfg.attention_impl,
                 segment_ids=segment_ids,
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
+                window=cfg.attention_window,
             )
         # Row-parallel output projection: contraction dim sharded over
         # `model` — GSPMD inserts the all-reduce here.

@@ -36,8 +36,11 @@ def reference_attention(
     causal: bool = True,
     scale: float | None = None,
     segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
-    """XLA attention in f32 accumulation. BLHD in, BLHD out."""
+    """XLA attention in f32 accumulation. BLHD in, BLHD out.
+    window > 0 = sliding-window: query i attends keys in
+    (i - window, i] (end-aligned like the causal mask)."""
     b, lq, h, d = q.shape
     lk = k.shape[1]
     scale = scale if scale is not None else d ** -0.5
@@ -48,6 +51,11 @@ def reference_attention(
     if causal:
         mask = jnp.tril(jnp.ones((lq, lk), dtype=bool), k=lk - lq)
         logits = jnp.where(mask[None, None], logits, -1e30)
+    if window > 0:
+        qpos = jnp.arange(lq)[:, None] + (lk - lq)
+        kpos = jnp.arange(lk)[None, :]
+        near = qpos - kpos < window
+        logits = jnp.where(near[None, None], logits, -1e30)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None], logits, -1e30)
@@ -56,7 +64,8 @@ def reference_attention(
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("causal", "impl", "block_q", "block_k"))
+                   static_argnames=("causal", "impl", "block_q", "block_k",
+                                    "window"))
 def attention(
     q: jax.Array,
     k: jax.Array,
@@ -67,6 +76,7 @@ def attention(
     segment_ids: jax.Array | None = None,
     block_q: int = 0,
     block_k: int = 0,
+    window: int = 0,
 ) -> jax.Array:
     """Dispatching attention. impl: auto | flash | reference.
 
@@ -75,7 +85,8 @@ def attention(
     training lengths (58 GB at seq 2048, BASELINE.md round 2).
     """
     if impl == "reference":
-        return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+        return reference_attention(q, k, v, causal=causal,
+                                   segment_ids=segment_ids, window=window)
     on_tpu = jax.devices()[0].platform == "tpu"
     if impl == "flash" or (impl == "auto" and on_tpu and _flash_supported(q, k)):
         import os
@@ -95,8 +106,9 @@ def attention(
                                            DEFAULT_BLOCK_K))
         return flash_attention(q, k, v, causal=causal,
                                block_q=bq, block_k=bk,
-                               segment_ids=segment_ids)
-    return reference_attention(q, k, v, causal=causal, segment_ids=segment_ids)
+                               segment_ids=segment_ids, window=window)
+    return reference_attention(q, k, v, causal=causal,
+                               segment_ids=segment_ids, window=window)
 
 
 def _flash_supported(q: jax.Array, k: jax.Array) -> bool:

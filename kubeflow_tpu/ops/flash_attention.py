@@ -59,23 +59,49 @@ def _vmem_spec(shape, imap) -> "pl.BlockSpec":
 
 
 def _block_mask(*, causal, block_q, block_k, qi, ki, offset,
-                qseg_row=None, kseg_row=None):
-    """The block's combined validity mask: causal diagonal and/or
-    segment equality (sequence packing). None = nothing masked."""
+                qseg_row=None, kseg_row=None, window=0):
+    """The block's combined validity mask: causal diagonal, sliding
+    window (query i sees keys in (i - window, i]), and/or segment
+    equality (sequence packing). None = nothing masked."""
     mask = None
-    if causal:
+    rows = cols = None
+    if causal or window > 0:
         rows = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         cols = jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    if causal:
         mask = (qi * block_q + rows + offset) >= (ki * block_k + cols)
+    if window > 0:
+        near = ((qi * block_q + rows + offset)
+                - (ki * block_k + cols)) < window
+        mask = near if mask is None else mask & near
     if qseg_row is not None:
         seg = qseg_row[:, None] == kseg_row[None, :]   # [BQ, BK]
         mask = seg if mask is None else mask & seg
     return mask
 
 
+def _block_runs(*, causal, block_q, block_k, qi, ki, offset, window=0):
+    """Whether a (qi, ki) block pair can contain ANY valid logits —
+    blocks past the causal diagonal or entirely left of the sliding
+    window are skipped outright (never computed)."""
+    run = True
+    if causal:
+        # the block's lowest k column vs its highest causal q row
+        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+    if window > 0:
+        # smallest (qpos - kpos) over the block pair = LOWEST q row vs
+        # HIGHEST k column; if even that closest pair is >= window away,
+        # no pair in the block is inside the window => skip
+        closest = ((qi * block_q + offset)            # lowest q row
+                   - (ki * block_k + block_k - 1))    # highest k col
+        run = jnp.logical_and(run, closest < window) if causal \
+            else closest < window
+    return run
+
+
 def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
                     block_q, block_k, qi, ki, offset,
-                    qseg_row=None, kseg_row=None):
+                    qseg_row=None, kseg_row=None, window=0):
     """Shared backward block math: recompute probabilities from the saved
     lse and form ds = p * (dp - delta) * scale. Used by BOTH backward
     kernels so the masking/scaling convention can never diverge between
@@ -86,7 +112,7 @@ def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
     ) * scale                                          # [BQ, BK]
     mask = _block_mask(causal=causal, block_q=block_q, block_k=block_k,
                        qi=qi, ki=ki, offset=offset,
-                       qseg_row=qseg_row, kseg_row=kseg_row)
+                       qseg_row=qseg_row, kseg_row=kseg_row, window=window)
     if mask is not None:
         s = jnp.where(mask, s, NEG_INF)
     p = jnp.exp(s - lse_row[:, None])                  # [BQ, BK]
@@ -103,7 +129,7 @@ def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
 # --------------------------------------------------------------------------
 
 def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
-                block_k: int, offset: int, has_seg: bool):
+                block_k: int, offset: int, has_seg: bool, window: int = 0):
     # offset = lk - lq: causality is end-aligned (query row i may attend
     # keys <= i + offset), matching reference_attention's tril(k=lk-lq) —
     # the KV-cache decode / chunked-prefill convention.
@@ -123,10 +149,10 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         l_s[:] = jnp.zeros_like(l_s)
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    # causal: kv block strictly above the diagonal contributes nothing
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+    # blocks past the causal diagonal / outside the sliding window
+    # contribute nothing and are skipped outright
+    run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
+                      qi=qi, ki=ki, offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -141,7 +167,8 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
             causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, ki=ki, offset=offset,
             qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
-            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0],
+            window=window)
         if mask is not None:
             s = jnp.where(mask, s, NEG_INF)
         m_prev = m_s[:]                                # [BQ, 1]
@@ -165,7 +192,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
-               qseg=None, kseg=None):
+               qseg=None, kseg=None, window=0):
     """q,k,v: [BH, L, D] (kv already repeated to q heads); qseg/kseg:
     optional [BH, 1, L] int32 segment ids (sequence packing)."""
     bh, lq, d = q.shape
@@ -177,6 +204,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=lk - lq, has_seg=has_seg,
+        window=window,
     )
     if not _HAS_PLTPU:
         raise ImportError(
@@ -233,7 +261,8 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
 # grid dimension, exactly like the forward.
 # --------------------------------------------------------------------------
 
-def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
+def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
+                   window=0):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dq_ref, acc_s) = refs
@@ -249,9 +278,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
     def _init():
         acc_s[:] = jnp.zeros_like(acc_s)
 
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+    run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
+                      qi=qi, ki=ki, offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -261,7 +289,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, ki=ki, offset=offset,
             qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
-            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0],
+            window=window)
         acc_s[:] = acc_s[:] + jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -272,7 +301,8 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
         dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
+                    window=0):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
@@ -289,10 +319,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
-    run = True
-    if causal:
-        # any row of this q block may attend into this kv block
-        run = ki * block_k <= qi * block_q + (block_q - 1) + offset
+    run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
+                      qi=qi, ki=ki, offset=offset, window=window)
 
     @pl.when(run)
     def _compute():
@@ -303,7 +331,8 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             qi=qi, ki=ki, offset=offset,
             qseg_row=None if qseg_ref is None else qseg_ref[0, 0],
-            kseg_row=None if kseg_ref is None else kseg_ref[0, 0])
+            kseg_row=None if kseg_ref is None else kseg_ref[0, 0],
+            window=window)
         dv_s[:] = dv_s[:] + jax.lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -320,7 +349,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg):
 
 
 def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
-                      interpret, qseg=None, kseg=None):
+                      interpret, qseg=None, kseg=None, window=0):
     """Fused backward: q,k,v,out,g [BH, L, D]; lse [BH, L]; qseg/kseg
     optional [BH, 1, L] int32."""
     bh, lq, d = q.shape
@@ -356,7 +385,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset,
-                          has_seg=has_seg),
+                          has_seg=has_seg, window=window),
         grid=(bh, nq, nk),
         in_specs=dq_specs,
         out_specs=bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -384,7 +413,7 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset,
-                          has_seg=has_seg),
+                          has_seg=has_seg, window=window),
         grid=(bh, nk, nq),
         in_specs=dkv_specs,
         out_specs=[
@@ -452,26 +481,29 @@ def _flash_bwd_xla(q, k, v, out, lse, g, scale, causal, block_k):
 # qseg/kseg are None (empty pytrees) on the unsegmented hot path —
 # has_seg resolves statically at trace time, so the compiled kernel is
 # bit-identical to the pre-segments one.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, qseg, kseg, scale, causal, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash(q, k, v, qseg, kseg, scale, causal, block_q, block_k, window):
     out, _ = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                        _interpret_default(), qseg=qseg, kseg=kseg)
+                        _interpret_default(), qseg=qseg, kseg=kseg,
+                        window=window)
     return out
 
 
-def _flash_vjp_fwd(q, k, v, qseg, kseg, scale, causal, block_q, block_k):
+def _flash_vjp_fwd(q, k, v, qseg, kseg, scale, causal, block_q, block_k,
+                   window):
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
-                          _interpret_default(), qseg=qseg, kseg=kseg)
+                          _interpret_default(), qseg=qseg, kseg=kseg,
+                          window=window)
     return out, (q, k, v, qseg, kseg, out, lse)
 
 
-def _flash_vjp_bwd(scale, causal, block_q, block_k, res, g):
+def _flash_vjp_bwd(scale, causal, block_q, block_k, window, res, g):
     import numpy as np
 
     q, k, v, qseg, kseg, out, lse = res
     dq, dk, dv = _flash_bwd_pallas(
         q, k, v, out, lse, g, scale, causal, block_q, block_k,
-        _interpret_default(), qseg=qseg, kseg=kseg)
+        _interpret_default(), qseg=qseg, kseg=kseg, window=window)
     # integer segment ids take float0 cotangents (None stays None)
     zero = lambda a: (None if a is None  # noqa: E731
                       else np.zeros(a.shape, jax.dtypes.float0))
@@ -492,8 +524,18 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     segment_ids: jax.Array | None = None,
     kv_segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Fused attention. [B, L, H, D] in / out; GQA via fewer KV heads.
+
+    window > 0 = sliding-window attention: keys further than window-1
+    positions in the PAST are masked (one-sided; with causal=False,
+    future keys stay fully attended — same convention as
+    reference_attention). Blocks fully left of the window skip their
+    COMPUTE via pl.when, so MXU work is O(L * window); their K/V blocks
+    are still DMA'd (the grid shape is static), so HBM traffic stays
+    O(L^2) — a window-sized k-grid with a qi-offset index map is the
+    follow-up that fixes the bandwidth term.
 
     segment_ids: optional [B, L] int32 sequence-packing ids — query i
     attends key j only when their ids match (on top of causality), so
@@ -539,5 +581,6 @@ def flash_attention(
                           ).reshape(b * h, 1, lq)
         kseg = jnp.repeat(kv_segment_ids.astype(jnp.int32)[:, None], h, axis=1
                           ).reshape(b * h, 1, lk)
-    out = _flash(qt, kt, vt, qseg, kseg, scale, causal, block_q, block_k)
+    out = _flash(qt, kt, vt, qseg, kseg, scale, causal, block_q, block_k,
+                 window)
     return out.reshape(b, h, lq, d).transpose(0, 2, 1, 3)
