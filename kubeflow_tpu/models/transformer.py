@@ -82,8 +82,8 @@ class TransformerConfig:
     # window-1 positions in the past are masked; flash skips the COMPUTE
     # of blocks left of the window (MXU work O(L * window); their DMA
     # still runs — see ops/flash_attention.py). 0 = full causal.
-    # Training-path only (flash/reference impls; decode and ring/ulysses
-    # reject it).
+    # Training-path only (flash/reference/ring/ulysses; decode rejects
+    # it).
     attention_window: int = 0
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
@@ -275,21 +275,17 @@ class Attention(nn.Module):
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
 
-            if cfg.attention_window:
-                raise ValueError("attention_window is not supported under "
-                                 "ring attention yet")
             out = ring_attention(q, k, v, axis_name=AXIS_SEQ,
-                                 segment_ids=segment_ids)
+                                 segment_ids=segment_ids,
+                                 window=cfg.attention_window)
         elif cfg.attention_impl == "ulysses":
             from kubeflow_tpu.ops.ulysses import ulysses_attention
 
-            if cfg.attention_window:
-                raise ValueError("attention_window is not supported under "
-                                 "ulysses attention yet")
             out = ulysses_attention(q, k, v, axis_name=AXIS_SEQ,
                                     segment_ids=segment_ids,
                                     block_q=cfg.flash_block_q,
-                                    block_k=cfg.flash_block_k)
+                                    block_k=cfg.flash_block_k,
+                                    window=cfg.attention_window)
         else:
             from kubeflow_tpu.ops.attention import attention
 

@@ -39,10 +39,12 @@ def _ring_perm(n: int) -> list[tuple[int, int]]:
 
 
 def _block_attn(q, k, v, row_ids, col_ids, scale, causal,
-                qseg=None, kseg=None):
+                qseg=None, kseg=None, window=0):
     """One block pair: returns (unnormalized out, row max, row sum).
     qseg/kseg: optional [b, lq]/[b, lk] packing ids — cross-document
-    pairs are masked like causal violations."""
+    pairs are masked like causal violations. window > 0 masks keys
+    further than window-1 positions in the past (global indices, so the
+    bound holds across ring hops)."""
     h = q.shape[2]
     if k.shape[2] != h:
         k = jnp.repeat(k, h // k.shape[2], axis=2)
@@ -54,6 +56,11 @@ def _block_attn(q, k, v, row_ids, col_ids, scale, causal,
         mask = jnp.broadcast_to(
             row_ids[:, None] >= col_ids[None, :],      # global indices
             (q.shape[0],) + (row_ids.shape[0], col_ids.shape[0]))
+    if window > 0:
+        near = jnp.broadcast_to(
+            (row_ids[:, None] - col_ids[None, :]) < window,
+            (q.shape[0],) + (row_ids.shape[0], col_ids.shape[0]))
+        mask = near if mask is None else mask & near
     if qseg is not None:
         seg = qseg[:, :, None] == kseg[:, None, :]
         mask = seg if mask is None else mask & seg
@@ -80,6 +87,7 @@ def ring_attention(
     mesh: Mesh | None = None,
     causal: bool = True,
     segment_ids: jax.Array | None = None,
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over seq-sharded [B, L, H, D] arrays.
 
@@ -95,7 +103,7 @@ def ring_attention(
         from kubeflow_tpu.ops.attention import reference_attention
 
         return reference_attention(q, k, v, causal=causal,
-                                   segment_ids=segment_ids)
+                                   segment_ids=segment_ids, window=window)
 
     n_ring = mesh.shape[axis_name]
     scale = q.shape[-1] ** -0.5
@@ -139,7 +147,8 @@ def ring_attention(
             col_ids = src * l_block + jnp.arange(k_cur.shape[1])
             o_i, m_i, l_i = _block_attn(q_blk, k_cur, v_cur, row_ids, col_ids,
                                         scale, causal,
-                                        qseg=seg_blk, kseg=kseg_cur)
+                                        qseg=seg_blk, kseg=kseg_cur,
+                                        window=window)
             m_new = jnp.maximum(m, m_i)
             alpha = jnp.exp(m - m_new)             # rescale old accumulator
             beta = jnp.exp(m_i - m_new)
@@ -162,12 +171,19 @@ def ring_attention(
         m0 = jnp.full((b, h, lq), NEG_INF, jnp.float32)
         l0 = jnp.zeros((b, h, lq), jnp.float32)
         kseg0 = seg_blk if has_seg else jnp.zeros((b, 1), jnp.int32)
-        # scan the first n_ring-1 rotations; peel the last block so its
+        # causal + window: hop i's closest (q, k) pair sits (i-1)*l_block+1
+        # positions apart, so blocks past ceil((window-1)/l_block) hops are
+        # entirely outside the window — skip their compute AND their
+        # ppermute traffic (static cap: window/l_block are Python ints).
+        n_hops = n_ring
+        if causal and window > 0:
+            n_hops = min(n_ring, max(1, (window - 2) // l_block + 2))
+        # scan the first n_hops-1 rotations; peel the last block so its
         # K/V are not ppermuted onward (that transfer is never read).
         (o, m, l, k_last, v_last, kseg_last), _ = jax.lax.scan(
-            step, (o0, m0, l0, k_blk, v_blk, kseg0), jnp.arange(n_ring - 1)
+            step, (o0, m0, l0, k_blk, v_blk, kseg0), jnp.arange(n_hops - 1)
         )
-        o, m, l = accumulate(o, m, l, k_last, v_last, kseg_last, n_ring - 1)
+        o, m, l = accumulate(o, m, l, k_last, v_last, kseg_last, n_hops - 1)
         l = jnp.maximum(l, 1e-20)
         out = o / l[..., None].transpose(0, 2, 1, 3)
         return out.astype(q_blk.dtype)

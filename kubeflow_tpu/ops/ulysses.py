@@ -51,6 +51,7 @@ def ulysses_attention(
     segment_ids: jax.Array | None = None,
     block_q: int = 0,
     block_k: int = 0,
+    window: int = 0,
 ) -> jax.Array:
     """Causal attention over seq-sharded [B, L, H, D] via head all-to-all.
 
@@ -67,7 +68,7 @@ def ulysses_attention(
 
         return attention(q, k, v, causal=causal, impl=impl,
                          segment_ids=segment_ids,
-                         block_q=block_q, block_k=block_k)
+                         block_q=block_q, block_k=block_k, window=window)
 
     sp = mesh.shape[axis_name]
     h = q.shape[2]
@@ -120,7 +121,7 @@ def ulysses_attention(
 
         out = attention(q_g, k_g, v_g, causal=causal, impl=impl,
                         segment_ids=seg_full,
-                        block_q=block_q, block_k=block_k)
+                        block_q=block_q, block_k=block_k, window=window)
 
         # [b, L, h_loc/sp, d] -> [b, L/sp, h_loc, d]: scatter sequence,
         # gather heads.

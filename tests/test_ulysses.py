@@ -101,3 +101,38 @@ def test_ulysses_with_segments_matches_reference(devices8):
             q, k, v, mesh=mesh, segment_ids=s))(q, k, v, seg)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_with_window_matches_reference(devices8):
+    from kubeflow_tpu.ops.attention import reference_attention
+
+    mesh = build_mesh(MeshSpec(data=1, seq=4), devices=jax.devices()[:4])
+    q, k, v = make_qkv()
+    want = reference_attention(q, k, v, causal=True, window=10)
+    with mesh:
+        got = jax.jit(lambda q, k, v: ulysses_attention(
+            q, k, v, mesh=mesh, window=10))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_window_gradients(devices8):
+    from kubeflow_tpu.ops.attention import reference_attention
+
+    mesh = build_mesh(MeshSpec(data=1, seq=4), devices=jax.devices()[:4])
+    q, k, v = make_qkv(b=1)
+
+    def f_uly(q, k, v):
+        with mesh:
+            return (ulysses_attention(q, k, v, mesh=mesh, window=12)
+                    .astype(jnp.float32) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (reference_attention(q, k, v, causal=True, window=12)
+                .astype(jnp.float32) ** 2).sum()
+
+    g_uly = jax.grad(f_uly, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_uly, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=5e-4, rtol=5e-4)
