@@ -82,8 +82,10 @@ class TransformerConfig:
     # window-1 positions in the past are masked; flash skips the COMPUTE
     # of blocks left of the window (MXU work O(L * window); their DMA
     # still runs — see ops/flash_attention.py). 0 = full causal.
-    # Training-path only (flash/reference/ring/ulysses; decode rejects
-    # it).
+    # Supported by every attention path: flash/reference/ring/ulysses
+    # in training, and decode masks the cache identically (train/serve
+    # parity; the cache itself still holds max_seq positions — a
+    # bounded rolling cache is the noted follow-up).
     attention_window: int = 0
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
@@ -182,11 +184,6 @@ class Attention(nn.Module):
                 raise ValueError(
                     f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r} "
                     "(auto|int8)")
-            if cfg.attention_window:
-                # decoding full-cache while training windowed would be a
-                # silent train/serve mismatch
-                raise ValueError("attention_window decode is not "
-                                 "supported yet (train-path only)")
             quant = cfg.kv_cache_dtype == "int8"
             cache_dt = jnp.int8 if quant else cfg.dtype
             ck = self.variable(
@@ -259,9 +256,14 @@ class Attention(nn.Module):
                 # chunk; degenerates to pos <= idx at lq == 1)
                 qpos = (idx + jnp.arange(lq, dtype=jnp.int32)
                         )[None, None, None, :, None]
-                mask = pos <= qpos
             else:
-                mask = pos <= idx[:, None, None, None, None]
+                qpos = idx[:, None, None, None, None]
+            mask = pos <= qpos
+            if cfg.attention_window:
+                # same sliding window as training (train/serve parity);
+                # the cache still holds max_seq positions — a bounded
+                # rolling cache is the noted follow-up
+                mask = mask & (pos > qpos - cfg.attention_window)
             if pad_len is not None:
                 # left-padded ragged prompts: positions before each row's
                 # real start are pad garbage and must not be attended to

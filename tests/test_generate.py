@@ -216,3 +216,21 @@ def test_prefill_chunk_env_override(monkeypatch):
         model, params, G.init_cache(model, 2), prompt, None)
     np.testing.assert_allclose(np.asarray(l_new), np.asarray(l_old),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_windowed_decode_matches_windowed_full_forward():
+    """attention_window decode == the windowed training forward,
+    token for token (train/serve parity — the reason decode masks the
+    cache with the same window instead of rejecting the knob)."""
+    model, variables = make_model_and_params(
+        dtype=jnp.float32, attention_window=6, attention_impl="reference")
+    rng = jax.random.PRNGKey(4)
+    prompt = jax.random.randint(rng, (2, 8), 0, 256, jnp.int32)
+    out = generate(model, variables, prompt, max_new_tokens=6)
+    logits = model.apply(variables, out[:, :-1], train=False)
+    for i in range(6):
+        pos = 8 + i - 1
+        want = jnp.argmax(logits[:, pos], axis=-1)
+        np.testing.assert_array_equal(
+            np.asarray(out[:, 8 + i]), np.asarray(want),
+            err_msg=f"windowed decode token {i} diverges from train fwd")
