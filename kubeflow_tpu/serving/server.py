@@ -65,6 +65,17 @@ def predict_errors():
                    "failed predict requests", labelnames=("model",))
 
 
+def speculative_counters():
+    import prometheus_client as prom
+
+    return (_metric("serving_speculative_drafted_total", prom.Counter,
+                    "draft tokens proposed", labelnames=("model",)),
+            _metric("serving_speculative_accepted_total", prom.Counter,
+                    "draft tokens accepted by the target "
+                    "(accepted/drafted = acceptance rate; low rates mean "
+                    "the draft is wasting rounds)", labelnames=("model",)))
+
+
 @dataclass
 class ServedModel:
     """One versioned model: predict_fn maps a batched np array / dict of
@@ -737,12 +748,15 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             from kubeflow_tpu.runtime.speculative import speculative_generate
 
             dm, dv = _draft()
+            drafted_c, accepted_c = speculative_counters()
             outs = []
             for r in range(prompt.shape[0]):
-                toks, _ = speculative_generate(
+                toks, stats = speculative_generate(
                     model, use_vars, dm, dv, prompt[r:r + 1],
                     max_new_tokens=max_new_tokens, k=draft_k,
                     pad_len=jnp.asarray(pad_lens[r:r + 1], jnp.int32))
+                drafted_c.labels(model=name).inc(stats["drafted"])
+                accepted_c.labels(model=name).inc(stats["accepted"])
                 outs.append(np.asarray(toks)[0])
             return np.stack(outs)[:, prompt_len:]
         with (sm.mesh if sm is not None else contextlib.nullcontext()):

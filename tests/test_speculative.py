@@ -106,3 +106,20 @@ def test_served_speculative_rejects_bad_combos():
         serve_lm_generator("y", "transformer-test",
                            draft_model="transformer-test",
                            temperature=0.7)
+
+
+def test_served_speculative_exports_acceptance_metrics():
+    import prometheus_client
+
+    from kubeflow_tpu.serving.server import serve_lm_generator
+
+    spec = serve_lm_generator(
+        "specm", "transformer-test", prompt_len=8, max_new_tokens=4,
+        draft_model="transformer-test", draft_k=2)
+    try:
+        spec.predict([{"tokens": [4, 2]}])
+        scrape = prometheus_client.generate_latest().decode()
+        assert 'serving_speculative_drafted_total{model="specm"}' in scrape
+        assert 'serving_speculative_accepted_total{model="specm"}' in scrape
+    finally:
+        spec.close()
