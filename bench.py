@@ -120,7 +120,9 @@ def run_lm(args, devs):
     cfg = TrainConfig.from_dict(dict(
         model=args.lm_model,
         model_kwargs={"attention_impl": args.lm_attention,
-                      "max_seq_len": args.seq_len},
+                      "max_seq_len": args.seq_len,
+                      **({"attention_window": args.lm_window}
+                         if args.lm_window else {})},
         task="lm",
         global_batch=args.lm_batch,
         seq_len=args.seq_len,
@@ -162,6 +164,7 @@ def run_lm(args, devs):
         "remat_policy": args.lm_remat_policy,
         "xent_chunks": args.lm_xent_chunks,
         "grad_accum": args.lm_grad_accum,
+        **({"window": args.lm_window} if args.lm_window else {}),
         "n_params_m": round(trainer.n_params / 1e6, 1),
     }
     # echo the kernel-tuning env so sweep logs are self-describing and
@@ -176,7 +179,7 @@ def run_lm(args, devs):
 # promotion file (budget/choice knobs like --lm-min-budget-s do NOT)
 _LM_POINT_FLAGS = ("--lm-model", "--lm-batch", "--lm-optimizer",
                    "--lm-remat", "--lm-remat-policy", "--lm-attention",
-                   "--lm-xent-chunks", "--lm-grad-accum")
+                   "--lm-xent-chunks", "--lm-grad-accum", "--lm-window")
 
 
 def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
@@ -260,6 +263,8 @@ def main() -> int:
                         "logits tensor never materializes, freeing GBs of "
                         "activation memory at large batch; 0 = classic "
                         "full-logits loss")
+    p.add_argument("--lm-window", type=int, default=0,
+                   help="sliding-window attention width (0 = full causal)")
     p.add_argument("--lm-grad-accum", type=int, default=0,
                    help="split each step into this many microbatches "
                         "(lax.scan) with one averaged optimizer update; "

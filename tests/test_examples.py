@@ -68,10 +68,12 @@ def test_sweep_queue_builds_valid_bench_commands():
     whose flags bench.py actually defines (the queue and the CLI drift
     independently)."""
     from tools.lm_sweep import (BLOCK_GRID, PHASE2_POINTS, PHASE3_POINTS,
-                                PHASE4_POINTS, POINTS, bench_cmd)
+                                PHASE4_POINTS, PHASE5_POINTS, POINTS,
+                                bench_cmd)
 
     src = open(os.path.join(HERE, "bench.py")).read()
     for point in (POINTS + PHASE2_POINTS + PHASE3_POINTS + PHASE4_POINTS
+                  + PHASE5_POINTS
                   + [dict(POINTS[0], xent_chunks=8, grad_accum=2)]):
         cmd = bench_cmd(point)
         assert cmd[1] == "bench.py"
@@ -182,3 +184,26 @@ class TestLmPromotion:
         bp.write_text("{broken")
         args = mkargs()
         assert bench.apply_lm_promotion(args, [], best_path=str(bp)) == "flags"
+
+
+def test_promotion_skips_windowed_points(tmp_path, monkeypatch):
+    """Sliding-window sweep points do less attention work than the MFU
+    accounting assumes — their inflated 'MFU' must never win promotion."""
+    import sys as _sys
+
+    import tools.promote_best as pb
+
+    log = tmp_path / "lm_sweep.log"
+    log.write_text("\n".join([
+        json.dumps({"metric": "x", "lm": {
+            "model": "gpt-350m", "mfu": 0.9, "window": 512,
+            "optimizer": "adafactor", "global_batch": 8}}),
+        json.dumps({"metric": "x", "lm": {
+            "model": "gpt-350m", "mfu": 0.31,
+            "optimizer": "adafactor", "global_batch": 8}}),
+    ]) + "\n")
+    monkeypatch.setattr(pb, "HERE", str(tmp_path))
+    monkeypatch.setattr(_sys, "argv", ["promote", str(log)])
+    pb.main()
+    best = json.loads((tmp_path / "lm_best.json").read_text())
+    assert best["mfu"] == 0.31 and "window" not in best

@@ -101,6 +101,18 @@ PHASE4_POINTS: list[dict] = [
     dict(model="gpt-moe-8e", batch=8, remat="full", xent_chunks=8),
 ]
 
+# Phase 5 (--phase5): feature-cost ledger for the round-3 additions —
+# sliding-window attention A/B at the measured operating points, plus a
+# reconfirmation of the promoted best under the current code.
+PHASE5_POINTS: list[dict] = [
+    dict(model="gpt-350m", batch=8, xent_chunks=8),
+    dict(model="gpt-350m", batch=8, xent_chunks=8, window=512),
+    dict(model="gpt-350m", batch=8, xent_chunks=8, window=1024),
+    dict(model="llama-1b", batch=32, remat="full", xent_chunks=8),
+    dict(model="llama-1b", batch=32, remat="full", xent_chunks=8,
+         window=512),
+]
+
 # Flash-attention block grid, applied to the best point found above.
 # Phase-1 hardware: 128/128 0.227 < 256/256 0.368 < 256/512 0.434 <
 # 512/512 0.467 (llama-1b bs16) — monotone in block area so far, so the
@@ -120,6 +132,8 @@ def bench_cmd(point: dict) -> list[str]:
         cmd += ["--lm-xent-chunks", str(point["xent_chunks"])]
     if point.get("grad_accum"):
         cmd += ["--lm-grad-accum", str(point["grad_accum"])]
+    if point.get("window"):
+        cmd += ["--lm-window", str(point["window"])]
     return cmd
 
 
@@ -182,6 +196,8 @@ def main() -> int:
                        help="run the grad-accum PHASE3_POINTS queue instead")
     phase.add_argument("--phase4", action="store_true",
                        help="run the post-0.49-frontier PHASE4_POINTS queue")
+    phase.add_argument("--phase5", action="store_true",
+                       help="run the feature-cost PHASE5_POINTS queue")
     args = ap.parse_args()
 
     best: dict | None = None
@@ -196,12 +212,18 @@ def main() -> int:
             queue = PHASE3_POINTS
         elif args.phase4:
             queue = PHASE4_POINTS
+        elif args.phase5:
+            queue = PHASE5_POINTS
         for point in queue:
             print("point:", point, flush=True)
             lm = run_point(point, log, args.timeout)
             print("  ->", (f"mfu={lm['mfu']:.4f} {lm['tokens_per_sec']} tok/s"
                            if lm else "FAILED (see log)"), flush=True)
-            if lm and (best is None or lm["mfu"] > best["mfu"]):
+            # windowed points do less attention work than the MFU
+            # accounting assumes (same invariant as promote_best.py):
+            # they must not win the block-grid slot either
+            if (lm and not point.get("window")
+                    and (best is None or lm["mfu"] > best["mfu"])):
                 best, best_point = lm, point
         if best_point is not None and not args.skip_blocks:
             for bq, bk in BLOCK_GRID:

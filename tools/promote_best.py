@@ -31,6 +31,11 @@ def candidates(log_path: str):
         except ValueError:
             continue
         lm = doc.get("lm") or {}
+        if lm.get("window"):
+            # sliding-window points do LESS attention work than the MFU
+            # accounting assumes — their "MFU" is inflated and must never
+            # compete with full-causal points for the headline default
+            continue
         if isinstance(lm.get("mfu"), (int, float)) and lm["mfu"] > 0:
             yield lm
 
