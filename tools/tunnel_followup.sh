@@ -11,6 +11,8 @@ while true; do
   if timeout 180 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
     echo "tunnel UP $(date -u +%H:%M:%S) — phase4 sweep, microbench, bench" >> "$LOG"
     timeout 14400 python tools/lm_sweep.py --phase4 >> "$LOG" 2>&1
+    echo "--- phase5 feature-cost sweep $(date -u +%H:%M:%S)" >> "$LOG"
+    timeout 5400 python tools/lm_sweep.py --phase5 --skip-blocks >> "$LOG" 2>&1
     echo "--- microbench $(date -u +%H:%M:%S)" >> "$LOG"
     timeout 2400 python tools/op_microbench.py --batch 8 --seq 2048 \
       >> "$LOG" 2>&1
