@@ -274,6 +274,9 @@ def main() -> int:
                    help="wall-clock budget; the lm extra is skipped when "
                         "nearly spent (remote compiles can take minutes)")
     p.add_argument("--lm-min-budget-s", type=float, default=600.0)
+    p.add_argument("--force-cpu", action="store_true",
+                   help="testing only: run on the CPU backend (hermetic "
+                        "pipeline check; MFU numbers are meaningless)")
     p.add_argument("--lm-best", default="auto", choices=["auto", "off"],
                    help="auto: when no --lm-* flag is given explicitly and "
                         "tools/lm_best.json exists (written by the sweep's "
@@ -291,15 +294,17 @@ def main() -> int:
     # becomes a fast explicit failure instead of a hung bench.
     import subprocess
 
-    try:
-        probe = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=300, capture_output=True, text=True,
-            env=dict(os.environ))
-        probe_err = "" if probe.returncode == 0 else \
-            (probe.stderr or "")[-200:]
-    except subprocess.TimeoutExpired:
-        probe_err = "device init timed out after 300s"
+    probe_err = ""
+    if not args.force_cpu:  # CPU init can't hang; only the tunnel can
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=300, capture_output=True, text=True,
+                env=dict(os.environ))
+            probe_err = "" if probe.returncode == 0 else \
+                (probe.stderr or "")[-200:]
+        except subprocess.TimeoutExpired:
+            probe_err = "device init timed out after 300s"
     if probe_err:
         print(json.dumps({
             "metric": f"{args.model}_train_mfu", "unit": "fraction",
@@ -309,6 +314,9 @@ def main() -> int:
         return 3
 
     import jax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
 
     from kubeflow_tpu.runtime.metrics import peak_flops
 

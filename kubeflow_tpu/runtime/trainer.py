@@ -228,6 +228,10 @@ class Trainer:
                 kw.setdefault("flash_block_q", self.cfg.flash_block_q)
             if self.cfg.flash_block_k:
                 kw.setdefault("flash_block_k", self.cfg.flash_block_k)
+            # same guard as num_classes below: synthetic targets draw
+            # from cfg.vocab_size, and a model head with a different
+            # registry default would see out-of-range labels -> NaN loss
+            kw.setdefault("vocab_size", self.cfg.vocab_size)
         if self.cfg.task in ("classification", "seq_classification"):
             if kw.get("num_classes", self.cfg.num_classes) != self.cfg.num_classes:
                 # the data generator draws labels from cfg.num_classes; a

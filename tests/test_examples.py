@@ -207,3 +207,27 @@ def test_promotion_skips_windowed_points(tmp_path, monkeypatch):
     pb.main()
     best = json.loads((tmp_path / "lm_best.json").read_text())
     assert best["mfu"] == 0.31 and "window" not in best
+
+
+def test_bench_lm_pipeline_runs_hermetically():
+    """The driver's round-end bench must not be the first execution of
+    bench's LM code path: --force-cpu runs the whole pipeline (flag
+    parsing, promotion gating, trainer build, timing, JSON emit) on the
+    CPU backend with a tiny model."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # the conftest's 8-device virtual mesh must not leak into bench's
+    # single-device subprocess (batch 2 is not divisible 8 ways)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "host_platform_device_count" not in f)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(HERE, "bench.py"), "--force-cpu",
+         "--workload", "lm", "--lm-model", "transformer-test",
+         "--lm-batch", "2", "--seq-len", "64", "--steps", "2",
+         "--warmup", "1", "--lm-xent-chunks", "4"],
+        cwd=HERE, env=env, capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-500:]
+    doc = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert doc["on_tpu"] is False
+    assert doc["lm"]["tokens_per_sec"] > 0
+    assert doc["lm"]["xent_chunks"] == 4

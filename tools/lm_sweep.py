@@ -171,7 +171,7 @@ def run_point(point: dict, log, timeout: float, env=None) -> dict | None:
     # pages long and the canonical keyword scrolls out of any fixed tail.
     oom = any(m in err for m in (
         "RESOURCE_EXHAUSTED", "Out of memory", "Allocation type: HLO temp",
-        "exceeds the memory available"))
+        "exceeds the memory available", "scoped vmem limit"))
     # bench.py's fail-fast paths (e.g. dead tunnel) print their error
     # JSON to STDOUT and leave stderr empty — keep both tails so the
     # ledger stays actionable for every failure mode.
