@@ -252,11 +252,13 @@ def main() -> int:
     p.add_argument("--lm-remat", action="store_true",
                    help="rematerialize the forward (fits larger models)")
     p.add_argument("--lm-remat-policy", default="mlp",
-                   choices=["dots", "full", "mlp"],
+                   choices=["dots", "full", "mlp", "slim"],
                    help="dots keeps matmul outputs (cheap recompute); "
                         "full recomputes everything (min memory); mlp "
                         "drops only the d_ff-wide tensors (most of the "
-                        "memory win, small recompute tax)")
+                        "memory win, small recompute tax); slim saves "
+                        "ONLY the named d-wide anchors (whitelist — "
+                        "near-full-remat memory at roughly half the tax)")
     p.add_argument("--lm-xent-chunks", type=int, default=0,
                    help="compute the LM head + cross-entropy in this many "
                         "sequence chunks (ops/xent.py): the [B, L, V] "

@@ -27,6 +27,7 @@ from typing import Any, Optional
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import PartitionSpec as P
 
 from kubeflow_tpu.models.registry import register_model
@@ -97,6 +98,11 @@ class TransformerConfig:
     moe_every: int = 0
     n_experts: int = 8
     expert_top_k: int = 2
+    # Dispatch implementation (ops/moe.py): "auto" picks the sort+
+    # all-to-all sparse path on meshes it covers (fsdp/model/seq/pipe
+    # all 1), else the dense one-hot-einsum oracle; "dense"/"sparse"
+    # force one.
+    moe_impl: str = "auto"
     # Pipeline parallelism: split the block stack into this many stages
     # over the `pipe` mesh axis (0/1 = no pipelining).
     pipeline_stages: int = 0
@@ -136,15 +142,40 @@ def _remat_policy(cfg: "TransformerConfig"):
     if cfg.remat_policy == "full":
         return jax.checkpoint_policies.nothing_saveable
     if cfg.remat_policy == "mlp":
-        # Save every block intermediate EXCEPT the d_ff-wide MLP tensors
-        # (gate/up/h — tagged in SwiGLU). Those are ~75% of a block's
-        # activation bytes but only the gate+up matmuls (~2/9 of block
-        # MACs) to recompute: most of full-remat's memory win at a small
-        # fraction of its recompute tax.
-        return jax.checkpoint_policies.save_anything_except_these_names(
-            "mlp_wide")
+        # Save every block intermediate EXCEPT d_ff-wide ones (gate/up/
+        # silu/h). Implemented as a WIDTH predicate on the equation's
+        # input avals, not checkpoint_name tags: flax wraps activations
+        # like silu in jit, and a name applied after the pjit equation
+        # leaves the pjit's own output saveable — round 3 shipped the
+        # name-tag version and saved_residuals showed it retaining a
+        # full d_ff-wide tensor per layer, which is why "mlp" OOMed at
+        # the same batch sizes as no-remat (tools/remat_plan.py).
+        # Replay cost: the gate/up matmuls + elementwise, ~2/9 of block
+        # MACs.
+        wide = cfg.d_ff
+
+        def mlp_policy(prim, *avals, **params):
+            del prim, params
+            return not any(
+                getattr(a, "shape", None) and a.shape[-1] >= wide
+                for a in avals)
+
+        return mlp_policy
+    if cfg.remat_policy == "slim":
+        # Whitelist, not blacklist: save ONLY the named d-wide bf16
+        # anchors (norm outputs, post-rope q/k/v, pre-o attention
+        # context). "mlp" hardware runs OOMed at bs>=16 because
+        # save-everything-except also keeps every unnamed residual the
+        # backward touches — including the f32 RMSNorm duplicates, which
+        # alone match the entire dropped mlp_wide set in bytes. Replay
+        # recomputes gate/up (~2/9 of block MACs) and, because the flash
+        # kernel's lse residual lives inside its custom_vjp, the flash
+        # forward (~6% more at seq 2048): most of full remat's memory
+        # floor at roughly half its recompute tax.
+        return jax.checkpoint_policies.save_only_these_names(
+            "block_norm", "attn_qkv", "attn_ctx")
     raise ValueError(
-        f"unknown remat_policy {cfg.remat_policy!r} (full|dots|mlp)")
+        f"unknown remat_policy {cfg.remat_policy!r} (full|dots|mlp|slim)")
 
 
 class Attention(nn.Module):
@@ -169,6 +200,13 @@ class Attention(nn.Module):
         v = dense((cfg.n_kv_heads, cfg.head_dim), (AXIS_FSDP, AXIS_MODEL, None), "v")(x)
         q = rope(q, positions, cfg.rope_theta)
         k = rope(k, positions, cfg.rope_theta)
+        # remat anchors for the "slim" whitelist policy: saving post-rope
+        # q/k/v lets the flash backward run without recomputing the
+        # projections (its own fwd replay still happens — lse is a
+        # custom_vjp residual the policy can't reach)
+        q = checkpoint_name(q, "attn_qkv")
+        k = checkpoint_name(k, "attn_qkv")
+        v = checkpoint_name(v, "attn_qkv")
 
         if decode_index is not None:
             # KV-cache decode: x is the single new token [B, 1, ...]; write
@@ -297,6 +335,7 @@ class Attention(nn.Module):
                 block_q=cfg.flash_block_q, block_k=cfg.flash_block_k,
                 window=cfg.attention_window,
             )
+        out = checkpoint_name(out, "attn_ctx")
         # Row-parallel output projection: contraction dim sharded over
         # `model` — GSPMD inserts the all-reduce here.
         out = nn.DenseGeneral(
@@ -315,13 +354,16 @@ class SwiGLU(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        from jax.ad_checkpoint import checkpoint_name
-
         cfg = self.cfg
         init = nn.initializers.normal(0.02)
-        # Column-parallel up projections. The d_ff-wide tensors carry the
-        # "mlp_wide" checkpoint name so remat_policy="mlp" can drop
-        # exactly these (and nothing else) from the saved residuals.
+        # Column-parallel up projections. EVERY d_ff-wide tensor carries
+        # the "mlp_wide" checkpoint name so remat_policy="mlp" can drop
+        # exactly these from the saved residuals. That includes
+        # silu(gate): the product's backward consumes it, and round 3
+        # shipped it unnamed — saved_residuals showed the "mlp" policy
+        # retaining a full d_ff-wide tensor per layer anyway, which is
+        # why it OOMed at the same batch sizes as no-remat on hardware
+        # (tools/remat_plan.py).
         gate = checkpoint_name(nn.DenseGeneral(
             cfg.d_ff, use_bias=False, dtype=cfg.dtype,
             kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="gate",
@@ -330,7 +372,8 @@ class SwiGLU(nn.Module):
             cfg.d_ff, use_bias=False, dtype=cfg.dtype,
             kernel_init=_part(init, (AXIS_FSDP, AXIS_MODEL)), name="up",
         )(x), "mlp_wide")
-        h = checkpoint_name(shard(nn.silu(gate) * up, WIDE_SPEC), "mlp_wide")
+        sg = checkpoint_name(nn.silu(gate), "mlp_wide")
+        h = checkpoint_name(shard(sg * up, WIDE_SPEC), "mlp_wide")
         # Row-parallel down projection (psum on output)
         out = nn.DenseGeneral(
             x.shape[-1], use_bias=False, dtype=cfg.dtype,
@@ -374,16 +417,23 @@ class Block(nn.Module):
     def __call__(self, x, positions, segment_ids=None, decode_index=None,
                  pad_len=None):
         cfg = self.cfg
+        # "block_norm" anchors both norm outputs: they are the weight-grad
+        # inputs of the q/k/v and gate/up matmuls, so saving these d-wide
+        # bf16 tensors (instead of the f32 RMSNorm internals a blacklist
+        # policy keeps) is what lets the "slim" replay skip the norms.
+        ln1 = checkpoint_name(
+            RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), "block_norm")
         x = x + Attention(cfg, name="attn")(
-            RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), positions,
-            segment_ids, decode_index, pad_len
+            ln1, positions, segment_ids, decode_index, pad_len
         )
+        ln2 = checkpoint_name(
+            RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x), "block_norm")
         if self.use_moe:
             from kubeflow_tpu.ops.moe import MoEBlock
 
-            mlp_out = MoEBlock(cfg, name="moe")(RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x))
+            mlp_out = MoEBlock(cfg, name="moe")(ln2)
         else:
-            mlp_out = SwiGLU(cfg, name="mlp")(RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x))
+            mlp_out = SwiGLU(cfg, name="mlp")(ln2)
         return x + mlp_out
 
 
@@ -417,7 +467,14 @@ class TransformerLM(nn.Module):
         del train  # no dropout in the speed-run configuration
         emb = self.param(
             "embedding",
-            _part(nn.initializers.normal(1.0), (AXIS_MODEL, AXIS_FSDP)),
+            # vocab over (model, fsdp), d unsharded: the gradient of a
+            # d-over-fsdp table needs a batch-shard -> feature-shard
+            # reshard of dx that the pre-Shardy partitioner can only do
+            # as replicate-then-slice ("Involuntary full
+            # rematerialization"); vocab-sharding makes both the lookup
+            # and the grad scatter the standard ZeRO gather/scatter over
+            # the vocab dim instead
+            _part(nn.initializers.normal(1.0), ((AXIS_MODEL, AXIS_FSDP), None)),
             (cfg.vocab_size, cfg.d_model),
             jnp.float32,
         )

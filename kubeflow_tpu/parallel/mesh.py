@@ -57,7 +57,12 @@ _AXIS_ORDER = (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT,
 
 # Every batch-sharded PartitionSpec uses this tuple; size-1 axes are free,
 # so single-slice meshes pay nothing for carrying the dcn name.
-BATCH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP)
+# `expert` is a batch axis too (GShard-style): outside MoE layers the
+# expert dimension has nothing to shard, and leaving tokens replicated
+# across it would duplicate every dense block's compute ep-fold. Inside
+# an MoE layer the token<->expert regrouping is exactly the all-to-all
+# over this axis (ops/moe.py).
+BATCH_AXES = (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_EXPERT)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -179,7 +184,9 @@ def replicated(mesh: Mesh) -> NamedSharding:
 
 
 def local_batch_size(mesh: Mesh, global_batch: int) -> int:
-    n = mesh.shape[AXIS_DCN] * mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    n = 1
+    for a in BATCH_AXES:
+        n *= mesh.shape[a]
     if global_batch % n:
         raise ValueError(f"global batch {global_batch} not divisible by dp={n}")
     return global_batch // n

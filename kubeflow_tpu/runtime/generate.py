@@ -55,7 +55,7 @@ def check_decode_geometry(model, prompt_len: int, max_new_tokens: int) -> None:
 PREFILL_CHUNK = 128
 
 
-def prefill_scan(model, params, cache, prompts, pad_len):
+def prefill_scan(model, params, cache, prompts, pad_len, chunk=0):
     """Run a [B, P] prompt through the KV cache in position chunks
     (cache-correct by construction: each chunk writes its K/V before
     attending, and the causal mask covers within-chunk order); returns
@@ -63,11 +63,16 @@ def prefill_scan(model, params, cache, prompts, pad_len):
     chunk (P % width) runs as one extra apply, so EVERY prompt length
     gets GEMM-shaped prefill — never a per-token GEMV tail. The ONE
     prefill implementation — generate(), the slot decoder, and
-    speculative decode must never drift apart here."""
+    speculative decode must never drift apart here.
+
+    `chunk` is the static chunk width (0 = KFTPU_PREFILL_CHUNK env, else
+    PREFILL_CHUNK). NOTE the env var is read at TRACE time: jitted
+    callers bake it into their compiled program and changing it later in
+    the same process has no effect (the jit cache key does not include
+    it) — pass `chunk` explicitly for in-process A/Bs; the env hook is
+    for per-process sweeps like tools/serve_bench.py."""
     b, lp = prompts.shape
-    # env override (read at trace time) so hardware sweeps can A/B chunk
-    # widths — same hook pattern as KFTPU_FLASH_BLOCK_Q/K
-    width = int(os.environ.get("KFTPU_PREFILL_CHUNK", PREFILL_CHUNK))
+    width = chunk or int(os.environ.get("KFTPU_PREFILL_CHUNK", PREFILL_CHUNK))
     c = min(max(width, 1), lp)
     n_full, rem = (lp // c, lp % c) if c else (0, 0)
     logits = jnp.zeros((b, model.cfg.vocab_size), jnp.float32)

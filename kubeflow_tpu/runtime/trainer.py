@@ -29,6 +29,7 @@ from kubeflow_tpu.parallel.mesh import (
     AXIS_DCN,
     AXIS_FSDP,
     AXIS_PIPELINE,
+    BATCH_AXES,
     MeshSpec,
     build_mesh,
     batch_sharding,
@@ -330,9 +331,11 @@ class Trainer:
     # ---- build jitted fns ------------------------------------------------
 
     def _dp_size(self) -> int:
-        """Ways the batch axis is sharded (dcn * data * fsdp)."""
-        return (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
-                * self.mesh.shape[AXIS_FSDP])
+        """Ways the batch axis is sharded (dcn * data * fsdp * expert)."""
+        n = 1
+        for a in BATCH_AXES:
+            n *= self.mesh.shape[a]
+        return n
 
     def _init_fn(self, rng):
         batch = self._example_batch()
@@ -367,11 +370,14 @@ class Trainer:
         # (it rejects string kwargs like mutable=[...]). seg is the
         # optional [B, L] sequence-packing ids (LM batches only) — the
         # flash kernel masks cross-document attention from them.
+        # "diagnostics" carries per-step observability sows (MoE dispatch
+        # fill/drop — ops/moe.py) that must NOT contribute to the loss.
+        _MUTABLE = ["batch_stats", "losses", "diagnostics"]
+
         def forward(variables, x, seg=None):
             kw = {"segment_ids": seg} if seg is not None else {}
             return self.model.apply(
-                variables, x, train=True, mutable=["batch_stats", "losses"],
-                **kw
+                variables, x, train=True, mutable=_MUTABLE, **kw
             )
 
         if cfg.remat and not self._model_self_remat:
@@ -401,7 +407,7 @@ class Trainer:
                 kw = {"segment_ids": seg} if seg is not None else {}
                 return self.model.apply(
                     variables, x, train=True, return_hidden=True,
-                    mutable=["batch_stats", "losses"], **kw)
+                    mutable=_MUTABLE, **kw)
 
             def chunked_loss_acc(params, hidden, y):
                 return chunked_lm_xent(
@@ -431,7 +437,20 @@ class Trainer:
             # so packed microbatches with uneven -1 masking still combine
             # into the exact full-batch token-weighted mean
             n_valid = jnp.sum(y >= 0)
-            return loss, (new_vars.get("batch_stats", {}), acc, n_valid)
+            # mean each diagnostics sow into one scalar per name (the
+            # sow name is the innermost dict key; sows across layers
+            # average), e.g. moe_fill / moe_drop
+            from jax.tree_util import tree_flatten_with_path
+
+            sums: dict = {}
+            for path, v in tree_flatten_with_path(
+                    new_vars.get("diagnostics", {}))[0]:
+                name = next((p.key for p in reversed(path)
+                             if hasattr(p, "key")), None)
+                if name is not None:
+                    sums.setdefault(str(name), []).append(v)
+            diag = {k: sum(v) / len(v) for k, v in sums.items() if v}
+            return loss, (new_vars.get("batch_stats", {}), acc, n_valid, diag)
 
         accum = max(1, cfg.grad_accum_steps)
         if accum > 1:
@@ -443,7 +462,7 @@ class Trainer:
             if (cfg.global_batch // accum) % dp:
                 raise ValueError(
                     f"microbatch {cfg.global_batch // accum} not divisible "
-                    f"by the {dp}-way batch sharding (dcn*data*fsdp)")
+                    f"by the {dp}-way batch sharding (dcn*data*fsdp*expert)")
             if (mesh.shape.get(AXIS_PIPELINE, 1) > 1
                     and (cfg.global_batch // accum) % cfg.pp_microbatches):
                 raise ValueError(
@@ -455,13 +474,25 @@ class Trainer:
             """[B, ...] -> [accum, B/accum, ...] with a STRIDED row split:
             row r lands in microbatch r % accum, so each microbatch draws
             evenly from every device's contiguous batch shard (a block
-            split would put whole microbatches on a subset of the mesh)."""
-            return jax.tree.map(
-                lambda a: a.reshape(
-                    (a.shape[0] // accum, accum) + a.shape[1:]).swapaxes(0, 1),
-                batch)
+            split would put whole microbatches on a subset of the mesh).
 
-        def _apply_update(state, grads, new_stats, loss, acc):
+            The split is device-local under the batch sharding: row
+            j*accum+m of a contiguous dp shard maps to row j of the same
+            shard in microbatch m. GSPMD cannot see that through
+            reshape+swapaxes on its own — without an explicit constraint
+            it replicates the stacked tensor and re-partitions it every
+            scan iteration ("[SPMD] Involuntary full rematerialization"),
+            a per-step full-batch broadcast on real dcn×fsdp jobs."""
+            def split(a):
+                a = a.reshape(
+                    (a.shape[0] // accum, accum) + a.shape[1:]).swapaxes(0, 1)
+                spec = P(None, BATCH_AXES, *([None] * (a.ndim - 2)))
+                return jax.lax.with_sharding_constraint(
+                    a, NamedSharding(mesh, spec))
+
+            return jax.tree.map(split, batch)
+
+        def _apply_update(state, grads, new_stats, loss, acc, diag=None):
             updates, new_opt = state.tx.update(grads, state.opt_state, state.params)
             new_params = optax.apply_updates(state.params, updates)
             new_state = state.replace(
@@ -470,13 +501,14 @@ class Trainer:
                 batch_stats=new_stats,
                 opt_state=new_opt,
             )
-            return new_state, {"loss": loss, "accuracy": acc}
+            return new_state, {"loss": loss, "accuracy": acc, **(diag or {})}
 
         def train_step(state: TrainState, batch):
-            (loss, (new_stats, acc, _)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            (loss, (new_stats, acc, _, diag)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(
                 state.params, state.batch_stats, batch
             )
-            return _apply_update(state, grads, new_stats, loss, acc)
+            return _apply_update(state, grads, new_stats, loss, acc, diag)
 
         def train_step_accum(state: TrainState, batch):
             # Per-microbatch losses are means over that microbatch's VALID
@@ -489,17 +521,26 @@ class Trainer:
             # proportionally more balancing pressure.
             def body(carry, microbatch):
                 stats, g_sum, loss_sum, acc_sum, n_sum = carry
-                (loss, (new_stats, acc, n)), grads = jax.value_and_grad(
+                # re-pin the batch sharding on the scanned slice: the scan
+                # carries only the stacked tensor's sharding, and the
+                # sliced view needs the same anchor or the whole forward
+                # propagates from an unconstrained operand
+                microbatch = jax.tree.map(
+                    lambda a: jax.lax.with_sharding_constraint(
+                        a, NamedSharding(
+                            mesh, P(BATCH_AXES, *([None] * (a.ndim - 1))))),
+                    microbatch)
+                (loss, (new_stats, acc, n, diag)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True)(state.params, stats, microbatch)
                 w = n.astype(jnp.float32)
                 return (new_stats,
                         jax.tree.map(lambda a, g: a + g * w, g_sum, grads),
                         loss_sum + loss * w, acc_sum + acc * w,
-                        n_sum + w), None
+                        n_sum + w), diag
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (new_stats, g_sum, loss_sum, acc_sum, n_sum), _ = jax.lax.scan(
+            (new_stats, g_sum, loss_sum, acc_sum, n_sum), diags = jax.lax.scan(
                 body,
                 (state.batch_stats, zeros, jnp.float32(0.0),
                  jnp.float32(0.0), jnp.float32(0.0)),
@@ -507,8 +548,9 @@ class Trainer:
             n = jnp.maximum(n_sum, 1.0)
             grads = jax.tree.map(
                 lambda g, p: (g / n).astype(p.dtype), g_sum, state.params)
+            diag = jax.tree.map(lambda a: a.mean(), diags)
             return _apply_update(state, grads, new_stats,
-                                 loss_sum / n, acc_sum / n)
+                                 loss_sum / n, acc_sum / n, diag)
 
         self._train_step = jax.jit(
             train_step_accum if accum > 1 else train_step, donate_argnums=(0,))
@@ -692,10 +734,18 @@ class Trainer:
             last_eval = {k: v / max(1, cfg.eval_steps) for k, v in sums.items()}
             if cfg.task == "lm":
                 last_eval["perplexity"] = _m.exp(min(last_eval["loss"], 30.0))
+            # Without eval_data_path this "eval" reads the TRAINING source
+            # at a shifted seed — a smoke check, not held-out perplexity
+            # (with shuffle_buffer=0 it scores the training shards'
+            # leading window verbatim). Mark it so the gauges, the log
+            # line, and the summary can't be mistaken for generalization.
+            smoke = not cfg.eval_data_path
+            last_eval["smoke"] = float(smoke)
+            kind = "training-data smoke eval" if smoke else "held-out eval"
             for k, v in last_eval.items():
                 rt_metrics.REGISTRY.gauge(f"jaxrt_eval_{k}", v,
-                                          f"held-out eval {k}")
-            log.info("eval @ step %d: %s", gstep,
+                                          f"{kind} {k}")
+            log.info("%s @ step %d: %s", kind, gstep,
                      " ".join(f"{k}={v:.4f}" for k, v in sorted(last_eval.items())))
 
         from kubeflow_tpu.runtime.profiler import TraceWindow

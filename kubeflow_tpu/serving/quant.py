@@ -43,19 +43,28 @@ def _is_qleaf(node: Any) -> bool:
 def quantize_params(variables: Any, min_size: int = 4096) -> Any:
     """int8-quantize every floating leaf with ndim >= 2 and at least
     ``min_size`` elements (norm scales / biases stay exact — they are a
-    rounding error of total bytes but matter for quality)."""
+    rounding error of total bytes but matter for quality).
+
+    Matmul kernels scale per-output-channel (amax over all axes but the
+    last). Embedding-like tables scale per-ROW instead: their rows are
+    looked up independently, and a trailing-axis-shared scale would
+    quantize every rare token's row against the largest row's amax."""
 
     from kubeflow_tpu.ops.quantize import symmetric_int8
 
-    def leaf(x):
+    def leaf(path, x):
         if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
                 and x.ndim >= 2 and x.size >= min_size):
             return x
-        # per-output-channel: scale shared over all axes but the last
-        q, scale = symmetric_int8(x, tuple(range(x.ndim - 1)))
+        keys = {getattr(p, "key", None) for p in path}
+        if "embedding" in keys:
+            axes = tuple(range(1, x.ndim))       # per-row (vocab entry)
+        else:
+            axes = tuple(range(x.ndim - 1))      # per-output-channel
+        q, scale = symmetric_int8(x, axes)
         return {"int8": q, "scale": scale}
 
-    return jax.tree.map(leaf, variables)
+    return jax.tree_util.tree_map_with_path(leaf, variables)
 
 
 def dequantize_params(variables: Any, dtype=jnp.bfloat16) -> Any:

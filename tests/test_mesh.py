@@ -48,8 +48,9 @@ def test_batch_sharding_puts_batch_on_data(devices8):
     mesh = build_mesh(MeshSpec(data=4, fsdp=2))
     x = np.arange(16 * 3, dtype=np.float32).reshape(16, 3)
     xs = jax.device_put(x, batch_sharding(mesh, extra_dims=1))
-    # batch dim sharded over dcn*data*fsdp = 8 (dcn size 1 is free)
-    assert xs.sharding.spec == P(("dcn", AXIS_DATA, "fsdp"), None)
+    # batch dim sharded over dcn*data*fsdp*expert = 8 (size-1 axes free;
+    # expert is a batch axis so EP meshes don't duplicate dense compute)
+    assert xs.sharding.spec == P(("dcn", AXIS_DATA, "fsdp", "expert"), None)
     np.testing.assert_array_equal(np.asarray(xs), x)
 
 
@@ -75,7 +76,7 @@ class TestDcnAxis:
         spec = MeshSpec(dcn=2, model=2).resolve(8)
         assert spec.data == 2
         assert spec.axis_sizes()[AXIS_DCN] == 2
-        assert BATCH_AXES == (AXIS_DCN, AXIS_DATA, "fsdp")
+        assert BATCH_AXES == (AXIS_DCN, AXIS_DATA, "fsdp", "expert")
         assert spec.batch_axes == BATCH_AXES
 
     def test_build_mesh_dcn_outermost_contiguous_ranks(self, devices8):

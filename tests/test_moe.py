@@ -1,0 +1,141 @@
+"""MoE dispatch: sparse (sort + all-to-all) vs dense (one-hot einsum)
+oracle, dispatch diagnostics, and the expert-as-batch-axis regime.
+
+The dense path is the correctness oracle (SURVEY.md §2.5: the TPU-native
+EP design is "all-to-all dispatch over ICI"; the dense einsum is the
+GShard formulation GSPMD can partition on any mesh). The sparse path must
+produce the same module output whenever no token overflows capacity —
+the two differ only in WHICH overflow tokens drop (per-row vs per-shard
+arrival order), so tests pin ample capacity.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer
+
+
+def lm_cfg(**kw):
+    base = dict(
+        model="moe-test",
+        task="lm",
+        global_batch=8,
+        seq_len=16,
+        vocab_size=256,
+        optimizer="adamw",
+        learning_rate=1e-3,
+        total_steps=2,
+        warmup_steps=1,
+    )
+    base.update(kw)
+    return TrainConfig.from_dict(base)
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
+
+
+def _one_step_loss(cfg, devs):
+    mesh = build_mesh(cfg.mesh, devices=devs)
+    trainer = Trainer(cfg, mesh=mesh)
+    state = trainer.init_state()
+    state, m = trainer.train_step(state, next(trainer.data_iter()))
+    return float(m["loss"]), m
+
+
+def test_sparse_matches_dense_on_ep_mesh(devices8):
+    """Same seed, same tokens, ample capacity: the sparse all-to-all
+    path must reproduce the dense oracle's loss on a dp x ep mesh."""
+    mesh = MeshSpec(data=2, expert=4)
+    dense_cfg = lm_cfg(model_kwargs={"moe_impl": "dense"}, mesh=mesh)
+    sparse_cfg = lm_cfg(model_kwargs={"moe_impl": "sparse"}, mesh=mesh)
+    loss_d, _ = _one_step_loss(dense_cfg, devices8)
+    loss_s, m_s = _one_step_loss(sparse_cfg, devices8)
+    # bf16 forward, different contraction orders: small tolerance
+    assert abs(loss_d - loss_s) < 5e-2, (loss_d, loss_s)
+    assert np.isfinite(loss_s)
+
+
+def test_sparse_reports_dispatch_diagnostics(devices8):
+    cfg = lm_cfg(model_kwargs={"moe_impl": "sparse"},
+                 mesh=MeshSpec(data=2, expert=4))
+    _, m = _one_step_loss(cfg, devices8)
+    assert 0.0 < float(m["moe_fill"]) <= 1.0, m
+    assert 0.0 <= float(m["moe_drop"]) < 1.0, m
+
+
+def test_dense_reports_dispatch_diagnostics(devices8):
+    cfg = lm_cfg(model_kwargs={"moe_impl": "dense"},
+                 mesh=MeshSpec(data=2, expert=4))
+    _, m = _one_step_loss(cfg, devices8)
+    assert 0.0 < float(m["moe_fill"]) <= 1.0, m
+
+
+def test_auto_uses_sparse_on_pure_ep_mesh(devices8):
+    """moe_impl=auto on dcn/data/expert-only meshes takes the sparse
+    path (observable: sparse + dense diverge once tokens overflow, but
+    both must train finitely either way — here just assert it runs and
+    the diagnostics exist, which only the instrumented paths emit)."""
+    cfg = lm_cfg(mesh=MeshSpec(data=4, expert=2))
+    loss, m = _one_step_loss(cfg, devices8)
+    assert np.isfinite(loss)
+    assert "moe_fill" in m
+
+
+def test_sparse_single_device_no_mesh_matches_dense():
+    """ep=1, no mesh: sparse degenerates to local sort+scatter and must
+    match the dense oracle closely (same tokens kept at high capacity)."""
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.ops import moe as moe_mod
+
+    rng = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(rng, (2, 16), 0, 256)
+
+    outs = {}
+    for impl in ("dense", "sparse"):
+        model = get_model("moe-test", moe_impl=impl)
+        # force the sparse branch decision even without a mesh by
+        # monkeypatching the gate: no mesh means _sparse_ok is False for
+        # "auto"/"sparse" (shard_map needs a mesh), so call the kernel
+        # directly below instead for the no-mesh case.
+        variables = model.init(jax.random.PRNGKey(1), tokens, train=True)
+        out = model.apply(variables, tokens, train=True)
+        outs[impl] = np.asarray(out, np.float32)
+    # no mesh -> both configs ran the dense path; sanity equality
+    np.testing.assert_allclose(outs["dense"], outs["sparse"], rtol=0, atol=0)
+
+    # now the sparse kernel itself vs the dense math on one shard
+    cfg = get_model("moe-test").cfg
+    d, e, k = cfg.d_model, cfg.n_experts, cfg.expert_top_k
+    t = 32
+    key = jax.random.PRNGKey(2)
+    x = jax.random.normal(key, (t, d), jnp.float32).astype(cfg.dtype)
+    gate_idx = jax.random.randint(key, (t, k), 0, e)
+    gate_vals = jax.nn.softmax(jax.random.normal(key, (t, k)), axis=-1)
+    wg = jax.random.normal(key, (e, d, cfg.d_ff), jnp.float32) * 0.02
+    wu = jax.random.normal(key, (e, d, cfg.d_ff), jnp.float32) * 0.02
+    wd = jax.random.normal(key, (e, cfg.d_ff, d), jnp.float32) * 0.02
+
+    y, fill, routed = moe_mod.sparse_dispatch_mlp(
+        cfg, x, gate_vals, gate_idx, wg, wu, wd, capacity_factor=8.0)
+    assert int(routed) == t * k
+    assert int(fill) == t * k  # ample capacity: nothing drops
+
+    # dense reference: run each (token, slot) through its expert
+    xin = x.astype(jnp.float32)
+    y_ref = np.zeros((t, d), np.float32)
+    for ti in range(t):
+        for ki in range(k):
+            ei = int(gate_idx[ti, ki])
+            g = jax.nn.silu(xin[ti] @ wg[ei]) * (xin[ti] @ wu[ei])
+            y_ref[ti] += float(gate_vals[ti, ki]) * np.asarray(
+                (g @ wd[ei]), np.float32)
+    np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
+                               rtol=0.1, atol=0.05)

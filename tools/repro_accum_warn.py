@@ -1,0 +1,38 @@
+"""Repro: which config emits the SPMD involuntary-remat warning, and on
+which tensor. Run: python tools/repro_accum_warn.py '{"grad_accum_steps": 2, ...}'"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh  # noqa: E402
+from kubeflow_tpu.runtime.trainer import TrainConfig, Trainer  # noqa: E402
+
+over = json.loads(sys.argv[1]) if len(sys.argv) > 1 else {}
+mesh_kw = over.pop("mesh", dict(dcn=2, data=2, fsdp=2))
+base = dict(
+    model="transformer-test",
+    model_kwargs={"attention_impl": "reference"},
+    task="lm", global_batch=16, seq_len=16, vocab_size=256,
+    mesh=MeshSpec(**mesh_kw),
+    optimizer="adafactor", learning_rate=1e-3, total_steps=1,
+    warmup_steps=1, grad_accum_steps=2, xent_chunks=4,
+)
+base.update(over)
+cfg = TrainConfig.from_dict(base)
+
+mesh = build_mesh(cfg.mesh, devices=jax.devices()[:8])
+trainer = Trainer(cfg, mesh=mesh)
+state = trainer.init_state()
+state, m = trainer.train_step(state, next(trainer.data_iter()))
+print("loss", float(m["loss"]))
