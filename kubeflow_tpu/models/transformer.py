@@ -138,7 +138,14 @@ class RMSNorm(nn.Module):
 
 def _remat_policy(cfg: "TransformerConfig"):
     if cfg.remat_policy == "dots":
-        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+        # dot outputs PLUS the flash kernel's named residuals (out, lse —
+        # tagged inside its custom_vjp fwd rule, ops/flash_attention.py):
+        # pallas_call is not a dot, so plain dots_saveable would replay
+        # the whole flash forward in the backward (~6.5% of block MACs at
+        # seq 2048) for want of an lse it threw away.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_flash"))
     if cfg.remat_policy == "full":
         return jax.checkpoint_policies.nothing_saveable
     if cfg.remat_policy == "mlp":
@@ -164,16 +171,16 @@ def _remat_policy(cfg: "TransformerConfig"):
     if cfg.remat_policy == "slim":
         # Whitelist, not blacklist: save ONLY the named d-wide bf16
         # anchors (norm outputs, post-rope q/k/v, pre-o attention
-        # context). "mlp" hardware runs OOMed at bs>=16 because
-        # save-everything-except also keeps every unnamed residual the
-        # backward touches — including the f32 RMSNorm duplicates, which
-        # alone match the entire dropped mlp_wide set in bytes. Replay
-        # recomputes gate/up (~2/9 of block MACs) and, because the flash
-        # kernel's lse residual lives inside its custom_vjp, the flash
-        # forward (~6% more at seq 2048): most of full remat's memory
-        # floor at roughly half its recompute tax.
+        # context, and the flash kernel's out/lse residuals). "mlp"
+        # hardware runs OOMed at bs>=16 because save-everything-except
+        # also keeps every unnamed residual the backward touches —
+        # including the f32 RMSNorm duplicates, which alone match the
+        # entire dropped mlp_wide set in bytes. Replay recomputes
+        # gate/up + elementwise (~2/9 of block MACs): most of full
+        # remat's memory floor at roughly half its recompute tax, with
+        # zero flash-forward replay.
         return jax.checkpoint_policies.save_only_these_names(
-            "block_norm", "attn_qkv", "attn_ctx")
+            "block_norm", "attn_qkv", "attn_ctx", "attn_flash")
     raise ValueError(
         f"unknown remat_policy {cfg.remat_policy!r} (full|dots|mlp|slim)")
 

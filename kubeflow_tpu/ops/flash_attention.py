@@ -494,7 +494,17 @@ def _flash_vjp_fwd(q, k, v, qseg, kseg, scale, causal, block_q, block_k,
     out, lse = _flash_fwd(q, k, v, scale, causal, block_q, block_k,
                           _interpret_default(), qseg=qseg, kseg=kseg,
                           window=window)
-    return out, (q, k, v, qseg, kseg, out, lse)
+    # jax.checkpoint partial-eval looks THROUGH custom_vjp fwd rules, so
+    # these residuals are policy-visible equations: naming them lets a
+    # remat policy keep exactly (out, lse) — and with q/k/v anchored by
+    # the model, the backward then runs with ZERO flash-forward replay.
+    # checkpoint_name is identity outside remat; the hot path is
+    # unchanged.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out_r = checkpoint_name(out, "attn_flash")
+    lse_r = checkpoint_name(lse, "attn_flash")
+    return out, (q, k, v, qseg, kseg, out_r, lse_r)
 
 
 def _flash_vjp_bwd(scale, causal, block_q, block_k, window, res, g):

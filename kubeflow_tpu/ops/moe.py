@@ -82,7 +82,10 @@ def sparse_dispatch_mlp(cfg, x_local, gate_vals, gate_idx, w_gate, w_up,
     there is no mesh): x_local [t, d] flattened tokens, gate_* [t, k],
     weights [e_local, ...]. When ep_axis is set, buffers are exchanged
     across it (global experts e = e_local * ep). Returns (y [t, d],
-    fill_count scalar, routed_count scalar).
+    fill_count, routed_count, slot_count) — slot_count is THIS shard's
+    allocated capacity slots (e * cap), the denominator for the fill
+    diagnostic (per-shard capacity rounds differently from the dense
+    per-row formula, so callers must not recompute it).
     """
     t, d = x_local.shape
     k = gate_idx.shape[-1]
@@ -135,7 +138,8 @@ def sparse_dispatch_mlp(cfg, x_local, gate_vals, gate_idx, w_gate, w_up,
     w = jnp.where(keep, gate_vals.reshape(-1)[order], 0.0)
     y = jnp.zeros((t, d), jnp.float32).at[sorted_tok].add(
         contrib.astype(jnp.float32) * w[:, None])
-    return y.astype(cfg.dtype), jnp.sum(keep), jnp.asarray(t * k)
+    return (y.astype(cfg.dtype), jnp.sum(keep), jnp.asarray(t * k),
+            jnp.asarray(e * cap))
 
 
 class MoEBlock(nn.Module):
@@ -146,7 +150,17 @@ class MoEBlock(nn.Module):
 
     def _sparse_ok(self, mesh) -> bool:
         impl = getattr(self.cfg, "moe_impl", "auto")
-        if impl == "dense" or mesh is None:
+        if impl == "dense":
+            return False
+        if mesh is None:
+            # No mesh context -> dense, even when sparse is forced:
+            # init-time traces (jax.eval_shape of model.init) legitimately
+            # run outside the mesh context, so raising here would break
+            # every forced-sparse config before its first step. Trainer
+            # steps always carry the mesh; a truly meshless forced-sparse
+            # run therefore measures the DENSE path — single-chip A/Bs
+            # must go through the trainer/bench (which always build a
+            # mesh) for the label to mean what it says.
             return False
         ep = mesh.shape.get(AXIS_EXPERT, 1)
         # preconditions of the shard_map formulation: tokens sharded over
@@ -185,11 +199,10 @@ class MoEBlock(nn.Module):
 
         mesh = current_mesh()
         if self._sparse_ok(mesh):
-            y, fill, routed = self._sparse(
+            y, kept, routed, slots = self._sparse(
                 x, gate_vals, gate_idx, w_gate, w_up, w_down, mesh)
-            kept = fill
         else:
-            y, kept, routed = self._dense(
+            y, kept, routed, slots = self._dense(
                 x, gate_vals, gate_idx, w_gate, w_up, w_down)
 
         # aux load-balancing loss: mean_e (dispatch fraction * prob mass),
@@ -204,12 +217,14 @@ class MoEBlock(nn.Module):
         aux = e * jnp.sum(me * ce)
         self.sow("losses", "moe_aux", aux)
         # dispatch diagnostics (VERDICT r3 #5): how much of the capacity
-        # buffer is padding, and how much routing overflowed
-        total_slots = jnp.asarray(
-            e * max(1, int(self.capacity_factor * s * k / e)) * b,
-            jnp.float32)
+        # buffer is padding, and how much routing overflowed. `slots` is
+        # reported by the path that allocated them — the sparse path's
+        # per-shard capacity (cf*t_local*k/e) rounds differently from the
+        # dense per-row formula, so recomputing it here would let
+        # moe_fill exceed 1.
         self.sow("diagnostics", "moe_fill",
-                 kept.astype(jnp.float32) / jnp.maximum(total_slots, 1.0))
+                 kept.astype(jnp.float32)
+                 / jnp.maximum(slots.astype(jnp.float32), 1.0))
         self.sow("diagnostics", "moe_drop",
                  1.0 - kept.astype(jnp.float32)
                  / jnp.maximum(routed.astype(jnp.float32), 1.0))
@@ -268,7 +283,8 @@ class MoEBlock(nn.Module):
             y = shard_constraint(y, P(noexp, None, None))
             y = shard_constraint(y, P(BATCH_AXES, None, None))
         kept = jnp.sum(assign)
-        return y, kept, jnp.asarray(b * s * k, jnp.float32)
+        return (y, kept, jnp.asarray(b * s * k, jnp.float32),
+                jnp.asarray(b * e * capacity, jnp.float32))
 
     # ---- sparse (all-to-all) path ---------------------------------------
 
@@ -282,22 +298,23 @@ class MoEBlock(nn.Module):
 
         def body(xl, gvl, gil, wg, wu, wd):
             bl = xl.shape[0]
-            y, fill, routed = sparse_dispatch_mlp(
+            y, fill, routed, slots = sparse_dispatch_mlp(
                 cfg, xl.reshape(bl * s, d), gvl.reshape(bl * s, -1),
                 gil.reshape(bl * s, -1), wg, wu, wd, cf,
                 ep_axis=AXIS_EXPERT)
             # diagnostics are global sums: reduce over the token shards
             fill = jax.lax.psum(fill, tok_axes)
             routed = jax.lax.psum(routed, tok_axes)
-            return y.reshape(bl, s, d), fill, routed
+            slots = jax.lax.psum(slots, tok_axes)
+            return y.reshape(bl, s, d), fill, routed, slots
 
         tok_spec = P(tok_axes, None, None)
         gate_spec = P(tok_axes, None, None)
-        y, fill, routed = shard_map(
+        y, fill, routed, slots = shard_map(
             body, mesh=mesh,
             in_specs=(tok_spec, gate_spec, gate_spec,
                       P(AXIS_EXPERT, None, None), P(AXIS_EXPERT, None, None),
                       P(AXIS_EXPERT, None, None)),
-            out_specs=(tok_spec, P(), P()),
+            out_specs=(tok_spec, P(), P(), P()),
         )(x, gate_vals, gate_idx, w_gate, w_up, w_down)
-        return y, fill, routed
+        return y, fill, routed, slots

@@ -83,6 +83,12 @@ class SPMDPipeline(nn.Module):
         # Stage-0 feed for every tick; the tail of the schedule (drain
         # ticks) re-feeds the last microbatch — its output is discarded.
         feed = x_mb[jnp.minimum(jnp.arange(ticks), n_mb - 1)]
+        # Anchor the stacked scan input: without it the partitioner
+        # propagates an arbitrary sharding onto `feed`, and the per-tick
+        # dynamic-slice then needs a reshard it can only do as
+        # replicate-then-repartition (caught by the dryrun warning gate
+        # at n=16, dcn x dp x pp x tp).
+        feed = _shard(feed, P(None, BATCH_AXES, AXIS_SEQ, None))
         # Broadcast inputs are shared across microbatches by API contract
         # (they are passed unsplit to every tick); no shape heuristic here —
         # a leading dim that merely *equals* batch (e.g. positions when
@@ -108,6 +114,7 @@ class SPMDPipeline(nn.Module):
                 # The concat of a fresh row with state[:-1] on the
                 # pipe-sharded dim IS the inter-stage transfer: XLA lowers
                 # it to collective-permute over ICI.
+                feed_t = _shard(feed_t, P(BATCH_AXES, AXIS_SEQ, None))
                 stages_in = jnp.concatenate([feed_t[None], state[:-1]], axis=0)
                 stages_in = _shard(stages_in, STATE_SPEC)
                 out = vstage(*outer.stage_args, name="stages")(stages_in, *bcast)

@@ -419,8 +419,7 @@ class _ServingMesh:
 
     def __init__(self, mesh_spec, seed: int, checkpoint_dir: str | None,
                  param_dtype: str | None = None):
-        from kubeflow_tpu.parallel.mesh import (
-            AXIS_DATA, AXIS_DCN, AXIS_FSDP, build_mesh)
+        from kubeflow_tpu.parallel.mesh import BATCH_AXES, build_mesh
 
         self.mesh = build_mesh(mesh_spec)
         self.seed = seed
@@ -446,8 +445,12 @@ class _ServingMesh:
                 ck.close()
         self.variables = None
         self._lock = threading.Lock()
-        dp = (self.mesh.shape[AXIS_DCN] * self.mesh.shape[AXIS_DATA]
-              * self.mesh.shape[AXIS_FSDP])
+        # every batch axis, INCLUDING expert (BATCH_AXES widened in round
+        # 4): padding to a multiple the batch sharding doesn't divide
+        # would fail device_put at request time on MoE serving meshes
+        dp = 1
+        for a in BATCH_AXES:
+            dp *= self.mesh.shape[a]
         if dp & (dp - 1):
             raise ValueError(
                 f"serving mesh data axes product {dp} must be a power of "

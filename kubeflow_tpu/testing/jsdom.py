@@ -1782,12 +1782,19 @@ class Element:
     def dispatchEvent(self, event: "JSObject"):
         etype = js_str(event.get("type"))
         event.setdefault("target", self)
+        # stopPropagation halts the walk BEFORE the next ancestor; the
+        # current node's remaining listeners still run (DOM semantics —
+        # only stopImmediatePropagation would cut those, unsupported)
+        stopped = []
+        event["stopPropagation"] = lambda: stopped.append(True)
         node = self
         while node is not None:  # bubble
             for fn in list(node._listeners.get(etype, [])):
                 r = (fn.call([event]) if isinstance(fn, JSFunction)
                      else fn(event))
                 _raise_if_rejected(r)  # broken async handler = test fails
+            if stopped:
+                break
             node = node.parent
         return True
 

@@ -1,0 +1,19 @@
+"""Driver-contract dryrun at the n=16 tier (slow).
+
+The driver itself validates dryrun_multichip(8); this covers the larger
+tier the driver does not run: a 16-device virtual mesh where the composed
+4-factor config G (dcn x dp x pp x tp, pp >= 2 guaranteed) exists. The
+wrapper's partitioner-warning gate applies, so this also asserts every
+config compiles without GSPMD involuntary rematerialization/replication
+(VERDICT r3 #7). Runs in a subprocess (the wrapper re-execs with
+JAX_PLATFORMS=cpu and the 16-device flag before jax initializes).
+"""
+
+import pytest
+
+import __graft_entry__ as graft
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_16_green_and_warning_clean():
+    graft.dryrun_multichip(16)

@@ -123,10 +123,11 @@ def test_sparse_single_device_no_mesh_matches_dense():
     wu = jax.random.normal(key, (e, d, cfg.d_ff), jnp.float32) * 0.02
     wd = jax.random.normal(key, (e, cfg.d_ff, d), jnp.float32) * 0.02
 
-    y, fill, routed = moe_mod.sparse_dispatch_mlp(
+    y, fill, routed, slots = moe_mod.sparse_dispatch_mlp(
         cfg, x, gate_vals, gate_idx, wg, wu, wd, capacity_factor=8.0)
     assert int(routed) == t * k
     assert int(fill) == t * k  # ample capacity: nothing drops
+    assert int(slots) >= int(fill)
 
     # dense reference: run each (token, slot) through its expert
     xin = x.astype(jnp.float32)

@@ -133,6 +133,36 @@ def test_remat_dots_policy_trains_and_matches_no_remat(devices8):
         _remat_policy(TransformerConfig(remat_policy="bogus"))
 
 
+def test_remat_slim_and_mlp_policies_match_no_remat(devices8):
+    """The round-4 policies — slim (whitelist of named anchors) and the
+    width-predicate mlp — are memory/compute trades only: same loss as
+    no-remat on the same batch, and they must train under chunked CE
+    (the production loss) too."""
+    import numpy as np
+
+    from kubeflow_tpu.parallel.mesh import MeshSpec
+
+    def cfg(**over):
+        base = dict(
+            model="transformer-test", task="lm", global_batch=8,
+            seq_len=32, vocab_size=128, mesh=MeshSpec(data=8),
+            optimizer="adamw", learning_rate=1e-3, total_steps=2,
+            warmup_steps=1, log_every=10**9, xent_chunks=4,
+        )
+        base.update(over)
+        return TrainConfig.from_dict(base)
+
+    t_plain = Trainer(cfg())
+    batch = next(t_plain.data_iter())
+    _, m_plain = t_plain.train_step(t_plain.init_state(), batch)
+    for policy in ("slim", "mlp"):
+        t_r = Trainer(cfg(remat=True, remat_policy=policy))
+        _, m_r = t_r.train_step(t_r.init_state(), batch)
+        np.testing.assert_allclose(
+            float(m_plain["loss"]), float(m_r["loss"]), rtol=1e-5,
+            err_msg=f"policy {policy}")
+
+
 def test_periodic_eval_in_fit():
     """eval_every runs held-out eval during fit (train_and_evaluate
     parity): metrics land in the summary with LM perplexity = exp(loss),

@@ -49,11 +49,14 @@ def recompute_tax(cfg, policy: str, seq: int) -> float:
     if policy == "full":
         return 1.0
     if policy == "dots":
-        return attn / block  # flash fwd replays (lse is custom_vjp-internal)
+        # dot outputs + the flash out/lse residuals (named inside the
+        # custom_vjp fwd rule) are saved: replay is elementwise only
+        return 0.0
     if policy == "mlp":
         return (2 * d * dff) / block
     if policy == "slim":
-        return (2 * d * dff + attn) / block
+        # gate/up matmuls replay; flash does not (attn_flash saved)
+        return (2 * d * dff) / block
     raise ValueError(policy)
 
 
