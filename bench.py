@@ -250,7 +250,8 @@ def run_serving(args) -> dict:
     sargs = types.SimpleNamespace(
         model="gpt-350m", vocab_size=32000, prompt_len=256,
         max_new_tokens=32, requests=12, concurrency=8, slots=8,
-        window_ms=0.0, param_dtype="int8", kv_cache_dtype="", mesh=None)
+        window_ms=0.0, param_dtype="int8", kv_cache_dtype="", mesh=None,
+        attention_window=0, rolling_kv_cache=False)
     return sb.run_mode("continuous", sargs)
 
 

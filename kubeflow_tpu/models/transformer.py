@@ -85,9 +85,14 @@ class TransformerConfig:
     # still runs — see ops/flash_attention.py). 0 = full causal.
     # Supported by every attention path: flash/reference/ring/ulysses
     # in training, and decode masks the cache identically (train/serve
-    # parity; the cache itself still holds max_seq positions — a
-    # bounded rolling cache is the noted follow-up).
+    # parity).
     attention_window: int = 0
+    # Bounded decode cache for windowed models: the KV cache holds only
+    # the last `attention_window` positions (slot = position % window),
+    # so serving memory AND per-step cache bandwidth are O(window), not
+    # O(max_seq). Requires attention_window > 0. Exact: token-for-token
+    # equal to the full cache under the same window (pinned by tests).
+    rolling_kv_cache: bool = False
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
     # "dots": keep matmul outputs, recompute only elementwise — most of
@@ -188,6 +193,132 @@ def _remat_policy(cfg: "TransformerConfig"):
 class Attention(nn.Module):
     cfg: TransformerConfig
 
+    def _decode_rolling(self, q, k, v, decode_index, pad_len):
+        """Bounded-window decode: the cache keeps only the last W
+        positions (slot = position % W), so memory and per-step cache
+        bandwidth are O(W) instead of O(max_seq).
+
+        Clobber-safe ordering: attention runs against the OLD cache (all
+        positions < idx) plus the current chunk's keys directly, and the
+        chunk is written only afterwards — a chunk write may overwrite
+        slot p-W while an earlier chunk row still needs it, so
+        write-then-attend (the full-cache path's order) would be wrong
+        here. Exact under the same window: pinned against the full-cache
+        path by tests/test_generate.py."""
+        cfg = self.cfg
+        b, lq = q.shape[0], q.shape[1]
+        hkv, hd = cfg.n_kv_heads, cfg.head_dim
+        W = min(cfg.attention_window, cfg.max_seq_len)
+        quant = cfg.kv_cache_dtype == "int8"
+        cache_dt = jnp.int8 if quant else cfg.dtype
+        ck = self.variable("cache", "cached_key",
+                           lambda: jnp.zeros((b, W, hkv, hd), cache_dt))
+        cv = self.variable("cache", "cached_value",
+                           lambda: jnp.zeros((b, W, hkv, hd), cache_dt))
+        if quant:
+            cks = self.variable("cache", "cached_key_scale",
+                                lambda: jnp.zeros((b, W, hkv, 1), jnp.float32))
+            cvs = self.variable("cache", "cached_value_scale",
+                                lambda: jnp.zeros((b, W, hkv, 1), jnp.float32))
+            k_old = (ck.value.astype(jnp.float32) * cks.value).astype(cfg.dtype)
+            v_old = (cv.value.astype(jnp.float32) * cvs.value).astype(cfg.dtype)
+        else:
+            k_old, v_old = ck.value, cv.value
+
+        idx = jnp.asarray(decode_index, jnp.int32)
+        # Quantize the chunk BEFORE attending and attend its dequantized
+        # values: the full-cache path writes first and attends from the
+        # (dequantized) cache, so token-for-token parity under int8
+        # requires the in-chunk term to see the same quantize->dequantize
+        # round trip.
+        if quant:
+            from kubeflow_tpu.ops.quantize import symmetric_int8
+
+            k_w, ks_w = symmetric_int8(k, -1)
+            v_w, vs_w = symmetric_int8(v, -1)
+            k_c = (k_w.astype(jnp.float32) * ks_w).astype(cfg.dtype)
+            v_c = (v_w.astype(jnp.float32) * vs_w).astype(cfg.dtype)
+        else:
+            k_w, v_w = k.astype(cfg.dtype), v.astype(cfg.dtype)
+            k_c, v_c = k_w, v_w
+        g = cfg.n_heads // hkv
+        qg = q.reshape(b, lq, hkv, g, hd)
+        scale = hd ** -0.5
+        # old-cache term [b,h,g,lq,W] + in-chunk term [b,h,g,lq,lq]
+        lc = jnp.einsum("bqhgd,bshd->bhgqs", qg, k_old,
+                        preferred_element_type=jnp.float32) * scale
+        ls = jnp.einsum("bqhgd,bchd->bhgqc", qg, k_c,
+                        preferred_element_type=jnp.float32) * scale
+
+        slots = jnp.arange(W, dtype=jnp.int32)
+        cols = jnp.arange(lq, dtype=jnp.int32)
+        if idx.ndim == 0:
+            # scalar start: query row r sits at absolute position idx+r
+            qpos = idx + cols                                   # [lq]
+            cur_old = idx - 1
+            # absolute position currently held by each slot (the largest
+            # p <= cur_old with p % W == slot); negative = never written
+            pos_abs = cur_old - ((cur_old - slots) % W)         # [W]
+            mc = (pos_abs[None, :] >= 0) \
+                & (pos_abs[None, :] > qpos[:, None] - W)        # [lq, W]
+            mc = jnp.broadcast_to(mc[None], (b, lq, W))
+            ms = (cols[None, :] <= cols[:, None]) \
+                & (cols[None, :] > cols[:, None] - W)           # [lq, lq]
+            ms = jnp.broadcast_to(ms[None], (b, lq, lq))
+            if pad_len is not None:
+                mc = mc & (pos_abs[None, None, :] >= pad_len[:, None, None])
+                ms = ms & ((idx + cols)[None, None, :]
+                           >= pad_len[:, None, None])
+        else:
+            # per-row positions (continuous batching): lq == 1
+            cur_old = idx - 1                                   # [b]
+            pos_abs = cur_old[:, None] - (
+                (cur_old[:, None] - slots[None, :]) % W)        # [b, W]
+            mc = (pos_abs >= 0) & (pos_abs > idx[:, None] - W)
+            mc = mc[:, None, :]                                 # [b, 1, W]
+            ms = jnp.ones((b, 1, 1), bool)
+            if pad_len is not None:
+                mc = mc & (pos_abs[:, None, :] >= pad_len[:, None, None])
+                ms = ms & (idx[:, None, None] >= pad_len[:, None, None])
+
+        neg = jnp.float32(-1e30)
+        lc = jnp.where(mc[:, None, None, :, :], lc, neg)
+        ls = jnp.where(ms[:, None, None, :, :], ls, neg)
+        probs = jax.nn.softmax(jnp.concatenate([lc, ls], axis=-1), axis=-1)
+        pc, ps = probs[..., :W], probs[..., W:]
+        out = (jnp.einsum("bhgqs,bshd->bqhgd", pc.astype(v_old.dtype), v_old)
+               + jnp.einsum("bhgqc,bchd->bqhgd", ps.astype(cfg.dtype), v_c))
+        out = out.reshape(b, lq, cfg.n_heads, hd)
+
+        # ---- write the (already-quantized) chunk, AFTER attending ----
+        if idx.ndim == 0:
+            # only the last W chunk columns survive a wrap; among those
+            # the slot map (idx+c) % W is injective
+            wslot = (idx + cols) % W                            # [lq]
+            alive = cols >= lq - W
+            hot = (slots[:, None] == wslot[None, :]) & alive[None, :]
+            hit = hot.any(axis=1)                               # [W]
+
+            def wr(old, new):
+                upd = jnp.einsum("sc,bc...->bs...", hot.astype(new.dtype),
+                                 new).astype(old.dtype)
+                keep = jnp.reshape(~hit, (1, W) + (1,) * (old.ndim - 2))
+                return jnp.where(keep, old, upd)
+
+            ck.value = wr(ck.value, k_w)
+            cv.value = wr(cv.value, v_w)
+            if quant:
+                cks.value = wr(cks.value, ks_w)
+                cvs.value = wr(cvs.value, vs_w)
+        else:
+            hot = (slots[None, :] == (idx % W)[:, None])[:, :, None, None]
+            ck.value = jnp.where(hot, k_w, ck.value)
+            cv.value = jnp.where(hot, v_w, cv.value)
+            if quant:
+                cks.value = jnp.where(hot, ks_w, cks.value)
+                cvs.value = jnp.where(hot, vs_w, cvs.value)
+        return out
+
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, decode_index=None,
                  pad_len=None):
@@ -215,7 +346,19 @@ class Attention(nn.Module):
         k = checkpoint_name(k, "attn_qkv")
         v = checkpoint_name(v, "attn_qkv")
 
-        if decode_index is not None:
+        if decode_index is not None and cfg.rolling_kv_cache:
+            if not cfg.attention_window:
+                raise ValueError(
+                    "rolling_kv_cache requires attention_window > 0")
+            if cfg.kv_cache_dtype not in ("auto", "int8"):
+                raise ValueError(
+                    f"unknown kv_cache_dtype {cfg.kv_cache_dtype!r} "
+                    "(auto|int8)")
+            # falls through to the SHARED output projection below — the
+            # 'o' DenseGeneral must stay single-sited or the two decode
+            # paths silently diverge in init/sharding
+            out = self._decode_rolling(q, k, v, decode_index, pad_len)
+        elif decode_index is not None:
             # KV-cache decode: x is the single new token [B, 1, ...]; write
             # its K/V at decode_index and attend q against the full cache
             # with a <=index mask. Cache layout [B, max_seq, Hkv, D].
@@ -306,8 +449,8 @@ class Attention(nn.Module):
             mask = pos <= qpos
             if cfg.attention_window:
                 # same sliding window as training (train/serve parity);
-                # the cache still holds max_seq positions — a bounded
-                # rolling cache is the noted follow-up
+                # this path keeps max_seq cache slots — set
+                # rolling_kv_cache for the O(window) bounded cache
                 mask = mask & (pos > qpos - cfg.attention_window)
             if pad_len is not None:
                 # left-padded ragged prompts: positions before each row's

@@ -821,6 +821,15 @@ def main() -> None:  # pragma: no cover - container entry
                    help="cast served LM parameters (bfloat16 halves the "
                         "weight HBM reads that dominate decode; int8 is "
                         "weight-only quantization, halving them again)")
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window attention width for served LMs "
+                        "(0 = full causal)")
+    p.add_argument("--rolling-kv-cache", action="store_true",
+                   help="bound the decode KV cache to the attention "
+                        "window (slot = position %% window): serving "
+                        "memory and per-step cache bandwidth become "
+                        "O(window) instead of O(max_seq); requires "
+                        "--attention-window")
     p.add_argument("--kv-cache-dtype", default=None,
                    choices=["auto", "int8"],
                    help="int8 quantizes the decode KV cache (per-token-"
@@ -875,7 +884,11 @@ def main() -> None:  # pragma: no cover - container entry
             draft_model=args.draft_model, draft_k=args.draft_k,
             draft_checkpoint_dir=args.draft_checkpoint_dir,
             **({"kv_cache_dtype": args.kv_cache_dtype}
-               if args.kv_cache_dtype else {})))
+               if args.kv_cache_dtype else {}),
+            **({"attention_window": args.attention_window}
+               if args.attention_window else {}),
+            **({"rolling_kv_cache": True}
+               if args.rolling_kv_cache else {})))
     svc = server.serve(port=args.port)
     log.info("serving on :%d", svc.port)
     try:

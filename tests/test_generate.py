@@ -234,3 +234,87 @@ def test_windowed_decode_matches_windowed_full_forward():
         np.testing.assert_array_equal(
             np.asarray(out[:, 8 + i]), np.asarray(want),
             err_msg=f"windowed decode token {i} diverges from train fwd")
+
+
+class TestRollingKvCache:
+    """rolling_kv_cache: the bounded cache (last W positions only) must
+    be token-for-token equal to the full cache under the same sliding
+    window — a memory layout change, never a semantics change."""
+
+    def _pair(self, window, seed=3, dtype=jnp.float32, **kw):
+        # f32 by default: the equality is exact only when both paths do
+        # the same arithmetic; bf16 re-association noise would force a
+        # tolerance and weaken the pin
+        full = get_model("transformer-test", max_seq_len=64, dtype=dtype,
+                         attention_window=window, **kw)
+        roll = get_model("transformer-test", max_seq_len=64, dtype=dtype,
+                         attention_window=window, rolling_kv_cache=True,
+                         **kw)
+        tok = jnp.zeros((2, 8), jnp.int32)
+        variables = meta.unbox(full.init(jax.random.PRNGKey(seed), tok))
+        return full, roll, variables
+
+    def test_cache_is_window_sized(self):
+        _, roll, variables = self._pair(window=16)
+        cache = init_cache(roll, batch=2)
+        leaf = jax.tree.leaves(cache)[0]
+        assert leaf.shape[1] == 16  # W, not max_seq_len
+
+    def test_greedy_equal_to_full_cache_past_the_wrap(self):
+        full, roll, variables = self._pair(window=16)
+        rng = jax.random.PRNGKey(7)
+        prompt = jax.random.randint(rng, (2, 12), 0, 256, jnp.int32)
+        # 12 prompt + 24 new = 36 positions: wraps the 16-slot cache twice
+        a = generate(full, variables, prompt, max_new_tokens=24)
+        b = generate(roll, variables, prompt, max_new_tokens=24)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_equal_with_left_padding(self):
+        full, roll, variables = self._pair(window=8)
+        rng = jax.random.PRNGKey(9)
+        real = jax.random.randint(rng, (1, 6), 0, 256, jnp.int32)
+        padded = jnp.concatenate(
+            [jnp.zeros((1, 3), jnp.int32), real], axis=1)
+        pad_len = jnp.array([3], jnp.int32)
+        a = generate(full, variables, padded, max_new_tokens=10,
+                     pad_len=pad_len)
+        b = generate(roll, variables, padded, max_new_tokens=10,
+                     pad_len=pad_len)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_continuous_batching_slots_equal(self):
+        from kubeflow_tpu.serving.continuous import SlotDecoder
+
+        full, roll, variables = self._pair(window=16)
+        prompts = [[5, 9, 2, 7, 11, 3], [4, 4, 8]]
+        outs = {}
+        for name, model in (("full", full), ("roll", roll)):
+            dec = SlotDecoder(model, variables, slots=2, prompt_len=8,
+                              max_new_tokens=20)
+            try:
+                outs[name] = [dec.submit(p) for p in prompts]
+            finally:
+                dec.close()
+        assert outs["full"] == outs["roll"]
+
+    def test_equal_with_int8_kv_cache(self):
+        """int8 parity: the rolling path quantizes the chunk BEFORE
+        attending (the full path attends the just-written dequantized
+        cache), so both see the same quantize->dequantize round trip."""
+        full, roll, variables = self._pair(window=16,
+                                           kv_cache_dtype="int8")
+        rng = jax.random.PRNGKey(11)
+        prompt = jax.random.randint(rng, (2, 10), 0, 256, jnp.int32)
+        a = generate(full, variables, prompt, max_new_tokens=20)
+        b = generate(roll, variables, prompt, max_new_tokens=20)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_rolling_without_window_refuses(self):
+        import pytest
+
+        model = get_model("transformer-test", max_seq_len=64,
+                          rolling_kv_cache=True)
+        tok = jnp.zeros((1, 4), jnp.int32)
+        variables = meta.unbox(model.init(jax.random.PRNGKey(0), tok))
+        with pytest.raises(ValueError, match="attention_window"):
+            generate(model, variables, tok, max_new_tokens=2)

@@ -45,7 +45,7 @@ def candidates(paths):
 def _config_key(doc) -> str:
     return "|".join(str(doc.get(k)) for k in (
         "model", "max_new_tokens", "slots", "param_dtype",
-        "kv_cache_dtype"))
+        "kv_cache_dtype", "attention_window", "rolling_kv_cache"))
 
 
 def main() -> int:

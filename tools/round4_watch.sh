@@ -80,6 +80,16 @@ while true; do
       --modes continuous --requests 16 --model llama-1b \
       --prompt-len 1024 --max-new-tokens 32 --slots 8 \
       --param-dtype int8 --kv-cache-dtype int8
+    # rolling-cache A/B: same window, bounded (O(window)) vs full
+    # (O(max_seq)) cache — the decode-bandwidth claim measured
+    run_stage serve_win_full 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --attention-window 512
+    run_stage serve_win_rolling 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --attention-window 512 --rolling-kv-cache
     # 3b. ResNet byte-wall A/B (VERDICT #6): whole-forward remat trades
     #     the HBM activation round-trip for VMEM-fused recompute — the
     #     one lever that can move a 96%-of-roofline workload.
@@ -137,7 +147,7 @@ while true; do
     python tools/promote_best.py tools/lm_sweep_r04.jsonl >> "$LOG" 2>&1 || true
     python tools/promote_serve_best.py "$LEDGER"/serve_*.out >> "$LOG" 2>&1 || true
     settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
-    if [ "$settled" -ge 22 ]; then
+    if [ "$settled" -ge 24 ]; then
       note "all stages settled ($settled done+skip)"; exit 0
     fi
   else

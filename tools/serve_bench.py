@@ -43,7 +43,10 @@ def run_mode(mode: str, args) -> dict:
         mesh=args.mesh or None,
         vocab_size=args.vocab_size,
         **({"kv_cache_dtype": args.kv_cache_dtype}
-           if args.kv_cache_dtype else {}))
+           if args.kv_cache_dtype else {}),
+        **({"attention_window": args.attention_window}
+           if args.attention_window else {}),
+        **({"rolling_kv_cache": True} if args.rolling_kv_cache else {}))
     try:
         rng = __import__("random").Random(0)
         prompts = [[rng.randrange(1, args.vocab_size)
@@ -105,6 +108,9 @@ def run_mode(mode: str, args) -> dict:
             "param_dtype": args.param_dtype or "f32",
             **({"kv_cache_dtype": args.kv_cache_dtype}
                if args.kv_cache_dtype else {}),
+            **({"attention_window": args.attention_window,
+                "rolling_kv_cache": bool(args.rolling_kv_cache)}
+               if args.attention_window else {}),
         }
     finally:
         served.close()
@@ -123,6 +129,12 @@ def main() -> int:
                    help="micro-batching window for the micro mode")
     p.add_argument("--param-dtype", default="bfloat16",
                    choices=["bfloat16", "float32", "int8", ""])
+    p.add_argument("--attention-window", type=int, default=0,
+                   help="sliding-window width for the served model "
+                        "(0 = full causal)")
+    p.add_argument("--rolling-kv-cache", action="store_true",
+                   help="bound the KV cache to the window (O(window) "
+                        "memory + per-step cache stream)")
     p.add_argument("--kv-cache-dtype", default="",
                    choices=["", "auto", "int8"],
                    help="int8 quantizes the decode KV cache (per-token-"
