@@ -120,6 +120,14 @@ while true; do
     run_stage lm_350m_bs16_dots 1800 python bench.py --workload lm \
       --lm-model gpt-350m --lm-batch 16 --lm-optimizer adafactor \
       --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    # stretch: bs16 dots on the big models — remat_plan upper bounds say
+    # marginal; .skip machinery absorbs a deterministic OOM in one retry
+    run_stage lm_1b_bs16_dots 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_760m_bs16_dots 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
     # 5. The 760m/llama full-remat frontier, chunked-CE era, one point
     #    per stage so a drop costs at most one compile.
     run_stage lm_760m_bs8_mlp 1800 python bench.py --workload lm \
@@ -147,7 +155,7 @@ while true; do
     python tools/promote_best.py tools/lm_sweep_r04.jsonl >> "$LOG" 2>&1 || true
     python tools/promote_serve_best.py "$LEDGER"/serve_*.out >> "$LOG" 2>&1 || true
     settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
-    if [ "$settled" -ge 24 ]; then
+    if [ "$settled" -ge 26 ]; then
       note "all stages settled ($settled done+skip)"; exit 0
     fi
   else
