@@ -128,8 +128,18 @@ def _recompute_p_ds(q, k, v, g, lse_row, delta_row, *, scale, causal,
 # forward kernel
 # --------------------------------------------------------------------------
 
+def _kb_lo(qi, *, block_q, block_k, offset, window):
+    """First k-block the sliding window can reach for q-block `qi`:
+    the lowest q row's earliest in-window key position, floor-divided
+    to blocks. Shared by the kernel and the BlockSpec index maps so the
+    loaded block and the mask arithmetic can never disagree."""
+    lo_pos = qi * block_q + offset - (window - 1)
+    return jnp.maximum(0, lo_pos // block_k)
+
+
 def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
-                block_k: int, offset: int, has_seg: bool, window: int = 0):
+                block_k: int, offset: int, has_seg: bool, window: int = 0,
+                nk_total: int = 0, pruned: bool = False):
     # offset = lk - lq: causality is end-aligned (query row i may attend
     # keys <= i + offset), matching reference_attention's tril(k=lk-lq) —
     # the KV-cache decode / chunked-prefill convention.
@@ -140,10 +150,18 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         q_ref, k_ref, v_ref, o_ref, lse_ref, m_s, l_s, acc_s = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    if pruned:
+        # windowed grid: axis 2 walks only the k-blocks the window can
+        # reach (the BlockSpec index map loads block kb_lo + j, clamped);
+        # ki here is the UNclamped logical block for the mask arithmetic
+        ki = _kb_lo(qi, block_q=block_q, block_k=block_k, offset=offset,
+                    window=window) + j
+    else:
+        ki = j
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         m_s[:] = jnp.full_like(m_s, NEG_INF)
         l_s[:] = jnp.zeros_like(l_s)
@@ -153,6 +171,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
     # contribute nothing and are skipped outright
     run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
                       qi=qi, ki=ki, offset=offset, window=window)
+    if pruned:
+        # clamped duplicate loads past the last real k block never run
+        run = jnp.logical_and(run, ki <= nk_total - 1)
 
     @pl.when(run)
     def _compute():
@@ -184,7 +205,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, block_q: int,
         m_s[:] = m_new
         l_s[:] = l_new
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         l = jnp.maximum(l_s[:], 1e-20)
         o_ref[0] = (acc_s[:] / l).astype(o_ref.dtype)
@@ -199,12 +220,32 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
     lk = k.shape[1]
     nq = pl.cdiv(lq, block_q)
     nk = pl.cdiv(lk, block_k)
+    offset = lk - lq
     has_seg = qseg is not None
+    # Windowed grid pruning: with a sliding window only the k-blocks in
+    # (qpos - window, qpos] are reachable, so the k axis of the grid
+    # shrinks from nk to the window span — out-of-window blocks are
+    # never DMA'd at all (round 3 skipped their COMPUTE but still
+    # streamed them from HBM). Index maps load kb_lo(qi) + j, clamped;
+    # the kernel re-derives the logical ki for its masks.
+    pruned = causal and window > 0 and lq > 1
+    nkw = min(nk, pl.cdiv(block_q + window, block_k) + 1) if pruned else nk
+
+    def kj(b, i, j):
+        if not pruned:
+            return (b, j, 0)
+        lo = _kb_lo(i, block_q=block_q, block_k=block_k, offset=offset,
+                    window=window)
+        return (b, jnp.minimum(lo + j, nk - 1), 0)
+
+    def kj_seg(b, i, j):
+        bj, kb, _ = kj(b, i, j)
+        return (bj, 0, kb)
 
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=lk - lq, has_seg=has_seg,
-        window=window,
+        block_q=block_q, block_k=block_k, offset=offset, has_seg=has_seg,
+        window=window, nk_total=nk, pruned=pruned,
     )
     if not _HAS_PLTPU:
         raise ImportError(
@@ -220,20 +261,20 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
 
     in_specs = [
         bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
-        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        bs((1, block_k, d), kj),
+        bs((1, block_k, d), kj),
     ]
     operands = [q, k, v]
     if has_seg:
         in_specs += [
             bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),
-            bs((1, 1, block_k), lambda b, i, j: (b, 0, j)),
+            bs((1, 1, block_k), kj_seg),
         ]
         operands += [qseg, kseg]
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, nq, nk),
+        grid=(bh, nq, nkw),
         in_specs=in_specs,
         out_specs=[
             bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -262,7 +303,7 @@ def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret,
 # --------------------------------------------------------------------------
 
 def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
-                   window=0):
+                   window=0, nk_total=0, pruned=False):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dq_ref, acc_s) = refs
@@ -271,15 +312,24 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
          dq_ref, acc_s) = refs
         qseg_ref = kseg_ref = None
     qi = pl.program_id(1)
-    ki = pl.program_id(2)
-    nk = pl.num_programs(2)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    if pruned:
+        # windowed grid (see _flash_fwd): axis 2 walks only the
+        # window-reachable k blocks; the index map loads kb_lo + j
+        ki = _kb_lo(qi, block_q=block_q, block_k=block_k, offset=offset,
+                    window=window) + j
+    else:
+        ki = j
 
-    @pl.when(ki == 0)
+    @pl.when(j == 0)
     def _init():
         acc_s[:] = jnp.zeros_like(acc_s)
 
     run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
                       qi=qi, ki=ki, offset=offset, window=window)
+    if pruned:
+        run = jnp.logical_and(run, ki <= nk_total - 1)
 
     @pl.when(run)
     def _compute():
@@ -296,13 +346,21 @@ def _bwd_dq_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(ki == nk - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dq_ref[0] = acc_s[:].astype(dq_ref.dtype)
 
 
+def _qb_lo(ki, *, block_q, block_k, offset):
+    """First q-block the CAUSAL constraint lets attend k-block `ki`
+    (qpos + offset >= kpos). The window bounds the other end: q rows
+    further than window-1 past a key can't see it, so the valid q span
+    per k block is at most cdiv(block_k + window, block_q) + 1 blocks."""
+    return jnp.maximum(0, (ki * block_k - offset) // block_q)
+
+
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
-                    window=0):
+                    window=0, nq_total=0, pruned=False):
     if has_seg:
         (q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
          qseg_ref, kseg_ref, dk_ref, dv_ref, dk_s, dv_s) = refs
@@ -311,16 +369,22 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
          dk_ref, dv_ref, dk_s, dv_s) = refs
         qseg_ref = kseg_ref = None
     ki = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    j = pl.program_id(2)
+    nj = pl.num_programs(2)
+    if pruned:
+        qi = _qb_lo(ki, block_q=block_q, block_k=block_k, offset=offset) + j
+    else:
+        qi = j
 
-    @pl.when(qi == 0)
+    @pl.when(j == 0)
     def _init():
         dk_s[:] = jnp.zeros_like(dk_s)
         dv_s[:] = jnp.zeros_like(dv_s)
 
     run = _block_runs(causal=causal, block_q=block_q, block_k=block_k,
                       qi=qi, ki=ki, offset=offset, window=window)
+    if pruned:
+        run = jnp.logical_and(run, qi <= nq_total - 1)
 
     @pl.when(run)
     def _compute():
@@ -342,7 +406,7 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, block_k, offset, has_seg,
             preferred_element_type=jnp.float32,
         )
 
-    @pl.when(qi == nq - 1)
+    @pl.when(j == nj - 1)
     def _finalize():
         dk_ref[0] = dk_s[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_s[:].astype(dv_ref.dtype)
@@ -365,11 +429,28 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     lse = lse.reshape(bh, 1, lq)
 
     bs = _vmem_spec
+    # windowed grid pruning, mirrored from _flash_fwd: out-of-window
+    # blocks are never DMA'd in the backward either (it carries ~2x the
+    # forward's attention HBM traffic)
+    pruned = causal and window > 0 and lq > 1
+    nkw = min(nk, pl.cdiv(block_q + window, block_k) + 1) if pruned else nk
+    nqw = min(nq, pl.cdiv(block_k + window, block_q) + 1) if pruned else nq
+
+    def kj(b, i, j):
+        if not pruned:
+            return (b, j, 0)
+        lo = _kb_lo(i, block_q=block_q, block_k=block_k, offset=offset,
+                    window=window)
+        return (b, jnp.minimum(lo + j, nk - 1), 0)
+
+    def kj_seg(b, i, j):
+        bj, kb, _ = kj(b, i, j)
+        return (bj, 0, kb)
 
     dq_specs = [
         bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
-        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
-        bs((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
+        bs((1, block_k, d), kj),                          # k
+        bs((1, block_k, d), kj),                          # v
         bs((1, block_q, d), lambda b, i, j: (b, i, 0)),   # g
         bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # lse
         bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # delta
@@ -378,15 +459,16 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     if has_seg:
         dq_specs += [
             bs((1, 1, block_q), lambda b, i, j: (b, 0, i)),   # qseg
-            bs((1, 1, block_k), lambda b, i, j: (b, 0, j)),   # kseg
+            bs((1, 1, block_k), kj_seg),                      # kseg
         ]
         dq_operands += [qseg, kseg]
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset,
-                          has_seg=has_seg, window=window),
-        grid=(bh, nq, nk),
+                          has_seg=has_seg, window=window, nk_total=nk,
+                          pruned=pruned),
+        grid=(bh, nq, nkw),
         in_specs=dq_specs,
         out_specs=bs((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype),
@@ -394,18 +476,29 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
         interpret=interpret,
     )(*dq_operands)
 
+    def qi_map(b, kb, j):
+        # dkv grid is (bh, k-block, q-walk): q block loaded = qb_lo + j
+        if not pruned:
+            return (b, j, 0)
+        lo = _qb_lo(kb, block_q=block_q, block_k=block_k, offset=offset)
+        return (b, jnp.minimum(lo + j, nq - 1), 0)
+
+    def qi_row(b, kb, j):
+        bj, qb, _ = qi_map(b, kb, j)
+        return (bj, 0, qb)
+
     dkv_specs = [
-        bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
+        bs((1, block_q, d), qi_map),                      # q
         bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
         bs((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-        bs((1, block_q, d), lambda b, j, i: (b, i, 0)),   # g
-        bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # lse
-        bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # delta
+        bs((1, block_q, d), qi_map),                      # g
+        bs((1, 1, block_q), qi_row),                      # lse
+        bs((1, 1, block_q), qi_row),                      # delta
     ]
     dkv_operands = [q, k, v, g, lse, delta]
     if has_seg:
         dkv_specs += [
-            bs((1, 1, block_q), lambda b, j, i: (b, 0, i)),   # qseg
+            bs((1, 1, block_q), qi_row),                      # qseg
             bs((1, 1, block_k), lambda b, j, i: (b, 0, j)),   # kseg
         ]
         dkv_operands += [qseg, kseg]
@@ -413,8 +506,9 @@ def _flash_bwd_pallas(q, k, v, out, lse, g, scale, causal, block_q, block_k,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, offset=offset,
-                          has_seg=has_seg, window=window),
-        grid=(bh, nk, nq),
+                          has_seg=has_seg, window=window, nq_total=nq,
+                          pruned=pruned),
+        grid=(bh, nk, nqw),
         in_specs=dkv_specs,
         out_specs=[
             bs((1, block_k, d), lambda b, j, i: (b, j, 0)),

@@ -92,6 +92,16 @@ def speculative_generate(target, target_vars, draft, draft_vars,
         raise ValueError("speculative_generate is batch-1 "
                          f"(got batch {prompt.shape[0]}); batch via the "
                          "serving layer")
+    for name, m in (("target", target), ("draft", draft)):
+        if getattr(m.cfg, "rolling_kv_cache", False):
+            # rejection rewinds the decode index: a slot then holds a
+            # REJECTED newer position while the rolling mask dates it as
+            # the older same-residue position — silently wrong attention.
+            # The full cache masks stale future entries out via
+            # pos <= qpos, so only it composes with speculation.
+            raise ValueError(
+                f"speculative decoding requires the full KV cache; "
+                f"{name} has rolling_kv_cache=True")
     p_len = prompt.shape[1]
     for name, m in (("target", target), ("draft", draft)):
         need = p_len + max_new_tokens + k

@@ -623,6 +623,13 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
         if mesh is not None:
             raise ValueError("speculative decoding is single-chip for "
                              "now (no mesh)")
+        if getattr(model.cfg, "rolling_kv_cache", False):
+            # fail at REGISTRATION like the other exclusions — the
+            # per-request guard in runtime/speculative.py would otherwise
+            # 500 every decode on a server that reported healthy
+            raise ValueError("speculative decoding requires the full KV "
+                             "cache (rolling_kv_cache evicts positions a "
+                             "rejected draft must rewind over)")
     quantized = param_dtype == "int8"
     if quantized and mesh is not None:
         raise ValueError("param_dtype='int8' serving is single-chip for "

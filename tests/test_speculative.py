@@ -123,3 +123,20 @@ def test_served_speculative_exports_acceptance_metrics():
         assert 'serving_speculative_accepted_total{model="specm"}' in scrape
     finally:
         spec.close()
+
+
+def test_speculative_refuses_rolling_cache():
+    """Rejection rewinds the decode index; a rolling cache slot would
+    then hold a rejected newer position that the window mask dates as an
+    older one — refused up front (runtime/speculative.py)."""
+    from flax.core import meta as _meta
+
+    target = get_model("transformer-test", max_seq_len=64,
+                       attention_window=16, rolling_kv_cache=True)
+    draft = get_model("transformer-test", max_seq_len=64)
+    tok = jnp.zeros((1, 4), jnp.int32)
+    tvars = _meta.unbox(target.init(jax.random.PRNGKey(0), tok))
+    dvars = _meta.unbox(draft.init(jax.random.PRNGKey(1), tok))
+    with pytest.raises(ValueError, match="rolling_kv_cache"):
+        speculative_generate(target, tvars, draft, dvars, tok,
+                             max_new_tokens=4)
