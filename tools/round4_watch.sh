@@ -149,13 +149,25 @@ while true; do
     run_stage lm_350m_win512 1500 python bench.py --workload lm \
       --lm-model gpt-350m --lm-batch 8 --lm-optimizer adafactor \
       --lm-xent-chunks 8 --lm-window 512
+    # long-context windowed pair (seq 8k): with the round-4 grid pruning
+    # the windowed point's attention DMA is ~5x lower than full causal —
+    # this pair is the hardware evidence (same model/batch, only the
+    # window differs; windowed MFU is never promoted)
+    run_stage lm_350m_8k_full 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 2 --seq-len 8192 \
+      --lm-optimizer adafactor --lm-remat --lm-remat-policy dots \
+      --lm-xent-chunks 16
+    run_stage lm_350m_8k_win512 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 2 --seq-len 8192 \
+      --lm-optimizer adafactor --lm-remat --lm-remat-policy dots \
+      --lm-xent-chunks 16 --lm-window 512
     # promote any measured LM/serving point that beats the ledger floor,
     # so the NEXT validate/driver bench.py adopts it automatically
     cat "$LEDGER"/*.out > tools/lm_sweep_r04.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r04.jsonl >> "$LOG" 2>&1 || true
     python tools/promote_serve_best.py "$LEDGER"/serve_*.out >> "$LOG" 2>&1 || true
     settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
-    if [ "$settled" -ge 26 ]; then
+    if [ "$settled" -ge 28 ]; then
       note "all stages settled ($settled done+skip)"; exit 0
     fi
   else
