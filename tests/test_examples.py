@@ -24,7 +24,8 @@ def test_all_examples_are_covered_here():
     covered = {"resnet50.yaml", "gpt-125m.yaml", "longctx-ring.yaml",
                "llama-1b-singlechip.yaml", "tpudef.yaml",
                "studyjob-sweep.yaml", "multislice-2slice.yaml",
-               "packed-pretrain.yaml"}
+               "packed-pretrain.yaml",
+               "mistral-style-window-serving.yaml"}
     assert have == covered, f"new example needs a parse test: {have - covered}"
 
 
@@ -32,7 +33,8 @@ def test_trainconfig_examples_parse():
     from kubeflow_tpu.runtime.trainer import TrainConfig
 
     for name in ("resnet50.yaml", "gpt-125m.yaml", "longctx-ring.yaml",
-                 "llama-1b-singlechip.yaml", "packed-pretrain.yaml"):
+                 "llama-1b-singlechip.yaml", "packed-pretrain.yaml",
+                 "mistral-style-window-serving.yaml"):
         cfg = TrainConfig.from_dict(_load(name))
         assert cfg.total_steps > 0, name
         if name == "packed-pretrain.yaml":
@@ -40,6 +42,9 @@ def test_trainconfig_examples_parse():
         if name == "llama-1b-singlechip.yaml":
             # the measured operating point must be config-reproducible
             assert cfg.flash_block_q == 1024 and cfg.xent_chunks == 8
+        if name == "mistral-style-window-serving.yaml":
+            # the train config carries the window the serve command uses
+            assert cfg.model_kwargs["attention_window"] == 512
 
 
 def test_tpudef_example_parses():
