@@ -335,3 +335,35 @@ def test_window_pruned_grid_long_sequence(window):
     gr = jax.grad(r)(q)
     np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                atol=3e-4, rtol=3e-4)
+
+
+@pytest.mark.parametrize("l,window,bq,bk", [
+    (192, 40, 64, 64),    # l not a multiple of block, window < block
+    (256, 300, 64, 64),   # window >= l: pruning degenerates to full
+    (384, 64, 64, 128),   # asymmetric blocks (bk = 2*bq)
+    (512, 8, 128, 64),    # tiny window inside one block
+])
+def test_window_pruned_grid_edge_shapes(l, window, bq, bk):
+    """Pruned-grid edge cases: windows wider than the sequence, windows
+    narrower than a block, asymmetric block shapes (l=192 exercises the
+    auto-halving of blocks for non-multiple lengths). Reference parity
+    fwd+bwd pins the kb_lo/qb_lo remaps and the clamped tail loads at
+    every geometry."""
+    q, k, v = make_qkv(l=l)
+    want = reference_attention(q, k, v, causal=True, window=window)
+    got = flash_attention(q, k, v, causal=True, window=window,
+                          block_q=bq, block_k=bk)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+    def f(q):
+        return flash_attention(q, k, v, causal=True, window=window,
+                               block_q=bq, block_k=bk).sum()
+
+    def r(q):
+        return reference_attention(q, k, v, causal=True,
+                                   window=window).sum()
+
+    np.testing.assert_allclose(np.asarray(jax.grad(f)(q)),
+                               np.asarray(jax.grad(r)(q)),
+                               atol=3e-4, rtol=3e-4)
