@@ -166,6 +166,16 @@ while true; do
     cat "$LEDGER"/*.out > tools/lm_sweep_r04.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r04.jsonl >> "$LOG" 2>&1 || true
     python tools/promote_serve_best.py "$LEDGER"/serve_*.out >> "$LOG" 2>&1 || true
+    # persist results into the REAL repo (this may run from a .sweepsnap
+    # copy): the driver's round-end bench.py reads the repo's
+    # tools/lm_best.json / serve_best.json, and uncommitted ledger files
+    # are committed by the driver — measurements survive unattended
+    if [ -d /root/repo/tools ] && [ "$PWD" != /root/repo ]; then
+      for f in lm_best.json serve_best.json serve_table.json \
+               lm_sweep_r04.jsonl round4_watch.log; do
+        [ -e "tools/$f" ] && cp "tools/$f" /root/repo/tools/ || true
+      done
+    fi
     settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
     if [ "$settled" -ge 28 ]; then
       note "all stages settled ($settled done+skip)"; exit 0
