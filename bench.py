@@ -187,7 +187,8 @@ def run_lm(args, devs):
 # promotion file (budget/choice knobs like --lm-min-budget-s do NOT)
 _LM_POINT_FLAGS = ("--lm-model", "--lm-batch", "--lm-optimizer",
                    "--lm-remat", "--lm-remat-policy", "--lm-attention",
-                   "--lm-xent-chunks", "--lm-grad-accum", "--lm-window")
+                   "--lm-xent-chunks", "--lm-grad-accum", "--lm-window",
+                   "--seq-len")
 
 
 def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
@@ -212,6 +213,11 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
         model = str(best.get("model", args.lm_model))
         attention = str(best.get("attention", args.lm_attention))
         batch = int(best.get("global_batch", args.lm_batch))
+        # seq_len must replay too: an 8k-context point replayed at the
+        # default 2048 with its tiny batch would not reproduce its MFU.
+        # getattr: older callers/tests build namespaces without seq_len
+        default_seq = getattr(args, "seq_len", 2048)
+        seq_len = int(best.get("seq_len", default_seq) or default_seq)
         optimizer = str(best.get("optimizer", args.lm_optimizer))
         remat = bool(best.get("remat", args.lm_remat))
         policy = str(best.get("remat_policy", args.lm_remat_policy))
@@ -225,6 +231,7 @@ def apply_lm_promotion(args, argv, best_path: str | None = None) -> str:
     args.lm_model = model
     args.lm_attention = attention
     args.lm_batch = batch
+    args.seq_len = seq_len
     args.lm_optimizer = optimizer
     args.lm_remat = remat
     args.lm_remat_policy = policy

@@ -161,19 +161,33 @@ while true; do
       --lm-model gpt-350m --lm-batch 2 --seq-len 8192 \
       --lm-optimizer adafactor --lm-remat --lm-remat-policy dots \
       --lm-xent-chunks 16 --lm-window 512
-    # promote any measured LM/serving point that beats the ledger floor,
-    # so the NEXT validate/driver bench.py adopts it automatically
+    # promote any measured LM/serving point that beats the ledger floor.
+    # Pull the REPO's promotion files first: the floor must be the best
+    # ever banked, not this snapshot's stale copy — otherwise a weaker
+    # window could re-promote over a better earlier point. promote_*
+    # re-derive the best from the FULL candidate ledger, so
+    # pull -> promote -> push converges on the true max.
+    if [ -d /root/repo/tools ] && [ "$PWD" != /root/repo ]; then
+      for f in lm_best.json serve_best.json serve_table.json; do
+        [ -e "/root/repo/tools/$f" ] && cp "/root/repo/tools/$f" tools/ || true
+      done
+    fi
     cat "$LEDGER"/*.out > tools/lm_sweep_r04.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r04.jsonl >> "$LOG" 2>&1 || true
     python tools/promote_serve_best.py "$LEDGER"/serve_*.out >> "$LOG" 2>&1 || true
     # persist results into the REAL repo (this may run from a .sweepsnap
     # copy): the driver's round-end bench.py reads the repo's
     # tools/lm_best.json / serve_best.json, and uncommitted ledger files
-    # are committed by the driver — measurements survive unattended
+    # are committed by the driver — measurements survive unattended.
+    # Atomic per-file (tmp + rename): the driver's bench can json.load
+    # these at any moment.
     if [ -d /root/repo/tools ] && [ "$PWD" != /root/repo ]; then
       for f in lm_best.json serve_best.json serve_table.json \
                lm_sweep_r04.jsonl round4_watch.log; do
-        [ -e "tools/$f" ] && cp "tools/$f" /root/repo/tools/ || true
+        if [ -e "tools/$f" ]; then
+          cp "tools/$f" "/root/repo/tools/.$f.tmp" \
+            && mv "/root/repo/tools/.$f.tmp" "/root/repo/tools/$f" || true
+        fi
       done
     fi
     settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
