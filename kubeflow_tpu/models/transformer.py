@@ -108,6 +108,11 @@ class TransformerConfig:
     # all 1), else the dense one-hot-einsum oracle; "dense"/"sparse"
     # force one.
     moe_impl: str = "auto"
+    # Expert capacity = factor * tokens * top_k / n_experts per shard
+    # (per batch row in the dense path). Tune against the measured
+    # moe_fill / moe_drop step diagnostics: fill << 1 wastes expert
+    # GEMM width on padding, drop >> 0 silently zeroes token updates.
+    moe_capacity_factor: float = 1.25
     # Pipeline parallelism: split the block stack into this many stages
     # over the `pipe` mesh axis (0/1 = no pipelining).
     pipeline_stages: int = 0
@@ -581,7 +586,9 @@ class Block(nn.Module):
         if self.use_moe:
             from kubeflow_tpu.ops.moe import MoEBlock
 
-            mlp_out = MoEBlock(cfg, name="moe")(ln2)
+            mlp_out = MoEBlock(
+                cfg, capacity_factor=cfg.moe_capacity_factor,
+                name="moe")(ln2)
         else:
             mlp_out = SwiGLU(cfg, name="mlp")(ln2)
         return x + mlp_out

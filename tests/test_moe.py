@@ -140,3 +140,17 @@ def test_sparse_single_device_no_mesh_matches_dense():
                 (g @ wd[ei]), np.float32)
     np.testing.assert_allclose(np.asarray(y, np.float32), y_ref,
                                rtol=0.1, atol=0.05)
+
+
+def test_capacity_factor_flows_from_config(devices8):
+    """moe_capacity_factor is the tuning knob against the fill/drop
+    diagnostics: a tight factor must visibly raise fill and drop."""
+    loose = lm_cfg(model_kwargs={"moe_capacity_factor": 8.0},
+                   mesh=MeshSpec(data=4, expert=2))
+    tight = lm_cfg(model_kwargs={"moe_capacity_factor": 0.5},
+                   mesh=MeshSpec(data=4, expert=2))
+    _, m_loose = _one_step_loss(loose, devices8)
+    _, m_tight = _one_step_loss(tight, devices8)
+    assert float(m_loose["moe_drop"]) == 0.0
+    assert float(m_tight["moe_fill"]) > float(m_loose["moe_fill"])
+    assert float(m_tight["moe_drop"]) > 0.0
