@@ -181,7 +181,15 @@ class TestServer:
         assert srv.workers
         import time as _t
 
-        _t.sleep(0.01)
+        # GC only reaps IDLE workers (busy ones keep their identity so a
+        # re-submit can't start a second concurrent apply) — wait for the
+        # worker thread to drain its apply, bounded (flaked at a fixed
+        # 10 ms under CPU contention)
+        deadline = _t.monotonic() + 30.0
+        while _t.monotonic() < deadline:
+            if not any(w.busy for w in srv.workers.values()):
+                break
+            _t.sleep(0.05)
         assert srv.gc_once() == ["kubeflow-tpu"]
         assert not srv.workers
 
