@@ -34,6 +34,13 @@ run_stage() {
   local name="$1" tmo="$2"; shift 2
   [ -e "$LEDGER/$name.done" ] && return 0
   [ -e "$LEDGER/$name.skip" ] && return 0
+  # Yield to the DRIVER's bench: stages run bench sequentially from this
+  # process, so any bench.py alive at stage-start belongs to someone
+  # else (the driver's round-end capture) — the one chip must be theirs.
+  if pgrep -f "[b]ench.py" >/dev/null 2>&1; then
+    note "external bench.py running — yielding the chip before $name"
+    return 1
+  fi
   if ! probe; then note "tunnel dropped before $name"; return 1; fi
   note "stage $name: $*"
   if timeout "$tmo" "$@" > "$LEDGER/$name.out" 2>&1; then
