@@ -175,6 +175,13 @@ def run_lm(args, devs):
         **({"window": args.lm_window} if args.lm_window else {}),
         "n_params_m": round(trainer.n_params / 1e6, 1),
     }
+    # MoE observability rides along (moe_fill/moe_drop, plus
+    # moe_sparse_dispatch — the ground truth for which dispatch path ran;
+    # ADVICE r4): read from the last warmup step's metrics, which see the
+    # same resident batch as the timed steps.
+    for key in sorted(m):
+        if key.startswith("moe_"):
+            out[key] = round(float(m[key]), 4)
     # echo the kernel-tuning env so sweep logs are self-describing and
     # tools/promote_best.py can reproduce the winning operating point
     for var in ("KFTPU_FLASH_BLOCK_Q", "KFTPU_FLASH_BLOCK_K"):
@@ -262,6 +269,42 @@ def run_serving(args) -> dict:
     return sb.run_mode("continuous", sargs)
 
 
+_EXTERN_LOCK = "/tmp/kftpu_extern_bench.lock"
+
+
+def _mark_extern_bench(force_cpu: bool = False) -> None:
+    """Signal the persistent hardware watcher (tools/round5_watch.sh)
+    that an EXTERNAL bench owns the chip. The watcher's own stages run
+    with KFTPU_STAGE_RUN=1 and skip this; any other invocation — above
+    all the driver's round-end capture — writes a pid lockfile that the
+    watcher polls every few seconds, killing its in-flight stage so the
+    chip frees well inside this bench's 300s device-init probe window.
+    The round-4 protocol checked only at stage START, so a driver bench
+    landing mid-stage lost the whole round's capture (VERDICT r4 #1)."""
+    if force_cpu or os.environ.get("KFTPU_STAGE_RUN"):
+        # --force-cpu never touches the chip: the hermetic test suite
+        # must not evict the watcher's in-flight hardware stage
+        return
+    import atexit
+
+    def _unlock() -> None:
+        try:
+            os.unlink(_EXTERN_LOCK)
+        except OSError:
+            pass
+
+    try:
+        # atomic create (tmp + rename): the watcher's poll must never
+        # observe an empty lock mid-write and reap it as stale
+        tmp = f"{_EXTERN_LOCK}.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(str(os.getpid()))
+        os.replace(tmp, _EXTERN_LOCK)
+        atexit.register(_unlock)
+    except OSError:
+        pass  # /tmp unwritable: lose the courtesy signal, not the bench
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=256,
@@ -335,6 +378,7 @@ def main() -> int:
     p.add_argument("--serving-min-budget-s", type=float, default=300.0)
     args = p.parse_args()
 
+    _mark_extern_bench(force_cpu=args.force_cpu)
     logging.basicConfig(level=logging.WARNING)
 
     lm_config_source = apply_lm_promotion(args, sys.argv[1:])

@@ -168,7 +168,11 @@ def _remat_policy(cfg: "TransformerConfig"):
         # full d_ff-wide tensor per layer, which is why "mlp" OOMed at
         # the same batch sizes as no-remat (tools/remat_plan.py).
         # Replay cost: the gate/up matmuls + elementwise, ~2/9 of block
-        # MACs.
+        # MACs — plus the down-projection matmul, whose INPUT is d_ff-
+        # wide even though its output is d-wide: an input-aval predicate
+        # cannot save it, so its ~1/9 of block MACs replays too (total
+        # ~3/9). A width predicate on output avals alone would instead
+        # retain the d_ff-wide gate/up outputs and lose the memory win.
         wide = cfg.d_ff
 
         def mlp_policy(prim, *avals, **params):

@@ -198,12 +198,19 @@ class MoEBlock(nn.Module):
             (e, cfg.d_ff, d), jnp.float32)
 
         mesh = current_mesh()
-        if self._sparse_ok(mesh):
+        use_sparse = self._sparse_ok(mesh)
+        if use_sparse:
             y, kept, routed, slots = self._sparse(
                 x, gate_vals, gate_idx, w_gate, w_up, w_down, mesh)
         else:
             y, kept, routed, slots = self._dense(
                 x, gate_vals, gate_idx, w_gate, w_up, w_down)
+        # Ground truth for which dispatch path actually ran (ADVICE r4):
+        # _sparse_ok silently falls back to dense on a meshless trace, so
+        # a run labeled 'sparse' could measure dense with nothing in the
+        # record saying so. 1.0 = sparse all-to-all, 0.0 = dense oracle.
+        self.sow("diagnostics", "moe_sparse_dispatch",
+                 jnp.float32(1.0 if use_sparse else 0.0))
 
         # aux load-balancing loss: mean_e (dispatch fraction * prob mass),
         # with the dispatch fraction taken from the router's PRE-capacity

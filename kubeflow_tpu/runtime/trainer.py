@@ -536,19 +536,24 @@ class Trainer:
                 return (new_stats,
                         jax.tree.map(lambda a, g: a + g * w, g_sum, grads),
                         loss_sum + loss * w, acc_sum + acc * w,
-                        n_sum + w), diag
+                        n_sum + w), (diag, w)
 
             zeros = jax.tree.map(
                 lambda p: jnp.zeros(p.shape, jnp.float32), state.params)
-            (new_stats, g_sum, loss_sum, acc_sum, n_sum), diags = jax.lax.scan(
-                body,
-                (state.batch_stats, zeros, jnp.float32(0.0),
-                 jnp.float32(0.0), jnp.float32(0.0)),
-                _microbatches(batch))
+            (new_stats, g_sum, loss_sum, acc_sum, n_sum), (diags, ws) = \
+                jax.lax.scan(
+                    body,
+                    (state.batch_stats, zeros, jnp.float32(0.0),
+                     jnp.float32(0.0), jnp.float32(0.0)),
+                    _microbatches(batch))
             n = jnp.maximum(n_sum, 1.0)
             grads = jax.tree.map(
                 lambda g, p: (g / n).astype(p.dtype), g_sum, state.params)
-            diag = jax.tree.map(lambda a: a.mean(), diags)
+            # diagnostics combine token-weighted, matching loss/acc: with
+            # packed batches the microbatch valid-token counts differ, and
+            # an unweighted mean of moe_fill/moe_drop would drift from the
+            # single-step definition (ADVICE r4).
+            diag = jax.tree.map(lambda a: (a * ws).sum() / n, diags)
             return _apply_update(state, grads, new_stats,
                                  loss_sum / n, acc_sum / n, diag)
 

@@ -17,3 +17,26 @@ import __graft_entry__ as graft
 @pytest.mark.slow
 def test_dryrun_multichip_16_green_and_warning_clean():
     graft.dryrun_multichip(16)
+
+
+def test_spmd_equivalence_parity():
+    """The self-certifying SPMD statement (VERDICT r4 weak #6): one
+    model/seed/batch reaches the same loss under dp, dp·tp·sp and
+    fsdp·accum layouts — forward parity at step 1, gradient-path parity
+    at step 2."""
+    graft.assert_spmd_parity(graft.spmd_equivalence_losses(8))
+
+
+def test_spmd_equivalence_catches_dropped_collective(monkeypatch):
+    """The contract must FAIL when a sharding bug is injected: neutering
+    ring attention's ppermute (each shard silently attends only its local
+    K/V — shapes intact, numbers wrong) has to trip the parity
+    assertion. Guards against the contract degenerating into
+    'execution succeeded'."""
+    import jax
+
+    monkeypatch.setattr(jax.lax, "ppermute",
+                        lambda x, axis_name, perm: x)
+    losses = graft.spmd_equivalence_losses(8)
+    with pytest.raises(AssertionError, match="SPMD parity violated"):
+        graft.assert_spmd_parity(losses)

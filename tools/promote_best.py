@@ -60,6 +60,12 @@ def main() -> int:
     if best is None:
         print(f"no sweep point beat mfu={floor:.3f}; defaults unchanged")
         return 0
+    best = dict(best)
+    if not best.get("remat"):
+        # ledger hygiene (VERDICT r4 weak #4): record only knobs actually
+        # in effect — "remat_policy" next to remat=false invites reading
+        # the point as remat-verified when the policy never ran
+        best.pop("remat_policy", None)
     # atomic replace: a bench.py starting concurrently (both are fired
     # by the tunnel coming back) must never read a half-written file
     tmp = best_path + ".tmp"

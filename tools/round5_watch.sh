@@ -1,0 +1,228 @@
+#!/usr/bin/env bash
+# Round-5 persistent hardware watcher.
+#
+# Protocol fixes over round 4 (VERDICT r4 weak #1 + ADVICE r4 #1):
+#   - The chip-yield is BIDIRECTIONAL. bench.py, when invoked by anyone
+#     other than a watcher stage (KFTPU_STAGE_RUN unset), writes
+#     /tmp/kftpu_extern_bench.lock with its pid. This watcher checks the
+#     lock between stages AND every 5s while a stage is in flight,
+#     killing the stage's whole process group the moment the lock
+#     appears — the driver's round-end bench gives up on device init
+#     after 300s, so the chip must free within seconds, not within
+#     `timeout 2400` of a stage.
+#   - probe() is bounded to 90s (round 4's 240s probe could itself
+#     collide with a driver capture) and never runs while the lock is
+#     held.
+#   - A failure counts toward the 2-strike .skip ONLY when it is
+#     deterministic: rc not in {124,137} (timeout kills) AND a
+#     post-failure probe succeeds. Two mid-stage tunnel drops no longer
+#     permanently skip a stage that never ran on a healthy window.
+#
+# Run from the repo root: nohup bash tools/round5_watch.sh &
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/round5_watch.log
+LEDGER=tools/r5_stages
+LOCK=/tmp/kftpu_extern_bench.lock
+mkdir -p "$LEDGER"
+
+note() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
+
+# True iff an external bench's lockfile exists and its pid is alive.
+# A stale lock (bench SIGKILLed before atexit) is removed on sight.
+extern_active() {
+  [ -e "$LOCK" ] || return 1
+  local pid
+  pid=$(cat "$LOCK" 2>/dev/null)
+  if [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null; then return 0; fi
+  rm -f "$LOCK"
+  return 1
+}
+
+probe() {
+  extern_active && return 1
+  timeout 90 env KFTPU_STAGE_RUN=1 \
+    python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+# run NAME TIMEOUT CMD... — execute once, mark done on rc==0. Stage
+# stdout/stderr goes to $LEDGER/$name.out (bench JSON lines land there
+# for the promote step) and is appended to LOG.
+run_stage() {
+  local name="$1" tmo="$2"; shift 2
+  [ -e "$LEDGER/$name.done" ] && return 0
+  [ -e "$LEDGER/$name.skip" ] && return 0
+  if extern_active; then
+    note "external bench holds the chip — yielding before $name"
+    return 1
+  fi
+  if ! probe; then note "tunnel dropped before $name"; return 1; fi
+  note "stage $name: $*"
+  setsid env KFTPU_STAGE_RUN=1 timeout "$tmo" "$@" \
+    > "$LEDGER/$name.out" 2>&1 &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    if extern_active; then
+      note "external bench appeared — killing in-flight stage $name"
+      kill -TERM -- -"$pid" 2>/dev/null
+      sleep 5
+      kill -KILL -- -"$pid" 2>/dev/null
+      wait "$pid" 2>/dev/null
+      while extern_active; do sleep 10; done
+      note "external bench finished — resuming"
+      return 1  # yielded, not failed: no strike, stage re-runs next pass
+    fi
+    sleep 5
+  done
+  wait "$pid"
+  local rc=$?
+  if [ "$rc" -eq 0 ]; then
+    touch "$LEDGER/$name.done"; note "stage $name DONE"
+    cat "$LEDGER/$name.out" >> "$LOG"
+    return 0
+  fi
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    note "stage $name timed out (rc=$rc) — no strike"
+  elif probe; then
+    echo x >> "$LEDGER/$name.fail"
+    if [ "$(wc -l < "$LEDGER/$name.fail")" -ge 2 ]; then
+      mv "$LEDGER/$name.fail" "$LEDGER/$name.skip"
+      note "stage $name FAILED twice deterministically (rc=$rc) — skipping"
+    else
+      note "stage $name FAILED (rc=$rc) — one deterministic retry left"
+    fi
+  else
+    note "stage $name failed (rc=$rc) with the tunnel down — no strike"
+  fi
+  cat "$LEDGER/$name.out" >> "$LOG"
+  return 1
+}
+
+while true; do
+  if extern_active; then
+    note "external bench holds the chip — idling"
+    sleep 20
+    continue
+  fi
+  if probe; then
+    note "tunnel UP — resuming ledger"
+    # 1. Headline validation: the exact command the driver runs.
+    run_stage validate_bench 2400 python bench.py
+    # 2. MoE hardware point (first gpt-moe-8e measurement).
+    run_stage moe_point 1800 python bench.py --workload lm \
+      --lm-model gpt-moe-8e --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    # dots OOMs on MoE by 577M (r5 ledger: it pins the [e,cap,d_ff]
+    # expert outputs — the one tensor class MoE needs dropped); slim's
+    # whitelist recomputes them, bs4 halves them
+    run_stage moe_point_slim 1800 python bench.py --workload lm \
+      --lm-model gpt-moe-8e --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    run_stage moe_point_bs4 1800 python bench.py --workload lm \
+      --lm-model gpt-moe-8e --lm-batch 4 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    # 3. Serving ledger: prefill chunking, int8 weights, int8 KV,
+    #    rolling-cache A/B on a GQA model with a real cache.
+    run_stage serve_prefill_per_token 1800 env KFTPU_PREFILL_CHUNK=1 \
+      python tools/serve_bench.py --modes micro --requests 16 \
+      --param-dtype bfloat16
+    run_stage serve_prefill_chunked 1800 python tools/serve_bench.py \
+      --modes micro --requests 16 --param-dtype bfloat16
+    run_stage serve_cont_bf16 1800 python tools/serve_bench.py \
+      --modes continuous --requests 32 --param-dtype bfloat16
+    run_stage serve_cont_int8 1800 python tools/serve_bench.py \
+      --modes continuous --requests 32 --param-dtype int8
+    run_stage serve_kv_bf16 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 --param-dtype int8
+    run_stage serve_kv_int8 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --kv-cache-dtype int8
+    run_stage serve_win_full 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --attention-window 512
+    run_stage serve_win_rolling 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --attention-window 512 --rolling-kv-cache
+    # 4. ResNet byte-wall A/B: whole-forward remat trades the HBM
+    #    activation round-trip for VMEM-fused recompute.
+    run_stage resnet_remat_full 1800 python bench.py --workload resnet \
+      --resnet-remat full
+    run_stage resnet_remat_dots 1800 python bench.py --workload resnet \
+      --resnet-remat dots
+    # 5. Remat-policy frontier (the route toward >=0.55 at 700M+).
+    #    tools/remat_plan.py upper bounds (llama-1b bs16): dots = 23.6
+    #    GiB saved at 6.5% replay; slim = 11.6 GiB at 58%; full = 2.6
+    #    GiB at 100%. bs8 halves activation bytes: dots@bs8 is the
+    #    highest-MFU candidate IF it fits.
+    run_stage lm_1b_bs8_dots 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_760m_bs8_dots 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_1b_bs8_slim 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    run_stage lm_1b_bs16_slim 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    run_stage lm_760m_bs16_slim 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    run_stage lm_350m_bs16_dots 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_1b_bs16_dots 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_760m_bs16_dots 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy dots --lm-xent-chunks 8
+    run_stage lm_760m_bs8_mlp 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy mlp --lm-xent-chunks 8
+    run_stage lm_760m_bs16_full 1800 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy full --lm-xent-chunks 8
+    run_stage lm_1b_bs16_full 1800 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy full --lm-xent-chunks 8
+    run_stage lm_350m_bs16_full 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 16 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy full --lm-xent-chunks 8
+    # 6. Op microbenchmark (attributes the remaining MFU gap).
+    run_stage microbench 2400 python tools/op_microbench.py \
+      --batch 8 --seq 2048
+    # 7. Feature-cost A/Bs (sliding window; 8k long-context pair —
+    #    windowed points are never promoted).
+    run_stage lm_350m_win512 1500 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 8 --lm-optimizer adafactor \
+      --lm-xent-chunks 8 --lm-window 512
+    run_stage lm_350m_8k_full 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 2 --seq-len 8192 \
+      --lm-optimizer adafactor --lm-remat --lm-remat-policy dots \
+      --lm-xent-chunks 16
+    run_stage lm_350m_8k_win512 1800 python bench.py --workload lm \
+      --lm-model gpt-350m --lm-batch 2 --seq-len 8192 \
+      --lm-optimizer adafactor --lm-remat --lm-remat-policy dots \
+      --lm-xent-chunks 16 --lm-window 512
+    # Promote any measured LM/serving point that beats the ledger floor.
+    cat "$LEDGER"/*.out > tools/lm_sweep_r05.jsonl 2>/dev/null || true
+    python tools/promote_best.py tools/lm_sweep_r05.jsonl \
+      >> "$LOG" 2>&1 || true
+    python tools/promote_serve_best.py "$LEDGER"/serve_*.out \
+      >> "$LOG" 2>&1 || true
+    settled=$(ls "$LEDGER"/*.done "$LEDGER"/*.skip 2>/dev/null | wc -l)
+    if [ "$settled" -ge 30 ]; then
+      note "all stages settled ($settled done+skip)"
+      exit 0
+    fi
+  else
+    note "tunnel down"
+  fi
+  sleep 230
+done
