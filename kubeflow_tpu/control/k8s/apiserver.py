@@ -122,7 +122,12 @@ class ApiServer:
                     self._send_json(409, _status(409, str(e), "Conflict"))
                 elif isinstance(e, ob.Expired):
                     self._send_json(410, _status(410, str(e), "Expired"))
-                elif isinstance(e, (ValueError, LookupError, ob.Invalid)):
+                elif isinstance(e, ob.Invalid):
+                    # 422 round-trips to ob.Invalid in RestClient._req:
+                    # a controller catching Invalid behaves identically
+                    # on FakeCluster and over HTTP
+                    self._send_json(422, _status(422, str(e), "Invalid"))
+                elif isinstance(e, (ValueError, LookupError)):
                     self._send_json(400, _status(400, str(e), "BadRequest"))
                 else:
                     log.exception("apiserver internal error")
@@ -217,6 +222,21 @@ class ApiServer:
             return
         if verb == "PATCH":
             patch = json.loads(h._body())
+            ctype = h.headers.get("Content-Type") or ""
+            if "apply-patch" in ctype:
+                # server-side apply: PATCH with apply-patch content type
+                # (the body is the manager's full intent; JSON is a YAML
+                # subset, so kubectl-style +yaml bodies parse fine)
+                fm = (q.get("fieldManager") or [""])[0]
+                force = (q.get("force") or ["false"])[0] in ("1", "true")
+                patch.setdefault("apiVersion", p.api_version)
+                patch.setdefault("kind", p.kind)
+                ob.meta(patch).setdefault("name", p.name)
+                if p.namespace:
+                    ob.meta(patch).setdefault("namespace", p.namespace)
+                h._send_json(200, c.apply(patch, field_manager=fm,
+                                          force=force))
+                return
             h._send_json(200, c.patch(p.api_version, p.kind, p.name, patch,
                                       p.namespace))
             return

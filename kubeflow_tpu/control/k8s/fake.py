@@ -25,6 +25,76 @@ from typing import Any, Callable, Iterator
 from kubeflow_tpu.control.k8s import objects as ob
 
 
+# Identity/system fields excluded from server-side-apply ownership:
+# shared by construction, never conflict, never removed.
+_SSA_IDENTITY = {("apiVersion",), ("kind",), ("metadata", "name"),
+                 ("metadata", "namespace")}
+
+
+def _ssa_leaf_paths(obj: dict, prefix: tuple = ()) -> set[tuple]:
+    """Leaf field paths of an apply intent (scalars, lists and empty
+    dicts are leaves; non-empty dicts recurse), minus identity fields."""
+    out: set[tuple] = set()
+    for k, v in obj.items():
+        p = prefix + (k,)
+        if isinstance(v, dict) and v:
+            out |= _ssa_leaf_paths(v, p)
+        elif p not in _SSA_IDENTITY:
+            out.add(p)
+    return out
+
+
+def _ssa_overlaps(p: tuple, q: tuple) -> bool:
+    """True when one path is the other (or an ancestor of it) — i.e.
+    writing p restructures the field at q or vice versa."""
+    n = min(len(p), len(q))
+    return p[:n] == q[:n]
+
+
+def _ssa_get(obj: dict, path: tuple) -> tuple[Any, bool]:
+    cur = obj
+    for k in path:
+        if not isinstance(cur, dict) or k not in cur:
+            return None, False
+        cur = cur[k]
+    return cur, True
+
+
+def _ssa_set(obj: dict, path: tuple, value: Any) -> None:
+    cur = obj
+    for k in path[:-1]:
+        nxt = cur.get(k)
+        if not isinstance(nxt, dict):
+            nxt = cur[k] = {}
+        cur = nxt
+    cur[path[-1]] = value
+
+
+def _ssa_delete(obj: dict, path: tuple) -> None:
+    """Delete a leaf and prune now-empty parent dicts."""
+    parents = []
+    cur = obj
+    for k in path[:-1]:
+        if not isinstance(cur, dict) or k not in cur:
+            return
+        parents.append((cur, k))
+        cur = cur[k]
+    if isinstance(cur, dict):
+        cur.pop(path[-1], None)
+    for parent, k in reversed(parents):
+        if parent[k] == {}:
+            del parent[k]
+
+
+def _ssa_managed_fields(owners: dict[tuple, set]) -> list[dict]:
+    by_mgr: dict[str, list] = {}
+    for path, mgrs in owners.items():
+        for mg in mgrs:
+            by_mgr.setdefault(mg, []).append(list(path))
+    return [{"manager": mg, "operation": "Apply", "fields": sorted(fs)}
+            for mg, fs in sorted(by_mgr.items())]
+
+
 @dataclass(frozen=True)
 class Key:
     api_version: str
@@ -272,6 +342,122 @@ class FakeCluster:
             else:
                 new = ob.merge_patch(cur, patch)
             ob.meta(new)["resourceVersion"] = ob.meta(cur)["resourceVersion"]
+            return self._update(new)
+
+    def apply(self, obj: dict, *, field_manager: str,
+              force: bool = False) -> dict:
+        """Server-side apply (simplified SSA — the apiserver's
+        `application/apply-patch+yaml` PATCH; reference controllers'
+        CreateOrUpdate flows assume a live apiserver provides this).
+
+        `obj` is the manager's full declarative intent. Semantics kept
+        from the real thing:
+          - per-field ownership tracked in metadata.managedFields
+            (one entry per manager, `fields` = list of leaf paths);
+          - changing a field owned by another manager is a 409 Conflict
+            naming the owner, unless force=true transfers ownership;
+          - applying the same value as another manager shares ownership;
+          - a field this manager owned but no longer applies is REMOVED
+            (unless co-owned) — the declarative-deletion contract that
+            merge-patch cannot express.
+        Simplifications (documented, tested): leaf granularity is
+        scalars/lists/empty-dicts (lists replace atomically — no
+        strategic-merge list keys), and only Apply operations take
+        ownership (plain updates don't steal fields).
+        """
+        if not field_manager:
+            raise ob.Invalid("fieldManager is required for server-side apply")
+        with self._lock:
+            intent = ob.deep_copy(obj)
+            m = ob.meta(intent)
+            for sys_field in ("managedFields", "resourceVersion", "uid",
+                              "creationTimestamp", "generation"):
+                m.pop(sys_field, None)
+            key = self._key(intent)
+            paths = _ssa_leaf_paths(intent)
+            found = self._store.get(key)
+            if found is None:
+                m["managedFields"] = _ssa_managed_fields(
+                    {p: {field_manager} for p in paths})
+                return self.create(intent)
+
+            owners: dict[tuple, set] = {}
+            for entry in ob.meta(found).get("managedFields") or []:
+                for ps in entry.get("fields", []):
+                    owners.setdefault(tuple(ps), set()).add(entry["manager"])
+            conflicts = []
+            for p in sorted(paths):
+                # ownership guards the whole subtree: an intent path that
+                # is a strict ancestor or descendant of another manager's
+                # leaf (e.g. applying spec.resources.cpu under an owned
+                # spec.resources scalar) restructures that field just as
+                # surely as rewriting the exact path
+                for q, mgrs in list(owners.items()):
+                    others = mgrs - {field_manager}
+                    if not others or not _ssa_overlaps(p, q):
+                        continue
+                    if p == q:
+                        cur_val, has = _ssa_get(found, p)
+                        new_val, _ = _ssa_get(intent, p)
+                        if has and cur_val == new_val:
+                            continue  # same value: share ownership
+                    elif len(p) < len(q):
+                        iv, _ = _ssa_get(intent, p)
+                        if iv == {}:
+                            # asserting an empty map composes with deeper
+                            # owners (entries are preserved, not cleared)
+                            continue
+                    else:
+                        cv, has = _ssa_get(found, q)
+                        if has and isinstance(cv, dict):
+                            # q's owner asserted a map; a deeper write
+                            # adds/updates an entry, it does not
+                            # restructure their field
+                            continue
+                    if force:
+                        owners[q] -= others  # ownership transfers
+                        if not owners[q]:
+                            del owners[q]
+                    else:
+                        conflicts.append((p, q, sorted(others)))
+            if conflicts:
+                raise ob.Conflict(
+                    f"{key.kind} {key.name}: server-side apply conflicts "
+                    f"for manager {field_manager!r}: " + "; ".join(
+                        (f"{'.'.join(p)}" if p == q
+                         else f"{'.'.join(p)} (under {'.'.join(q)})")
+                        + f" owned by {', '.join(o)}"
+                        for p, q, o in conflicts))
+
+            new = ob.deep_copy(found)
+            prev = {p for p, mgrs in owners.items() if field_manager in mgrs}
+            for p in prev - paths:
+                others_hold = any(
+                    _ssa_overlaps(p, q) and (mgrs - {field_manager})
+                    for q, mgrs in owners.items())
+                if others_hold:
+                    # co- or sub-owned (e.g. this manager owned the map,
+                    # another owns an entry under it): relinquish only
+                    owners[p].discard(field_manager)
+                    if not owners[p]:
+                        del owners[p]
+                    continue
+                _ssa_delete(new, p)
+                owners.pop(p, None)
+            for p in sorted(paths):
+                val, _ = _ssa_get(intent, p)
+                if val == {}:
+                    cur, has = _ssa_get(new, p)
+                    if has and isinstance(cur, dict):
+                        # owning an empty map asserts its existence, it
+                        # does not clear entries other managers put there
+                        owners.setdefault(p, set()).add(field_manager)
+                        continue
+                _ssa_set(new, p, ob.deep_copy(val))
+                owners.setdefault(p, set()).add(field_manager)
+            ob.meta(new)["managedFields"] = _ssa_managed_fields(owners)
+            ob.meta(new)["resourceVersion"] = \
+                ob.meta(found)["resourceVersion"]
             return self._update(new)
 
     def delete(

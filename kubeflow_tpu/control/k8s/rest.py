@@ -125,10 +125,22 @@ class RestClient:
 
     def _req(self, method: str, path: str, **kw) -> Any:
         r = self._s.request(method, self.base_url + path, timeout=30, **kw)
+
+        def errtext() -> str:
+            # surface the Status message (client-go behavior) — the
+            # actionable part of e.g. an SSA conflict is its tail, which
+            # raw-body truncation would cut
+            try:
+                return r.json().get("message") or r.text[:300]
+            except ValueError:
+                return r.text[:300]
+
         if r.status_code == 404:
-            raise ob.NotFound(f"{method} {path}: {r.text[:200]}")
+            raise ob.NotFound(f"{method} {path}: {errtext()}")
         if r.status_code == 409:
-            raise ob.Conflict(f"{method} {path}: {r.text[:200]}")
+            raise ob.Conflict(f"{method} {path}: {errtext()}")
+        if r.status_code == 422:
+            raise ob.Invalid(f"{method} {path}: {errtext()}")
         if r.status_code >= 400:
             err = ob.ApiError(f"{method} {path}: HTTP {r.status_code}: {r.text[:500]}")
             err.code = r.status_code
@@ -234,6 +246,23 @@ class RestClient:
         return self._req(
             "PATCH", path, data=json.dumps(patch), headers={"Content-Type": ctype}
         )
+
+    def apply(self, obj: dict, *, field_manager: str,
+              force: bool = False) -> dict:
+        """Server-side apply: PATCH the manager's full intent with the
+        apply-patch content type. Conflicting fields owned by another
+        manager raise Conflict (409) unless force=True transfers
+        ownership. Same signature as FakeCluster.apply, so controllers
+        written against either backend can declare state identically."""
+        m = ob.meta(obj)
+        path = self._path(obj["apiVersion"], obj["kind"],
+                          m.get("namespace"), m["name"])
+        params = {"fieldManager": field_manager}
+        if force:
+            params["force"] = "true"
+        return self._req(
+            "PATCH", path, params=params, data=json.dumps(obj),
+            headers={"Content-Type": "application/apply-patch+yaml"})
 
     def delete(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> None:
         self._req("DELETE", self._path(api_version, kind, namespace, name))
