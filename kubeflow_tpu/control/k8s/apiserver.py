@@ -234,6 +234,17 @@ class ApiServer:
                 ob.meta(patch).setdefault("name", p.name)
                 if p.namespace:
                     ob.meta(patch).setdefault("namespace", p.namespace)
+                # a body naming a DIFFERENT object than the URL must be
+                # a 400, never a silent apply elsewhere (apiserver
+                # semantics: the URL is authoritative)
+                got = (patch["apiVersion"], patch["kind"],
+                       ob.meta(patch).get("name"),
+                       ob.meta(patch).get("namespace") or None)
+                want = (p.api_version, p.kind, p.name, p.namespace)
+                if got != want:
+                    raise ValueError(
+                        f"apply body addresses {got}, URL addresses "
+                        f"{want}")
                 h._send_json(200, c.apply(patch, field_manager=fm,
                                           force=force))
                 return

@@ -401,6 +401,10 @@ class FakeCluster:
                         new_val, _ = _ssa_get(intent, p)
                         if has and cur_val == new_val:
                             continue  # same value: share ownership
+                        if new_val == {} and isinstance(cur_val, dict):
+                            # re-asserting a map that now has entries
+                            # composes, exactly like the ancestor case
+                            continue
                     elif len(p) < len(q):
                         iv, _ = _ssa_get(intent, p)
                         if iv == {}:
@@ -432,8 +436,11 @@ class FakeCluster:
             new = ob.deep_copy(found)
             prev = {p for p, mgrs in owners.items() if field_manager in mgrs}
             for p in prev - paths:
+                # only q AT or BELOW p blocks deletion: a strict-ancestor
+                # empty-map assert owns the map's existence, not this
+                # leaf — counting it would orphan the field forever
                 others_hold = any(
-                    _ssa_overlaps(p, q) and (mgrs - {field_manager})
+                    q[:len(p)] == p and (mgrs - {field_manager})
                     for q, mgrs in owners.items())
                 if others_hold:
                     # co- or sub-owned (e.g. this manager owned the map,

@@ -129,11 +129,15 @@ class RestClient:
         def errtext() -> str:
             # surface the Status message (client-go behavior) — the
             # actionable part of e.g. an SSA conflict is its tail, which
-            # raw-body truncation would cut
+            # raw-body truncation would cut. Non-dict JSON bodies (a
+            # proxy's bare string/null) fall back to raw text.
             try:
-                return r.json().get("message") or r.text[:300]
+                doc = r.json()
             except ValueError:
-                return r.text[:300]
+                doc = None
+            if isinstance(doc, dict) and doc.get("message"):
+                return doc["message"]
+            return r.text[:300]
 
         if r.status_code == 404:
             raise ob.NotFound(f"{method} {path}: {errtext()}")

@@ -1,0 +1,122 @@
+"""Registry->registry image mirroring — the hubsync analogue.
+
+The reference syncs its GCR-built images to DockerHub with
+releasing/hubsync/hubsync.py:1 (enumerate tags on the source registry,
+skip images the destination already has, pull/retag/push the rest).
+Same capability here, driven by the release image matrix
+(release/image_matrix.all_images()) instead of a registry listing —
+the matrix IS the source of truth for what a release ships.
+
+- ``mirror_commands(spec, ...)`` — the pull/tag/push command triplet for
+  one image (pure; unit-testable).
+- ``mirror(...)``                — execute the sync with a pluggable
+  runner and digest probe, skipping destination-fresh images the way
+  hubsync skips already-pushed tags.
+- ``mirror_workflow(...)``       — the sync as a Workflow DAG step per
+  image, composable after release_workflow's pushes.
+"""
+
+from __future__ import annotations
+
+import subprocess
+from typing import Callable
+
+from kubeflow_tpu.release.image_matrix import all_images
+from kubeflow_tpu.release.releaser import ImageSpec, image_ref
+from kubeflow_tpu.testing.workflow import Workflow
+
+
+def mirror_commands(spec: ImageSpec, src_registry: str, dst_registry: str,
+                    tag: str, tool: str = "docker") -> list[list[str]]:
+    src = image_ref(spec, src_registry, tag)
+    dst = image_ref(spec, dst_registry, tag)
+    return [
+        [tool, "pull", src],
+        [tool, "tag", src, dst],
+        [tool, "push", dst],
+    ]
+
+
+def _default_probe(ref: str, tool: str = "docker") -> str | None:
+    """Content digest of `ref` on its registry, or None when absent (the
+    hubsync.py existence check, via `manifest inspect`). Extracts the
+    Descriptor digest — the registry-independent identity — because the
+    verbose output also embeds the queried Ref, which necessarily
+    differs between source and destination."""
+    out = subprocess.run(
+        [tool, "manifest", "inspect", "--verbose", ref],
+        capture_output=True, text=True)
+    if out.returncode != 0:
+        return None
+    import json
+
+    try:
+        doc = json.loads(out.stdout)
+    except ValueError:
+        return None
+    entries = doc if isinstance(doc, list) else [doc]
+    digests = [((e.get("Descriptor") or {}).get("digest"))
+               for e in entries if isinstance(e, dict)]
+    if not digests or any(d is None for d in digests):
+        return None
+    return ",".join(sorted(digests))
+
+
+def mirror(src_registry: str, dst_registry: str, tag: str, *,
+           images: tuple[ImageSpec, ...] | None = None,
+           runner: Callable[[list[str]], None] | None = None,
+           probe: Callable[[str], str | None] | None = None,
+           tool: str = "docker") -> dict:
+    """Sync `images` (default: the full release matrix) from src to dst.
+
+    An image whose destination digest matches its source digest is
+    skipped (already mirrored); a destination miss or mismatch triggers
+    pull -> tag -> push. Returns {"mirrored": [...], "skipped": [...]}.
+    """
+    images = all_images() if images is None else images
+    run = runner or (lambda cmd: subprocess.run(cmd, check=True))
+    probe = probe or (lambda ref: _default_probe(ref, tool))
+    mirrored, skipped = [], []
+    for spec in images:
+        src = image_ref(spec, src_registry, tag)
+        dst = image_ref(spec, dst_registry, tag)
+        src_digest = probe(src)
+        if src_digest is not None and probe(dst) == src_digest:
+            skipped.append(dst)
+            continue
+        for cmd in mirror_commands(spec, src_registry, dst_registry,
+                                   tag, tool):
+            run(cmd)
+        mirrored.append(dst)
+    return {"mirrored": mirrored, "skipped": skipped}
+
+
+def mirror_workflow(src_registry: str, dst_registry: str, tag: str, *,
+                    images: tuple[ImageSpec, ...] | None = None,
+                    runner: Callable[[list[str]], None] | None = None,
+                    probe: Callable[[str], str | None] | None = None,
+                    tool: str = "docker",
+                    artifacts_dir: str | None = None) -> Workflow:
+    """The sync as a DAG: one independent step per image (a registry
+    hiccup fails that image's step, not the whole sync) plus a summary
+    step — the shape hubsync's per-tag loop had, made restartable."""
+    images = all_images() if images is None else images
+    wf = Workflow(f"mirror-{tag}", artifacts_dir=artifacts_dir)
+
+    def mk(spec: ImageSpec):
+        def fn(ctx):
+            out = mirror(src_registry, dst_registry, tag, images=(spec,),
+                         runner=runner, probe=probe, tool=tool)
+            return out["mirrored"] or out["skipped"]
+        return fn
+
+    for spec in images:
+        wf.step(f"mirror-{spec.name}", mk(spec))
+
+    def summary(ctx):
+        return {"tag": tag, "src": src_registry, "dst": dst_registry,
+                "images": [image_ref(s, dst_registry, tag) for s in images]}
+
+    wf.step("mirror-summary", summary,
+            deps=[f"mirror-{s.name}" for s in images])
+    return wf

@@ -187,9 +187,9 @@ class TestSchedulingFairness:
             calls: list = []
             real_prefill = dec._prefill
 
-            def spy(prompts, pads):
+            def spy(params, prompts, pads):
                 calls.append(int(prompts.shape[0]))
-                return real_prefill(prompts, pads)
+                return real_prefill(params, prompts, pads)
 
             # hold the loop while the burst queues up: pause via a fake
             # empty free list, then restore
@@ -353,7 +353,7 @@ class TestFailureContainment:
             real_step = dec._step
             blew = []
 
-            def exploding_step(state):
+            def exploding_step(params, state):
                 if not blew:
                     blew.append(1)
                     # simulate the donation: the failed call consumed
@@ -362,7 +362,7 @@ class TestFailureContainment:
 
                     jax.tree.map(lambda a: a.delete(), state)
                     raise RuntimeError("RESOURCE_EXHAUSTED (simulated)")
-                return real_step(state)
+                return real_step(params, state)
 
             dec._step = exploding_step
             with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):

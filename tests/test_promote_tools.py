@@ -119,3 +119,47 @@ class TestPromoteBest:
                         str(log)], capture_output=True, text=True, timeout=120)
         # the weaker measured point must NOT replace the banked best
         assert _load(tooldir / "lm_best.json")["mfu"] == 0.4936
+
+    def test_promoted_record_drops_stale_remat_policy(self, tmp_path):
+        """Ledger hygiene (VERDICT r4 weak #4): a winning point with
+        remat=false must not carry a remat_policy field — the knob never
+        ran, and recording it invites reading the number as
+        remat-verified."""
+        import shutil
+
+        tooldir = tmp_path / "tools"
+        tooldir.mkdir()
+        shutil.copy(os.path.join(REPO, "tools", "promote_best.py"),
+                    tooldir / "promote_best.py")
+        log = tmp_path / "sweep.log"
+        log.write_text(json.dumps({"lm": {
+            "model": "gpt-350m", "mfu": 0.52, "optimizer": "adafactor",
+            "remat": False, "remat_policy": "mlp", "tokens_per_sec": 1,
+        }}) + "\n" + json.dumps({"lm": {
+            "model": "gpt-350m", "mfu": 0.30, "optimizer": "adafactor",
+            "remat": True, "remat_policy": "dots", "tokens_per_sec": 1,
+        }}) + "\n")
+        subprocess.run([sys.executable, str(tooldir / "promote_best.py"),
+                        str(log)], capture_output=True, text=True,
+                       timeout=120)
+        best = _load(tooldir / "lm_best.json")
+        assert best["mfu"] == 0.52
+        assert "remat_policy" not in best
+
+    def test_promoted_record_keeps_policy_when_remat_ran(self, tmp_path):
+        import shutil
+
+        tooldir = tmp_path / "tools"
+        tooldir.mkdir()
+        shutil.copy(os.path.join(REPO, "tools", "promote_best.py"),
+                    tooldir / "promote_best.py")
+        log = tmp_path / "sweep.log"
+        log.write_text(json.dumps({"lm": {
+            "model": "llama-1b", "mfu": 0.55, "optimizer": "adafactor",
+            "remat": True, "remat_policy": "dots", "tokens_per_sec": 1,
+        }}) + "\n")
+        subprocess.run([sys.executable, str(tooldir / "promote_best.py"),
+                        str(log)], capture_output=True, text=True,
+                       timeout=120)
+        best = _load(tooldir / "lm_best.json")
+        assert best["remat_policy"] == "dots"

@@ -57,3 +57,85 @@ def test_release_workflow_build_failure_skips_push(tmp_path):
     assert res.steps["push-jaxrt"].status == "Skipped"
     assert res.steps["release-manifest"].status == "Skipped"
     assert res.steps["push-platform"].status == "Succeeded"
+
+
+class TestMirror:
+    """release/mirror.py — the hubsync analogue (reference:
+    releasing/hubsync/hubsync.py:1 GCR->DockerHub sync)."""
+
+    SPECS = (ImageSpec("app", ".", "Dockerfile", ()),
+             ImageSpec("web", ".", "Dockerfile", ()))
+
+    def test_mirror_commands_triplet(self):
+        from kubeflow_tpu.release.mirror import mirror_commands
+
+        cmds = mirror_commands(self.SPECS[0], "gcr.io/kf", "docker.io/kf", "v1")
+        assert cmds == [
+            ["docker", "pull", "gcr.io/kf/app:v1"],
+            ["docker", "tag", "gcr.io/kf/app:v1", "docker.io/kf/app:v1"],
+            ["docker", "push", "docker.io/kf/app:v1"],
+        ]
+
+    def test_mirror_skips_destination_fresh_images(self):
+        from kubeflow_tpu.release.mirror import mirror
+
+        # app already mirrored (same digest both sides); web missing
+        digests = {"gcr.io/kf/app:v1": "d1", "docker.io/kf/app:v1": "d1",
+                   "gcr.io/kf/web:v1": "d2"}
+        ran = []
+        out = mirror("gcr.io/kf", "docker.io/kf", "v1", images=self.SPECS,
+                     runner=ran.append, probe=digests.get)
+        assert out == {"mirrored": ["docker.io/kf/web:v1"],
+                       "skipped": ["docker.io/kf/app:v1"]}
+        assert [c[1] for c in ran] == ["pull", "tag", "push"]
+
+    def test_mirror_resyncs_on_digest_mismatch(self):
+        from kubeflow_tpu.release.mirror import mirror
+
+        digests = {"gcr.io/kf/app:v1": "d1", "docker.io/kf/app:v1": "STALE"}
+        ran = []
+        out = mirror("gcr.io/kf", "docker.io/kf", "v1",
+                     images=self.SPECS[:1], runner=ran.append,
+                     probe=digests.get)
+        assert out["mirrored"] == ["docker.io/kf/app:v1"]
+        assert len(ran) == 3
+
+    def test_mirror_workflow_dag(self):
+        from kubeflow_tpu.release.mirror import mirror_workflow
+
+        ran = []
+        wf = mirror_workflow("gcr.io/kf", "docker.io/kf", "v1",
+                             images=self.SPECS, runner=ran.append,
+                             probe=lambda ref: None)
+        res = wf.run()
+        assert all(s.status == "Succeeded" for s in res.steps.values())
+        assert res.steps["mirror-summary"].output["images"] == [
+            "docker.io/kf/app:v1", "docker.io/kf/web:v1"]
+        # one pull/tag/push triplet per image
+        assert sorted(c[1] for c in ran) == sorted(
+            ["pull", "tag", "push"] * 2)
+
+    def test_default_probe_extracts_content_digest(self, monkeypatch):
+        """The digest must be the registry-independent Descriptor digest
+        — hashing the raw verbose output would embed the queried Ref and
+        the destination-fresh skip would never fire across registries."""
+        import json as _json
+        import subprocess as _sp
+
+        from kubeflow_tpu.release import mirror as M
+
+        def fake_run(cmd, capture_output=True, text=True):
+            ref = cmd[-1]
+
+            class R:
+                returncode = 0
+                stdout = _json.dumps({
+                    "Ref": ref,  # differs per registry — must be ignored
+                    "Descriptor": {"digest": "sha256:abc"},
+                })
+            return R()
+
+        monkeypatch.setattr(_sp, "run", fake_run)
+        assert (M._default_probe("gcr.io/kf/app:v1")
+                == M._default_probe("docker.io/kf/app:v1")
+                == "sha256:abc")

@@ -628,3 +628,61 @@ class TestServerSideApply:
             for k in ("uid", "creationTimestamp", "resourceVersion"):
                 doc["metadata"].pop(k, None)
         assert via_fake == via_rest
+
+    def test_sub_owner_removal_succeeds_under_map_assert(self, client):
+        """The inverse of the map-owner case: b owns spec.image under
+        a's spec map-assert; when b stops applying it, the field is
+        REMOVED (an ancestor assert owns the map's existence, not the
+        leaf — counting it as co-ownership would orphan the field
+        forever)."""
+        client.apply(self._intent(), field_manager="a")  # spec map assert
+        client.apply(self._intent(image="jax:0.8"), field_manager="b")
+        out = client.apply(self._intent(), field_manager="b")
+        assert "image" not in (out.get("spec") or {})
+
+    def test_reasserting_populated_map_composes(self, client):
+        """Re-applying {spec: {}} against a spec that now has entries is
+        NOT a conflict: asserting the map composes with deeper owners."""
+        client.apply(self._intent(), field_manager="a")
+        client.apply(self._intent(image="jax:0.8"), field_manager="b")
+        out = client.apply(self._intent(), field_manager="a")  # no 409
+        assert out["spec"]["image"] == "jax:0.8"
+
+    def test_apply_body_url_mismatch_is_400(self, client):
+        body = {"apiVersion": self.AV, "kind": self.KIND,
+                "metadata": {"name": "OTHER", "namespace": "user1"},
+                "spec": {"image": "x"}}
+        import json as _json
+
+        import requests
+
+        r = requests.patch(
+            client.base_url + "/apis/kubeflow.org/v1/namespaces/user1/"
+            "notebooks/nb?fieldManager=ctrl",
+            data=_json.dumps(body),
+            headers={"Content-Type": "application/apply-patch+yaml"})
+        assert r.status_code == 400
+        # and nothing was applied anywhere
+        assert client.get_or_none(self.AV, self.KIND, "OTHER", "user1") is None
+        assert client.get_or_none(self.AV, self.KIND, "nb", "user1") is None
+
+    def test_error_text_survives_non_dict_json_body(self, client, server):
+        """A proxy answering 404 with a bare JSON string must still
+        surface NotFound, not an AttributeError from .get on a str."""
+        import pytest as _pytest
+
+        class FakeResp:
+            status_code = 404
+            content = b'"not found"'
+            text = '"not found"'
+
+            def json(self):
+                return "not found"
+
+        orig = client._s.request
+        client._s.request = lambda *a, **k: FakeResp()
+        try:
+            with _pytest.raises(ob.NotFound, match="not found"):
+                client.get("v1", "ConfigMap", "x", "default")
+        finally:
+            client._s.request = orig

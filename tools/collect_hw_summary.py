@@ -1,6 +1,6 @@
-"""Render the round-4 hardware ledger as markdown tables.
+"""Render a round's hardware ledger as markdown tables.
 
-Reads the watcher's stage outputs (tools/r4_stages/*.out — each holds a
+Reads the watcher's stage outputs (tools/r{N}_stages/*.out — each holds a
 bench.py or serve_bench.py JSON line) plus the promoted
 serve_table.json, and prints markdown ready for BASELINE.md: one LM
 table (model / batch / policy / MFU / tok/s), one ResNet row set, one
@@ -37,9 +37,19 @@ def stage_records(stage_dir):
         yield name, doc, done, skip
 
 
+def _latest_stage_dir() -> str:
+    """Newest r{N}_stages dir — defaulting to a hardcoded round would
+    silently render a STALE ledger as if it were current."""
+    import re
+
+    dirs = glob.glob(os.path.join(HERE, "r*_stages"))
+    dirs = [d for d in dirs if re.search(r"r(\d+)_stages$", d)]
+    dirs.sort(key=lambda d: int(re.search(r"r(\d+)_stages$", d).group(1)))
+    return dirs[-1] if dirs else os.path.join(HERE, "r4_stages")
+
+
 def main() -> int:
-    stage_dir = sys.argv[1] if len(sys.argv) > 1 else \
-        os.path.join(HERE, "r4_stages")
+    stage_dir = sys.argv[1] if len(sys.argv) > 1 else _latest_stage_dir()
     if not os.path.isdir(stage_dir):
         print(f"no stage dir at {stage_dir}; nothing measured yet")
         return 0
