@@ -1,102 +1,19 @@
 #!/usr/bin/env bash
-# Round-5 persistent hardware watcher.
+# Round-5 persistent hardware watcher (phase 1).
 #
-# Protocol fixes over round 4 (VERDICT r4 weak #1 + ADVICE r4 #1):
-#   - The chip-yield is BIDIRECTIONAL. bench.py, when invoked by anyone
-#     other than a watcher stage (KFTPU_STAGE_RUN unset), writes
-#     /tmp/kftpu_extern_bench.lock with its pid. This watcher checks the
-#     lock between stages AND every 5s while a stage is in flight,
-#     killing the stage's whole process group the moment the lock
-#     appears — the driver's round-end bench gives up on device init
-#     after 300s, so the chip must free within seconds, not within
-#     `timeout 2400` of a stage.
-#   - probe() is bounded to 90s (round 4's 240s probe could itself
-#     collide with a driver capture) and never runs while the lock is
-#     held.
-#   - A failure counts toward the 2-strike .skip ONLY when it is
-#     deterministic: rc not in {124,137} (timeout kills) AND a
-#     post-failure probe succeeds. Two mid-stage tunnel drops no longer
-#     permanently skip a stage that never ran on a healthy window.
+# Protocol fixes over round 4 (VERDICT r4 weak #1 + ADVICE r4 #1) live
+# in tools/watch_lib.sh (shared with phase 2): bidirectional chip-yield
+# via bench.py's atomic pid lockfile (checked between stages AND every
+# 5s while a stage is in flight), bounded 90s probes that never run
+# under the lock, and a 2-strike skip that only counts deterministic
+# failures (rc not a timeout kill, post-failure probe up).
 #
 # Run from the repo root: nohup bash tools/round5_watch.sh &
 set -u
 cd "$(dirname "$0")/.."
 LOG=tools/round5_watch.log
 LEDGER=tools/r5_stages
-LOCK=/tmp/kftpu_extern_bench.lock
-mkdir -p "$LEDGER"
-
-note() { echo "$(date -u +%H:%M:%S) $*" >> "$LOG"; }
-
-# True iff an external bench's lockfile exists and its pid is alive.
-# A stale lock (bench SIGKILLed before atexit) is removed on sight.
-extern_active() {
-  [ -e "$LOCK" ] || return 1
-  local pid
-  pid=$(cat "$LOCK" 2>/dev/null)
-  if [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null; then return 0; fi
-  rm -f "$LOCK"
-  return 1
-}
-
-probe() {
-  extern_active && return 1
-  timeout 90 env KFTPU_STAGE_RUN=1 \
-    python -c "import jax; jax.devices()" >/dev/null 2>&1
-}
-
-# run NAME TIMEOUT CMD... — execute once, mark done on rc==0. Stage
-# stdout/stderr goes to $LEDGER/$name.out (bench JSON lines land there
-# for the promote step) and is appended to LOG.
-run_stage() {
-  local name="$1" tmo="$2"; shift 2
-  [ -e "$LEDGER/$name.done" ] && return 0
-  [ -e "$LEDGER/$name.skip" ] && return 0
-  if extern_active; then
-    note "external bench holds the chip — yielding before $name"
-    return 1
-  fi
-  if ! probe; then note "tunnel dropped before $name"; return 1; fi
-  note "stage $name: $*"
-  setsid env KFTPU_STAGE_RUN=1 timeout "$tmo" "$@" \
-    > "$LEDGER/$name.out" 2>&1 &
-  local pid=$!
-  while kill -0 "$pid" 2>/dev/null; do
-    if extern_active; then
-      note "external bench appeared — killing in-flight stage $name"
-      kill -TERM -- -"$pid" 2>/dev/null
-      sleep 5
-      kill -KILL -- -"$pid" 2>/dev/null
-      wait "$pid" 2>/dev/null
-      while extern_active; do sleep 10; done
-      note "external bench finished — resuming"
-      return 1  # yielded, not failed: no strike, stage re-runs next pass
-    fi
-    sleep 5
-  done
-  wait "$pid"
-  local rc=$?
-  if [ "$rc" -eq 0 ]; then
-    touch "$LEDGER/$name.done"; note "stage $name DONE"
-    cat "$LEDGER/$name.out" >> "$LOG"
-    return 0
-  fi
-  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
-    note "stage $name timed out (rc=$rc) — no strike"
-  elif probe; then
-    echo x >> "$LEDGER/$name.fail"
-    if [ "$(wc -l < "$LEDGER/$name.fail")" -ge 2 ]; then
-      mv "$LEDGER/$name.fail" "$LEDGER/$name.skip"
-      note "stage $name FAILED twice deterministically (rc=$rc) — skipping"
-    else
-      note "stage $name FAILED (rc=$rc) — one deterministic retry left"
-    fi
-  else
-    note "stage $name failed (rc=$rc) with the tunnel down — no strike"
-  fi
-  cat "$LEDGER/$name.out" >> "$LOG"
-  return 1
-}
+. tools/watch_lib.sh
 
 while true; do
   if extern_active; then

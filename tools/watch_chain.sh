@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Supervise the phase-1 -> phase-2 watcher handoff: exactly one watcher
+# owns the queue at a time (two concurrently would contend for the one
+# chip and corrupt each other's timings). Phase liveness is judged by
+# the watcher's OWN pidfile (tools/watch_lib.sh writes it), not by
+# pgrep substring matching — an editor with the filename open must not
+# stall the handoff, and a not-yet-started phase 1 must not trigger a
+# premature (concurrent) phase-2 launch.
+set -u
+cd "$(dirname "$0")/.."
+PIDFILE=/tmp/kftpu_watch.pid
+
+phase1_alive() {
+  # the currently-running phase-1 instance predates the pidfile
+  # mechanism, so fall back to an exact-cmdline match for it
+  local pid
+  pid=$(cat "$PIDFILE" 2>/dev/null)
+  if [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null; then return 0; fi
+  pgrep -f "^bash tools/round5_watch.sh$" >/dev/null 2>&1
+}
+
+# give a just-starting phase 1 time to appear before concluding it is
+# done (prevents the instant-passthrough double-launch)
+sleep 90
+while phase1_alive; do sleep 60; done
+echo "$(date -u +%H:%M:%S) phase 1 exited — starting phase 2" \
+  >> tools/round5_watch.log
+exec bash tools/round5b_watch.sh

@@ -1,0 +1,89 @@
+# Shared watcher machinery: chip-yield protocol + stage ledger.
+# Sourced by tools/round5_watch.sh and tools/round5b_watch.sh — the
+# protocol lives in ONE place so a fix can never apply to one phase and
+# silently miss the other (the round-4 -> round-5 protocol supersession
+# happened exactly because each round's watcher was a diverging copy).
+#
+# Contract for sourcing scripts: set LOG and LEDGER first; optionally
+# WATCH_TAG (log-line prefix). Provides note/extern_active/probe/
+# run_stage and writes $$ to $PIDFILE for the handoff supervisor.
+LOCK=/tmp/kftpu_extern_bench.lock
+PIDFILE="${PIDFILE:-/tmp/kftpu_watch.pid}"
+WATCH_TAG="${WATCH_TAG:-}"
+mkdir -p "$LEDGER"
+echo $$ > "$PIDFILE"
+
+note() { echo "$(date -u +%H:%M:%S)${WATCH_TAG} $*" >> "$LOG"; }
+
+# True iff an external bench's lockfile exists and its pid is alive.
+# A stale lock (bench SIGKILLed before atexit) is removed on sight.
+extern_active() {
+  [ -e "$LOCK" ] || return 1
+  local pid
+  pid=$(cat "$LOCK" 2>/dev/null)
+  if [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null; then return 0; fi
+  rm -f "$LOCK"
+  return 1
+}
+
+probe() {
+  extern_active && return 1
+  timeout 90 env KFTPU_STAGE_RUN=1 \
+    python -c "import jax; jax.devices()" >/dev/null 2>&1
+}
+
+# run NAME TIMEOUT CMD... — execute once, mark done on rc==0. Stage
+# stdout/stderr goes to $LEDGER/$name.out and is appended to LOG.
+# Yields the chip (killing the in-flight stage) within ~5s of an
+# external bench taking the lock; a failure counts toward the 2-strike
+# .skip only when deterministic (rc not a timeout kill AND a
+# post-failure probe succeeds).
+run_stage() {
+  local name="$1" tmo="$2"; shift 2
+  [ -e "$LEDGER/$name.done" ] && return 0
+  [ -e "$LEDGER/$name.skip" ] && return 0
+  if extern_active; then
+    note "external bench holds the chip — yielding before $name"
+    return 1
+  fi
+  if ! probe; then note "tunnel dropped before $name"; return 1; fi
+  note "stage $name: $*"
+  setsid env KFTPU_STAGE_RUN=1 timeout "$tmo" "$@" \
+    > "$LEDGER/$name.out" 2>&1 &
+  local pid=$!
+  while kill -0 "$pid" 2>/dev/null; do
+    if extern_active; then
+      note "external bench appeared — killing in-flight stage $name"
+      kill -TERM -- -"$pid" 2>/dev/null
+      sleep 5
+      kill -KILL -- -"$pid" 2>/dev/null
+      wait "$pid" 2>/dev/null
+      while extern_active; do sleep 10; done
+      note "external bench finished — resuming"
+      return 1  # yielded, not failed: no strike, stage re-runs next pass
+    fi
+    sleep 5
+  done
+  wait "$pid"
+  local rc=$?
+  if [ "$rc" -eq 0 ]; then
+    touch "$LEDGER/$name.done"; note "stage $name DONE"
+    cat "$LEDGER/$name.out" >> "$LOG"
+    return 0
+  fi
+  if [ "$rc" -eq 124 ] || [ "$rc" -eq 137 ]; then
+    note "stage $name timed out (rc=$rc) — no strike"
+  elif probe; then
+    echo x >> "$LEDGER/$name.fail"
+    if [ "$(wc -l < "$LEDGER/$name.fail")" -ge 2 ]; then
+      mv "$LEDGER/$name.fail" "$LEDGER/$name.skip"
+      note "stage $name FAILED twice deterministically (rc=$rc) — skipping"
+    else
+      note "stage $name FAILED (rc=$rc) — one deterministic retry left"
+    fi
+  else
+    note "stage $name failed (rc=$rc) with the tunnel down — no strike"
+  fi
+  cat "$LEDGER/$name.out" >> "$LOG"
+  return 1
+}
