@@ -778,6 +778,22 @@ def llama_1b(**kw) -> TransformerLM:
     return _build("llama-1b", **base)
 
 
+@register_model("llama-1b-hd128")
+def llama_1b_hd128(**kw) -> TransformerLM:
+    """TPU-shaped 1B: identical to llama-1b except 16 heads x head_dim
+    128 (GQA 4 kv heads) instead of 32 x 64. The v5e MXU contracts over
+    a 128-lane dimension, so head_dim 64 caps the attention matmuls at
+    half the systolic array; r5's op microbench measured the flash
+    fwd+bwd at ~0.10-0.11 utilization vs ~0.66 for the MLP block,
+    making attention the headline-MFU bottleneck. head_dim 128 is the
+    established TPU-era choice (Llama-2-7B, Gemma); param count and
+    attention FLOPs are unchanged."""
+    base = dict(d_model=2048, n_layers=16, n_heads=16, n_kv_heads=4,
+                head_dim=128, d_ff=8192)
+    base.update(kw)
+    return _build("llama-1b-hd128", **base)
+
+
 @register_model("moe-test")
 def moe_test(**kw) -> TransformerLM:
     base = dict(vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=4,

@@ -104,6 +104,25 @@ def test_midsize_gpt_configs_build():
         assert m.flops_per_token(seq_len=64) > 6 * 2 * 3 * d * m.cfg.d_ff
 
 
+def test_llama_1b_hd128_matches_llama_1b_budget():
+    """The TPU-shaped head variant is the SAME model budget — identical
+    param count and per-token FLOPs as llama-1b (16x128 GQA heads vs
+    32x64) — so its bench numbers are apples-to-apples."""
+    def n_params(name):
+        m = get_model(name, vocab_size=32000)
+        tok = jnp.ones((1, 32), jnp.int32)
+        v = jax.eval_shape(
+            lambda: m.init(jax.random.PRNGKey(0), tok, train=False))
+        return sum(int(jnp.prod(jnp.asarray(x.shape)))
+                   for x in jax.tree.leaves(v)), m.flops_per_token(2048)
+
+    (n_a, f_a), (n_b, f_b) = n_params("llama-1b"), n_params("llama-1b-hd128")
+    assert n_a == n_b
+    assert f_a == f_b
+    m = get_model("llama-1b-hd128", vocab_size=512)
+    assert (m.cfg.head_dim, m.cfg.n_heads, m.cfg.n_kv_heads) == (128, 16, 4)
+
+
 def test_bert_seq_classification_trains(devices8):
     """BERT fine-tune shape through the Trainer: task=seq_classification
     (tokens in, one label per sequence out), loss decreases on a fixed

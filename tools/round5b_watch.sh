@@ -39,6 +39,22 @@ while true; do
     run_stage lm_760m_bs8_slim 1500 python bench.py --workload lm \
       --lm-model gpt-760m --lm-batch 8 --lm-optimizer adafactor \
       --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    # slim BEAT no-remat at 1b bs8 (0.513 vs r3's 0.475): in the
+    # byte-bound regime saved activation traffic outweighs recompute —
+    # so measure one step further down the memory ladder too
+    run_stage lm_1b_bs8_full 1500 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy full --lm-xent-chunks 8
+    # TPU-shaped head geometry: the microbench puts flash fwd+bwd at
+    # ~0.10 util vs 0.66 for MLP because head_dim 64 uses half the MXU
+    # contraction lanes; llama-1b-hd128 is the same 1.1B params / same
+    # FLOPs with 16x128 GQA heads
+    run_stage lm_1b_hd128_bs8_slim 1500 python bench.py --workload lm \
+      --lm-model llama-1b-hd128 --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim --lm-xent-chunks 8
+    run_stage lm_1b_hd128_bs8 1500 python bench.py --workload lm \
+      --lm-model llama-1b-hd128 --lm-batch 8 --lm-optimizer adafactor \
+      --lm-xent-chunks 8
     # flash-block sweep at the winning point (default 512/1024 already
     # measured as lm_1b_bs8_slim = 0.5132)
     lm1b lm_1b_slim_q256_k512   256  512
@@ -53,8 +69,10 @@ while true; do
       >> "$LOG" 2>&1 || true
     settled=$(ls "$LEDGER"/lm_1b_slim_*.done "$LEDGER"/lm_1b_slim_*.skip \
       "$LEDGER"/lm_760m_bs8_slim.done "$LEDGER"/lm_760m_bs8_slim.skip \
+      "$LEDGER"/lm_1b_bs8_full.done "$LEDGER"/lm_1b_bs8_full.skip \
+      "$LEDGER"/lm_1b_hd128_*.done "$LEDGER"/lm_1b_hd128_*.skip \
       2>/dev/null | wc -l)
-    if [ "$settled" -ge 7 ]; then
+    if [ "$settled" -ge 10 ]; then
       note "phase-2 settled ($settled)"
       exit 0
     fi
