@@ -63,16 +63,29 @@ while true; do
     lm1b lm_1b_slim_q256_k1024  256  1024
     lm1b lm_1b_slim_q1024_k1024 1024 1024
     lm1b lm_1b_slim_q512_k2048  512  2048
+    # fused-decode serving re-measurement: the same commands as phase
+    # 1's serve_cont_int8 / serve_kv_int8 rows, now running the
+    # FUSE=8 tick fusion (amortizes the per-dispatch tunnel round-trip
+    # that made decode latency-bound)
+    run_stage serve_cont_int8_fused 1800 python tools/serve_bench.py \
+      --modes continuous --requests 32 --param-dtype int8
+    run_stage serve_kv_int8_fused 1800 python tools/serve_bench.py \
+      --modes continuous --requests 16 --model llama-1b \
+      --prompt-len 1024 --max-new-tokens 32 --slots 8 \
+      --param-dtype int8 --kv-cache-dtype int8
     # promote anything that beats the banked floor
     cat "$LEDGER"/*.out > tools/lm_sweep_r05.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r05.jsonl \
+      >> "$LOG" 2>&1 || true
+    python tools/promote_serve_best.py "$LEDGER"/serve_*.out \
       >> "$LOG" 2>&1 || true
     settled=$(ls "$LEDGER"/lm_1b_slim_*.done "$LEDGER"/lm_1b_slim_*.skip \
       "$LEDGER"/lm_760m_bs8_slim.done "$LEDGER"/lm_760m_bs8_slim.skip \
       "$LEDGER"/lm_1b_bs8_full.done "$LEDGER"/lm_1b_bs8_full.skip \
       "$LEDGER"/lm_1b_hd128_*.done "$LEDGER"/lm_1b_hd128_*.skip \
+      "$LEDGER"/serve_*_fused.done "$LEDGER"/serve_*_fused.skip \
       2>/dev/null | wc -l)
-    if [ "$settled" -ge 10 ]; then
+    if [ "$settled" -ge 12 ]; then
       note "phase-2 settled ($settled)"
       exit 0
     fi
