@@ -146,6 +146,14 @@ class RMSNorm(nn.Module):
         return (y * scale).astype(self.dtype)
 
 
+def _kv_scale_rows(s):
+    """[B, S, Hkv, 1] per-(position, head) int8-cache scales -> a layout
+    broadcastable against [B, Hkv, G, Lq, S] attention logits/probs (the
+    factored-scale decode path applies them there instead of
+    dequantizing the cache elementwise)."""
+    return s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
+
+
 def _remat_policy(cfg: "TransformerConfig"):
     if cfg.remat_policy == "dots":
         # dot outputs PLUS the flash kernel's named residuals (out, lse —
@@ -229,10 +237,17 @@ class Attention(nn.Module):
                                 lambda: jnp.zeros((b, W, hkv, 1), jnp.float32))
             cvs = self.variable("cache", "cached_value_scale",
                                 lambda: jnp.zeros((b, W, hkv, 1), jnp.float32))
-            k_old = (ck.value.astype(jnp.float32) * cks.value).astype(cfg.dtype)
-            v_old = (cv.value.astype(jnp.float32) * cvs.value).astype(cfg.dtype)
+            # int8 feeds the matmuls directly; scales factor out of the
+            # head_dim contraction (applied to scores / folded into
+            # probs below) — see the full-cache path for the r5 ledger
+            # evidence that elementwise dequant here costs 3.6x
+            k_old = ck.value.astype(cfg.dtype)
+            v_old = cv.value.astype(cfg.dtype)
+            ksc_b = _kv_scale_rows(cks.value)
+            vsc_b = _kv_scale_rows(cvs.value)
         else:
             k_old, v_old = ck.value, cv.value
+            ksc_b = vsc_b = None
 
         idx = jnp.asarray(decode_index, jnp.int32)
         # Quantize the chunk BEFORE attending and attend its dequantized
@@ -245,11 +260,17 @@ class Attention(nn.Module):
 
             k_w, ks_w = symmetric_int8(k, -1)
             v_w, vs_w = symmetric_int8(v, -1)
-            k_c = (k_w.astype(jnp.float32) * ks_w).astype(cfg.dtype)
-            v_c = (v_w.astype(jnp.float32) * vs_w).astype(cfg.dtype)
+            # the in-chunk term sees the same int8 + factored-scale math
+            # as a cache read, so a token attends identically now and
+            # after it lands in the cache
+            k_c = k_w.astype(cfg.dtype)
+            v_c = v_w.astype(cfg.dtype)
+            ksw_b = _kv_scale_rows(ks_w)
+            vsw_b = _kv_scale_rows(vs_w)
         else:
             k_w, v_w = k.astype(cfg.dtype), v.astype(cfg.dtype)
             k_c, v_c = k_w, v_w
+            ksw_b = vsw_b = None
         g = cfg.n_heads // hkv
         qg = q.reshape(b, lq, hkv, g, hd)
         scale = hd ** -0.5
@@ -258,6 +279,9 @@ class Attention(nn.Module):
                         preferred_element_type=jnp.float32) * scale
         ls = jnp.einsum("bqhgd,bchd->bhgqc", qg, k_c,
                         preferred_element_type=jnp.float32) * scale
+        if quant:
+            lc = lc * ksc_b
+            ls = ls * ksw_b
 
         slots = jnp.arange(W, dtype=jnp.int32)
         cols = jnp.arange(lq, dtype=jnp.int32)
@@ -295,7 +319,10 @@ class Attention(nn.Module):
         ls = jnp.where(ms[:, None, None, :, :], ls, neg)
         probs = jax.nn.softmax(jnp.concatenate([lc, ls], axis=-1), axis=-1)
         pc, ps = probs[..., :W], probs[..., W:]
-        out = (jnp.einsum("bhgqs,bshd->bqhgd", pc.astype(v_old.dtype), v_old)
+        if quant:
+            pc = pc * vsc_b
+            ps = ps * vsw_b
+        out = (jnp.einsum("bhgqs,bshd->bqhgd", pc.astype(cfg.dtype), v_old)
                + jnp.einsum("bhgqc,bchd->bqhgd", ps.astype(cfg.dtype), v_c))
         out = out.reshape(b, lq, cfg.n_heads, hd)
 
@@ -428,14 +455,24 @@ class Attention(nn.Module):
                     cks.value = jnp.where(hot, ks_w, cks.value)
                     cvs.value = jnp.where(hot, vs_w, cvs.value)
             if quant:
-                # dequant fuses into the attention matmuls; HBM streamed
-                # the int8 cache + tiny scales
-                k_all = (ck.value.astype(jnp.float32)
-                         * cks.value).astype(cfg.dtype)
-                v_all = (cv.value.astype(jnp.float32)
-                         * cvs.value).astype(cfg.dtype)
+                # The int8 cache feeds the matmuls DIRECTLY (int8->bf16
+                # convert is exact for [-127,127] and fuses into the
+                # operand load). Round 3 dequantized elementwise here,
+                # materializing + streaming a full-width copy each tick —
+                # measured r5: int8-KV decode 3.6x SLOWER than bf16, the
+                # opposite of the feature's point. The per-(position,
+                # head) scales factor out of the head_dim contraction:
+                #   scores = (q · k_int8) * ks[s]     (scale on scores)
+                #   out    = (probs * vs[s]) · v_int8 (scale into probs)
+                # so cache traffic is 1 byte/elt and the scale math is
+                # head_dim-times smaller than a dequantized cache.
+                k_all = ck.value.astype(cfg.dtype)
+                v_all = cv.value.astype(cfg.dtype)
+                ks_b = _kv_scale_rows(cks.value)
+                vs_b = _kv_scale_rows(cvs.value)
             else:
                 k_all, v_all = ck.value, cv.value
+                ks_b = vs_b = None
             # Grouped-query attention WITHOUT jnp.repeat: expanding K/V
             # to n_heads would materialize (and stream) a G-times-larger
             # bf16 tensor every decode step — the exact traffic the int8
@@ -446,6 +483,8 @@ class Attention(nn.Module):
             logits = jnp.einsum(
                 "bqhgd,bshd->bhgqs", qg, k_all,
                 preferred_element_type=jnp.float32) * (cfg.head_dim ** -0.5)
+            if ks_b is not None:
+                logits = logits * ks_b
             pos = jnp.arange(cfg.max_seq_len)[None, None, None, None, :]
             if idx.ndim == 0:
                 # chunked decode: query row r sits at absolute position
@@ -468,8 +507,10 @@ class Attention(nn.Module):
                 mask = mask & (pos >= pad_len[:, None, None, None, None])
             logits = jnp.where(mask, logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1)
+            if vs_b is not None:
+                probs = probs * vs_b
             out = jnp.einsum(
-                "bhgqs,bshd->bqhgd", probs.astype(v_all.dtype), v_all
+                "bhgqs,bshd->bqhgd", probs.astype(cfg.dtype), v_all
             ).reshape(b, lq, cfg.n_heads, cfg.head_dim)
         elif cfg.attention_impl == "ring":
             from kubeflow_tpu.ops.ring_attention import ring_attention
