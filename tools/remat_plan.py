@@ -11,6 +11,13 @@ alongside the analytic recompute tax in block-MAC terms.
 
 Usage:
   python tools/remat_plan.py --model llama-1b --batch 16 [--seq 2048]
+
+CALIBRATION (round-5 hardware ledger): these numbers bound the saved
+RESIDUAL bytes only — XLA's compile-time HLO temps amplify the real
+footprint well past them (llama-1b bs8 dots: planner said comfortable,
+AOT compile needed 19.3G against 15.75G HBM; gpt-760m bs8 slim missed
+by 50MB). Use the report to ORDER candidate policies, never to conclude
+a config fits; the watcher's compile-probe stages are the ground truth.
 """
 
 from __future__ import annotations
