@@ -401,11 +401,43 @@ def main() -> int:
         except subprocess.TimeoutExpired:
             probe_err = "device init timed out after 300s"
     if probe_err:
-        print(json.dumps({
+        doc = {
             "metric": f"{args.model}_train_mfu", "unit": "fraction",
             "value": 0.0, "vs_baseline": 0.0,
             "error": f"TPU backend unavailable: {probe_err}",
-        }))
+        }
+        # A dead tunnel at capture time must not erase the round's
+        # measured evidence: attach the promoted operating points (each
+        # the max over the stage ledger, measured on real hardware in
+        # an earlier up-window) and the ledger location, so the
+        # artifact points at witnessable data instead of just 0.0.
+        here = os.path.dirname(os.path.abspath(__file__))
+        for key, fname in (("banked_lm", "lm_best.json"),
+                           ("banked_serving", "serve_best.json")):
+            path = os.path.join(here, "tools", fname)
+            if os.path.exists(path):
+                try:
+                    with open(path) as f:
+                        doc[key] = json.load(f)
+                except (ValueError, OSError):
+                    pass
+        import glob as _glob
+        import re as _re
+
+        stages = [d for d in _glob.glob(os.path.join(here, "tools",
+                                                     "r*_stages"))
+                  if _re.search(r"r(\d+)_stages$", d)]
+        # numeric round order: lexicographic would rank r10 below r5
+        stages.sort(key=lambda d: int(
+            _re.search(r"r(\d+)_stages$", d).group(1)))
+        if stages:
+            sd = stages[-1]
+            doc["stage_ledger"] = {
+                "dir": os.path.relpath(sd, here),
+                "done": len(_glob.glob(os.path.join(sd, "*.done"))),
+                "skip": len(_glob.glob(os.path.join(sd, "*.skip"))),
+            }
+        print(json.dumps(doc))
         return 3
 
     import jax
