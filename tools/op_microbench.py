@@ -160,6 +160,12 @@ def main() -> int:
         bench_matmul(tokens, 2048, 8192, peak),
         bench_flash(b, l, 32, 64, peak, bwd=True),
         bench_block_soup(b, l, 2048, 8192, peak),
+        # llama-1b-hd128 head shape: same total head width (16x128 vs
+        # 32x64) — the direct measurement of the head_dim-64 MXU
+        # half-contraction penalty the r5 attribution blamed for the
+        # attention utilization floor
+        bench_flash(b, l, 16, 128, peak, bwd=False),
+        bench_flash(b, l, 16, 128, peak, bwd=True),
     ]
     for r in results:
         print(json.dumps(r), flush=True)

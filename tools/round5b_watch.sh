@@ -73,6 +73,9 @@ while true; do
       --modes continuous --requests 16 --model llama-1b \
       --prompt-len 1024 --max-new-tokens 32 --slots 8 \
       --param-dtype int8 --kv-cache-dtype int8
+    # head_dim 64-vs-128 flash utilization, measured directly
+    run_stage microbench_hd128 1500 python tools/op_microbench.py \
+      --batch 8 --seq 2048
     # promote anything that beats the banked floor
     cat "$LEDGER"/*.out > tools/lm_sweep_r05.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r05.jsonl \
@@ -84,8 +87,9 @@ while true; do
       "$LEDGER"/lm_1b_bs8_full.done "$LEDGER"/lm_1b_bs8_full.skip \
       "$LEDGER"/lm_1b_hd128_*.done "$LEDGER"/lm_1b_hd128_*.skip \
       "$LEDGER"/serve_*_fused.done "$LEDGER"/serve_*_fused.skip \
+      "$LEDGER"/microbench_hd128.done "$LEDGER"/microbench_hd128.skip \
       2>/dev/null | wc -l)
-    if [ "$settled" -ge 12 ]; then
+    if [ "$settled" -ge 13 ]; then
       note "phase-2 settled ($settled)"
       exit 0
     fi

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Second-stage supervisor: the phase-2 watcher that launched at 12:05
+# parsed an older queue (no fused-serving or hd128-microbench stages).
+# Wait for it (watch_lib's pidfile) to exit, then run the updated
+# round5b — run_stage skips every .done/.skip stage, so only the new
+# and unsettled work executes.
+set -u
+cd "$(dirname "$0")/.."
+PIDFILE=/tmp/kftpu_watch.pid
+
+alive() {
+  local pid
+  pid=$(cat "$PIDFILE" 2>/dev/null)
+  [ -n "$pid" ] && kill -0 "$pid" 2>/dev/null
+}
+
+sleep 30
+while alive; do sleep 60; done
+echo "$(date -u +%H:%M:%S) prior watcher exited — running updated phase 2" \
+  >> tools/round5_watch.log
+exec bash tools/round5b_watch.sh
