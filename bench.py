@@ -335,14 +335,27 @@ def main() -> int:
                    choices=["adamw", "adafactor", "sgdm"])
     p.add_argument("--lm-remat", action="store_true",
                    help="rematerialize the forward (fits larger models)")
+    def _remat_policy_arg(v: str) -> str:
+        name = v.split("@", 1)[0]
+        if name not in ("dots", "full", "mlp", "slim") or (
+                "@" in v and not v.split("@", 1)[1].isdigit()):
+            raise argparse.ArgumentTypeError(
+                f"{v!r}: expected dots|full|mlp|slim with optional "
+                "'@<layer count>' suffix (e.g. slim@12)")
+        return v
+
     p.add_argument("--lm-remat-policy", default="mlp",
-                   choices=["dots", "full", "mlp", "slim"],
+                   type=_remat_policy_arg,
                    help="dots keeps matmul outputs (cheap recompute); "
                         "full recomputes everything (min memory); mlp "
                         "drops only the d_ff-wide tensors (most of the "
                         "memory win, small recompute tax); slim saves "
                         "ONLY the named d-wide anchors (whitelist — "
-                        "near-full-remat memory at roughly half the tax)")
+                        "near-full-remat memory at roughly half the "
+                        "tax). Any policy takes an optional '@K' suffix "
+                        "(e.g. slim@12): remat only the first K blocks, "
+                        "save everything on the rest — the fractional "
+                        "rung between whole-model policies")
     p.add_argument("--lm-xent-chunks", type=int, default=0,
                    help="compute the LM head + cross-entropy in this many "
                         "sequence chunks (ops/xent.py): the [B, L, V] "

@@ -154,8 +154,28 @@ def _kv_scale_rows(s):
     return s[..., 0].transpose(0, 2, 1)[:, :, None, None, :]
 
 
+def _split_policy(policy: str) -> tuple[str, int | None]:
+    """'slim@12' -> ('slim', 12): apply the named policy to the FIRST
+    12 blocks and save everything on the rest — a fractional dial on
+    the memory/recompute ladder between whole-model policy rungs. The
+    r5 hardware ledger motivated it twice: gpt-760m bs8 slim missed
+    fitting by 50MB (slim@15 would fit), and slim measurably BEAT
+    no-remat at llama-1b bs8 (byte-bound regime), so the optimum can
+    sit strictly between two whole-model policies. Plain names return
+    (name, None) = every block."""
+    if "@" in policy:
+        name, k = policy.split("@", 1)
+        if not name or not k.isdigit():
+            raise ValueError(
+                f"malformed remat_policy {policy!r}: expected "
+                "'<dots|full|mlp|slim>@<layer count>' (e.g. slim@12)")
+        return name, int(k)
+    return policy, None
+
+
 def _remat_policy(cfg: "TransformerConfig"):
-    if cfg.remat_policy == "dots":
+    name, _ = _split_policy(cfg.remat_policy)
+    if name == "dots":
         # dot outputs PLUS the flash kernel's named residuals (out, lse —
         # tagged inside its custom_vjp fwd rule, ops/flash_attention.py):
         # pallas_call is not a dot, so plain dots_saveable would replay
@@ -164,9 +184,9 @@ def _remat_policy(cfg: "TransformerConfig"):
         return jax.checkpoint_policies.save_from_both_policies(
             jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
             jax.checkpoint_policies.save_only_these_names("attn_flash"))
-    if cfg.remat_policy == "full":
+    if name == "full":
         return jax.checkpoint_policies.nothing_saveable
-    if cfg.remat_policy == "mlp":
+    if name == "mlp":
         # Save every block intermediate EXCEPT d_ff-wide ones (gate/up/
         # silu/h). Implemented as a WIDTH predicate on the equation's
         # input avals, not checkpoint_name tags: flax wraps activations
@@ -190,7 +210,7 @@ def _remat_policy(cfg: "TransformerConfig"):
                 for a in avals)
 
         return mlp_policy
-    if cfg.remat_policy == "slim":
+    if name == "slim":
         # Whitelist, not blacklist: save ONLY the named d-wide bf16
         # anchors (norm outputs, post-rope q/k/v, pre-o attention
         # context, and the flash kernel's out/lse residuals). "mlp"
@@ -653,6 +673,11 @@ class Stage(nn.Module):
         positions = jnp.broadcast_to(positions_1d[None, :], x.shape[:2])
         block = Block
         if cfg.remat:
+            if _split_policy(cfg.remat_policy)[1] is not None:
+                raise ValueError(
+                    f"mixed remat policy {cfg.remat_policy!r} is not "
+                    "supported under pipeline parallelism (stages would "
+                    "carry unequal activation memory)")
             block = nn.remat(Block, policy=_remat_policy(cfg))
         for p in range(cfg.n_layers // cfg.pipeline_stages):
             x = block(cfg, name=f"block_{p}")(x, positions)
@@ -727,12 +752,21 @@ class TransformerLM(nn.Module):
                 name="pipeline",
             )(x, jnp.arange(tokens.shape[1], dtype=jnp.int32))
         else:
-            block = Block
+            rblock = Block
+            k_mix = None
             if cfg.remat:
-                block = nn.remat(Block, policy=_remat_policy(cfg))
+                _, k_mix = _split_policy(cfg.remat_policy)
+                if k_mix is not None and not 0 < k_mix <= cfg.n_layers:
+                    raise ValueError(
+                        f"remat_policy {cfg.remat_policy!r}: layer count "
+                        f"must be in 1..{cfg.n_layers}")
+                rblock = nn.remat(Block, policy=_remat_policy(cfg))
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
-                x = block(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
+                # mixed policy: first k_mix blocks remat, the rest save
+                # everything (remat never changes values, only residuals)
+                blk = rblock if (k_mix is None or i < k_mix) else Block
+                x = blk(cfg, use_moe=use_moe, name=f"layer_{i}")(x, positions, segment_ids)
         x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
         if return_hidden:
             # Chunked-loss path (ops.xent.chunked_lm_xent): the caller

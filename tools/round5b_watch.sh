@@ -76,6 +76,16 @@ while true; do
     # head_dim 64-vs-128 flash utilization, measured directly
     run_stage microbench_hd128 1500 python tools/op_microbench.py \
       --batch 8 --seq 2048
+    # mixed remat (policy@K): slim@15 rescues gpt-760m bs8's 50MB miss;
+    # slim@12 probes whether 4 save-everything layers beat full slim at
+    # the 1b frontier (slim already beat no-remat, so the optimum may
+    # sit between)
+    run_stage lm_760m_bs8_slim15 1500 python bench.py --workload lm \
+      --lm-model gpt-760m --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim@15 --lm-xent-chunks 8
+    run_stage lm_1b_bs8_slim12 1500 python bench.py --workload lm \
+      --lm-model llama-1b --lm-batch 8 --lm-optimizer adafactor \
+      --lm-remat --lm-remat-policy slim@12 --lm-xent-chunks 8
     # promote anything that beats the banked floor
     cat "$LEDGER"/*.out > tools/lm_sweep_r05.jsonl 2>/dev/null || true
     python tools/promote_best.py tools/lm_sweep_r05.jsonl \
@@ -88,8 +98,10 @@ while true; do
       "$LEDGER"/lm_1b_hd128_*.done "$LEDGER"/lm_1b_hd128_*.skip \
       "$LEDGER"/serve_*_fused.done "$LEDGER"/serve_*_fused.skip \
       "$LEDGER"/microbench_hd128.done "$LEDGER"/microbench_hd128.skip \
+      "$LEDGER"/lm_760m_bs8_slim15.done "$LEDGER"/lm_760m_bs8_slim15.skip \
+      "$LEDGER"/lm_1b_bs8_slim12.done "$LEDGER"/lm_1b_bs8_slim12.skip \
       2>/dev/null | wc -l)
-    if [ "$settled" -ge 13 ]; then
+    if [ "$settled" -ge 15 ]; then
       note "phase-2 settled ($settled)"
       exit 0
     fi
