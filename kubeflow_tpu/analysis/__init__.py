@@ -1,0 +1,25 @@
+"""tpulint — JAX/TPU-aware static analysis for this tree.
+
+Two rule families, both distilled from bugs this repo actually shipped
+(VERDICT.md):
+
+- ``TPU1xx`` (rules_jax): closure-captured arrays in jitted programs,
+  host syncs inside traced functions, import-time device work, missing
+  buffer donation on train steps.
+- ``LOCK2xx`` (rules_lockset): a lockset checker for the hand-rolled
+  mutex idiom of the control plane, plus blocking-call detection in
+  reconcile bodies.
+
+CLI: ``python -m kubeflow_tpu.analysis [paths...]`` — exits nonzero on
+findings. Suppress a finding in-line with
+``# tpulint: disable=RULE  <justification>``. docs/static-analysis.md
+documents every rule.
+"""
+
+from kubeflow_tpu.analysis.core import (  # noqa: F401
+    Finding, Module, Rule, all_rules, register, scan_paths, scan_source,
+)
+from kubeflow_tpu.analysis.report import render_json, render_text  # noqa: F401
+
+__all__ = ["Finding", "Module", "Rule", "all_rules", "register",
+           "scan_paths", "scan_source", "render_json", "render_text"]

@@ -1,0 +1,84 @@
+"""tpulint CLI: ``python -m kubeflow_tpu.analysis [paths...]``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error. ``--hygiene`` adds the
+stdlib hygiene gates (parse/debugger/conflict-marker, yaml manifests)
+on top of the tpulint rules, so tools/lint_all.sh is one process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+
+from kubeflow_tpu.analysis import core, hygiene, report
+
+
+def _parse_rules(text: str | None) -> set[str] | None:
+    if not text:
+        return None
+    return {r.strip() for r in text.split(",") if r.strip()}
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m kubeflow_tpu.analysis",
+        description="JAX/TPU-aware static analysis (tpulint)")
+    parser.add_argument("paths", nargs="*", default=["kubeflow_tpu"],
+                        help="files or directories to scan "
+                             "(default: kubeflow_tpu)")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable report on stdout")
+    parser.add_argument("--select", metavar="RULES",
+                        help="comma-separated rule ids to run exclusively")
+    parser.add_argument("--ignore", metavar="RULES",
+                        help="comma-separated rule ids to skip")
+    parser.add_argument("--hygiene", action="store_true",
+                        help="also run the stdlib hygiene gates "
+                             "(parse/debugger/conflict markers, yaml)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print registered rules and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rule in core.all_rules():
+            print(f"{rule.id}  {rule.name}: {rule.short}")
+        for rid, short in sorted(hygiene.HYGIENE_RULES.items()):
+            print(f"{rid}  hygiene: {short}")
+        return 0
+
+    for raw in args.paths:
+        if not pathlib.Path(raw).exists():
+            # a typo'd path must not exit 0 "clean" while scanning nothing
+            print(f"no such path: {raw}", file=sys.stderr)
+            return 2
+
+    select, ignore = _parse_rules(args.select), _parse_rules(args.ignore)
+    known = {r.id for r in core.all_rules()} | {core.PARSE_RULE}
+    known |= set(hygiene.HYGIENE_RULES)
+    for wanted in (select or set()) | (ignore or set()):
+        if wanted not in known:
+            print(f"unknown rule id: {wanted}", file=sys.stderr)
+            return 2
+    if select and select & set(hygiene.HYGIENE_RULES):
+        # selecting a HYG id implies the hygiene pass — otherwise the
+        # selection would silently scan nothing and exit 0
+        args.hygiene = True
+
+    findings = core.scan_paths(args.paths, select=select, ignore=ignore)
+    if args.hygiene:
+        hyg = hygiene.run_hygiene(args.paths)
+        if select:
+            hyg = [f for f in hyg if f.rule in select]
+        if ignore:
+            hyg = [f for f in hyg if f.rule not in ignore]
+        findings = sorted(findings + hyg,
+                          key=lambda f: (f.path, f.line, f.col, f.rule))
+
+    print(report.render_json(findings) if args.json
+          else report.render_text(findings))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,221 @@
+"""tpulint core: the module model, rule registry, and suppressions.
+
+The framework is deliberately small — every rule gets a parsed
+``Module`` (source + AST + parent links) and yields ``Finding``s; the
+registry maps rule ids to singleton rule instances; suppression is a
+per-line ``# tpulint: disable=RULE[,RULE...]  <justification>`` comment
+(or ``disable-file=`` for a whole module). Nothing here imports jax or
+touches devices: tpulint must run in CI images with no accelerator and
+must never execute the code it scans.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import pathlib
+import re
+from typing import Iterable, Iterator
+
+PARSE_RULE = "TPU000"  # reserved: file does not parse
+
+# the rule list is strictly comma-separated ids (no spaces inside ids),
+# so a justification after a SINGLE space still leaves the rules intact
+# instead of being swallowed into the rule list as a silent no-op
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*(disable|disable-file)\s*=\s*"
+    r"([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic: rule id + location + human message."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Module:
+    """A parsed source file handed to every rule.
+
+    Carries the AST with parent back-links (``parents``) so rules can
+    walk *up* — "is this node inside a ``with self._lock`` block?" —
+    which ``ast`` alone cannot answer.
+    """
+
+    def __init__(self, path: str, source: str):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.parents: dict[ast.AST, ast.AST] = {}
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                self.parents[child] = parent
+        self._line_suppress, self._file_suppress = _parse_suppressions(
+            self.lines)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self.parents.get(cur)
+
+    def enclosing_function(self, node: ast.AST):
+        """Nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def suppressed(self, finding: Finding) -> bool:
+        if {"all"} & self._file_suppress or finding.rule in self._file_suppress:
+            return True
+        rules = self._line_suppress.get(finding.line, set())
+        return "all" in rules or finding.rule in rules
+
+
+def _parse_suppressions(lines: list[str]):
+    """Collect ``# tpulint: disable=...`` comments.
+
+    Line suppressions apply to findings reported on that physical line;
+    file suppressions (``disable-file=``) apply module-wide. Rule lists
+    are comma-separated; ``all`` matches every rule. Text after two
+    spaces (or a second ``#``) is the justification and is ignored.
+    """
+    line_map: dict[int, set[str]] = {}
+    file_set: set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        kind, rules_text = m.group(1), m.group(2)
+        rules = {r.strip() for r in rules_text.split(",") if r.strip()}
+        if kind == "disable-file":
+            file_set |= rules
+        else:
+            line_map.setdefault(i, set()).update(rules)
+    return line_map, file_set
+
+
+# -- rule registry -----------------------------------------------------------
+
+class Rule:
+    """Base class: subclass, set id/name/short, implement check()."""
+
+    id: str = ""
+    name: str = ""
+    short: str = ""
+
+    def check(self, module: Module) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, module: Module, node: ast.AST, message: str) -> Finding:
+        return Finding(self.id, module.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message)
+
+
+REGISTRY: dict[str, Rule] = {}
+
+
+def register(cls: type[Rule]) -> type[Rule]:
+    """Class decorator: instantiate the rule and add it to REGISTRY."""
+    rule = cls()
+    assert rule.id and rule.id not in REGISTRY, f"bad rule id {rule.id!r}"
+    REGISTRY[rule.id] = rule
+    return cls
+
+
+def all_rules() -> list[Rule]:
+    _load_builtin_rules()
+    return [REGISTRY[k] for k in sorted(REGISTRY)]
+
+
+def _load_builtin_rules() -> None:
+    # import for the @register side effect; lazy so core stays importable
+    # from rule modules without a cycle
+    from kubeflow_tpu.analysis import rules_jax, rules_lockset  # noqa: F401
+
+
+# -- scanning ----------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> Iterator[pathlib.Path]:
+    """Expand files/directories into .py files, skipping caches."""
+    for raw in paths:
+        p = pathlib.Path(raw)
+        if p.is_dir():
+            for f in sorted(p.rglob("*.py")):
+                if "__pycache__" not in f.parts:
+                    yield f
+        elif p.suffix == ".py":
+            yield p
+
+
+def scan_source(path: str, source: str,
+                rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Run rules over one in-memory source (also the test-corpus entry
+    point). Returns unsuppressed findings sorted by position."""
+    if rules is None:
+        rules = all_rules()
+    try:
+        module = Module(path, source)
+    except SyntaxError as e:
+        return [Finding(PARSE_RULE, path, e.lineno or 1, e.offset or 0,
+                        f"file does not parse: {e.msg}")]
+    out: list[Finding] = []
+    for rule in rules:
+        for f in rule.check(module):
+            if not module.suppressed(f):
+                out.append(f)
+    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+
+
+def scan_paths(paths: Iterable[str], select: set[str] | None = None,
+               ignore: set[str] | None = None) -> list[Finding]:
+    rules = all_rules()
+    if select:
+        rules = [r for r in rules if r.id in select]
+    if ignore:
+        rules = [r for r in rules if r.id not in ignore]
+    if not rules and (not select or PARSE_RULE not in select):
+        # nothing to run (e.g. a hygiene-only --select): skip the parse
+        # pass entirely instead of AST-ing the tree for zero rules
+        return []
+    findings: list[Finding] = []
+    for f in iter_py_files(paths):
+        findings.extend(scan_source(str(f), f.read_text(), rules))
+    # select/ignore also apply to TPU000 parse findings, which
+    # scan_source emits outside the rules list
+    if select:
+        findings = [f for f in findings if f.rule in select]
+    if ignore:
+        findings = [f for f in findings if f.rule not in ignore]
+    return findings
+
+
+# -- shared AST helpers ------------------------------------------------------
+
+def dotted(node: ast.AST) -> str | None:
+    """Render Name/Attribute chains as 'a.b.c' (None for anything else)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted(call.func)

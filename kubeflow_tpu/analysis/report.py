@@ -1,0 +1,39 @@
+"""tpulint reporters: human text and machine JSON.
+
+The JSON schema is versioned so round tooling (tools/lint_all.sh, CI
+dashboards) can consume it without scraping: ``{"version": 1,
+"count": N, "findings": [{rule, path, line, col, message}, ...]}``.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Iterable
+
+from kubeflow_tpu.analysis.core import Finding
+
+JSON_VERSION = 1
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    """One `path:line:col: RULE message` per finding plus a summary."""
+    findings = list(findings)
+    lines = [f.render() for f in findings]
+    if findings:
+        by_rule = Counter(f.rule for f in findings)
+        breakdown = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+        lines.append(f"tpulint: {len(findings)} finding"
+                     f"{'s' if len(findings) != 1 else ''} ({breakdown})")
+    else:
+        lines.append("tpulint: clean")
+    return "\n".join(lines)
+
+
+def render_json(findings: Iterable[Finding]) -> str:
+    findings = list(findings)
+    return json.dumps({
+        "version": JSON_VERSION,
+        "count": len(findings),
+        "findings": [f.to_dict() for f in findings],
+    }, indent=2, sort_keys=True)

@@ -133,15 +133,17 @@ class Controller:
     def _process_one(self, req: Request) -> None:
         try:
             res = self.reconciler.reconcile(self.client, req)
-            self._failures.pop(req, None)
+            with self._cv:
+                self._failures.pop(req, None)
             if res and res.requeue_after:
                 self.enqueue_after(req, res.requeue_after)
         except ob.Conflict:
             # optimistic-concurrency loser: immediate benign retry
             self.enqueue(req)
         except Exception:
-            n = self._failures.get(req, 0) + 1
-            self._failures[req] = n
+            with self._cv:
+                n = self._failures.get(req, 0) + 1
+                self._failures[req] = n
             if n <= self.MAX_RETRIES:
                 log.exception("%s: reconcile %s failed (attempt %d)", self.name, req, n)
                 self.enqueue_after(req, min(0.01 * (2**n), 5.0))
@@ -253,15 +255,22 @@ class Controller:
             return 0
         for _ in range(max_rounds):
             self._drain_streams()
-            self._pump_delayed()
-            if not self._queue and advance_delayed and self._delayed:
-                self._queue.update({r: None for _, r in self._delayed})
-                self._delayed = []
-                advance_delayed = False  # only one synthetic advance per call
-            if not self._queue:
-                break
-            req = next(iter(self._queue))
-            del self._queue[req]
+            # queue surgery under the condition lock: the drain is
+            # single-threaded by contract, but nothing stops a caller
+            # from draining while run() workers are live, and unlocked
+            # dict/list mutation here would tear their state
+            with self._cv:
+                self._pump_delayed()
+                if not self._queue and advance_delayed and self._delayed:
+                    self._queue.update({r: None for _, r in self._delayed})
+                    self._delayed = []
+                    advance_delayed = False  # one synthetic advance per call
+                if not self._queue:
+                    break
+                req = next(iter(self._queue))
+                del self._queue[req]
+            # reconcile outside the lock: holding _cv through a reconcile
+            # would serialize this drain against every run() worker
             self._process_one(req)
             done += 1
         return done

@@ -9,6 +9,7 @@ while client threads hammer the apiserver; CPython's data-race surface
 optimistic concurrency) is exercised directly.
 """
 
+import os
 import threading
 import time
 
@@ -17,11 +18,17 @@ import pytest
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.k8s.fake import FakeCluster
 
+# Stress knobs (ISSUE 1): the default tier stays fast and deterministic;
+# a slow-tier run cranks contention without editing the file, e.g.
+#   TPU_RACE_THREADS=32 TPU_RACE_ITERS=200 python -m pytest tests/test_race.py
+RACE_THREADS = int(os.environ.get("TPU_RACE_THREADS", "8"))
+RACE_ITERS = int(os.environ.get("TPU_RACE_ITERS", "30"))
+
 
 def test_fakecluster_concurrent_crud_consistency():
     c = FakeCluster()
     errors: list[Exception] = []
-    N, PER = 8, 30
+    N, PER = RACE_THREADS, RACE_ITERS
 
     def worker(wid: int):
         try:
@@ -56,9 +63,11 @@ def test_optimistic_concurrency_under_contention():
     c = FakeCluster()
     c.create(ob.new_object("v1", "ConfigMap", "shared", namespace="ns"))
     conflicts = [0]
+    writers = max(2, RACE_THREADS // 2)
+    per_writer = max(5, RACE_ITERS)
 
     def incr():
-        for _ in range(25):
+        for _ in range(per_writer):
             while True:
                 got = c.get("v1", "ConfigMap", "shared", "ns")
                 data = dict(got.get("data") or {})
@@ -70,13 +79,13 @@ def test_optimistic_concurrency_under_contention():
                 except ob.Conflict:
                     conflicts[0] += 1
 
-    threads = [threading.Thread(target=incr) for _ in range(4)]
+    threads = [threading.Thread(target=incr) for _ in range(writers)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
     final = c.get("v1", "ConfigMap", "shared", "ns")
-    assert final["data"]["count"] == str(4 * 25)
+    assert final["data"]["count"] == str(writers * per_writer)
 
 
 def test_controller_threaded_mode_against_churn():
@@ -132,7 +141,7 @@ def test_tpctl_server_concurrent_creates_single_worker_per_name():
                       query={}, headers={}, body=body)
         srv.create(req)
 
-    threads = [threading.Thread(target=create) for _ in range(8)]
+    threads = [threading.Thread(target=create) for _ in range(RACE_THREADS)]
     for t in threads:
         t.start()
     for t in threads:

@@ -1,0 +1,578 @@
+"""tpulint regression corpus + tree gate (ISSUE 1 tentpole wiring).
+
+Three layers:
+
+1. Corpus: for every registered rule, a known-bad fragment asserting
+   the rule fires with the right id AND line number, and a known-clean
+   near-miss fragment asserting it stays silent (false-positive pin).
+   The clean fragments encode the real idioms of this tree (params as
+   jit arguments, scan bodies capturing within a trace, helpers called
+   with the lock held) so rule tightening can't regress them.
+2. Mechanics: suppression comments, reporters, CLI exit codes.
+3. Tree gate: every kubeflow_tpu/ module is scanned parametrically —
+   a new finding fails CI like any other test.
+"""
+
+import ast
+import json
+import pathlib
+import textwrap
+
+import pytest
+
+from kubeflow_tpu.analysis import all_rules, render_json, render_text, scan_source
+from kubeflow_tpu.analysis.__main__ import main as tpulint_main
+from kubeflow_tpu.analysis import hygiene
+
+pytestmark = pytest.mark.lint
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "kubeflow_tpu"
+
+
+def _scan(src: str):
+    return scan_source("<corpus>", textwrap.dedent(src))
+
+
+# --------------------------------------------------------------------------
+# corpus: (rule id) -> [(bad source, expected line)], [clean sources]
+# line numbers are 1-based within the dedented fragment
+# --------------------------------------------------------------------------
+
+BAD = {
+    "TPU101": [
+        # the 700MB class: weight tree captured across the jit boundary
+        ("""\
+import jax
+
+
+def make(model, variables):
+    def fwd(x):
+        return model.apply(variables, x)
+    return jax.jit(fwd)
+""", 6),
+        # array built on host, closed over by the jitted fn
+        ("""\
+import jax
+import jax.numpy as jnp
+
+
+def build():
+    table = jnp.arange(65536)
+    def lookup(i):
+        return table[i]
+    return jax.jit(lookup)
+""", 8),
+    ],
+    "TPU102": [
+        ("""\
+import jax
+
+
+@jax.jit
+def step(state, batch):
+    loss = (state - batch).sum()
+    print(loss)
+    return loss
+""", 7),
+        ("""\
+import jax
+
+
+@jax.jit
+def step(state, batch):
+    return (state - batch).sum().item()
+""", 6),
+    ],
+    "TPU103": [
+        ("""\
+import jax.numpy as jnp
+
+NEG_MASK = jnp.full((1024,), -1e9)
+""", 3),
+    ],
+    "TPU104": [
+        ("""\
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+step = jax.jit(train_step)
+""", 8),
+        ("""\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def train_step(state, batch, lr):
+    return state
+""", 6),
+    ],
+    "LOCK201": [
+        ("""\
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.jobs[k] = v
+
+    def drop(self, k):
+        del self.jobs[k]
+""", 14),
+        ("""\
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._mu:
+            self.n += 1
+
+    def reset(self):
+        self.n = 0
+""", 14),
+    ],
+    "LOCK202": [
+        ("""\
+import time
+
+
+class NodeReconciler:
+    def reconcile(self, client, req):
+        time.sleep(5.0)
+        return None
+""", 6),
+    ],
+}
+
+CLEAN = {
+    "TPU101": [
+        # params flow through jit arguments (speculative.py idiom)
+        """\
+import jax
+
+
+def make(model):
+    def fwd(params, x):
+        return model.apply(params, x)
+    return jax.jit(fwd)
+""",
+        # scan body capturing from its enclosing function with no jit
+        # boundary: the capture is a tracer in the caller's trace
+        # (flash_attention.py _flash_bwd_xla idiom)
+        """\
+import jax
+import jax.numpy as jnp
+
+
+def bwd(q, lse):
+    positions = jnp.arange(q.shape[1])
+
+    def kv_block(carry, jb):
+        return carry + positions[jb], None
+
+    out, _ = jax.lax.scan(kv_block, jnp.zeros(()), jnp.arange(4))
+    return out
+""",
+        # arrays built INSIDE the jit root are part of the trace
+        """\
+import jax
+import jax.numpy as jnp
+
+
+def build(model):
+    def fwd(x):
+        scale = jnp.float32(2.0)
+
+        def inner(y):
+            return y * scale
+        return inner(x)
+    return jax.jit(fwd)
+""",
+    ],
+    "TPU102": [
+        """\
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(state, batch):
+    jax.debug.print("loss {l}", l=state.sum())
+    return (state - batch).sum()
+
+
+def host_epilogue(metrics):
+    return float(np.asarray(metrics))
+""",
+        # float() on a static arg is concretization-safe
+        """\
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnames=("lr",))
+def scale(x, lr):
+    return x * float(lr)
+""",
+    ],
+    "TPU103": [
+        """\
+import jax.numpy as jnp
+import numpy as np
+
+HOST_TABLE = np.arange(16)  # np at import is host-only: allowed
+
+
+def masked(x):
+    return x + jnp.full((8,), -1e9)
+""",
+        # the unaliased spelling gets the same host-numpy exemption
+        """\
+import numpy
+
+HOST_TABLE = numpy.arange(16)
+""",
+    ],
+    "TPU104": [
+        """\
+import jax
+
+
+def train_step(state, batch):
+    return state
+
+
+def eval_step(state, batch):
+    return state
+
+
+step = jax.jit(train_step, donate_argnums=(0,))
+evaluate = jax.jit(eval_step)
+""",
+    ],
+    "LOCK201": [
+        # private helper only called with the lock held (leases.py
+        # _became idiom): no re-acquire required, no finding
+        """\
+import threading
+
+
+class Elector:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.held = False
+
+    def acquire(self):
+        with self._lock:
+            return self._round()
+
+    def _round(self):
+        self.held = True
+        return self.held
+""",
+        # recursive helper cycle whose every external entry holds the
+        # lock (FakeCluster _delete_now <-> _gc_orphans shape): internal
+        # cycle edges are lock-held, so the unlocked-looking writes are
+        # fine and must not fire
+        """\
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = {}
+
+    def delete(self, k):
+        with self._lock:
+            self._delete_now(k)
+
+    def _delete_now(self, k):
+        self.items.pop(k, None)
+        self._cascade(k)
+
+    def _cascade(self, k):
+        for child in list(self.items):
+            if child.startswith(k):
+                self._delete_now(child)
+""",
+        # mutually-recursive private helpers with NO locked entry point
+        # must not vouch for each other (entry-point pass):
+        # no finding because nothing here is ever mutated under the lock
+        """\
+import threading
+
+
+class Orphans:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def _a(self, depth):
+        self.n += 1
+        if depth:
+            self._b(depth - 1)
+
+    def _b(self, depth):
+        self._a(depth)
+
+    def reset(self):
+        self.n = 0
+""",
+        # .update() on an API client object is a call, not a container
+        # mutation: must not make 'client' a guarded attribute
+        """\
+import threading
+
+
+class Syncer:
+    def __init__(self, client):
+        self._lock = threading.Lock()
+        self.client = client
+
+    def push(self, obj):
+        with self._lock:
+            self.client.update(obj)
+
+    def push_unlocked(self, obj):
+        self.client.update(obj)
+""",
+    ],
+    "LOCK202": [
+        """\
+import time
+
+
+class NodeReconciler:
+    def reconcile(self, client, req):
+        return Result(requeue_after=5.0)
+
+    def helper(self):
+        time.sleep(0.1)  # not a reconcile body
+
+
+class Result:
+    def __init__(self, requeue_after=None):
+        self.requeue_after = requeue_after
+""",
+    ],
+}
+
+
+def _bad_cases():
+    return [(rule, src, line)
+            for rule, cases in sorted(BAD.items())
+            for src, line in cases]
+
+
+def _clean_cases():
+    return [(rule, src)
+            for rule, cases in sorted(CLEAN.items())
+            for src in cases]
+
+
+@pytest.mark.parametrize("rule,src,line", _bad_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("TPU", "LOCK")) else None)
+def test_rule_fires_with_id_and_line(rule, src, line):
+    findings = _scan(src)
+    hits = [f for f in findings if f.rule == rule]
+    assert hits, f"{rule} did not fire; got {[f.render() for f in findings]}"
+    assert line in [f.line for f in hits], (
+        f"{rule} fired at {[f.line for f in hits]}, expected line {line}")
+
+
+@pytest.mark.parametrize("rule,src", _clean_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("TPU", "LOCK")) else None)
+def test_clean_fragment_stays_clean(rule, src):
+    findings = [f for f in _scan(src) if f.rule == rule]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_at_least_six_rules_each_with_both_cases():
+    ids = {r.id for r in all_rules()}
+    assert len(ids) >= 6, ids
+    assert ids == set(BAD) == set(CLEAN), (
+        "every registered rule needs a firing AND a non-firing corpus case")
+
+
+# -- suppression mechanics ---------------------------------------------------
+
+_SUPPRESSIBLE = """\
+import time
+
+
+class R:
+    def reconcile(self, client, req):
+        time.sleep(1.0){comment}
+"""
+
+
+def test_line_suppression_silences_only_named_rule():
+    src = _SUPPRESSIBLE.format(
+        comment="  # tpulint: disable=LOCK202  corpus justification")
+    assert _scan(src) == []
+    wrong = _SUPPRESSIBLE.format(comment="  # tpulint: disable=TPU101")
+    assert [f.rule for f in _scan(wrong)] == ["LOCK202"]
+
+
+def test_line_suppression_all():
+    src = _SUPPRESSIBLE.format(comment="  # tpulint: disable=all")
+    assert _scan(src) == []
+
+
+def test_file_suppression():
+    src = ("# tpulint: disable-file=LOCK202  corpus justification\n"
+           + _SUPPRESSIBLE.format(comment=""))
+    assert _scan(src) == []
+
+
+def test_single_space_justification_still_suppresses():
+    """A one-space separator must not swallow the justification into the
+    rule list (which would silently disable the suppression)."""
+    src = _SUPPRESSIBLE.format(
+        comment="  # tpulint: disable=LOCK202 requeue handled by caller")
+    assert _scan(src) == []
+
+
+def test_parse_error_is_reported_not_raised():
+    findings = scan_source("<corpus>", "def broken(:\n")
+    assert [f.rule for f in findings] == ["TPU000"]
+
+
+# -- reporters ---------------------------------------------------------------
+
+def test_json_reporter_schema():
+    findings = _scan(BAD["LOCK202"][0][0])
+    doc = json.loads(render_json(findings))
+    assert doc["version"] == 1
+    assert doc["count"] == len(findings) == len(doc["findings"])
+    entry = doc["findings"][0]
+    assert set(entry) == {"rule", "path", "line", "col", "message"}
+    assert entry["rule"] == "LOCK202"
+
+
+def test_text_reporter_mentions_rule_and_location():
+    f = _scan(BAD["LOCK202"][0][0])[0]
+    text = render_text([f])
+    assert "LOCK202" in text and f":{f.line}:" in text
+    assert render_text([]) == "tpulint: clean"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+def test_cli_exit_codes(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD["TPU104"][0][0]))
+    good = tmp_path / "good.py"
+    good.write_text("x = 1\n")
+    assert tpulint_main([str(good)]) == 0
+    assert tpulint_main([str(bad)]) == 1
+    assert tpulint_main(["--select", "NOPE999", str(bad)]) == 2
+    assert tpulint_main(["--select", "LOCK202", str(bad)]) == 0  # filtered
+    assert tpulint_main([str(tmp_path / "no_such_dir")]) == 2  # path typo
+    capsys.readouterr()
+    assert tpulint_main(["--json", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["findings"][0]["rule"] == "TPU104"
+
+
+def test_cli_selecting_hygiene_rule_implies_hygiene_pass(tmp_path, capsys):
+    """--select HYG002 without --hygiene must still run the hygiene
+    pass (not silently scan nothing and exit 0)."""
+    p = tmp_path / "hooked.py"
+    p.write_text("breakpoint()\n")
+    assert tpulint_main(["--select", "HYG002", str(p)]) == 1
+    capsys.readouterr()
+
+
+def test_cli_list_rules(capsys):
+    assert tpulint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in list(BAD) + ["HYG001", "HYG002", "HYG003"]:
+        assert rid in out
+
+
+# -- hygiene gates -----------------------------------------------------------
+
+def test_hygiene_catches_debugger_and_conflict_markers(tmp_path):
+    (tmp_path / "hooked.py").write_text("x = 1\nbreakpoint()\n")
+    (tmp_path / "torn.py").write_text("x = 1\n" + "<<" + "<<<<< HEAD\n")
+    rules = {f.rule for f in hygiene.run_hygiene([str(tmp_path)])}
+    # the conflict marker also breaks the parse gate, hence HYG001
+    assert rules == {"HYG001", "HYG002", "HYG003"}
+
+
+def test_hygiene_yaml_gate(tmp_path):
+    p = tmp_path / "m.yaml"
+    p.write_text("a: [1, 2\n")
+    assert [f.rule for f in hygiene.run_hygiene([str(p)])] == ["HYG001"]
+
+
+def test_hygiene_skips_explicit_non_gated_file(tmp_path):
+    p = tmp_path / "watch.sh"
+    p.write_text("#!/bin/bash\nwhile true; do date; done\n")
+    assert hygiene.run_hygiene([str(p)]) == []
+
+
+def test_hygiene_only_select_filters_parse_findings(tmp_path, capsys):
+    """--select HYG002 must not leak TPU000 parse findings (and must not
+    even run the tpulint parse pass)."""
+    (tmp_path / "broken.py").write_text("def broken(:\n")
+    (tmp_path / "hooked.py").write_text("breakpoint()\n")
+    assert tpulint_main(["--select", "HYG002", str(tmp_path)]) == 1
+    out = capsys.readouterr().out
+    assert "HYG002" in out and "TPU000" not in out and "HYG001" not in out
+
+
+# -- the tree gate: the shipped package must lint clean ----------------------
+
+TREE_FILES = sorted(
+    p for p in PACKAGE.rglob("*.py") if "__pycache__" not in p.parts)
+
+
+@pytest.mark.parametrize("path", TREE_FILES,
+                         ids=lambda p: str(p.relative_to(REPO)))
+def test_tree_file_lints_clean(path):
+    findings = scan_source(str(path), path.read_text())
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_suppressions_in_tree_carry_justification():
+    """Inline suppressions are allowed only with a why: prose must follow
+    the rule list. Uses the framework's own suppression regex, so doc
+    mentions of the syntax that core would not honor are not checked.
+    Covers every python target tools/lint_all.sh scans, not just the
+    package."""
+    from kubeflow_tpu.analysis.core import _SUPPRESS_RE
+
+    gated = TREE_FILES + sorted(
+        (REPO / "tools").rglob("*.py")) + sorted(
+        (REPO / "tests").rglob("*.py")) + [
+        REPO / "bench.py", REPO / "__graft_entry__.py"]
+    for path in gated:
+        for i, line in enumerate(path.read_text().splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            justification = line[m.end():].strip().strip("#").strip()
+            assert justification, (
+                f"{path}:{i}: suppression without justification text")

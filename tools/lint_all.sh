@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# The full static-analysis gate, pytest-free (ISSUE 1 satellite): run
+# tpulint (JAX/TPU + lockset rules) over the package and round tooling,
+# plus the stdlib hygiene gates (parse / debugger hooks / conflict
+# markers, yaml manifests) over everything that ships — tests and
+# examples ride only the hygiene gates, mirroring the pytest lint tier.
+# Exits nonzero on any finding, so a round driver can gate on it:
+#
+#   tools/lint_all.sh
+#
+# For machine-readable output run the underlying passes yourself with
+# --json (each invocation emits one JSON document).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+PY=${PYTHON:-python}
+
+# 1. tpulint rules over the package and executable round tooling
+"$PY" -m kubeflow_tpu.analysis kubeflow_tpu tools bench.py __graft_entry__.py
+
+# 2. stdlib hygiene (HYG rules only) over everything shipped
+"$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
+    kubeflow_tpu tools tests examples bench.py __graft_entry__.py
