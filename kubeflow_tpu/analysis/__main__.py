@@ -3,6 +3,10 @@
 Exit codes: 0 clean, 1 findings, 2 usage error. ``--hygiene`` adds the
 stdlib hygiene gates (parse/debugger/conflict-marker, yaml manifests)
 on top of the tpulint rules, so tools/lint_all.sh is one process.
+``--format sarif`` emits a code-scanning artifact; ``--write-baseline``
+/ ``--baseline`` implement the ratchet (fail only on NEW findings).
+Multi-path scans run the whole-program rules (cross-module call graph)
+over all paths as one program.
 """
 
 from __future__ import annotations
@@ -28,11 +32,23 @@ def main(argv: list[str] | None = None) -> int:
                         help="files or directories to scan "
                              "(default: kubeflow_tpu)")
     parser.add_argument("--json", action="store_true",
-                        help="machine-readable report on stdout")
-    parser.add_argument("--select", metavar="RULES",
+                        help="machine-readable report on stdout "
+                             "(alias for --format json)")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text",
+                        help="report format (sarif for CI code-scanning "
+                             "uploads)")
+    parser.add_argument("--select", "--rules", dest="select",
+                        metavar="RULES",
                         help="comma-separated rule ids to run exclusively")
     parser.add_argument("--ignore", metavar="RULES",
                         help="comma-separated rule ids to skip")
+    parser.add_argument("--baseline", metavar="FILE",
+                        help="ratchet mode: fail only on findings not in "
+                             "this baseline file")
+    parser.add_argument("--write-baseline", metavar="FILE",
+                        help="write current findings as the baseline and "
+                             "exit 0")
     parser.add_argument("--hygiene", action="store_true",
                         help="also run the stdlib hygiene gates "
                              "(parse/debugger/conflict markers, yaml)")
@@ -75,7 +91,25 @@ def main(argv: list[str] | None = None) -> int:
         findings = sorted(findings + hyg,
                           key=lambda f: (f.path, f.line, f.col, f.rule))
 
-    print(report.render_json(findings) if args.json
+    if args.write_baseline:
+        pathlib.Path(args.write_baseline).write_text(
+            report.render_baseline(findings))
+        print(f"tpulint: baseline written to {args.write_baseline} "
+              f"({len(findings)} finding"
+              f"{'s' if len(findings) != 1 else ''})")
+        return 0
+    if args.baseline:
+        try:
+            baseline = report.load_baseline(
+                pathlib.Path(args.baseline).read_text())
+        except FileNotFoundError:
+            print(f"no such baseline: {args.baseline}", file=sys.stderr)
+            return 2
+        findings = report.new_findings(findings, baseline)
+
+    fmt = "json" if args.json else args.format
+    print(report.render_sarif(findings) if fmt == "sarif"
+          else report.render_json(findings) if fmt == "json"
           else report.render_text(findings))
     return 1 if findings else 0
 

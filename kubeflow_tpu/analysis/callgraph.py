@@ -1,0 +1,618 @@
+"""Whole-program layer for tpulint: modules, classes, and a cross-module
+call graph with lock-context propagation.
+
+PR 1's lockset checker saw one file at a time, so a lock taken in
+``control/runtime.py`` could not vouch for a helper in another module,
+and lock-acquisition *order* was invisible entirely. This module builds
+the program model the LOCK2xx/TPU10x whole-program rules share:
+
+- ``Program``: every scanned ``Module`` plus per-module import tables,
+  top-level classes (with their locks and container-evidence attrs,
+  the same evidence LOCK201 has always used) and functions.
+- Call sites: each ``ast.Call`` inside a top-level function/method is
+  resolved — ``self.method``, ``self.attr.method`` (via constructor
+  attribute-type inference), module-level and ``from``-imported
+  functions, and parameters annotated with a program class — and
+  annotated with the lock tokens lexically held at the site.
+- ``locked_entry``: the bounded greatest-fixpoint generalization of
+  LOCK201's per-class locked-context pass. A private function's entry
+  context is the intersection over all known call sites of (locks held
+  at the site + the caller's own entry context), pruned by an
+  entry-point pass so mutually-recursive helpers never vouch for each
+  other without a genuinely locked way in.
+- ``may_held``: the union (any-path) analogue, feeding LOCK203's
+  lock-acquisition-order graph.
+- ``writes()`` / ``guarded_map()``: attribute writes program-wide —
+  including writes through parameters of a known class (``def
+  seed_controller(c: Controller): c._streams.append(...)``) — with the
+  lock tokens protecting each, and the resulting per-class
+  guarded-attribute map that both static LOCK201 and the dynamic
+  happens-before validator (analysis/dyntrace.py) consume.
+
+Everything is resolution-bounded: an unresolvable callee or receiver
+simply contributes nothing, so the analysis degrades to PR 1's per-file
+behavior on a single module and never guesses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterable, Iterator
+
+from kubeflow_tpu.analysis.core import Module, call_name, dotted
+
+# Lock evidence (shared with rules_lockset): ctor assignment or a
+# lock-ish `with self.X:` name. `with self.mesh:` (jax Mesh activation)
+# must not count.
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "Lock", "RLock", "Condition"}
+LOCKISH = re.compile(r"lock|mutex|cond|(^|_)(mu|cv)$")
+MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
+            "clear", "update", "setdefault", "add", "discard"}
+CONTAINER_CTORS = {"dict", "list", "set", "collections.defaultdict",
+                   "defaultdict", "collections.OrderedDict", "OrderedDict",
+                   "collections.deque", "deque", "queue.Queue", "Queue"}
+
+_FIXPOINT_CAP = 32  # bounded-depth: iterations, not recursion
+
+# A lock token: (class qualname "mod:Class", lock attribute name).
+Token = tuple[str, str]
+
+
+def receiver_attr(node: ast.AST, recv: str) -> str | None:
+    """'X' when node is the attribute access ``<recv>.X``."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == recv):
+        return node.attr
+    return None
+
+
+def receiver_attr_root(node: ast.AST, recv: str) -> str | None:
+    """Root ``<recv>.X`` of a chain like ``recv.X[k]`` / ``recv.X.y[k]``."""
+    while isinstance(node, (ast.Subscript, ast.Attribute)):
+        got = receiver_attr(node, recv)
+        if got is not None:
+            return got
+        node = node.value
+    return None
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """A top-level function or method (nested defs belong to their
+    enclosing FuncInfo; their bodies never outlive its analysis)."""
+
+    qual: str                      # "mod:func" or "mod:Class.method"
+    name: str
+    node: ast.FunctionDef
+    module: Module
+    modname: str
+    owner: "ClassInfo | None" = None
+    # parameter name -> class qualname, for `self` and annotated params
+    param_classes: dict[str, str] = dataclasses.field(default_factory=dict)
+
+    @property
+    def is_private(self) -> bool:
+        return self.name.startswith("_") and not self.name.startswith("__")
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qual: str                      # "mod:Class"
+    name: str
+    node: ast.ClassDef
+    module: Module
+    modname: str
+    locks: set[str] = dataclasses.field(default_factory=set)
+    containers: set[str] = dataclasses.field(default_factory=set)
+    methods: dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    attr_classes: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class CallSite:
+    call: ast.Call
+    caller: FuncInfo
+    callee: str | None             # resolved FuncInfo qual, or None
+    lex_held: frozenset[Token]     # tokens lexically held at the site
+
+
+@dataclasses.dataclass
+class WriteRec:
+    """One attribute write, attributed to a program class."""
+
+    class_qual: str
+    attr: str
+    node: ast.AST
+    func: FuncInfo
+    module: Module
+    recv: str                      # receiver name at the write ("self", "c")
+    # lock tokens of the OWNING class protecting this write (lexical +
+    # the function's guaranteed entry context)
+    tokens: frozenset[str]         # lock attr names of class_qual
+
+
+def _find_locks(cls: ast.ClassDef) -> set[str]:
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                attr = receiver_attr(item.context_expr, "self")
+                if attr is not None and LOCKISH.search(attr):
+                    locks.add(attr)
+        elif isinstance(node, ast.Assign):
+            if (isinstance(node.value, ast.Call)
+                    and call_name(node.value) in LOCK_CTORS):
+                for t in node.targets:
+                    attr = receiver_attr(t, "self")
+                    if attr is not None:
+                        locks.add(attr)
+    return locks
+
+
+def _find_containers(cls: ast.ClassDef) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        value = node.value
+        is_container = isinstance(
+            value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
+                    ast.ListComp, ast.SetComp)) or (
+            isinstance(value, ast.Call)
+            and call_name(value) in CONTAINER_CTORS)
+        if not is_container:
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        for t in targets:
+            attr = receiver_attr(t, "self")
+            if attr is not None:
+                out.add(attr)
+    return out
+
+
+def _parse_imports(module: Module, modname: str) -> dict[str, tuple]:
+    """Alias table: name -> ("mod", target) | ("sym", target, symbol)."""
+    out: dict[str, tuple] = {}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = (
+                    ("mod", alias.name) if alias.asname
+                    else ("mod", alias.name.split(".")[0]))
+                if alias.asname is None and "." in alias.name:
+                    # `import a.b.c` binds `a`, but calls spelled
+                    # a.b.c.f() resolve through the full dotted prefix
+                    out[alias.name] = ("mod", alias.name)
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # relative import: strip `level` trailing components of
+                # this module's dotted name, then append the target
+                parts = modname.split(".")
+                keep = parts[:max(len(parts) - node.level, 0)]
+                base = ".".join(keep + ([node.module] if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                out[alias.asname or alias.name] = ("sym", base, alias.name)
+    return out
+
+
+class Program:
+    """The whole-program model: modules, classes, functions, call graph."""
+
+    def __init__(self, modules: dict[str, Module]):
+        self.modules = modules                      # dotted name -> Module
+        self.by_path = {m.path: m for m in modules.values()}
+        self.imports: dict[str, dict[str, tuple]] = {}
+        self.classes: dict[str, ClassInfo] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self._collect_defs()
+        self._infer_param_classes()
+        self._infer_attr_classes()
+        self.calls: list[CallSite] = []
+        self._collect_calls()
+        self._locked_entry: dict[str, frozenset[Token]] | None = None
+        self._may_held: dict[str, frozenset[Token]] | None = None
+        self._writes: list[WriteRec] | None = None
+
+    # -- construction --------------------------------------------------------
+
+    def _collect_defs(self) -> None:
+        for modname, module in self.modules.items():
+            self.imports[modname] = _parse_imports(module, modname)
+            for node in module.tree.body:
+                if isinstance(node, ast.FunctionDef):
+                    qual = f"{modname}:{node.name}"
+                    self.functions[qual] = FuncInfo(
+                        qual, node.name, node, module, modname)
+                elif isinstance(node, ast.ClassDef):
+                    cqual = f"{modname}:{node.name}"
+                    info = ClassInfo(cqual, node.name, node, module, modname,
+                                     locks=_find_locks(node),
+                                     containers=_find_containers(node))
+                    self.classes[cqual] = info
+                    for sub in node.body:
+                        if isinstance(sub, ast.FunctionDef):
+                            fqual = f"{modname}:{node.name}.{sub.name}"
+                            fi = FuncInfo(fqual, sub.name, sub, module,
+                                          modname, owner=info)
+                            info.methods[sub.name] = fi
+                            self.functions[fqual] = fi
+
+    def resolve_symbol(self, modname: str, name: str) -> str | None:
+        """Resolve a bare or dotted name to a program class/function qual
+        ("mod:Sym"), following one level of from-import indirection."""
+        local = f"{modname}:{name.split('.')[0]}" if "." not in name else None
+        if local and (local in self.classes or local in self.functions):
+            return local
+        table = self.imports.get(modname, {})
+        head, _, rest = name.partition(".")
+        got = table.get(name) or table.get(head)
+        # longest-prefix match for `import a.b.c` style dotted calls
+        if "." in name:
+            parts = name.split(".")
+            for cut in range(len(parts) - 1, 0, -1):
+                prefix = ".".join(parts[:cut])
+                if prefix in table and table[prefix][0] == "mod":
+                    target, sym = table[prefix][1], ".".join(parts[cut:])
+                    if "." in sym:
+                        return None  # a.b.C.method etc.: out of scope
+                    if target in self.modules:
+                        q = f"{target}:{sym}"
+                        if q in self.classes or q in self.functions:
+                            return q
+                    return None
+        if got is None:
+            return None
+        if got[0] == "sym":
+            _, target, sym = got
+            if rest:                     # alias.attr: symbol of a symbol
+                return None
+            if target in self.modules:
+                q = f"{target}:{sym}"
+                if q in self.classes or q in self.functions:
+                    return q
+        elif got[0] == "mod" and rest:
+            target = got[1]
+            if target in self.modules and "." not in rest:
+                q = f"{target}:{rest}"
+                if q in self.classes or q in self.functions:
+                    return q
+        return None
+
+    def _annotation_class(self, fi_mod: str, ann: ast.expr | None) -> str | None:
+        if ann is None:
+            return None
+        name = dotted(ann) or (
+            ann.value if isinstance(ann, ast.Constant)
+            and isinstance(ann.value, str) else None)
+        if not name:
+            return None
+        got = self.resolve_symbol(fi_mod, name)
+        return got if got in self.classes else None
+
+    def _infer_param_classes(self) -> None:
+        for fi in self.functions.values():
+            args = fi.node.args
+            params = args.posonlyargs + args.args + args.kwonlyargs
+            for i, a in enumerate(params):
+                if fi.owner is not None and i == 0 and a.arg in ("self", "cls"):
+                    if a.arg == "self":
+                        fi.param_classes["self"] = fi.owner.qual
+                    continue
+                got = self._annotation_class(fi.modname, a.annotation)
+                if got:
+                    fi.param_classes[a.arg] = got
+
+    def _infer_attr_classes(self) -> None:
+        """``self.x = ClassName(...)`` pins attr x to a program class, so
+        ``self.x.method()`` calls resolve across modules."""
+        for cls in self.classes.values():
+            for node in ast.walk(cls.node):
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                name = call_name(node.value)
+                target = self.resolve_symbol(cls.modname, name) if name else None
+                if target not in self.classes:
+                    continue
+                for t in node.targets:
+                    attr = receiver_attr(t, "self")
+                    if attr is not None:
+                        cls.attr_classes[attr] = target
+
+    # -- lexical lock context ------------------------------------------------
+
+    def lex_tokens(self, node: ast.AST, fi: FuncInfo) -> frozenset[Token]:
+        """Lock tokens held at `node` by `with <recv>.<lock>` blocks
+        inside fi's own body. A nested def breaks the chain (its body
+        runs at call time, not necessarily under the enclosing with)."""
+        held: set[Token] = set()
+        for anc in fi.module.ancestors(node):
+            if isinstance(anc, ast.With):
+                for item in anc.items:
+                    tok = self._with_token(item.context_expr, fi)
+                    if tok is not None:
+                        held.add(tok)
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break  # reached fi.node or a nested def first
+        return frozenset(held)
+
+    def _with_token(self, expr: ast.expr, fi: FuncInfo) -> Token | None:
+        if not isinstance(expr, ast.Attribute):
+            return None
+        if not isinstance(expr.value, ast.Name):
+            return None
+        recv = expr.value.id
+        cqual = fi.param_classes.get(recv)
+        if cqual is None:
+            return None
+        cls = self.classes[cqual]
+        if expr.attr in cls.locks:
+            return (cqual, expr.attr)
+        return None
+
+    # -- call graph ----------------------------------------------------------
+
+    def _collect_calls(self) -> None:
+        for fi in self.functions.values():
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    self.calls.append(CallSite(
+                        node, fi, self._resolve_call(node, fi),
+                        self.lex_tokens(node, fi)))
+        self._sites_by_callee: dict[str, list[CallSite]] = {}
+        for site in self.calls:
+            if site.callee is not None:
+                self._sites_by_callee.setdefault(site.callee, []).append(site)
+
+    def _resolve_call(self, call: ast.Call, fi: FuncInfo) -> str | None:
+        name = call_name(call)
+        if name is None:
+            return None
+        parts = name.split(".")
+        # recv.method / recv.attr.method where recv is self or a typed param
+        if parts[0] in fi.param_classes:
+            cls = self.classes[fi.param_classes[parts[0]]]
+            if len(parts) == 2:
+                m = cls.methods.get(parts[1])
+                return m.qual if m else None
+            if len(parts) == 3:
+                target = cls.attr_classes.get(parts[1])
+                if target:
+                    m = self.classes[target].methods.get(parts[2])
+                    return m.qual if m else None
+            return None
+        got = self.resolve_symbol(fi.modname, name)
+        if got in self.functions:
+            return got
+        if got in self.classes:
+            init = self.classes[got].methods.get("__init__")
+            return init.qual if init else None
+        return None
+
+    # -- entry-context fixpoints ---------------------------------------------
+
+    def locked_entry(self) -> dict[str, frozenset[Token]]:
+        """Tokens guaranteed held whenever a private function runs.
+
+        Greatest fixpoint over the call graph (TOP = "every token"),
+        then an entry-point pruning pass: a token survives only if some
+        call path actually acquires it lexically — otherwise two
+        mutually-recursive helpers called from nowhere locked would
+        vouch for each other (PR 1's two-pass shape, program-wide)."""
+        if self._locked_entry is not None:
+            return self._locked_entry
+        TOP = None  # lattice top: unconstrained
+        entry: dict[str, frozenset[Token] | None] = {}
+        candidates = [q for q, fi in self.functions.items()
+                      if fi.is_private and self._sites_by_callee.get(q)]
+        for q in self.functions:
+            entry[q] = TOP if q in candidates else frozenset()
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for q in candidates:
+                acc: frozenset[Token] | None = TOP
+                for site in self._sites_by_callee[q]:
+                    ctx = entry.get(site.caller.qual, frozenset())
+                    here = (TOP if ctx is TOP
+                            else frozenset(site.lex_held | ctx))
+                    if here is TOP:
+                        continue
+                    acc = here if acc is TOP else (acc & here)
+                if acc is not TOP and entry[q] != acc:
+                    entry[q] = acc
+                    changed = True
+            if not changed:
+                break
+        # entry-point pass, per token
+        entered: dict[str, set[Token]] = {q: set() for q in candidates}
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for q in candidates:
+                for site in self._sites_by_callee[q]:
+                    new = set(site.lex_held)
+                    new |= entered.get(site.caller.qual, set())
+                    if not new <= entered[q]:
+                        entered[q] |= new
+                        changed = True
+            if not changed:
+                break
+        out: dict[str, frozenset[Token]] = {}
+        for q in self.functions:
+            e = entry[q]
+            if e is TOP:
+                out[q] = frozenset(entered.get(q, set()))
+            else:
+                out[q] = frozenset(e & entered[q]) if q in entered else e
+        self._locked_entry = out
+        return out
+
+    def may_held(self) -> dict[str, frozenset[Token]]:
+        """Tokens possibly held on SOME path into each function — the
+        any-path union dual of locked_entry, for lock-order edges."""
+        if self._may_held is not None:
+            return self._may_held
+        may: dict[str, set[Token]] = {q: set() for q in self.functions}
+        for _ in range(_FIXPOINT_CAP):
+            changed = False
+            for site in self.calls:
+                if site.callee is None:
+                    continue
+                new = set(site.lex_held) | may.get(site.caller.qual, set())
+                if not new <= may[site.callee]:
+                    may[site.callee] |= new
+                    changed = True
+            if not changed:
+                break
+        self._may_held = {q: frozenset(s) for q, s in may.items()}
+        return self._may_held
+
+    # -- writes and the guarded map ------------------------------------------
+
+    def writes(self) -> list[WriteRec]:
+        """Every attribute write attributable to a program class, with
+        the owning class's lock tokens protecting it."""
+        if self._writes is not None:
+            return self._writes
+        entry = self.locked_entry()
+        out: list[WriteRec] = []
+        for fi in self.functions.values():
+            roots = fi.param_classes
+            if not roots:
+                continue
+            ctx = entry.get(fi.qual, frozenset())
+            for node in ast.walk(fi.node):
+                for recv, attr, loc in self._write_targets(node, roots):
+                    cqual = roots[recv]
+                    cls = self.classes[cqual]
+                    if attr in cls.locks:
+                        continue  # assigning the lock itself
+                    if (isinstance(loc, ast.Call)
+                            and attr not in cls.containers):
+                        continue  # mutator call without container evidence
+                    held = self.lex_tokens(loc, fi) | ctx
+                    tokens = frozenset(a for (cq, a) in held if cq == cqual)
+                    out.append(WriteRec(cqual, attr, loc, fi, fi.module,
+                                        recv, tokens))
+        self._writes = out
+        return out
+
+    @staticmethod
+    def _write_targets(node: ast.AST, roots: dict[str, str]
+                       ) -> Iterator[tuple[str, str, ast.AST]]:
+        """(receiver, attr, report-node) triples for one AST node."""
+        def root_of(e: ast.AST) -> tuple[str, str] | None:
+            for recv in roots:
+                a = receiver_attr(e, recv)
+                if a is None and isinstance(e, (ast.Subscript, ast.Attribute)):
+                    a = receiver_attr_root(e, recv)
+                if a is not None:
+                    return recv, a
+            return None
+
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for e in elts:
+                    got = None
+                    for recv in roots:
+                        a = receiver_attr(e, recv)
+                        if a is None and isinstance(e, ast.Subscript):
+                            a = receiver_attr_root(e, recv)
+                        if a is not None:
+                            got = (recv, a)
+                            break
+                    if got:
+                        yield got[0], got[1], e
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                got = root_of(t)
+                if got:
+                    yield got[0], got[1], t
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in MUTATORS):
+            got = root_of(node.func.value)
+            if got:
+                yield got[0], got[1], node
+
+    def guarded_map(self) -> dict[str, dict[str, tuple[str, int, frozenset[str]]]]:
+        """Per class: attr -> (path and line of first locked write,
+        intersection of lock attrs over all locked writes). Writes in
+        ``__init__`` are exempt (construction happens-before
+        publication)."""
+        out: dict[str, dict[str, tuple[str, int, frozenset[str]]]] = {}
+        for w in self.writes():
+            if not w.tokens or w.func.name == "__init__":
+                continue
+            per = out.setdefault(w.class_qual, {})
+            if w.attr in per:
+                path, line, locks = per[w.attr]
+                per[w.attr] = (path, line, locks & w.tokens)
+            else:
+                per[w.attr] = (w.module.path, w.node.lineno, w.tokens)
+        return out
+
+    # -- lock-order edges (LOCK203 input) ------------------------------------
+
+    def lock_order_edges(self) -> list[tuple[Token, Token, ast.With, Module]]:
+        """Directed acquisition edges (held -> acquired), combining
+        lexical nesting with the any-path may_held context, so an
+        acquisition reached through a call made under a lock still
+        orders after that lock."""
+        may = self.may_held()
+        edges: list[tuple[Token, Token, ast.With, Module]] = []
+        for fi in self.functions.values():
+            if not fi.param_classes:
+                continue
+            ctx = may.get(fi.qual, frozenset())
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.With):
+                    continue
+                prior: set[Token] = set()  # earlier items of this With
+                for item in node.items:
+                    tok = self._with_token(item.context_expr, fi)
+                    if tok is None:
+                        continue
+                    held = self.lex_tokens(node, fi) | ctx | prior
+                    for h in held:
+                        if h != tok:
+                            edges.append((h, tok, node, fi.module))
+                    prior.add(tok)
+        return edges
+
+
+# -- construction helpers ----------------------------------------------------
+
+def module_name_for(path) -> str:
+    """Dotted module name from the filesystem: walk up while the parent
+    directory holds an ``__init__.py``; fall back to the file stem."""
+    import pathlib
+
+    p = pathlib.Path(path).resolve()
+    parts = [p.stem] if p.name != "__init__.py" else []
+    cur = p.parent
+    while (cur / "__init__.py").exists():
+        parts.insert(0, cur.name)
+        cur = cur.parent
+    return ".".join(parts) or p.stem
+
+
+def build_program(modules: Iterable[Module]) -> Program:
+    """Program over already-parsed Modules, keyed by dotted name (path
+    stem collisions fall back to the path so nothing is dropped)."""
+    table: dict[str, Module] = {}
+    for m in modules:
+        name = module_name_for(m.path)
+        if name in table:
+            name = m.path
+        table[name] = m
+    return Program(table)

@@ -62,8 +62,8 @@ class Module:
         for parent in ast.walk(self.tree):
             for child in ast.iter_child_nodes(parent):
                 self.parents[child] = parent
-        self._line_suppress, self._file_suppress = _parse_suppressions(
-            self.lines)
+        (self._line_suppress, self._file_suppress,
+         self._suppress_entries) = _parse_suppressions(self.lines)
 
     def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
         cur = self.parents.get(node)
@@ -92,20 +92,26 @@ def _parse_suppressions(lines: list[str]):
     file suppressions (``disable-file=``) apply module-wide. Rule lists
     are comma-separated; ``all`` matches every rule. Text after two
     spaces (or a second ``#``) is the justification and is ignored.
+
+    Also returns the raw entry list ``[(line, kind, rule), ...]`` so the
+    stale-suppression gate (HYG004) can audit each comment against the
+    findings that actually fired.
     """
     line_map: dict[int, set[str]] = {}
     file_set: set[str] = set()
+    entries: list[tuple[int, str, str]] = []
     for i, text in enumerate(lines, start=1):
         m = _SUPPRESS_RE.search(text)
         if not m:
             continue
         kind, rules_text = m.group(1), m.group(2)
         rules = {r.strip() for r in rules_text.split(",") if r.strip()}
+        entries.extend((i, kind, r) for r in sorted(rules))
         if kind == "disable-file":
             file_set |= rules
         else:
             line_map.setdefault(i, set()).update(rules)
-    return line_map, file_set
+    return line_map, file_set, entries
 
 
 # -- rule registry -----------------------------------------------------------
@@ -123,6 +129,21 @@ class Rule:
     def finding(self, module: Module, node: ast.AST, message: str) -> Finding:
         return Finding(self.id, module.path, getattr(node, "lineno", 1),
                        getattr(node, "col_offset", 0), message)
+
+
+class ProgramRule(Rule):
+    """A whole-program rule: sees every scanned module at once through
+    the call-graph ``Program`` (analysis/callgraph.py) instead of one
+    file. ``scan_source`` wraps a single module in a one-module program,
+    so program rules degrade gracefully to per-file behavior; a
+    multi-file ``scan_paths``/``scan_sources`` run builds the program
+    once and lets lock context and writes cross module boundaries."""
+
+    def check(self, module: Module) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError(f"{self.id} is a program rule")
+
+    def check_program(self, program) -> Iterator[Finding]:  # pragma: no cover
+        raise NotImplementedError
 
 
 REGISTRY: dict[str, Rule] = {}
@@ -144,7 +165,9 @@ def all_rules() -> list[Rule]:
 def _load_builtin_rules() -> None:
     # import for the @register side effect; lazy so core stays importable
     # from rule modules without a cycle
-    from kubeflow_tpu.analysis import rules_jax, rules_lockset  # noqa: F401
+    from kubeflow_tpu.analysis import (  # noqa: F401
+        rules_jax, rules_lockset, rules_order, rules_sharding,
+    )
 
 
 # -- scanning ----------------------------------------------------------------
@@ -161,46 +184,190 @@ def iter_py_files(paths: Iterable[str]) -> Iterator[pathlib.Path]:
             yield p
 
 
+STALE_RULE = "HYG004"  # stale suppression (emitted by full scans)
+
+
+def _sort_key(f: Finding):
+    return (f.path, f.line, f.col, f.rule)
+
+
+def _run_rules(modules: dict[str, Module],
+               rules: Iterable[Rule]) -> list[Finding]:
+    """Raw (pre-suppression) findings from per-file and program rules.
+    The Program is built once over all modules, so lock context and
+    writes cross module boundaries in multi-file scans."""
+    file_rules = [r for r in rules if not isinstance(r, ProgramRule)]
+    prog_rules = [r for r in rules if isinstance(r, ProgramRule)]
+    raw: list[Finding] = []
+    for m in modules.values():
+        for rule in file_rules:
+            raw.extend(rule.check(m))
+    if prog_rules and modules:
+        from kubeflow_tpu.analysis.callgraph import Program  # lazy: no cycle
+        program = Program(modules)
+        for rule in prog_rules:
+            raw.extend(rule.check_program(program))
+    return raw
+
+
+def _comment_lines(source: str) -> set[int]:
+    """Lines whose tpulint marker sits in a real COMMENT token. The
+    suppression *parser* stays line-based (back-compat), but the stale
+    audit must not flag syntax examples quoted inside docstrings."""
+    import io
+    import tokenize
+
+    try:
+        return {t.start[0]
+                for t in tokenize.generate_tokens(io.StringIO(source).readline)
+                if t.type == tokenize.COMMENT and "tpulint:" in t.string}
+    except (tokenize.TokenError, IndentationError):
+        return set()
+
+
+def _stale_findings(module: Module, raw: list[Finding]) -> list[Finding]:
+    """HYG004: suppression comments whose rule id does not exist, or
+    never fires where the comment claims it does. Only meaningful after
+    a full-rule-set scan (`raw` must cover every registered rule)."""
+    from kubeflow_tpu.analysis import hygiene  # lazy: hygiene imports core
+
+    known = set(REGISTRY) | {PARSE_RULE}
+    real = _comment_lines(module.source)
+    out: list[Finding] = []
+    for line, kind, rule in module._suppress_entries:
+        if line not in real:
+            continue  # quoted in a string/docstring, not a live comment
+        if rule in hygiene.HYGIENE_RULES:
+            continue  # hygiene gates run in a separate, unsuppressed pass
+        if rule == "all":
+            if kind == "disable":
+                stale = not any(f.line == line for f in raw)
+                msg = "no rule fires on this line"
+            else:
+                stale = not raw
+                msg = "no rule fires in this module"
+        elif rule not in known:
+            stale = True
+            msg = f"rule '{rule}' does not exist"
+        elif kind == "disable":
+            stale = not any(f.rule == rule and f.line == line for f in raw)
+            msg = f"{rule} does not fire on this line"
+        else:
+            stale = not any(f.rule == rule for f in raw)
+            msg = f"{rule} never fires in this module"
+        if stale:
+            out.append(Finding(STALE_RULE, module.path, line, 0,
+                               f"stale suppression: {msg} — delete the "
+                               "comment or fix the rule id"))
+    return out
+
+
+def _finalize(modules: dict[str, Module], raw: list[Finding],
+              stale: bool) -> list[Finding]:
+    """Apply suppressions; optionally audit the suppressions themselves."""
+    by_path = {m.path: m for m in modules.values()}
+    out = [f for f in raw
+           if f.path not in by_path or not by_path[f.path].suppressed(f)]
+    if stale:
+        for m in modules.values():
+            raw_here = [f for f in raw if f.path == m.path]
+            out.extend(f for f in _stale_findings(m, raw_here)
+                       if not m.suppressed(f))
+    return out
+
+
 def scan_source(path: str, source: str,
                 rules: Iterable[Rule] | None = None) -> list[Finding]:
     """Run rules over one in-memory source (also the test-corpus entry
-    point). Returns unsuppressed findings sorted by position."""
-    if rules is None:
+    point). Returns unsuppressed findings sorted by position. With the
+    default full rule set, stale suppressions (HYG004) are reported
+    too; an explicit `rules` subset skips that audit (a partial run
+    cannot prove a suppression dead)."""
+    full = rules is None
+    if full:
         rules = all_rules()
     try:
         module = Module(path, source)
     except SyntaxError as e:
         return [Finding(PARSE_RULE, path, e.lineno or 1, e.offset or 0,
                         f"file does not parse: {e.msg}")]
-    out: list[Finding] = []
-    for rule in rules:
-        for f in rule.check(module):
-            if not module.suppressed(f):
-                out.append(f)
-    return sorted(out, key=lambda f: (f.path, f.line, f.col, f.rule))
+    from kubeflow_tpu.analysis.callgraph import module_name_for
+    modules = {module_name_for(path): module}
+    raw = _run_rules(modules, rules)
+    return sorted(_finalize(modules, raw, stale=full), key=_sort_key)
+
+
+def scan_sources(sources: dict[str, str],
+                 rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Multi-module corpus entry point: ``{dotted_name: source}``. The
+    names double as import targets, so cross-module fixtures exercise
+    the call-graph rules exactly as a real tree scan would."""
+    full = rules is None
+    if full:
+        rules = all_rules()
+    modules: dict[str, Module] = {}
+    findings: list[Finding] = []
+    for name, src in sources.items():
+        path = name.replace(".", "/") + ".py"
+        try:
+            modules[name] = Module(path, src)
+        except SyntaxError as e:
+            findings.append(Finding(PARSE_RULE, path, e.lineno or 1,
+                                    e.offset or 0,
+                                    f"file does not parse: {e.msg}"))
+    raw = _run_rules(modules, rules)
+    findings.extend(_finalize(modules, raw, stale=full))
+    return sorted(findings, key=_sort_key)
 
 
 def scan_paths(paths: Iterable[str], select: set[str] | None = None,
                ignore: set[str] | None = None) -> list[Finding]:
+    """Scan files/directories as ONE program: per-file rules run per
+    module, program rules (LOCK201/203/204, TPU105/106) run once over
+    the cross-module call graph. select/ignore filter the output (and,
+    when possible, skip running excluded rules)."""
     rules = all_rules()
+    active = rules
     if select:
-        rules = [r for r in rules if r.id in select]
+        active = [r for r in active if r.id in select]
     if ignore:
-        rules = [r for r in rules if r.id not in ignore]
-    if not rules and (not select or PARSE_RULE not in select):
+        active = [r for r in active if r.id not in ignore]
+    full = select is None and ignore is None
+    # the stale-suppression audit needs every rule's raw findings; it
+    # runs on full scans, or when HYG004 is selected explicitly
+    stale = full or (select is not None and STALE_RULE in select)
+    if ignore and STALE_RULE in ignore:
+        stale = False
+    run_rules = rules if stale else active
+    if not run_rules and not stale and (not select
+                                        or PARSE_RULE not in select):
         # nothing to run (e.g. a hygiene-only --select): skip the parse
         # pass entirely instead of AST-ing the tree for zero rules
         return []
+    modules: dict[str, Module] = {}
     findings: list[Finding] = []
+    from kubeflow_tpu.analysis.callgraph import module_name_for
     for f in iter_py_files(paths):
-        findings.extend(scan_source(str(f), f.read_text(), rules))
-    # select/ignore also apply to TPU000 parse findings, which
-    # scan_source emits outside the rules list
+        try:
+            m = Module(str(f), f.read_text())
+        except SyntaxError as e:
+            findings.append(Finding(PARSE_RULE, str(f), e.lineno or 1,
+                                    e.offset or 0,
+                                    f"file does not parse: {e.msg}"))
+            continue
+        name = module_name_for(f)
+        if name in modules:  # stem collision outside a package
+            name = str(f)
+        modules[name] = m
+    raw = _run_rules(modules, run_rules)
+    findings.extend(_finalize(modules, raw, stale=stale))
+    # select/ignore also apply to TPU000 parse findings, which are
+    # emitted outside the rules list
     if select:
         findings = [f for f in findings if f.rule in select]
     if ignore:
         findings = [f for f in findings if f.rule not in ignore]
-    return findings
+    return sorted(findings, key=_sort_key)
 
 
 # -- shared AST helpers ------------------------------------------------------

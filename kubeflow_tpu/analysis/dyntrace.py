@@ -1,0 +1,414 @@
+"""Dynamic happens-before validator: confirm LOCK201's static lockset
+map against what instrumented control-plane classes actually do.
+
+Static analysis says "`Controller._queue` is guarded by `_cv`". This
+module checks that claim at runtime, Eraser-style, while the race tier
+(tests/test_race.py, under ``TPU_RACE_TRACE=1``) hammers the control
+plane in its production threaded mode:
+
+- ``Tracer.instrument(cls)`` wraps the class's ``__setattr__`` to see
+  attribute rebinds, registers every ``threading.Lock``/``RLock``/
+  ``Condition`` assigned to an instance attribute (that is how a lock
+  object gets its *name*), and transparently replaces dict/list values
+  with recording proxies so container mutations — the writes the
+  control plane actually performs (``self._queue[req] = None``) — are
+  observed too.
+- A ``sys.setprofile`` / ``threading.setprofile`` hook watches C-level
+  ``acquire``/``release``/``__enter__``/``__exit__`` (and Condition's
+  ``_release_save``/``_acquire_restore`` around ``wait``) on the
+  registered lock objects, maintaining a per-thread held-lock multiset.
+- Each write is fed to the per-(instance, attr) Eraser state machine:
+  writes stay *exclusive* while a single thread owns the location
+  (creation/``__init__`` happens-before publication, no lock needed);
+  the first write from a second thread moves it to *shared* and from
+  then on the candidate lockset is the intersection of locks held at
+  every write.
+
+``divergences(static_map)`` then compares: for every attribute the
+static map claims is guarded, a shared (multi-thread-written) location
+whose observed lockset misses the claimed lock is a divergence — either
+the static map is wrong or the code has a real race the lint's
+suppression/fixpoint reasoning papered over. Locations never contended
+are vacuously consistent.
+
+Opt-in only: tracing costs a profile hook on every thread; nothing here
+activates unless a Tracer is entered.
+
+Known limit: container proxying replaces an assigned dict/list with a
+recording *copy*, so instrument only classes that assign fresh
+containers (``self._queue = {}``) — mutating a pre-existing alias after
+assigning it to an instrumented attribute would bypass both the
+instance and the recorder. The control-plane classes this validator
+targets follow the fresh-container idiom throughout.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+from typing import Iterable
+
+from kubeflow_tpu.analysis.callgraph import Program, module_name_for
+from kubeflow_tpu.analysis.core import Module
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+_ACQUIRE = {"acquire", "__enter__", "_acquire_restore"}
+_RELEASE = {"release", "__exit__", "_release_save"}
+
+
+def static_guarded_map(paths: Iterable[str]) -> dict[str, dict[str, set[str]]]:
+    """LOCK201's guarded-attribute map for the given source files:
+    ``{ClassName: {attr: {lock attrs}}}`` — the static half of the
+    comparison, built on the same Program the lint rules use."""
+    modules: dict[str, Module] = {}
+    for p in paths:
+        with open(p, "r", encoding="utf-8") as fh:
+            modules[module_name_for(p)] = Module(str(p), fh.read())
+    program = Program(modules)
+    out: dict[str, dict[str, set[str]]] = {}
+    for cqual, per in program.guarded_map().items():
+        name = cqual.split(":")[-1]
+        out.setdefault(name, {}).update(
+            {attr: set(locks) for attr, (_p, _l, locks) in per.items()})
+    return out
+
+
+class _TracedLock:
+    """Delegating Lock/RLock proxy. CPython's ``with`` statement invokes
+    C-level ``__enter__`` without emitting a ``c_call`` profile event
+    (only ``__exit__`` is visible), so bare locks are proxied with
+    Python-level enter/exit that record directly; Condition objects need
+    no proxy because their Python-level methods call the inner RLock's C
+    methods through normal CALLs, which the profile hook does see."""
+
+    def __init__(self, inner, tracer: "Tracer"):
+        self._kftr_inner = inner
+        self._kftr_tracer = tracer
+
+    def acquire(self, *a, **kw):
+        got = self._kftr_inner.acquire(*a, **kw)
+        if got:
+            self._kftr_tracer._bump(id(self), +1)
+        return got
+
+    def release(self):
+        self._kftr_tracer._bump(id(self), -1)
+        self._kftr_inner.release()
+
+    def __enter__(self):
+        self._kftr_inner.acquire()
+        self._kftr_tracer._bump(id(self), +1)
+        return self
+
+    def __exit__(self, *exc):
+        self._kftr_tracer._bump(id(self), -1)
+        self._kftr_inner.release()
+        return False
+
+    def locked(self):
+        return self._kftr_inner.locked()
+
+    def __getattr__(self, name):
+        return getattr(self._kftr_inner, name)
+
+
+class _AttrState:
+    """Eraser state machine for one (instance, attr) location."""
+
+    __slots__ = ("owner_thread", "shared", "lockset", "writes")
+
+    def __init__(self, thread_id: int):
+        self.owner_thread = thread_id
+        self.shared = False
+        self.lockset: frozenset | None = None  # None = top (unrefined)
+        self.writes = 0
+
+    def record(self, thread_id: int, held: frozenset) -> None:
+        self.writes += 1
+        if not self.shared:
+            if thread_id == self.owner_thread:
+                return  # exclusive: creation happens-before publication
+            self.shared = True
+        self.lockset = held if self.lockset is None else self.lockset & held
+
+
+class _TracedDict(dict):
+    """dict recording every mutation against its owning (class, attr)."""
+
+    def _note(self):
+        self._kftr_tracer._record(self._kftr_cls, self._kftr_owner,
+                                  self._kftr_attr)
+
+    def __setitem__(self, k, v):
+        self._note()
+        super().__setitem__(k, v)
+
+    def __delitem__(self, k):
+        self._note()
+        super().__delitem__(k)
+
+    def update(self, *a, **kw):
+        self._note()
+        super().update(*a, **kw)
+
+    def pop(self, *a):
+        self._note()
+        return super().pop(*a)
+
+    def popitem(self):
+        self._note()
+        return super().popitem()
+
+    def clear(self):
+        self._note()
+        super().clear()
+
+    def setdefault(self, *a):
+        self._note()
+        return super().setdefault(*a)
+
+
+class _TracedList(list):
+    def _note(self):
+        self._kftr_tracer._record(self._kftr_cls, self._kftr_owner,
+                                  self._kftr_attr)
+
+    def append(self, x):
+        self._note()
+        super().append(x)
+
+    def extend(self, it):
+        self._note()
+        super().extend(it)
+
+    def insert(self, i, x):
+        self._note()
+        super().insert(i, x)
+
+    def remove(self, x):
+        self._note()
+        super().remove(x)
+
+    def pop(self, *a):
+        self._note()
+        return super().pop(*a)
+
+    def clear(self):
+        self._note()
+        super().clear()
+
+    def __setitem__(self, i, v):
+        self._note()
+        super().__setitem__(i, v)
+
+    def __delitem__(self, i):
+        self._note()
+        super().__delitem__(i)
+
+    def __iadd__(self, other):
+        self._note()
+        return super().__iadd__(other)
+
+    def sort(self, **kw):
+        self._note()
+        super().sort(**kw)
+
+    def reverse(self):
+        self._note()
+        super().reverse()
+
+
+class Tracer:
+    """Record lock acquire/release and attribute writes on instrumented
+    classes; compare the observed locksets with the static map."""
+
+    def __init__(self):
+        self._locks: dict[int, tuple[str, str]] = {}   # id -> (cls, attr)
+        self._states: dict[tuple[int, str, str], _AttrState] = {}
+        self._tls = threading.local()
+        self._saved_setattr: list[tuple[type, object | None]] = []
+        self._mu = threading.Lock()
+        self._prev_profile = None
+        self._active = False
+
+    # -- instrumentation -----------------------------------------------------
+
+    def instrument(self, cls: type) -> None:
+        """Wrap cls.__setattr__ to observe rebinds, discover locks, and
+        proxy container values. Idempotent per Tracer."""
+        if any(c is cls for c, _ in self._saved_setattr):
+            return
+        own = cls.__dict__.get("__setattr__")
+        self._saved_setattr.append((cls, own))
+        orig = cls.__setattr__
+        tracer = self
+
+        def traced_setattr(obj, name, value):
+            value = tracer._on_setattr(cls, obj, name, value)
+            orig(obj, name, value)
+
+        cls.__setattr__ = traced_setattr
+
+    def uninstrument_all(self) -> None:
+        for cls, own in self._saved_setattr:
+            if own is None:
+                try:
+                    del cls.__setattr__
+                except AttributeError:
+                    pass
+            else:
+                cls.__setattr__ = own
+        self._saved_setattr.clear()
+
+    def _on_setattr(self, cls: type, obj, name: str, value):
+        if isinstance(value, _LOCK_TYPES):
+            proxy = _TracedLock(value, self)
+            self._locks[id(proxy)] = (cls.__name__, name)
+            return proxy
+        if isinstance(value, threading.Condition):
+            self._locks[id(value._lock)] = (cls.__name__, name)
+            self._locks[id(value)] = (cls.__name__, name)
+            return value
+        if isinstance(value, (threading.Event, _TracedLock)):
+            return value  # Event.set() is internally synchronized
+        self._record(cls, obj, name)
+        if type(value) is dict:
+            value = self._proxy(_TracedDict(value), cls, obj, name)
+        elif type(value) is list:
+            value = self._proxy(_TracedList(value), cls, obj, name)
+        return value
+
+    def _proxy(self, proxied, cls: type, obj, name: str):
+        # plain attributes (not slots): proxies carry their identity
+        proxied._kftr_tracer = self
+        proxied._kftr_cls = cls
+        proxied._kftr_owner = obj
+        proxied._kftr_attr = name
+        return proxied
+
+    # -- the write stream ----------------------------------------------------
+
+    def _bump(self, key: int, delta: int) -> None:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = {}
+        held[key] = max(held.get(key, 0) + delta, 0)
+
+    def _held_tokens(self) -> frozenset:
+        held = getattr(self._tls, "held", None)
+        if not held:
+            return frozenset()
+        return frozenset(self._locks[k] for k, n in held.items()
+                         if n > 0 and k in self._locks)
+
+    def _record(self, cls: type, obj, attr: str) -> None:
+        if not self._active:
+            return
+        key = (id(obj), cls.__name__, attr)
+        tid = threading.get_ident()
+        held = self._held_tokens()
+        # locks of *this* class guard its attrs; a foreign lock held by
+        # coincidence must not count as protection
+        held_here = frozenset(a for (c, a) in held if c == cls.__name__)
+        with self._mu:
+            st = self._states.get(key)
+            if st is None:
+                st = self._states[key] = _AttrState(tid)
+            st.record(tid, held_here)
+
+    # -- lock event stream (sys.setprofile) ----------------------------------
+
+    def _profile(self, frame, event, arg):
+        if event not in ("c_call", "c_return"):
+            return
+        try:
+            sobj = getattr(arg, "__self__", None)
+            if sobj is None or id(sobj) not in self._locks:
+                return
+            name = getattr(arg, "__name__", "")
+            held = getattr(self._tls, "held", None)
+            if held is None:
+                held = self._tls.held = {}
+            key = id(sobj)
+            if name in _ACQUIRE and event == "c_return":
+                if name == "_acquire_restore":
+                    held[key] = getattr(self._tls, "saved", {}).pop(key, 1)
+                else:
+                    held[key] = held.get(key, 0) + 1
+            elif name in _RELEASE and event == "c_call":
+                if name == "_release_save":
+                    saved = getattr(self._tls, "saved", None)
+                    if saved is None:
+                        saved = self._tls.saved = {}
+                    saved[key] = held.get(key, 0)
+                    held[key] = 0
+                else:
+                    held[key] = max(held.get(key, 0) - 1, 0)
+        except Exception:  # a raising profile hook silently uninstalls
+            pass
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def __enter__(self) -> "Tracer":
+        self._prev_profile = sys.getprofile()
+        self._prev_thread_profile = threading.getprofile()
+        self._active = True
+        threading.setprofile(self._profile)  # new threads
+        sys.setprofile(self._profile)        # this thread
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self._active = False
+        sys.setprofile(self._prev_profile)
+        threading.setprofile(self._prev_thread_profile)
+        self.uninstrument_all()
+
+    # -- results -------------------------------------------------------------
+
+    def observed(self) -> dict[tuple[str, str], dict]:
+        """Aggregate per (class, attr): shared?, final lockset (the
+        intersection across all shared instances), write count."""
+        out: dict[tuple[str, str], dict] = {}
+        with self._mu:
+            states = dict(self._states)
+        for (_oid, cls, attr), st in states.items():
+            agg = out.setdefault((cls, attr), {
+                "shared": False, "lockset": None, "writes": 0})
+            agg["writes"] += st.writes
+            if st.shared:
+                agg["shared"] = True
+                ls = st.lockset if st.lockset is not None else frozenset()
+                agg["lockset"] = (ls if agg["lockset"] is None
+                                  else agg["lockset"] & ls)
+        return out
+
+    def divergences(self, static_map: dict[str, dict[str, set[str]]]
+                    ) -> list[str]:
+        """Statically-guarded attrs whose observed (shared) lockset does
+        not contain the claimed lock. Empty = static and dynamic agree."""
+        out = []
+        for (cls, attr), rec in sorted(self.observed().items()):
+            want = static_map.get(cls, {}).get(attr)
+            if not want or not rec["shared"]:
+                continue
+            got = set(rec["lockset"] or frozenset())
+            if not (want & got):
+                out.append(
+                    f"{cls}.{attr}: static map says guarded by "
+                    f"{sorted(want)}, but {rec['writes']} observed writes "
+                    f"hold only {sorted(got)}")
+        return out
+
+    def confirmed(self, static_map: dict[str, dict[str, set[str]]]
+                  ) -> list[str]:
+        """Statically-guarded attrs the dynamic run actually contended
+        and confirmed — the positive half of the cross-check."""
+        out = []
+        for (cls, attr), rec in sorted(self.observed().items()):
+            want = static_map.get(cls, {}).get(attr)
+            if not want or not rec["shared"]:
+                continue
+            got = set(rec["lockset"] or frozenset())
+            if want & got:
+                out.append(f"{cls}.{attr}")
+        return out

@@ -20,6 +20,10 @@ HYGIENE_RULES = {
     "HYG001": "file does not parse",
     "HYG002": "debugger hook (breakpoint/set_trace)",
     "HYG003": "merge conflict marker",
+    # HYG004 is emitted by core.scan_source/scan_paths full scans (it
+    # audits tpulint suppressions against the findings that actually
+    # fired), but is listed here so --list-rules and --select know it
+    "HYG004": "stale tpulint suppression (rule gone or never fires)",
 }
 
 # split so the strings never match this file itself

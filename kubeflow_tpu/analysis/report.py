@@ -1,8 +1,14 @@
-"""tpulint reporters: human text and machine JSON.
+"""tpulint reporters: human text, machine JSON, SARIF, and the baseline
+ratchet.
 
 The JSON schema is versioned so round tooling (tools/lint_all.sh, CI
 dashboards) can consume it without scraping: ``{"version": 1,
 "count": N, "findings": [{rule, path, line, col, message}, ...]}``.
+
+SARIF 2.1.0 output (``--format sarif``) lets CI upload findings as
+code-scanning artifacts; the baseline helpers implement the ratchet —
+``tools/lint_baseline.json`` pins today's findings, and a diff run
+fails only on *new* ones, so a rule can tighten without a flag-day.
 """
 
 from __future__ import annotations
@@ -14,6 +20,8 @@ from typing import Iterable
 from kubeflow_tpu.analysis.core import Finding
 
 JSON_VERSION = 1
+BASELINE_VERSION = 1
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
 
 
 def render_text(findings: Iterable[Finding]) -> str:
@@ -37,3 +45,78 @@ def render_json(findings: Iterable[Finding]) -> str:
         "count": len(findings),
         "findings": [f.to_dict() for f in findings],
     }, indent=2, sort_keys=True)
+
+
+def _rule_meta(rule_id: str) -> str:
+    """Short description for SARIF rule metadata (registry or hygiene)."""
+    from kubeflow_tpu.analysis import hygiene
+    from kubeflow_tpu.analysis.core import PARSE_RULE, REGISTRY, all_rules
+
+    all_rules()  # ensure builtins are registered
+    if rule_id in REGISTRY:
+        return REGISTRY[rule_id].short
+    if rule_id == PARSE_RULE:
+        return "file does not parse"
+    return hygiene.HYGIENE_RULES.get(rule_id, "")
+
+
+def render_sarif(findings: Iterable[Finding]) -> str:
+    """SARIF 2.1.0: one run, tool 'tpulint', result per finding. The
+    shape GitHub code scanning (and most SARIF viewers) ingest."""
+    findings = list(findings)
+    rule_ids = sorted({f.rule for f in findings})
+    return json.dumps({
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "tpulint",
+                "informationUri": "docs/static-analysis.md",
+                "rules": [{"id": rid,
+                           "shortDescription": {"text": _rule_meta(rid)}}
+                          for rid in rule_ids],
+            }},
+            "results": [{
+                "ruleId": f.rule,
+                "level": "warning",
+                "message": {"text": f.message},
+                "locations": [{"physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1},
+                }}],
+            } for f in findings],
+        }],
+    }, indent=2, sort_keys=True)
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+def finding_key(f: Finding) -> tuple:
+    return (f.rule, f.path, f.line, f.message)
+
+
+def render_baseline(findings: Iterable[Finding]) -> str:
+    keys = sorted(list(finding_key(f)) for f in findings)
+    return json.dumps({"version": BASELINE_VERSION, "findings": keys},
+                      indent=2) + "\n"
+
+
+def load_baseline(text: str) -> Counter:
+    doc = json.loads(text)
+    return Counter(tuple(k) for k in doc.get("findings", []))
+
+
+def new_findings(findings: Iterable[Finding], baseline: Counter
+                 ) -> list[Finding]:
+    """Findings not covered by the baseline (multiset semantics: two
+    identical findings need two baseline entries)."""
+    budget = Counter(baseline)
+    out = []
+    for f in findings:
+        k = finding_key(f)
+        if budget[k] > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out

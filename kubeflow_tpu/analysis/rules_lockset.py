@@ -1,15 +1,27 @@
-"""tpulint lockset/concurrency rules (LOCK2xx) for the control plane.
+"""tpulint lockset/concurrency rules (LOCK201/202) for the control plane.
 
 LOCK201 is an Eraser-style lockset checker specialized to the idiom
 this tree actually uses (SURVEY.md §5: hand-rolled mutexes): each class
 declares ``self._lock = threading.Lock()`` and guards state with
 ``with self._lock:`` blocks. The rule learns, per class, which
-``self.*`` attributes are mutated under which lock, then flags
-mutations of those same attributes outside any lock. Private helpers
-that are only ever *called* with the lock held (``_became`` under
-``try_acquire`` in control/leases.py) are recognized via a small
-intra-class call-graph fixpoint, so the checker does not force every
-helper to re-acquire.
+attributes are mutated under which lock, then flags mutations of those
+same attributes outside any lock.
+
+Since PR 2 the rule runs on the whole-program call graph
+(analysis/callgraph.py) instead of one class at a time:
+
+- private helpers whose every call site holds the lock — in the same
+  class (``LeaderElector._became`` under ``try_acquire``), in another
+  class, or in another *module* — are recognized via the program-wide
+  locked-entry fixpoint, so a lock taken in ``control/runtime.py``
+  still vouches for a helper reached through ``control/leases.py``;
+- writes through parameters of a known class (``def seed(c:
+  Controller): c._queue[k] = v``, or ``self`` passed along) are
+  attributed to that class and checked against its guarded map.
+
+Mutator calls (``.append``/``.update``/...) count as writes only for
+attributes with container evidence, so ``self.client.update(obj)`` (a
+k8s API call) never registers as a mutation of ``self.client``.
 
 LOCK202 keeps reconcile bodies non-blocking: a sleeping reconcile stalls
 the shared workqueue worker for every object behind it — the correct
@@ -19,210 +31,17 @@ spelling is ``Result(requeue_after=...)``.
 from __future__ import annotations
 
 import ast
-import dataclasses
-import re
 from typing import Iterator
 
+from kubeflow_tpu.analysis.callgraph import Program
 from kubeflow_tpu.analysis.core import (
-    Finding, Module, Rule, call_name, register,
+    Finding, Module, ProgramRule, Rule, call_name, register,
 )
-
-_LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
-               "Lock", "RLock", "Condition"}
-# `with self.X:` counts as lock evidence only for lock-ish names — the
-# tree also uses `with self.mesh:` (a jax Mesh activation), which must
-# not be mistaken for a mutex
-_LOCKISH = re.compile(r"lock|mutex|cond|(^|_)(mu|cv)$")
-_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popitem",
-             "clear", "update", "setdefault", "add", "discard"}
-# mutator calls count as writes only for attributes with container
-# evidence (assigned a dict/list/set in the class) — otherwise
-# `self.client.update(obj)` (a k8s API call) would register as a
-# mutation of self.client
-_CONTAINER_CTORS = {"dict", "list", "set", "collections.defaultdict",
-                    "defaultdict", "collections.OrderedDict", "OrderedDict",
-                    "collections.deque", "deque", "queue.Queue", "Queue"}
-
-
-def _self_attr(node: ast.AST) -> str | None:
-    """'X' when node is the attribute access ``self.X``."""
-    if (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name) and node.value.id == "self"):
-        return node.attr
-    return None
-
-
-def _self_attr_root(node: ast.AST) -> str | None:
-    """Root ``self.X`` of a chain like ``self.X[k]`` / ``self.X.y[k]``."""
-    while isinstance(node, (ast.Subscript, ast.Attribute)):
-        got = _self_attr(node)
-        if got is not None:
-            return got
-        node = node.value
-    return None
-
-
-@dataclasses.dataclass(frozen=True)
-class _Write:
-    attr: str
-    node: ast.AST          # location to report
-    method: ast.FunctionDef
-    locked: bool           # lexically inside a `with self.<lock>` block
-
-
-class _ClassModel:
-    """Per-class facts LOCK201 needs: locks, writes, call graph."""
-
-    def __init__(self, module: Module, cls: ast.ClassDef):
-        self.module = module
-        self.cls = cls
-        self.methods = [n for n in cls.body if isinstance(n, ast.FunctionDef)]
-        self.locks = self._find_locks()
-        self.containers = self._find_containers()
-        self.writes = [w for m in self.methods for w in self._writes_in(m)]
-        self.locked_context = self._locked_context_methods()
-
-    # -- lock discovery ------------------------------------------------------
-
-    def _find_locks(self) -> set[str]:
-        locks: set[str] = set()
-        for node in ast.walk(self.cls):
-            if isinstance(node, ast.With):
-                for item in node.items:
-                    attr = _self_attr(item.context_expr)
-                    if attr is not None and _LOCKISH.search(attr):
-                        locks.add(attr)
-            elif isinstance(node, ast.Assign):
-                if (isinstance(node.value, ast.Call)
-                        and call_name(node.value) in _LOCK_CTORS):
-                    for t in node.targets:
-                        attr = _self_attr(t)
-                        if attr is not None:
-                            locks.add(attr)
-        return locks
-
-    def _find_containers(self) -> set[str]:
-        """Attributes assigned a dict/list/set anywhere in the class."""
-        out: set[str] = set()
-        for node in ast.walk(self.cls):
-            if not isinstance(node, (ast.Assign, ast.AnnAssign)):
-                continue
-            value = node.value
-            is_container = isinstance(
-                value, (ast.Dict, ast.List, ast.Set, ast.DictComp,
-                        ast.ListComp, ast.SetComp)) or (
-                isinstance(value, ast.Call)
-                and call_name(value) in _CONTAINER_CTORS)
-            if not is_container:
-                continue
-            targets = (node.targets if isinstance(node, ast.Assign)
-                       else [node.target])
-            for t in targets:
-                attr = _self_attr(t)
-                if attr is not None:
-                    out.add(attr)
-        return out
-
-    def _lexically_locked(self, node: ast.AST, method: ast.FunctionDef) -> bool:
-        """Inside a `with self.<lock>` in this method? A nested def breaks
-        the chain: its body runs at call time, not necessarily under the
-        lexically-enclosing with."""
-        for anc in self.module.ancestors(node):
-            if isinstance(anc, ast.With):
-                for item in anc.items:
-                    if _self_attr(item.context_expr) in self.locks:
-                        return True
-            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return False  # reached `method` or a nested def first
-        return False
-
-    # -- write extraction ----------------------------------------------------
-
-    def _writes_in(self, method: ast.FunctionDef) -> Iterator[_Write]:
-        for node in ast.walk(method):
-            attrs: list[tuple[str, ast.AST]] = []
-            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-                targets = (node.targets if isinstance(node, ast.Assign)
-                           else [node.target])
-                for t in targets:
-                    elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
-                    for e in elts:
-                        a = _self_attr(e)
-                        if a is None and isinstance(e, ast.Subscript):
-                            a = _self_attr_root(e)
-                        if a is not None:
-                            attrs.append((a, e))
-            elif isinstance(node, ast.Delete):
-                for t in node.targets:
-                    a = _self_attr_root(t)
-                    if a is not None:
-                        attrs.append((a, t))
-            elif (isinstance(node, ast.Call)
-                    and isinstance(node.func, ast.Attribute)
-                    and node.func.attr in _MUTATORS):
-                a = _self_attr_root(node.func.value)
-                if a is not None and a in self.containers:
-                    attrs.append((a, node))
-            for attr, loc in attrs:
-                if attr in self.locks:
-                    continue  # assigning the lock itself
-                yield _Write(attr, loc, method,
-                             self._lexically_locked(loc, method))
-
-    # -- call-graph fixpoint -------------------------------------------------
-
-    def _locked_context_methods(self) -> set[str]:
-        """Private methods whose every intra-class call site holds the
-        lock (directly, or transitively via another locked-context
-        caller). Two passes: a greatest fixpoint evicts anything with a
-        provably-unlocked call site (which keeps recursive helper cycles
-        like FakeCluster's _delete_now <-> _gc_orphans, whose internal
-        edges are only reachable under the lock), then an entry-point
-        pass drops cycles NO locked call site ever enters — otherwise
-        two mutually-recursive helpers called from nowhere locked would
-        vouch for each other."""
-        sites: dict[str, list[tuple[ast.AST, ast.FunctionDef]]] = {}
-        for method in self.methods:
-            for node in ast.walk(method):
-                if isinstance(node, ast.Call):
-                    callee = _self_attr(node.func)
-                    if callee is not None:
-                        sites.setdefault(callee, []).append((node, method))
-        known = {m.name for m in self.methods}
-        candidates = {name for name in sites
-                      if name in known and name.startswith("_")
-                      and not name.startswith("__")}
-        changed = True
-        while changed:
-            changed = False
-            for name in sorted(candidates):
-                ok = all(
-                    self._lexically_locked(call, enclosing)
-                    or enclosing.name in candidates
-                    for call, enclosing in sites[name])
-                if not ok:
-                    candidates.discard(name)
-                    changed = True
-        entered = {name for name in candidates
-                   if any(self._lexically_locked(call, enclosing)
-                          for call, enclosing in sites[name])}
-        changed = True
-        while changed:
-            changed = False
-            for name in sorted(candidates - entered):
-                if any(enclosing.name in entered
-                       for _, enclosing in sites[name]):
-                    entered.add(name)
-                    changed = True
-        return entered
-
-    def _write_is_locked(self, w: _Write) -> bool:
-        return w.locked or w.method.name in self.locked_context
 
 
 @register
-class UnguardedAttribute(Rule):
-    """LOCK201: attribute mutated under a lock in one method and without
+class UnguardedAttribute(ProgramRule):
+    """LOCK201: attribute mutated under a lock in one place and without
     it in another — the torn-state/lost-update class the race tier
     (tests/test_race.py) probes dynamically, caught statically."""
 
@@ -230,25 +49,24 @@ class UnguardedAttribute(Rule):
     name = "unguarded-attribute"
     short = "lock-guarded attribute mutated without the lock"
 
-    def check(self, module: Module) -> Iterator[Finding]:
-        for cls in ast.walk(module.tree):
-            if not isinstance(cls, ast.ClassDef):
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        guarded = program.guarded_map()
+        for w in program.writes():
+            per = guarded.get(w.class_qual)
+            if per is None or w.attr not in per:
                 continue
-            model = _ClassModel(module, cls)
-            if not model.locks:
+            if w.tokens or w.func.name == "__init__":
                 continue
-            guarded: dict[str, int] = {}
-            for w in model.writes:
-                if model._write_is_locked(w) and w.method.name != "__init__":
-                    guarded.setdefault(w.attr, w.node.lineno)
-            for w in model.writes:
-                if (w.attr in guarded and not model._write_is_locked(w)
-                        and w.method.name != "__init__"):
-                    yield self.finding(
-                        module, w.node,
-                        f"'self.{w.attr}' is mutated under a lock at line "
-                        f"{guarded[w.attr]} but mutated here "
-                        f"(in '{cls.name}.{w.method.name}') without it")
+            cls_name = w.class_qual.split(":")[-1]
+            where = (f"{cls_name}.{w.func.name}" if w.func.owner is not None
+                     else w.func.name)
+            locked_path, locked_line, _ = per[w.attr]
+            at = (f"line {locked_line}" if locked_path == w.module.path
+                  else f"{locked_path}:{locked_line}")
+            yield self.finding(
+                w.module, w.node,
+                f"'{w.recv}.{w.attr}' is mutated under a lock at {at} "
+                f"but mutated here (in '{where}') without it")
 
 
 @register

@@ -1,0 +1,220 @@
+"""tpulint deadlock & atomicity rules (LOCK203/LOCK204) — whole-program.
+
+LOCK203 builds the program-wide lock-acquisition-order graph: every
+``with <recv>.<lock>:`` acquisition is an edge from each lock that may
+already be held at that point (lexically nested withs, plus the any-path
+``may_held`` call-graph context, so an acquisition reached through a
+call made under a lock still orders after that lock). A cycle in that
+graph — ``_cv`` then ``_lock`` on one path, ``_lock`` then ``_cv`` on
+another, across classes or modules — is the classic ABBA deadlock the
+control plane's threaded mode (watch + worker threads + elector) could
+only hit probabilistically at runtime.
+
+LOCK204 is the check-then-act (TOCTOU) atomicity rule: a guarded
+attribute read *outside* any lock in an ``if``/``while`` test, followed
+by a locked write of that same attribute inside the branch. Between the
+unlocked check and the locked act another thread may have changed the
+state, so the decision is stale. The accepted idiom — re-checking the
+condition once the lock is held (double-checked locking) — is
+recognized and stays quiet.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeflow_tpu.analysis.callgraph import Program, Token, receiver_attr
+from kubeflow_tpu.analysis.core import Finding, ProgramRule, register
+
+
+def _token_str(t: Token) -> str:
+    return f"{t[0].split(':')[-1]}.{t[1]}"
+
+
+def _own_walk(node: ast.AST) -> Iterator[ast.AST]:
+    """Walk without descending into nested function/lambda defs: their
+    bodies run at call time, not in this branch (mirrors the lock-
+    context rule in Program.lex_tokens)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        yield cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and cur is not node:
+            continue
+        stack.extend(ast.iter_child_nodes(cur))
+
+
+def _sccs(adj: dict[Token, set[Token]]) -> list[list[Token]]:
+    """Tarjan's strongly-connected components, iterative (no recursion
+    limit risk on long chains). Returns components of size >= 2."""
+    index: dict[Token, int] = {}
+    low: dict[Token, int] = {}
+    on_stack: set[Token] = set()
+    stack: list[Token] = []
+    out: list[list[Token]] = []
+    counter = [0]
+
+    for root in sorted(adj):
+        if root in index:
+            continue
+        work: list[tuple[Token, list[Token], int]] = [
+            (root, sorted(adj.get(root, ())), 0)]
+        while work:
+            node, succs, i = work.pop()
+            if i == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recurse = False
+            while i < len(succs):
+                s = succs[i]
+                i += 1
+                if s not in index:
+                    work.append((node, succs, i))
+                    work.append((s, sorted(adj.get(s, ())), 0))
+                    recurse = True
+                    break
+                if s in on_stack:
+                    low[node] = min(low[node], index[s])
+            if recurse:
+                continue
+            if low[node] == index[node]:
+                comp: list[Token] = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                if len(comp) >= 2:
+                    out.append(sorted(comp))
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+    return out
+
+
+@register
+class LockOrderCycle(ProgramRule):
+    """LOCK203: two locks acquired in opposite orders on different
+    program paths — a potential ABBA deadlock under the threaded
+    controller mode."""
+
+    id = "LOCK203"
+    name = "lock-order-cycle"
+    short = "locks acquired in opposite orders on different paths"
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        edges = program.lock_order_edges()
+        adj: dict[Token, set[Token]] = {}
+        for held, acquired, _node, _module in edges:
+            adj.setdefault(held, set()).add(acquired)
+            adj.setdefault(acquired, set())
+        for comp in _sccs(adj):
+            members = set(comp)
+            cycle = " -> ".join(_token_str(t) for t in comp)
+            for held, acquired, node, module in edges:
+                if held in members and acquired in members:
+                    yield Finding(
+                        self.id, module.path, node.lineno, node.col_offset,
+                        f"'{_token_str(acquired)}' acquired while holding "
+                        f"'{_token_str(held)}', but another path acquires "
+                        f"them in the opposite order (cycle: {cycle}) — "
+                        "potential deadlock; pick one global order")
+
+
+@register
+class CheckThenAct(ProgramRule):
+    """LOCK204: unlocked read of a guarded attribute deciding a locked
+    write of that attribute — the decision is stale by the time the
+    lock arrives. Re-check under the lock (double-checked locking)."""
+
+    id = "LOCK204"
+    name = "check-then-act"
+    short = "guarded attribute checked without the lock, then written under it"
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        guarded = program.guarded_map()
+        entry = program.locked_entry()
+        for fi in program.functions.values():
+            if not fi.param_classes:
+                continue
+            ctx = entry.get(fi.qual, frozenset())
+            for node in ast.walk(fi.node):
+                if not isinstance(node, (ast.If, ast.While)):
+                    continue
+                yield from self._check_branch(program, fi, ctx, node, guarded)
+
+    def _check_branch(self, program: Program, fi, ctx, node,
+                      guarded) -> Iterator[Finding]:
+        # reads of guarded attrs in the test, per receiver class
+        read: list[tuple[str, str, str]] = []  # (recv, class_qual, attr)
+        for sub in ast.walk(node.test):
+            if not (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.ctx, ast.Load)):
+                continue
+            if not isinstance(sub.value, ast.Name):
+                continue
+            recv = sub.value.id
+            cqual = fi.param_classes.get(recv)
+            if cqual is None:
+                continue
+            if sub.attr in guarded.get(cqual, ()):
+                read.append((recv, cqual, sub.attr))
+        if not read:
+            return
+        # the check itself must be unlocked (lexically and by entry
+        # context) for the class whose attr it reads
+        held = program.lex_tokens(node, fi) | ctx
+        for recv, cqual, attr in read:
+            if any(cq == cqual for cq, _ in held):
+                continue
+            for w in _own_walk(node):
+                if not (isinstance(w, ast.With)
+                        and self._acquires(program, fi, w, cqual)):
+                    continue
+                if self._rechecks(w, recv, attr):
+                    continue  # double-checked locking: the real idiom
+                if self._writes_attr(program, w, recv, attr):
+                    yield Finding(
+                        self.id, fi.module.path, node.test.lineno,
+                        node.test.col_offset,
+                        f"'{recv}.{attr}' is read here without its lock, "
+                        "then written under the lock inside this branch — "
+                        "the check is stale by the time the lock is held; "
+                        "re-check under the lock (double-checked locking) "
+                        "or widen the locked region")
+                    break
+
+    @staticmethod
+    def _acquires(program: Program, fi, with_node: ast.With,
+                  cqual: str) -> bool:
+        return any((tok := program._with_token(item.context_expr, fi))
+                   is not None and tok[0] == cqual
+                   for item in with_node.items)
+
+    @staticmethod
+    def _rechecks(with_node: ast.With, recv: str, attr: str) -> bool:
+        """A re-read of recv.attr in a test/assert inside the locked
+        region means the decision is re-made under the lock."""
+        for sub in _own_walk(with_node):
+            if isinstance(sub, (ast.If, ast.While, ast.IfExp, ast.Assert)):
+                test = sub.test
+                for n in ast.walk(test):
+                    if (receiver_attr(n, recv) == attr
+                            and isinstance(getattr(n, "ctx", None), ast.Load)):
+                        return True
+        return False
+
+    @staticmethod
+    def _writes_attr(program: Program, with_node: ast.With, recv: str,
+                     attr: str) -> bool:
+        roots = {recv: ""}
+        for sub in _own_walk(with_node):
+            for r, a, _loc in Program._write_targets(sub, roots):
+                if r == recv and a == attr:
+                    return True
+        return False

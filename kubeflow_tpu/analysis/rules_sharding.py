@@ -1,0 +1,272 @@
+"""tpulint sharding-consistency rules (TPU105/TPU106) — whole-program.
+
+A mesh-axis typo is the cheapest way to ship a silently wrong sharding:
+``jax.jit(..., in_shardings=NamedSharding(mesh, P("modle")))`` raises
+only at run time on a real slice (or, worse, replicates where it should
+shard). Both rules resolve the mesh-axis vocabulary *statically* from
+the program slice being scanned:
+
+- every ``Mesh(devices, (...axes...))`` constructor whose axis-name
+  tuple resolves through module-level constants (including constants
+  imported from other scanned modules, e.g. ``_AXIS_ORDER`` in
+  ``parallel/mesh.py``), and
+- the canonical axis vocabulary of ``kubeflow_tpu/parallel/mesh.py``
+  whenever a module imports from it (so per-file scans of modules built
+  on the shared helpers are still checked).
+
+TPU105 flags ``jax.jit``/``pjit`` ``in_shardings``/``out_shardings``
+whose PartitionSpec axis names are not in that vocabulary; TPU106 flags
+any other ``NamedSharding(mesh, P(...))`` construction that drifts from
+it. With no resolvable Mesh and no mesh-helper import the rules stay
+silent, a module whose own Mesh constructor does not resolve is
+skipped (its true vocabulary is unknowable), and unresolvable axis
+expressions inside specs are skipped — the rules never guess.
+
+The canonical tuple below mirrors ``parallel/mesh.py:_AXIS_ORDER``;
+tests/test_tpulint.py pins the two in sync by parsing the source (this
+package must not import jax).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeflow_tpu.analysis.callgraph import Program
+from kubeflow_tpu.analysis.core import (
+    Finding, ProgramRule, call_name, dotted, register,
+)
+from kubeflow_tpu.analysis.rules_jax import _JITS
+
+# mirror of kubeflow_tpu/parallel/mesh.py axis vocabulary (AST-pinned in
+# tests; analysis must stay importable without jax)
+CANONICAL_AXES = ("dcn", "data", "fsdp", "pipe", "expert", "seq", "model")
+_MESH_HELPER_MODULE = "kubeflow_tpu.parallel.mesh"
+
+_MESH_CTORS = {"Mesh", "jax.sharding.Mesh", "sharding.Mesh",
+               "maps.Mesh", "jax.experimental.maps.Mesh"}
+_SPEC_CTORS = {"P", "PartitionSpec", "jax.sharding.PartitionSpec",
+               "sharding.PartitionSpec"}
+_NAMED_SHARDING = {"NamedSharding", "jax.sharding.NamedSharding",
+                   "sharding.NamedSharding"}
+_SHARDING_KWARGS = ("in_shardings", "out_shardings")
+
+
+def _module_consts(module) -> dict[str, ast.expr]:
+    """Top-level simple-name assignments (the constant table)."""
+    out: dict[str, ast.expr] = {}
+    for node in module.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if isinstance(node.target, ast.Name):
+                out[node.target.id] = node.value
+    return out
+
+
+class _AxisResolver:
+    """Resolve axis-name expressions to strings through module-level
+    constants, following from-imports into other scanned modules."""
+
+    def __init__(self, program: Program):
+        self.program = program
+        self._consts = {name: _module_consts(m)
+                        for name, m in program.modules.items()}
+
+    def resolve(self, modname: str, expr: ast.expr,
+                depth: int = 4) -> tuple[list[str], bool]:
+        """(axis names, fully_resolved). Nested tuples flatten; None
+        entries (replicated dims) are fine and contribute nothing."""
+        if depth <= 0:
+            return [], False
+        if isinstance(expr, ast.Constant):
+            if expr.value is None:
+                return [], True
+            if isinstance(expr.value, str):
+                return [expr.value], True
+            return [], False
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            axes: list[str] = []
+            complete = True
+            for e in expr.elts:
+                got, ok = self.resolve(modname, e, depth)
+                axes.extend(got)
+                complete &= ok
+            return axes, complete
+        if isinstance(expr, (ast.Name, ast.Attribute)):
+            return self._resolve_name(modname, expr, depth)
+        return [], False
+
+    def _resolve_name(self, modname: str, expr: ast.expr,
+                      depth: int) -> tuple[list[str], bool]:
+        name = dotted(expr)
+        if not name:
+            return [], False
+        # local constant
+        if name in self._consts.get(modname, {}):
+            return self.resolve(modname, self._consts[modname][name],
+                                depth - 1)
+        table = self.program.imports.get(modname, {})
+        head, _, rest = name.partition(".")
+        got = table.get(name) or table.get(head)
+        if got is None:
+            return [], False
+        if got[0] == "sym" and not rest:
+            _, target, sym = got
+            if target in self.program.modules:
+                if sym in self._consts.get(target, {}):
+                    return self.resolve(target, self._consts[target][sym],
+                                        depth - 1)
+            return [], False
+        if got[0] == "mod" and rest and "." not in rest:
+            target = got[1]
+            if target in self.program.modules and \
+                    rest in self._consts.get(target, {}):
+                return self.resolve(target, self._consts[target][rest],
+                                    depth - 1)
+        return [], False
+
+
+def _mesh_vocabulary(program: Program,
+                     resolver: _AxisResolver) -> tuple[set[str], set[str]]:
+    """(axis vocabulary, unreliable modules).
+
+    The vocabulary is the union of every *resolved* Mesh constructor's
+    axes across the program. A module whose own Mesh constructor does
+    NOT fully resolve (runtime-built axis names) is listed unreliable:
+    flagging specs in *that* module against the partial vocabulary
+    would invent false positives, so it is skipped — but a fully
+    resolved module elsewhere in the program is still checked."""
+    vocab: set[str] = set()
+    unreliable: set[str] = set()
+    found: set[str] = set()
+    for modname, module in program.modules.items():
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if call_name(node) not in _MESH_CTORS:
+                continue
+            axes_expr = None
+            if len(node.args) >= 2:
+                axes_expr = node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "axis_names":
+                    axes_expr = kw.value
+            if axes_expr is None:
+                continue
+            found.add(modname)
+            axes, ok = resolver.resolve(modname, axes_expr)
+            vocab.update(axes)
+            if not ok:
+                unreliable.add(modname)
+        # modules built on the shared mesh helpers get the canonical
+        # vocabulary even when parallel/mesh.py isn't in this scan
+        for target in program.imports.get(modname, {}).values():
+            if target[1] == _MESH_HELPER_MODULE or (
+                    target[0] == "sym"
+                    and target[1].endswith("parallel.mesh")):
+                vocab.update(CANONICAL_AXES)
+                found.add(modname)
+    if not found:
+        return set(), set()  # no mesh evidence anywhere: never guess
+    return vocab, unreliable
+
+
+def _axis_strings(resolver: _AxisResolver, modname: str,
+                  call: ast.Call) -> Iterator[tuple[str, ast.expr]]:
+    """Axis-name strings mentioned in a P(...)/PartitionSpec(...) call
+    (literals and fully-resolved constants only)."""
+    for arg in call.args:
+        axes, ok = resolver.resolve(modname, arg)
+        if ok:
+            for a in axes:
+                yield a, arg
+
+
+class _ShardingRule(ProgramRule):
+    """Shared machinery: walk spec constructions, compare to vocab."""
+
+    def _spec_calls(self, expr: ast.expr) -> Iterator[ast.Call]:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and call_name(node) in _SPEC_CTORS:
+                yield node
+
+    def _jit_sharding_kwargs(self, module) -> Iterator[ast.expr]:
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and call_name(node) in _JITS:
+                for kw in node.keywords:
+                    if kw.arg in _SHARDING_KWARGS:
+                        yield kw.value
+
+
+@register
+class JitShardingAxisDrift(_ShardingRule):
+    """TPU105: in_shardings/out_shardings name a mesh axis the program
+    slice's Mesh does not define."""
+
+    id = "TPU105"
+    name = "jit-sharding-axis-drift"
+    short = "jit in_/out_shardings reference an axis missing from the mesh"
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        resolver = _AxisResolver(program)
+        vocab, unreliable = _mesh_vocabulary(program, resolver)
+        if not vocab:
+            return
+        for modname, module in program.modules.items():
+            if modname in unreliable:
+                continue  # this module's own mesh didn't resolve
+            for kwval in self._jit_sharding_kwargs(module):
+                for spec in self._spec_calls(kwval):
+                    for axis, node in _axis_strings(resolver, modname, spec):
+                        if axis not in vocab:
+                            yield Finding(
+                                self.id, module.path, node.lineno,
+                                node.col_offset,
+                                f"sharding axis '{axis}' is not an axis of "
+                                "any Mesh in this program slice "
+                                f"(known: {', '.join(sorted(vocab))}) — "
+                                "the jit will fail at call time or "
+                                "silently replicate")
+
+
+@register
+class NamedShardingAxisDrift(_ShardingRule):
+    """TPU106: a NamedSharding built from a PartitionSpec whose axis
+    names drift from the mesh vocabulary (parallel/mesh.py helpers)."""
+
+    id = "TPU106"
+    name = "namedsharding-axis-drift"
+    short = "NamedSharding spec names an axis missing from the mesh"
+
+    def check_program(self, program: Program) -> Iterator[Finding]:
+        resolver = _AxisResolver(program)
+        vocab, unreliable = _mesh_vocabulary(program, resolver)
+        if not vocab:
+            return
+        for modname, module in program.modules.items():
+            if modname in unreliable:
+                continue  # this module's own mesh didn't resolve
+            # TPU105 owns anything inside a jit sharding kwarg
+            claimed: set[int] = set()
+            for kwval in self._jit_sharding_kwargs(module):
+                claimed.update(id(n) for n in ast.walk(kwval))
+            for node in ast.walk(module.tree):
+                if not (isinstance(node, ast.Call)
+                        and call_name(node) in _NAMED_SHARDING
+                        and id(node) not in claimed):
+                    continue
+                for spec in self._spec_calls(node):
+                    for axis, sub in _axis_strings(resolver, modname, spec):
+                        if axis not in vocab:
+                            yield Finding(
+                                self.id, module.path, sub.lineno,
+                                sub.col_offset,
+                                f"NamedSharding spec names axis '{axis}', "
+                                "which no Mesh in this program slice "
+                                f"defines (known: "
+                                f"{', '.join(sorted(vocab))}) — axis names "
+                                "must come from parallel/mesh.py's "
+                                "vocabulary")

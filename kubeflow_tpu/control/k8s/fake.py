@@ -201,7 +201,40 @@ class FakeCluster:
             m.setdefault("generation", 1)
             self._store[key] = obj
             self._notify("ADDED", obj)
+            self._gc_if_orphaned(key)
             return ob.deep_copy(obj)
+
+    def _gc_if_orphaned(self, key: Key) -> None:
+        """Reap a just-created child whose owner died between the
+        reconciler's read and this create (the check-then-act window the
+        race tier's happens-before tracer exposed): the kube garbage
+        collector deletes dependents with dangling owner uids on its
+        next sync, so without this the fake leaks orphans forever."""
+        obj = self._store.get(key)
+        if obj is None:
+            return
+        m = ob.meta(obj)
+        refs = m.get("ownerReferences") or []
+        if not refs:
+            return
+        live = {ob.meta(o).get("uid") for o in self._store.values()}
+        keep = [r for r in refs if not r.get("uid") or r["uid"] in live]
+        if len(keep) == len(refs):
+            return
+        if keep:
+            # prune dangling refs only — with the rv bump + MODIFIED
+            # every other mutation path performs, or a watcher's cache
+            # could resurrect the dangling ref through update()
+            m["ownerReferences"] = keep
+            m["resourceVersion"] = self._next_rv()
+            self._notify("MODIFIED", obj)
+        elif m.get("finalizers"):
+            m.pop("ownerReferences", None)
+            m["deletionTimestamp"] = m.get("deletionTimestamp") or ob.now_iso()
+            m["resourceVersion"] = self._next_rv()
+            self._notify("MODIFIED", obj)
+        else:
+            self._delete_now(key)
 
     def get(self, api_version: str, kind: str, name: str, namespace: str | None = None) -> dict:
         with self._lock:

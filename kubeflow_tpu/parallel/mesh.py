@@ -55,6 +55,13 @@ AXIS_MODEL = "model"
 _AXIS_ORDER = (AXIS_DCN, AXIS_DATA, AXIS_FSDP, AXIS_PIPELINE, AXIS_EXPERT,
                AXIS_SEQ, AXIS_MODEL)
 
+# The canonical axis vocabulary, public. tpulint's sharding-consistency
+# rules (TPU105/TPU106, kubeflow_tpu/analysis/rules_sharding.py) resolve
+# every PartitionSpec axis name against this tuple — a new axis must be
+# added here (the lint's mirror is AST-pinned to _AXIS_ORDER in
+# tests/test_tpulint.py) before any spec may name it.
+AXIS_NAMES: tuple[str, ...] = _AXIS_ORDER
+
 # Every batch-sharded PartitionSpec uses this tuple; size-1 axes are free,
 # so single-slice meshes pay nothing for carrying the dcn name.
 # `expert` is a batch axis too (GShard-style): outside MoE layers the

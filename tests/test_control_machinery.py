@@ -144,6 +144,62 @@ class TestFakeCluster:
         assert c.get_or_none("v1", "Pod", "j-worker-0", "default") is None
         assert c.get_or_none("v1", "Service", "j", "default") is None
 
+    def test_create_after_owner_delete_is_garbage_collected(self):
+        """The reconcile-vs-delete window the happens-before tracer
+        exposed: a child created with an ownerReference to an
+        already-deleted owner must be reaped immediately (kube GC
+        semantics), with watchers seeing ADDED then DELETED."""
+        c = FakeCluster()
+        job = c.create(ob.new_object("kubeflow.org/v1alpha1", "JAXJob", "j",
+                                     "default", spec={}))
+        stream = c.watch("v1", "Pod", "default")
+        c.delete("kubeflow.org/v1alpha1", "JAXJob", "j", "default")
+        pod = make_pod("j-worker-0")
+        ob.set_owner(pod, job)
+        c.create(pod)
+        assert c.get_or_none("v1", "Pod", "j-worker-0", "default") is None
+        seen = []
+        while True:
+            ev = stream.poll()
+            if ev is None:
+                break
+            seen.append(ev.type)
+        assert seen == ["ADDED", "DELETED"]
+
+    def test_dangling_owner_ref_pruned_with_rv_bump_and_event(self):
+        """Partial prune (one live owner, one dangling) must keep the
+        child but bump resourceVersion and emit MODIFIED like every
+        other mutation path, or watcher caches go stale forever."""
+        c = FakeCluster()
+        live = c.create(ob.new_object("v1", "ConfigMap", "live", "default"))
+        dead = c.create(ob.new_object("v1", "ConfigMap", "dead", "default"))
+        c.delete("v1", "ConfigMap", "dead", "default")
+        stream = c.watch("v1", "Secret", "default")
+        child = ob.new_object("v1", "Secret", "kid", "default")
+        ob.meta(child)["ownerReferences"] = [
+            {"uid": ob.meta(live)["uid"], "kind": "ConfigMap",
+             "name": "live"},
+            {"uid": ob.meta(dead)["uid"], "kind": "ConfigMap",
+             "name": "dead"},
+        ]
+        c.create(child)
+        got = c.get("v1", "Secret", "kid", "default")
+        refs = [r["name"] for r in ob.meta(got)["ownerReferences"]]
+        assert refs == ["live"]
+        seen = []
+        while True:
+            ev = stream.poll()
+            if ev is None:
+                break
+            seen.append(ev)
+        assert [ev.type for ev in seen] == ["ADDED", "MODIFIED"]
+        # the prune bumped the rv past the ADDED event's, so a watcher
+        # cache rebuilt from the stream can never resurrect 'dead'
+        assert int(ob.meta(seen[1].object)["resourceVersion"]) > int(
+            ob.meta(seen[0].object)["resourceVersion"])
+        assert [r["name"] for r in
+                ob.meta(seen[1].object)["ownerReferences"]] == ["live"]
+
     def test_watch_stream(self):
         c = FakeCluster()
         w = c.watch("v1", "Pod", namespace="default")

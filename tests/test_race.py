@@ -24,6 +24,55 @@ from kubeflow_tpu.control.k8s.fake import FakeCluster
 RACE_THREADS = int(os.environ.get("TPU_RACE_THREADS", "8"))
 RACE_ITERS = int(os.environ.get("TPU_RACE_ITERS", "30"))
 
+# Happens-before validator (ISSUE 2): with TPU_RACE_TRACE=1 the whole
+# tier runs under analysis/dyntrace.py instrumentation of the
+# control-plane classes, and at teardown the observed locksets are
+# diffed against LOCK201's static guarded-attribute map — static says
+# Controller._queue is guarded by _cv; dynamic confirms or fails.
+RACE_TRACE = os.environ.get("TPU_RACE_TRACE") == "1"
+
+_TRACER = None
+
+
+def _static_lockset_map():
+    import pathlib
+
+    from kubeflow_tpu.analysis.dyntrace import static_guarded_map
+
+    control = pathlib.Path(__file__).resolve().parent.parent / \
+        "kubeflow_tpu" / "control"
+    return static_guarded_map([str(control / "runtime.py"),
+                               str(control / "leases.py")])
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _dyntrace_tier():
+    """Instrument Controller + LeaderElector for every race test when
+    TPU_RACE_TRACE=1; assert static/dynamic lockset agreement at module
+    teardown so the whole tier cross-checks the map on every run."""
+    global _TRACER
+    if not RACE_TRACE:
+        yield
+        return
+    from kubeflow_tpu.analysis.dyntrace import Tracer
+    from kubeflow_tpu.control.leases import LeaderElector
+    from kubeflow_tpu.control.runtime import Controller
+
+    tr = Tracer()
+    tr.instrument(Controller)
+    tr.instrument(LeaderElector)
+    _TRACER = tr
+    try:
+        with tr:
+            yield
+    finally:
+        tr.uninstrument_all()
+        _TRACER = None
+    divergences = tr.divergences(_static_lockset_map())
+    assert not divergences, (
+        "dynamic locksets diverged from LOCK201's static map:\n"
+        + "\n".join(divergences))
+
 
 def test_fakecluster_concurrent_crud_consistency():
     c = FakeCluster()
@@ -290,3 +339,50 @@ def test_paginated_list_under_concurrent_churn():
     finally:
         stop.set()
         t.join()
+
+
+@pytest.mark.dyntrace
+@pytest.mark.skipif(not RACE_TRACE,
+                    reason="happens-before validator: set TPU_RACE_TRACE=1")
+def test_dyntrace_observed_lockset_agrees_with_static_map():
+    """The ISSUE 2 acceptance check: drive an instrumented Controller in
+    production threaded mode until its queue state is genuinely
+    contended (multiple threads writing), then require that the
+    dynamically observed locksets agree with LOCK201's static
+    guarded-attribute map for control/runtime.py — and that the
+    agreement is non-vacuous (the guarded attrs were actually hit)."""
+    from kubeflow_tpu.control.notebook import types as NT
+    from kubeflow_tpu.control.notebook.controller import build_controller
+
+    static = _static_lockset_map()
+    # pin the static half so a lint regression can't hollow out the test
+    assert static["Controller"]["_queue"] == {"_cv"}
+    assert static["Controller"]["_delayed"] == {"_cv"}
+    assert static["Controller"]["_failures"] == {"_cv"}
+    assert static["LeaderElector"]["_held"] == {"_lock"}
+
+    c = FakeCluster()
+    ctl = build_controller(c)
+    ctl.run(workers=3)
+    try:
+        names = [f"tr-{i}" for i in range(10)]
+        for n in names:
+            c.create(NT.new_notebook(n, "ns", image="img:1",
+                                     cpu="0.1", memory="128Mi"))
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            sts = {s["metadata"]["name"]
+                   for s in c.list("apps/v1", "StatefulSet", namespace="ns")}
+            if sts == set(names):
+                break
+            time.sleep(0.05)
+        assert sts == set(names)
+    finally:
+        ctl.stop()
+
+    observed = _TRACER.observed()
+    rec = observed[("Controller", "_queue")]
+    assert rec["shared"], "scenario never contended _queue: vacuous run"
+    confirmed = _TRACER.confirmed(static)
+    assert "Controller._queue" in confirmed, confirmed
+    assert _TRACER.divergences(static) == []

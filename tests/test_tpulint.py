@@ -21,6 +21,7 @@ import textwrap
 import pytest
 
 from kubeflow_tpu.analysis import all_rules, render_json, render_text, scan_source
+from kubeflow_tpu.analysis.core import scan_sources
 from kubeflow_tpu.analysis.__main__ import main as tpulint_main
 from kubeflow_tpu.analysis import hygiene
 
@@ -157,6 +158,75 @@ class NodeReconciler:
         time.sleep(5.0)
         return None
 """, 6),
+    ],
+    "LOCK203": [
+        # ABBA: _cv then _lock on one path, the reverse on another
+        ("""\
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def a(self):
+        with self._cv:
+            with self._lock:
+                pass
+
+    def b(self):
+        with self._lock:
+            with self._cv:
+                pass
+""", 11),
+    ],
+    "LOCK204": [
+        # classic check-then-act: unlocked read decides a locked write
+        ("""\
+import threading
+
+
+class Flag:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def arm(self):
+        with self._lock:
+            self.ready = True
+
+    def ensure(self):
+        if not self.ready:
+            with self._lock:
+                self.ready = True
+""", 14),
+    ],
+    "TPU105": [
+        # jit sharding kwarg names an axis the Mesh doesn't define
+        ("""\
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make(devices, fn):
+    mesh = Mesh(devices, ("data", "model"))
+    return jax.jit(fn, in_shardings=NamedSharding(mesh, P("modle")))
+""", 7),
+    ],
+    "TPU106": [
+        # NamedSharding spec drifts from the mesh axis vocabulary,
+        # resolved through a module-level constant
+        ("""\
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "model")
+
+
+def shardings(devices):
+    mesh = Mesh(devices, AXES)
+    return NamedSharding(mesh, P("fsdp"))
+""", 8),
     ],
 }
 
@@ -373,6 +443,131 @@ class Result:
         self.requeue_after = requeue_after
 """,
     ],
+    "LOCK203": [
+        # consistent global order (always _cv before _lock): no cycle
+        """\
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+
+    def a(self):
+        with self._cv:
+            with self._lock:
+                pass
+
+    def b(self):
+        with self._cv:
+            with self._lock:
+                pass
+""",
+        # re-acquiring the SAME lock through a helper is the locked-
+        # context idiom, not an order cycle
+        """\
+import threading
+
+
+class Solo:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0
+
+    def bump(self):
+        with self._lock:
+            self._apply()
+
+    def _apply(self):
+        self.n += 1
+""",
+    ],
+    "LOCK204": [
+        # double-checked locking: the decision is re-made under the lock
+        """\
+import threading
+
+
+class Flag:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def arm(self):
+        with self._lock:
+            self.ready = True
+
+    def ensure(self):
+        if not self.ready:
+            with self._lock:
+                if not self.ready:
+                    self.ready = True
+""",
+        # check already under the lock (leases.py try_acquire idiom)
+        """\
+import threading
+
+
+class Flag:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def arm(self):
+        with self._lock:
+            self.ready = True
+
+    def ensure(self):
+        with self._lock:
+            if not self.ready:
+                self.ready = True
+""",
+    ],
+    "TPU105": [
+        # axis present in the Mesh built in the same slice
+        """\
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make(devices, fn):
+    mesh = Mesh(devices, ("data", "model"))
+    return jax.jit(fn, in_shardings=NamedSharding(mesh, P("model")))
+""",
+        # no Mesh constructed in the slice: the rule must not guess
+        """\
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def make(mesh, fn):
+    return jax.jit(fn, in_shardings=NamedSharding(mesh, P("rows")))
+""",
+    ],
+    "TPU106": [
+        # tuple axes and None dims within the vocabulary stay quiet
+        """\
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("data", "model")
+
+
+def shardings(devices):
+    mesh = Mesh(devices, AXES)
+    return NamedSharding(mesh, P(("data", "model"), None))
+""",
+        # unresolvable axis names (runtime values) are skipped, not
+        # flagged
+        """\
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def shardings(devices, axis):
+    mesh = Mesh(devices, ("data", "model"))
+    return NamedSharding(mesh, P(axis))
+""",
+    ],
 }
 
 
@@ -407,9 +602,9 @@ def test_clean_fragment_stays_clean(rule, src):
     assert not findings, [f.render() for f in findings]
 
 
-def test_at_least_six_rules_each_with_both_cases():
+def test_at_least_ten_rules_each_with_both_cases():
     ids = {r.id for r in all_rules()}
-    assert len(ids) >= 6, ids
+    assert len(ids) >= 10, ids
     assert ids == set(BAD) == set(CLEAN), (
         "every registered rule needs a firing AND a non-firing corpus case")
 
@@ -431,7 +626,8 @@ def test_line_suppression_silences_only_named_rule():
         comment="  # tpulint: disable=LOCK202  corpus justification")
     assert _scan(src) == []
     wrong = _SUPPRESSIBLE.format(comment="  # tpulint: disable=TPU101")
-    assert [f.rule for f in _scan(wrong)] == ["LOCK202"]
+    # LOCK202 still fires; the TPU101 suppression is itself stale
+    assert [f.rule for f in _scan(wrong)] == ["HYG004", "LOCK202"]
 
 
 def test_line_suppression_all():
@@ -458,6 +654,297 @@ def test_parse_error_is_reported_not_raised():
     assert [f.rule for f in findings] == ["TPU000"]
 
 
+# -- stale suppressions (HYG004) ---------------------------------------------
+
+def test_stale_suppression_unknown_rule_fires():
+    src = "x = 1  # tpulint: disable=LOCK999  long-gone rule\n"
+    findings = _scan(src)
+    assert [f.rule for f in findings] == ["HYG004"]
+    assert "LOCK999" in findings[0].message and findings[0].line == 1
+
+
+def test_stale_suppression_rule_never_fires_on_line():
+    src = "x = 1  # tpulint: disable=LOCK202  nothing blocks here\n"
+    assert [f.rule for f in _scan(src)] == ["HYG004"]
+
+
+def test_stale_file_suppression_fires():
+    src = ("# tpulint: disable-file=LOCK202  no reconciles in this module\n"
+           "x = 1\n")
+    findings = _scan(src)
+    assert [f.rule for f in findings] == ["HYG004"]
+    assert "never fires in this module" in findings[0].message
+
+
+def test_live_suppression_is_not_stale():
+    src = _SUPPRESSIBLE.format(
+        comment="  # tpulint: disable=LOCK202  corpus justification")
+    assert _scan(src) == []
+
+
+def test_suppression_quoted_in_docstring_is_not_stale():
+    src = '"""Suppress with ``# tpulint: disable=LOCK202  why``."""\n'
+    assert _scan(src) == []
+
+
+def test_stale_suppression_only_on_full_scans():
+    """A partial rule run cannot prove a suppression dead."""
+    rules = [r for r in all_rules() if r.id == "LOCK202"]
+    src = "x = 1  # tpulint: disable=TPU101  stale on purpose\n"
+    assert scan_source("<corpus>", src, rules) == []
+
+
+def test_hyg004_is_itself_suppressible():
+    src = ("x = 1  # tpulint: disable=LOCK999,HYG004  "
+           "kept for a vendored checkout\n")
+    assert _scan(src) == []
+
+
+# -- whole-program: cross-module call graph ----------------------------------
+
+_REGISTRY_MOD = """\
+import threading
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.jobs[k] = v
+"""
+
+
+def test_lock201_sees_writes_through_annotated_params_cross_module():
+    findings = scan_sources({
+        "reg": _REGISTRY_MOD,
+        "helpers": """\
+from reg import Registry
+
+
+def prune(r: Registry):
+    r.jobs.clear()
+""",
+    })
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("LOCK201", "helpers.py", 5)]
+    assert "'r.jobs'" in findings[0].message
+    assert "reg.py:11" in findings[0].message  # names the locked site
+
+
+def test_lock201_locked_context_crosses_modules():
+    """A private helper in another module whose only call site holds the
+    lock must not be forced to re-acquire (the cross-module analogue of
+    the leases.py _became idiom)."""
+    findings = scan_sources({
+        "reg2": """\
+import threading
+
+from helpers2 import _prune_locked
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.jobs[k] = v
+
+    def gc(self):
+        with self._lock:
+            _prune_locked(self)
+""",
+        "helpers2": """\
+from reg2 import Registry
+
+
+def _prune_locked(r: Registry):
+    r.jobs.pop("dead", None)
+""",
+    })
+    assert findings == []
+
+
+def test_lock201_unlocked_cross_module_entry_defeats_helper():
+    """Same helper, but a second call site WITHOUT the lock: the helper
+    can no longer be assumed locked, so its write is flagged."""
+    findings = scan_sources({
+        "reg3": """\
+import threading
+
+from helpers3 import _prune
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.jobs = {}
+
+    def add(self, k, v):
+        with self._lock:
+            self.jobs[k] = v
+
+    def gc(self):
+        with self._lock:
+            _prune(self)
+
+    def gc_unlocked(self):
+        _prune(self)
+""",
+        "helpers3": """\
+from reg3 import Registry
+
+
+def _prune(r: Registry):
+    r.jobs.pop("dead", None)
+""",
+    })
+    assert [(f.rule, f.path, f.line) for f in findings] == [
+        ("LOCK201", "helpers3.py", 5)]
+
+
+def test_lock203_cycle_across_classes_and_modules():
+    """_cv-then-_lock through a cross-module call on one path and
+    _lock-then-_cv on the other: the ABBA cycle spans both files."""
+    findings = scan_sources({
+        "eng": """\
+import threading
+
+from gate import Gate
+
+
+class Engine:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self.gate = Gate()
+
+    def tick(self):
+        with self._cv:
+            self.gate.open_()
+
+    def flush(self):
+        with self._cv:
+            pass
+""",
+        "gate": """\
+import threading
+
+from eng import Engine
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def open_(self):
+        with self._lock:
+            pass
+
+    def shut(self, eng: Engine):
+        with self._lock:
+            eng.flush()
+""",
+    })
+    by_rule = [f for f in findings if f.rule == "LOCK203"]
+    assert {f.path for f in by_rule} == {"eng.py", "gate.py"}
+    assert any("Engine._cv" in f.message and "Gate._lock" in f.message
+               for f in by_rule)
+
+
+def test_tpu106_canonical_vocabulary_from_mesh_helper_import():
+    """A module importing parallel/mesh helpers is checked against the
+    canonical axis vocabulary even with no Mesh ctor in the scan."""
+    findings = scan_sources({
+        "layers": """\
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeflow_tpu.parallel.mesh import AXIS_MODEL
+
+
+def shard(mesh):
+    good = NamedSharding(mesh, P(AXIS_MODEL))
+    bad = NamedSharding(mesh, P("tensor"))
+    return good, bad
+""",
+    })
+    assert [(f.rule, f.line) for f in findings] == [("TPU106", 8)]
+    assert "'tensor'" in findings[0].message
+
+
+def test_unresolvable_mesh_elsewhere_does_not_silence_resolved_module():
+    """A runtime-built Mesh in one module must skip only THAT module,
+    not turn the sharding rules off for the whole program."""
+    findings = scan_sources({
+        "dyn": """\
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make(devices, axes):
+    mesh = Mesh(devices, axes)
+    return NamedSharding(mesh, P("whatever"))
+""",
+        "fixed": """\
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def make(devices):
+    mesh = Mesh(devices, ("data", "model"))
+    return NamedSharding(mesh, P("tpyo"))
+""",
+    })
+    assert [(f.rule, f.path) for f in findings] == [("TPU106", "fixed.py")]
+
+
+def test_lock204_quiet_for_write_inside_nested_def():
+    """Defining a closure performs no write: the locked write inside a
+    nested def runs at call time, so there is no check-then-act window
+    at the branch (mirrors lex_tokens' nested-def rule)."""
+    src = """\
+import threading
+
+
+class Flag:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.ready = False
+
+    def arm(self):
+        with self._lock:
+            self.ready = True
+
+    def maker(self):
+        if not self.ready:
+            def later():
+                with self._lock:
+                    self.ready = True
+            return later
+        return None
+"""
+    assert [f.rule for f in _scan(src) if f.rule == "LOCK204"] == []
+
+
+def test_canonical_axes_mirror_parallel_mesh():
+    """rules_sharding hardcodes the axis vocabulary (analysis must not
+    import jax); pin it to parallel/mesh.py's _AXIS_ORDER by AST."""
+    from kubeflow_tpu.analysis.rules_sharding import CANONICAL_AXES
+
+    src = (PACKAGE / "parallel" / "mesh.py").read_text()
+    tree = ast.parse(src)
+    consts = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.targets[0], ast.Name):
+            consts[node.targets[0].id] = node.value
+    order = consts["_AXIS_ORDER"]
+    axes = tuple(
+        consts[e.id].value if isinstance(e, ast.Name) else e.value
+        for e in order.elts)
+    assert axes == CANONICAL_AXES
+
+
 # -- reporters ---------------------------------------------------------------
 
 def test_json_reporter_schema():
@@ -475,6 +962,49 @@ def test_text_reporter_mentions_rule_and_location():
     text = render_text([f])
     assert "LOCK202" in text and f":{f.line}:" in text
     assert render_text([]) == "tpulint: clean"
+
+
+def test_sarif_reporter_schema():
+    from kubeflow_tpu.analysis.report import render_sarif
+
+    findings = _scan(BAD["LOCK202"][0][0])
+    doc = json.loads(render_sarif(findings))
+    assert doc["version"] == "2.1.0" and "sarif-2.1.0" in doc["$schema"]
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "tpulint"
+    rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    assert "LOCK202" in rules
+    assert rules["LOCK202"]["shortDescription"]["text"]
+    res = run["results"][0]
+    assert res["ruleId"] == "LOCK202" and res["level"] == "warning"
+    loc = res["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "<corpus>"
+    # SARIF columns are 1-based; tpulint cols are 0-based
+    assert loc["region"]["startLine"] == findings[0].line
+    assert loc["region"]["startColumn"] == findings[0].col + 1
+
+
+def test_sarif_empty_run_is_valid():
+    from kubeflow_tpu.analysis.report import render_sarif
+
+    doc = json.loads(render_sarif([]))
+    assert doc["runs"][0]["results"] == []
+
+
+# -- baseline ratchet --------------------------------------------------------
+
+def test_baseline_roundtrip_and_new_finding_detection():
+    from kubeflow_tpu.analysis.report import (
+        load_baseline, new_findings, render_baseline,
+    )
+
+    old = _scan(BAD["LOCK202"][0][0])
+    baseline = load_baseline(render_baseline(old))
+    assert new_findings(old, baseline) == []
+    extra = _scan(BAD["TPU104"][0][0])
+    assert new_findings(old + extra, baseline) == extra
+    # multiset semantics: a second identical finding is NEW
+    assert new_findings(old + old, baseline) == old
 
 
 # -- CLI ---------------------------------------------------------------------
@@ -495,6 +1025,45 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert doc["findings"][0]["rule"] == "TPU104"
 
 
+def test_cli_rules_alias_and_format(tmp_path, capsys):
+    """--rules is an alias for --select; --format sarif/json both work."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD["TPU104"][0][0]))
+    assert tpulint_main(["--rules", "LOCK202", str(bad)]) == 0
+    capsys.readouterr()
+    assert tpulint_main(["--rules", "TPU104", str(bad)]) == 1
+    capsys.readouterr()
+    assert tpulint_main(["--format", "sarif", str(bad)]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["runs"][0]["results"][0]["ruleId"] == "TPU104"
+
+
+def test_cli_baseline_ratchet(tmp_path, capsys):
+    """--write-baseline pins today's findings; --baseline fails only on
+    NEW findings (ratchet, not flag-day)."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent(BAD["TPU104"][0][0]))
+    base = tmp_path / "baseline.json"
+    assert tpulint_main(["--write-baseline", str(base), str(bad)]) == 0
+    doc = json.loads(base.read_text())
+    assert doc["version"] == 1 and len(doc["findings"]) == 1
+    capsys.readouterr()
+    # unchanged tree: ratchet passes despite the pre-existing finding
+    assert tpulint_main(["--baseline", str(base), str(bad)]) == 0
+    capsys.readouterr()
+    # a new finding appears: ratchet fails and reports ONLY the new one
+    worse = tmp_path / "worse.py"
+    worse.write_text(textwrap.dedent(BAD["LOCK202"][0][0]))
+    assert tpulint_main(["--baseline", str(base), str(bad),
+                         str(worse)]) == 1
+    out = capsys.readouterr().out
+    assert "LOCK202" in out and "TPU104" not in out
+    # a missing baseline is a usage error, not a silent pass
+    assert tpulint_main(["--baseline", str(tmp_path / "nope.json"),
+                         str(bad)]) == 2
+    capsys.readouterr()
+
+
 def test_cli_selecting_hygiene_rule_implies_hygiene_pass(tmp_path, capsys):
     """--select HYG002 without --hygiene must still run the hygiene
     pass (not silently scan nothing and exit 0)."""
@@ -507,7 +1076,7 @@ def test_cli_selecting_hygiene_rule_implies_hygiene_pass(tmp_path, capsys):
 def test_cli_list_rules(capsys):
     assert tpulint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
-    for rid in list(BAD) + ["HYG001", "HYG002", "HYG003"]:
+    for rid in list(BAD) + ["HYG001", "HYG002", "HYG003", "HYG004"]:
         assert rid in out
 
 
@@ -576,3 +1145,163 @@ def test_suppressions_in_tree_carry_justification():
             justification = line[m.end():].strip().strip("#").strip()
             assert justification, (
                 f"{path}:{i}: suppression without justification text")
+
+
+def test_whole_program_scan_of_tree_is_clean():
+    """The cross-module pass (LOCK201 with call-graph context, LOCK203,
+    LOCK204, TPU105, TPU106, HYG004) over the package as ONE program —
+    per-file cleanliness above does not imply this."""
+    from kubeflow_tpu.analysis import scan_paths
+
+    findings = scan_paths([str(PACKAGE)])
+    assert not findings, "\n".join(f.render() for f in findings)
+
+
+def test_cli_new_rules_run_clean_on_tree(capsys):
+    """The ISSUE 2 acceptance command, pinned."""
+    assert tpulint_main(["--rules", "LOCK203,LOCK204,TPU105,TPU106",
+                         str(PACKAGE)]) == 0
+    capsys.readouterr()
+
+
+def test_program_guarded_map_for_control_runtime():
+    """The static lockset map the dynamic validator diffs against:
+    Controller's queue state is guarded by _cv, the elector's flags by
+    _lock. If this pins differently, dyntrace comparisons are vacuous."""
+    from kubeflow_tpu.analysis.dyntrace import static_guarded_map
+
+    static = static_guarded_map([
+        str(PACKAGE / "control" / "runtime.py"),
+        str(PACKAGE / "control" / "leases.py"),
+    ])
+    ctl = static["Controller"]
+    assert ctl["_queue"] == {"_cv"}
+    assert ctl["_delayed"] == {"_cv"}
+    assert ctl["_failures"] == {"_cv"}
+    assert static["LeaderElector"]["_held"] == {"_lock"}
+
+
+# -- dyntrace: the happens-before validator (unit level; the race tier
+#    wires it against the real controllers behind TPU_RACE_TRACE=1) ----------
+
+def _run_threads(*fns):
+    import threading as _t
+
+    ts = [_t.Thread(target=f) for f in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+
+def test_dyntrace_confirms_locked_class_and_flags_unlocked_one():
+    import threading
+
+    from kubeflow_tpu.analysis.dyntrace import Tracer
+
+    class Good:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.jobs = {}
+
+        def add(self, k):
+            with self._lock:
+                self.jobs[k] = 1
+
+    class Bad:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.jobs = {}
+
+        def add(self, k):
+            self.jobs[k] = 1  # no lock: the race LOCK201 catches
+
+    static = {"Good": {"jobs": {"_lock"}}, "Bad": {"jobs": {"_lock"}}}
+    tr = Tracer()
+    tr.instrument(Good)
+    tr.instrument(Bad)
+    try:
+        with tr:
+            g, b = Good(), Bad()
+            _run_threads(lambda: [g.add(f"a{i}") for i in range(50)],
+                         lambda: [g.add(f"b{i}") for i in range(50)])
+            _run_threads(lambda: [b.add(f"a{i}") for i in range(50)],
+                         lambda: [b.add(f"b{i}") for i in range(50)])
+    finally:
+        tr.uninstrument_all()
+    assert tr.confirmed(static) == ["Good.jobs"]
+    div = tr.divergences(static)
+    assert len(div) == 1 and div[0].startswith("Bad.jobs")
+
+
+def test_dyntrace_exclusive_thread_writes_are_vacuous():
+    """Writes from a single thread (construction, test-mode drains)
+    never refine the lockset — happens-before, not lock discipline."""
+    import threading
+
+    from kubeflow_tpu.analysis.dyntrace import Tracer
+
+    class Solo:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+
+        def push(self, x):
+            self.items.append(x)  # single-threaded by construction
+
+    tr = Tracer()
+    tr.instrument(Solo)
+    try:
+        with tr:
+            s = Solo()
+            for i in range(10):
+                s.push(i)
+    finally:
+        tr.uninstrument_all()
+    assert tr.divergences({"Solo": {"items": {"_lock"}}}) == []
+    rec = tr.observed()[("Solo", "items")]
+    assert rec["shared"] is False and rec["writes"] >= 10
+
+
+def test_dyntrace_condition_and_rebind_tracking():
+    """Condition locks (the Controller._cv shape) and attribute rebinds
+    are tracked, including across cv.wait()'s release/reacquire."""
+    import threading
+
+    from kubeflow_tpu.analysis.dyntrace import Tracer
+
+    class Q:
+        def __init__(self):
+            self._cv = threading.Condition()
+            self.pending = []
+            self.sealed = False
+
+        def put(self, x):
+            with self._cv:
+                self.pending.append(x)
+                self._cv.notify_all()
+
+        def drain(self):
+            with self._cv:
+                if not self.pending:
+                    self._cv.wait(timeout=0.05)
+                self.pending = []  # rebind under the lock
+
+        def seal(self):
+            self.sealed = True  # rebind WITHOUT the lock
+
+    static = {"Q": {"pending": {"_cv"}, "sealed": {"_cv"}}}
+    tr = Tracer()
+    tr.instrument(Q)
+    try:
+        with tr:
+            q = Q()
+            _run_threads(lambda: [q.put(i) for i in range(30)],
+                         lambda: [q.drain() for _ in range(30)],
+                         lambda: [q.seal() for _ in range(30)],
+                         lambda: [q.seal() for _ in range(30)])
+    finally:
+        tr.uninstrument_all()
+    assert tr.confirmed(static) == ["Q.pending"]
+    div = tr.divergences(static)
+    assert len(div) == 1 and div[0].startswith("Q.sealed")

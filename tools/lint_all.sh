@@ -1,23 +1,73 @@
 #!/usr/bin/env bash
 # The full static-analysis gate, pytest-free (ISSUE 1 satellite): run
-# tpulint (JAX/TPU + lockset rules) over the package and round tooling,
-# plus the stdlib hygiene gates (parse / debugger hooks / conflict
-# markers, yaml manifests) over everything that ships — tests and
-# examples ride only the hygiene gates, mirroring the pytest lint tier.
-# Exits nonzero on any finding, so a round driver can gate on it:
+# tpulint (JAX/TPU + lockset/deadlock/sharding rules, whole-program)
+# over the package and round tooling, plus the stdlib hygiene gates
+# (parse / debugger hooks / conflict markers, yaml manifests) over
+# everything that ships — tests and examples ride only the hygiene
+# gates, mirroring the pytest lint tier.
 #
-#   tools/lint_all.sh
+#   tools/lint_all.sh            # gate: exit nonzero on ANY finding
+#   tools/lint_all.sh --json     # write tools/lint_baseline.json
+#   tools/lint_all.sh --diff     # ratchet: fail only on NEW findings
+#                                # vs the committed baseline
 #
-# For machine-readable output run the underlying passes yourself with
-# --json (each invocation emits one JSON document).
+# The ratchet (ISSUE 2 satellite) lets a rule tighten without a
+# flag-day: commit today's findings with --json, gate on --diff, and
+# burn the baseline down over time. An empty baseline makes --diff
+# equivalent to the plain gate.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 PY=${PYTHON:-python}
+BASELINE=tools/lint_baseline.json
 
-# 1. tpulint rules over the package and executable round tooling
-"$PY" -m kubeflow_tpu.analysis kubeflow_tpu tools bench.py __graft_entry__.py
+# pass 1: tpulint rules over the package and executable round tooling
+RULE_PATHS=(kubeflow_tpu tools bench.py __graft_entry__.py)
+# pass 2: stdlib hygiene (HYG001-003) over everything shipped
+HYG_PATHS=(kubeflow_tpu tools tests examples bench.py __graft_entry__.py)
 
-# 2. stdlib hygiene (HYG rules only) over everything shipped
-"$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
-    kubeflow_tpu tools tests examples bench.py __graft_entry__.py
+case "${1:-gate}" in
+gate)
+    "$PY" -m kubeflow_tpu.analysis "${RULE_PATHS[@]}"
+    "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
+        "${HYG_PATHS[@]}"
+    ;;
+--json)
+    tmp1=$(mktemp) && tmp2=$(mktemp)
+    trap 'rm -f "$tmp1" "$tmp2"' EXIT
+    "$PY" -m kubeflow_tpu.analysis --write-baseline "$tmp1" \
+        "${RULE_PATHS[@]}" >/dev/null
+    "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
+        --write-baseline "$tmp2" "${HYG_PATHS[@]}" >/dev/null
+    "$PY" - "$tmp1" "$tmp2" "$BASELINE" <<'EOF'
+import json
+import sys
+
+findings = []
+for path in sys.argv[1:3]:
+    with open(path) as fh:
+        findings.extend(json.load(fh)["findings"])
+with open(sys.argv[3], "w") as fh:
+    json.dump({"version": 1, "findings": sorted(findings)}, fh, indent=2)
+    fh.write("\n")
+print(f"lint_all: baseline written to {sys.argv[3]} "
+      f"({len(findings)} findings)")
+EOF
+    ;;
+--diff)
+    test -f "$BASELINE" || {
+        echo "lint_all: no $BASELINE — run tools/lint_all.sh --json first" >&2
+        exit 2
+    }
+    rc=0
+    "$PY" -m kubeflow_tpu.analysis --baseline "$BASELINE" \
+        "${RULE_PATHS[@]}" || rc=1
+    "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
+        --baseline "$BASELINE" "${HYG_PATHS[@]}" || rc=1
+    exit $rc
+    ;;
+*)
+    echo "usage: tools/lint_all.sh [--json|--diff]" >&2
+    exit 2
+    ;;
+esac
