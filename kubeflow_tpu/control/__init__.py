@@ -15,6 +15,9 @@ surface in Python on an in-tree API machinery layer:
   (components/common/reconcilehelper/util.go).
 - ``control.jaxjob``         — the training-job operator (TFJob/OpenMPI
   analogue): gang TPU pod sets + jax.distributed env injection.
+- ``control.scheduler``      — the TPU gang scheduler (kube-scheduler/
+  Kueue analogue): slice-topology node model, per-namespace gang queue,
+  all-or-nothing admission, priority preemption (docs/scheduler.md).
 - ``control.notebook``, ``control.profile``, ``control.tensorboard``,
   ``control.poddefault`` (admission webhook), ``control.kfam``,
   ``control.gatekeeper`` — the remaining operators/services, one per

@@ -29,6 +29,10 @@ from kubeflow_tpu.control import reconcilehelper as rh
 from kubeflow_tpu.control.jaxjob import types as T
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
+from kubeflow_tpu.control.scheduler import (
+    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+)
+from kubeflow_tpu.control.scheduler.topology import parse_topology
 
 log = logging.getLogger("kubeflow_tpu.jaxjob")
 
@@ -142,7 +146,13 @@ class JAXJobReconciler(Reconciler):
             sel = pod_spec.setdefault("nodeSelector", {})
             sel.setdefault(T.NODESELECTOR_ACCEL, tpu["accelerator"])
             if tpu.get("topology"):
-                sel.setdefault(T.NODESELECTOR_TOPOLOGY, tpu["topology"])
+                # normalized spelling ("2X4" -> "2x4"): node labels use
+                # the canonical form, and selector matching is exact
+                try:
+                    topo = str(parse_topology(tpu["topology"]))
+                except ValueError:
+                    topo = tpu["topology"]  # validate() reports this
+                sel.setdefault(T.NODESELECTOR_TOPOLOGY, topo)
 
         labels = {
             **(tmpl.get("metadata", {}).get("labels") or {}),
@@ -151,6 +161,25 @@ class JAXJobReconciler(Reconciler):
         }
         if slices > 1:
             labels[T.LABEL_SLICE_INDEX] = str(slice_id)
+        annotations = dict(tmpl.get("metadata", {}).get("annotations") or {})
+        if spec.get("schedulerName"):
+            pod_spec["schedulerName"] = spec["schedulerName"]
+        if spec.get("schedulerName") == SCHEDULER_NAME:
+            # OUR gang scheduler: a scheduling gate keeps every kubelet
+            # off the pod until the WHOLE gang is bound (all-or-nothing
+            # admission), and the annotations carry the gang contract it
+            # reads. A foreign schedulerName passes through ungated —
+            # only the scheduler that will lift a gate may add one.
+            # Appended (not setdefault): a template with its own gates
+            # must still get ours, or nothing holds the kubelets off.
+            gates = list(pod_spec.get("schedulingGates") or [])
+            if not any(g.get("name") == GATE_GANG for g in gates):
+                gates.append({"name": GATE_GANG})
+            pod_spec["schedulingGates"] = gates
+            # the controller OWNS the gang contract: a stale template
+            # annotation must not shrink the gang or skew its priority
+            annotations[ANNOTATION_GANG_SIZE] = str(total)
+            annotations[ANNOTATION_PRIORITY] = str(spec.get("priority", 0))
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -158,7 +187,7 @@ class JAXJobReconciler(Reconciler):
                 "name": worker_name(m["name"], index),
                 "namespace": m["namespace"],
                 "labels": labels,
-                "annotations": dict(tmpl.get("metadata", {}).get("annotations") or {}),
+                "annotations": annotations,
             },
             "spec": pod_spec,
         }

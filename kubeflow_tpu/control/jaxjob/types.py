@@ -16,6 +16,8 @@ Succeeded, Failed.
 from __future__ import annotations
 
 from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
+from kubeflow_tpu.control.scheduler.topology import parse_topology
 
 GROUP = "kubeflow.org"
 VERSION = "v1alpha1"
@@ -88,13 +90,21 @@ def new_jaxjob(
     chips_per_worker: int = 4,
     restart_policy: str = RESTART_GANG,
     max_restarts: int = 3,
+    priority: int = 0,
+    gang_schedule: bool = False,
 ) -> dict:
     """Convenience constructor (the create_job_specs.py analogue).
 
     ``replicas`` is the worker count PER SLICE; ``slice_count`` > 1 asks
     for a multislice deployment (the reference's closest analogue is the
     multi-replica TFJob topology, create_job_specs.py:125-191 — but DCN
-    replaces the PS/gRPC fabric)."""
+    replaces the PS/gRPC fabric).
+
+    ``gang_schedule=True`` opts the job into the TPU gang scheduler
+    (control/scheduler): generated pods get spec.schedulerName plus a
+    scheduling gate, and are only run once the whole gang is bound
+    all-or-nothing. ``priority`` orders admission; a higher-priority
+    gang may preempt a running lower-priority one."""
     spec: dict = {
         "replicas": replicas,
         "template": {
@@ -117,6 +127,10 @@ def new_jaxjob(
     }
     if slice_count > 1:
         spec["sliceCount"] = slice_count
+    if priority:
+        spec["priority"] = priority
+    if gang_schedule:
+        spec["schedulerName"] = SCHEDULER_NAME
     if accelerator:
         spec["tpu"] = {
             "accelerator": accelerator,
@@ -146,6 +160,9 @@ def validate(job: dict) -> list[str]:
     port = spec.get("coordinatorPort", DEFAULT_COORDINATOR_PORT)
     if not isinstance(port, int) or not (0 < port < 65536):
         errs.append(f"spec.coordinatorPort invalid: {port!r}")
+    prio = spec.get("priority", 0)
+    if not isinstance(prio, int) or isinstance(prio, bool):
+        errs.append(f"spec.priority must be an int, got {prio!r}")
     errs += _validate_tpu_topology(spec)
     return errs
 
@@ -160,12 +177,9 @@ def _validate_tpu_topology(spec: dict) -> list[str]:
     if not topology or not chips:
         return []
     try:
-        dims = [int(d) for d in topology.lower().split("x")]
-        slice_chips = 1
-        for d in dims:
-            if d < 1:
-                raise ValueError(topology)
-            slice_chips *= d
+        # the ONE topology parser (control/scheduler/topology.py);
+        # AST-pinned against reimplementation in tests/test_scheduler.py
+        slice_chips = parse_topology(topology).chips
     except ValueError:
         return [f"spec.tpu.topology {topology!r} is not NxM[xK]"]
     replicas = spec.get("replicas", 1)

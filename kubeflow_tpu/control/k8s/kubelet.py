@@ -38,26 +38,65 @@ def _set_phase(cluster: FakeCluster, pod: dict, phase: str, **status_extra) -> d
 
 
 class FakeKubelet:
-    """Pending -> Running on step(); terminal phases are test-driven."""
+    """Pending -> Running on step(); terminal phases are test-driven.
 
-    def __init__(self, cluster: FakeCluster):
+    This fake stands in for the whole node fleet, not one kubelet: it
+    runs any pod BOUND to any node (spec.nodeName set) and never runs a
+    pod still carrying a scheduling gate. ``auto_bind`` (default, the
+    pre-gang-scheduler behavior) additionally stands in for
+    kube-scheduler: unbound ungated pods are bound to ``node_name``
+    (creating that Node, Ready, if absent — slice-health checks treat a
+    missing node as failed) and then run. Gang-scheduler tests pass
+    ``auto_bind=False`` so only scheduler-bound pods execute.
+    """
+
+    def __init__(self, cluster: FakeCluster, auto_bind: bool = True,
+                 node_name: str = "fake-node"):
         self.cluster = cluster
+        self.auto_bind = auto_bind
+        self.node_name = node_name
+
+    def _ensure_node(self) -> None:
+        if self.cluster.get_or_none("v1", "Node", self.node_name) is None:
+            node = ob.new_object("v1", "Node", self.node_name)
+            node["status"] = {
+                "conditions": [{"type": "Ready", "status": "True"}]}
+            try:
+                self.cluster.create(node)
+            except ob.Conflict:
+                pass
 
     def step(self) -> int:
         moved = 0
         for pod in self.cluster.list("v1", "Pod"):
-            if (pod.get("status") or {}).get("phase", "Pending") == "Pending":
-                _set_phase(
-                    self.cluster, pod, "Running",
-                    startTime=ob.now_iso(),
-                    containerStatuses=[
-                        {"name": c.get("name", "main"),
-                         "state": {"running": {"startedAt": ob.now_iso()}},
-                         "ready": True}
-                        for c in pod["spec"].get("containers", [])
-                    ],
-                )
-                moved += 1
+            if (pod.get("status") or {}).get("phase", "Pending") != "Pending":
+                continue
+            spec = pod.get("spec") or {}
+            if spec.get("schedulingGates"):
+                continue  # not admitted by the gang scheduler yet
+            if not spec.get("nodeName"):
+                if not self.auto_bind:
+                    continue  # kubelets run only bound pods
+                self._ensure_node()
+                m = ob.meta(pod)
+                try:
+                    pod = self.cluster.patch(
+                        "v1", "Pod", m["name"],
+                        {"spec": {"nodeName": self.node_name}},
+                        m.get("namespace"))
+                except ob.NotFound:
+                    continue
+            _set_phase(
+                self.cluster, pod, "Running",
+                startTime=ob.now_iso(),
+                containerStatuses=[
+                    {"name": c.get("name", "main"),
+                     "state": {"running": {"startedAt": ob.now_iso()}},
+                     "ready": True}
+                    for c in pod["spec"].get("containers", [])
+                ],
+            )
+            moved += 1
         return moved
 
     def succeed(self, name: str, namespace: str = "default") -> None:
@@ -176,14 +215,22 @@ class LocalPodExecutor:
                 m = ob.meta(pod)
                 key = (m.get("namespace") or "default", m["name"])
                 phase = (pod.get("status") or {}).get("phase", "Pending")
+                if pod["spec"].get("schedulingGates"):
+                    continue  # gated: the gang scheduler has not admitted it
                 if phase == "Pending" and key not in self._procs:
                     c = pod["spec"]["containers"][0]
                     cmd = list(c.get("command") or []) + list(c.get("args") or [])
                     log.info("exec pod %s: %s", m["name"], " ".join(cmd))
                     if self.node_name and not pod["spec"].get("nodeName"):
+                        # bind-once: re-read and only self-bind if still
+                        # unbound — the gang scheduler may have placed
+                        # this pod between our list() and now, and its
+                        # binding must win (never rebind a bound pod)
                         fresh = self.cluster.get_or_none("v1", "Pod",
                                                          m["name"], key[0])
-                        if fresh is not None:
+                        if fresh is not None and fresh["spec"].get("nodeName"):
+                            pod = fresh
+                        elif fresh is not None:
                             fresh["spec"]["nodeName"] = self.node_name
                             pod = self.cluster.update(fresh)
                     proc = subprocess.Popen(

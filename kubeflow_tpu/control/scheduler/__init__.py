@@ -1,0 +1,46 @@
+"""kubeflow_tpu.control.scheduler — TPU-topology-aware gang scheduler.
+
+The reference delegates placement entirely to kube-scheduler; its only
+topology notion is "N pods each asking for nvidia.com/gpu: 1"
+(tf-controller-examples/tf-cnn/create_job_specs.py:165-170). That
+collapses on TPU, where a job needs a *contiguous slice* and partial
+placement is worthless: a jax.distributed world missing one worker never
+forms a mesh. This package is the kube-scheduler/Kueue analogue rebuilt
+TPU-slice-native:
+
+- ``topology``  — the ONE parser for ``"2x4"``/``"4x4x4"`` slice strings
+  (shared with tpctl and JAXJob validation; AST-pinned in tests).
+- ``nodes``     — the node/TPU-pool model: accelerator + topology labels,
+  chips-per-node allocatable, taints, readiness.
+- ``queue``     — per-namespace gang queue: priority + FIFO order,
+  exponential requeue backoff, injectable clock.
+- ``scheduler`` — the Reconciler: all-or-nothing gang admission
+  (reserve -> bind every pod via spec.nodeName, or release and requeue)
+  and priority preemption (evict a lower-priority gang as
+  Failed/Evicted so the JAXJob controller's gang-restart path fires).
+
+A JAXJob opts in by setting ``spec.schedulerName`` (see
+``jaxjob.types.new_jaxjob(gang_schedule=True)``); its generated pods
+carry a scheduling gate that only admission lifts, so no kubelet runs a
+partially placed gang.
+"""
+
+from __future__ import annotations
+
+# Pod-facing contract, consumed by the JAXJob controller when a job opts
+# into gang scheduling. Constants live here (import-light) so jaxjob can
+# import them without pulling the scheduler runtime in.
+SCHEDULER_NAME = "kubeflow-tpu-scheduler"
+GATE_GANG = "scheduler.kubeflow.org/gang"
+ANNOTATION_GANG_SIZE = "scheduler.kubeflow.org/gang-size"
+ANNOTATION_PRIORITY = "scheduler.kubeflow.org/priority"
+
+
+def __getattr__(name):
+    # lazy: the runtime imports jaxjob types/controller, which import the
+    # constants above — eager re-export here would be a cycle
+    if name in ("build_scheduler", "GangScheduler"):
+        from kubeflow_tpu.control.scheduler import scheduler as _s
+
+        return getattr(_s, name)
+    raise AttributeError(name)

@@ -1,0 +1,5 @@
+from kubeflow_tpu.control.mains import run_controller
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+
+run_controller("gang-scheduler",
+               lambda client, args: build_scheduler(client))

@@ -1,0 +1,134 @@
+"""Node/TPU-pool model for the gang scheduler.
+
+A ``Node`` in the FakeCluster (or a real apiserver) carries the GKE TPU
+pool surface the JAXJob controller already targets with nodeSelectors
+(jaxjob/types.py NODESELECTOR_*): the accelerator + topology labels,
+``status.allocatable["google.com/tpu"]`` chips, taints and the Ready
+condition. This module reads that surface into a small value type the
+admission pass computes against, and provides the constructor tests and
+tpctl use to stand up TPU node pools in the fake cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.scheduler.topology import parse_topology
+
+# Pod phases that no longer hold their node's chips.
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+# GKE TPU hosts expose at most 4 chips each; larger slices span hosts.
+CHIPS_PER_HOST = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class NodeView:
+    """The scheduler's read of one Node."""
+
+    name: str
+    labels: dict
+    allocatable_chips: int
+    ready: bool
+    taints: tuple
+
+
+def new_tpu_node(
+    name: str,
+    accelerator: str = "tpu-v5-lite-podslice",
+    topology: str = "2x4",
+    chips_per_node: int | None = None,
+    ready: bool = True,
+    taints: tuple = (),
+    labels: dict | None = None,
+) -> dict:
+    """A Node carrying TPU pool labels (the gke node-pool analogue).
+
+    ``chips_per_node`` defaults to the per-host share of the slice
+    (min(slice chips, 4) — GKE's hightpu-4t machine shapes)."""
+    topo = parse_topology(topology)
+    chips = chips_per_node if chips_per_node is not None \
+        else min(topo.chips, CHIPS_PER_HOST)
+    node = ob.new_object(
+        "v1", "Node", name,
+        labels={
+            JT.NODESELECTOR_ACCEL: accelerator,
+            JT.NODESELECTOR_TOPOLOGY: str(topo),
+            **(labels or {}),
+        },
+    )
+    if taints:
+        node["spec"] = {"taints": [dict(t) for t in taints]}
+    node["status"] = {
+        "allocatable": {JT.RESOURCE_TPU: chips},
+        "conditions": [
+            {"type": "Ready", "status": "True" if ready else "False"}],
+    }
+    return node
+
+
+def node_view(node: dict) -> NodeView:
+    status = node.get("status") or {}
+    alloc = (status.get("allocatable") or {}).get(JT.RESOURCE_TPU) or 0
+    conds = status.get("conditions") or []
+    ready = any(c.get("type") == "Ready" and c.get("status") == "True"
+                for c in conds)
+    taints = tuple((node.get("spec") or {}).get("taints") or [])
+    return NodeView(
+        name=ob.meta(node)["name"],
+        labels=dict(ob.labels_of(node)),
+        allocatable_chips=int(alloc),
+        ready=ready,
+        taints=taints,
+    )
+
+
+def pod_tpu_request(pod: dict) -> int:
+    """Chips this pod claims: the sum of google.com/tpu limits."""
+    total = 0
+    for c in (pod.get("spec") or {}).get("containers") or []:
+        limits = (c.get("resources") or {}).get("limits") or {}
+        total += int(limits.get(JT.RESOURCE_TPU) or 0)
+    return total
+
+
+def selector_matches(pod: dict, view: NodeView) -> bool:
+    sel = (pod.get("spec") or {}).get("nodeSelector") or {}
+    return all(view.labels.get(k) == v for k, v in sel.items())
+
+
+def tolerates(pod: dict, taint: dict) -> bool:
+    """Kubernetes toleration semantics: effect must match (empty
+    toleration effect = all effects); operator Exists matches on key
+    alone (empty key = everything), operator Equal (the default) also
+    requires the taint's value."""
+    t_key = taint.get("key")
+    t_value = taint.get("value", "")
+    t_effect = taint.get("effect", "")
+    for tol in (pod.get("spec") or {}).get("tolerations") or []:
+        effect = tol.get("effect", "")
+        if effect and effect != t_effect:
+            continue
+        if tol.get("operator", "Equal") == "Exists":
+            if not tol.get("key") or tol.get("key") == t_key:
+                return True
+        elif tol.get("key") == t_key and tol.get("value", "") == t_value:
+            return True
+    return False
+
+
+def feasible(pod: dict, view: NodeView) -> bool:
+    """Can this pod land on this node at all (ignoring free capacity)?
+    NotReady nodes and untolerated NoSchedule/NoExecute taints — which
+    include the impending-TPU-maintenance taint — exclude the node."""
+    if not view.ready:
+        return False
+    if not selector_matches(pod, view):
+        return False
+    for t in view.taints:
+        if t.get("effect") in ("NoSchedule", "NoExecute") \
+                and not tolerates(pod, t):
+            return False
+    return True

@@ -1,0 +1,528 @@
+"""Gang scheduler runtime: all-or-nothing admission, priority preemption.
+
+A ``Reconciler`` on the same ``control/runtime.py`` machinery as every
+other controller. Reconcile keys are gangs (namespace + job name, from
+the pod label the JAXJob controller already stamps); pod events map to
+their gang, node events retry everything queued.
+
+Admission is kube-scheduler-shaped but slice-native:
+
+1. walk each namespace's queued gangs in priority/FIFO order
+   (``GangQueue.ordered_by_namespace`` — a backed-off head still blocks
+   its namespace, see _schedule_pass);
+2. for the head gang, compute per-node free chips (allocatable minus
+   the requests of bound, non-terminal pods) and try to place EVERY
+   worker on a feasible node (selector + taints + readiness) — best-fit
+   on free chips so slices pack;
+3. complete assignment -> bind all pods (spec.nodeName patch + lift the
+   scheduling gate); any bind failure releases the partial reservation
+   (unbind + re-gate) — no partial placement ever escapes;
+4. no assignment -> try preempting lower-priority gangs (evict their
+   pods as Failed/Evicted, which fires the JAXJob controller's existing
+   gang-restart path), else requeue with exponential backoff.
+
+The pass is strict-priority FIFO per namespace (Kueue StrictFIFO): a
+blocked head gang blocks its namespace's queue behind it, so a large
+high-priority job cannot be starved by a stream of small ones — while
+one tenant's stuck gang never halts another tenant's admission.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import schedule_latency
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.runtime import (
+    Controller, Reconciler, Request, Result,
+)
+from kubeflow_tpu.control.scheduler import (
+    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+)
+from kubeflow_tpu.control.scheduler import nodes as N
+from kubeflow_tpu.control.scheduler.queue import GangQueue
+from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
+
+log = logging.getLogger("kubeflow_tpu.scheduler")
+
+# after a preemption the freed chips appear as soon as the eviction
+# status lands — retry quickly rather than paying a backoff round
+_RETRY_AFTER_PREEMPT = 0.05
+
+# _WAIT: blocked for a non-capacity reason (gang mid-creation, transient
+# bind failure) — never a preemption trigger. _UNPLACEABLE: a genuine
+# failed capacity assignment — the only outcome that may evict others.
+_ADMITTED, _GONE, _WAIT, _UNPLACEABLE = \
+    "admitted", "gone", "wait", "unplaceable"
+
+# Sentinel reconcile key: "retry everything queued". Node events and
+# bound-pod phase changes enqueue this ONE key instead of one key per
+# queued gang — each reconcile already runs a full global scheduling
+# pass, so fanning out N keys per event was N-1 redundant passes.
+RETRY_ALL = Request("", "-retry-all-")
+
+
+def _gang_annotation(pods: list[dict], key: str) -> int | None:
+    for p in pods:
+        v = ob.annotations_of(p).get(key)
+        if v is not None:
+            try:
+                return int(v)
+            except ValueError:
+                return None
+    return None
+
+
+class GangScheduler(Reconciler):
+    def __init__(
+        self,
+        queue: GangQueue | None = None,
+        registry: MetricsRegistry = REGISTRY,
+        record_events: bool = True,
+        clock=None,
+    ):
+        if queue is None:
+            queue = GangQueue(clock=clock) if clock else GangQueue()
+        self.queue = queue
+        self.registry = registry
+        self.record_events = record_events
+        # admission is a read-compute-bind transaction over cluster
+        # state; two run(workers=N) threads interleaving passes would
+        # each see the same free chips and double-book a node, so the
+        # whole pass is serialized (kube-scheduler's single scheduling
+        # cycle). Queue state has its own finer lock.
+        self._pass_lock = threading.Lock()
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, client, req: Request) -> Result | None:
+        if req != RETRY_ALL:  # the sentinel names no gang to sync
+            self._sync(client, req)
+        with self._pass_lock:
+            delay = self._schedule_pass(client)
+        self._publish_metrics()
+        if delay is not None:
+            return Result(requeue_after=max(delay, 0.01))
+        return None
+
+    def _sync(self, client, req: Request) -> None:
+        """Fold this gang's current cluster state into the queue."""
+        pods = self._gang_pods(client, req.namespace, req.name)
+        pending = [p for p in pods if self._unbound_pending(p)]
+        if not pending:
+            self.queue.remove(req.namespace, req.name)
+            return
+        prio = _gang_annotation(pods, ANNOTATION_PRIORITY) or 0
+        self.queue.offer(req.namespace, req.name, priority=prio)
+
+    def _schedule_pass(self, client) -> float | None:
+        """Admit queued gangs, per namespace, in strict priority/FIFO
+        order until that namespace's head blocks. Returns the shortest
+        delay to requeue after, or None when idle.
+
+        Head blocking is PER NAMESPACE (the queue is per-tenant, ISSUE
+        3): an unplaceable gang in one namespace cannot starve another
+        tenant whose pool has room. Within a namespace the walk covers
+        ALL entries, not just backoff-expired ones — a backed-off head
+        still holds its namespace's queue (nothing may jump it), its
+        backoff only pacing how often admission is retried."""
+        now = self.queue.clock()
+        delays: list[float] = []
+        # namespaces are processed in their HEAD entry's global
+        # admission order (priority desc, then FIFO): after an eviction
+        # the retrying preemptor is always first to the freed chips — a
+        # lower-priority head in a later namespace can never steal them
+        by_ns = self.queue.ordered_by_namespace()
+        for _ns, entries in sorted(
+                by_ns.items(),
+                key=lambda kv: (-kv[1][0].priority, kv[1][0].seq)):
+            for entry in entries:
+                if entry.not_before > now:
+                    delays.append(entry.not_before - now)  # head backing off
+                    break
+                outcome = self._try_admit(client, entry)
+                if outcome in (_ADMITTED, _GONE):
+                    self.queue.remove(entry.namespace, entry.name)
+                    continue
+                # blocked: the namespace head holds its queue; on a
+                # genuine capacity failure (never on a gang still being
+                # created or a transient bind error) try to make room,
+                # else back off
+                if outcome == _WAIT:
+                    # mid-creation / transient: poll at the base rate
+                    # WITHOUT burning the exponential schedule or the
+                    # failed-admission counter — this gang never had a
+                    # real admission attempt rejected
+                    delays.append(self.queue.base_backoff)
+                    break
+                if self._try_preempt(client, entry):
+                    # end the WHOLE pass: gangs in not-yet-walked
+                    # namespaces must not bind the chips this eviction
+                    # just freed for the preemptor
+                    return _RETRY_AFTER_PREEMPT
+                delays.append(
+                    self.queue.requeue(entry.namespace, entry.name))
+                self.registry.counter_inc(
+                    "scheduler_requeues_total",
+                    help_="gang admission attempts that failed and "
+                          "backed off",
+                    namespace=entry.namespace)
+                break
+        if delays:
+            return min(delays)
+        return self.queue.next_wakeup(now)
+
+    # -- admission ----------------------------------------------------------
+
+    def _gang_pods(self, client, namespace: str, name: str) -> list[dict]:
+        pods = client.list(
+            "v1", "Pod", namespace=namespace,
+            label_selector={"matchLabels": {JT.LABEL_JOB_NAME: name}})
+        return [p for p in pods
+                if (p.get("spec") or {}).get("schedulerName")
+                == SCHEDULER_NAME]
+
+    @staticmethod
+    def _unbound_pending(pod: dict) -> bool:
+        spec = pod.get("spec") or {}
+        phase = (pod.get("status") or {}).get("phase", "Pending")
+        if phase != "Pending" or spec.get("nodeName"):
+            return False
+        # kube semantics: a pod carrying ANY foreign gate is
+        # unschedulable — admitting its gang would reserve chips (and
+        # possibly preempt running work) for workers that cannot start
+        # until that gate's controller lifts it
+        return all(g.get("name") == GATE_GANG
+                   for g in spec.get("schedulingGates") or [])
+
+    def _try_admit(self, client, entry) -> str:
+        pods = self._gang_pods(client, entry.namespace, entry.name)
+        if self._repair_stragglers(client, entry.namespace, pods):
+            pods = self._gang_pods(client, entry.namespace, entry.name)
+        pending = sorted((p for p in pods if self._unbound_pending(p)),
+                         key=lambda p: ob.meta(p)["name"])
+        if not pending:
+            return _GONE  # bound elsewhere or deleted
+        size = _gang_annotation(pods, ANNOTATION_GANG_SIZE) or len(pending)
+        if len(pending) < size:
+            return _WAIT  # gang mid-creation: wait for the full set
+        free, views = self._free_chips(client)
+        assignment = self._assign(pending, views, free)
+        if assignment is None:
+            return _UNPLACEABLE
+        if not self._bind(client, entry, assignment):
+            return _WAIT
+        return _ADMITTED
+
+    def _free_chips(self, client) -> tuple[dict[str, int], dict]:
+        """Per-node free chips = allocatable - requests of bound,
+        non-terminal pods (an evicted gang's chips free immediately)."""
+        views = {v.name: v
+                 for v in (N.node_view(n)
+                           for n in client.list("v1", "Node"))}
+        free = {name: v.allocatable_chips for name, v in views.items()}
+        for p in client.list("v1", "Pod"):
+            node = (p.get("spec") or {}).get("nodeName")
+            if not node or node not in free:
+                continue
+            if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                continue
+            free[node] -= N.pod_tpu_request(p)
+        return free, views
+
+    @staticmethod
+    def _assign(pods: list[dict], views: dict, free: dict[str, int]):
+        """All-or-nothing placement: best-fit every worker or None.
+        Does not mutate ``free`` (callers simulate with copies)."""
+        remaining = dict(free)
+        out: dict[str, str] = {}
+        for pod in pods:
+            need = N.pod_tpu_request(pod)
+            best = None
+            for name in sorted(views):
+                if remaining[name] < need or not N.feasible(pod, views[name]):
+                    continue
+                if best is None or remaining[name] < remaining[best]:
+                    best = name
+            if best is None:
+                return None
+            remaining[best] -= need
+            out[ob.meta(pod)["name"]] = best
+        return out
+
+    def _bind(self, client, entry, assignment: dict[str, str]) -> bool:
+        """Bind the whole gang in two phases: set every spec.nodeName
+        WHILE the scheduling gates still hold the kubelets off, and only
+        once all binds landed lift the gates. A failure in the BIND
+        phase leaves only gated (unrunnable) pods to release, so a
+        kubelet polling mid-bind can never start a partial gang. A
+        failure in the LIFT phase (pod deleted under us — the JAXJob
+        controller tearing the gang down) can leave an already-ungated
+        pod briefly runnable; the release below re-gates whatever is
+        still Pending and leaves Running pods to the JAXJob controller's
+        gang-restart reconciliation (a lone worker is a missing-worker
+        gang restart there) — full multi-pod atomicity does not exist
+        over an apiserver."""
+        bound: list[str] = []
+        try:
+            for pod_name, node_name in sorted(assignment.items()):
+                client.patch(
+                    "v1", "Pod", pod_name,
+                    {"spec": {"nodeName": node_name}},
+                    entry.namespace)
+                bound.append(pod_name)
+            for pod_name in sorted(assignment):
+                self._lift_gate(client, entry.namespace, pod_name)
+        except ob.ApiError as e:
+            log.warning("gang %s/%s: bind failed (%s); releasing %d pods",
+                        entry.namespace, entry.name, e, len(bound))
+            for pod_name in bound:
+                try:
+                    self._release_pod(client, entry.namespace, pod_name)
+                except ob.ApiError:
+                    log.exception("gang %s/%s: release of %s failed",
+                                  entry.namespace, entry.name, pod_name)
+            return False
+        latency = max(self.queue.clock() - entry.enqueued_at, 0.0)
+        schedule_latency().observe(latency)
+        self.registry.counter_inc(
+            "scheduler_bind_latency_seconds_sum",
+            help_="queue-to-bound gang latency (sum)", by=latency)
+        self.registry.counter_inc(
+            "scheduler_bind_latency_seconds_count",
+            help_="queue-to-bound gang latency (count)")
+        self.registry.counter_inc(
+            "scheduler_gangs_admitted_total",
+            help_="gangs fully bound", namespace=entry.namespace)
+        if self.record_events and hasattr(client, "record_event"):
+            for pod_name, node_name in sorted(assignment.items()):
+                pod = client.get_or_none("v1", "Pod", pod_name,
+                                         entry.namespace)
+                if pod is not None:
+                    client.record_event(
+                        pod, "Scheduled",
+                        f"gang-bound {pod_name} to {node_name}",
+                        component=SCHEDULER_NAME)
+        return True
+
+    def _repair_stragglers(self, client, namespace: str,
+                           pods: list[dict]) -> bool:
+        """Release half-bound leftovers: a pod that is Pending, BOUND,
+        and still carrying OUR gate is the residue of a failed bind
+        whose rollback also failed. Left alone it wedges its gang in
+        _WAIT forever (bound pods are excluded from the pending set);
+        releasing it here makes the rollback self-healing. Safe against
+        our own in-flight binds: passes are serialized by _pass_lock, so
+        no bind is mid-phase while this runs."""
+        repaired = False
+        for p in pods:
+            spec = p.get("spec") or {}
+            phase = (p.get("status") or {}).get("phase", "Pending")
+            if phase != "Pending" or not spec.get("nodeName"):
+                continue
+            if not any(g.get("name") == GATE_GANG
+                       for g in spec.get("schedulingGates") or []):
+                continue
+            try:
+                self._release_pod(client, namespace, ob.meta(p)["name"])
+                repaired = True
+            except ob.ApiError:
+                log.exception("straggler release of %s/%s failed",
+                              namespace, ob.meta(p)["name"])
+        return repaired
+
+    @staticmethod
+    def _lift_gate(client, namespace: str, pod_name: str) -> None:
+        """Remove OUR gate only — another controller's gate (a quota
+        hold, say) is its to lift, never ours to clobber."""
+        cur = client.get("v1", "Pod", pod_name, namespace)
+        gates = [g for g in (cur.get("spec") or {}).get("schedulingGates")
+                 or [] if g.get("name") != GATE_GANG]
+        client.patch("v1", "Pod", pod_name,
+                     {"spec": {"schedulingGates": gates or None}},
+                     namespace)
+
+    @staticmethod
+    def _release_pod(client, namespace: str, pod_name: str) -> None:
+        """Failed-bind rollback for one pod: unbind and restore OUR gate
+        (preserving any foreign gates). Non-Pending pods are left alone
+        — stripping a Running pod's binding would corrupt node
+        accounting; the JAXJob controller owns its fate (gang restart)."""
+        cur = client.get_or_none("v1", "Pod", pod_name, namespace)
+        if cur is None:
+            return
+        if (cur.get("status") or {}).get("phase", "Pending") != "Pending":
+            return
+        gates = list((cur.get("spec") or {}).get("schedulingGates") or [])
+        if not any(g.get("name") == GATE_GANG for g in gates):
+            gates.append({"name": GATE_GANG})
+        client.patch("v1", "Pod", pod_name,
+                     {"spec": {"nodeName": None, "schedulingGates": gates}},
+                     namespace)
+
+    # -- preemption ---------------------------------------------------------
+
+    def _try_preempt(self, client, entry) -> bool:
+        """Make room for a blocked gang by evicting lower-priority
+        gangs, lowest first, until the blocked gang would fit. Eviction
+        marks victims Failed/Evicted — the JAXJob controller's
+        ``_pod_preempted`` path gang-restarts them (preemption budget,
+        not the crash budget) and their recreated pods requeue behind
+        the preemptor."""
+        pods = self._gang_pods(client, entry.namespace, entry.name)
+        pending = sorted((p for p in pods if self._unbound_pending(p)),
+                         key=lambda p: ob.meta(p)["name"])
+        if not pending:
+            return False
+        free, views = self._free_chips(client)
+        if self._assign(pending, views, free) is not None:
+            # fits without evicting anyone (state moved since the failed
+            # admission attempt) — let the next pass admit it instead
+            return False
+        # only nodes the preemptor could actually use: evicting a gang
+        # from a different pool (topology/accelerator mismatch) frees
+        # nothing this gang can take, so such victims are never touched
+        usable = {name for name, v in views.items()
+                  if any(N.feasible(p, v) for p in pending)}
+        chosen: list[tuple[tuple[str, str], list[dict]]] = []
+        for gang_key, gang_pods in self._victim_gangs(client, entry.priority):
+            if not any((p.get("spec") or {}).get("nodeName") in usable
+                       for p in gang_pods):
+                continue
+            for p in gang_pods:
+                node = (p.get("spec") or {}).get("nodeName")
+                if node in free:
+                    free[node] += N.pod_tpu_request(p)
+            chosen.append((gang_key, gang_pods))
+            if self._assign(pending, views, free) is not None:
+                self._evict(client, entry, chosen)
+                return True
+        return False
+
+    def _victim_gangs(self, client, priority: int):
+        """Bound, non-terminal gangs of strictly lower priority, grouped
+        and ordered lowest-priority first (then newest name-order last
+        resort for determinism)."""
+        gangs: dict[tuple[str, str], list[dict]] = {}
+        prios: dict[tuple[str, str], int] = {}
+        for p in client.list("v1", "Pod"):
+            spec = p.get("spec") or {}
+            if spec.get("schedulerName") != SCHEDULER_NAME:
+                continue
+            if not spec.get("nodeName"):
+                continue
+            if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                continue
+            job = ob.labels_of(p).get(JT.LABEL_JOB_NAME)
+            if not job:
+                continue
+            try:
+                prio = int(ob.annotations_of(p).get(ANNOTATION_PRIORITY, 0))
+            except ValueError:
+                prio = 0
+            if prio >= priority:
+                continue
+            key = (ob.meta(p).get("namespace") or "default", job)
+            gangs.setdefault(key, []).append(p)
+            prios[key] = prio
+        order = sorted(gangs, key=lambda k: (prios[k], k))
+        return [(k, gangs[k]) for k in order]
+
+    def _evict(self, client, entry, chosen) -> None:
+        for (ns, name), gang_pods in chosen:
+            message = (f"preempted by higher-priority gang "
+                       f"{entry.namespace}/{entry.name}")
+            for p in gang_pods:
+                cur = client.get_or_none("v1", "Pod", ob.meta(p)["name"], ns)
+                if cur is None:
+                    continue
+                cur.setdefault("status", {})
+                cur["status"].update({
+                    "phase": "Failed",
+                    "reason": "Evicted",
+                    "message": message,
+                    "containerStatuses": [],
+                })
+                client.update_status(cur)
+            log.info("evicted gang %s/%s: %s", ns, name, message)
+            self.registry.counter_inc(
+                "scheduler_preemptions_total",
+                help_="gangs evicted for a higher-priority gang",
+                namespace=ns)
+            if self.record_events and hasattr(client, "record_event") \
+                    and gang_pods:
+                client.record_event(gang_pods[0], "GangPreempted", message,
+                                    "Warning", component=SCHEDULER_NAME)
+
+    # -- observability ------------------------------------------------------
+
+    def _publish_metrics(self) -> None:
+        for ns, depth in self.queue.depths().items():
+            self.registry.gauge(
+                "scheduler_queue_depth", depth,
+                help_="gangs queued awaiting admission", namespace=ns)
+
+
+def _pod_mapper(rec: GangScheduler, client):
+    """A pod event maps to its own gang (kicking that gang's backoff —
+    its pod set changed, retry on the new state now); a BOUND pod's
+    event also enqueues the single RETRY_ALL sentinel, kicking every
+    backoff when the pod's chips just freed — terminal phase, or the
+    pod is gone from the cluster (a Running pod deleted out from under
+    its gang) — so new capacity never waits out an exponential delay."""
+
+    def fn(pod: dict) -> list[Request]:
+        spec = pod.get("spec") or {}
+        m = ob.meta(pod)
+        reqs: dict[Request, None] = {}
+        if spec.get("schedulerName") == SCHEDULER_NAME:
+            job = ob.labels_of(pod).get(JT.LABEL_JOB_NAME)
+            if job:
+                ns = m.get("namespace") or "default"
+                rec.queue.kick_one(ns, job)
+                reqs[Request(ns, job)] = None
+        if spec.get("nodeName") and rec.queue.depth():
+            freed = (pod.get("status") or {}).get("phase") \
+                in N.TERMINAL_PHASES
+            if not freed:
+                # mappers see objects, not event types: a DELETED
+                # Running pod is recognized by its absence from the
+                # store (its last state still says Running)
+                freed = client.get_or_none(
+                    "v1", "Pod", m["name"], m.get("namespace")) is None
+            if freed:
+                rec.queue.kick()
+            reqs[RETRY_ALL] = None
+        return list(reqs)
+
+    return fn
+
+
+def _node_mapper(rec: GangScheduler):
+    """Node capacity/health changed: expire every backoff (new capacity
+    must not wait out an exponential delay) and run one global pass."""
+
+    def fn(_node: dict) -> list[Request]:
+        if not rec.queue.depth():
+            return []
+        rec.queue.kick()
+        return [RETRY_ALL]
+
+    return fn
+
+
+def build_scheduler(
+    client,
+    registry: MetricsRegistry = REGISTRY,
+    record_events: bool = True,
+    clock=None,
+    queue: GangQueue | None = None,
+) -> Controller:
+    rec = GangScheduler(queue=queue, registry=registry,
+                        record_events=record_events, clock=clock)
+    ctl = Controller("gang-scheduler", client, rec)
+    ctl.maps("v1", "Pod", _pod_mapper(rec, client))
+    ctl.maps("v1", "Node", _node_mapper(rec))
+    return ctl

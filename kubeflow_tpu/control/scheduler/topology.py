@@ -1,0 +1,58 @@
+"""Canonical TPU slice-topology parsing — ONE spelling for the tree.
+
+``"2x4"`` / ``"4x4x4"`` strings name the physical chip grid of a TPU
+slice (the value of the GKE ``cloud.google.com/gke-tpu-topology`` node
+label). Three places used to parse them independently — tpctl's
+node-pool sizing, JAXJob admission validation, and now the gang
+scheduler's node model — which is exactly how a "2x4" and a "2X4"
+drift apart. This module is the single parser; every other module
+imports it, and tests/test_scheduler.py AST-pins the spelling the way
+parallel/mesh.py pins AXIS_NAMES for tpulint: no other module in the
+package may split on the separator itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+# The one spelling of the dimension separator (AST-pinned in tests).
+TOPOLOGY_SEPARATOR = "x"
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """A parsed slice shape: dimension extents, outermost first."""
+
+    dims: tuple[int, ...]
+
+    @property
+    def chips(self) -> int:
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    @property
+    def rank(self) -> int:
+        return len(self.dims)
+
+    def __str__(self) -> str:
+        return TOPOLOGY_SEPARATOR.join(str(d) for d in self.dims)
+
+
+def parse_topology(s: str) -> Topology:
+    """Parse ``"2x4"``-style strings; raises ValueError on anything that
+    is not positive-int extents joined by the separator."""
+    parts = (s or "").strip().lower().split(TOPOLOGY_SEPARATOR)
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        raise ValueError(f"topology {s!r} is not NxM[xK]") from None
+    if not dims or any(d < 1 for d in dims):
+        raise ValueError(f"topology {s!r} is not NxM[xK]")
+    return Topology(dims)
+
+
+def chip_count(s: str) -> int:
+    """Total chips in a slice topology string."""
+    return parse_topology(s).chips

@@ -93,10 +93,11 @@ class GkeTpuPlatform(PlatformProvider):
 
     @staticmethod
     def _chips(topology: str) -> int:
-        n = 1
-        for d in (topology or "1").lower().split("x"):
-            n *= int(d)
-        return n
+        # the ONE topology parser (control/scheduler/topology.py);
+        # empty means a single-chip pool
+        from kubeflow_tpu.control.scheduler.topology import chip_count
+
+        return chip_count(topology or "1")
 
     def _machine(self, cfg: TpuDef) -> tuple[str, int]:
         if cfg.accelerator not in self.MACHINE_TYPES:

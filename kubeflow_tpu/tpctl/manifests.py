@@ -148,6 +148,7 @@ def render(cfg: TpuDef) -> list[dict]:
 
     controllers = {
         "jaxjob-controller": ["python", "-m", "kubeflow_tpu.control.jaxjob"],
+        "gang-scheduler": ["python", "-m", "kubeflow_tpu.control.scheduler"],
         "notebook-controller": ["python", "-m", "kubeflow_tpu.control.notebook"],
         "profile-controller": ["python", "-m", "kubeflow_tpu.control.profile"],
         "tensorboard-controller": ["python", "-m", "kubeflow_tpu.control.tensorboard"],

@@ -17,6 +17,7 @@ from typing import Any
 import yaml
 
 from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.scheduler.topology import parse_topology
 
 API_VERSION = "tpctl.kubeflow.org/v1alpha1"
 KIND = "TpuDef"
@@ -31,6 +32,7 @@ ALL_COMPONENTS = (
     "namespace",
     "rbac",
     "jaxjob-controller",
+    "gang-scheduler",
     "notebook-controller",
     "profile-controller",
     "tensorboard-controller",
@@ -61,6 +63,12 @@ class TpuDef:
     ha_controllers: bool = False
     overlays: list[dict] = dataclasses.field(default_factory=list)
     raw: dict = dataclasses.field(default_factory=dict, repr=False)
+
+    def slice_chips(self) -> int:
+        """Total chips in the deployment's slice topology — parsed by
+        the ONE shared parser (control/scheduler/topology.py, also used
+        by JAXJob validation and the gang scheduler's node model)."""
+        return parse_topology(self.topology).chips
 
     @classmethod
     def from_dict(cls, d: dict) -> "TpuDef":

@@ -1,0 +1,46 @@
+"""Worker payload for the SCHEDULER gang e2e test.
+
+Joins the jax.distributed world from the JAXJOB_* env the controller
+injected and proves ONE world formed across the scheduler-placed pods:
+after initialize_from_env, jax.device_count() equals num_processes only
+when every rank's topology exchange with the coordinator succeeded (a
+lone process would see 1). Deliberately stops short of the full flax
+trainer (that path is gang_worker.py's job): the scheduler e2e isolates
+placement → world formation, so it must not inherit the trainer's
+model-layer dependencies — or the CPU backend's lack of multiprocess
+collectives.
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+# sitecustomize may have pre-registered a TPU backend; force cpu the same
+# way tests/conftest.py does.
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.parallel.dist import initialize_from_env  # noqa: E402
+
+
+def main() -> int:
+    dist = initialize_from_env()
+    # the world-formation proof: every process sees every process's
+    # devices (ranks that failed to join would leave this at 1)
+    assert jax.device_count() == dist.num_processes, \
+        (jax.device_count(), dist.num_processes)
+    assert jax.process_count() == dist.num_processes
+
+    with open(os.environ["GANG_LOG"], "a") as f:
+        f.write(json.dumps({"rank": dist.process_id,
+                            "world": jax.device_count()}) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

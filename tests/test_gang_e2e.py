@@ -193,6 +193,65 @@ class TestGangE2E:
         assert all(r["start_step"] == stop_step for r in finished), runs
 
 
+SCHED_WORKER = os.path.join(HERE, "sched_worker.py")
+
+
+@pytest.mark.slow
+class TestSchedulerGangE2E:
+    def test_no_partial_placement_then_admitted_gang_runs(self, tmp_path):
+        """The gang scheduler in the REAL loop: with capacity for only
+        one of two workers, zero pods bind and zero processes launch
+        (scheduling gates hold the kubelet off); once a second node
+        appears the whole gang binds, the gates lift, and the admitted
+        gang forms ONE jax.distributed world across the scheduler-placed
+        pods (sched_worker.py allgathers ranks) and succeeds."""
+        from kubeflow_tpu.control.runtime import seed_controller as _seed
+        from kubeflow_tpu.control.scheduler.nodes import new_tpu_node
+        from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+
+        cluster, ctl, executor, gang_log = make_world(tmp_path, total_steps=3)
+        sched = _seed(build_scheduler(cluster, record_events=False))
+        cluster.create(new_tpu_node("n0"))  # one 4-chip host: half a gang
+        cluster.create(JT.new_jaxjob(
+            "gang", replicas=2, accelerator="tpu-v5-lite-podslice",
+            topology="2x4", chips_per_worker=4, gang_schedule=True,
+            command=[sys.executable, SCHED_WORKER]))
+        deadline = time.monotonic() + 6
+        while time.monotonic() < deadline:
+            ctl.run_until_idle(advance_delayed=True)
+            sched.run_until_idle(advance_delayed=True)
+            executor.poll_once()
+            time.sleep(0.2)
+        assert executor.alive_count() == 0, "partial gang must never start"
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert p["spec"].get("nodeName") is None
+            assert p["spec"].get("schedulingGates")
+
+        cluster.create(new_tpu_node("n1"))  # capacity for the full gang
+        deadline = time.monotonic() + 180
+        try:
+            while time.monotonic() < deadline:
+                ctl.run_until_idle(advance_delayed=True)
+                sched.run_until_idle(advance_delayed=True)
+                executor.poll_once()
+                job = cluster.get_or_none(JT.API_VERSION, JT.KIND,
+                                          "gang", "default")
+                if job is not None and ob.cond_is_true(job,
+                                                       JT.COND_SUCCEEDED):
+                    break
+                time.sleep(0.2)
+        finally:
+            executor.shutdown()
+        assert ob.cond_is_true(job, JT.COND_SUCCEEDED)
+        runs = runs_from(gang_log)
+        assert {r["rank"] for r in runs} == {0, 1}
+        assert all(r["world"] == 2 for r in runs)  # one world, not two
+        # the gang ran where the scheduler put it: one worker per host
+        nodes = {p["spec"]["nodeName"]
+                 for p in cluster.list("v1", "Pod", namespace="default")}
+        assert nodes == {"n0", "n1"}
+
+
 def make_node(name: str, ready: bool = True) -> dict:
     node = ob.new_object("v1", "Node", name)
     node["status"] = {"conditions": [

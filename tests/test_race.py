@@ -42,7 +42,8 @@ def _static_lockset_map():
     control = pathlib.Path(__file__).resolve().parent.parent / \
         "kubeflow_tpu" / "control"
     return static_guarded_map([str(control / "runtime.py"),
-                               str(control / "leases.py")])
+                               str(control / "leases.py"),
+                               str(control / "scheduler" / "queue.py")])
 
 
 @pytest.fixture(scope="module", autouse=True)
@@ -57,10 +58,12 @@ def _dyntrace_tier():
     from kubeflow_tpu.analysis.dyntrace import Tracer
     from kubeflow_tpu.control.leases import LeaderElector
     from kubeflow_tpu.control.runtime import Controller
+    from kubeflow_tpu.control.scheduler.queue import GangQueue
 
     tr = Tracer()
     tr.instrument(Controller)
     tr.instrument(LeaderElector)
+    tr.instrument(GangQueue)
     _TRACER = tr
     try:
         with tr:
@@ -135,6 +138,59 @@ def test_optimistic_concurrency_under_contention():
         t.join()
     final = c.get("v1", "ConfigMap", "shared", "ns")
     assert final["data"]["count"] == str(writers * per_writer)
+
+
+def test_gang_queue_concurrent_offer_requeue_remove():
+    """The gang scheduler's queue (ISSUE 3) under thread fire: offers,
+    requeues, ready() scans and removes race from many threads; state
+    must never tear, and the admission order (priority desc, FIFO
+    within) must hold over the survivors. Under TPU_RACE_TRACE=1 the
+    module fixture instruments GangQueue, so this churn also feeds the
+    happens-before validator's static/dynamic lockset diff."""
+    from kubeflow_tpu.control.scheduler.queue import GangQueue
+
+    # static pin: LOCK201's map must prove the queue's state is guarded
+    static = _static_lockset_map()
+    assert static["GangQueue"]["_entries"] == {"_lock"}
+    assert static["GangQueue"]["_seq"] == {"_lock"}
+
+    q = GangQueue(base_backoff=0.001, max_backoff=0.002)
+    errors: list[Exception] = []
+
+    def worker(wid: int):
+        try:
+            for i in range(RACE_ITERS):
+                name = f"g-{wid}-{i}"
+                q.offer("ns", name, priority=i % 3)
+                q.requeue("ns", name)
+                q.ready()
+                q.depths()
+                if i % 2 == 0:
+                    q.remove("ns", name)
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(RACE_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    expect = RACE_THREADS * sum(1 for i in range(RACE_ITERS) if i % 2 != 0)
+    assert q.depth() == expect
+    time.sleep(0.01)  # every backoff deadline expires
+    entries = q.ready()
+    assert len(entries) == expect
+    # seqs unique (no torn counter), and every survivor carries exactly
+    # the state its worker wrote: the offered priority and ONE requeue —
+    # an independently derived expectation, not ready()'s own sort key
+    assert len({e.seq for e in entries}) == expect
+    expected = {f"g-{w}-{i}": i % 3
+                for w in range(RACE_THREADS)
+                for i in range(RACE_ITERS) if i % 2 != 0}
+    assert {e.name: e.priority for e in entries} == expected
+    assert all(e.attempts == 1 for e in entries)
 
 
 def test_controller_threaded_mode_against_churn():

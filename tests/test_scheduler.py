@@ -1,0 +1,794 @@
+"""TPU gang scheduler (control/scheduler): queueing, all-or-nothing
+admission, priority preemption — plus the node/topology model and the
+kubelet binding contract it relies on.
+
+The e2e tests run the JAXJob controller AND the gang scheduler against
+one FakeCluster with a non-auto-binding kubelet, so the full production
+loop is exercised: JAXJob renders a gated gang -> scheduler admits
+all-or-nothing -> kubelet runs only bound pods -> preemption flows back
+through the JAXJob controller's existing gang-restart path.
+"""
+
+import ast
+import pathlib
+import sys
+
+import pytest
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import build_controller, worker_name
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet, LocalPodExecutor
+from kubeflow_tpu.control.runtime import seed_controller
+from kubeflow_tpu.control.scheduler import (
+    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+)
+from kubeflow_tpu.control.scheduler.nodes import (
+    feasible, new_tpu_node, node_view, pod_tpu_request,
+)
+from kubeflow_tpu.control.scheduler.queue import GangQueue
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+from kubeflow_tpu.control.scheduler.topology import (
+    TOPOLOGY_SEPARATOR, chip_count, parse_topology,
+)
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+PACKAGE = pathlib.Path(__file__).resolve().parent.parent / "kubeflow_tpu"
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- topology ----------------------------------------------------------------
+
+
+class TestTopology:
+    def test_parse_shapes(self):
+        assert parse_topology("2x4").dims == (2, 4)
+        assert parse_topology("4x4x4").dims == (4, 4, 4)
+        assert parse_topology("8").dims == (8,)
+        assert parse_topology(" 2X4 ").dims == (2, 4)  # case/space tolerant
+
+    def test_chip_count(self):
+        assert chip_count("2x4") == 8
+        assert chip_count("4x4x4") == 64
+        assert chip_count("1") == 1
+
+    def test_str_roundtrip(self):
+        assert str(parse_topology("2x4")) == "2x4"
+
+    @pytest.mark.parametrize("bad", ["", "2xbad", "0x4", "2x-1", "x", "2x"])
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ValueError):
+            parse_topology(bad)
+
+    def test_single_spelling_ast_pin(self):
+        """The satellite contract: exactly ONE topology parser. No other
+        module in the package may split on the separator (the way
+        parallel/mesh.py's AXIS_NAMES is pinned for tpulint), and every
+        former parsing site imports the shared module."""
+        offenders = []
+        for path in PACKAGE.rglob("*.py"):
+            if path.parent.name == "scheduler" and path.name == "topology.py":
+                continue
+            tree = ast.parse(path.read_text())
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "split"
+                        and node.args
+                        and isinstance(node.args[0], ast.Constant)
+                        and node.args[0].value == TOPOLOGY_SEPARATOR):
+                    offenders.append(
+                        f"{path.relative_to(PACKAGE)}:{node.lineno}")
+        assert offenders == [], (
+            f"topology parsing duplicated outside scheduler/topology.py: "
+            f"{offenders}")
+        for rel in ("control/jaxjob/types.py", "tpctl/tpudef.py",
+                    "tpctl/apply.py"):
+            src = (PACKAGE / rel).read_text()
+            assert "kubeflow_tpu.control.scheduler.topology" in src, rel
+
+    def test_tpudef_shares_parser(self):
+        from kubeflow_tpu.tpctl.tpudef import TpuDef
+
+        assert TpuDef(topology="4x4").slice_chips() == 16
+
+
+# -- node model --------------------------------------------------------------
+
+
+class TestNodeModel:
+    def test_new_tpu_node_surface(self):
+        node = new_tpu_node("n0", accelerator="tpu-v5-lite-podslice",
+                            topology="2x4")
+        v = node_view(node)
+        assert v.allocatable_chips == 4  # per-host share of the slice
+        assert v.ready
+        assert v.labels[JT.NODESELECTOR_ACCEL] == "tpu-v5-lite-podslice"
+        assert v.labels[JT.NODESELECTOR_TOPOLOGY] == "2x4"
+
+    def _pod(self, chips=4, selector=None, tolerations=None):
+        pod = ob.new_object("v1", "Pod", "p", "default")
+        pod["spec"] = {"containers": [{"name": "jax", "resources": {
+            "limits": {JT.RESOURCE_TPU: chips}}}]}
+        if selector:
+            pod["spec"]["nodeSelector"] = selector
+        if tolerations:
+            pod["spec"]["tolerations"] = tolerations
+        return pod
+
+    def test_pod_tpu_request(self):
+        assert pod_tpu_request(self._pod(chips=4)) == 4
+        cpu_pod = ob.new_object("v1", "Pod", "c", "default")
+        cpu_pod["spec"] = {"containers": [{"name": "main"}]}
+        assert pod_tpu_request(cpu_pod) == 0
+
+    def test_feasibility_selector_and_readiness(self):
+        v = node_view(new_tpu_node("n0", topology="2x4"))
+        assert feasible(self._pod(selector={
+            JT.NODESELECTOR_TOPOLOGY: "2x4"}), v)
+        assert not feasible(self._pod(selector={
+            JT.NODESELECTOR_TOPOLOGY: "4x4"}), v)
+        assert not feasible(
+            self._pod(), node_view(new_tpu_node("n1", ready=False)))
+
+    def test_taints_block_unless_tolerated(self):
+        taint = {"key": JT.TAINT_IMPENDING_TERMINATION, "effect": "NoSchedule"}
+        v = node_view(new_tpu_node("n0", taints=(taint,)))
+        assert not feasible(self._pod(), v)
+        assert feasible(self._pod(tolerations=[
+            {"key": JT.TAINT_IMPENDING_TERMINATION}]), v)
+
+    def test_toleration_operator_equal_requires_value_and_effect(self):
+        """kube semantics: Equal (the default operator) must match the
+        taint's VALUE, and a toleration naming an effect only covers
+        that effect — a key-only match must not defeat a taint."""
+        taint = {"key": "maintenance", "value": "tpu-repair",
+                 "effect": "NoExecute"}
+        v = node_view(new_tpu_node("n0", taints=(taint,)))
+        # wrong value: real kube-scheduler rejects this node
+        assert not feasible(self._pod(tolerations=[
+            {"key": "maintenance", "operator": "Equal",
+             "value": "upgrade-ok"}]), v)
+        assert feasible(self._pod(tolerations=[
+            {"key": "maintenance", "operator": "Equal",
+             "value": "tpu-repair"}]), v)
+        # wrong effect never tolerates; Exists-on-key ignores the value
+        assert not feasible(self._pod(tolerations=[
+            {"key": "maintenance", "operator": "Exists",
+             "effect": "NoSchedule"}]), v)
+        assert feasible(self._pod(tolerations=[
+            {"key": "maintenance", "operator": "Exists"}]), v)
+
+
+# -- gang queue --------------------------------------------------------------
+
+
+class TestGangQueue:
+    def test_priority_then_fifo_order(self):
+        fc = FakeClock()
+        q = GangQueue(clock=fc)
+        q.offer("ns", "low-a", priority=0)
+        q.offer("ns", "high", priority=5)
+        q.offer("ns", "low-b", priority=0)
+        assert [e.name for e in q.ready()] == ["high", "low-a", "low-b"]
+
+    def test_exponential_backoff_with_fake_clock(self):
+        fc = FakeClock()
+        q = GangQueue(clock=fc, base_backoff=1.0, max_backoff=8.0)
+        q.offer("ns", "g")
+        assert q.requeue("ns", "g") == 1.0
+        assert q.ready() == []                 # backed off
+        assert q.next_wakeup() == 1.0
+        fc.advance(1.0)
+        assert [e.name for e in q.ready()] == ["g"]
+        assert q.requeue("ns", "g") == 2.0     # doubles
+        fc.advance(2.0)
+        assert q.requeue("ns", "g") == 4.0
+        fc.advance(4.0)
+        assert q.requeue("ns", "g") == 8.0
+        fc.advance(8.0)
+        assert q.requeue("ns", "g") == 8.0     # capped
+        fc.advance(8.0)
+        assert [e.attempts for e in q.ready()] == [5]
+
+    def test_remove_resets_backoff_state(self):
+        fc = FakeClock()
+        q = GangQueue(clock=fc, base_backoff=1.0)
+        q.offer("ns", "g")
+        q.requeue("ns", "g")
+        q.remove("ns", "g")
+        e = q.offer("ns", "g")                 # re-queued fresh
+        assert e.attempts == 0 and e.not_before == 0.0
+
+    def test_offer_idempotent_tracks_priority(self):
+        q = GangQueue(clock=FakeClock())
+        e1 = q.offer("ns", "g", priority=0)
+        e2 = q.offer("ns", "g", priority=7)
+        assert e2.seq == e1.seq and e2.priority == 7
+        assert q.depth() == 1
+
+    def test_depths_report_zero_after_drain_then_prune(self):
+        q = GangQueue(clock=FakeClock())
+        q.offer("a", "g1")
+        q.offer("b", "g2")
+        q.remove("a", "g1")
+        assert q.depths() == {"a": 0, "b": 1}
+        # one zero-fill per drain, then the namespace is pruned so
+        # ephemeral-tenant churn cannot grow the map forever
+        assert q.depths() == {"b": 1}
+
+    def test_kick_expires_backoff(self):
+        fc = FakeClock()
+        q = GangQueue(clock=fc, base_backoff=10.0)
+        q.offer("ns", "g1")
+        q.offer("ns", "g2")
+        q.requeue("ns", "g1")
+        q.requeue("ns", "g2")
+        assert q.ready() == []
+        q.kick_one("ns", "g1")
+        assert [e.name for e in q.ready()] == ["g1"]
+        q.kick()
+        assert {e.name for e in q.ready()} == {"g1", "g2"}
+        # attempts survive a kick: the NEXT failure still backs off far
+        assert all(e.attempts == 1 for e in q.ready())
+
+
+# -- e2e worlds --------------------------------------------------------------
+
+
+def gang_job(name, replicas=2, priority=0, topology="2x4", chips=4,
+             slice_count=1, **kw):
+    return JT.new_jaxjob(
+        name, replicas=replicas, slice_count=slice_count,
+        accelerator="tpu-v5-lite-podslice", topology=topology,
+        chips_per_worker=chips, priority=priority, gang_schedule=True, **kw)
+
+
+def sched_world(clock):
+    cluster = FakeCluster()
+    registry = MetricsRegistry()
+    jax_ctl = seed_controller(build_controller(cluster, record_events=False))
+    sched_ctl = seed_controller(build_scheduler(
+        cluster, registry=registry, record_events=False, clock=clock))
+    kubelet = FakeKubelet(cluster, auto_bind=False)
+    return cluster, jax_ctl, sched_ctl, kubelet, registry
+
+
+def pump(ctls, clock, kubelet=None, rounds=10):
+    for _ in range(rounds):
+        for c in ctls:
+            c.run_until_idle(advance_delayed=True)
+        if kubelet is not None:
+            kubelet.step()
+        clock.advance(1.0)
+
+
+def bindings(cluster, namespace="default"):
+    return {ob.meta(p)["name"]: (p["spec"].get("nodeName"))
+            for p in cluster.list("v1", "Pod", namespace=namespace)}
+
+
+class TestAllOrNothingAdmission:
+    def test_capacity_for_n_minus_one_binds_zero(self):
+        """THE gang property: 2 workers, room for 1 => NOTHING binds."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))     # one 4-chip host
+        cluster.create(gang_job("gang", replicas=2))  # needs 2 hosts
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        assert len(b) == 2
+        assert all(node is None for node in b.values()), b
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert p["spec"]["schedulingGates"] == [{"name": GATE_GANG}]
+            phase = (p.get("status") or {}).get("phase", "Pending")
+            assert phase == "Pending"
+        # queued + backing off, visible in metrics
+        text = reg.render()
+        assert 'scheduler_queue_depth{namespace="default"} 1' in text
+        assert "scheduler_requeues_total" in text
+
+    def test_admits_when_capacity_appears(self):
+        import prometheus_client as prom
+
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(gang_job("gang", replicas=2))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        assert all(n is None for n in bindings(cluster).values())
+        before = prom.REGISTRY.get_sample_value(
+            "jaxjob_gang_schedule_seconds_count") or 0.0
+
+        cluster.create(new_tpu_node("n1"))     # capacity arrives
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        assert sorted(b) == ["gang-worker-0", "gang-worker-1"]
+        assert sorted(b.values()) == ["n0", "n1"]  # one worker per host
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert not p["spec"].get("schedulingGates")  # gate lifted
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+        # bind latency reached BOTH sinks: the prom histogram and the
+        # MetricsRegistry counters
+        after = prom.REGISTRY.get_sample_value(
+            "jaxjob_gang_schedule_seconds_count")
+        assert after == before + 1
+        text = reg.render()
+        assert "scheduler_bind_latency_seconds_count 1" in text
+        assert 'scheduler_gangs_admitted_total{namespace="default"} 1' in text
+        assert 'scheduler_queue_depth{namespace="default"} 0' in text
+
+    def test_node_event_bypasses_backoff(self):
+        """New capacity must not wait out an exponential backoff: a
+        Node event kicks every backed-off entry and retries at once."""
+        from kubeflow_tpu.control.scheduler.scheduler import GangScheduler
+
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(gang_job("gang", replicas=2))
+        for _ in range(4):  # pump WITHOUT advancing the clock
+            jax_ctl.run_until_idle(advance_delayed=True)
+            sched_ctl.run_until_idle(advance_delayed=True)
+        assert all(n is None for n in bindings(cluster).values())
+        rec = sched_ctl.reconciler
+        assert isinstance(rec, GangScheduler)
+        assert rec.queue.get("default", "gang").not_before > 0  # backing off
+        cluster.create(new_tpu_node("n1"))  # capacity arrives NOW
+        for _ in range(4):
+            sched_ctl.run_until_idle(advance_delayed=True)
+            jax_ctl.run_until_idle(advance_delayed=True)
+        assert fc.t == 0.0  # only the kick can explain admission
+        assert sorted(bindings(cluster).values()) == ["n0", "n1"]
+
+    def test_mid_creation_wait_does_not_burn_backoff(self):
+        """A gang observed mid-creation (_WAIT) polls at the base rate:
+        no attempts escalation, no failed-admission counter — its first
+        REAL capacity failure must start the schedule at base_backoff."""
+        from kubeflow_tpu.control.scheduler.scheduler import GangScheduler
+
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        # half a gang, as a watch could observe it mid-creation
+        pod = ob.new_object(
+            "v1", "Pod", "gang-worker-0", "default",
+            labels={JT.LABEL_JOB_NAME: "gang"},
+            annotations={ANNOTATION_GANG_SIZE: "2",
+                         ANNOTATION_PRIORITY: "0"})
+        pod["spec"] = {"schedulerName": SCHEDULER_NAME,
+                       "schedulingGates": [{"name": GATE_GANG}],
+                       "containers": [{"name": "jax"}]}
+        cluster.create(pod)
+        for _ in range(4):
+            sched_ctl.run_until_idle(advance_delayed=True)
+        rec = sched_ctl.reconciler
+        assert isinstance(rec, GangScheduler)
+        e = rec.queue.get("default", "gang")
+        assert e is not None and e.attempts == 0
+        assert "scheduler_requeues_total" not in reg.render()
+
+    def test_deleting_running_gang_kicks_backoff(self):
+        """Chips freed by DELETING a Running gang (not just a terminal
+        phase) must not wait out a queued gang's backoff."""
+        from kubeflow_tpu.control.scheduler.scheduler import GangScheduler
+
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(gang_job("a", replicas=2))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        assert ob.cond_is_true(
+            cluster.get(JT.API_VERSION, JT.KIND, "a", "default"),
+            JT.COND_RUNNING)
+        cluster.create(gang_job("b", replicas=2))  # equal priority: queues
+        pump([jax_ctl, sched_ctl], fc, kubelet, rounds=3)
+        rec = sched_ctl.reconciler
+        assert isinstance(rec, GangScheduler)
+        for _ in range(3):  # push b deep into backoff
+            rec.queue.requeue("default", "b")
+        assert rec.queue.get("default", "b").not_before > fc.t
+        # delete the RUNNING gang a: its pods cascade-delete at phase
+        # Running — capacity frees with no terminal phase ever seen
+        cluster.delete(JT.API_VERSION, JT.KIND, "a", "default")
+        for _ in range(4):  # drain WITHOUT advancing the clock
+            sched_ctl.run_until_idle(advance_delayed=True)
+            jax_ctl.run_until_idle(advance_delayed=True)
+        b = bindings(cluster)
+        assert {b["b-worker-0"], b["b-worker-1"]} == {"n0", "n1"}
+
+    def test_strict_fifo_head_blocks_lower_priority(self):
+        """Kueue-StrictFIFO semantics: a blocked high-priority gang
+        holds the queue — a smaller low-priority gang that WOULD fit
+        must not jump it (no starvation of big jobs)."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0", topology="2x2"))  # 4 chips
+        # big: 2 slices x 2 workers x 2 chips = 8 chips (needs 2 hosts)
+        cluster.create(gang_job("big", replicas=2, chips=2, topology="2x2",
+                                slice_count=2, priority=5))
+        # small: 2 workers x 2 chips = 4 chips (fits n0 alone)
+        cluster.create(gang_job("small", replicas=2, chips=2,
+                                topology="2x2", priority=0))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        assert all(n is None for n in bindings(cluster).values())
+
+    def test_failed_bind_releases_whole_gang_and_no_pod_was_runnable(self):
+        """All-or-nothing under a mid-bind failure: nodeName lands for
+        every pod BEFORE any gate lifts, so a kubelet polling between
+        patches never sees a runnable partial gang; after the failure
+        everything is unbound and re-gated."""
+        from kubeflow_tpu.control.scheduler.scheduler import GangScheduler
+
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(gang_job("gang", replicas=2))
+        jax_ctl.run_until_idle()
+
+        runnable_seen = []
+        orig_patch = cluster.patch
+        calls = {"n": 0}
+
+        def failing_patch(api, kind, name, patch, ns=None):
+            if kind == "Pod" and "spec" in (patch or {}):
+                calls["n"] += 1
+                # the invariant: a kubelet polling between scheduler
+                # patches must never find a runnable (ungated+bound)
+                # pod while any of its gang-mates is still unbound —
+                # that would be a startable partial gang
+                pods = cluster.list("v1", "Pod", namespace="default")
+                if any(not p["spec"].get("nodeName") for p in pods):
+                    for p in pods:
+                        if p["spec"].get("nodeName") and \
+                                not p["spec"].get("schedulingGates"):
+                            runnable_seen.append(ob.meta(p)["name"])
+                if calls["n"] == 3:  # first gate-lift attempt
+                    raise ob.Conflict("injected mid-bind failure")
+            return orig_patch(api, kind, name, patch, ns)
+
+        cluster.patch = failing_patch
+        try:
+            sched_ctl.run_until_idle(advance_delayed=True)
+        finally:
+            cluster.patch = orig_patch
+        assert runnable_seen == []  # the invariant under test
+        # the failed attempt was fully rolled back and (backoff kicked
+        # by the release events) retried to a clean full admission
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        assert sorted(bindings(cluster).values()) == ["n0", "n1"]
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert not p["spec"].get("schedulingGates")
+
+    def test_head_blocking_is_per_namespace(self):
+        """Multi-tenancy: an unplaceable gang at the head of namespace
+        A's queue must not stop namespace B's gang from admitting."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))                  # 2x4 pool, 1 host
+        cluster.create(new_tpu_node("nb", topology="2x2"))  # tenant B's pool
+        # tenant A: needs two 2x4 hosts, only one exists -> blocked head
+        cluster.create(gang_job("big-a", replicas=2, priority=10))
+        # tenant B: fits its own pool
+        cluster.create(gang_job("fit-b", replicas=1, topology="2x2",
+                                namespace="tenant-b"))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster, "tenant-b")
+        assert b == {"fit-b-worker-0": "nb"}, b
+        assert all(n is None for n in bindings(cluster).values())
+
+    def test_topology_spelling_is_normalized_for_placement(self):
+        """parse_topology tolerates '2X4'; the pod selector must carry
+        the canonical spelling or it can never match a node label."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0", topology="2x4"))
+        cluster.create(new_tpu_node("n1", topology="2x4"))
+        cluster.create(gang_job("gang", replicas=2, topology="2X4"))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        assert sorted(bindings(cluster).values()) == ["n0", "n1"]
+
+    def test_non_gang_jobs_ignore_the_scheduler(self):
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        job = JT.new_jaxjob("plain", replicas=1)   # no gang_schedule
+        cluster.create(job)
+        pump([jax_ctl, sched_ctl], fc)
+        pod = cluster.get("v1", "Pod", worker_name("plain", 0), "default")
+        assert "schedulerName" not in pod["spec"]
+        assert "schedulingGates" not in pod["spec"]
+
+
+class TestPriorityPreemption:
+    def test_high_priority_gang_preempts_low(self):
+        """End to end through the existing JAXJob gang-restart path:
+        the evicted low-priority gang restarts (preemption budget, not
+        the crash budget) and requeues behind the preemptor."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(gang_job("low", replicas=2, priority=0))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "low", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+
+        cluster.create(gang_job("high", replicas=2, priority=10))
+        pump([jax_ctl, sched_ctl], fc, kubelet, rounds=14)
+
+        high = cluster.get(JT.API_VERSION, JT.KIND, "high", "default")
+        assert ob.cond_is_true(high, JT.COND_RUNNING)
+        b = bindings(cluster)
+        assert {b["high-worker-0"], b["high-worker-1"]} == {"n0", "n1"}
+        # the low gang went through the preemption path, not a crash
+        low = cluster.get(JT.API_VERSION, JT.KIND, "low", "default")
+        assert low["status"].get("preemptions", 0) >= 1
+        assert low["status"].get("restarts", 0) == 0
+        assert not ob.cond_is_true(low, JT.COND_FAILED)
+        # its recreated pods wait unbound in the queue (no capacity)
+        assert b["low-worker-0"] is None and b["low-worker-1"] is None
+        text = reg.render()
+        assert 'scheduler_preemptions_total{namespace="default"} 1' in text
+
+    def test_preempted_capacity_goes_to_the_preemptor_not_a_thief(self):
+        """No priority inversion across namespaces: chips freed by an
+        eviction must land on the high-priority preemptor, never on a
+        lower-priority gang queued in another namespace — otherwise a
+        priority-5 gang dies so a priority-1 gang can run, and the
+        evictions cascade."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(gang_job("victim", replicas=2, priority=5))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        # "aaa" sorts before "bbb": the naive alphabetical walk would
+        # visit the priority-1 thief right after the eviction
+        cluster.create(gang_job("high", replicas=2, priority=10,
+                                namespace="bbb"))
+        cluster.create(gang_job("thief", replicas=2, priority=1,
+                                namespace="aaa"))
+        pump([jax_ctl, sched_ctl], fc, kubelet, rounds=14)
+        high = cluster.get(JT.API_VERSION, JT.KIND, "high", "bbb")
+        assert ob.cond_is_true(high, JT.COND_RUNNING)
+        hb = bindings(cluster, "bbb")
+        assert {hb["high-worker-0"], hb["high-worker-1"]} == {"n0", "n1"}
+        assert all(n is None for n in bindings(cluster, "aaa").values())
+        # exactly ONE eviction (the victim), never a cascade via thief
+        text = reg.render()
+        assert 'scheduler_preemptions_total{namespace="default"} 1' in text
+        assert 'scheduler_preemptions_total{namespace="aaa"}' not in text
+        thief = cluster.get(JT.API_VERSION, JT.KIND, "thief", "aaa")
+        assert not ob.cond_is_true(thief, JT.COND_RUNNING)
+        assert thief["status"].get("preemptions", 0) == 0
+
+    def test_victims_in_other_pools_are_never_evicted(self):
+        """A gang blocked on the v5e pool must not evict a lower-priority
+        gang running on a different-topology pool — freeing those nodes
+        gains it nothing."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("small-0", topology="2x2"))  # 2x2 pool
+        cluster.create(gang_job("low", replicas=2, chips=2,
+                                topology="2x2", priority=0))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "low", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+        # high wants the (empty) 2x4 pool — nothing to preempt there
+        cluster.create(gang_job("high", replicas=2, priority=10))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        low = cluster.get(JT.API_VERSION, JT.KIND, "low", "default")
+        assert low["status"].get("preemptions", 0) == 0
+        assert ob.cond_is_true(low, JT.COND_RUNNING)
+        assert "scheduler_preemptions_total" not in reg.render()
+
+    def test_equal_priority_never_preempts(self):
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(gang_job("first", replicas=2, priority=3))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        cluster.create(gang_job("second", replicas=2, priority=3))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        assert {b["first-worker-0"], b["first-worker-1"]} == {"n0", "n1"}
+        assert b["second-worker-0"] is None
+        assert "scheduler_preemptions_total" not in reg.render()
+
+
+class TestGangPodRendering:
+    def test_gated_pods_carry_the_gang_contract(self):
+        fc = FakeClock()
+        cluster, jax_ctl, _sched, _k, _r = sched_world(fc)
+        cluster.create(gang_job("gang", replicas=2, priority=4))
+        jax_ctl.run_until_idle()
+        pod = cluster.get("v1", "Pod", worker_name("gang", 0), "default")
+        assert pod["spec"]["schedulerName"] == SCHEDULER_NAME
+        assert pod["spec"]["schedulingGates"] == [{"name": GATE_GANG}]
+        anns = ob.annotations_of(pod)
+        assert anns[ANNOTATION_GANG_SIZE] == "2"
+        assert anns[ANNOTATION_PRIORITY] == "4"
+
+    def test_template_annotations_cannot_override_the_gang_contract(self):
+        """The controller owns gang-size/priority: a stale template
+        annotation must not shrink the gang (which would re-enable
+        partial placement) or skew preemption ordering."""
+        fc = FakeClock()
+        cluster, jax_ctl, _sched, _k, _r = sched_world(fc)
+        job = gang_job("gang", replicas=2, priority=7)
+        job["spec"]["template"].setdefault("metadata", {})["annotations"] = {
+            ANNOTATION_GANG_SIZE: "1", ANNOTATION_PRIORITY: "99"}
+        cluster.create(job)
+        jax_ctl.run_until_idle()
+        pod = cluster.get("v1", "Pod", worker_name("gang", 0), "default")
+        anns = ob.annotations_of(pod)
+        assert anns[ANNOTATION_GANG_SIZE] == "2"
+        assert anns[ANNOTATION_PRIORITY] == "7"
+
+    def test_foreign_scheduler_name_passes_through_ungated(self):
+        """Only the scheduler that will lift a gate may add one: a job
+        naming some OTHER scheduler must not get our gate (nothing
+        would ever lift it — the pods would hang Pending forever)."""
+        fc = FakeClock()
+        cluster, jax_ctl, _sched, _k, _r = sched_world(fc)
+        job = JT.new_jaxjob("other", replicas=1)
+        job["spec"]["schedulerName"] = "my-custom-scheduler"
+        cluster.create(job)
+        jax_ctl.run_until_idle()
+        pod = cluster.get("v1", "Pod", worker_name("other", 0), "default")
+        assert pod["spec"]["schedulerName"] == "my-custom-scheduler"
+        assert "schedulingGates" not in pod["spec"]
+        anns = ob.annotations_of(pod)
+        assert ANNOTATION_GANG_SIZE not in anns
+
+    def test_foreign_gate_defers_admission_until_lifted(self):
+        """Kube gate semantics end to end: a pod with ANY foreign gate
+        is unschedulable, so its gang must not reserve chips (or
+        preempt anyone) — admission waits until the foreign controller
+        lifts its gate, then binds and removes only OUR gate."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        job = gang_job("gang", replicas=2)
+        # template names ONLY the foreign gate — the controller must
+        # APPEND ours (a setdefault would silently drop it)
+        job["spec"]["template"]["spec"]["schedulingGates"] = [
+            {"name": "quota.example.com/hold"}]
+        cluster.create(job)
+        jax_ctl.run_until_idle()
+        pod = cluster.get("v1", "Pod", worker_name("gang", 0), "default")
+        assert {g["name"] for g in pod["spec"]["schedulingGates"]} == {
+            "quota.example.com/hold", GATE_GANG}
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert p["spec"].get("nodeName") is None  # capacity untouched
+        # the quota controller lifts its hold
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            p["spec"]["schedulingGates"] = [
+                g for g in p["spec"]["schedulingGates"]
+                if g["name"] == GATE_GANG]
+            cluster.update(p)
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert p["spec"]["nodeName"] in ("n0", "n1")
+            assert not p["spec"].get("schedulingGates")
+            assert (p.get("status") or {}).get("phase") == "Running"
+
+    def test_priority_must_be_int(self):
+        job = JT.new_jaxjob("j", replicas=1)
+        job["spec"]["priority"] = "urgent"
+        assert any("spec.priority" in e for e in JT.validate(job))
+
+
+# -- kubelet binding contract ------------------------------------------------
+
+
+class TestFakeKubeletBinding:
+    def _pod(self, cluster, name="p0", gates=None, node=None):
+        pod = ob.new_object("v1", "Pod", name, "default")
+        pod["spec"] = {"containers": [{"name": "main"}]}
+        if gates:
+            pod["spec"]["schedulingGates"] = gates
+        if node:
+            pod["spec"]["nodeName"] = node
+        return cluster.create(pod)
+
+    def test_auto_bind_compat_binds_and_runs(self):
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)           # compat default
+        self._pod(cluster)
+        assert kubelet.step() == 1
+        pod = cluster.get("v1", "Pod", "p0", "default")
+        assert pod["status"]["phase"] == "Running"
+        assert pod["spec"]["nodeName"] == "fake-node"
+        # the backing node exists and is Ready (slice-health checks
+        # treat a missing node as unhealthy)
+        node = cluster.get("v1", "Node", "fake-node")
+        assert node["status"]["conditions"][0]["status"] == "True"
+
+    def test_without_auto_bind_only_bound_pods_run(self):
+        cluster = FakeCluster()
+        cluster.create(new_tpu_node("n0"))
+        kubelet = FakeKubelet(cluster, auto_bind=False)
+        self._pod(cluster, "unbound")
+        self._pod(cluster, "bound", node="n0")
+        assert kubelet.step() == 1
+        assert (cluster.get("v1", "Pod", "unbound", "default")
+                .get("status") or {}).get("phase") is None
+        assert cluster.get("v1", "Pod", "bound", "default")[
+            "status"]["phase"] == "Running"
+
+    def test_gated_pods_never_run_even_with_auto_bind(self):
+        cluster = FakeCluster()
+        kubelet = FakeKubelet(cluster)
+        self._pod(cluster, gates=[{"name": GATE_GANG}])
+        assert kubelet.step() == 0
+        pod = cluster.get("v1", "Pod", "p0", "default")
+        assert pod["spec"].get("nodeName") is None
+
+
+class TestExecutorBindOnce:
+    def _exec_pod(self, name="p0", node=None, gates=None):
+        pod = ob.new_object("v1", "Pod", name, "default")
+        pod["spec"] = {"containers": [
+            {"name": "main", "command": [sys.executable, "-c", "pass"]}]}
+        if node:
+            pod["spec"]["nodeName"] = node
+        if gates:
+            pod["spec"]["schedulingGates"] = gates
+        return pod
+
+    def test_respects_scheduler_binding(self):
+        """Bind-once: a pod the gang scheduler already placed keeps its
+        node — the executor must not race it with its own node_name."""
+        cluster = FakeCluster()
+        ex = LocalPodExecutor(cluster, node_name="exec-node")
+        cluster.create(self._exec_pod(node="tpu-node-7"))
+        try:
+            ex.run_until_settled(timeout=30)
+        finally:
+            ex.shutdown()
+        pod = cluster.get("v1", "Pod", "p0", "default")
+        assert pod["spec"]["nodeName"] == "tpu-node-7"
+        assert pod["status"]["phase"] == "Succeeded"
+
+    def test_self_binds_when_unbound(self):
+        cluster = FakeCluster()
+        ex = LocalPodExecutor(cluster, node_name="exec-node")
+        cluster.create(self._exec_pod())
+        try:
+            ex.run_until_settled(timeout=30)
+        finally:
+            ex.shutdown()
+        pod = cluster.get("v1", "Pod", "p0", "default")
+        assert pod["spec"]["nodeName"] == "exec-node"
+
+    def test_skips_gated_pods(self):
+        cluster = FakeCluster()
+        ex = LocalPodExecutor(cluster)
+        cluster.create(self._exec_pod(gates=[{"name": GATE_GANG}]))
+        try:
+            ex.poll_once()
+            assert ex.alive_count() == 0
+        finally:
+            ex.shutdown()
+        pod = cluster.get("v1", "Pod", "p0", "default")
+        assert (pod.get("status") or {}).get("phase") is None
