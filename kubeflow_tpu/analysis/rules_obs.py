@@ -1,0 +1,87 @@
+"""tpulint observability rule (OBS301): wall-clock duration math.
+
+``time.time()`` is wall clock: NTP slew/step can make consecutive
+readings go backwards or jump, so a latency computed as
+``time.time() - t0`` can be negative or wildly wrong — and those are
+exactly the numbers the span pipeline and the Prometheus histograms
+publish. Duration math must use ``time.perf_counter()`` (monotonic,
+high resolution); ``obs/trace.py`` converts perf_counter readings to
+epoch timestamps through a single module-level wall anchor.
+
+What fires: a subtraction whose operand is a ``time.time()`` call, or a
+name bound to one in the same scope. What stays silent (FP pins in
+tests/test_tpulint.py): deadline arithmetic (``time.time() + ttl``),
+expiry comparisons (``exp < time.time()``), plain timestamping, and all
+``perf_counter``/``monotonic`` math.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, Rule, dotted, register,
+)
+
+
+def _time_time_aliases(module: Module) -> set[str]:
+    """Dotted spellings that resolve to time.time in this module."""
+    aliases = {"time.time"}
+    for node in ast.walk(module.tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name == "time":
+                    aliases.add(a.asname or "time")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == "time" and a.asname:
+                    aliases.add(f"{a.asname}.time")
+    return aliases
+
+
+@register
+class WallClockDuration(Rule):
+    id = "OBS301"
+    name = "wall-clock-duration"
+    short = "time.time() used to measure a duration; use time.perf_counter()"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        aliases = _time_time_aliases(module)
+
+        def is_time_time(node: ast.AST) -> bool:
+            return (isinstance(node, ast.Call)
+                    and dotted(node.func) in aliases)
+
+        # names bound to a time.time() reading, keyed by enclosing
+        # function (None = module level) so an unrelated local called
+        # `t0` in another function never taints this one
+        tainted: dict[ast.AST | None, set[str]] = {}
+        for node in ast.walk(module.tree):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign) and is_time_time(node.value):
+                targets = node.targets
+            elif (isinstance(node, ast.AnnAssign) and node.value is not None
+                    and is_time_time(node.value)):
+                targets = [node.target]
+            for tgt in targets:
+                if isinstance(tgt, ast.Name):
+                    scope = module.enclosing_function(node)
+                    tainted.setdefault(scope, set()).add(tgt.id)
+
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.BinOp)
+                    and isinstance(node.op, ast.Sub)):
+                continue
+            scope = module.enclosing_function(node)
+            names = tainted.get(scope, set()) | tainted.get(None, set())
+
+            def wallish(operand: ast.AST) -> bool:
+                return is_time_time(operand) or (
+                    isinstance(operand, ast.Name) and operand.id in names)
+
+            if wallish(node.left) or wallish(node.right):
+                yield self.finding(
+                    module, node,
+                    "duration computed from time.time(); wall clock can "
+                    "step/slew under NTP — use time.perf_counter()")

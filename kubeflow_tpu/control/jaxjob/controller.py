@@ -33,6 +33,7 @@ from kubeflow_tpu.control.scheduler import (
     ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
 )
 from kubeflow_tpu.control.scheduler.topology import parse_topology
+from kubeflow_tpu.obs import trace as obs_trace
 
 log = logging.getLogger("kubeflow_tpu.jaxjob")
 
@@ -74,6 +75,51 @@ def worker_name(job_name: str, index: int) -> str:
 class JAXJobReconciler(Reconciler):
     def __init__(self, record_events: bool = True):
         self.record_events = record_events
+        # open per-job root spans ("JAXJob created" -> gang running),
+        # keyed by (namespace, name); their ids are exactly the
+        # traceparent stamped into the job + pod annotations, so every
+        # scheduler/worker span downstream parents into this root
+        self._roots: dict[tuple[str, str], obs_trace.Span] = {}
+
+    # -- trace propagation ---------------------------------------------------
+
+    def _ensure_traceparent(self, client, job: dict) -> dict:
+        """Mint the job's trace context on first sight and stamp it into
+        the job's annotations (the durable carrier across reconciles and
+        controller restarts); open the root span under those exact ids."""
+        m = ob.meta(job)
+        if (m.get("annotations") or {}).get(obs_trace.TRACEPARENT_ANNOTATION):
+            return job
+        ctx = obs_trace.SpanContext(
+            obs_trace.new_trace_id(), obs_trace.new_span_id())
+        # resourceVersion precondition: two workers racing the first
+        # reconcile would otherwise BOTH mint a context (last write
+        # wins, orphaning one root span). The loser 409s — a benign
+        # immediate retry that then sees the winner's annotation.
+        job = client.patch(
+            T.API_VERSION, T.KIND, m["name"],
+            {"metadata": {
+                "resourceVersion": m["resourceVersion"],
+                "annotations": {
+                    obs_trace.TRACEPARENT_ANNOTATION: ctx.to_traceparent()}}},
+            m["namespace"])
+        self._roots[(m["namespace"], m["name"])] = obs_trace.TRACER.begin(
+            "jaxjob", context=ctx, detached=True,
+            namespace=m["namespace"], job=m["name"])
+        return job
+
+    def _job_context(self, job: dict) -> obs_trace.SpanContext | None:
+        return obs_trace.parse_traceparent(
+            (ob.meta(job).get("annotations") or {})
+            .get(obs_trace.TRACEPARENT_ANNOTATION))
+
+    def _finish_root(self, namespace: str, name: str, outcome: str) -> None:
+        """Close the submit→outcome root span (no-op when this process
+        never opened one, e.g. after a controller restart)."""
+        root = self._roots.pop((namespace, name), None)
+        if root is not None:
+            root.attrs["outcome"] = outcome
+            obs_trace.TRACER.finish(root)
 
     # -- generate* ----------------------------------------------------------
 
@@ -129,6 +175,14 @@ class JAXJobReconciler(Reconciler):
             {"name": T.ENV_NAME, "value": m["name"]},
             {"name": T.ENV_NAMESPACE, "value": m["namespace"]},
         ]
+        traceparent = (m.get("annotations") or {}).get(
+            obs_trace.TRACEPARENT_ANNOTATION)
+        if traceparent:
+            # end-to-end propagation: the scheduler reads the annotation
+            # (its admission spans), the launcher/trainer read the env
+            # var (worker + step spans) — all children of the job root
+            env.append({"name": obs_trace.TRACEPARENT_ENV,
+                        "value": traceparent})
         if slices > 1:
             from kubeflow_tpu.parallel import dist as D
 
@@ -162,6 +216,8 @@ class JAXJobReconciler(Reconciler):
         if slices > 1:
             labels[T.LABEL_SLICE_INDEX] = str(slice_id)
         annotations = dict(tmpl.get("metadata", {}).get("annotations") or {})
+        if traceparent:
+            annotations[obs_trace.TRACEPARENT_ANNOTATION] = traceparent
         if spec.get("schedulerName"):
             pod_spec["schedulerName"] = spec["schedulerName"]
         if spec.get("schedulerName") == SCHEDULER_NAME:
@@ -198,7 +254,10 @@ class JAXJobReconciler(Reconciler):
     def reconcile(self, client, req: Request) -> Result | None:
         job = client.get_or_none(T.API_VERSION, T.KIND, req.name, req.namespace)
         if job is None:
-            return None  # deleted; ownerRef GC reaps children
+            # deleted; ownerRef GC reaps children. Close any still-open
+            # root span (a job deleted before Running must not leak it).
+            self._finish_root(req.namespace, req.name, "deleted")
+            return None
         m = ob.meta(job)
         if m.get("deletionTimestamp"):
             return None
@@ -210,13 +269,22 @@ class JAXJobReconciler(Reconciler):
             )
             if changed:
                 client.update_status(job)
+            self._finish_root(req.namespace, req.name, "validation-failed")
             return None
 
         if ob.cond_is_true(job, T.COND_SUCCEEDED) or ob.cond_is_true(job, T.COND_FAILED):
-            return None  # terminal
+            # terminal. Close any straggler root span — every terminal
+            # path must export the submit→outcome timeline (a job that
+            # went invalid mid-flight, say) rather than leak it open.
+            self._finish_root(
+                req.namespace, req.name,
+                "failed" if ob.cond_is_true(job, T.COND_FAILED)
+                else "succeeded")
+            return None
 
         if not ob.cond_get(job, T.COND_CREATED):
             jobs_created().inc()
+            job = self._ensure_traceparent(client, job)
             ob.cond_set(job, T.COND_CREATED, "True", "JAXJobCreated",
                         "gang pod set is being provisioned")
             job = client.update_status(job)
@@ -238,23 +306,27 @@ class JAXJobReconciler(Reconciler):
         missing = [i for i in range(replicas) if worker_name(req.name, i) not in by_name]
         if missing and len(missing) == replicas:
             created: list[dict] = []
-            try:
-                for i in missing:
-                    pod = self.generate_pod(job, i)
-                    ob.set_owner(pod, job)
-                    created.append(client.create(pod))
-            except ob.ApiError as e:
-                for p in created:
-                    try:
-                        client.delete("v1", "Pod", ob.meta(p)["name"], req.namespace)
-                    except ob.NotFound:
-                        pass
-                if self.record_events:
-                    client.record_event(
-                        job, "GangCreateFailed",
-                        f"could not create full gang of {replicas}: {e}", "Warning",
-                    )
-                raise  # retry with backoff
+            with obs_trace.TRACER.span(
+                    "jaxjob.provision", parent=self._job_context(job),
+                    namespace=req.namespace, job=req.name,
+                    workers=replicas):
+                try:
+                    for i in missing:
+                        pod = self.generate_pod(job, i)
+                        ob.set_owner(pod, job)
+                        created.append(client.create(pod))
+                except ob.ApiError as e:
+                    for p in created:
+                        try:
+                            client.delete("v1", "Pod", ob.meta(p)["name"], req.namespace)
+                        except ob.NotFound:
+                            pass
+                    if self.record_events:
+                        client.record_event(
+                            job, "GangCreateFailed",
+                            f"could not create full gang of {replicas}: {e}", "Warning",
+                        )
+                    raise  # retry with backoff
             pods = created
             by_name = {ob.meta(p)["name"]: p for p in pods}
         elif missing:
@@ -295,6 +367,7 @@ class JAXJobReconciler(Reconciler):
                 jobs_running().dec()
             if self.record_events:
                 client.record_event(job, "JAXJobSucceeded", "all workers succeeded")
+            self._finish_root(req.namespace, req.name, "succeeded")
             return None
 
         # slice health: a node going NotReady (or tainted for impending
@@ -325,6 +398,10 @@ class JAXJobReconciler(Reconciler):
                 jobs_running().inc()
                 if self.record_events:
                     client.record_event(job, "JAXJobRunning", "gang is running")
+                # the root span's question is "how long from submit to a
+                # running gang?" — close it here; worker/step spans keep
+                # arriving in the same trace as children of its ids
+                self._finish_root(req.namespace, req.name, "running")
             return None
 
         # still scheduling/pending — keep status fresh, poll again
@@ -415,6 +492,7 @@ class JAXJobReconciler(Reconciler):
         client.update_status(job)
         if self.record_events:
             client.record_event(job, "JAXJobFailed", message, "Warning")
+        self._finish_root(req.namespace, req.name, "failed")
         return None
 
     def _gang_restart(self, client, job, pods, reason: str, message: str,
@@ -468,9 +546,10 @@ def _node_mapper(client):
     return fn
 
 
-def build_controller(client, record_events: bool = True) -> Controller:
+def build_controller(client, record_events: bool = True,
+                     registry=None) -> Controller:
     rec = JAXJobReconciler(record_events=record_events)
-    ctl = Controller("jaxjob", client, rec)
+    ctl = Controller("jaxjob", client, rec, registry=registry)
     ctl.watches_primary(T.API_VERSION, T.KIND).owns("v1", "Pod").owns("v1", "Service")
     ctl.maps("v1", "Node", _node_mapper(client))
     return ctl

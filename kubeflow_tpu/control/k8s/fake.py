@@ -129,6 +129,7 @@ class FakeCluster:
     def __init__(self, history_limit: int = 1024):
         self._lock = threading.RLock()
         self._store: dict[Key, dict] = {}
+        self._recorder = None  # lazy EventRecorder (obs/events.py)
         self._rv = 0
         self._watches: list[_Watch] = []
         # Mutating-webhook style interceptors: fn(verb, obj) -> obj.
@@ -614,31 +615,17 @@ class FakeCluster:
         etype: str = "Normal",
         component: str = "kubeflow-tpu",
     ) -> dict:
-        m = ob.meta(involved)
-        ns = m.get("namespace") or "default"
-        ev = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {
-                "name": f"{m['name']}.{uuid.uuid4().hex[:10]}",
-                "namespace": ns,
-            },
-            "involvedObject": {
-                "apiVersion": involved.get("apiVersion"),
-                "kind": involved.get("kind"),
-                "name": m["name"],
-                "namespace": ns,
-                "uid": m.get("uid", ""),
-            },
-            "reason": reason,
-            "message": message,
-            "type": etype,
-            "source": {"component": component},
-            "firstTimestamp": ob.now_iso(),
-            "lastTimestamp": ob.now_iso(),
-            "count": 1,
-        }
-        return self.create(ev)
+        """Record through the shared EventRecorder (obs/events.py): real
+        Event objects with count-dedup — a controller re-recording the
+        same decision bumps count instead of flooding the store."""
+        with self._lock:
+            if self._recorder is None:
+                from kubeflow_tpu.obs.events import EventRecorder
+
+                self._recorder = EventRecorder(self)
+            recorder = self._recorder
+        return recorder.event(involved, reason, message, etype,
+                              component=component)
 
     # -- convenience --------------------------------------------------------
 

@@ -109,6 +109,11 @@ class RestClient:
         if token:
             self._s.headers["Authorization"] = f"Bearer {token}"
         self._s.verify = ca_cert if ca_cert is not None else False
+        # eager (not lazy-on-first-event): two worker threads racing a
+        # lazy init would build two recorders with split dedup maps
+        from kubeflow_tpu.obs.events import EventRecorder
+
+        self._event_recorder = EventRecorder(self)
 
     # -- path construction --------------------------------------------------
 
@@ -279,30 +284,10 @@ class RestClient:
         etype: str = "Normal",
         component: str = "kubeflow-tpu",
     ) -> dict:
-        import uuid
-
-        m = ob.meta(involved)
-        ns = m.get("namespace") or "default"
-        ev = {
-            "apiVersion": "v1",
-            "kind": "Event",
-            "metadata": {"name": f"{m['name']}.{uuid.uuid4().hex[:10]}", "namespace": ns},
-            "involvedObject": {
-                "apiVersion": involved.get("apiVersion"),
-                "kind": involved.get("kind"),
-                "name": m["name"],
-                "namespace": ns,
-                "uid": m.get("uid", ""),
-            },
-            "reason": reason,
-            "message": message,
-            "type": etype,
-            "source": {"component": component},
-            "firstTimestamp": ob.now_iso(),
-            "lastTimestamp": ob.now_iso(),
-            "count": 1,
-        }
-        return self.create(ev)
+        """Same EventRecorder (count-dedup) as FakeCluster.record_event —
+        controllers get identical event semantics on either backend."""
+        return self._event_recorder.event(involved, reason, message, etype,
+                                          component=component)
 
     def watch(self, api_version: str, kind: str, namespace: str | None = None):
         """Streaming watch (chunked JSON lines), reconnecting on EOF."""

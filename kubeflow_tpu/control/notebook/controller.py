@@ -228,12 +228,20 @@ class NotebookReconciler(Reconciler):
         # re-emit pod events onto the Notebook (:565-613)
         self._forward_pod_events(client, nb, pods)
 
-        ob.cond_set(
+        ready = bool(status.get("readyReplicas"))
+        changed = ob.cond_set(
             nb, "Ready",
-            "True" if status.get("readyReplicas") else "False",
-            "NotebookReady" if status.get("readyReplicas") else "NotebookNotReady",
+            "True" if ready else "False",
+            "NotebookReady" if ready else "NotebookNotReady",
         )
         client.update_status(nb)
+        if changed:
+            # readiness transitions are decision points worth an Event
+            # (count-dedup in obs/events.py absorbs flapping pods)
+            client.record_event(
+                nb, "NotebookReady" if ready else "NotebookNotReady",
+                f"readyReplicas={status.get('readyReplicas', 0)}",
+                "Normal" if ready else "Warning")
 
         # -- culling (:250 -> culler.GetRequeueTime) ------------------------
         if culler.enabled() and not culler.is_stopped(nb):

@@ -25,6 +25,8 @@ import time
 from typing import Callable
 
 from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
 
 log = logging.getLogger("kubeflow_tpu.control")
 
@@ -67,15 +69,22 @@ def _owner_mapper(owner_kind: str) -> Callable[[dict], list[Request]]:
 class Controller:
     MAX_RETRIES = 8
 
-    def __init__(self, name: str, client, reconciler: Reconciler):
+    def __init__(self, name: str, client, reconciler: Reconciler,
+                 registry: MetricsRegistry | None = None, tracer=None):
         self.name = name
         self.client = client
         self.reconciler = reconciler
+        self.registry = registry if registry is not None else REGISTRY
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
         self._sources: list[_Source] = []
         self._primary: tuple[str, str] | None = None
         self._queue: dict[Request, None] = {}  # ordered set
         self._delayed: list[tuple[float, Request]] = []
         self._failures: dict[Request, int] = {}
+        # first-enqueue time per key, for the workqueue-wait histogram
+        # and the reconcile span's queue_wait_s attribute (the answer to
+        # "why did my job take 40s to start" when the queue was deep)
+        self._enqueued_at: dict[Request, float] = {}
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._streams: list = []
@@ -104,12 +113,24 @@ class Controller:
     def enqueue(self, req: Request) -> None:
         with self._cv:
             self._queue[req] = None
+            self._enqueued_at.setdefault(req, time.monotonic())
+            self._report_depth_locked()
             self._cv.notify_all()
 
     def enqueue_after(self, req: Request, delay: float) -> None:
         with self._cv:
             self._delayed.append((time.monotonic() + delay, req))
             self._cv.notify_all()
+
+    def _report_depth_locked(self) -> None:
+        """Publish the depth gauge WHILE holding _cv: read+report must be
+        atomic against other reporters, or a stale depth published late
+        overwrites a newer one and the gauge sticks wrong on an idle
+        queue. (_cv -> registry lock only, never the reverse.)"""
+        self.registry.gauge(
+            "workqueue_depth", len(self._queue),
+            help_="reconcile keys queued, per controller",
+            controller=self.name)
 
     def _dispatch(self, src: _Source, obj: dict) -> None:
         if src.mapper is None:
@@ -126,29 +147,71 @@ class Controller:
         self._delayed = [(t, r) for t, r in self._delayed if t > now]
         for r in due:
             self._queue[r] = None
+            # queue wait counts from (re)entry into the hot queue, not
+            # from when the requeue-after timer was armed
+            self._enqueued_at.setdefault(r, now)
         if self._delayed:
             return max(0.0, min(t for t, _ in self._delayed) - now)
         return None
 
     def _process_one(self, req: Request) -> None:
+        now = time.monotonic()
+        with self._cv:
+            t_enq = self._enqueued_at.pop(req, None)
+            attempt = self._failures.get(req, 0) + 1
+            self._report_depth_locked()
+        wait = max(now - t_enq, 0.0) if t_enq is not None else 0.0
+        self.registry.histogram(
+            "workqueue_wait_seconds", wait,
+            help_="time a reconcile key spent queued before processing",
+            controller=self.name)
+        span = self.tracer.begin(
+            "reconcile", controller=self.name, namespace=req.namespace,
+            object=req.name, attempt=attempt, queue_wait_s=round(wait, 6))
+        result = "success"
+        t0 = time.perf_counter()
         try:
             res = self.reconciler.reconcile(self.client, req)
             with self._cv:
                 self._failures.pop(req, None)
             if res and res.requeue_after:
+                result = "requeue"
                 self.enqueue_after(req, res.requeue_after)
         except ob.Conflict:
             # optimistic-concurrency loser: immediate benign retry
+            result = "conflict"
             self.enqueue(req)
-        except Exception:
+        except Exception as e:
+            result = "error"
+            span.status = "ERROR"
+            span.error = f"{type(e).__name__}: {e}"
             with self._cv:
                 n = self._failures.get(req, 0) + 1
                 self._failures[req] = n
+            self.registry.counter_inc(
+                "controller_reconcile_retries_total",
+                help_="reconciles retried after an error",
+                controller=self.name)
             if n <= self.MAX_RETRIES:
                 log.exception("%s: reconcile %s failed (attempt %d)", self.name, req, n)
                 self.enqueue_after(req, min(0.01 * (2**n), 5.0))
             else:
                 log.error("%s: reconcile %s dropped after %d attempts", self.name, req, n)
+                # dropping ends this failure streak: a later event-driven
+                # reconcile of the same key starts from attempt 1 with a
+                # full retry budget (and a truthful span attribute)
+                with self._cv:
+                    self._failures.pop(req, None)
+        finally:
+            span.attrs["result"] = result
+            self.tracer.finish(span)
+            self.registry.counter_inc(
+                "controller_reconcile_total",
+                help_="reconciles by outcome",
+                controller=self.name, result=result)
+            self.registry.histogram(
+                "controller_reconcile_seconds", time.perf_counter() - t0,
+                help_="reconcile latency", controller=self.name)
 
     # -- production mode ----------------------------------------------------
 
@@ -262,7 +325,10 @@ class Controller:
             with self._cv:
                 self._pump_delayed()
                 if not self._queue and advance_delayed and self._delayed:
-                    self._queue.update({r: None for _, r in self._delayed})
+                    now = time.monotonic()
+                    for _, r in self._delayed:
+                        self._queue[r] = None
+                        self._enqueued_at.setdefault(r, now)
                     self._delayed = []
                     advance_delayed = False  # one synthetic advance per call
                 if not self._queue:

@@ -43,7 +43,12 @@ from kubeflow_tpu.control.scheduler import (
 )
 from kubeflow_tpu.control.scheduler import nodes as N
 from kubeflow_tpu.control.scheduler.queue import GangQueue
+from kubeflow_tpu.obs import trace as obs_trace
 from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
+
+# Queue-to-bound latency buckets: scheduling is sub-second when capacity
+# exists, minutes when a gang waits behind backoff/preemption.
+BIND_LATENCY_BUCKETS = (0.1, 0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600)
 
 log = logging.getLogger("kubeflow_tpu.scheduler")
 
@@ -72,6 +77,19 @@ def _gang_annotation(pods: list[dict], key: str) -> int | None:
                 return int(v)
             except ValueError:
                 return None
+    return None
+
+
+def _gang_context(pods: list[dict]) -> obs_trace.SpanContext | None:
+    """The job's trace context, read from the traceparent annotation the
+    JAXJob controller stamps on gang pods — admission/bind/preemption
+    spans parent on it so the scheduler's work appears inside the job's
+    own timeline, not in a disconnected trace."""
+    for p in pods:
+        ctx = obs_trace.parse_traceparent(
+            ob.annotations_of(p).get(obs_trace.TRACEPARENT_ANNOTATION))
+        if ctx is not None:
+            return ctx
     return None
 
 
@@ -115,7 +133,13 @@ class GangScheduler(Reconciler):
             self.queue.remove(req.namespace, req.name)
             return
         prio = _gang_annotation(pods, ANNOTATION_PRIORITY) or 0
+        newly = self.queue.get(req.namespace, req.name) is None
         self.queue.offer(req.namespace, req.name, priority=prio)
+        if newly and self.record_events and hasattr(client, "record_event"):
+            client.record_event(
+                pending[0], "GangQueued",
+                f"gang {req.namespace}/{req.name} queued for admission "
+                f"(priority {prio})", component=SCHEDULER_NAME)
 
     def _schedule_pass(self, client) -> float | None:
         """Admit queued gangs, per namespace, in strict priority/FIFO
@@ -199,6 +223,18 @@ class GangScheduler(Reconciler):
 
     def _try_admit(self, client, entry) -> str:
         pods = self._gang_pods(client, entry.namespace, entry.name)
+        with obs_trace.TRACER.span(
+                "scheduler.admit", parent=_gang_context(pods),
+                namespace=entry.namespace, gang=entry.name,
+                attempt=entry.attempts,
+                queue_wait_s=round(
+                    max(self.queue.clock() - entry.enqueued_at, 0.0),
+                    6)) as sp:
+            outcome = self._admit(client, entry, pods)
+            sp.attrs["outcome"] = outcome
+            return outcome
+
+    def _admit(self, client, entry, pods: list[dict]) -> str:
         if self._repair_stragglers(client, entry.namespace, pods):
             pods = self._gang_pods(client, entry.namespace, entry.name)
         pending = sorted((p for p in pods if self._unbound_pending(p)),
@@ -211,6 +247,14 @@ class GangScheduler(Reconciler):
         free, views = self._free_chips(client)
         assignment = self._assign(pending, views, free)
         if assignment is None:
+            if self.record_events and hasattr(client, "record_event"):
+                # dedup (obs/events.py) collapses the retry storm: one
+                # Event whose count tracks the failed attempts
+                client.record_event(
+                    pending[0], "GangUnschedulable",
+                    f"gang {entry.namespace}/{entry.name}: no node set "
+                    f"fits all {len(pending)} workers", "Warning",
+                    component=SCHEDULER_NAME)
             return _UNPLACEABLE
         if not self._bind(client, entry, assignment):
             return _WAIT
@@ -266,45 +310,47 @@ class GangScheduler(Reconciler):
         gang restart there) — full multi-pod atomicity does not exist
         over an apiserver."""
         bound: list[str] = []
-        try:
-            for pod_name, node_name in sorted(assignment.items()):
-                client.patch(
-                    "v1", "Pod", pod_name,
-                    {"spec": {"nodeName": node_name}},
-                    entry.namespace)
-                bound.append(pod_name)
-            for pod_name in sorted(assignment):
-                self._lift_gate(client, entry.namespace, pod_name)
-        except ob.ApiError as e:
-            log.warning("gang %s/%s: bind failed (%s); releasing %d pods",
-                        entry.namespace, entry.name, e, len(bound))
-            for pod_name in bound:
-                try:
-                    self._release_pod(client, entry.namespace, pod_name)
-                except ob.ApiError:
-                    log.exception("gang %s/%s: release of %s failed",
-                                  entry.namespace, entry.name, pod_name)
-            return False
+        bound_objs: dict[str, dict] = {}
+        with obs_trace.TRACER.span("scheduler.bind",
+                                   workers=len(assignment)) as bind_span:
+            try:
+                for pod_name, node_name in sorted(assignment.items()):
+                    bound_objs[pod_name] = client.patch(
+                        "v1", "Pod", pod_name,
+                        {"spec": {"nodeName": node_name}},
+                        entry.namespace)
+                    bound.append(pod_name)
+                for pod_name in sorted(assignment):
+                    self._lift_gate(client, entry.namespace, pod_name)
+            except ob.ApiError as e:
+                log.warning("gang %s/%s: bind failed (%s); releasing %d pods",
+                            entry.namespace, entry.name, e, len(bound))
+                bind_span.status = "ERROR"
+                bind_span.error = f"{type(e).__name__}: {e}"
+                for pod_name in bound:
+                    try:
+                        self._release_pod(client, entry.namespace, pod_name)
+                    except ob.ApiError:
+                        log.exception("gang %s/%s: release of %s failed",
+                                      entry.namespace, entry.name, pod_name)
+                return False
         latency = max(self.queue.clock() - entry.enqueued_at, 0.0)
         schedule_latency().observe(latency)
-        self.registry.counter_inc(
-            "scheduler_bind_latency_seconds_sum",
-            help_="queue-to-bound gang latency (sum)", by=latency)
-        self.registry.counter_inc(
-            "scheduler_bind_latency_seconds_count",
-            help_="queue-to-bound gang latency (count)")
+        self.registry.histogram(
+            "scheduler_bind_latency_seconds", latency,
+            help_="queue-to-bound gang latency",
+            buckets=BIND_LATENCY_BUCKETS)
         self.registry.counter_inc(
             "scheduler_gangs_admitted_total",
             help_="gangs fully bound", namespace=entry.namespace)
         if self.record_events and hasattr(client, "record_event"):
+            # the bind-phase patch responses already carry everything an
+            # involvedObject needs — no per-pod re-GET on the hot pass
             for pod_name, node_name in sorted(assignment.items()):
-                pod = client.get_or_none("v1", "Pod", pod_name,
-                                         entry.namespace)
-                if pod is not None:
-                    client.record_event(
-                        pod, "Scheduled",
-                        f"gang-bound {pod_name} to {node_name}",
-                        component=SCHEDULER_NAME)
+                client.record_event(
+                    bound_objs[pod_name], "Scheduled",
+                    f"gang-bound {pod_name} to {node_name}",
+                    component=SCHEDULER_NAME)
         return True
 
     def _repair_stragglers(self, client, namespace: str,
@@ -376,6 +422,15 @@ class GangScheduler(Reconciler):
                          key=lambda p: ob.meta(p)["name"])
         if not pending:
             return False
+        with obs_trace.TRACER.span(
+                "scheduler.preempt", parent=_gang_context(pods),
+                namespace=entry.namespace, gang=entry.name,
+                priority=entry.priority) as sp:
+            evicted = self._preempt(client, entry, pending)
+            sp.attrs["evicted"] = evicted
+            return evicted
+
+    def _preempt(self, client, entry, pending: list[dict]) -> bool:
         free, views = self._free_chips(client)
         if self._assign(pending, views, free) is not None:
             # fits without evicting anyone (state moved since the failed
@@ -522,7 +577,7 @@ def build_scheduler(
 ) -> Controller:
     rec = GangScheduler(queue=queue, registry=registry,
                         record_events=record_events, clock=clock)
-    ctl = Controller("gang-scheduler", client, rec)
+    ctl = Controller("gang-scheduler", client, rec, registry=registry)
     ctl.maps("v1", "Pod", _pod_mapper(rec, client))
     ctl.maps("v1", "Node", _node_mapper(rec))
     return ctl

@@ -1,0 +1,41 @@
+"""kubeflow_tpu.obs — end-to-end tracing + structured events.
+
+The reference's observability stops at control-plane Prometheus
+(bootstrap server.go request histograms, notebook-controller
+pkg/metrics); there is no way to answer "why did my job take 40s to
+start?" across layers. This package is the missing spine:
+
+- ``trace``  — zero-dependency span API (trace/span ids, exception-safe
+  context managers, a thread-safe bounded collector) with W3C-style
+  ``traceparent`` encode/decode for cross-process propagation and two
+  exporters: Perfetto/Chrome ``trace_event`` JSON and compact JSONL.
+- ``events`` — the corev1 EventRecorder analogue: real ``Event``
+  objects written through the k8s client, with count-dedup (a repeated
+  identical event bumps ``count``/``lastTimestamp`` instead of
+  flooding etcd).
+
+Propagation contract: the JAXJob controller stamps the job's
+``traceparent`` into generated pod annotations and a ``TRACEPARENT``
+env var; the gang scheduler parents its admission/bind/preemption spans
+on the pod annotation; the launcher and ``Trainer.fit`` pick the env
+var up so worker step spans join the same trace. One timeline from
+"JAXJob created" through "gang bound" to "first step done".
+"""
+
+from kubeflow_tpu.obs.trace import (  # noqa: F401
+    COLLECTOR,
+    TRACER,
+    Span,
+    SpanContext,
+    TraceCollector,
+    Tracer,
+    context_from_env,
+    parse_traceparent,
+    to_chrome_trace,
+    to_jsonl,
+)
+from kubeflow_tpu.obs.events import EventRecorder  # noqa: F401
+
+__all__ = ["COLLECTOR", "TRACER", "Span", "SpanContext", "TraceCollector",
+           "Tracer", "context_from_env", "parse_traceparent",
+           "to_chrome_trace", "to_jsonl", "EventRecorder"]

@@ -33,6 +33,8 @@ import subprocess
 import sys
 import time
 
+from kubeflow_tpu.obs import trace as obs_trace
+
 log = logging.getLogger("kubeflow_tpu.launcher")
 
 
@@ -75,14 +77,35 @@ def run_builtin_trainer(cfg_dict: dict) -> int:
         rt_metrics.serve_metrics(metrics_port)
     except OSError:
         log.warning("metrics port %d busy; metrics endpoint disabled", metrics_port)
-    cfg = TrainConfig.from_dict(cfg_dict)
-    trainer = Trainer(cfg)
-    # SIGTERM (pod eviction / TPU maintenance) => checkpoint + EX_TEMPFAIL
-    # so the JAXJob controller gang-restarts and resumes.
-    notice = PreemptionNotice().install()
-    _, summary = trainer.fit(stop=notice)
+    # The worker span: child of the job root (TRACEPARENT env, stamped
+    # by the JAXJob controller) — trainer/step spans nest inside it, so
+    # one trace runs from "JAXJob created" to "step done".
+    try:
+        with obs_trace.TRACER.span(
+                "worker", process=os.environ.get("JAXJOB_PROCESS_ID", ""),
+                job=os.environ.get("JAXJOB_NAME", "")):
+            cfg = TrainConfig.from_dict(cfg_dict)
+            trainer = Trainer(cfg)
+            # SIGTERM (pod eviction / TPU maintenance) => checkpoint +
+            # EX_TEMPFAIL so the JAXJob controller gang-restarts and resumes.
+            notice = PreemptionNotice().install()
+            _, summary = trainer.fit(stop=notice)
+    finally:
+        _dump_trace()
     print(json.dumps({"summary": summary}), flush=True)
     return EX_TEMPFAIL if summary.get("preempted") else 0
+
+
+def _dump_trace() -> None:
+    """Persist this process's spans (KFTPU_TRACE_FILE=<path>.jsonl);
+    tools/trace2perfetto.py turns the dump into a Perfetto timeline."""
+    path = os.environ.get("KFTPU_TRACE_FILE")
+    if not path:
+        return
+    try:
+        obs_trace.write_jsonl(path, obs_trace.COLLECTOR.spans())
+    except OSError as e:
+        log.warning("could not write trace dump %s: %s", path, e)
 
 
 def run_user_command(argv: list[str]) -> int:
@@ -121,6 +144,13 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", plat)
 
     from kubeflow_tpu.parallel.dist import initialize_from_env
+
+    # Adopt the job's trace context before any spans open: the JAXJob
+    # controller stamped TRACEPARENT into the pod env, and attaching it
+    # here parents every worker-side span on the job's root span.
+    ctx = obs_trace.context_from_env()
+    if ctx is not None:
+        obs_trace.TRACER.attach(ctx)
 
     cfg = initialize_from_env()
     log.info("process %d/%d (job=%s)", cfg.process_id, cfg.num_processes, cfg.job_name or "-")

@@ -57,17 +57,39 @@ def peak_hbm_bw(device_kind: str) -> float:
 
 
 class StepMeter:
-    """Tracks step wall time, examples/sec and MFU over a sliding window."""
+    """Tracks step wall time, examples/sec and MFU over a sliding window.
 
-    def __init__(self, flops_per_step: float, n_chips: int, device_kind: str = "", window: int = 20):
+    With ``tracer`` set (an ``obs.trace.Tracer``), each start/stop pair
+    additionally emits a ``train.step`` span under the ambient trace
+    context — this is what links worker step timing back to the gang
+    scheduler's admission span (one timeline, job submit → step)."""
+
+    def __init__(self, flops_per_step: float, n_chips: int, device_kind: str = "", window: int = 20,
+                 tracer=None, span_name: str = "train.step", step_base: int = 0):
         self.flops_per_step = float(flops_per_step)
         self.n_chips = max(1, n_chips)
         self.peak = peak_flops(device_kind) * self.n_chips if device_kind else None
         self._times: deque[float] = deque(maxlen=window)
         self._t0: float | None = None
         self.steps = 0
+        self._tracer = tracer
+        self._span_name = span_name
+        # span step attr = step_base + metered count, so a trainer that
+        # meters from global step N (compile step excluded) labels its
+        # spans with the true global step indices
+        self.step_base = step_base
+        self._span = None
 
     def start(self) -> None:
+        if self._tracer is not None:
+            if self._span is not None:
+                # the previous step never reached stop() (it raised):
+                # close its span as ERROR so the failed step — the one
+                # an operator most wants to see — still exports
+                self._span.status = "ERROR"
+                self._tracer.finish(self._span)
+            self._span = self._tracer.begin(
+                self._span_name, step=self.step_base + self.steps)
         self._t0 = time.perf_counter()
 
     def stop(self) -> float:
@@ -76,7 +98,21 @@ class StepMeter:
         self._times.append(dt)
         self.steps += 1
         self._t0 = None
+        if self._span is not None:
+            self._span.attrs["step_time_s"] = round(dt, 6)
+            self._tracer.finish(self._span)
+            self._span = None
         return dt
+
+    def close(self) -> None:
+        """Finish a still-open step span as ERROR. Call when the loop
+        unwinds between start() and stop() (a step raised): the failing
+        step's span must still export — there is no later start() to
+        self-heal it."""
+        if self._span is not None:
+            self._span.status = "ERROR"
+            self._tracer.finish(self._span)
+            self._span = None
 
     @property
     def step_time(self) -> float:
@@ -96,12 +132,54 @@ class StepMeter:
         return self.achieved_flops / self.peak
 
 
+# Default latency buckets (seconds) — controller-runtime's reconcile
+# histogram range: sub-ms reconciles up to minute-scale stalls.
+DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                   1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class _Histogram:
+    """Cumulative-bucket histogram state for one label set."""
+
+    __slots__ = ("buckets", "counts", "sum", "count")
+
+    def __init__(self, buckets):
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        self.counts = [0] * len(self.buckets)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        for i, le in enumerate(self.buckets):
+            if value <= le:
+                self.counts[i] += 1
+                break
+        self.sum += value
+        self.count += 1
+
+
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping: backslash, quote and
+    newline must be escaped or the exposition is unscrapeable."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_str(key: tuple, extra: tuple = ()) -> str:
+    return ",".join(f'{k}="{_escape_label(v)}"' for k, v in (*key, *extra))
+
+
 class MetricsRegistry:
-    """Minimal Prometheus registry: gauges and counters, text format 0.0.4."""
+    """Minimal Prometheus registry: gauges, counters and native
+    histograms, text format 0.0.4."""
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._metrics: dict[str, tuple[str, str, dict[tuple, float]]] = {}
+        self._metrics: dict[str, tuple[str, str, dict[tuple, object]]] = {}
 
     def _set(self, kind: str, name: str, help_: str, value: float, labels: dict | None):
         key = tuple(sorted((labels or {}).items()))
@@ -118,17 +196,48 @@ class MetricsRegistry:
             _, _, series = self._metrics.setdefault(name, ("counter", help_, {}))
             series[key] = series.get(key, 0.0) + by
 
+    def histogram(self, name: str, value: float, help_: str = "",
+                  buckets=DEFAULT_BUCKETS, **labels) -> None:
+        """Observe ``value`` into a cumulative-bucket histogram. Renders
+        as ``name_bucket{le=...}`` / ``name_sum`` / ``name_count`` —
+        the native type the scheduler's hand-rolled ``_sum``/``_count``
+        counter pair predated."""
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            _, _, series = self._metrics.setdefault(
+                name, ("histogram", help_, {}))
+            hist = series.get(key)
+            if not isinstance(hist, _Histogram):
+                hist = series[key] = _Histogram(buckets)
+            hist.observe(float(value))
+
+    @staticmethod
+    def _render_histogram(out: list, name: str, key: tuple,
+                          hist: _Histogram) -> None:
+        cum = 0
+        for le, n in zip(hist.buckets, hist.counts):
+            cum += n
+            out.append(f"{name}_bucket{{"
+                       f"{_label_str(key, (('le', le),))}}} {cum}")
+        out.append(f"{name}_bucket{{{_label_str(key, (('le', '+Inf'),))}}} "
+                   f"{hist.count}")
+        suffix = f"{{{_label_str(key)}}}" if key else ""
+        out.append(f"{name}_sum{suffix} {hist.sum}")
+        out.append(f"{name}_count{suffix} {hist.count}")
+
     def render(self) -> str:
         out = []
         with self._lock:
             for name, (kind, help_, series) in sorted(self._metrics.items()):
                 if help_:
-                    out.append(f"# HELP {name} {help_}")
+                    out.append(f"# HELP {name} {_escape_help(help_)}")
                 out.append(f"# TYPE {name} {kind}")
-                for key, value in sorted(series.items()):
-                    if key:
-                        lbl = ",".join(f'{k}="{v}"' for k, v in key)
-                        out.append(f"{name}{{{lbl}}} {value}")
+                for key in sorted(series):
+                    value = series[key]
+                    if isinstance(value, _Histogram):
+                        self._render_histogram(out, name, key, value)
+                    elif key:
+                        out.append(f"{name}{{{_label_str(key)}}} {value}")
                     else:
                         out.append(f"{name} {value}")
         return "\n".join(out) + "\n"

@@ -696,9 +696,17 @@ class Trainer:
                            "step_time_s": None,
                            "examples_per_sec": 0.0, "mfu": 0.0, "final": {}}
 
+        from kubeflow_tpu.obs import trace as obs_trace
+
         data = None
         kind = next(iter(self.mesh.devices.flat)).device_kind
-        meter = rt_metrics.StepMeter(self.flops_per_step(), self.mesh.devices.size, kind)
+        # tracer=: each metered step emits a train.step span under the
+        # ambient context — linked to the gang-admission span when the
+        # launcher attached the pod's TRACEPARENT. Metering starts after
+        # the compile step, hence the +1 global-step base.
+        meter = rt_metrics.StepMeter(self.flops_per_step(), self.mesh.devices.size, kind,
+                                     tracer=obs_trace.TRACER,
+                                     step_base=start_step + 1)
         last = {}
         last_saved = -1
         first_dt = float("nan")
@@ -760,6 +768,15 @@ class Trainer:
 
         ok = False
         preempted = False
+        # Fit span: nest under the caller's ambient span when one is
+        # open (the launcher's "worker" span), else fall back to the
+        # pod's TRACEPARENT so a Trainer built outside the launcher
+        # still joins the job trace, else start a new root.
+        fit_span = obs_trace.TRACER.begin(
+            "train.fit",
+            parent=obs_trace.TRACER.current() or obs_trace.context_from_env(),
+            model=cfg.model, global_batch=cfg.global_batch,
+            start_step=start_step, steps=steps)
         try:
             # Data construction inside the try: its failure modes (no
             # shards match the glob, native loader required but missing)
@@ -796,8 +813,10 @@ class Trainer:
                     # Step 0 pays XLA compile; keep it out of the meter window
                     # so step_time/throughput/MFU reflect steady state.
                     t0 = _time.perf_counter()
-                    state, m = self.train_step(state, batch)
-                    jax.block_until_ready(m["loss"])
+                    with obs_trace.TRACER.span("train.step", step=start_step,
+                                               compile=True):
+                        state, m = self.train_step(state, batch)
+                        jax.block_until_ready(m["loss"])
                     first_dt = _time.perf_counter() - t0
                     log.info("first step (incl. compile): %.2fs", first_dt)
                     last = {k: float(v) for k, v in m.items()}
@@ -831,6 +850,11 @@ class Trainer:
                     callback(i, m)
             ok = True
         finally:
+            meter.close()  # a step that raised still exports, as ERROR
+            fit_span.attrs["preempted"] = preempted
+            if not ok and fit_span.status == "OK":
+                fit_span.status = "ERROR"
+            obs_trace.TRACER.finish(fit_span)
             trace.stop()
             if hasattr(data, "close"):
                 data.close()  # stop the prefetch thread

@@ -320,6 +320,17 @@ class Dashboard:
         evs.sort(key=lambda e: e.get("lastTimestamp", ""), reverse=True)
         return {"events": evs[:50]}
 
+    def traces(self, req: HttpReq):
+        """This process's span collector as Perfetto trace_event JSON —
+        save the response and open it at ui.perfetto.dev. In the
+        hermetic harness (controllers in-process) this is the full
+        submit→bind timeline; in production each component exports its
+        own collector and tools/trace2perfetto.py merges the dumps."""
+        self._user(req)
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        return obs_trace.to_chrome_trace(obs_trace.COLLECTOR.spans())
+
     def get_metrics(self, req: HttpReq):
         mtype = req.params["type"]
         if mtype == "node-cpu":
@@ -349,6 +360,7 @@ class Dashboard:
         r.route("GET", "/api/namespaces/{namespace}/jaxjobs", self.jaxjobs)
         r.route("GET", "/api/serving/models", self.serving_models)
         r.route("GET", "/api/activities/{namespace}", self.activities)
+        r.route("GET", "/api/traces", self.traces)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)
         from kubeflow_tpu.webapps.dashboard_ui import add_ui_routes

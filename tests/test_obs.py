@@ -1,0 +1,653 @@
+"""Observability tier (ISSUE 4): spans, traceparent propagation,
+EventRecorder dedup, native histograms, and the hermetic end-to-end
+trace of a gang-scheduled JAXJob.
+
+The e2e is the acceptance criterion made executable: run the JAXJob
+controller AND the gang scheduler against one FakeCluster, let the
+fake kubelet run the bound gang, then emit worker/step spans from each
+pod's stamped TRACEPARENT — and assert the result is ONE connected
+trace (every span reachable from the job root via parent ids), valid
+Perfetto JSON, Events on the objects, and histogram metrics in valid
+Prometheus text format over a real GET /metrics.
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import build_controller
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.control.runtime import (
+    Controller, Reconciler, Request, Result, seed_controller,
+)
+from kubeflow_tpu.control.scheduler.nodes import new_tpu_node
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+from kubeflow_tpu.obs import trace as tr
+from kubeflow_tpu.runtime.metrics import MetricsRegistry, StepMeter, serve_metrics
+
+pytestmark = pytest.mark.obs
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- span API ----------------------------------------------------------------
+
+
+class TestSpans:
+    def test_nesting_parents_on_ambient_context(self):
+        t = tr.Tracer(tr.TraceCollector())
+        with t.span("outer") as outer:
+            with t.span("inner") as inner:
+                pass
+        assert inner.trace_id == outer.trace_id
+        assert inner.parent_id == outer.span_id
+        assert outer.parent_id is None
+        a, b = t.collector.spans()
+        assert (a.name, b.name) == ("inner", "outer")  # finish order
+        assert a.end is not None and b.end is not None
+        assert b.duration >= a.duration >= 0.0
+
+    def test_explicit_parent_overrides_ambient(self):
+        t = tr.Tracer(tr.TraceCollector())
+        ctx = tr.SpanContext(tr.new_trace_id(), tr.new_span_id())
+        with t.span("ambient"):
+            with t.span("child", parent=ctx) as child:
+                pass
+        assert child.trace_id == ctx.trace_id
+        assert child.parent_id == ctx.span_id
+
+    def test_exception_recorded_and_reraised(self):
+        t = tr.Tracer(tr.TraceCollector())
+        with pytest.raises(ValueError, match="boom"):
+            with t.span("work"):
+                raise ValueError("boom")
+        sp = t.collector.spans()[0]
+        assert sp.status == "ERROR"
+        assert sp.error == "ValueError: boom"
+        assert sp.end is not None  # finished despite the raise
+
+    def test_detached_begin_finish_across_contexts(self):
+        """The jaxjob-root pattern: begin in one reconcile, finish in a
+        later one — must not disturb the ambient context either time."""
+        t = tr.Tracer(tr.TraceCollector())
+        root = t.begin("root", detached=True)
+        assert t.current() is None  # detached: nothing installed
+        with t.span("unrelated"):
+            pass
+        t.finish(root)
+        assert root.end is not None
+        unrelated = t.collector.spans()[0]
+        assert unrelated.trace_id != root.trace_id
+
+    def test_begin_with_pinned_context(self):
+        t = tr.Tracer(tr.TraceCollector())
+        ctx = tr.SpanContext("ab" * 16, "cd" * 8)
+        sp = t.begin("root", context=ctx, detached=True)
+        t.finish(sp)
+        assert (sp.trace_id, sp.span_id) == (ctx.trace_id, ctx.span_id)
+
+    def test_attach_detach_env_context(self):
+        t = tr.Tracer(tr.TraceCollector())
+        ctx = tr.SpanContext(tr.new_trace_id(), tr.new_span_id())
+        env = {tr.TRACEPARENT_ENV: ctx.to_traceparent()}
+        token = t.attach(tr.context_from_env(env))
+        try:
+            with t.span("worker") as sp:
+                pass
+            assert sp.parent_id == ctx.span_id
+        finally:
+            t.detach(token)
+        assert t.current() is None
+
+    def test_collector_is_bounded(self):
+        c = tr.TraceCollector(capacity=4)
+        t = tr.Tracer(c)
+        for i in range(10):
+            with t.span(f"s{i}"):
+                pass
+        assert len(c) == 4
+        assert [s.name for s in c.spans()] == ["s6", "s7", "s8", "s9"]
+
+
+class TestTraceparent:
+    def test_round_trip(self):
+        ctx = tr.SpanContext(tr.new_trace_id(), tr.new_span_id())
+        assert tr.parse_traceparent(ctx.to_traceparent()) == ctx
+
+    def test_unsampled_flag(self):
+        ctx = tr.SpanContext("ab" * 16, "cd" * 8, sampled=False)
+        header = ctx.to_traceparent()
+        assert header.endswith("-00")
+        assert tr.parse_traceparent(header) == ctx
+
+    @pytest.mark.parametrize("bad", [
+        None, 17, "", "junk", "00-short-cd-01",
+        "00-" + "g" * 32 + "-" + "cd" * 8 + "-01",      # non-hex
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",      # all-zero trace
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",     # all-zero span
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",     # invalid version
+        "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01-extra",
+    ])
+    def test_malformed_is_none_not_raise(self, bad):
+        assert tr.parse_traceparent(bad) is None
+
+    def test_context_from_env_absent(self):
+        assert tr.context_from_env({}) is None
+
+
+# -- exporters ---------------------------------------------------------------
+
+
+def _golden_spans():
+    root = tr.Span(name="jaxjob", trace_id="ab" * 16, span_id="cd" * 8,
+                   parent_id=None, start=100.0, end=100.5,
+                   attrs={"namespace": "default"}, pid=7, tid=9)
+    child = tr.Span(name="scheduler.admit", trace_id="ab" * 16,
+                    span_id="ef" * 8, parent_id="cd" * 8,
+                    start=100.25, end=100.375,
+                    attrs={"outcome": "admitted"}, status="ERROR",
+                    error="ApiError: x", pid=7, tid=9)
+    return [root, child]
+
+
+class TestExporters:
+    def test_chrome_trace_golden(self):
+        assert tr.to_chrome_trace(_golden_spans()) == {
+            "traceEvents": [
+                {"ph": "M", "pid": 7, "tid": 0, "name": "process_name",
+                 "args": {"name": "kubeflow-tpu:7"}},
+                {"ph": "X", "cat": "kftpu", "name": "jaxjob",
+                 "ts": 100000000.0, "dur": 500000.0, "pid": 7, "tid": 9,
+                 "args": {"namespace": "default", "trace_id": "ab" * 16,
+                          "span_id": "cd" * 8, "status": "OK"}},
+                {"ph": "X", "cat": "kftpu", "name": "scheduler.admit",
+                 "ts": 100250000.0, "dur": 125000.0, "pid": 7, "tid": 9,
+                 "args": {"outcome": "admitted", "trace_id": "ab" * 16,
+                          "span_id": "ef" * 8, "status": "ERROR",
+                          "parent_id": "cd" * 8, "error": "ApiError: x"}},
+            ],
+            "displayTimeUnit": "ms",
+        }
+
+    def test_chrome_trace_skips_open_spans(self):
+        open_span = tr.Span(name="open", trace_id="ab" * 16,
+                            span_id="11" * 8, start=1.0, end=None)
+        doc = tr.to_chrome_trace([open_span])
+        assert [e for e in doc["traceEvents"] if e["ph"] == "X"] == []
+
+    def test_jsonl_round_trip_identity(self):
+        spans = _golden_spans()
+        back = tr.from_jsonl(tr.to_jsonl(spans))
+        assert [s.to_dict() for s in back] == [s.to_dict() for s in spans]
+
+    def test_jsonl_golden_line(self):
+        line = tr.to_jsonl(_golden_spans()[:1]).splitlines()[0]
+        assert json.loads(line) == {
+            "name": "jaxjob", "trace_id": "ab" * 16, "span_id": "cd" * 8,
+            "parent_id": None, "start": 100.0, "end": 100.5,
+            "attrs": {"namespace": "default"}, "status": "OK",
+            "error": None, "pid": 7, "tid": 9,
+        }
+
+    def test_file_round_trip_and_cli(self, tmp_path, capsys):
+        src = tmp_path / "w.jsonl"
+        out = tmp_path / "out.json"
+        tr.write_jsonl(str(src), _golden_spans())
+        assert [s.to_dict() for s in tr.read_jsonl(str(src))] \
+            == [s.to_dict() for s in _golden_spans()]
+        from tools.trace2perfetto import main as t2p
+        assert t2p([str(src), "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert doc == tr.to_chrome_trace(_golden_spans())
+        assert t2p([str(tmp_path / "missing.jsonl")]) == 2
+        notspans = tmp_path / "notspans.jsonl"
+        notspans.write_text('{"foo": 1}\n')  # valid JSON, not a span dump
+        assert t2p([str(notspans)]) == 2
+
+
+# -- EventRecorder -----------------------------------------------------------
+
+
+class TestEventDedup:
+    def test_repeat_bumps_count_not_objects(self):
+        cluster = FakeCluster()
+        pod = cluster.create(ob.new_object("v1", "Pod", "p", "default"))
+        ev1 = cluster.record_event(pod, "GangUnschedulable", "no capacity",
+                                   "Warning")
+        ev2 = cluster.record_event(pod, "GangUnschedulable", "no capacity",
+                                   "Warning")
+        assert ob.meta(ev1)["name"] == ob.meta(ev2)["name"]
+        assert ev2["count"] == 2
+        assert len(cluster.list("v1", "Event", namespace="default")) == 1
+
+    def test_different_reason_or_message_is_a_new_event(self):
+        cluster = FakeCluster()
+        pod = cluster.create(ob.new_object("v1", "Pod", "p", "default"))
+        cluster.record_event(pod, "Scheduled", "bound to n0")
+        cluster.record_event(pod, "Scheduled", "bound to n1")
+        cluster.record_event(pod, "Preempted", "bound to n0")
+        assert len(cluster.list("v1", "Event", namespace="default")) == 3
+
+    def test_recreated_after_event_expiry(self):
+        """Events expire server-side; a stale dedup entry must recreate,
+        not lose the occurrence."""
+        cluster = FakeCluster()
+        pod = cluster.create(ob.new_object("v1", "Pod", "p", "default"))
+        ev1 = cluster.record_event(pod, "Pulled", "image pulled")
+        cluster.delete("v1", "Event", ob.meta(ev1)["name"], "default")
+        ev2 = cluster.record_event(pod, "Pulled", "image pulled")
+        assert ev2["count"] == 1
+        assert ob.meta(ev2)["name"] != ob.meta(ev1)["name"]
+
+    def test_event_shape_is_corev1(self):
+        cluster = FakeCluster()
+        pod = cluster.create(ob.new_object("v1", "Pod", "p", "ns1"))
+        ev = cluster.record_event(pod, "Started", "container started",
+                                  component="kubelet")
+        inv = ev["involvedObject"]
+        assert inv["kind"] == "Pod" and inv["name"] == "p"
+        assert inv["uid"] == ob.meta(pod)["uid"]
+        assert ev["source"] == {"component": "kubelet"}
+        assert ev["type"] == "Normal"
+        assert ev["firstTimestamp"] and ev["lastTimestamp"]
+
+
+# -- metrics: histograms, escaping, endpoint ---------------------------------
+
+# one metric sample or comment per line (Prometheus text format 0.0.4)
+_EXPOSITION_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .+"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.e+-]+(nan|inf)?)$",
+    re.IGNORECASE)
+
+
+def assert_valid_exposition(text: str) -> None:
+    for line in text.strip().splitlines():
+        assert _EXPOSITION_LINE.match(line), f"bad exposition line: {line!r}"
+
+
+class TestMetricsRegistry:
+    def test_histogram_cumulative_buckets_sum_count(self):
+        reg = MetricsRegistry()
+        for v in (0.05, 0.3, 0.3, 7.0):
+            reg.histogram("lat_seconds", v, help_="latency",
+                          buckets=(0.1, 0.5, 1.0), op="bind")
+        text = reg.render()
+        assert "# TYPE lat_seconds histogram" in text
+        assert 'lat_seconds_bucket{op="bind",le="0.1"} 1' in text
+        assert 'lat_seconds_bucket{op="bind",le="0.5"} 3' in text
+        assert 'lat_seconds_bucket{op="bind",le="1.0"} 3' in text
+        assert 'lat_seconds_bucket{op="bind",le="+Inf"} 4' in text
+        assert 'lat_seconds_sum{op="bind"} 7.65' in text
+        assert 'lat_seconds_count{op="bind"} 4' in text
+        assert_valid_exposition(text)
+
+    def test_histogram_without_labels(self):
+        reg = MetricsRegistry()
+        reg.histogram("h", 0.2, buckets=(1.0,))
+        text = reg.render()
+        assert 'h_bucket{le="1.0"} 1' in text
+        assert "h_sum 0.2" in text
+        assert "h_count 1" in text
+        assert_valid_exposition(text)
+
+    def test_label_values_escaped(self):
+        """The ISSUE-4 escaping fix: quote/backslash/newline in label
+        values must render escaped or the exposition is unscrapeable."""
+        reg = MetricsRegistry()
+        reg.gauge("g", 1, path='a"b\\c\nd')
+        text = reg.render()
+        assert r'g{path="a\"b\\c\nd"} 1' in text
+        assert "\na" not in text  # the raw newline never splits the line
+        assert_valid_exposition(text)
+
+    def test_help_text_escaped(self):
+        reg = MetricsRegistry()
+        reg.gauge("g", 1, help_="line one\nline two \\ end")
+        assert "# HELP g line one\\nline two \\\\ end" in reg.render()
+
+    def test_metrics_endpoint_serves_histograms(self):
+        """GET /metrics over real HTTP (acceptance: the new histograms
+        render in valid text format end to end)."""
+        reg = MetricsRegistry()
+        reg.histogram("controller_reconcile_seconds", 0.02,
+                      help_="reconcile latency", controller="jaxjob")
+        srv = serve_metrics(port=0, registry=reg)
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics") as resp:
+                assert resp.status == 200
+                body = resp.read().decode()
+        finally:
+            srv.shutdown()
+        assert "# TYPE controller_reconcile_seconds histogram" in body
+        assert ('controller_reconcile_seconds_bucket'
+                '{controller="jaxjob",le="0.025"} 1') in body
+        assert_valid_exposition(body)
+
+
+class TestStepMeterSpans:
+    def test_step_spans_under_ambient_context(self):
+        t = tr.Tracer(tr.TraceCollector())
+        meter = StepMeter(1e12, 1, tracer=t)
+        with t.span("worker") as w:
+            for _ in range(3):
+                meter.start()
+                meter.stop()
+        steps = [s for s in t.collector.spans() if s.name == "train.step"]
+        assert [s.attrs["step"] for s in steps] == [0, 1, 2]
+        assert all(s.parent_id == w.span_id for s in steps)
+        assert all(s.attrs["step_time_s"] >= 0 for s in steps)
+
+    def test_meter_without_tracer_emits_nothing(self):
+        meter = StepMeter(1e12, 1)
+        meter.start()
+        assert meter.stop() >= 0.0
+
+    def test_step_base_labels_global_steps(self):
+        """Trainer.fit meters from start_step+1 (compile step excluded);
+        the spans must carry the GLOBAL step index."""
+        t = tr.Tracer(tr.TraceCollector())
+        meter = StepMeter(1e12, 1, tracer=t, step_base=5)
+        for _ in range(2):
+            meter.start()
+            meter.stop()
+        assert [s.attrs["step"] for s in t.collector.spans()] == [5, 6]
+
+    def test_unstopped_step_span_closes_as_error_on_next_start(self):
+        t = tr.Tracer(tr.TraceCollector())
+        meter = StepMeter(1e12, 1, tracer=t)
+        meter.start()   # this "step" raises before stop() in real life
+        meter.start()
+        meter.stop()
+        spans = t.collector.spans()
+        assert [s.status for s in spans] == ["ERROR", "OK"]
+        assert all(s.end is not None for s in spans)
+
+    def test_close_exports_aborted_final_step(self):
+        """Trainer.fit's finally calls close(): a raising LAST step (no
+        later start() to self-heal) must still export as ERROR."""
+        t = tr.Tracer(tr.TraceCollector())
+        meter = StepMeter(1e12, 1, tracer=t)
+        meter.start()
+        meter.close()
+        (sp,) = t.collector.spans()
+        assert sp.status == "ERROR" and sp.end is not None
+        meter.close()  # idempotent
+        assert len(t.collector.spans()) == 1
+
+
+# -- controller runtime instrumentation --------------------------------------
+
+
+class _Flaky(Reconciler):
+    """Fails the first reconcile, requeues the second, then settles."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def reconcile(self, client, req):
+        self.calls += 1
+        if self.calls == 1:
+            raise RuntimeError("boom")
+        if self.calls == 2:
+            return Result(requeue_after=0.01)
+        return None
+
+
+class TestReconcileInstrumentation:
+    def _run(self):
+        reg = MetricsRegistry()
+        t = tr.Tracer(tr.TraceCollector())
+        ctl = Controller("flaky", FakeCluster(), _Flaky(),
+                         registry=reg, tracer=t)
+        ctl.enqueue(Request("ns1", "obj"))
+        for _ in range(4):
+            ctl.run_until_idle(advance_delayed=True)
+        return reg, t.collector.spans()
+
+    def test_spans_carry_result_attempt_queue_wait(self):
+        _, spans = self._run()
+        spans = [s for s in spans if s.name == "reconcile"]
+        assert [s.attrs["result"] for s in spans] \
+            == ["error", "requeue", "success"]
+        assert spans[0].status == "ERROR"
+        assert spans[0].error == "RuntimeError: boom"
+        assert spans[0].attrs["attempt"] == 1
+        assert spans[1].attrs["attempt"] == 2  # retry after the failure
+        assert all(s.attrs["queue_wait_s"] >= 0 for s in spans)
+        assert all(s.attrs["controller"] == "flaky" for s in spans)
+        assert spans[0].attrs["namespace"] == "ns1"
+        assert spans[0].attrs["object"] == "obj"
+
+    def test_controller_runtime_parity_metrics(self):
+        reg, _ = self._run()
+        text = reg.render()
+        assert 'controller_reconcile_total{controller="flaky",result="error"} 1.0' in text
+        assert 'controller_reconcile_total{controller="flaky",result="requeue"} 1.0' in text
+        assert 'controller_reconcile_total{controller="flaky",result="success"} 1.0' in text
+        assert 'controller_reconcile_retries_total{controller="flaky"} 1.0' in text
+        assert "# TYPE controller_reconcile_seconds histogram" in text
+        assert 'controller_reconcile_seconds_count{controller="flaky"} 3' in text
+        assert 'workqueue_wait_seconds_count{controller="flaky"} 3' in text
+        assert 'workqueue_depth{controller="flaky"} 0' in text
+        assert_valid_exposition(text)
+
+
+# -- the hermetic end-to-end trace -------------------------------------------
+
+
+def _pump(ctls, clock, kubelet=None, rounds=10):
+    for _ in range(rounds):
+        for c in ctls:
+            c.run_until_idle(advance_delayed=True)
+        if kubelet is not None:
+            kubelet.step()
+        clock.advance(1.0)
+
+
+class TestEndToEnd:
+    def _world(self):
+        tr.COLLECTOR.clear()
+        clock = FakeClock()
+        cluster = FakeCluster()
+        registry = MetricsRegistry()
+        jax_ctl = seed_controller(
+            build_controller(cluster, record_events=True, registry=registry))
+        sched_ctl = seed_controller(
+            build_scheduler(cluster, registry=registry, record_events=True,
+                            clock=clock))
+        kubelet = FakeKubelet(cluster, auto_bind=False)
+        return clock, cluster, registry, jax_ctl, sched_ctl, kubelet
+
+    def _run_gang(self, clock, cluster, jax_ctl, sched_ctl, kubelet,
+                  replicas=2):
+        for i in range(replicas):
+            cluster.create(new_tpu_node(f"n{i}"))
+        cluster.create(JT.new_jaxjob(
+            "train", replicas=replicas,
+            accelerator="tpu-v5-lite-podslice",
+            topology={1: "2x2", 2: "2x4"}[replicas], chips_per_worker=4,
+            gang_schedule=True))
+        _pump([jax_ctl, sched_ctl], clock, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "train", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING), job.get("status")
+        return job
+
+    def _emit_worker_spans(self, cluster):
+        """The worker-side half of the pipeline, driven exactly the way
+        runtime/launcher.py + Trainer.fit do it: parse TRACEPARENT from
+        the pod env, attach, emit worker + metered step spans."""
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert pods
+        for p in pods:
+            env = {e["name"]: e["value"]
+                   for e in p["spec"]["containers"][0]["env"]}
+            ctx = tr.context_from_env(env)
+            assert ctx is not None, "pod env missing TRACEPARENT"
+            with tr.TRACER.span("worker", parent=ctx,
+                                pod=ob.meta(p)["name"]):
+                meter = StepMeter(1e12, 1, tracer=tr.TRACER)
+                meter.start()
+                meter.stop()
+        return pods
+
+    def test_single_connected_trace_submit_to_step(self):
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        job = self._run_gang(clock, cluster, jax_ctl, sched_ctl, kubelet)
+
+        header = (ob.meta(job).get("annotations") or {})[
+            tr.TRACEPARENT_ANNOTATION]
+        root_ctx = tr.parse_traceparent(header)
+        assert root_ctx is not None
+
+        pods = self._emit_worker_spans(cluster)
+        # the scheduler saw the same context via the pod annotation
+        for p in pods:
+            assert ob.annotations_of(p)[tr.TRACEPARENT_ANNOTATION] == header
+
+        spans = tr.COLLECTOR.trace(root_ctx.trace_id)
+        names = {s.name for s in spans}
+        assert {"jaxjob", "jaxjob.provision", "scheduler.admit",
+                "scheduler.bind", "worker", "train.step"} <= names, names
+
+        # the job root span IS the stamped context, closed at Running
+        root = next(s for s in spans if s.name == "jaxjob")
+        assert root.span_id == root_ctx.span_id
+        assert root.end is not None
+        assert root.attrs["outcome"] == "running"
+        admit = [s for s in spans if s.name == "scheduler.admit"]
+        assert any(s.attrs["outcome"] == "admitted" for s in admit)
+
+        # THE acceptance property: one connected tree — every span in
+        # the trace (incl. every worker step span) reachable from the
+        # root via parent ids
+        reach = tr.reachable(spans, root.span_id)
+        assert reach == {s.span_id for s in spans}
+        step_spans = [s for s in spans if s.name == "train.step"]
+        assert len(step_spans) == 2
+        assert {s.span_id for s in step_spans} <= reach
+
+        # exportable to valid Perfetto JSON
+        doc = json.loads(json.dumps(tr.to_chrome_trace(spans)))
+        complete = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(complete) == len(spans)
+        for e in complete:
+            assert e["dur"] >= 0 and e["ts"] > 0
+            assert {"name", "pid", "tid", "cat", "args"} <= set(e)
+
+    def test_events_emitted_at_decision_points(self):
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        self._run_gang(clock, cluster, jax_ctl, sched_ctl, kubelet)
+        events = cluster.list("v1", "Event", namespace="default")
+        reasons = {e["reason"] for e in events}
+        assert {"JAXJobCreated", "GangQueued", "Scheduled",
+                "JAXJobRunning"} <= reasons, reasons
+        by_kind = {e["involvedObject"]["kind"] for e in events}
+        assert {"JAXJob", "Pod"} <= by_kind
+
+    def test_unschedulable_gang_events_dedup(self):
+        """A gang that cannot fit emits ONE Warning Event whose count
+        climbs with the retries — not an Event per backoff round."""
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        cluster.create(new_tpu_node("n0"))  # room for 1 of 2 workers
+        cluster.create(JT.new_jaxjob(
+            "train", replicas=2, accelerator="tpu-v5-lite-podslice",
+            topology="2x4", chips_per_worker=4, gang_schedule=True))
+        _pump([jax_ctl, sched_ctl], clock, kubelet, rounds=8)
+        unsched = [e for e in cluster.list("v1", "Event", namespace="default")
+                   if e["reason"] == "GangUnschedulable"]
+        assert len(unsched) == 1
+        assert unsched[0]["type"] == "Warning"
+        assert unsched[0]["count"] >= 2
+
+    def test_metrics_render_after_e2e(self):
+        """Acceptance: reconcile-latency and bind-latency histograms in
+        valid exposition after a real gang run."""
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        self._run_gang(clock, cluster, jax_ctl, sched_ctl, kubelet)
+        text = registry.render()
+        assert "# TYPE controller_reconcile_seconds histogram" in text
+        assert 'controller_reconcile_seconds_bucket{controller="jaxjob"' in text
+        assert ('controller_reconcile_seconds_bucket'
+                '{controller="gang-scheduler"') in text
+        assert "# TYPE scheduler_bind_latency_seconds histogram" in text
+        assert 'scheduler_bind_latency_seconds_bucket{le="+Inf"} 1' in text
+        assert "# TYPE workqueue_wait_seconds histogram" in text
+        assert "workqueue_depth" in text
+        assert_valid_exposition(text)
+
+    def test_deleted_job_closes_root_span(self):
+        """A job deleted before ever Running must not leak an open root
+        span in the controller."""
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        cluster.create(JT.new_jaxjob(
+            "doomed", replicas=2, accelerator="tpu-v5-lite-podslice",
+            topology="2x4", chips_per_worker=4, gang_schedule=True))
+        _pump([jax_ctl, sched_ctl], clock, kubelet, rounds=3)  # no nodes
+        job = cluster.get(JT.API_VERSION, JT.KIND, "doomed", "default")
+        assert not ob.cond_is_true(job, JT.COND_RUNNING)
+        assert ("default", "doomed") in jax_ctl.reconciler._roots
+        cluster.delete(JT.API_VERSION, JT.KIND, "doomed", "default")
+        _pump([jax_ctl, sched_ctl], clock, kubelet, rounds=3)
+        assert jax_ctl.reconciler._roots == {}
+        root = next(s for s in tr.COLLECTOR.spans() if s.name == "jaxjob")
+        assert root.end is not None
+        assert root.attrs["outcome"] == "deleted"
+
+    def test_job_invalidated_midflight_closes_root_span(self):
+        """A job whose spec goes invalid after provisioning reaches the
+        Failed terminal via the validation branch — which must still
+        close (and export) the root span."""
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        cluster.create(JT.new_jaxjob(
+            "wonky", replicas=2, accelerator="tpu-v5-lite-podslice",
+            topology="2x4", chips_per_worker=4, gang_schedule=True))
+        _pump([jax_ctl, sched_ctl], clock, kubelet, rounds=2)  # no nodes
+        assert ("default", "wonky") in jax_ctl.reconciler._roots
+        job = cluster.get(JT.API_VERSION, JT.KIND, "wonky", "default")
+        job["spec"]["replicas"] = 0  # now invalid
+        cluster.update(job)
+        _pump([jax_ctl, sched_ctl], clock, kubelet, rounds=3)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "wonky", "default")
+        assert ob.cond_is_true(job, JT.COND_FAILED)
+        assert ("default", "wonky") not in jax_ctl.reconciler._roots
+        root = next(s for s in tr.COLLECTOR.spans() if s.name == "jaxjob")
+        assert root.end is not None
+        assert root.attrs["outcome"] in ("validation-failed", "failed")
+
+    def test_dashboard_serves_trace_and_activity(self):
+        from kubeflow_tpu.utils.httpd import HttpReq
+        from kubeflow_tpu.webapps.dashboard import Dashboard
+
+        clock, cluster, registry, jax_ctl, sched_ctl, kubelet = self._world()
+        self._run_gang(clock, cluster, jax_ctl, sched_ctl, kubelet)
+        router = Dashboard(cluster).router()
+
+        def get(path):
+            resp = router.dispatch(HttpReq(
+                method="GET", path=path, params={}, query={},
+                headers={"kubeflow-userid": "alice@example.com"}))
+            assert resp.status < 300, resp.body
+            return json.loads(resp.body)
+
+        acts = get("/api/activities/default")
+        assert any(e["reason"] == "JAXJobRunning" for e in acts["events"])
+        doc = get("/api/traces")
+        assert {e["name"] for e in doc["traceEvents"]} >= {
+            "reconcile", "scheduler.admit", "scheduler.bind"}

@@ -322,11 +322,14 @@ class TestAllOrNothingAdmission:
         job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
         assert ob.cond_is_true(job, JT.COND_RUNNING)
         # bind latency reached BOTH sinks: the prom histogram and the
-        # MetricsRegistry counters
+        # MetricsRegistry native histogram (ISSUE 4: migrated off the
+        # hand-rolled _sum/_count counter pair)
         after = prom.REGISTRY.get_sample_value(
             "jaxjob_gang_schedule_seconds_count")
         assert after == before + 1
         text = reg.render()
+        assert "# TYPE scheduler_bind_latency_seconds histogram" in text
+        assert 'scheduler_bind_latency_seconds_bucket{le="+Inf"} 1' in text
         assert "scheduler_bind_latency_seconds_count 1" in text
         assert 'scheduler_gangs_admitted_total{namespace="default"} 1' in text
         assert 'scheduler_queue_depth{namespace="default"} 0' in text

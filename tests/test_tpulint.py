@@ -228,6 +228,26 @@ def shardings(devices):
     return NamedSharding(mesh, P("fsdp"))
 """, 8),
     ],
+    "OBS301": [
+        # the classic: wall-clock stopwatch around a measured section
+        ("""\
+import time
+
+
+def measure(fn):
+    t0 = time.time()
+    fn()
+    return time.time() - t0
+""", 7),
+        # direct subtraction against a wall deadline
+        ("""\
+import time
+
+
+def remaining(deadline):
+    return deadline - time.time()
+""", 5),
+    ],
 }
 
 CLEAN = {
@@ -568,6 +588,48 @@ def shardings(devices, axis):
     return NamedSharding(mesh, P(axis))
 """,
     ],
+    "OBS301": [
+        # the correct stopwatch: perf_counter deltas
+        """\
+import time
+
+
+def measure(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+""",
+        # deadline ARITHMETIC on wall clock is a timestamp, not a
+        # duration (gatekeeper/auth.py token-expiry idiom)
+        """\
+import time
+
+
+def expiry(ttl):
+    return int(time.time() + ttl)
+""",
+        # expiry COMPARISON against wall clock: also not a duration
+        """\
+import time
+
+
+def expired(exp):
+    return int(exp) < time.time()
+""",
+        # a same-named local in another function must not taint this one
+        """\
+import time
+
+
+def stamp():
+    t0 = time.time()
+    return t0
+
+
+def diff(t0, t1):
+    return t1 - t0
+""",
+    ],
 }
 
 
@@ -585,7 +647,7 @@ def _clean_cases():
 
 @pytest.mark.parametrize("rule,src,line", _bad_cases(),
                          ids=lambda v: v if isinstance(v, str) and
-                         v.startswith(("TPU", "LOCK")) else None)
+                         v.startswith(("TPU", "LOCK", "OBS")) else None)
 def test_rule_fires_with_id_and_line(rule, src, line):
     findings = _scan(src)
     hits = [f for f in findings if f.rule == rule]
@@ -596,7 +658,7 @@ def test_rule_fires_with_id_and_line(rule, src, line):
 
 @pytest.mark.parametrize("rule,src", _clean_cases(),
                          ids=lambda v: v if isinstance(v, str) and
-                         v.startswith(("TPU", "LOCK")) else None)
+                         v.startswith(("TPU", "LOCK", "OBS")) else None)
 def test_clean_fragment_stays_clean(rule, src):
     findings = [f for f in _scan(src) if f.rule == rule]
     assert not findings, [f.render() for f in findings]

@@ -25,32 +25,40 @@ BASELINE=tools/lint_baseline.json
 RULE_PATHS=(kubeflow_tpu tools bench.py __graft_entry__.py)
 # pass 2: stdlib hygiene (HYG001-003) over everything shipped
 HYG_PATHS=(kubeflow_tpu tools tests examples bench.py __graft_entry__.py)
+# pass 3: OBS hygiene (wall-clock duration math) over tests too — span
+# and latency assertions in the test tier must obey the same
+# perf_counter discipline the package does (pass 1 already covers the
+# package + tools)
+OBS_PATHS=(tests)
 
 case "${1:-gate}" in
 gate)
     "$PY" -m kubeflow_tpu.analysis "${RULE_PATHS[@]}"
     "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
         "${HYG_PATHS[@]}"
+    "$PY" -m kubeflow_tpu.analysis --select OBS301 "${OBS_PATHS[@]}"
     ;;
 --json)
-    tmp1=$(mktemp) && tmp2=$(mktemp)
-    trap 'rm -f "$tmp1" "$tmp2"' EXIT
+    tmp1=$(mktemp) && tmp2=$(mktemp) && tmp3=$(mktemp)
+    trap 'rm -f "$tmp1" "$tmp2" "$tmp3"' EXIT
     "$PY" -m kubeflow_tpu.analysis --write-baseline "$tmp1" \
         "${RULE_PATHS[@]}" >/dev/null
     "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
         --write-baseline "$tmp2" "${HYG_PATHS[@]}" >/dev/null
-    "$PY" - "$tmp1" "$tmp2" "$BASELINE" <<'EOF'
+    "$PY" -m kubeflow_tpu.analysis --select OBS301 \
+        --write-baseline "$tmp3" "${OBS_PATHS[@]}" >/dev/null
+    "$PY" - "$tmp1" "$tmp2" "$tmp3" "$BASELINE" <<'EOF'
 import json
 import sys
 
 findings = []
-for path in sys.argv[1:3]:
+for path in sys.argv[1:4]:
     with open(path) as fh:
         findings.extend(json.load(fh)["findings"])
-with open(sys.argv[3], "w") as fh:
+with open(sys.argv[4], "w") as fh:
     json.dump({"version": 1, "findings": sorted(findings)}, fh, indent=2)
     fh.write("\n")
-print(f"lint_all: baseline written to {sys.argv[3]} "
+print(f"lint_all: baseline written to {sys.argv[4]} "
       f"({len(findings)} findings)")
 EOF
     ;;
@@ -64,6 +72,8 @@ EOF
         "${RULE_PATHS[@]}" || rc=1
     "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
         --baseline "$BASELINE" "${HYG_PATHS[@]}" || rc=1
+    "$PY" -m kubeflow_tpu.analysis --select OBS301 \
+        --baseline "$BASELINE" "${OBS_PATHS[@]}" || rc=1
     exit $rc
     ;;
 *)

@@ -1,0 +1,58 @@
+#!/usr/bin/env python
+"""Convert a span JSONL dump into Perfetto/Chrome trace_event JSON.
+
+Workers write JSONL dumps when KFTPU_TRACE_FILE is set (one span per
+line — runtime/launcher.py); the control plane can dump its collector
+the same way. This CLI merges any number of dumps into one timeline
+openable at https://ui.perfetto.dev or chrome://tracing:
+
+    python tools/trace2perfetto.py worker0.jsonl worker1.jsonl -o out.json
+
+Timestamps are epoch-anchored microseconds, so spans from different
+processes land on one consistent axis (modulo host clock skew).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.obs import trace as obs_trace  # noqa: E402
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("inputs", nargs="+", help="span JSONL dump(s)")
+    p.add_argument("-o", "--output", default="-",
+                   help="Perfetto JSON path (default: stdout)")
+    args = p.parse_args(argv)
+
+    spans: list[obs_trace.Span] = []
+    for path in args.inputs:
+        try:
+            spans.extend(obs_trace.read_jsonl(path))
+        except (OSError, ValueError, TypeError) as e:
+            # TypeError: structurally valid JSON that is not a span dump
+            # (missing name/ids) — same friendly path as bad JSON
+            print(f"trace2perfetto: {path}: {e}", file=sys.stderr)
+            return 2
+    spans.sort(key=lambda s: s.start)
+    doc = obs_trace.to_chrome_trace(spans)
+    rendered = json.dumps(doc, indent=1, sort_keys=True)
+    if args.output == "-":
+        print(rendered)
+    else:
+        with open(args.output, "w") as fh:
+            fh.write(rendered + "\n")
+        n = len(doc["traceEvents"])
+        print(f"trace2perfetto: wrote {n} events from "
+              f"{len(spans)} spans to {args.output}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
