@@ -72,6 +72,23 @@ def worker_name(job_name: str, index: int) -> str:
     return f"{job_name}-worker-{index}"
 
 
+def gang_epoch(job: dict) -> int:
+    """The job's current gang incarnation: total restarts of any kind.
+    Pods are stamped with the epoch they were created under
+    (T.ANNOTATION_EPOCH); an older stamp marks a condemned leftover."""
+    status = job.get("status") or {}
+    return status.get("restarts", 0) + status.get("preemptions", 0)
+
+
+def pod_epoch(pod: dict, default: int) -> int:
+    """A pod's stamped epoch; unstamped pods (pre-epoch incarnations,
+    hand-made test pods) count as current — never condemned by default."""
+    try:
+        return int(ob.annotations_of(pod).get(T.ANNOTATION_EPOCH, default))
+    except (TypeError, ValueError):
+        return default
+
+
 class JAXJobReconciler(Reconciler):
     def __init__(self, record_events: bool = True):
         self.record_events = record_events
@@ -216,6 +233,9 @@ class JAXJobReconciler(Reconciler):
         if slices > 1:
             labels[T.LABEL_SLICE_INDEX] = str(slice_id)
         annotations = dict(tmpl.get("metadata", {}).get("annotations") or {})
+        # controller-owned incarnation stamp (a template value must not
+        # be able to mark a fresh pod as condemned)
+        annotations[T.ANNOTATION_EPOCH] = str(gang_epoch(job))
         if traceparent:
             annotations[obs_trace.TRACEPARENT_ANNOTATION] = traceparent
         if spec.get("schedulerName"):
@@ -299,6 +319,28 @@ class JAXJobReconciler(Reconciler):
             "v1", "Pod", namespace=req.namespace,
             label_selector={"matchLabels": {T.LABEL_JOB_NAME: req.name}},
         )
+
+        # condemned sweep: pods stamped with an OLDER gang epoch are the
+        # leftovers of a recorded restart whose teardown was interrupted
+        # (a transient apiserver error mid-delete). Finish the teardown
+        # and keep them out of status derivation — re-reading a
+        # condemned pod's phase as a fresh failure would double-count
+        # the restart budget for one incident.
+        epoch = gang_epoch(job)
+        condemned = [p for p in pods if pod_epoch(p, epoch) < epoch]
+        if condemned:
+            for p in condemned:
+                try:
+                    client.delete("v1", "Pod", ob.meta(p)["name"],
+                                  req.namespace)
+                except ob.NotFound:
+                    pass
+                except ob.ApiError:
+                    log.exception("condemned-pod delete of %s failed",
+                                  ob.meta(p)["name"])
+            # their names must free up before the new incarnation can be
+            # created — poll again rather than racing the store
+            return Result(requeue_after=0.05)
         by_name = {ob.meta(p)["name"]: p for p in pods}
 
         # gang creation: all pods created in one pass; on partial failure,
@@ -321,6 +363,13 @@ class JAXJobReconciler(Reconciler):
                             client.delete("v1", "Pod", ob.meta(p)["name"], req.namespace)
                         except ob.NotFound:
                             pass
+                        except ob.ApiError:
+                            # best-effort rollback: a transient error on
+                            # one delete must not strand the rest; the
+                            # partial-gang branch below self-heals any
+                            # residue on the next reconcile
+                            log.exception("rollback delete of %s failed",
+                                          ob.meta(p)["name"])
                     if self.record_events:
                         client.record_event(
                             job, "GangCreateFailed",
@@ -330,14 +379,38 @@ class JAXJobReconciler(Reconciler):
             pods = created
             by_name = {ob.meta(p)["name"]: p for p in pods}
         elif missing:
-            # partial gang (e.g. a worker was deleted out from under us):
-            # gang semantics say restart the whole set.
-            return self._gang_restart(
-                client, job, pods, reason="WorkerDisappeared",
-                message=f"workers missing: {[worker_name(req.name, i) for i in missing]}",
-            )
+            if all((p.get("status") or {}).get("phase", "Pending") == "Pending"
+                   for p in by_name.values()):
+                # the gang is still FORMING — every existing worker is
+                # Pending, so no jax.distributed world has started that a
+                # late worker could corrupt. Complete the set in place
+                # (cheaper than a restart, and burns no restart budget on
+                # what was never a running gang — e.g. the residue of a
+                # partial create whose rollback also hit a transient error)
+                with obs_trace.TRACER.span(
+                        "jaxjob.provision", parent=self._job_context(job),
+                        namespace=req.namespace, job=req.name,
+                        workers=len(missing), completion=True):
+                    for i in missing:
+                        pod = self.generate_pod(job, i)
+                        ob.set_owner(pod, job)
+                        p = client.create(pod)
+                        by_name[ob.meta(p)["name"]] = p
+                pods = list(by_name.values())
+            else:
+                # a worker vanished from a STARTED gang: the remaining
+                # world can never re-form a mesh — restart the whole set.
+                return self._gang_restart(
+                    client, job, pods, reason="WorkerDisappeared",
+                    message=f"workers missing: {[worker_name(req.name, i) for i in missing]}",
+                )
 
         # -- derive status from pod phases ---------------------------------
+        # snapshot for the no-op write guard below: an unchanged status
+        # must NOT be re-written — every write bumps the rv and emits a
+        # MODIFIED event on our own primary watch, so unconditional
+        # keep-fresh writes make the controller its own event storm
+        prev_status = ob.deep_copy(job.get("status") or {})
         phases = {
             name: (p.get("status") or {}).get("phase", "Pending")
             for name, p in by_name.items()
@@ -405,7 +478,8 @@ class JAXJobReconciler(Reconciler):
             return None
 
         # still scheduling/pending — keep status fresh, poll again
-        client.update_status(job)
+        if job.get("status") != prev_status:
+            client.update_status(job)
         return Result(requeue_after=2.0)
 
     # -- gang restart -------------------------------------------------------
@@ -487,12 +561,13 @@ class JAXJobReconciler(Reconciler):
                           f"workers failed: {failed}; restarts exhausted")
 
     def _fail(self, client, job, message: str) -> None:
+        m = ob.meta(job)
         ob.cond_set(job, T.COND_RUNNING, "False", "JobFailed", "")
         ob.cond_set(job, T.COND_FAILED, "True", "WorkerFailed", message)
         client.update_status(job)
         if self.record_events:
             client.record_event(job, "JAXJobFailed", message, "Warning")
-        self._finish_root(req.namespace, req.name, "failed")
+        self._finish_root(m["namespace"], m["name"], "failed")
         return None
 
     def _gang_restart(self, client, job, pods, reason: str, message: str,
@@ -502,13 +577,16 @@ class JAXJobReconciler(Reconciler):
         restarted jax.distributed world can never re-form a mesh, so the
         gang restarts as a unit and resumes from the latest checkpoint.
         preemption=True counts in status.preemptions instead of the
-        status.restarts crash budget."""
+        status.restarts crash budget.
+
+        Ordering is record-FIRST: the counter bump + Restarting
+        condition land durably before any pod dies, advancing the gang
+        epoch — so however the teardown below is interrupted (a
+        transient apiserver error on one delete, a controller crash),
+        the next reconcile sees the old incarnation as condemned and
+        FINISHES this restart instead of classifying the half-torn-down
+        gang as a brand-new incident. One incident, one budget unit."""
         m = ob.meta(job)
-        for p in pods:
-            try:
-                client.delete("v1", "Pod", ob.meta(p)["name"], m["namespace"])
-            except ob.NotFound:
-                pass
         job["status"] = job.get("status") or {}
         counter = "preemptions" if preemption else "restarts"
         job["status"][counter] = job["status"].get(counter, 0) + 1
@@ -516,10 +594,21 @@ class JAXJobReconciler(Reconciler):
         ob.cond_set(job, T.COND_RESTARTING, "True", reason,
                     f"{message}; gang restart ({counter} "
                     f"#{job['status'][counter]})")
+        # a failure HERE leaves status untouched in the store: the retry
+        # re-enters from the original counters, still one increment
         client.update_status(job)
         gang_restarts().inc()
         if self.record_events:
             client.record_event(job, "GangRestart", message, "Warning")
+        for p in pods:
+            try:
+                client.delete("v1", "Pod", ob.meta(p)["name"], m["namespace"])
+            except ob.NotFound:
+                pass
+            except ob.ApiError:
+                # best-effort: the condemned sweep reaps survivors
+                log.exception("gang restart: delete of %s failed",
+                              ob.meta(p)["name"])
         return Result(requeue_after=0.1)
 
 

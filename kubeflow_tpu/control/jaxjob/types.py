@@ -36,6 +36,15 @@ LABEL_JOB_NAME = "jaxjob.kubeflow.org/job-name"
 LABEL_REPLICA_INDEX = "jaxjob.kubeflow.org/replica-index"
 LABEL_SLICE_INDEX = "jaxjob.kubeflow.org/slice-index"
 
+# Pod incarnation marker: the gang epoch (status.restarts +
+# status.preemptions at creation time). A pod whose epoch is older than
+# the job's current epoch belongs to a TORN-DOWN incarnation — the
+# controller condemns it (deletes, excludes from status derivation)
+# instead of re-reading its phase as a fresh failure. This is what
+# makes gang restart resumable across transient apiserver errors
+# without double-counting the restart budget.
+ANNOTATION_EPOCH = "jaxjob.kubeflow.org/epoch"
+
 # Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env.
 # Re-exported from dist (ONE authoritative spelling of the wire contract);
 # the import is jax-free — parallel/__init__ is lazy exactly so the

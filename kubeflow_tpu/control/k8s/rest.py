@@ -10,14 +10,30 @@ live cluster by swapping this in.
 from __future__ import annotations
 
 import json
+import logging
 import os
+import random
 import ssl  # noqa: F401  (documents the TLS dependency)
 import time
 from typing import Any
 
 from kubeflow_tpu.control.k8s import objects as ob
 
+log = logging.getLogger("kubeflow_tpu.rest")
+
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# Statuses where the server itself says "try again": it REFUSED the
+# request, so no mutation was applied and retrying any verb is safe.
+_REFUSED_STATUS = (429, 503)
+# Statuses (and connection-level failures) where the request MAY have
+# been applied before things went wrong — only verbs that are safe to
+# replay get retried. GET re-reads; DELETE re-deleting is a 404 the
+# callers already treat as done; PUT carries a resourceVersion
+# precondition, so a replay of an applied update is a benign 409.
+# POST (create) and PATCH (no precondition in general) are NOT replayed.
+_AMBIGUOUS_STATUS = (500, 502, 504)
+_REPLAY_SAFE = frozenset({"GET", "PUT", "DELETE"})
 
 # kind → (plural, cluster_scoped). CRDs registered by our operators are
 # included so no discovery round-trip is needed for the common path.
@@ -87,6 +103,9 @@ class RestClient:
         token: str | None = None,
         ca_cert: str | bool | None = None,
         namespace: str | None = None,
+        max_retries: int = 4,
+        retry_base: float = 0.1,
+        retry_cap: float = 2.0,
     ):
         import requests
 
@@ -105,6 +124,14 @@ class RestClient:
                 namespace = open(ns_path).read().strip()
         self.base_url = base_url.rstrip("/")
         self.namespace = namespace or "default"
+        # transient-fault retry policy (client-go's rest.Request retries
+        # 429/5xx the same way); _sleep/_rng injectable so tests pin the
+        # schedule against a fake clock instead of actually sleeping
+        self.max_retries = max_retries
+        self.retry_base = retry_base
+        self.retry_cap = retry_cap
+        self._sleep = time.sleep
+        self._rng = random.Random()
         self._s = requests.Session()
         if token:
             self._s.headers["Authorization"] = f"Bearer {token}"
@@ -128,8 +155,58 @@ class RestClient:
             parts.append(name)
         return "/".join(parts)
 
+    def _backoff(self, attempt: int, retry_after: str | None) -> float:
+        """Capped exponential backoff with full jitter; a parseable
+        Retry-After (seconds form) raises the floor — the server knows
+        better than our schedule when it will be ready."""
+        delay = min(self.retry_cap, self.retry_base * (2 ** attempt))
+        delay *= self._rng.uniform(0.5, 1.5)
+        if retry_after:
+            try:
+                delay = max(delay, float(retry_after))
+            except ValueError:
+                pass  # HTTP-date form: keep the computed backoff
+        return delay
+
     def _req(self, method: str, path: str, **kw) -> Any:
-        r = self._s.request(method, self.base_url + path, timeout=30, **kw)
+        """One verb against the apiserver, with transient-fault retries.
+
+        Retry matrix (see _REFUSED_STATUS/_AMBIGUOUS_STATUS above): a
+        429/503 response is an explicit refusal — the mutation was not
+        applied, so every verb retries, honoring Retry-After. 5xx
+        responses and connection-level errors are ambiguous (the write
+        may have landed), so only replay-safe verbs (GET/PUT/DELETE)
+        retry; POST/PATCH surface the error to the reconcile loop,
+        whose level-triggered retry re-reads before re-writing."""
+        attempt = 0
+        while True:
+            try:
+                r = self._s.request(
+                    method, self.base_url + path, timeout=30, **kw)
+            except Exception as e:
+                if method in _REPLAY_SAFE and attempt < self.max_retries:
+                    delay = self._backoff(attempt, None)
+                    log.warning("%s %s: connection error (%s); retry %d/%d "
+                                "in %.2fs", method, path, e, attempt + 1,
+                                self.max_retries, delay)
+                    self._sleep(delay)
+                    attempt += 1
+                    continue
+                raise
+            code = r.status_code
+            retryable = (
+                code in _REFUSED_STATUS
+                or (code in _AMBIGUOUS_STATUS and method in _REPLAY_SAFE))
+            if retryable and attempt < self.max_retries:
+                delay = self._backoff(attempt, r.headers.get("Retry-After"))
+                log.warning("%s %s: HTTP %d; retry %d/%d in %.2fs",
+                            method, path, code, attempt + 1,
+                            self.max_retries, delay)
+                r.close()
+                self._sleep(delay)
+                attempt += 1
+                continue
+            break
 
         def errtext() -> str:
             # surface the Status message (client-go behavior) — the

@@ -137,6 +137,15 @@ class LeaderElector:
             pass
         except ob.ApiError as e:
             log.warning("leader election for %s errored: %s", self.name, e)
+            if self._held and now - self._last_renew < self.lease_seconds:
+                # transient apiserver error on a RENEW: the Lease still
+                # names us and has not expired, so dropping to standby
+                # now would flap leadership on every blip. Stay leader —
+                # WITHOUT advancing _last_renew (no real renewal
+                # happened): if errors persist past the lease duration,
+                # this guard stops holding exactly when a standby may
+                # legitimately take over.
+                return True
         return self._became(False, now)
 
     def release(self) -> None:

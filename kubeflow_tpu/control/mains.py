@@ -37,6 +37,21 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     from kubeflow_tpu.control.k8s.rest import RestClient
 
     client = RestClient(base_url=args.apiserver or None)
+
+    # staging chaos drills: TPU_CHAOS_RATE>0 wraps the client in the
+    # seeded fault injector (TPU_CHAOS_SEED picks the schedule) so a
+    # whole controller deployment can be soak-tested against apiserver
+    # faults without touching the cluster. 0/unset: no wrapper at all.
+    if float(os.environ.get("TPU_CHAOS_RATE", "0") or 0) > 0:
+        from kubeflow_tpu.control.k8s.chaos import ChaosClient
+
+        client = ChaosClient(client)
+        logging.getLogger("kubeflow_tpu.chaos").warning(
+            "chaos fault injection ENABLED for %s (TPU_CHAOS_RATE=%s, "
+            "TPU_CHAOS_SEED=%s)", name,
+            os.environ.get("TPU_CHAOS_RATE"),
+            os.environ.get("TPU_CHAOS_SEED", "0"))
+
     ctl = build(client, args)
 
     # --enable-leader-election parity (notebook-controller main.go:51-62):

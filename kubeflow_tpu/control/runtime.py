@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import random
 import threading
 import time
 from typing import Callable
@@ -68,6 +69,17 @@ def _owner_mapper(owner_kind: str) -> Callable[[dict], list[Request]]:
 
 class Controller:
     MAX_RETRIES = 8
+    # Error-retry backoff: min(RETRY_BASE * 2^attempt, RETRY_CAP).
+    # Class attrs so harnesses can pin the schedule (a chaos replay sets
+    # RETRY_BASE=0 to make retry timing wall-clock-free).
+    RETRY_BASE = 0.01
+    RETRY_CAP = 5.0
+    # Conflict (409) retry window: two writers ping-ponging conflicts
+    # with IMMEDIATE re-enqueue spin at CPU speed against the apiserver;
+    # a small jittered delay desynchronizes them while staying far below
+    # human-visible latency. (0, 0) re-enables immediate retry for
+    # deterministic harnesses.
+    CONFLICT_RETRY = (0.01, 0.05)
 
     def __init__(self, name: str, client, reconciler: Reconciler,
                  registry: MetricsRegistry | None = None, tracer=None):
@@ -178,9 +190,16 @@ class Controller:
                 result = "requeue"
                 self.enqueue_after(req, res.requeue_after)
         except ob.Conflict:
-            # optimistic-concurrency loser: immediate benign retry
+            # optimistic-concurrency loser: benign retry after a small
+            # jittered delay (immediate re-enqueue lets two writers
+            # ping-pong 409s at CPU speed — a conflict hot-spin)
             result = "conflict"
-            self.enqueue(req)
+            lo, hi = self.CONFLICT_RETRY
+            delay = random.uniform(lo, hi) if hi > 0 else 0.0
+            if delay > 0:
+                self.enqueue_after(req, delay)
+            else:
+                self.enqueue(req)
         except Exception as e:
             result = "error"
             span.status = "ERROR"
@@ -194,7 +213,8 @@ class Controller:
                 controller=self.name)
             if n <= self.MAX_RETRIES:
                 log.exception("%s: reconcile %s failed (attempt %d)", self.name, req, n)
-                self.enqueue_after(req, min(0.01 * (2**n), 5.0))
+                self.enqueue_after(
+                    req, min(self.RETRY_BASE * (2 ** n), self.RETRY_CAP))
             else:
                 log.error("%s: reconcile %s dropped after %d attempts", self.name, req, n)
                 # dropping ends this failure streak: a later event-driven
@@ -219,7 +239,8 @@ class Controller:
         """Start watch threads + worker threads; returns immediately."""
         for src in self._sources:
             stream = self.client.watch(src.api_version, src.kind)
-            self._streams.append(stream)
+            with self._cv:
+                self._streams.append(stream)
             t = threading.Thread(
                 target=self._watch_loop, args=(src, stream), daemon=True,
                 name=f"{self.name}-watch-{src.kind}",
@@ -236,10 +257,51 @@ class Controller:
         return self
 
     def _watch_loop(self, src: _Source, stream) -> None:
-        for ev in stream:
+        """Pump one watch stream into the queue — and OUTLIVE it. A
+        stream that raises (or ends while we are still running) would
+        otherwise silently kill this thread and the controller would
+        never see another {kind} event; instead resubscribe and relist
+        (the level-triggered resync) after a short pause."""
+        while not self._stop.is_set():
+            try:
+                for ev in stream:
+                    if self._stop.is_set():
+                        return
+                    self._dispatch(src, ev.object)
+            except Exception:
+                log.exception("%s: watch stream for %s failed; resubscribing",
+                              self.name, src.kind)
             if self._stop.is_set():
                 return
-            self._dispatch(src, ev.object)
+            self._stop.wait(0.2)
+            try:
+                new_stream = self.client.watch(src.api_version, src.kind)
+            except Exception:
+                log.exception("%s: watch resubscribe for %s failed; will "
+                              "retry", self.name, src.kind)
+                continue
+            # REPLACE the dead stream's slot (never append): a
+            # long-lived controller resubscribing across apiserver
+            # restarts must not grow _streams — or leak the dead
+            # stream's socket — forever
+            with self._cv:
+                try:
+                    self._streams.remove(stream)
+                except ValueError:
+                    pass
+                self._streams.append(new_stream)
+            try:
+                stream.stop()
+            except Exception:
+                pass
+            stream = new_stream
+            try:
+                for obj in self.client.list(src.api_version, src.kind):
+                    self._dispatch(src, obj)
+            except Exception:
+                log.exception("%s: post-resubscribe relist for %s failed; "
+                              "stream is live, next events resync",
+                              self.name, src.kind)
 
     def _worker(self) -> None:
         while not self._stop.is_set():
@@ -380,7 +442,8 @@ def seed_controller(c: Controller) -> Controller:
     without starting threads. Use with run_until_idle()."""
     for src in c._sources:
         stream = c.client.watch(src.api_version, src.kind)
-        c._streams.append(stream)
+        with c._cv:
+            c._streams.append(stream)
     for src in c._sources:
         for obj in c.client.list(src.api_version, src.kind):
             c._dispatch(src, obj)

@@ -1,5 +1,7 @@
 from kubeflow_tpu.control.mains import run_controller
 from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
 
+# 10% requeue-backoff jitter in production: after a node comes back,
+# same-shaped gangs must not retry admission in lockstep
 run_controller("gang-scheduler",
-               lambda client, args: build_scheduler(client))
+               lambda client, args: build_scheduler(client, jitter=0.1))

@@ -20,6 +20,16 @@ from kubeflow_tpu.control.scheduler.topology import parse_topology
 # Pod phases that no longer hold their node's chips.
 TERMINAL_PHASES = ("Succeeded", "Failed")
 
+
+def eviction_status(message: str) -> dict:
+    """The kubelet-eviction status shape (phase Failed, reason Evicted,
+    no containerStatuses): ONE spelling, because three call sites — the
+    scheduler's priority preemption, its node-health pass, and the
+    chaos engine's pod killer — must all be classified as preemption
+    (never crash) by ``JAXJobReconciler._pod_preempted``."""
+    return {"phase": "Failed", "reason": "Evicted", "message": message,
+            "containerStatuses": []}
+
 # GKE TPU hosts expose at most 4 chips each; larger slices span hosts.
 CHIPS_PER_HOST = 4
 

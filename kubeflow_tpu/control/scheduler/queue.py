@@ -18,6 +18,7 @@ observe and tpulint's LOCK201 lockset checker can prove.
 from __future__ import annotations
 
 import dataclasses
+import random
 import threading
 import time
 
@@ -46,10 +47,18 @@ class GangQueue:
         clock=time.monotonic,
         base_backoff: float = 0.5,
         max_backoff: float = 30.0,
+        jitter: float = 0.0,
+        rng: random.Random | None = None,
     ):
         self.clock = clock
         self.base_backoff = base_backoff
         self.max_backoff = max_backoff
+        # jitter spreads same-shaped gangs' retries apart (thundering-
+        # herd control after a big node comes back); 0.0 (default) keeps
+        # the schedule exactly pinnable in tests. rng injectable so a
+        # seeded chaos run replays the same jittered schedule.
+        self.jitter = jitter
+        self._rng = rng if rng is not None else random.Random()
         self._lock = threading.Lock()
         self._entries: dict[tuple[str, str], Entry] = {}
         # namespaces ever queued: keeps the queue-depth gauge reporting
@@ -90,6 +99,8 @@ class GangQueue:
             attempts = cur.attempts + 1
             delay = min(self.base_backoff * (2 ** (attempts - 1)),
                         self.max_backoff)
+            if self.jitter > 0:
+                delay *= 1.0 + self.jitter * self._rng.random()
             self._entries[key] = dataclasses.replace(
                 cur, attempts=attempts, not_before=now + delay)
             return delay

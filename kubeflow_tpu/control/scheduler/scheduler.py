@@ -100,9 +100,13 @@ class GangScheduler(Reconciler):
         registry: MetricsRegistry = REGISTRY,
         record_events: bool = True,
         clock=None,
+        jitter: float = 0.0,
     ):
         if queue is None:
-            queue = GangQueue(clock=clock) if clock else GangQueue()
+            kw = {"jitter": jitter}
+            if clock:
+                kw["clock"] = clock
+            queue = GangQueue(**kw)
         self.queue = queue
         self.registry = registry
         self.record_events = record_events
@@ -119,6 +123,13 @@ class GangScheduler(Reconciler):
         if req != RETRY_ALL:  # the sentinel names no gang to sync
             self._sync(client, req)
         with self._pass_lock:
+            if req == RETRY_ALL:
+                # node events land here: before admitting anything,
+                # evict gangs whose nodes died under them (freed chips
+                # then feed the same pass). Under the pass lock: two
+                # concurrent node-event reconciles must not double-
+                # evict (and double-count) the same pods.
+                self._health_pass(client)
             delay = self._schedule_pass(client)
         self._publish_metrics()
         if delay is not None:
@@ -197,6 +208,54 @@ class GangScheduler(Reconciler):
         if delays:
             return min(delays)
         return self.queue.next_wakeup(now)
+
+    # -- node health --------------------------------------------------------
+
+    def _health_pass(self, client) -> None:
+        """Evict bound gang pods whose node went NotReady or vanished
+        (today's admission-time filter, nodes.py feasible(), protects
+        only FUTURE placements). Eviction uses the kubelet-eviction
+        shape — phase Failed, reason Evicted — so the JAXJob
+        controller's existing ``_pod_preempted`` path gang-restarts the
+        job on its preemption budget, and the recreated (gated) pods
+        requeue for admission on the surviving nodes."""
+        views = {v.name: v for v in (N.node_view(n)
+                                     for n in client.list("v1", "Node"))}
+        victims: list[tuple[dict, str]] = []
+        for p in client.list("v1", "Pod"):
+            spec = p.get("spec") or {}
+            if spec.get("schedulerName") != SCHEDULER_NAME:
+                continue
+            node = spec.get("nodeName")
+            if not node:
+                continue
+            if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                continue
+            view = views.get(node)
+            if view is not None and view.ready:
+                continue
+            why = "deleted" if view is None else "NotReady"
+            victims.append((p, f"node {node} {why} under gang"))
+        for p, message in victims:
+            m = ob.meta(p)
+            cur = client.get_or_none("v1", "Pod", m["name"],
+                                     m.get("namespace"))
+            if cur is None:
+                continue
+            if (cur.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                continue
+            cur.setdefault("status", {})
+            cur["status"].update(N.eviction_status(message))
+            client.update_status(cur)
+            log.info("evicted %s/%s: %s", m.get("namespace"), m["name"],
+                     message)
+            self.registry.counter_inc(
+                "scheduler_node_evictions_total",
+                help_="gang pods evicted because their node died",
+                namespace=m.get("namespace") or "default")
+            if self.record_events and hasattr(client, "record_event"):
+                client.record_event(cur, "GangNodeLost", message, "Warning",
+                                    component=SCHEDULER_NAME)
 
     # -- admission ----------------------------------------------------------
 
@@ -494,12 +553,7 @@ class GangScheduler(Reconciler):
                 if cur is None:
                     continue
                 cur.setdefault("status", {})
-                cur["status"].update({
-                    "phase": "Failed",
-                    "reason": "Evicted",
-                    "message": message,
-                    "containerStatuses": [],
-                })
+                cur["status"].update(N.eviction_status(message))
                 client.update_status(cur)
             log.info("evicted gang %s/%s: %s", ns, name, message)
             self.registry.counter_inc(
@@ -557,13 +611,23 @@ def _pod_mapper(rec: GangScheduler, client):
 
 def _node_mapper(rec: GangScheduler):
     """Node capacity/health changed: expire every backoff (new capacity
-    must not wait out an exponential delay) and run one global pass."""
+    must not wait out an exponential delay) and run one global pass.
 
-    def fn(_node: dict) -> list[Request]:
-        if not rec.queue.depth():
-            return []
-        rec.queue.kick()
-        return [RETRY_ALL]
+    With an EMPTY queue, only an unhealthy-looking node event triggers
+    the sentinel (its reconcile runs the node-health pass over bound
+    gangs): a healthy node's periodic heartbeat/capacity refresh must
+    not cost a full-cluster list on an idle scheduler. A node DELETED
+    while Ready is the one shape this gate can miss (the event carries
+    the last state); the JAXJob controller's slice-health watch treats
+    a missing node as unhealthy and covers it."""
+
+    def fn(node: dict) -> list[Request]:
+        if rec.queue.depth():
+            rec.queue.kick()
+            return [RETRY_ALL]
+        if not N.node_view(node).ready:
+            return [RETRY_ALL]
+        return []
 
     return fn
 
@@ -574,9 +638,11 @@ def build_scheduler(
     record_events: bool = True,
     clock=None,
     queue: GangQueue | None = None,
+    jitter: float = 0.0,
 ) -> Controller:
     rec = GangScheduler(queue=queue, registry=registry,
-                        record_events=record_events, clock=clock)
+                        record_events=record_events, clock=clock,
+                        jitter=jitter)
     ctl = Controller("gang-scheduler", client, rec, registry=registry)
     ctl.maps("v1", "Pod", _pod_mapper(rec, client))
     ctl.maps("v1", "Node", _node_mapper(rec))

@@ -17,11 +17,14 @@ and get dedup for free on either backend.
 
 from __future__ import annotations
 
+import logging
 import threading
 import uuid
 from collections import OrderedDict
 
 from kubeflow_tpu.control.k8s import objects as ob
+
+log = logging.getLogger("kubeflow_tpu.events")
 
 
 class EventRecorder:
@@ -43,7 +46,13 @@ class EventRecorder:
         and lose an increment — the exact dedup this class exists for.
         Event recording is low-rate; serializing it is the same trade
         client-go's single recorder goroutine makes. (Lock order is
-        recorder→client only — never taken the other way around.)"""
+        recorder→client only — never taken the other way around.)
+
+        Fire-and-forget: a transient apiserver error here DROPS the
+        occurrence (returned unsent, logged) rather than raising —
+        client-go's recorder makes the same call, because failing a
+        reconcile over its own telemetry inverts the priority of the
+        two writes."""
         comp = component or self.component
         m = ob.meta(involved)
         ns = m.get("namespace") or "default"
@@ -53,7 +62,13 @@ class EventRecorder:
             hit = self._seen.get(key)
             if hit is not None:
                 self._seen.move_to_end(key)
-                bumped = self._bump(hit[0], hit[1])
+                try:
+                    bumped = self._bump(hit[0], hit[1])
+                except ob.ApiError as e:
+                    log.warning("event %s/%s %s dropped (count bump "
+                                "failed): %s", ns, m["name"], reason, e)
+                    return {"reason": reason, "message": message,
+                            "type": etype, "count": 0}
                 if bumped is not None:
                     return bumped
                 self._seen.pop(key, None)  # Event GC'd/expired: recreate
@@ -79,7 +94,12 @@ class EventRecorder:
                 "lastTimestamp": ob.now_iso(),
                 "count": 1,
             }
-            created = self.client.create(ev)
+            try:
+                created = self.client.create(ev)
+            except ob.ApiError as e:
+                log.warning("event %s/%s %s dropped (create failed): %s",
+                            ns, m["name"], reason, e)
+                return ev
             self._seen[key] = (ob.meta(created)["name"], ns)
             while len(self._seen) > self._max_keys:
                 self._seen.popitem(last=False)

@@ -302,8 +302,12 @@ def from_jsonl(text: str) -> list[Span]:
 
 
 def write_jsonl(path: str, spans: list[Span]) -> None:
-    with open(path, "w") as fh:
-        fh.write(to_jsonl(spans))
+    """Atomic dump (utils/fsatomic.py): the launcher writes this at
+    exit — often BECAUSE the worker is being preempted — and a kill mid-
+    write must leave the previous dump intact, not a torn half-file."""
+    from kubeflow_tpu.utils.fsatomic import atomic_write_text
+
+    atomic_write_text(path, to_jsonl(spans))
 
 
 def read_jsonl(path: str) -> list[Span]:

@@ -39,6 +39,12 @@ import jax
 log = logging.getLogger("kubeflow_tpu.checkpoint")
 
 
+# re-export: the jax-free implementation lives in utils/fsatomic.py so
+# obs/trace.py (which must not import this jax-importing module) shares
+# the exact same crash-consistency code
+from kubeflow_tpu.utils.fsatomic import atomic_write_text  # noqa: F401
+
+
 def _payload(state) -> dict:
     """The persisted pytree: everything in TrainState that is data."""
     return {
@@ -168,20 +174,70 @@ class Checkpointer:
 
     def restore_latest(self, template_state):
         """Resume-from-latest: returns a restored state, or None when the
-        directory has no finalized checkpoint (fresh start)."""
-        step = self.latest_step()
-        if step is None:
-            return None
-        return self.restore(step, template_state)
+        directory has no restorable checkpoint (fresh start).
+
+        Corruption-tolerant: a checkpoint that fails to restore (a node
+        killed mid-save before orbax finalized, a truncated array file,
+        bit rot on the shared volume) is SKIPPED and the previous good
+        step is tried — raising here would wedge every gang restart in
+        a crash loop on one bad file, which is exactly when resume
+        matters most.
+
+        But if EVERY step fails, the likely cause is systematic (the
+        checkpoint volume unreachable, a sharding/template mismatch) —
+        not three independently-corrupt files — so the LAST error is
+        re-raised rather than silently starting fresh: a fresh start
+        both discards all progress and lets max_to_keep GC delete the
+        good checkpoints as new saves land, while crash-and-retry
+        resumes correctly the moment the volume returns. None (fresh
+        start) is returned only for a genuinely empty directory."""
+        steps = sorted(self.all_steps(), reverse=True)
+        last_error: Exception | None = None
+        for i, step in enumerate(steps):
+            try:
+                return self.restore(step, template_state)
+            except Exception as e:  # orbax raises backend-specific types
+                last_error = e
+                log.warning(
+                    "checkpoint: step %d in %s is unrestorable (%s: %s); "
+                    "falling back to %s", step, self.directory,
+                    type(e).__name__, e,
+                    f"step {steps[i + 1]}" if i + 1 < len(steps)
+                    else "no remaining steps")
+        if last_error is not None:
+            raise last_error
+        return None
 
     # -- lifecycle ---------------------------------------------------------
+
+    def _write_manifest(self) -> None:
+        """Crash-consistent resume manifest next to the checkpoints:
+        dashboards and preflight tooling read "what step would this job
+        resume from" without importing orbax. Written atomically (temp
+        + fsync + rename) AFTER saves finalize, so it never names a
+        step that is not durably on disk. Best-effort: remote URIs and
+        I/O errors skip it (orbax metadata stays the source of truth)."""
+        if "://" in self.directory:
+            return
+        import json
+
+        try:
+            steps = self.all_steps()
+            atomic_write_text(
+                os.path.join(self.directory, "manifest.json"),
+                json.dumps({"latest_step": steps[-1] if steps else None,
+                            "steps": steps}, sort_keys=True) + "\n")
+        except OSError as e:
+            log.warning("checkpoint: manifest write failed: %s", e)
 
     def wait(self) -> None:
         """Block until queued async saves are durably finalized."""
         self._mgr.wait_until_finished()
+        self._write_manifest()
 
     def close(self) -> None:
         self._mgr.close()
+        self._write_manifest()
 
     def __enter__(self):
         return self

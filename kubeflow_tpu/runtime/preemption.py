@@ -37,10 +37,20 @@ class PreemptionNotice:
     def __init__(self):
         self._event = threading.Event()
         self._prev_handler = None
+        self._signum: int | None = None
 
     def install(self, signum: int = signal.SIGTERM) -> "PreemptionNotice":
         """Install the signal handler (main thread only — launcher entry).
-        Chains to any previously installed handler."""
+        Chains to any previously installed handler. Idempotent: a second
+        install() of the same signal is a no-op — naive re-chaining
+        would make the handler its own "previous" and fire it twice per
+        signal (and uninstall() could never reach the original)."""
+        if self._signum is not None:
+            if signum != self._signum:
+                raise ValueError(
+                    f"already installed on signal {self._signum}; "
+                    f"uninstall() before moving to signal {signum}")
+            return self
         prev = signal.getsignal(signum)
 
         def handler(sig, frame):
@@ -51,8 +61,26 @@ class PreemptionNotice:
                 prev(sig, frame)
 
         self._prev_handler = prev
+        self._signum = signum
         signal.signal(signum, handler)
         return self
+
+    def uninstall(self) -> "PreemptionNotice":
+        """Restore the handler that was active before install() — a
+        library embedding the trainer (a notebook kernel, a test
+        harness) gets its own SIGTERM behavior back on teardown.
+        Idempotent; keeps the notice's triggered state."""
+        if self._signum is not None:
+            signal.signal(self._signum, self._prev_handler
+                          if self._prev_handler is not None
+                          else signal.SIG_DFL)
+            self._prev_handler = None
+            self._signum = None
+        return self
+
+    @property
+    def installed(self) -> bool:
+        return self._signum is not None
 
     def trigger(self) -> None:
         self._event.set()

@@ -45,6 +45,16 @@ except OSError:
 
 import pytest  # noqa: E402
 
+# Chaos tier knobs (the TPU_RACE_* convention, threaded here so every
+# chaos test agrees on one seed set): TPU_CHAOS_RATE scales the per-call
+# fault probability of the chaos-parameterized reruns and the soak;
+# TPU_CHAOS_SEED re-bases the seed sweep so CI can explore fresh fault
+# schedules without editing tests. Defaults are the committed, verified
+# schedule — every seed in CHAOS_SEEDS converges deterministically.
+CHAOS_RATE = float(os.environ.get("TPU_CHAOS_RATE") or 0.05)
+CHAOS_SEED_BASE = int(os.environ.get("TPU_CHAOS_SEED") or 1)
+CHAOS_SEEDS = tuple(CHAOS_SEED_BASE + i for i in range(5))
+
 
 @pytest.fixture(scope="session")
 def devices8():
@@ -60,6 +70,12 @@ def pytest_configure(config):
         "markers",
         "slow: multi-process e2e tests (gang worlds, real subprocesses); "
         "run explicitly or via the full suite",
+    )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tier (tests/test_chaos.py); knobs: "
+        "TPU_CHAOS_SEED / TPU_CHAOS_RATE; the full-platform soak is also "
+        "marked slow",
     )
 
 

@@ -1,0 +1,1060 @@
+"""Chaos tier: seeded fault injection from apiserver to checkpoint.
+
+Three layers of coverage:
+
+1. The chaos engine itself (``control/k8s/chaos.py``): deterministic
+   replay, pass-through at rate 0, verb/kind targeting, watch drops
+   through the resume and 410-relist paths, cluster primitives.
+2. The hardening this PR adds, pinned in isolation: RestClient's
+   retry/backoff schedule against a scripted fake session, the
+   controller runtime's jittered conflict delay, the scheduler's
+   node-death eviction through the JAXJob gang-restart path, lease
+   retention across transient renew errors, PreemptionNotice handler
+   hygiene, and corruption-tolerant checkpoint resume.
+3. Convergence under chaos: the EXISTING jaxjob-controller and
+   scheduler happy-path suites re-run with faults armed across
+   CHAOS_SEEDS (same assertions, faults on), plus the full-platform
+   soak (jaxjob controller + gang scheduler + fake kubelet + leased
+   standby replica) marked slow.
+
+Knobs (tests/conftest.py): TPU_CHAOS_RATE, TPU_CHAOS_SEED.
+"""
+
+import json
+import os
+import random
+import signal
+
+import pytest
+
+import test_jaxjob_controller as J
+import test_scheduler as S
+from conftest import CHAOS_RATE, CHAOS_SEEDS
+
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxjob.controller import build_controller, worker_name
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.chaos import (
+    ChaosClient, ChaosPolicy, arm_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.control.k8s.rest import RestClient
+from kubeflow_tpu.control.leases import LeaderElector
+from kubeflow_tpu.control.runtime import (
+    Controller, Reconciler, Request, seed_controller,
+)
+from kubeflow_tpu.control.scheduler.nodes import eviction_status, new_tpu_node
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+from kubeflow_tpu.obs import trace as tr
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+from kubeflow_tpu.runtime.preemption import PreemptionNotice
+
+pytestmark = pytest.mark.chaos
+
+
+def _cm(name, ns="default"):
+    return {"apiVersion": "v1", "kind": "ConfigMap",
+            "metadata": {"name": name, "namespace": ns}}
+
+
+def _policy(seed, **over):
+    base = dict(seed=seed, rate=CHAOS_RATE, watch_drop_every=25)
+    base.update(over)
+    return ChaosPolicy(**base)
+
+
+# -- the chaos engine --------------------------------------------------------
+
+
+class TestChaosClient:
+    def test_rate_zero_is_pass_through(self):
+        inner = FakeCluster()
+        c = ChaosClient(inner, ChaosPolicy(seed=7, rate=0.0))
+        c.create(_cm("a"))
+        assert c.get("v1", "ConfigMap", "a", "default")["metadata"]["name"] == "a"
+        assert c.list("v1", "ConfigMap") == inner.list("v1", "ConfigMap")
+        c.delete("v1", "ConfigMap", "a", "default")
+        assert c.fault_log() == []
+        # rate 0 + watch_drop_every 0: the very stream the fake returns
+        stream = c.watch("v1", "ConfigMap")
+        assert hasattr(stream, "poll")
+        assert type(stream).__name__ == "FakeWatchStream"
+
+    def test_same_seed_same_faults(self):
+        def run(seed):
+            c = ChaosClient(FakeCluster(), ChaosPolicy(seed=seed, rate=0.5))
+            for i in range(60):
+                try:
+                    c.create(_cm(f"x{i}"))
+                except ob.ApiError:
+                    pass
+            return c.fault_log()
+
+        assert run(3) == run(3)
+        assert run(3) != run(4)
+        assert len(run(3)) > 5
+
+    def test_conflicts_only_on_mutating_verbs(self):
+        c = ChaosClient(FakeCluster(),
+                        ChaosPolicy(seed=1, rate=1.0, error_weight=0.0,
+                                    conflict_weight=1.0))
+        with pytest.raises(ob.Conflict):
+            c.create(_cm("a"))
+        # conflict-only policy leaves reads alone entirely
+        assert c.list("v1", "ConfigMap") == []
+        assert all(f.fault == "conflict" for f in c.fault_log())
+        assert {f.verb for f in c.fault_log()} == {"create"}
+
+    def test_server_errors_carry_code_and_retry_after(self):
+        c = ChaosClient(FakeCluster(),
+                        ChaosPolicy(seed=2, rate=1.0, conflict_weight=0.0,
+                                    retry_after=0.25))
+        codes = set()
+        for i in range(30):
+            try:
+                c.list("v1", "ConfigMap")
+            except ob.ApiError as e:
+                codes.add(e.code)
+                if e.code in (429, 503):
+                    assert e.retry_after == 0.25
+        assert codes == {429, 500, 503}
+
+    def test_verb_and_kind_filters(self):
+        c = ChaosClient(FakeCluster(),
+                        ChaosPolicy(seed=1, rate=1.0,
+                                    verbs=frozenset({"update"}),
+                                    kinds=frozenset({"Pod"})))
+        c.create(_cm("a"))                       # wrong verb: clean
+        pod = {"apiVersion": "v1", "kind": "Pod",
+               "metadata": {"name": "p", "namespace": "default"}}
+        c.create(pod)                            # wrong verb: clean
+        got = c.get("v1", "ConfigMap", "a", "default")
+        c.update(got)                            # wrong kind: clean
+        with pytest.raises(ob.ApiError):
+            c.update(c.get("v1", "Pod", "p", "default"))
+        assert [(f.verb, f.kind) for f in c.fault_log()] == [("update", "Pod")]
+
+    def test_armed_gating(self):
+        c = ChaosClient(FakeCluster(), ChaosPolicy(seed=1, rate=1.0),
+                        always_on=False)
+        c.create(_cm("a"))       # disarmed: clean
+        assert c.fault_log() == []
+        with c.armed():
+            with pytest.raises(ob.ApiError):
+                c.create(_cm("b"))
+        c.create(_cm("b"))       # disarmed again
+        assert len(c.fault_log()) == 1
+
+    def test_latency_injection_uses_sleeper(self):
+        slept = []
+        c = ChaosClient(FakeCluster(),
+                        ChaosPolicy(seed=1, rate=1.0, error_weight=0.0,
+                                    conflict_weight=0.0, latency=0.02),
+                        sleeper=slept.append)
+        c.create(_cm("a"))       # latency fault: delayed, not failed
+        assert slept == [0.02]
+        assert [f.fault for f in c.fault_log()] == ["latency"]
+
+    def test_events_are_never_faulted(self):
+        inner = FakeCluster()
+        c = ChaosClient(inner, ChaosPolicy(seed=1, rate=1.0))
+        pod = inner.create({"apiVersion": "v1", "kind": "Pod",
+                            "metadata": {"name": "p", "namespace": "default"}})
+        c.record_event(pod, "Tested", "fire-and-forget stays clean")
+        assert len(inner.list("v1", "Event", namespace="default")) == 1
+
+    def test_cluster_primitives(self):
+        inner = FakeCluster()
+        c = ChaosClient(inner, ChaosPolicy(seed=1, rate=0.0))
+        inner.create(new_tpu_node("n0"))
+        c.fail_node("n0")
+        conds = inner.get("v1", "Node", "n0")["status"]["conditions"]
+        assert {"type": "Ready", "status": "False"} in conds
+        c.heal_node("n0")
+        conds = inner.get("v1", "Node", "n0")["status"]["conditions"]
+        assert {"type": "Ready", "status": "True"} in conds
+        pod = inner.create({"apiVersion": "v1", "kind": "Pod",
+                            "metadata": {"name": "p", "namespace": "default"},
+                            "spec": {"nodeName": "n0"}})
+        c.evict_pod("p")
+        st = inner.get("v1", "Pod", "p", "default")["status"]
+        assert (st["phase"], st["reason"]) == ("Failed", "Evicted")
+        c.kill_pod("p")
+        assert inner.get_or_none("v1", "Pod", "p", "default") is None
+        c.kill_pod("p")  # idempotent
+        c.delete_node("n0")
+        assert inner.get_or_none("v1", "Node", "n0") is None
+
+    def test_backend_surface_passes_through(self):
+        inner = FakeCluster()
+        c = ChaosClient(inner, ChaosPolicy(seed=1, rate=1.0))
+        c.create  # faulted verb, defined on wrapper
+        assert c.dump() == []          # FakeCluster-only helper delegates
+        assert c.current_rv == inner.current_rv
+
+
+class TestChaosWatch:
+    def test_drop_and_resume_loses_no_object(self):
+        inner = FakeCluster()
+        c = ChaosClient(inner, ChaosPolicy(seed=3, watch_drop_every=3))
+        stream = c.watch("v1", "ConfigMap")
+        for i in range(12):
+            inner.create(_cm(f"c{i}"))
+        seen = set()
+        while True:
+            ev = stream.poll()
+            if ev is None:
+                break
+            seen.add(ob.meta(ev.object)["name"])
+        assert stream.drops >= 1, "policy should have dropped mid-stream"
+        assert seen == {f"c{i}" for i in range(12)}
+
+    def test_expired_resume_relists(self):
+        # tiny watch cache: the resume point falls out of history, the
+        # 410 path fires and the relist re-yields every live object
+        inner = FakeCluster(history_limit=4)
+        c = ChaosClient(inner, ChaosPolicy(seed=1, watch_drop_every=1))
+        stream = c.watch("v1", "ConfigMap")
+        inner.create(_cm("c0"))
+        inner.create(_cm("c1"))
+        first = stream.poll()
+        assert first is not None
+        for i in range(2, 10):   # push c0/c1's events out of history
+            inner.create(_cm(f"c{i}"))
+        seen = set()
+        while True:
+            ev = stream.poll()
+            if ev is None:
+                break
+            seen.add(ob.meta(ev.object)["name"])
+        assert stream.drops >= 1
+        assert seen == {f"c{i}" for i in range(10)} - {ob.meta(first.object)["name"]} \
+            or seen == {f"c{i}" for i in range(10)}
+
+    def test_relist_synthesizes_deleted(self):
+        inner = FakeCluster(history_limit=2)
+        c = ChaosClient(inner, ChaosPolicy(seed=1, watch_drop_every=1))
+        stream = c.watch("v1", "ConfigMap")
+        inner.create(_cm("doomed"))
+        ev = stream.poll()
+        assert ev is not None and ob.meta(ev.object)["name"] == "doomed"
+        # the object dies AND its deletion event ages out of the cache
+        inner.delete("v1", "ConfigMap", "doomed", "default")
+        for i in range(4):
+            inner.create(_cm(f"filler{i}"))
+        events = []
+        while True:
+            ev = stream.poll()
+            if ev is None:
+                break
+            events.append((ev.type, ob.meta(ev.object)["name"]))
+        assert ("DELETED", "doomed") in events, events
+        assert {n for t, n in events if t == "MODIFIED"} >= \
+            {f"filler{i}" for i in range(4)}
+
+
+# -- RestClient retry/backoff (fake session, pinned schedule) ---------------
+
+
+class _Resp:
+    def __init__(self, code, headers=None, body=None):
+        self.status_code = code
+        self.headers = headers or {}
+        doc = body if body is not None else {}
+        self.content = json.dumps(doc).encode()
+        self.text = self.content.decode()
+
+    def json(self):
+        return json.loads(self.content)
+
+    def close(self):
+        pass
+
+
+class _Session:
+    """Scripted responses; an Exception entry raises (connection error)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def request(self, method, url, timeout=None, **kw):
+        self.calls.append(method)
+        item = self.script.pop(0)
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+
+class _FixedRng:
+    def uniform(self, a, b):
+        return 1.0  # jitter factor pinned to 1x for exact schedule pins
+
+
+def _rest(script, **kw):
+    client = RestClient("http://chaos.invalid", token="t", ca_cert=False,
+                        **kw)
+    client._s = _Session(script)
+    client._rng = _FixedRng()
+    sleeps = []
+    client._sleep = sleeps.append
+    return client, client._s, sleeps
+
+
+class TestRestClientBackoff:
+    def test_refused_statuses_retry_with_exponential_schedule(self):
+        client, sess, sleeps = _rest(
+            [_Resp(503), _Resp(503), _Resp(200, body={"ok": True})])
+        assert client._req("GET", "/api/v1/pods") == {"ok": True}
+        assert sess.calls == ["GET"] * 3
+        assert sleeps == [0.1, 0.2]  # retry_base * 2^attempt, jitter 1x
+
+    def test_retry_after_raises_the_floor(self):
+        client, _, sleeps = _rest(
+            [_Resp(429, headers={"Retry-After": "0.7"}), _Resp(200)])
+        client._req("GET", "/api/v1/pods")
+        assert sleeps == [0.7]
+
+    def test_mutating_verbs_retry_on_explicit_refusal(self):
+        # 429/503 mean "not applied": POST retries safely
+        client, sess, sleeps = _rest([_Resp(429), _Resp(201, body={})])
+        client._req("POST", "/api/v1/pods", json={})
+        assert sess.calls == ["POST", "POST"]
+        assert sleeps == [0.1]
+
+    def test_post_never_retries_ambiguous_500(self):
+        client, sess, sleeps = _rest([_Resp(500)])
+        with pytest.raises(ob.ApiError) as ei:
+            client._req("POST", "/api/v1/pods", json={})
+        assert ei.value.code == 500
+        assert sess.calls == ["POST"]
+        assert sleeps == []
+
+    def test_get_retries_ambiguous_500(self):
+        client, sess, sleeps = _rest([_Resp(500), _Resp(200)])
+        client._req("GET", "/api/v1/pods")
+        assert sess.calls == ["GET", "GET"]
+        assert sleeps == [0.1]
+
+    def test_connection_error_retries_only_replay_safe_verbs(self):
+        client, sess, _ = _rest([OSError("conn reset"), _Resp(200)])
+        client._req("GET", "/api/v1/pods")
+        assert sess.calls == ["GET", "GET"]
+        client2, sess2, sleeps2 = _rest([OSError("conn reset")])
+        with pytest.raises(OSError):
+            client2._req("POST", "/api/v1/pods", json={})
+        assert sess2.calls == ["POST"]
+        assert sleeps2 == []
+
+    def test_exhaustion_surfaces_the_last_error(self):
+        client, sess, sleeps = _rest([_Resp(503)] * 5)  # max_retries=4
+        with pytest.raises(ob.ApiError) as ei:
+            client._req("GET", "/api/v1/pods")
+        assert ei.value.code == 503
+        assert sess.calls == ["GET"] * 5
+        assert sleeps == [0.1, 0.2, 0.4, 0.8]  # capped schedule, jitter 1x
+
+    def test_cap_bounds_the_schedule(self):
+        client, _, sleeps = _rest(
+            [_Resp(503)] * 5, retry_base=1.0, retry_cap=2.0)
+        with pytest.raises(ob.ApiError):
+            client._req("GET", "/api/v1/pods")
+        assert sleeps == [1.0, 2.0, 2.0, 2.0]
+
+    def test_status_mapping_unchanged_after_retry_plumbing(self):
+        client, _, _ = _rest([_Resp(404, body={"message": "gone"})])
+        with pytest.raises(ob.NotFound):
+            client._req("GET", "/api/v1/pods/x")
+        client, _, _ = _rest([_Resp(409, body={"message": "rv"})])
+        with pytest.raises(ob.Conflict):
+            client._req("PUT", "/api/v1/pods/x", json={})
+
+
+# -- controller runtime: conflict delay -------------------------------------
+
+
+class _ConflictOnce(Reconciler):
+    def __init__(self):
+        self.calls = 0
+
+    def reconcile(self, client, req):
+        self.calls += 1
+        if self.calls == 1:
+            raise ob.Conflict("injected")
+        return None
+
+
+class TestConflictBackoff:
+    def test_conflict_requeues_with_jittered_delay_not_hot_spin(self):
+        reg = MetricsRegistry()
+        ctl = Controller("t", FakeCluster(), _ConflictOnce(), registry=reg)
+        req = Request("ns", "x")
+        ctl._process_one(req)
+        # the retry went to the DELAYED queue, inside the jitter window
+        assert req not in ctl._queue
+        assert len(ctl._delayed) == 1
+        due, r = ctl._delayed[0]
+        assert r == req
+        import time as _time
+        lo, hi = Controller.CONFLICT_RETRY
+        remaining = due - _time.monotonic()
+        assert 0.0 < remaining <= hi + 0.001
+        assert 'result="conflict"' in reg.render()
+
+    def test_zeroed_window_restores_immediate_retry(self):
+        ctl = Controller("t", FakeCluster(), _ConflictOnce(),
+                         registry=MetricsRegistry())
+        ctl.CONFLICT_RETRY = (0, 0)
+        req = Request("ns", "x")
+        ctl._process_one(req)
+        assert req in ctl._queue
+        assert ctl._delayed == []
+
+    def test_drain_completes_the_conflicted_reconcile(self):
+        rec = _ConflictOnce()
+        ctl = Controller("t", FakeCluster(), rec, registry=MetricsRegistry())
+        ctl.enqueue(Request("ns", "x"))
+        for _ in range(3):
+            ctl.run_until_idle(advance_delayed=True)
+        assert rec.calls == 2  # conflict, then the successful retry
+
+
+# -- scheduler: node death under a bound gang -------------------------------
+
+
+class TestNodeHealthEviction:
+    def _running_world(self):
+        fc = S.FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = S.sched_world(fc)
+        cluster.create(new_tpu_node("n0"))
+        cluster.create(new_tpu_node("n1"))
+        cluster.create(S.gang_job("gang", replicas=2))
+        S.pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+        return fc, cluster, jax_ctl, sched_ctl, kubelet, reg
+
+    def test_health_pass_evicts_pods_on_dead_node(self):
+        """Scheduler-only view: the node dies and ONLY the scheduler
+        runs — its health pass must evict the bound pods through the
+        kubelet-eviction shape (preemption, not crash)."""
+        fc, cluster, jax_ctl, sched_ctl, kubelet, reg = self._running_world()
+        ChaosClient(cluster, ChaosPolicy()).fail_node("n0")
+        for _ in range(4):
+            sched_ctl.run_until_idle(advance_delayed=True)
+        evicted = [p for p in cluster.list("v1", "Pod", namespace="default")
+                   if (p.get("status") or {}).get("reason") == "Evicted"]
+        assert len(evicted) >= 1
+        assert any("NotReady under gang" in p["status"].get("message", "")
+                   for p in evicted)
+        assert "scheduler_node_evictions_total" in reg.render()
+
+    def test_node_not_ready_gang_restarts_on_preemption_budget(self):
+        fc, cluster, jax_ctl, sched_ctl, kubelet, reg = self._running_world()
+        chaos = ChaosClient(cluster, ChaosPolicy())
+        chaos.fail_node("n0")
+        S.pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert job["status"].get("preemptions", 0) >= 1
+        assert job["status"].get("restarts", 0) == 0
+        assert not ob.cond_is_true(job, JT.COND_FAILED)
+        # half the pool is gone: the recreated gang waits in the queue
+        assert all(n is None for n in S.bindings(cluster).values())
+        chaos.heal_node("n0")
+        S.pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+
+    def test_node_deleted_gang_restarts_and_requeues(self):
+        fc, cluster, jax_ctl, sched_ctl, kubelet, reg = self._running_world()
+        cluster.delete("v1", "Node", "n0")
+        S.pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert job["status"].get("preemptions", 0) >= 1
+        assert job["status"].get("restarts", 0) == 0
+        assert all(n is None for n in S.bindings(cluster).values())
+        cluster.create(new_tpu_node("n2"))   # replacement capacity
+        S.pump([jax_ctl, sched_ctl], fc, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "gang", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+        assert sorted(S.bindings(cluster).values()) == ["n1", "n2"]
+
+
+# -- leases: transient-error retention --------------------------------------
+
+
+class _LeaseClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestLeaseRetention:
+    def test_transient_renew_error_does_not_flap_leadership(self):
+        inner = FakeCluster()
+        clock = _LeaseClock()
+        faulty = ChaosClient(
+            inner, ChaosPolicy(seed=1, rate=1.0, conflict_weight=0.0,
+                               kinds=frozenset({"Lease"})),
+            always_on=False)
+        a = LeaderElector(faulty, "ctl", identity="a", lease_seconds=15.0,
+                          clock=clock)
+        assert a.try_acquire() is True       # clean bootstrap
+        faulty.always_on = True              # apiserver starts erroring
+        clock.t += 6                         # past the lease/3 cache
+        assert a.try_acquire() is True       # retained: lease still ours
+        assert a.is_leader
+        clock.t += 10                        # 16s since last REAL renew
+        assert a.try_acquire() is False      # guard ends at lease expiry
+        faulty.always_on = False             # apiserver healthy again
+        assert a.try_acquire() is True       # lease still names us: renew
+
+    def test_standby_takeover_still_works_after_retention_window(self):
+        inner = FakeCluster()
+        clock = _LeaseClock()
+        faulty = ChaosClient(
+            inner, ChaosPolicy(seed=2, rate=1.0, conflict_weight=0.0,
+                               kinds=frozenset({"Lease"})),
+            always_on=False)
+        a = LeaderElector(faulty, "ctl", identity="a", lease_seconds=15.0,
+                          clock=clock)
+        b = LeaderElector(inner, "ctl", identity="b", lease_seconds=15.0,
+                          clock=clock)
+        assert a.try_acquire()
+        faulty.always_on = True              # a can no longer renew
+        clock.t += 16                        # lease expires for everyone
+        assert b.try_acquire() is True       # healthy standby takes over
+        assert a.try_acquire() is False
+
+
+# -- preemption notice hygiene ----------------------------------------------
+
+
+class TestPreemptionNoticeHygiene:
+    SIG = signal.SIGUSR1
+
+    def test_uninstall_restores_previous_handler(self):
+        hits = []
+
+        def prev_handler(sig, frame):
+            hits.append(sig)
+
+        old = signal.signal(self.SIG, prev_handler)
+        try:
+            notice = PreemptionNotice().install(self.SIG)
+            assert notice.installed
+            # chained: our handler fires AND the previous one still runs
+            os.kill(os.getpid(), self.SIG)
+            assert notice() and hits == [self.SIG]
+            notice.uninstall()
+            assert not notice.installed
+            assert signal.getsignal(self.SIG) is prev_handler
+            os.kill(os.getpid(), self.SIG)
+            assert hits == [self.SIG, self.SIG]
+        finally:
+            signal.signal(self.SIG, old)
+
+    def test_double_install_is_idempotent(self):
+        old = signal.getsignal(self.SIG)
+        try:
+            notice = PreemptionNotice().install(self.SIG)
+            handler = signal.getsignal(self.SIG)
+            assert notice.install(self.SIG) is notice
+            # no re-chain: the active handler is the SAME object, so a
+            # signal cannot fire it twice (and uninstall still reaches
+            # the true previous handler)
+            assert signal.getsignal(self.SIG) is handler
+            notice.uninstall()
+            notice.uninstall()  # idempotent
+        finally:
+            signal.signal(self.SIG, old)
+
+    def test_install_on_second_signal_requires_uninstall(self):
+        old = signal.getsignal(self.SIG)
+        try:
+            notice = PreemptionNotice().install(self.SIG)
+            with pytest.raises(ValueError):
+                notice.install(signal.SIGUSR2)
+            notice.uninstall()
+        finally:
+            signal.signal(self.SIG, old)
+
+
+# -- checkpoint: corruption-tolerant resume + atomic writes -----------------
+
+
+class _State:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+    def replace(self, **kw):
+        d = dict(self.__dict__)
+        d.update(kw)
+        return _State(**d)
+
+
+class _StubMgr:
+    def __init__(self, steps, bad=()):
+        self._steps = list(steps)
+        self.bad = set(bad)
+        self.restore_attempts = []
+
+    def all_steps(self):
+        return list(self._steps)
+
+    def wait_until_finished(self):
+        pass
+
+    def close(self):
+        pass
+
+    def latest_step(self):
+        return max(self._steps) if self._steps else None
+
+    def restore(self, step, args=None):
+        self.restore_attempts.append(step)
+        if step in self.bad:
+            raise ValueError("truncated checkpoint payload")
+        return {"step": step, "params": {"w": float(step)},
+                "batch_stats": {}, "opt_state": {}}
+
+
+def _stub_checkpointer(mgr):
+    from types import SimpleNamespace
+
+    from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+    ck = Checkpointer.__new__(Checkpointer)
+    ck._mgr = mgr
+    ck._ocp = SimpleNamespace(
+        args=SimpleNamespace(StandardRestore=lambda tree: tree))
+    ck.directory = "/stub"
+    return ck
+
+
+class TestCheckpointResilience:
+    def _template(self):
+        return _State(step=0, params={"w": 0.0}, batch_stats={},
+                      opt_state={})
+
+    def test_restore_latest_skips_corrupt_and_falls_back(self):
+        mgr = _StubMgr([1, 2, 3], bad={3})
+        st = _stub_checkpointer(mgr).restore_latest(self._template())
+        assert st is not None and st.step == 2
+        assert mgr.restore_attempts == [3, 2]  # newest first, one fallback
+
+    def test_restore_latest_all_steps_failing_raises_systematic_error(self):
+        # every step failing is a volume outage / template mismatch, not
+        # three independent corruptions: crash-and-retry (the gang
+        # restart loop) beats silently discarding all progress
+        mgr = _StubMgr([1, 2, 3], bad={1, 2, 3})
+        with pytest.raises(ValueError):
+            _stub_checkpointer(mgr).restore_latest(self._template())
+        assert mgr.restore_attempts == [3, 2, 1]
+
+    def test_restore_latest_empty_dir_is_fresh_start(self):
+        assert _stub_checkpointer(_StubMgr([])).restore_latest(
+            self._template()) is None
+
+    def test_wait_writes_resume_manifest_atomically(self, tmp_path):
+        mgr = _StubMgr([1, 2, 5])
+        ck = _stub_checkpointer(mgr)
+        ck.directory = str(tmp_path)
+        ck.wait()
+        manifest = json.loads((tmp_path / "manifest.json").read_text())
+        assert manifest == {"latest_step": 5, "steps": [1, 2, 5]}
+        # remote URIs skip the local manifest (orbax owns metadata there)
+        ck.directory = "gs://bucket/ckpt"
+        ck.close()
+
+    def test_atomic_write_text(self, tmp_path):
+        from kubeflow_tpu.runtime.checkpoint import atomic_write_text
+
+        path = tmp_path / "manifest.json"
+        atomic_write_text(str(path), '{"step": 1}')
+        assert path.read_text() == '{"step": 1}'
+        atomic_write_text(str(path), '{"step": 2}')  # overwrite in place
+        assert path.read_text() == '{"step": 2}'
+        # no temp residue after successful replaces
+        assert [p.name for p in tmp_path.iterdir()] == ["manifest.json"]
+
+    def test_trace_dump_is_atomic_and_loadable(self, tmp_path):
+        t = tr.Tracer(tr.TraceCollector())
+        with t.span("unit"):
+            pass
+        path = tmp_path / "trace.jsonl"
+        tr.write_jsonl(str(path), t.collector.spans())
+        assert [s.name for s in tr.read_jsonl(str(path))] == ["unit"]
+        assert [p.name for p in tmp_path.iterdir()] == ["trace.jsonl"]
+
+
+# -- events: fire-and-forget under apiserver errors -------------------------
+
+
+class TestEventBestEffort:
+    def test_recorder_drops_instead_of_raising(self):
+        inner = FakeCluster()
+        faulty = ChaosClient(
+            inner, ChaosPolicy(seed=1, rate=1.0, conflict_weight=0.0,
+                               verbs=frozenset({"create"})))
+        rec = EventRecorder(faulty)
+        pod = inner.create({"apiVersion": "v1", "kind": "Pod",
+                            "metadata": {"name": "p", "namespace": "default"}})
+        out = rec.event(pod, "Chaos", "event create failed upstream")
+        assert out["reason"] == "Chaos"  # returned unsent, no raise
+        assert inner.list("v1", "Event", namespace="default") == []
+
+    def test_recorder_recovers_when_apiserver_does(self):
+        inner = FakeCluster()
+        faulty = ChaosClient(
+            inner, ChaosPolicy(seed=1, rate=1.0, conflict_weight=0.0,
+                               verbs=frozenset({"create"})),
+            always_on=False)
+        rec = EventRecorder(faulty)
+        pod = inner.create({"apiVersion": "v1", "kind": "Pod",
+                            "metadata": {"name": "p", "namespace": "default"}})
+        with faulty.armed():
+            rec.event(pod, "Chaos", "dropped")
+        rec.event(pod, "Chaos", "dropped")  # healthy: lands this time
+        evs = inner.list("v1", "Event", namespace="default")
+        assert len(evs) == 1 and evs[0]["count"] == 1
+
+
+# -- chaos-parameterized reruns of the happy-path suites --------------------
+
+
+def _jaxjob_chaos_world(seed):
+    """The J.world fixture, chaos edition: one FakeCluster, faults armed
+    ONLY during reconciles (the tests' own setup/asserts stay clean)."""
+    inner = FakeCluster()
+    chaos = ChaosClient(inner, _policy(seed), always_on=False)
+    ctl = arm_controller(
+        seed_controller(build_controller(chaos, record_events=True)), chaos)
+    # zero the retry delays: error/conflict retries then complete inside
+    # the SAME drain the original tests budget for, so their assertions
+    # hold with faults on (wall-clock pacing is pinned separately in
+    # TestConflictBackoff / TestRestClientBackoff)
+    ctl.CONFLICT_RETRY = (0, 0)
+    ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner)
+    return chaos, ctl, kubelet
+
+
+def _sched_chaos_world(seed):
+    def factory(clock):
+        inner = FakeCluster()
+        chaos = ChaosClient(inner, _policy(seed), always_on=False)
+        registry = MetricsRegistry()
+        jax_ctl = arm_controller(seed_controller(
+            build_controller(chaos, record_events=False)), chaos)
+        sched_ctl = arm_controller(seed_controller(
+            build_scheduler(chaos, registry=registry, record_events=False,
+                            clock=clock)), chaos)
+        for ctl in (jax_ctl, sched_ctl):
+            ctl.CONFLICT_RETRY = (0, 0)
+            ctl.RETRY_BASE = 0.0
+        kubelet = FakeKubelet(inner, auto_bind=False)
+        return chaos, jax_ctl, sched_ctl, kubelet, registry
+
+    return factory
+
+
+def _methods(cls):
+    return [(cls, n) for n in sorted(dir(cls)) if n.startswith("test_")]
+
+
+# Every jaxjob-controller suite whose tests drive ONLY through the world
+# tuple (TestIdempotency calls the reconciler directly — with chaos
+# armed its no-op contract cannot hold, so it stays chaos-free).
+JAXJOB_HAPPY = [case for cls in (
+    J.TestGangCreation, J.TestLifecycle, J.TestGangRestart,
+    J.TestPreemptionAwareRestart, J.TestSliceHealth,
+    J.TestSliceHealthOrdering, J.TestPreemptionClassification,
+    J.TestMultislice, J.TestTopologyValidation,
+) for case in _methods(cls)]
+
+# Scheduler happy paths whose assertions are chaos-stable (final
+# placement / never-happens properties — not exact retry counts,
+# fake-clock-pinned backoff schedules, or queue-ARRIVAL order, all of
+# which chaos legitimately shifts: e.g. strict-FIFO orders gangs once
+# queued, but a faulted gang creation can reach the queue second).
+SCHED_HAPPY = [
+    (S.TestAllOrNothingAdmission, "test_capacity_for_n_minus_one_binds_zero"),
+    (S.TestAllOrNothingAdmission, "test_admits_when_capacity_appears"),
+    (S.TestAllOrNothingAdmission, "test_head_blocking_is_per_namespace"),
+    (S.TestAllOrNothingAdmission,
+     "test_topology_spelling_is_normalized_for_placement"),
+    (S.TestAllOrNothingAdmission, "test_non_gang_jobs_ignore_the_scheduler"),
+    (S.TestPriorityPreemption, "test_victims_in_other_pools_are_never_evicted"),
+    (S.TestPriorityPreemption, "test_equal_priority_never_preempts"),
+]
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+@pytest.mark.parametrize(
+    "case", JAXJOB_HAPPY,
+    ids=[f"{cls.__name__}.{name}" for cls, name in JAXJOB_HAPPY])
+def test_jaxjob_happy_paths_survive_chaos(case, seed):
+    cls, name = case
+    getattr(cls(), name)(_jaxjob_chaos_world(seed))
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+@pytest.mark.parametrize(
+    "case", SCHED_HAPPY,
+    ids=[f"{cls.__name__}.{name}" for cls, name in SCHED_HAPPY])
+def test_scheduler_happy_paths_survive_chaos(case, seed, monkeypatch):
+    monkeypatch.setattr(S, "sched_world", _sched_chaos_world(seed))
+    cls, name = case
+    getattr(cls(), name)()
+
+
+# -- deterministic replay through real controllers --------------------------
+
+
+def _replay_run(seed):
+    """A full jaxjob lifecycle under conflict-only chaos with every
+    retry delay zeroed: control flow depends on nothing but the seed, so
+    two runs must inject the IDENTICAL fault sequence and converge to
+    the identical terminal state."""
+    inner = FakeCluster()
+    chaos = ChaosClient(
+        inner, ChaosPolicy(seed=seed, rate=0.3, error_weight=0.0,
+                           conflict_weight=1.0, watch_drop_every=7),
+        always_on=False)
+    ctl = arm_controller(
+        seed_controller(build_controller(chaos, record_events=True)), chaos)
+    ctl.CONFLICT_RETRY = (0, 0)
+    ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner)
+    inner.create(JT.new_jaxjob("replay", replicas=2,
+                               accelerator="tpu-v5-lite-podslice",
+                               topology="2x4", chips_per_worker=4))
+    for _ in range(6):
+        ctl.run_until_idle(advance_delayed=True)
+        kubelet.step()
+    for i in range(2):
+        kubelet.succeed(worker_name("replay", i))
+    for _ in range(6):
+        ctl.run_until_idle(advance_delayed=True)
+    job = inner.get(JT.API_VERSION, JT.KIND, "replay", "default")
+    return chaos.fault_log(), ob.cond_is_true(job, JT.COND_SUCCEEDED)
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_fault_sequence_replays_exactly(seed):
+    log1, ok1 = _replay_run(seed)
+    log2, ok2 = _replay_run(seed)
+    assert ok1 and ok2, "chaos run must still converge to Succeeded"
+    assert log1 == log2, "same seed must inject the same fault sequence"
+    assert log1, "the run should actually have seen faults"
+
+
+# -- the full-platform chaos soak -------------------------------------------
+
+
+def _assert_capacity_respected(inner):
+    """No node oversubscribed by bound, non-terminal pods — the
+    all-or-nothing + eviction accounting invariant, checked every
+    round."""
+    from kubeflow_tpu.control.scheduler import nodes as N
+
+    alloc = {}
+    for node in inner.list("v1", "Node"):
+        v = N.node_view(node)
+        alloc[v.name] = v.allocatable_chips
+    used: dict[str, int] = {}
+    for p in inner.list("v1", "Pod"):
+        node = (p.get("spec") or {}).get("nodeName")
+        if not node:
+            continue
+        if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+            continue
+        used[node] = used.get(node, 0) + N.pod_tpu_request(p)
+    for node, n in used.items():
+        if node in alloc:
+            assert n <= alloc[node], (
+                f"node {node} oversubscribed: {n} > {alloc[node]}")
+
+
+def _soak(seed, rounds=200):
+    """One seeded soak: 3 gang jobs contending for 4 TPU hosts while the
+    apiserver errors, watches drop, a node dies and heals, pods are
+    evicted and hard-killed, the lease plane misbehaves, and the leader
+    crashes mid-run. Returns (fault logs, failover duration)."""
+    tr.COLLECTOR.clear()
+    inner = FakeCluster(history_limit=64)
+    chaos = ChaosClient(
+        inner, ChaosPolicy(seed=seed, rate=0.06, watch_drop_every=18),
+        always_on=False)
+    lease_chaos = ChaosClient(
+        inner, ChaosPolicy(seed=seed + 1000, rate=0.15, conflict_weight=0.0,
+                           kinds=frozenset({"Lease"})))
+    clock = S.FakeClock()
+    registry = MetricsRegistry()
+    lease_seconds = 15.0
+
+    el_a = LeaderElector(lease_chaos, "jaxjob-soak", identity="a",
+                         lease_seconds=lease_seconds, clock=clock)
+    el_b = LeaderElector(lease_chaos, "jaxjob-soak", identity="b",
+                         lease_seconds=lease_seconds, clock=clock)
+    ctl_a = arm_controller(seed_controller(build_controller(
+        chaos, record_events=True, registry=registry)),
+        chaos).with_leader_election(el_a)
+    ctl_b = arm_controller(seed_controller(build_controller(
+        chaos, record_events=True, registry=registry)),
+        chaos).with_leader_election(el_b)
+    sched_ctl = arm_controller(seed_controller(build_scheduler(
+        chaos, registry=registry, record_events=True, clock=clock)), chaos)
+    for ctl in (ctl_a, ctl_b, sched_ctl):
+        ctl.CONFLICT_RETRY = (0, 0)  # timing-free: replay-exact runs
+        ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner, auto_bind=False)
+
+    for i in range(4):
+        inner.create(new_tpu_node(f"n{i}"))
+    jobs = ["j0", "j1", "j2"]
+    for i, name in enumerate(jobs):
+        job = JT.new_jaxjob(name, replicas=2,
+                            accelerator="tpu-v5-lite-podslice",
+                            topology="2x4", chips_per_worker=4,
+                            gang_schedule=True, priority=i % 2)
+        # chaos budget: transient faults must never exhaust a job
+        job["spec"]["maxRestarts"] = 100
+        job["spec"]["maxPreemptions"] = 100
+        inner.create(job)
+
+    rng = random.Random(seed)
+    run_age: dict[str, int] = {}
+    controllers = [ctl_a, ctl_b]
+    failover_took = None
+
+    def drain():
+        for c in controllers + [sched_ctl]:
+            c.run_until_idle(advance_delayed=True)
+
+    for r in range(rounds):
+        drain()
+        kubelet.step()
+        _assert_capacity_respected(inner)
+
+        # simulated workload: a pod that stays Running 6 rounds succeeds
+        # (long enough that every drill below lands on LIVE gangs)
+        for p in sorted(inner.list("v1", "Pod"),
+                        key=lambda p: ob.meta(p)["name"]):
+            if (p.get("status") or {}).get("phase") != "Running":
+                continue
+            uid = ob.meta(p)["uid"]
+            run_age[uid] = run_age.get(uid, 0) + 1
+            if run_age[uid] >= 6:
+                try:
+                    kubelet.succeed(ob.meta(p)["name"],
+                                    ob.meta(p).get("namespace") or "default")
+                except ob.NotFound:
+                    pass
+
+        # scripted chaos drills (deterministic per seed)
+        if r == 8:
+            chaos.fail_node("n0")
+        if r == 16:
+            chaos.heal_node("n0")
+        if r in (12, 20):
+            running = sorted(
+                (p for p in inner.list("v1", "Pod")
+                 if (p.get("status") or {}).get("phase") == "Running"
+                 and (p.get("spec") or {}).get("nodeName")),
+                key=lambda p: ob.meta(p)["name"])
+            if running:
+                victim = running[rng.randrange(len(running))]
+                m = ob.meta(victim)
+                if r == 12:
+                    chaos.evict_pod(m["name"], m.get("namespace") or "default")
+                else:
+                    chaos.kill_pod(m["name"], m.get("namespace") or "default")
+        if r == 26 and failover_took is None:
+            # crash whichever replica holds the lease RIGHT NOW (lease-
+            # plane chaos means it is not always "a"): stop driving it,
+            # and the survivor must take over within one lease duration
+            # of the leader's last successful renew (+ slack for fault-
+            # injected renew attempts of its own)
+            if el_b.is_leader:
+                survivor_ctl, survivor_el = ctl_a, el_a
+            else:  # a leads (or neither mid-fault: crash a, keep b)
+                survivor_ctl, survivor_el = ctl_b, el_b
+            controllers = [survivor_ctl]
+            crash_t = clock.t
+            while not survivor_el.try_acquire():
+                clock.advance(1.0)
+                survivor_ctl.run_until_idle(advance_delayed=True)
+                assert clock.t - crash_t <= lease_seconds + 5.0, \
+                    "standby failed to take over within one lease duration"
+            assert survivor_el.is_leader
+            failover_took = clock.t - crash_t
+
+        clock.advance(1.0)
+        done = all(ob.cond_is_true(
+            inner.get(JT.API_VERSION, JT.KIND, name, "default"),
+            JT.COND_SUCCEEDED) for name in jobs)
+        if done and failover_took is not None:
+            break
+
+    # -- convergence ---------------------------------------------------------
+    for name in jobs:
+        job = inner.get(JT.API_VERSION, JT.KIND, name, "default")
+        assert ob.cond_is_true(job, JT.COND_SUCCEEDED), (
+            name, job.get("status"))
+        assert not ob.cond_is_true(job, JT.COND_FAILED)
+        # no gang lost or duplicated: exactly the declared worker set
+        pods = inner.list("v1", "Pod", namespace="default",
+                          label_selector={"matchLabels": {
+                              JT.LABEL_JOB_NAME: name}})
+        assert sorted(ob.meta(p)["name"] for p in pods) == \
+            [worker_name(name, i) for i in range(2)]
+
+    # -- leader failover happened, inside one lease duration (+ slack) -------
+    assert failover_took is not None
+    assert failover_took <= lease_seconds + 5.0
+
+    # -- the trace tree stays connected under chaos --------------------------
+    for name in jobs:
+        job = inner.get(JT.API_VERSION, JT.KIND, name, "default")
+        header = (ob.meta(job).get("annotations") or {}).get(
+            tr.TRACEPARENT_ANNOTATION)
+        assert header, f"{name} lost its traceparent"
+        ctx = tr.parse_traceparent(header)
+        spans = tr.COLLECTOR.trace(ctx.trace_id)
+        assert spans, f"{name} produced no spans"
+        reach = tr.reachable(spans, ctx.span_id)
+        assert reach >= {s.span_id for s in spans}, (
+            f"{name}: disconnected spans "
+            f"{[s.name for s in spans if s.span_id not in reach]}")
+
+    return chaos.fault_log(), lease_chaos.fault_log(), failover_took
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+def test_chaos_soak_converges_and_replays(seed):
+    faults1, lease_faults1, took1 = _soak(seed)
+    assert faults1, "soak should actually have injected faults"
+    faults2, lease_faults2, took2 = _soak(seed)
+    assert faults1 == faults2, "soak fault sequence must replay exactly"
+    assert lease_faults1 == lease_faults2
+    assert took1 == took2
+
+
+# -- eviction-status single spelling ----------------------------------------
+
+
+def test_eviction_status_is_the_preemption_shape():
+    from kubeflow_tpu.control.jaxjob.controller import JAXJobReconciler
+
+    pod = {"apiVersion": "v1", "kind": "Pod",
+           "metadata": {"name": "p", "namespace": "default"},
+           "spec": {"containers": [{"name": "jax"}]},
+           "status": eviction_status("drill")}
+    assert JAXJobReconciler._pod_preempted(pod)
