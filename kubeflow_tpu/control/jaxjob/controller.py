@@ -22,6 +22,7 @@ semantics replace what the external tf-operator did for TFJobs:
 from __future__ import annotations
 
 import logging
+import os
 
 import prometheus_client as prom
 
@@ -30,8 +31,10 @@ from kubeflow_tpu.control.jaxjob import types as T
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
 from kubeflow_tpu.control.scheduler import (
-    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+    ANNOTATION_ELASTIC_MIN, ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY,
+    GATE_GANG, LABEL_SPOT, SCHEDULER_NAME,
 )
+from kubeflow_tpu.parallel.dist import WorldSpec
 from kubeflow_tpu.control.scheduler.topology import parse_topology
 from kubeflow_tpu.obs import trace as obs_trace
 
@@ -57,6 +60,12 @@ def gang_restarts():
 
 def jobs_running():
     return _metric("jaxjob_running", prom.Gauge, "JAXJobs currently in Running condition")
+
+
+def gang_resizes():
+    return _metric("jaxjob_resizes_total", prom.Counter,
+                   "elastic gang resizes (shrink-to-survivors / grow-back)",
+                   labelnames=("direction",))
 
 
 def schedule_latency():
@@ -87,6 +96,46 @@ def pod_epoch(pod: dict, default: int) -> int:
         return int(ob.annotations_of(pod).get(T.ANNOTATION_EPOCH, default))
     except (TypeError, ValueError):
         return default
+
+
+def worker_index(pod_name: str) -> int:
+    """Replica index from a worker pod name (ordering key for world
+    membership: ranks stay aligned with the original indices)."""
+    try:
+        return int(pod_name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return 0
+
+
+def member_coordinator(job: dict, member: str) -> str:
+    """Stable DNS of a member's coordinator port (the headless-service
+    name scheme the gang's env contract already uses)."""
+    m = ob.meta(job)
+    port = (job.get("spec") or {}).get(
+        "coordinatorPort", T.DEFAULT_COORDINATOR_PORT)
+    return f"{member}.{m['name']}.{m['namespace']}.svc:{port}"
+
+
+def job_world(job: dict) -> WorldSpec:
+    """The job's CURRENT elastic world. status.world is the durable
+    record a resize writes; absent (fresh job, or after a gang restart
+    cleared it) the world is implicitly the full gang."""
+    status = job.get("status") or {}
+    w = status.get("world")
+    if isinstance(w, dict):
+        try:
+            members = tuple(str(x) for x in w["members"])
+            return WorldSpec(gen=int(w["gen"]), size=len(members),
+                             members=members,
+                             coordinator=w.get("coordinator") or None)
+        except (KeyError, TypeError, ValueError):
+            pass  # malformed status residue: fall back to the full gang
+    m = ob.meta(job)
+    total = T.gang_size(job.get("spec") or {})
+    members = tuple(worker_name(m["name"], i) for i in range(total))
+    return WorldSpec(gen=status.get("resizes", 0), size=total,
+                     members=members,
+                     coordinator=member_coordinator(job, members[0]))
 
 
 class JAXJobReconciler(Reconciler):
@@ -165,9 +214,10 @@ class JAXJobReconciler(Reconciler):
         return svc
 
     def coordinator_address(self, job: dict) -> str:
-        m = ob.meta(job)
-        port = job["spec"].get("coordinatorPort", T.DEFAULT_COORDINATOR_PORT)
-        return f"{worker_name(m['name'], 0)}.{m['name']}.{m['namespace']}.svc:{port}"
+        # one spelling of the DNS scheme (member_coordinator): the
+        # rigid env coordinator and the elastic world stamp must agree
+        return member_coordinator(
+            job, worker_name(ob.meta(job)["name"], 0))
 
     def generate_pod(self, job: dict, index: int) -> dict:
         m = ob.meta(job)
@@ -206,10 +256,48 @@ class JAXJobReconciler(Reconciler):
             env += [{"name": k, "value": v} for k, v in sorted(
                 D.slice_env(slices, slice_id,
                             self.coordinator_address(job)).items())]
+        elastic = T.elastic_spec(spec)
+        if elastic:
+            # any elastic block (even resizePolicy Restart) opts the
+            # worker into spot/preemptible pools: it tolerates reclaim,
+            # by restart if not by resize. Rigid gangs never tolerate
+            # the spot taint, so on-demand capacity stays theirs.
+            tols = list(pod_spec.get("tolerations") or [])
+            spot_tol = {"key": LABEL_SPOT, "operator": "Equal",
+                        "value": "true", "effect": "NoSchedule"}
+            if spot_tol not in tols:
+                tols.append(spot_tol)
+            pod_spec["tolerations"] = tols
+        if T.is_elastic(spec):
+            # resize signal plumbing: the world annotation (stamped
+            # below, re-stamped on every resize) is projected into the
+            # pod via the downward API; the elastic coordinator re-reads
+            # the file to catch shrink/grow without a kube client
+            env += [
+                {"name": T.ENV_WORLD_FILE, "value": T.WORLD_FILE_PATH},
+                {"name": T.ENV_BATCH_POLICY,
+                 "value": elastic["batchPolicy"]},
+            ]
+            vols = list(pod_spec.get("volumes") or [])
+            if not any(v.get("name") == "jaxjob-world" for v in vols):
+                vols.append({"name": "jaxjob-world", "downwardAPI": {
+                    "items": [{"path": "world", "fieldRef": {
+                        "fieldPath": "metadata.annotations"
+                                     f"['{T.ANNOTATION_WORLD}']"}}]}})
+            pod_spec["volumes"] = vols
         tpu = spec.get("tpu") or {}
         for c in pod_spec.get("containers", []):
             have = {e["name"] for e in c.get("env", [])}
             c.setdefault("env", []).extend(e for e in env if e["name"] not in have)
+            if T.is_elastic(spec):
+                mounts = list(c.get("volumeMounts") or [])
+                if not any(v.get("name") == "jaxjob-world"
+                           for v in mounts):
+                    mounts.append({
+                        "name": "jaxjob-world",
+                        "mountPath": os.path.dirname(T.WORLD_FILE_PATH),
+                        "readOnly": True})
+                c["volumeMounts"] = mounts
             if tpu.get("chipsPerWorker"):
                 res = c.setdefault("resources", {}).setdefault("limits", {})
                 res.setdefault(T.RESOURCE_TPU, tpu["chipsPerWorker"])
@@ -236,6 +324,12 @@ class JAXJobReconciler(Reconciler):
         # controller-owned incarnation stamp (a template value must not
         # be able to mark a fresh pod as condemned)
         annotations[T.ANNOTATION_EPOCH] = str(gang_epoch(job))
+        if T.is_elastic(spec):
+            # controller-owned world stamp: a pod created DURING a
+            # shrunken incarnation (a grow-back replacement) carries the
+            # current shrunken membership — it is not a member until a
+            # grow resize re-stamps it (the worker's join barrier)
+            annotations[T.ANNOTATION_WORLD] = job_world(job).to_json()
         if traceparent:
             annotations[obs_trace.TRACEPARENT_ANNOTATION] = traceparent
         if spec.get("schedulerName"):
@@ -256,6 +350,11 @@ class JAXJobReconciler(Reconciler):
             # annotation must not shrink the gang or skew its priority
             annotations[ANNOTATION_GANG_SIZE] = str(total)
             annotations[ANNOTATION_PRIORITY] = str(spec.get("priority", 0))
+            if T.is_elastic(spec):
+                # partial-admission floor: the scheduler may bind any
+                # subset >= this instead of all-or-nothing
+                annotations[ANNOTATION_ELASTIC_MIN] = str(
+                    T.elastic_spec(spec)["minReplicas"])
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -398,12 +497,39 @@ class JAXJobReconciler(Reconciler):
                         by_name[ob.meta(p)["name"]] = p
                 pods = list(by_name.values())
             else:
-                # a worker vanished from a STARTED gang: the remaining
-                # world can never re-form a mesh — restart the whole set.
-                return self._gang_restart(
-                    client, job, pods, reason="WorkerDisappeared",
-                    message=f"workers missing: {[worker_name(req.name, i) for i in missing]}",
-                )
+                # a worker vanished from a STARTED gang. Elastic jobs
+                # shrink to the survivors (the data-parallel world
+                # re-forms at the smaller size, resumes from the last
+                # checkpoint — no budget burned); rigid worlds can never
+                # re-form a mesh minus one worker, so the whole set
+                # restarts.
+                handled = False
+                if T.is_elastic(spec):
+                    missing_names = {worker_name(req.name, i)
+                                     for i in missing}
+                    if not (missing_names & set(job_world(job).members)) \
+                            and (job.get("status") or {}).get("resizes", 0) \
+                            >= T.elastic_spec(spec)["maxResizes"]:
+                        # only NON-members are missing and the resize
+                        # ceiling is spent: they can never rejoin the
+                        # world (a grow needs a resize), so their
+                        # absence is permanent and harmless — the
+                        # shrunken world runs out at its current size
+                        handled = True
+                    else:
+                        res = self._elastic_shrink(
+                            client, job, pods,
+                            lost=[], recreate=missing,
+                            reason="WorkerDisappeared",
+                            message=f"workers missing: "
+                                    f"{sorted(missing_names)}")
+                        if res is not None:
+                            return res
+                if not handled:
+                    return self._gang_restart(
+                        client, job, pods, reason="WorkerDisappeared",
+                        message=f"workers missing: {[worker_name(req.name, i) for i in missing]}",
+                    )
 
         # -- derive status from pod phases ---------------------------------
         # snapshot for the no-op write guard below: an unchanged status
@@ -429,15 +555,37 @@ class JAXJobReconciler(Reconciler):
         if n_failed > 0:
             return self._maybe_restart_or_fail(client, job, pods, phases)
 
-        if n_succeeded == replicas:
+        complete = n_succeeded == replicas
+        leftovers: list[dict] = []
+        if not complete and T.is_elastic(spec) and n_succeeded > 0:
+            # elastic completion: the CURRENT world's members all
+            # succeeded. NON-member pods must not hold the job open —
+            # a waiting (or even already-Running, mid-join-barrier)
+            # grow-back replacement is deleted below, not re-run: its
+            # membership could only come from a grow re-stamp, which
+            # can never happen once the members have exited.
+            members = set(job_world(job).members)
+            if members and all(phases.get(name) == "Succeeded"
+                               for name in members):
+                complete = True
+                leftovers = [p for name, p in by_name.items()
+                             if name not in members
+                             and phases.get(name) != "Succeeded"]
+        if complete:
             was_running = ob.cond_is_true(job, T.COND_RUNNING)
             ob.cond_set(job, T.COND_RUNNING, "False", "JobCompleted", "")
             ob.cond_set(job, T.COND_SUCCEEDED, "True", "AllWorkersSucceeded",
-                        f"{replicas}/{replicas} workers succeeded")
+                        f"{n_succeeded}/{replicas} workers succeeded")
             job["status"]["completionTime"] = ob.now_iso()
             client.update_status(job)
             if was_running:
                 jobs_running().dec()
+            for p in leftovers:
+                try:
+                    client.delete("v1", "Pod", ob.meta(p)["name"],
+                                  req.namespace)
+                except (ob.NotFound, ob.ApiError):
+                    pass  # ownerRef GC reaps any residue at job deletion
             if self.record_events:
                 client.record_event(job, "JAXJobSucceeded", "all workers succeeded")
             self._finish_root(req.namespace, req.name, "succeeded")
@@ -452,15 +600,48 @@ class JAXJobReconciler(Reconciler):
         # drains afterwards must stay Succeeded, not be re-run.
         bad_nodes = self._unhealthy_nodes(client, pods)
         if bad_nodes and spec.get("restartPolicy", T.RESTART_GANG) == T.RESTART_GANG:
-            if job["status"].get("preemptions", 0) >= spec.get("maxPreemptions", 50):
-                return self._fail(client, job,
-                                  f"unhealthy nodes: {bad_nodes}; "
-                                  "preemption budget exhausted")
-            return self._gang_restart(
-                client, job, pods, reason="SliceUnhealthy",
-                message=f"unhealthy nodes under gang: {bad_nodes}",
-                preemption=True,
-            )
+            # None = rigid gang; a list = the elastic pods to condemn
+            # (only the non-terminal pods under the dying nodes — the
+            # rest of the data-parallel world keeps training smaller)
+            victims = None
+            if T.is_elastic(spec):
+                victims = [
+                    p for p in pods
+                    if (p.get("spec") or {}).get("nodeName") in bad_nodes
+                    and phases.get(ob.meta(p)["name"]) not in
+                    ("Succeeded", "Failed")]
+                if victims:
+                    res = self._elastic_shrink(
+                        client, job, pods,
+                        lost=victims,
+                        recreate=[worker_index(ob.meta(p)["name"])
+                                  for p in victims],
+                        reason="SliceUnhealthy",
+                        message=f"unhealthy nodes under gang: {bad_nodes}")
+                    if res is not None:
+                        return res
+            if victims is None or victims:
+                # rigid, or an elastic shrink that was not viable
+                # (below the floor / ceiling spent): whole-gang restart
+                if job["status"].get("preemptions", 0) >= spec.get("maxPreemptions", 50):
+                    return self._fail(client, job,
+                                      f"unhealthy nodes: {bad_nodes}; "
+                                      "preemption budget exhausted")
+                return self._gang_restart(
+                    client, job, pods, reason="SliceUnhealthy",
+                    message=f"unhealthy nodes under gang: {bad_nodes}",
+                    preemption=True,
+                )
+            # elastic with only terminal pods on the dying nodes (a
+            # member that already Succeeded): nothing to condemn, the
+            # running world is unaffected — neither a resize (which
+            # would spuriously shrink the finished member out) nor a
+            # restart; completion handles the member's exit
+
+        if T.is_elastic(spec):
+            res = self._elastic_world_pass(client, job, by_name, phases)
+            if res is not None:
+                return res
 
         if n_running == replicas:
             if not ob.cond_is_true(job, T.COND_RUNNING):
@@ -541,6 +722,21 @@ class JAXJobReconciler(Reconciler):
         # pathological always-75 loop.
         preempted = bool(failed_pods) and all(
             self._pod_preempted(p) for p in failed_pods)
+        if gang_policy and preempted and T.is_elastic(spec):
+            # preemption of an elastic gang: shrink to the survivors
+            # instead of tearing everything down — no budget consumed,
+            # warm state kept. Falls through to the restart path when
+            # the survivors would drop below minReplicas (or the resize
+            # ceiling is spent).
+            res = self._elastic_shrink(
+                client, job, pods,
+                lost=failed_pods,
+                recreate=[worker_index(ob.meta(p)["name"])
+                          for p in failed_pods],
+                reason="WorkerPreempted",
+                message=f"preempted workers: {failed}")
+            if res is not None:
+                return res
         if gang_policy and preempted:
             if job["status"].get("preemptions", 0) < spec.get("maxPreemptions", 50):
                 return self._gang_restart(
@@ -559,6 +755,201 @@ class JAXJobReconciler(Reconciler):
             )
         return self._fail(client, job,
                           f"workers failed: {failed}; restarts exhausted")
+
+    # -- elastic resize -----------------------------------------------------
+
+    @staticmethod
+    def _gang_gated(pod: dict) -> bool:
+        """Still held by OUR scheduling gate — the scheduler has not
+        admitted this pod (a grow-back replacement in the queue)."""
+        return any(g.get("name") == GATE_GANG for g in
+                   (pod.get("spec") or {}).get("schedulingGates") or [])
+
+    def _elastic_shrink(self, client, job, pods, lost, recreate,
+                        reason: str, message: str) -> Result | None:
+        """Shrink-to-survivors, or None when a shrink is not viable
+        (survivors below minReplicas / resize ceiling spent) — the
+        caller then falls back to the restart path."""
+        el = T.elastic_spec(job["spec"])
+        lost_names = {ob.meta(p)["name"] for p in lost}
+        survivors = sorted(
+            (ob.meta(p)["name"] for p in pods
+             if ob.meta(p)["name"] not in lost_names
+             and (p.get("status") or {}).get("phase") == "Running"),
+            key=worker_index)
+        if len(survivors) < el["minReplicas"]:
+            return None
+        world = job_world(job)
+        if tuple(survivors) != world.members \
+                and (job.get("status") or {}).get("resizes", 0) \
+                >= el["maxResizes"]:
+            return None  # flap ceiling: fall back to restart semantics
+        return self._resize(client, job, pods, members=survivors,
+                            remove=lost, recreate=recreate,
+                            reason=reason, message=message,
+                            direction="shrink")
+
+    def _elastic_world_pass(self, client, job, by_name, phases) -> Result | None:
+        """Steady-state elastic reconciliation: grow-back when admitted
+        replacements came up, shrink-to-admitted when the scheduler
+        could only place a subset at start, and the Running condition
+        for a healthy shrunken world. None = nothing elastic to do
+        (fall through to the rigid-status derivation)."""
+        spec = job["spec"]
+        el = T.elastic_spec(spec)
+        replicas = T.gang_size(spec)
+        world = job_world(job)
+        members = set(world.members)
+        running = sorted((n for n, ph in phases.items() if ph == "Running"),
+                         key=worker_index)
+        budget_left = (job.get("status") or {}).get("resizes", 0) \
+            < el["maxResizes"]
+
+        newcomers = set(running) - members
+        if newcomers and members <= set(running) and budget_left:
+            # grow-back: the scheduler readmitted capacity and the
+            # replacements are up (in their join barrier, waiting to
+            # appear in the world stamp) — re-form at the larger size
+            return self._resize(
+                client, job, list(by_name.values()), members=running,
+                remove=[], recreate=[], reason="CapacityReadmitted",
+                message=f"capacity readmitted: {sorted(newcomers)}",
+                direction="grow")
+
+        if 0 < len(running) < world.size \
+                and len(running) >= el["minReplicas"] and budget_left:
+            waiting = [n for n, ph in phases.items() if ph != "Running"]
+            if all(phases[n] == "Pending" and self._gang_gated(by_name[n])
+                   for n in waiting):
+                # partial admission at start: every non-running worker
+                # is still gate-held (the scheduler bound only a
+                # subset >= the elastic floor). Start the world at the
+                # admitted size rather than idling bound chips; the
+                # remainder grows back on admission.
+                return self._resize(
+                    client, job, list(by_name.values()), members=running,
+                    remove=[], recreate=[], reason="PartialAdmission",
+                    message=f"scheduler admitted {len(running)}/{replicas} "
+                            f"workers (elastic floor {el['minReplicas']})",
+                    direction="shrink")
+
+        if running and tuple(running) == world.members \
+                and world.size < replicas:
+            # healthy shrunken world: Running at the elastic size. The
+            # rigid n_running == replicas branch can never fire here.
+            m = ob.meta(job)
+            if not ob.cond_is_true(job, T.COND_RUNNING):
+                ob.cond_set(job, T.COND_RUNNING, "True", "AllWorkersRunning",
+                            f"{world.size}/{replicas} workers running "
+                            f"(elastic)")
+                job["status"].setdefault("startTime", ob.now_iso())
+                client.update_status(job)
+                jobs_running().inc()
+                if self.record_events:
+                    client.record_event(
+                        job, "JAXJobRunning",
+                        f"elastic gang is running at {world.size}/{replicas}")
+                self._finish_root(m["namespace"], m["name"], "running")
+            return Result()  # event-driven from here (grow on pod events)
+        return None
+
+    def _resize(self, client, job, pods, members, remove, recreate,
+                reason: str, message: str, direction: str) -> Result:
+        """Record + enact ONE elastic resize. Ordering mirrors
+        _gang_restart's record-FIRST discipline: the resize counter,
+        activeReplicas and the new world land durably in status before
+        any pod is touched — so an interrupted teardown re-enters here,
+        sees the membership already recorded, and only FINISHES the pod
+        work (idempotent: one incident, one resizes increment).
+
+        ``members`` is the new world (rank = sorted position); ``remove``
+        pods are deleted; ``recreate`` indices are re-provisioned as
+        fresh pods (gate-held under the gang scheduler), which is
+        exactly the grow-back queue."""
+        m = ob.meta(job)
+        spec = job["spec"]
+        members = sorted(members, key=worker_index)
+        replicas = T.gang_size(spec)
+        world = job_world(job)
+        if tuple(members) != world.members:
+            status = job["status"] = job.get("status") or {}
+            gen = status.get("resizes", 0) + 1
+            coordinator = member_coordinator(job, members[0])
+            world = WorldSpec(gen=gen, size=len(members),
+                              members=tuple(members),
+                              coordinator=coordinator)
+            status["resizes"] = gen
+            status["activeReplicas"] = len(members)
+            status["world"] = {"gen": gen, "size": len(members),
+                               "members": list(members),
+                               "coordinator": coordinator}
+            full = len(members) == replicas
+            ob.cond_set(job, T.COND_RESIZING,
+                        "False" if full else "True", reason,
+                        f"{message}; elastic {direction} to "
+                        f"{len(members)}/{replicas} (resize #{gen})")
+            # a failure HERE leaves status untouched in the store: the
+            # retry re-enters from the original membership, still one
+            # increment
+            client.update_status(job)
+            gang_resizes().labels(direction=direction).inc()
+            if self.record_events:
+                client.record_event(
+                    job,
+                    "GangShrunk" if direction == "shrink" else "GangGrown",
+                    f"{message}; world is now {len(members)}/{replicas}",
+                    "Warning" if direction == "shrink" else "Normal")
+        # stamp the new world on every remaining pod: survivors catch
+        # the resize through the downward-API projection; waiting
+        # replacements see their membership appear on grow (join
+        # barrier). Best-effort per pod — re-entry re-stamps stragglers.
+        stamp = world.to_json()
+        remove_names = {ob.meta(p)["name"] for p in remove}
+        for p in pods:
+            name = ob.meta(p)["name"]
+            if name in remove_names:
+                continue
+            if ob.annotations_of(p).get(T.ANNOTATION_WORLD) == stamp:
+                continue
+            try:
+                client.patch("v1", "Pod", name,
+                             {"metadata": {"annotations": {
+                                 T.ANNOTATION_WORLD: stamp}}},
+                             m["namespace"])
+            except ob.NotFound:
+                pass
+            except ob.ApiError:
+                log.exception("resize: world stamp of %s failed", name)
+        for p in remove:
+            try:
+                client.delete("v1", "Pod", ob.meta(p)["name"],
+                              m["namespace"])
+            except ob.NotFound:
+                pass
+            except ob.ApiError:
+                log.exception("resize: delete of %s failed",
+                              ob.meta(p)["name"])
+        if (job.get("status") or {}).get("resizes", 0) \
+                >= T.elastic_spec(spec)["maxResizes"]:
+            # the grow budget is spent: a replacement could never be
+            # admitted into the world (the grow re-stamp needs a resize)
+            # and would die by join-barrier timeout — a non-75 crash
+            # that tears down the healthy shrunken world. Run out the
+            # job at the current size instead.
+            recreate = []
+        have = {ob.meta(p)["name"] for p in pods} - remove_names
+        for i in recreate:
+            if worker_name(m["name"], i) in have:
+                continue
+            pod = self.generate_pod(job, i)
+            ob.set_owner(pod, job)
+            try:
+                client.create(pod)
+            except ob.Conflict:
+                pass  # old pod name still releasing; re-entry recreates
+            except ob.ApiError:
+                log.exception("resize: recreate of worker %d failed", i)
+        return Result(requeue_after=0.05)
 
     def _fail(self, client, job, message: str) -> None:
         m = ob.meta(job)
@@ -590,6 +981,12 @@ class JAXJobReconciler(Reconciler):
         job["status"] = job.get("status") or {}
         counter = "preemptions" if preemption else "restarts"
         job["status"][counter] = job["status"].get(counter, 0) + 1
+        if job["status"].pop("world", None) is not None:
+            # the shrunken-world record dies with the incarnation: a
+            # gang restart re-provisions the FULL gang
+            job["status"].pop("activeReplicas", None)
+            ob.cond_set(job, T.COND_RESIZING, "False", reason,
+                        "gang restart re-provisions the full gang")
         ob.cond_set(job, T.COND_RUNNING, "False", reason, "")
         ob.cond_set(job, T.COND_RESTARTING, "True", reason,
                     f"{message}; gang restart ({counter} "

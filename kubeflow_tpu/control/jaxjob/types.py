@@ -30,6 +30,10 @@ COND_RUNNING = "Running"
 COND_RESTARTING = "Restarting"
 COND_SUCCEEDED = "Succeeded"
 COND_FAILED = "Failed"
+# Elastic addition (not part of the katib polling contract, which only
+# reads the five above): True while the gang runs below its full size —
+# set on shrink, cleared when grow-back restores every worker.
+COND_RESIZING = "Resizing"
 
 # Pod labels (the `notebook-name` analogue, notebook_controller.go:541-563)
 LABEL_JOB_NAME = "jaxjob.kubeflow.org/job-name"
@@ -45,11 +49,37 @@ LABEL_SLICE_INDEX = "jaxjob.kubeflow.org/slice-index"
 # without double-counting the restart budget.
 ANNOTATION_EPOCH = "jaxjob.kubeflow.org/epoch"
 
+# Elastic world stamp: the serialized parallel.dist.WorldSpec naming the
+# CURRENT world's ordered members (rank = position, coordinator =
+# members[0]). The controller re-stamps every live pod on a resize; the
+# downward API projects the annotation into the pod (generate_pod mounts
+# it at WORLD_FILE_PATH) so the worker-side elastic coordinator
+# (runtime/elastic.py) sees shrink/grow without a kube client.
+ANNOTATION_WORLD = "jaxjob.kubeflow.org/world"
+WORLD_FILE_PATH = "/etc/jaxjob/world"
+
+# Elastic resize policies (spec.elastic.resizePolicy)
+RESIZE_RESIZE = "Resize"
+RESIZE_RESTART = "Restart"
+# Global-batch policies across a resize (spec.elastic.batchPolicy):
+# Preserve keeps the global batch (the loss curve is continuous);
+# Scale shrinks/grows the global batch with the world. Values are the
+# ENV_BATCH_POLICY wire contract, re-exported below from dist (the ONE
+# spelling the worker-side coordinator compares against).
+# Resizes never burn maxRestarts/maxPreemptions, but a generous ceiling
+# bounds a pathological shrink/grow flap the way maxPreemptions bounds
+# an always-75 loop; beyond it the controller falls back to the normal
+# preemption-restart path (whose own budget then applies).
+DEFAULT_MAX_RESIZES = 100
+
 # Env contract consumed by kubeflow_tpu.parallel.dist.initialize_from_env.
 # Re-exported from dist (ONE authoritative spelling of the wire contract);
 # the import is jax-free — parallel/__init__ is lazy exactly so the
 # control plane can import dist, and test_dist.py pins that property.
 from kubeflow_tpu.parallel.dist import (  # noqa: E402
+    BATCH_PRESERVE,
+    BATCH_SCALE,
+    ENV_BATCH_POLICY,
     ENV_COORD,
     ENV_NAME,
     ENV_NAMESPACE,
@@ -57,6 +87,7 @@ from kubeflow_tpu.parallel.dist import (  # noqa: E402
     ENV_NUM_SLICES,
     ENV_PID,
     ENV_SLICE_ID,
+    ENV_WORLD_FILE,
 )
 
 # GKE TPU scheduling surface (the nvidia.com/gpu swap point —
@@ -86,6 +117,28 @@ def gang_size(spec: dict) -> int:
     return spec.get("replicas", 1) * spec.get("sliceCount", 1)
 
 
+def elastic_spec(spec: dict) -> dict | None:
+    """spec.elastic with defaults applied, or None when absent."""
+    el = spec.get("elastic")
+    if not isinstance(el, dict):
+        return None
+    return {
+        "minReplicas": el.get("minReplicas", 1),
+        "maxReplicas": el.get("maxReplicas", gang_size(spec)),
+        "resizePolicy": el.get("resizePolicy", RESIZE_RESIZE),
+        "batchPolicy": el.get("batchPolicy", BATCH_PRESERVE),
+        "maxResizes": el.get("maxResizes", DEFAULT_MAX_RESIZES),
+    }
+
+
+def is_elastic(spec: dict) -> bool:
+    """True when the controller should resize instead of restart:
+    spec.elastic present with resizePolicy Resize (Restart keeps the
+    restart semantics while still opting into spot-pool scheduling)."""
+    el = elastic_spec(spec)
+    return bool(el and el["resizePolicy"] == RESIZE_RESIZE)
+
+
 def new_jaxjob(
     name: str,
     namespace: str = "default",
@@ -101,6 +154,9 @@ def new_jaxjob(
     max_restarts: int = 3,
     priority: int = 0,
     gang_schedule: bool = False,
+    elastic_min: int | None = None,
+    resize_policy: str = RESIZE_RESIZE,
+    batch_policy: str = BATCH_PRESERVE,
 ) -> dict:
     """Convenience constructor (the create_job_specs.py analogue).
 
@@ -113,7 +169,13 @@ def new_jaxjob(
     (control/scheduler): generated pods get spec.schedulerName plus a
     scheduling gate, and are only run once the whole gang is bound
     all-or-nothing. ``priority`` orders admission; a higher-priority
-    gang may preempt a running lower-priority one."""
+    gang may preempt a running lower-priority one.
+
+    ``elastic_min`` makes the job ELASTIC (docs/elastic.md): on node
+    loss/preemption the gang shrinks to the survivors (down to this
+    floor) instead of restarting, and grows back when capacity returns;
+    with gang_schedule, the scheduler may also admit the gang partially
+    (>= elastic_min) and prefers spot-pool nodes for its workers."""
     spec: dict = {
         "replicas": replicas,
         "template": {
@@ -138,6 +200,12 @@ def new_jaxjob(
         spec["sliceCount"] = slice_count
     if priority:
         spec["priority"] = priority
+    if elastic_min is not None:
+        spec["elastic"] = {
+            "minReplicas": elastic_min,
+            "resizePolicy": resize_policy,
+            "batchPolicy": batch_policy,
+        }
     if gang_schedule:
         spec["schedulerName"] = SCHEDULER_NAME
     if accelerator:
@@ -172,7 +240,55 @@ def validate(job: dict) -> list[str]:
     prio = spec.get("priority", 0)
     if not isinstance(prio, int) or isinstance(prio, bool):
         errs.append(f"spec.priority must be an int, got {prio!r}")
+    errs += _validate_elastic(spec)
     errs += _validate_tpu_topology(spec)
+    return errs
+
+
+def _validate_elastic(spec: dict) -> list[str]:
+    raw = spec.get("elastic")
+    if raw is None:
+        return []
+    if not isinstance(raw, dict):
+        return [f"spec.elastic must be an object, got {raw!r}"]
+    errs = []
+    el = elastic_spec(spec)
+    total = gang_size(spec)
+    mn, mx = el["minReplicas"], el["maxReplicas"]
+
+    def _posint(v) -> bool:
+        return isinstance(v, int) and not isinstance(v, bool) and v >= 1
+
+    if not _posint(mn):
+        errs.append(f"spec.elastic.minReplicas must be a positive int, "
+                    f"got {mn!r}")
+    if not _posint(mx):
+        errs.append(f"spec.elastic.maxReplicas must be a positive int, "
+                    f"got {mx!r}")
+    if _posint(mn) and _posint(mx):
+        if mn > mx:
+            errs.append(f"spec.elastic.minReplicas {mn} > maxReplicas {mx}")
+        if mx != total:
+            # the controller provisions the full gang and shrinks within
+            # it; a maxReplicas above the pod set could never be reached
+            # and one below it would strand provisioned workers
+            errs.append(
+                f"spec.elastic.maxReplicas {mx} must equal replicas x "
+                f"sliceCount = {total} (the provisioned gang)")
+    if el["resizePolicy"] not in (RESIZE_RESIZE, RESIZE_RESTART):
+        errs.append(f"spec.elastic.resizePolicy must be {RESIZE_RESIZE} "
+                    f"or {RESIZE_RESTART}")
+    if el["batchPolicy"] not in (BATCH_PRESERVE, BATCH_SCALE):
+        errs.append(f"spec.elastic.batchPolicy must be {BATCH_PRESERVE} "
+                    f"or {BATCH_SCALE}")
+    if not _posint(el["maxResizes"]):
+        errs.append(f"spec.elastic.maxResizes must be a positive int, "
+                    f"got {el['maxResizes']!r}")
+    if el["resizePolicy"] == RESIZE_RESIZE and spec.get("sliceCount", 1) > 1:
+        # shrinking a multislice gang would change the dcn axis under a
+        # sharded mesh — only pure data-parallel worlds resize freely
+        errs.append("spec.elastic with resizePolicy Resize requires "
+                    "sliceCount 1 (elastic resize is data-parallel only)")
     return errs
 
 

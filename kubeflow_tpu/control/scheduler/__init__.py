@@ -34,6 +34,18 @@ SCHEDULER_NAME = "kubeflow-tpu-scheduler"
 GATE_GANG = "scheduler.kubeflow.org/gang"
 ANNOTATION_GANG_SIZE = "scheduler.kubeflow.org/gang-size"
 ANNOTATION_PRIORITY = "scheduler.kubeflow.org/priority"
+# Elastic floor: present on a gang's pods => the gang may be admitted
+# PARTIALLY, down to this many workers (rigid gangs — no annotation —
+# keep the all-or-nothing law). Stamped by the JAXJob controller from
+# spec.elastic.minReplicas.
+ANNOTATION_ELASTIC_MIN = "scheduler.kubeflow.org/elastic-min"
+# Spot/preemptible pool surface (the GKE spot label): spot nodes carry
+# this label plus a matching NoSchedule taint, so only workloads that
+# explicitly tolerate reclaim — elastic gangs — may land there. The
+# scheduler PREFERS spot nodes for elastic workers (keeping on-demand
+# capacity for rigid gangs) but falls back to on-demand when the spot
+# pool is full: preferred, never required.
+LABEL_SPOT = "cloud.google.com/gke-spot"
 
 
 def __getattr__(name):

@@ -15,6 +15,7 @@ import dataclasses
 
 from kubeflow_tpu.control.jaxjob import types as JT
 from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.scheduler import LABEL_SPOT
 from kubeflow_tpu.control.scheduler.topology import parse_topology
 
 # Pod phases that no longer hold their node's chips.
@@ -34,6 +35,13 @@ def eviction_status(message: str) -> dict:
 CHIPS_PER_HOST = 4
 
 
+def spot_taint() -> dict:
+    """The spot-pool taint (ONE spelling, mirrored by the toleration the
+    JAXJob controller adds to elastic workers): only reclaim-tolerant
+    workloads may land on preemptible capacity."""
+    return {"key": LABEL_SPOT, "value": "true", "effect": "NoSchedule"}
+
+
 @dataclasses.dataclass(frozen=True)
 class NodeView:
     """The scheduler's read of one Node."""
@@ -43,6 +51,9 @@ class NodeView:
     allocatable_chips: int
     ready: bool
     taints: tuple
+    # spot/preemptible pool membership (LABEL_SPOT): lowest-priority
+    # capacity — preferred for elastic gangs, reclaimed without notice
+    spot: bool = False
 
 
 def new_tpu_node(
@@ -53,11 +64,16 @@ def new_tpu_node(
     ready: bool = True,
     taints: tuple = (),
     labels: dict | None = None,
+    spot: bool = False,
 ) -> dict:
     """A Node carrying TPU pool labels (the gke node-pool analogue).
 
     ``chips_per_node`` defaults to the per-host share of the slice
-    (min(slice chips, 4) — GKE's hightpu-4t machine shapes)."""
+    (min(slice chips, 4) — GKE's hightpu-4t machine shapes).
+
+    ``spot=True`` puts the node in a spot/preemptible pool: the
+    LABEL_SPOT label plus the matching NoSchedule taint, so only
+    reclaim-tolerant (elastic) workers can land on it."""
     topo = parse_topology(topology)
     chips = chips_per_node if chips_per_node is not None \
         else min(topo.chips, CHIPS_PER_HOST)
@@ -66,11 +82,13 @@ def new_tpu_node(
         labels={
             JT.NODESELECTOR_ACCEL: accelerator,
             JT.NODESELECTOR_TOPOLOGY: str(topo),
+            **({LABEL_SPOT: "true"} if spot else {}),
             **(labels or {}),
         },
     )
-    if taints:
-        node["spec"] = {"taints": [dict(t) for t in taints]}
+    all_taints = tuple(taints) + ((spot_taint(),) if spot else ())
+    if all_taints:
+        node["spec"] = {"taints": [dict(t) for t in all_taints]}
     node["status"] = {
         "allocatable": {JT.RESOURCE_TPU: chips},
         "conditions": [
@@ -86,12 +104,14 @@ def node_view(node: dict) -> NodeView:
     ready = any(c.get("type") == "Ready" and c.get("status") == "True"
                 for c in conds)
     taints = tuple((node.get("spec") or {}).get("taints") or [])
+    labels = dict(ob.labels_of(node))
     return NodeView(
         name=ob.meta(node)["name"],
-        labels=dict(ob.labels_of(node)),
+        labels=labels,
         allocatable_chips=int(alloc),
         ready=ready,
         taints=taints,
+        spot=labels.get(LABEL_SPOT) == "true",
     )
 
 

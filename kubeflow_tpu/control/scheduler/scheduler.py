@@ -33,13 +33,16 @@ import logging
 import threading
 
 from kubeflow_tpu.control.jaxjob import types as JT
-from kubeflow_tpu.control.jaxjob.controller import schedule_latency
+from kubeflow_tpu.control.jaxjob.controller import (
+    schedule_latency, worker_index,
+)
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.runtime import (
     Controller, Reconciler, Request, Result,
 )
 from kubeflow_tpu.control.scheduler import (
-    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+    ANNOTATION_ELASTIC_MIN, ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY,
+    GATE_GANG, SCHEDULER_NAME,
 )
 from kubeflow_tpu.control.scheduler import nodes as N
 from kubeflow_tpu.control.scheduler.queue import GangQueue
@@ -59,8 +62,13 @@ _RETRY_AFTER_PREEMPT = 0.05
 # _WAIT: blocked for a non-capacity reason (gang mid-creation, transient
 # bind failure) — never a preemption trigger. _UNPLACEABLE: a genuine
 # failed capacity assignment — the only outcome that may evict others.
-_ADMITTED, _GONE, _WAIT, _UNPLACEABLE = \
-    "admitted", "gone", "wait", "unplaceable"
+# _PARTIAL: an ELASTIC gang bound a subset >= its floor; the remainder
+# re-queues at the back of the FIFO (grow-back). _GROW_WAIT: an elastic
+# gang already running at/above its floor found no room to grow — backs
+# off WITHOUT head-blocking its namespace and never preempts (growth is
+# a preference; only sub-floor admission is a need).
+_ADMITTED, _GONE, _WAIT, _UNPLACEABLE, _PARTIAL, _GROW_WAIT = \
+    "admitted", "gone", "wait", "unplaceable", "partial", "grow-wait"
 
 # Sentinel reconcile key: "retry everything queued". Node events and
 # bound-pod phase changes enqueue this ONE key instead of one key per
@@ -181,6 +189,31 @@ class GangScheduler(Reconciler):
                 if outcome in (_ADMITTED, _GONE):
                     self.queue.remove(entry.namespace, entry.name)
                     continue
+                if outcome == _PARTIAL:
+                    # the elastic gang got capacity down to its floor;
+                    # its remainder moves to the BACK of the FIFO (fresh
+                    # seq) with backoff, so a gang waiting to grow back
+                    # can never starve the siblings queued behind it
+                    prio = entry.priority
+                    self.queue.remove(entry.namespace, entry.name)
+                    self.queue.offer(entry.namespace, entry.name,
+                                     priority=prio)
+                    delays.append(
+                        self.queue.requeue(entry.namespace, entry.name))
+                    continue
+                if outcome == _GROW_WAIT:
+                    # running at/above its floor, nothing to grow into:
+                    # back off but DO NOT head-block the namespace — a
+                    # viable running gang is not starved, and holding
+                    # the queue for its preference would starve others
+                    delays.append(
+                        self.queue.requeue(entry.namespace, entry.name))
+                    self.registry.counter_inc(
+                        "scheduler_requeues_total",
+                        help_="gang admission attempts that failed and "
+                              "backed off",
+                        namespace=entry.namespace)
+                    continue
                 # blocked: the namespace head holds its queue; on a
                 # genuine capacity failure (never on a gang still being
                 # created or a transient bind error) try to make room,
@@ -300,11 +333,32 @@ class GangScheduler(Reconciler):
                          key=lambda p: ob.meta(p)["name"])
         if not pending:
             return _GONE  # bound elsewhere or deleted
-        size = _gang_annotation(pods, ANNOTATION_GANG_SIZE) or len(pending)
-        if len(pending) < size:
+        bound = [p for p in pods
+                 if (p.get("spec") or {}).get("nodeName")
+                 and (p.get("status") or {}).get("phase")
+                 not in N.TERMINAL_PHASES]
+        size = _gang_annotation(pods, ANNOTATION_GANG_SIZE) \
+            or (len(pending) + len(bound))
+        if len(pending) + len(bound) < size:
             return _WAIT  # gang mid-creation: wait for the full set
+        emin = _gang_annotation(pods, ANNOTATION_ELASTIC_MIN)
+        elastic = emin is not None and emin >= 1
+        if len(pending) < size and not elastic:
+            # rigid gangs: bound residue (half-started bind) is the
+            # JAXJob controller's to resolve — unchanged semantics
+            return _WAIT
         free, views = self._free_chips(client)
-        assignment = self._assign(pending, views, free)
+        assignment = self._assign(pending, views, free,
+                                  prefer_spot=elastic)
+        if assignment is None and elastic:
+            # partial admission: any subset keeping the world at or
+            # above the elastic floor beats idling — the scheduler's
+            # half of shrink-to-survivors. Rigid gangs never get here:
+            # all-or-nothing stays the law.
+            floor = max(emin - len(bound), 1)
+            assignment = self._assign_partial(pending, views, free, floor)
+            if assignment is None and len(bound) >= emin:
+                return _GROW_WAIT
         if assignment is None:
             if self.record_events and hasattr(client, "record_event"):
                 # dedup (obs/events.py) collapses the retry storm: one
@@ -312,11 +366,28 @@ class GangScheduler(Reconciler):
                 client.record_event(
                     pending[0], "GangUnschedulable",
                     f"gang {entry.namespace}/{entry.name}: no node set "
-                    f"fits all {len(pending)} workers", "Warning",
+                    f"fits all {len(pending)} workers"
+                    + (f" (nor >= the elastic floor of {emin})"
+                       if elastic else ""), "Warning",
                     component=SCHEDULER_NAME)
             return _UNPLACEABLE
         if not self._bind(client, entry, assignment):
             return _WAIT
+        if any(views[n].spot for n in assignment.values()):
+            self.registry.counter_inc(
+                "scheduler_spot_admissions_total",
+                help_="gang admissions that placed workers on "
+                      "spot-pool nodes",
+                namespace=entry.namespace)
+        if len(assignment) < len(pending):
+            if self.record_events and hasattr(client, "record_event"):
+                client.record_event(
+                    pending[0], "GangPartiallyAdmitted",
+                    f"gang {entry.namespace}/{entry.name}: bound "
+                    f"{len(assignment) + len(bound)}/{size} workers "
+                    f"(elastic floor {emin}); remainder queued for "
+                    f"grow-back", component=SCHEDULER_NAME)
+            return _PARTIAL
         return _ADMITTED
 
     def _free_chips(self, client) -> tuple[dict[str, int], dict]:
@@ -336,17 +407,28 @@ class GangScheduler(Reconciler):
         return free, views
 
     @staticmethod
-    def _assign(pods: list[dict], views: dict, free: dict[str, int]):
+    def _assign(pods: list[dict], views: dict, free: dict[str, int],
+                prefer_spot: bool = False):
         """All-or-nothing placement: best-fit every worker or None.
-        Does not mutate ``free`` (callers simulate with copies)."""
+        Does not mutate ``free`` (callers simulate with copies).
+
+        ``prefer_spot`` (elastic gangs): when any feasible spot node has
+        room, best-fit among spot nodes only — spot capacity is
+        reclaim-tolerant work's to burn, keeping on-demand pools free
+        for rigid gangs. Preferred, not required: with the spot pool
+        full, placement falls back to any feasible node."""
         remaining = dict(free)
         out: dict[str, str] = {}
         for pod in pods:
             need = N.pod_tpu_request(pod)
+            candidates = [name for name in sorted(views)
+                          if remaining[name] >= need
+                          and N.feasible(pod, views[name])]
+            if prefer_spot:
+                spot = [n for n in candidates if views[n].spot]
+                candidates = spot or candidates
             best = None
-            for name in sorted(views):
-                if remaining[name] < need or not N.feasible(pod, views[name]):
-                    continue
+            for name in candidates:
                 if best is None or remaining[name] < remaining[best]:
                     best = name
             if best is None:
@@ -354,6 +436,41 @@ class GangScheduler(Reconciler):
             remaining[best] -= need
             out[ob.meta(pod)["name"]] = best
         return out
+
+    @staticmethod
+    def _replica_order(pod: dict):
+        """Numeric replica-index key (worker-10 must sort AFTER
+        worker-2, which plain name order gets wrong for gangs >= 10):
+        the partial-admission prefix keeps the lowest indices, so
+        worker 0 — the coordinator pick — survives when anything does.
+        ``worker_index`` is the ONE index parse, shared with the JAXJob
+        controller's world-membership ordering — the admitted prefix
+        and the world stamp must agree on what "lowest" means."""
+        name = ob.meta(pod)["name"]
+        return (worker_index(name), name)
+
+    def _assign_partial(self, pods: list[dict], views: dict,
+                        free: dict[str, int], floor: int):
+        """Largest placeable prefix of at least ``floor`` workers, or
+        None. Gang workers are homogeneous (same selector/chips), so a
+        deterministic index-ordered prefix loses no generality. Prefix
+        placeability is monotone in k (dropping a worker from a valid
+        assignment stays valid), so binary search: O(log n) full
+        best-fit passes instead of O(n) on the scheduler's hot path."""
+        if floor > len(pods):
+            return None
+        pods = sorted(pods, key=self._replica_order)
+        best = None
+        lo, hi = floor, len(pods) - 1
+        while lo <= hi:
+            mid = (lo + hi) // 2
+            a = self._assign(pods[:mid], views, free, prefer_spot=True)
+            if a is not None:
+                best = a
+                lo = mid + 1
+            else:
+                hi = mid - 1
+        return best
 
     def _bind(self, client, entry, assignment: dict[str, str]) -> bool:
         """Bind the whole gang in two phases: set every spec.nodeName

@@ -23,9 +23,11 @@ injects TPU_WORKER_HOSTNAMES etc.) and under bare `jax.distributed`.
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import os
 import socket
+import threading
 import time
 
 log = logging.getLogger("kubeflow_tpu.dist")
@@ -35,6 +37,18 @@ ENV_NPROC = "JAXJOB_NUM_PROCESSES"
 ENV_PID = "JAXJOB_PROCESS_ID"
 ENV_NAME = "JAXJOB_NAME"
 ENV_NAMESPACE = "JAXJOB_NAMESPACE"
+# Elastic resize contract (runtime/elastic.py): the JAXJob controller
+# projects its world annotation into the pod via the downward API and
+# points this env var at the projected file; the worker-side elastic
+# coordinator re-reads it to learn resizes. ENV_BATCH_POLICY carries
+# spec.elastic.batchPolicy (Preserve|Scale) to the worker.
+ENV_WORLD_FILE = "JAXJOB_WORLD_FILE"
+ENV_BATCH_POLICY = "JAXJOB_BATCH_POLICY"
+# The values ENV_BATCH_POLICY carries (ONE spelling of the wire value;
+# jaxjob types and runtime/elastic re-export): Preserve keeps the
+# global batch across a resize, Scale scales it with the world.
+BATCH_PRESERVE = "Preserve"
+BATCH_SCALE = "Scale"
 # Multislice (one jax.distributed world spanning several ICI slices wired
 # by DCN). The JAXJob controller injects these alongside the libtpu
 # MEGASCALE_* vars; the mesh's `dcn` axis maps onto the slice boundary.
@@ -119,6 +133,60 @@ def slice_env(num_slices: int, slice_id: int,
     return env
 
 
+@dataclasses.dataclass(frozen=True)
+class WorldSpec:
+    """One elastic-world incarnation — the value of the JAXJob
+    controller's world annotation (jaxjob/types.py ANNOTATION_WORLD),
+    projected into each pod via the downward API.
+
+    ``members`` is the ordered worker-pod-name list of the CURRENT
+    world: a member's rank is its position, and the coordinator is
+    members[0]'s stable DNS address. ``gen`` increments with every
+    resize, so a worker distinguishes 4→2→4 from never having resized.
+    This is the ONE spelling of the resize wire contract — the
+    controller writes it, runtime/elastic.py reads it."""
+
+    gen: int
+    size: int
+    members: tuple[str, ...]
+    coordinator: str | None = None
+
+    def rank_of(self, name: str) -> int | None:
+        """This worker's rank in the current world; None = not a member
+        (a replacement pod waiting out the join barrier)."""
+        try:
+            return self.members.index(name)
+        except ValueError:
+            return None
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "gen": self.gen, "size": self.size,
+            "members": list(self.members),
+            **({"coordinator": self.coordinator} if self.coordinator
+               else {}),
+        }, sort_keys=True)
+
+    @classmethod
+    def from_json(cls, text: str | None) -> "WorldSpec | None":
+        """None on missing/malformed input — the downward-API file can
+        be mid-write or absent before the kubelet first syncs it, and a
+        worker must keep its current world rather than crash."""
+        if not text:
+            return None
+        try:
+            d = json.loads(text)
+            members = tuple(str(m) for m in d["members"])
+            spec = cls(gen=int(d["gen"]), size=int(d["size"]),
+                       members=members,
+                       coordinator=d.get("coordinator") or None)
+        except (ValueError, TypeError, KeyError):
+            return None
+        if spec.size != len(members) or spec.gen < 0:
+            return None
+        return spec
+
+
 def wait_for_coordinator(address: str, timeout_s: float = 300.0) -> None:
     """Readiness gate: block until the coordinator's port accepts TCP.
 
@@ -140,6 +208,78 @@ def wait_for_coordinator(address: str, timeout_s: float = 300.0) -> None:
             delay = min(delay * 2, 5.0)
 
 
+# -- world lifecycle (elastic re-formation) ---------------------------------
+#
+# Module state: the world this process currently belongs to. Elastic
+# resize re-enters initialize_from_env with a CHANGED world (new size /
+# rank / coordinator after a shrink or grow); before this state existed
+# a second call silently kept the stale jax.distributed config while
+# returning a fresh-looking DistConfig. Now a re-entry either no-ops
+# (same world — idempotent) or tears the prior state down first.
+_WORLD_LOCK = threading.RLock()
+_ACTIVE: DistConfig | None = None
+_DIST_LIVE = False  # jax.distributed.initialize was called by this module
+
+
+class WorldTeardownError(RuntimeError):
+    """Prior distributed state could not be torn down for re-formation.
+
+    The elastic coordinator (runtime/elastic.py) handles this by exiting
+    EX_TEMPFAIL instead of resizing in place: the gang restart rebuilds
+    the world from scratch, which is always safe."""
+
+
+def _world_key(cfg: DistConfig) -> tuple:
+    """The fields that define a distributed world's identity; metadata
+    (job name/namespace) may change without re-forming anything."""
+    return (cfg.coordinator_address, cfg.num_processes, cfg.process_id,
+            cfg.num_slices, cfg.slice_id)
+
+
+def active_world() -> DistConfig | None:
+    """The world this process last initialized (None before the first
+    initialize_from_env)."""
+    with _WORLD_LOCK:
+        return _ACTIVE
+
+
+def _jax_initialize(cfg: DistConfig) -> None:
+    import jax  # deferred: must happen before any backend init
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+    )
+
+
+def _jax_shutdown() -> None:
+    import jax
+
+    jax.distributed.shutdown()
+
+
+def _teardown_locked() -> None:
+    global _ACTIVE, _DIST_LIVE
+    if _DIST_LIVE:
+        try:
+            _jax_shutdown()
+        except Exception as e:
+            raise WorldTeardownError(
+                f"could not shut down the previous jax.distributed world "
+                f"({_ACTIVE}): {type(e).__name__}: {e}") from e
+        _DIST_LIVE = False
+    _ACTIVE = None
+
+
+def shutdown() -> None:
+    """Tear down this process's distributed state (no-op when none).
+    The elastic coordinator calls this between worlds; raising
+    WorldTeardownError means in-place re-formation is off the table."""
+    with _WORLD_LOCK:
+        _teardown_locked()
+
+
 def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True) -> DistConfig:
     """Join the jax.distributed cluster described by JAXJOB_* env vars.
 
@@ -147,31 +287,44 @@ def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True)
     multi-host slice without code changes (num_processes==1 ⇒ no
     coordinator needed, exactly like running the reference's tf-cnn with
     an empty TF_CONFIG, launcher.py:64-66).
+
+    Re-entrant: calling again with the SAME world (coordinator, size,
+    rank, slices) is an idempotent no-op; a CHANGED world first tears
+    down the prior distributed state (raising WorldTeardownError if that
+    fails) and then forms the new one — the elastic resize path.
     """
     cfg = DistConfig.from_env(env)
-    if cfg.multislice:
-        # libtpu reads MEGASCALE_* at backend init; when only the JAXJOB_*
-        # contract is present (bare launch, tests) derive them here so the
-        # DCN transport still configures itself before jax imports
-        for k, v in cfg.to_env().items():
-            if k.startswith("MEGASCALE_"):
-                os.environ.setdefault(k, v)
-    if cfg.distributed:
-        import jax  # deferred: must happen before any backend init
-
-        if cfg.coordinator_address is None:
-            raise ValueError(f"{ENV_NPROC}>1 but {ENV_COORD} unset")
-        if wait and cfg.process_id != 0:
-            wait_for_coordinator(cfg.coordinator_address)
-        log.info(
-            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
-            cfg.coordinator_address, cfg.num_processes, cfg.process_id,
-        )
-        jax.distributed.initialize(
-            coordinator_address=cfg.coordinator_address,
-            num_processes=cfg.num_processes,
-            process_id=cfg.process_id,
-        )
+    if cfg.distributed and cfg.coordinator_address is None:
+        # validate before touching world state: a bad env must not tear
+        # down a healthy world
+        raise ValueError(f"{ENV_NPROC}>1 but {ENV_COORD} unset")
+    with _WORLD_LOCK:
+        global _ACTIVE, _DIST_LIVE
+        if _ACTIVE is not None:
+            if _world_key(cfg) == _world_key(_ACTIVE):
+                _ACTIVE = cfg  # refresh metadata (job name etc.)
+                return cfg
+            log.info("world changed (%s -> %s): tearing down prior state",
+                     _world_key(_ACTIVE), _world_key(cfg))
+            _teardown_locked()
+        if cfg.multislice:
+            # libtpu reads MEGASCALE_* at backend init; when only the
+            # JAXJOB_* contract is present (bare launch, tests) derive
+            # them here so the DCN transport still configures itself
+            # before jax imports
+            for k, v in cfg.to_env().items():
+                if k.startswith("MEGASCALE_"):
+                    os.environ.setdefault(k, v)
+        if cfg.distributed:
+            if wait and cfg.process_id != 0:
+                wait_for_coordinator(cfg.coordinator_address)
+            log.info(
+                "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+                cfg.coordinator_address, cfg.num_processes, cfg.process_id,
+            )
+            _jax_initialize(cfg)
+            _DIST_LIVE = True
+        _ACTIVE = cfg
     return cfg
 
 

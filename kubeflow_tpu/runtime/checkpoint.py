@@ -109,11 +109,20 @@ class Checkpointer:
         ckpt.close()                                  # wait + release
     """
 
-    def __init__(self, directory: str, keep: int = 3, async_save: bool = True):
+    def __init__(self, directory: str, keep: int = 3, async_save: bool = True,
+                 world_size: int | None = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = _normalize_dir(directory)
+        # elastic bookkeeping: the world size each step was SAVED at,
+        # recorded into the manifest so dashboards/preflight can answer
+        # "this resume reshards 8 -> 2" without opening orbax metadata.
+        # Restore itself is world-agnostic (global shapes are
+        # layout-independent; restore() reshards onto the template's
+        # mesh) — this is provenance, not a restore precondition.
+        self.world_size = world_size
+        self._world_sizes: dict[int, int] = {}
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -153,6 +162,8 @@ class Checkpointer:
             force=force,
         )
         if saved:
+            if self.world_size:
+                self._world_sizes[int(step)] = self.world_size
             log.info("checkpoint: queued save at step %d -> %s", step, self.directory)
         return bool(saved)
 
@@ -221,12 +232,31 @@ class Checkpointer:
             return
         import json
 
+        path = os.path.join(self.directory, "manifest.json")
         try:
             steps = self.all_steps()
+            # elastic provenance: merge world sizes recorded by PRIOR
+            # incarnations (a resized worker reopens the same dir) with
+            # this process's saves, pruned to steps still on disk
+            sizes: dict[str, int] = {}
+            try:
+                with open(path) as f:
+                    prior = json.load(f).get("world_sizes") or {}
+                sizes = {k: v for k, v in prior.items()
+                         if k.isdigit() and int(k) in steps}
+            except (OSError, ValueError, AttributeError, TypeError):
+                # a hand-edited/foreign manifest of the wrong SHAPE
+                # (valid json, not our schema) degrades like corruption
+                pass
+            # getattr: harnesses stub Checkpointer past __init__
+            mine = getattr(self, "_world_sizes", {})
+            sizes.update({str(s): w for s, w in mine.items()
+                          if s in steps})
             atomic_write_text(
-                os.path.join(self.directory, "manifest.json"),
+                path,
                 json.dumps({"latest_step": steps[-1] if steps else None,
-                            "steps": steps}, sort_keys=True) + "\n")
+                            "steps": steps,
+                            "world_sizes": sizes}, sort_keys=True) + "\n")
         except OSError as e:
             log.warning("checkpoint: manifest write failed: %s", e)
 

@@ -1,0 +1,357 @@
+"""Worker-side elastic coordinator: shrink/grow the training world in
+place instead of dying with the gang.
+
+The control-plane half lives in the JAXJob controller (docs/elastic.md):
+on node loss/preemption it condemns only the lost pods, re-stamps the
+surviving pods' world annotation (jaxjob/types.py ANNOTATION_WORLD, a
+serialized ``parallel.dist.WorldSpec``), and the downward API projects
+that annotation into each pod at $JAXJOB_WORLD_FILE. This module is the
+in-pod half:
+
+- poll the world source once per step (piggybacked on the trainer's
+  ``stop`` flag, exactly like the preemption notice);
+- on a CHANGED world: the trainer's stop path checkpoints the current
+  step, then the coordinator tears down the old ``jax.distributed``
+  state (``dist.shutdown()`` — the re-entrancy contract), re-forms at
+  the new size/rank/coordinator, rebuilds mesh + shardings (a fresh
+  Trainer — ``parallel/shardings.py`` re-infers placement for the new
+  mesh) and resumes from the checkpoint: save-at-N/restore-at-M
+  resharding is ``runtime/checkpoint.py``'s restore-onto-template path;
+- the global batch is PRESERVED across the resize by default (survivors
+  absorb the lost shards via gradient accumulation, so the loss curve
+  is continuous) or SCALED with the world per spec.elastic.batchPolicy;
+- a replacement pod whose name is absent from the current world stamp
+  waits in the JOIN BARRIER until a grow resize admits it;
+- a real preemption notice (runtime/preemption.py) always wins: a
+  SIGTERM'd pod is being terminated, so it exits EX_TEMPFAIL (the
+  controller restarts the gang) instead of burning its remaining grace
+  — surfaced via ``PreemptionNotice.remaining_grace()`` — on a doomed
+  in-place re-formation.
+
+Import-light: jax/trainer imports are deferred to run() so the control
+plane and tests can import the contract pieces freely.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import time
+from typing import Callable
+
+from kubeflow_tpu.parallel import dist as D
+
+log = logging.getLogger("kubeflow_tpu.elastic")
+
+# Batch policies — re-exported from the wire contract (parallel/dist.py,
+# the ONE spelling; jaxjob's spec.elastic.batchPolicy re-exports the
+# same values). The controller ships the value via $JAXJOB_BATCH_POLICY
+# so the worker needs no kube client.
+BATCH_PRESERVE = D.BATCH_PRESERVE
+BATCH_SCALE = D.BATCH_SCALE
+
+
+def file_world_source(path: str) -> Callable[[], D.WorldSpec | None]:
+    """World source over the downward-API projection: the kubelet keeps
+    the file in sync with the pod's world annotation. Missing/partial
+    files read as None (keep the current world) — the projection is
+    atomically symlink-swapped but may not exist before the first
+    sync."""
+
+    def read() -> D.WorldSpec | None:
+        try:
+            with open(path) as f:
+                return D.WorldSpec.from_json(f.read())
+        except OSError:
+            return None
+
+    return read
+
+
+@dataclasses.dataclass
+class ResizeExit:
+    """Why run() returned (summary["elastic"] mirrors this)."""
+
+    kind: str        # "completed" | "preempted"
+    resizes: int
+    worlds: list[int]
+
+
+class ElasticCoordinator:
+    """Drives Trainer.fit across world incarnations.
+
+    Injectable seams (hermetic CPU tests; production uses defaults):
+
+    - ``source``: () -> WorldSpec | None — current world (file source in
+      pods, a FakeCluster-annotation reader in tests).
+    - ``form_world``: WorldSpec -> None — joins/re-forms the
+      jax.distributed world (default: dist.initialize_from_env on the
+      world's env; single-process worlds no-op there).
+    - ``mesh_fn``: (TrainConfig, world_size) -> Mesh | None — the mesh
+      for a world (default None: the Trainer builds from cfg over all
+      visible devices, correct on real multi-host deployments where
+      jax.devices() IS the world).
+    """
+
+    def __init__(
+        self,
+        source: Callable[[], "D.WorldSpec | None"],
+        *,
+        my_name: str | None = None,
+        notice=None,
+        batch_policy: str = BATCH_PRESERVE,
+        form_world: "Callable[[D.WorldSpec], None] | None" = None,
+        mesh_fn=None,
+        join_timeout_s: float = 600.0,
+        join_poll_s: float = 1.0,
+        sleep=time.sleep,
+        clock=time.monotonic,
+    ):
+        self.source = source
+        self.my_name = my_name
+        self.notice = notice
+        self.batch_policy = batch_policy
+        self.form_world = form_world if form_world is not None \
+            else self._default_form_world
+        self.mesh_fn = mesh_fn
+        self.join_timeout_s = join_timeout_s
+        self.join_poll_s = join_poll_s
+        self._sleep = sleep
+        self._clock = clock
+
+    # -- world plumbing ------------------------------------------------------
+
+    def world_env(self, world: D.WorldSpec,
+                  base_env: dict | None = None) -> dict:
+        """The JAXJOB_* env describing this worker's place in ``world``
+        (rank = membership position, coordinator = members[0])."""
+        env = dict(os.environ if base_env is None else base_env)
+        env[D.ENV_NPROC] = str(world.size)
+        rank = world.rank_of(self.my_name) if self.my_name else None
+        env[D.ENV_PID] = str(rank if rank is not None else 0)
+        if world.coordinator:
+            env[D.ENV_COORD] = world.coordinator
+        return env
+
+    def _default_form_world(self, world: D.WorldSpec) -> None:
+        D.initialize_from_env(self.world_env(world))
+
+    def _member_world(self) -> "D.WorldSpec | None":
+        """Current world IF this worker is a member (or membership is
+        untracked because my_name is unset)."""
+        w = self.source()
+        if w is None:
+            return None
+        if self.my_name is not None and w.rank_of(self.my_name) is None:
+            return None
+        return w
+
+    def wait_for_membership(self) -> D.WorldSpec:
+        """The JOIN BARRIER: a replacement pod starts before the
+        controller's grow resize names it a member; block until the
+        world stamp includes us (the grow re-stamp) rather than join a
+        world that did not plan for this rank."""
+        deadline = self._clock() + self.join_timeout_s
+        while True:
+            w = self._member_world()
+            if w is not None:
+                return w
+            if self._clock() > deadline:
+                raise TimeoutError(
+                    f"{self.my_name}: not admitted into the elastic world "
+                    f"within {self.join_timeout_s}s")
+            self._sleep(self.join_poll_s)
+
+    def _stop_flag(self, world: D.WorldSpec) -> Callable[[], bool]:
+        """Polled once per step by Trainer.fit: true on a real
+        preemption notice OR a world stamp differing from the one this
+        incarnation trained under — either way the trainer checkpoints
+        the in-flight step and returns."""
+
+        def stop() -> bool:
+            if self.notice is not None and self.notice():
+                return True
+            cur = self.source()
+            return cur is not None and \
+                (cur.gen, cur.members) != (world.gen, world.members)
+
+        return stop
+
+    # -- run -----------------------------------------------------------------
+
+    def run(self, cfg, *, full_world: int | None = None,
+            callback=None, trainer_factory=None):
+        """Train ``cfg`` to completion across resizes; returns
+        (state, summary) like Trainer.fit, with summary["elastic"]
+        describing the incarnations. cfg.checkpoint_dir must be set —
+        the checkpoint IS the resize transport."""
+        from kubeflow_tpu.runtime.trainer import Trainer
+
+        if not cfg.checkpoint_dir:
+            raise ValueError("elastic training requires checkpoint_dir "
+                             "(the resize resumes from the checkpoint)")
+        if not cfg.resume:
+            # resume=False would make every resize silently retrain
+            # from step 0 — the opposite of the continuity contract
+            raise ValueError("elastic training requires resume=True "
+                             "(a resized incarnation restores the "
+                             "checkpointed step)")
+        make_trainer = trainer_factory or (
+            lambda c, world: Trainer(
+                c, mesh=self.mesh_fn(c, world) if self.mesh_fn else None))
+        # ALWAYS through the join barrier: a None source read at start
+        # means the downward-API file has not synced yet (the launcher
+        # only builds a coordinator when the controller wired the world
+        # file), never "train solo" — a fabricated size-1 world would
+        # have every not-yet-synced pod training as an independent
+        # rank 0 against the shared checkpoint directory.
+        world = self.wait_for_membership()
+        if full_world is None:
+            full_world = world.size
+        worlds: list[int] = [world.size]
+        resizes = 0
+        state = summary = None
+        while True:
+            try:
+                self.form_world(world)
+            except Exception as e:
+                # formation at a STALE world. The canonical case is
+                # partial admission: pods carry the full-gang stamp at
+                # creation, and the controller's shrink-to-admitted
+                # re-stamp lands while initialize blocks waiting for
+                # peers that were never admitted. If the stamp moved
+                # while we were blocked, retry at the CURRENT world —
+                # crashing here would read as a non-75 exit and burn
+                # the restart budget. A failure with an unchanged stamp
+                # is a genuine bootstrap error and propagates.
+                cur = self._member_world()
+                if cur is None or (cur.gen, cur.members) == \
+                        (world.gen, world.members):
+                    raise
+                log.warning(
+                    "world formation at size %d failed (%s: %s); the "
+                    "world moved to gen %d size %d — retrying there",
+                    world.size, type(e).__name__, e, cur.gen, cur.size)
+                D.shutdown()  # no-op after a failed init; typed on real failure
+                world = cur
+                worlds.append(world.size)
+                resizes += 1
+                continue
+            try:
+                # scale_config inside the try: the Scale policy's
+                # divisibility error on a resized world needs the same
+                # exit-for-restart treatment as an unbuildable trainer
+                wcfg = scale_config(cfg, full_world, world.size,
+                                    self.batch_policy)
+                trainer = make_trainer(wcfg, world.size)
+            except ValueError:
+                if world.size == full_world:
+                    raise  # a bad config at FULL size fails loudly
+                # the RESIZED world is incompatible with the config
+                # (e.g. global_batch not divisible by the survivor
+                # count): crashing here would burn the restart budget
+                # through a crash loop — exit EX_TEMPFAIL instead, so
+                # the controller gang-restarts at the full size and the
+                # checkpoint survives. docs/elastic.md: pick a
+                # global_batch divisible by every world size you allow.
+                log.exception(
+                    "world of %d is incompatible with the config; "
+                    "exiting for a gang restart instead of crash-looping",
+                    world.size)
+                exit_ = ResizeExit("preempted", resizes, worlds)
+                break
+            state, summary = trainer.fit(stop=self._stop_flag(world),
+                                         callback=callback)
+            if not summary.get("preempted"):
+                exit_ = ResizeExit("completed", resizes, worlds)
+                break
+            # fit stopped early: a resize signal, a real preemption
+            # notice, or both. The checkpoint at the interrupted step is
+            # already durable (fit's stop path saved it).
+            new = self._member_world()
+            resized = new is not None and \
+                (new.gen, new.members) != (world.gen, world.members)
+            if self.notice is not None and self.notice():
+                # SIGTERM means THIS pod is being terminated: always
+                # exit EX_TEMPFAIL for the gang restart. Re-forming in
+                # place would burn the remaining grace on a tear-down/
+                # re-init/restore cycle whose stop flag is already set
+                # (the notice is sticky) — pure wasted SIGKILL risk.
+                grace = self.notice.remaining_grace()
+                log.warning(
+                    "preemption notice (%s grace left%s): exiting for "
+                    "a gang restart",
+                    f"{grace:.1f}s" if grace is not None else "unknown",
+                    "; resize pending" if resized else "")
+                exit_ = ResizeExit("preempted", resizes, worlds)
+                break
+            if not resized:
+                # stop fired with neither a notice nor a stamp change
+                # (a source flicker): exiting for a restart is the safe
+                # answer — the checkpoint at this step is durable
+                exit_ = ResizeExit("preempted", resizes, worlds)
+                break
+            # in-place re-formation: tear down the old world first (the
+            # dist re-entrancy contract). If teardown fails, in-place
+            # resize is off the table — fall back to exit-and-restart.
+            try:
+                D.shutdown()
+            except D.WorldTeardownError:
+                log.exception("world teardown failed; exiting for a "
+                              "gang restart instead")
+                exit_ = ResizeExit("preempted", resizes, worlds)
+                break
+            log.info("elastic resize: world %d (gen %d) -> %d (gen %d), "
+                     "resuming from the checkpoint",
+                     world.size, world.gen, new.size, new.gen)
+            world = new
+            worlds.append(world.size)
+            resizes += 1
+        summary = dict(summary or {})  # None: never reached a fit()
+        summary["elastic"] = {"exit": exit_.kind, "resizes": exit_.resizes,
+                              "worlds": exit_.worlds}
+        if exit_.kind == "preempted":
+            summary["preempted"] = True
+        else:
+            summary.pop("preempted", None)
+        return state, summary
+
+
+def scale_config(cfg, full_world: int, world: int, policy: str):
+    """TrainConfig for one world incarnation.
+
+    Preserve (default): the global batch — and therefore the loss curve
+    and the optimizer's schedule semantics — is IDENTICAL at every
+    world size; a shrunken world pays more wall time per step instead
+    (each device holds a larger batch shard). A config ALREADY using
+    gradient accumulation gets grad_accum_steps scaled up (when
+    divisibility allows) so the per-device microbatch stays constant;
+    accumulation is never INTRODUCED by a resize — splitting a batch
+    that used to run in one shot would silently change BatchNorm-style
+    per-batch statistics, breaking the very loss-curve continuity
+    Preserve promises.
+
+    Scale: the global batch scales linearly with the world (classic
+    throughput-first elasticity; the loss curve changes and the LR
+    schedule is the caller's to re-tune — documented in
+    docs/elastic.md)."""
+    if policy not in (BATCH_PRESERVE, BATCH_SCALE):
+        raise ValueError(f"unknown batch policy {policy!r}")
+    if world == full_world:
+        return cfg
+    if policy == BATCH_SCALE:
+        scaled = cfg.global_batch * world
+        if scaled % full_world:
+            raise ValueError(
+                f"global_batch {cfg.global_batch} x {world}/{full_world} "
+                f"is not integral; Scale policy needs divisibility")
+        return dataclasses.replace(cfg, global_batch=scaled // full_world)
+    base = cfg.grad_accum_steps
+    if base <= 1:
+        return cfg  # single-shot stays single-shot (see docstring)
+    scaled = base * full_world
+    accum = scaled // world if scaled % world == 0 else base
+    if cfg.global_batch % accum:
+        accum = base  # keep the global batch; memory scaling is best-effort
+    return dataclasses.replace(cfg, grad_accum_steps=accum)

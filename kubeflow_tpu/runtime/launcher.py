@@ -85,11 +85,36 @@ def run_builtin_trainer(cfg_dict: dict) -> int:
                 "worker", process=os.environ.get("JAXJOB_PROCESS_ID", ""),
                 job=os.environ.get("JAXJOB_NAME", "")):
             cfg = TrainConfig.from_dict(cfg_dict)
-            trainer = Trainer(cfg)
             # SIGTERM (pod eviction / TPU maintenance) => checkpoint +
             # EX_TEMPFAIL so the JAXJob controller gang-restarts and resumes.
             notice = PreemptionNotice().install()
-            _, summary = trainer.fit(stop=notice)
+            from kubeflow_tpu.parallel import dist as D
+
+            world_file = os.environ.get(D.ENV_WORLD_FILE)
+            if world_file:
+                # elastic job: the controller projects its world stamp
+                # into this file (downward API); the coordinator resizes
+                # the training world in place on shrink/grow instead of
+                # dying with the gang (docs/elastic.md)
+                import socket
+
+                from kubeflow_tpu.runtime.elastic import (
+                    BATCH_PRESERVE, ElasticCoordinator, file_world_source,
+                )
+
+                coord = ElasticCoordinator(
+                    file_world_source(world_file),
+                    my_name=os.environ.get("HOSTNAME")
+                    or socket.gethostname(),
+                    notice=notice,
+                    batch_policy=os.environ.get(D.ENV_BATCH_POLICY,
+                                                BATCH_PRESERVE))
+                _, summary = coord.run(
+                    cfg, full_world=int(
+                        os.environ.get(D.ENV_NPROC, "1")))
+            else:
+                trainer = Trainer(cfg)
+                _, summary = trainer.fit(stop=notice)
     finally:
         _dump_trace()
     print(json.dumps({"summary": summary}), flush=True)

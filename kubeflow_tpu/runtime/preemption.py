@@ -9,6 +9,13 @@ exits EX_TEMPFAIL — the JAXJob controller then gang-restarts the job,
 which resumes from that checkpoint instead of losing the interval since
 the last periodic save.
 
+The notice also records a *grace deadline*: the kubelet enforces
+terminationGracePeriodSeconds after SIGTERM, so downstream consumers
+(the checkpointer choosing full-save vs fast-save; the elastic
+coordinator choosing resize-in-place vs exit-and-restart,
+runtime/elastic.py) can ask ``remaining_grace()`` how much wall time is
+left before SIGKILL instead of guessing.
+
 Usage (wired by the launcher):
     notice = PreemptionNotice().install()
     state, summary = trainer.fit(stop=notice)
@@ -19,8 +26,10 @@ Usage (wired by the launcher):
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import threading
+import time
 
 log = logging.getLogger("kubeflow_tpu.preemption")
 
@@ -29,15 +38,34 @@ log = logging.getLogger("kubeflow_tpu.preemption")
 # conventional "transient, retry me" exit status.
 EX_TEMPFAIL = 75
 
+# Kubernetes' terminationGracePeriodSeconds default: the window between
+# SIGTERM and SIGKILL. The JAXJob controller does not override it, so
+# 30s is the honest default when the env var is absent.
+DEFAULT_GRACE_S = 30.0
+ENV_GRACE = "JAXJOB_TERMINATION_GRACE_S"
+
 
 class PreemptionNotice:
     """Callable flag set by SIGTERM (and available for tests/manual
-    triggering via .trigger())."""
+    triggering via .trigger()), carrying the grace wall-deadline.
 
-    def __init__(self):
+    ``grace_s`` defaults from $JAXJOB_TERMINATION_GRACE_S (the pod's
+    terminationGracePeriodSeconds, when the template projects it) else
+    the kube default of 30s. ``clock`` is injectable (monotonic
+    seconds) so the deadline math is testable without sleeping."""
+
+    def __init__(self, grace_s: float | None = None, clock=time.monotonic):
         self._event = threading.Event()
         self._prev_handler = None
         self._signum: int | None = None
+        self._clock = clock
+        if grace_s is None:
+            try:
+                grace_s = float(os.environ.get(ENV_GRACE, ""))
+            except ValueError:
+                grace_s = DEFAULT_GRACE_S
+        self.grace_s = grace_s
+        self._deadline: float | None = None
 
     def install(self, signum: int = signal.SIGTERM) -> "PreemptionNotice":
         """Install the signal handler (main thread only — launcher entry).
@@ -56,7 +84,7 @@ class PreemptionNotice:
         def handler(sig, frame):
             log.warning("preemption notice (signal %d): will checkpoint "
                         "and exit after the current step", sig)
-            self._event.set()
+            self.trigger()
             if callable(prev) and prev not in (signal.SIG_IGN, signal.SIG_DFL):
                 prev(sig, frame)
 
@@ -83,7 +111,29 @@ class PreemptionNotice:
         return self._signum is not None
 
     def trigger(self) -> None:
+        """Mark the notice and stamp the grace deadline. The FIRST
+        trigger wins the deadline: the kubelet's SIGKILL timer started
+        at the first SIGTERM, so a repeated signal must not push the
+        recorded deadline out past the real one."""
+        if self._deadline is None:
+            self._deadline = self._clock() + self.grace_s
         self._event.set()
+
+    @property
+    def deadline(self) -> float | None:
+        """Clock value (monotonic) at which the grace period expires;
+        None before any trigger."""
+        return self._deadline
+
+    def remaining_grace(self) -> float | None:
+        """Seconds of termination grace left (>= 0.0), or None when no
+        notice has fired. The checkpointer reads this to choose a full
+        durable save (plenty of time) vs a fast best-effort one; the
+        elastic coordinator reads it to decide whether an in-place
+        world re-formation can still finish before SIGKILL."""
+        if self._deadline is None:
+            return None
+        return max(self._deadline - self._clock(), 0.0)
 
     def __call__(self) -> bool:
         return self._event.is_set()

@@ -679,7 +679,8 @@ class Trainer:
         if cfg.checkpoint_dir:
             from kubeflow_tpu.runtime.checkpoint import Checkpointer
 
-            ckpt = Checkpointer(cfg.checkpoint_dir, keep=cfg.checkpoint_keep)
+            ckpt = Checkpointer(cfg.checkpoint_dir, keep=cfg.checkpoint_keep,
+                                world_size=jax.process_count())
             if cfg.resume:
                 restored = ckpt.restore_latest(state)
                 if restored is not None:

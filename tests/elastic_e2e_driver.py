@@ -1,0 +1,107 @@
+"""Subprocess driver for the elastic shrink/grow loss-continuity e2e.
+
+Run by test_elastic.py in a FRESH interpreter (the gang_worker.py /
+sched_worker.py pattern): this image's jaxlib corrupts its heap when a
+long-lived process mixes many prior compilations with meshes over
+device SUBSETS — the same pre-existing crash family that kills
+tests/test_checkpoint.py in full-suite runs. Elastic resizes are
+exactly subset meshes, so the e2e gets its own process (and no
+persistent compilation cache) and reports its verdict as one JSON line:
+
+    ELASTIC_E2E {"worlds": [4, 2, 4], "losses": [...], ...}
+
+Scenario (deterministic under the fake scheduler clock): a 4-worker
+elastic JAXJob on 2 spot + 2 on-demand hosts; both spot hosts are
+reclaimed mid-training (the world shrinks to the 2 survivors and
+resumes from the checkpointed step), then healed (the scheduler
+readmits the replacements and the world grows back to 4). A reference
+run trains the same config uninterrupted for the loss-curve comparison.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main(ckpt_root: str) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import test_elastic as TE
+
+    from kubeflow_tpu.control.jaxjob import types as T
+    from kubeflow_tpu.control.jaxjob.controller import (
+        job_world, worker_name,
+    )
+    from kubeflow_tpu.control.k8s import objects as ob
+    from kubeflow_tpu.control.scheduler.nodes import new_tpu_node
+    from kubeflow_tpu.runtime import elastic
+    from kubeflow_tpu.runtime.trainer import Trainer
+
+    fc = TE.S.FakeClock()
+    cluster, jax_ctl, sched_ctl, kubelet, _reg = TE.sched_world(fc)
+    for i in range(2):
+        cluster.create(new_tpu_node(f"spot{i}", topology="4x4", spot=True))
+    for i in range(2):
+        cluster.create(new_tpu_node(f"ond{i}", topology="4x4"))
+    cluster.create(TE.gang_elastic_job())
+    TE.pump([jax_ctl, sched_ctl], fc, kubelet)
+    bind0 = TE.bindings(cluster)
+
+    def set_ready(ready: bool) -> None:
+        for name in ("spot0", "spot1"):
+            node = cluster.get("v1", "Node", name)
+            node["status"]["conditions"] = [
+                {"type": "Ready", "status": "True" if ready else "False"}]
+            cluster.update_status(node)
+        TE.pump([sched_ctl, jax_ctl], fc, kubelet, rounds=8)
+
+    losses: list[float] = []
+
+    def callback(i, m):
+        losses.append(float(m["loss"]))
+        if len(losses) == 5:
+            set_ready(False)   # spot reclaim lands mid-step-6
+        if len(losses) == 8:
+            set_ready(True)    # capacity readmitted mid-step-9
+
+    def source():
+        return job_world(
+            cluster.get(T.API_VERSION, T.KIND, "train", "default"))
+
+    coord = elastic.ElasticCoordinator(
+        source, my_name=worker_name("train", 2),
+        form_world=lambda w: None, mesh_fn=TE._device_mesh_fn())
+    state, summary = coord.run(
+        TE._train_cfg(os.path.join(ckpt_root, "elastic")),
+        full_world=4, callback=callback)
+
+    ref_losses: list[float] = []
+    ref = Trainer(TE._train_cfg(os.path.join(ckpt_root, "ref")),
+                  mesh=TE._device_mesh_fn()(None, 4))
+    ref.fit(callback=lambda i, m: ref_losses.append(float(m["loss"])))
+
+    job = cluster.get(T.API_VERSION, T.KIND, "train", "default")
+    st = job.get("status") or {}
+    print("ELASTIC_E2E " + json.dumps({
+        "elastic": summary["elastic"],
+        "step": int(state.step),
+        "losses": losses,
+        "ref_losses": ref_losses,
+        "initial_spot_bindings": sorted(
+            bind0[worker_name("train", i)] for i in (0, 1)),
+        "restarts": st.get("restarts", 0),
+        "preemptions": st.get("preemptions", 0),
+        "resizes": st.get("resizes", 0),
+        "active_replicas": st.get("activeReplicas", 0),
+        "resizing": (ob.cond_get(job, T.COND_RESIZING) or {}).get("status"),
+        "running": ob.cond_is_true(job, T.COND_RUNNING),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])

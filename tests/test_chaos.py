@@ -666,7 +666,8 @@ class TestCheckpointResilience:
         ck.directory = str(tmp_path)
         ck.wait()
         manifest = json.loads((tmp_path / "manifest.json").read_text())
-        assert manifest == {"latest_step": 5, "steps": [1, 2, 5]}
+        assert manifest == {"latest_step": 5, "steps": [1, 2, 5],
+                            "world_sizes": {}}
         # remote URIs skip the local manifest (orbax owns metadata there)
         ck.directory = "gs://bucket/ckpt"
         ck.close()
@@ -1045,6 +1046,110 @@ def test_chaos_soak_converges_and_replays(seed):
     assert faults1 == faults2, "soak fault sequence must replay exactly"
     assert lease_faults1 == lease_faults2
     assert took1 == took2
+
+
+# -- elastic: the scripted spot-reclaim drill (ISSUE 6) ----------------------
+
+
+def _spot_world(seed, rate):
+    """Full control plane over 2 spot + 2 on-demand hosts with a
+    4-worker elastic gang (floor 2); chaos primitives drive the reclaim
+    drill, API faults armed at ``rate`` (0.0 = scripted pass-through)."""
+    tr.COLLECTOR.clear()
+    inner = FakeCluster()
+    chaos = ChaosClient(inner, ChaosPolicy(seed=seed, rate=rate),
+                        always_on=False)
+    clock = S.FakeClock()
+    registry = MetricsRegistry()
+    jax_ctl = arm_controller(seed_controller(build_controller(
+        chaos, record_events=True)), chaos)
+    sched_ctl = arm_controller(seed_controller(build_scheduler(
+        chaos, registry=registry, record_events=True, clock=clock)), chaos)
+    for ctl in (jax_ctl, sched_ctl):
+        ctl.CONFLICT_RETRY = (0, 0)
+        ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner, auto_bind=False)
+    for i in range(2):
+        inner.create(new_tpu_node(f"spot{i}", topology="4x4", spot=True))
+    for i in range(2):
+        inner.create(new_tpu_node(f"ond{i}", topology="4x4"))
+    inner.create(JT.new_jaxjob(
+        "el", replicas=4, accelerator="tpu-v5-lite-podslice",
+        topology="4x4", chips_per_worker=4, gang_schedule=True,
+        elastic_min=2))
+
+    def pump(rounds=10):
+        for _ in range(rounds):
+            jax_ctl.run_until_idle(advance_delayed=True)
+            sched_ctl.run_until_idle(advance_delayed=True)
+            kubelet.step()
+            clock.advance(1.0)
+
+    return inner, chaos, kubelet, pump
+
+
+def _spot_drill(inner, chaos, kubelet, pump):
+    """kill K (spot) nodes -> shrunken gang continues -> heal -> grow
+    back -> finish. Returns the job's final status."""
+    pump()
+    job = inner.get(JT.API_VERSION, JT.KIND, "el", "default")
+    assert ob.cond_is_true(job, JT.COND_RUNNING)
+    # spot reclaim: both spot hosts die (workers 0,1 live there — the
+    # scheduler preferred the spot pool for this elastic gang)
+    chaos.fail_node("spot0")
+    chaos.fail_node("spot1")
+    pump()
+    mid = inner.get(JT.API_VERSION, JT.KIND, "el", "default")["status"]
+    assert mid["activeReplicas"] == 2, mid
+    assert {*mid["world"]["members"]} == {worker_name("el", 2),
+                                          worker_name("el", 3)}
+    # the reclaimed capacity returns
+    chaos.heal_node("spot0")
+    chaos.heal_node("spot1")
+    pump()
+    grown = inner.get(JT.API_VERSION, JT.KIND, "el", "default")["status"]
+    assert grown["activeReplicas"] == 4, grown
+    for i in range(4):
+        kubelet.succeed(worker_name("el", i))
+    pump()
+    job = inner.get(JT.API_VERSION, JT.KIND, "el", "default")
+    assert ob.cond_is_true(job, JT.COND_SUCCEEDED)
+    return job
+
+
+def test_spot_reclaim_drill_keeps_budgets_and_trace_connected():
+    inner, chaos, kubelet, pump = _spot_world(seed=CHAOS_SEEDS[0], rate=0.0)
+    job = _spot_drill(inner, chaos, kubelet, pump)
+    st = job["status"]
+    # THE budget assertion: a full reclaim/heal cycle costs ZERO of the
+    # restart AND preemption budgets — resizes carry it all
+    assert st.get("restarts", 0) == 0
+    assert st.get("preemptions", 0) == 0
+    assert st["resizes"] == 2  # scripted drill: one shrink, one grow
+    # the trace tree stays connected across both resizes
+    header = (ob.meta(job).get("annotations") or {}).get(
+        tr.TRACEPARENT_ANNOTATION)
+    assert header
+    ctx = tr.parse_traceparent(header)
+    spans = tr.COLLECTOR.trace(ctx.trace_id)
+    assert spans
+    reach = tr.reachable(spans, ctx.span_id)
+    assert reach >= {s.span_id for s in spans}, (
+        [s.name for s in spans if s.span_id not in reach])
+
+
+@pytest.mark.parametrize("seed", CHAOS_SEEDS[:2])
+def test_spot_reclaim_drill_survives_api_faults(seed):
+    """The same drill with apiserver faults armed: evictions may land
+    in separate waves (more than one shrink resize), but the budget and
+    convergence invariants must hold fault-schedule-independently."""
+    inner, chaos, kubelet, pump = _spot_world(seed=seed, rate=CHAOS_RATE)
+    job = _spot_drill(inner, chaos, kubelet, pump)
+    st = job["status"]
+    assert st.get("restarts", 0) == 0
+    assert st.get("preemptions", 0) == 0
+    assert st["resizes"] >= 2
+    assert chaos.fault_log(), "faults should actually have been injected"
 
 
 # -- eviction-status single spelling ----------------------------------------
