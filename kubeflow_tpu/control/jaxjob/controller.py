@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import logging
 import os
+import sys
 
 import prometheus_client as prom
 
@@ -100,11 +101,24 @@ def pod_epoch(pod: dict, default: int) -> int:
 
 def worker_index(pod_name: str) -> int:
     """Replica index from a worker pod name (ordering key for world
-    membership: ranks stay aligned with the original indices)."""
+    membership: ranks stay aligned with the original indices). A name
+    that does not parse sorts AFTER every real replica — aliasing it to
+    index 0 would let a malformed leftover steal the coordinator slot
+    in membership ordering and the partial-admission prefix."""
     try:
         return int(pod_name.rsplit("-", 1)[1])
     except (IndexError, ValueError):
-        return 0
+        return sys.maxsize
+
+
+def recreate_indices(pods: list[dict], replicas: int) -> list[int]:
+    """Replica slots to re-provision for lost elastic pods. Only real
+    slots: a pod whose name does not parse (worker_index's sort
+    sentinel) or is out of the gang's range has no slot — it is
+    deleted with the shrink, never re-provisioned as a bogus
+    '<job>-worker-<sentinel>' pod."""
+    idx = (worker_index(ob.meta(p)["name"]) for p in pods)
+    return [i for i in idx if i < replicas]
 
 
 def member_coordinator(job: dict, member: str) -> str:
@@ -614,8 +628,8 @@ class JAXJobReconciler(Reconciler):
                     res = self._elastic_shrink(
                         client, job, pods,
                         lost=victims,
-                        recreate=[worker_index(ob.meta(p)["name"])
-                                  for p in victims],
+                        recreate=recreate_indices(victims,
+                                                  T.gang_size(spec)),
                         reason="SliceUnhealthy",
                         message=f"unhealthy nodes under gang: {bad_nodes}")
                     if res is not None:
@@ -731,8 +745,8 @@ class JAXJobReconciler(Reconciler):
             res = self._elastic_shrink(
                 client, job, pods,
                 lost=failed_pods,
-                recreate=[worker_index(ob.meta(p)["name"])
-                          for p in failed_pods],
+                recreate=recreate_indices(failed_pods,
+                                          T.gang_size(spec)),
                 reason="WorkerPreempted",
                 message=f"preempted workers: {failed}")
             if res is not None:

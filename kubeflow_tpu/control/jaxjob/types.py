@@ -289,7 +289,28 @@ def _validate_elastic(spec: dict) -> list[str]:
         # sharded mesh — only pure data-parallel worlds resize freely
         errs.append("spec.elastic with resizePolicy Resize requires "
                     "sliceCount 1 (elastic resize is data-parallel only)")
+    if el["resizePolicy"] == RESIZE_RESIZE:
+        argv = _worker_argv(spec)
+        if "--" in argv and "--config" not in argv:
+            # only the launcher's built-in-trainer path wires the
+            # ElasticCoordinator; a user payload after "--" would never
+            # see a resize — its world file updates unread while the
+            # controller shrinks the gang around it
+            errs.append(
+                "spec.elastic with resizePolicy Resize requires the "
+                "built-in trainer (launcher --config): a user command "
+                f"after '--' cannot follow a resize (use {RESIZE_RESTART} "
+                "for spot tolerance without in-place resize)")
     return errs
+
+
+def _worker_argv(spec: dict) -> list:
+    """The worker container's effective argv (command + args)."""
+    tmpl_spec = (spec.get("template") or {}).get("spec") or {}
+    c = (tmpl_spec.get("containers") or [{}])[0]
+    if not isinstance(c, dict):
+        return []
+    return list(c.get("command") or []) + list(c.get("args") or [])
 
 
 def _validate_tpu_topology(spec: dict) -> list[str]:

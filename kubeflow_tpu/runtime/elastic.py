@@ -69,6 +69,13 @@ def file_world_source(path: str) -> Callable[[], D.WorldSpec | None]:
     return read
 
 
+class WorldMembershipError(RuntimeError):
+    """Asked to form a world this worker is not a member of — the stamp
+    moved between the membership check and env construction. Forming
+    anyway would default the rank to 0 and collide with the world's
+    real coordinator."""
+
+
 @dataclasses.dataclass
 class ResizeExit:
     """Why run() returned (summary["elastic"] mirrors this)."""
@@ -128,8 +135,15 @@ class ElasticCoordinator:
         (rank = membership position, coordinator = members[0])."""
         env = dict(os.environ if base_env is None else base_env)
         env[D.ENV_NPROC] = str(world.size)
-        rank = world.rank_of(self.my_name) if self.my_name else None
-        env[D.ENV_PID] = str(rank if rank is not None else 0)
+        if self.my_name is None:
+            rank = 0  # untracked membership: single-pod/test contract
+        else:
+            rank = world.rank_of(self.my_name)
+            if rank is None:
+                raise WorldMembershipError(
+                    f"{self.my_name} is not in world gen {world.gen} "
+                    f"{world.members}")
+        env[D.ENV_PID] = str(rank)
         if world.coordinator:
             env[D.ENV_COORD] = world.coordinator
         return env

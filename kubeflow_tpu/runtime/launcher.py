@@ -168,7 +168,7 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", plat)
 
-    from kubeflow_tpu.parallel.dist import initialize_from_env
+    from kubeflow_tpu.parallel import dist as D
 
     # Adopt the job's trace context before any spans open: the JAXJob
     # controller stamped TRACEPARENT into the pod env, and attaching it
@@ -177,8 +177,30 @@ def main(argv: list[str] | None = None) -> int:
     if ctx is not None:
         obs_trace.TRACER.attach(ctx)
 
-    cfg = initialize_from_env()
-    log.info("process %d/%d (job=%s)", cfg.process_id, cfg.num_processes, cfg.job_name or "-")
+    world_file = os.environ.get(D.ENV_WORLD_FILE)
+    if world_file and args.config:
+        # Elastic built-in-trainer job: the pod env describes the FULL
+        # gang, but the live membership is whatever the controller
+        # stamped into the world file — under partial admission (or a
+        # grow-back replacement joining a shrunken world) they
+        # disagree, and a global initialize at the env size would block
+        # for peers that were never admitted until it times out. Leave
+        # the first world formation to the ElasticCoordinator
+        # (wait_for_membership + form_world), which forms from the
+        # stamp and retries when the stamp moves mid-join. Only the
+        # --config path wires a coordinator: a user command keeps the
+        # eager env formation below (its payload owns its own world,
+        # and gets no elastic resize — docs/elastic.md).
+        log.info("elastic world file %s set: deferring world formation "
+                 "to the elastic coordinator", world_file)
+    else:
+        if world_file:
+            log.warning("%s is set but a user command is being run: "
+                        "elastic resize only applies to the built-in "
+                        "trainer (--config); forming the world from the "
+                        "gang env", D.ENV_WORLD_FILE)
+        cfg = D.initialize_from_env()
+        log.info("process %d/%d (job=%s)", cfg.process_id, cfg.num_processes, cfg.job_name or "-")
 
     if args.wait_devices:
         wait_for_devices(args.device_timeout)

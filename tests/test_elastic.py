@@ -132,6 +132,22 @@ class TestElasticSpec:
         job["spec"]["elastic"] = "yes"
         assert any("must be an object" in e for e in T.validate(job))
 
+    def test_resize_with_user_command_rejected(self):
+        # a payload after "--" never runs the ElasticCoordinator, so it
+        # could not follow a resize — reject at admission
+        cmd = ["python", "-m", "kubeflow_tpu.runtime.launcher",
+               "--", "python", "train.py"]
+        job = elastic_job(command=cmd)
+        assert any("built-in trainer" in e for e in T.validate(job))
+        # Restart (spot opt-in, whole-gang restart semantics) is fine
+        job["spec"]["elastic"]["resizePolicy"] = T.RESIZE_RESTART
+        assert T.validate(job) == []
+        # and so is the built-in trainer even with a trailing "--"
+        job2 = elastic_job(command=[
+            "python", "-m", "kubeflow_tpu.runtime.launcher",
+            "--config", "/etc/cfg.yaml"])
+        assert T.validate(job2) == []
+
 
 # -- the elastic pod surface -------------------------------------------------
 
@@ -515,6 +531,32 @@ class TestShrinkToSurvivors:
         drain(ctl)
         st = job_status(cluster)
         assert st.get("preemptions", 0) == 1
+
+
+def test_worker_index_unparseable_sorts_last():
+    """A pod name that does not parse must never alias to replica 0 —
+    that would let a malformed leftover steal the coordinator slot in
+    world-membership ordering and the partial-admission prefix. It
+    sorts after every real replica instead."""
+    from kubeflow_tpu.control.jaxjob.controller import worker_index
+
+    names = ["train-worker-10", "leftover", "train-worker-2",
+             "train-worker-0"]
+    assert sorted(names, key=worker_index) == [
+        "train-worker-0", "train-worker-2", "train-worker-10", "leftover"]
+
+
+def test_recreate_indices_only_real_replica_slots():
+    """Lost-pod recreate lists must carry only real replica slots: an
+    unparseable name (worker_index's sort sentinel) or an out-of-range
+    index has no slot to re-provision — passing it through would
+    create a bogus '<job>-worker-<sentinel>' pod on every shrink."""
+    from kubeflow_tpu.control.jaxjob.controller import recreate_indices
+
+    pods = [{"metadata": {"name": n}}
+            for n in ["train-worker-3", "leftover", "train-worker-1",
+                      "train-worker-9"]]
+    assert recreate_indices(pods, 4) == [3, 1]
 
 
 # -- scheduler: spot pools + partial admission -------------------------------
@@ -1190,6 +1232,74 @@ class TestElasticCoordinator:
         assert source() == W2
         path.write_text("{half a json")
         assert source() is None  # mid-write reads keep the current world
+
+    def test_world_env_names_this_workers_rank(self):
+        coord = _coord(_ScriptedSource(W2), my_name="train-worker-2")
+        env = coord.world_env(W2, base_env={})
+        assert env[dist.ENV_PID] == "1"
+        assert env[dist.ENV_NPROC] == "2"
+        assert env[dist.ENV_COORD] == "c:1"
+
+    def test_world_env_refuses_nonmember_rank_default(self):
+        """A worker whose name is absent from the world it was asked to
+        form (the stamp moved under it) must NOT default to rank 0 —
+        forming as rank 0 collides with the world's real coordinator."""
+        coord = _coord(_ScriptedSource(W2), my_name="train-worker-1")
+        with pytest.raises(elastic.WorldMembershipError):
+            coord.world_env(W2, base_env={})
+
+    def test_world_env_untracked_membership_is_rank0(self):
+        # my_name=None (single-pod/test contract) keeps the rank-0 default
+        coord = _coord(_ScriptedSource(W2), my_name=None)
+        assert coord.world_env(W2, base_env={})[dist.ENV_PID] == "0"
+
+
+# -- launcher bootstrap: elastic jobs defer world formation ------------------
+
+
+class TestLauncherElasticBootstrap:
+    def _run_main(self, tmp_path, monkeypatch):
+        from kubeflow_tpu.runtime import launcher
+
+        cfg_path = tmp_path / "cfg.json"
+        cfg_path.write_text("{}")
+        calls = []
+        monkeypatch.setattr(
+            dist, "initialize_from_env",
+            lambda *a, **k: calls.append(1) or dist.DistConfig.from_env({}))
+        monkeypatch.setattr(launcher, "run_builtin_trainer", lambda cfg: 0)
+        assert launcher.main(["--config", str(cfg_path)]) == 0
+        return calls
+
+    def test_rigid_job_initializes_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv(dist.ENV_WORLD_FILE, raising=False)
+        assert len(self._run_main(tmp_path, monkeypatch)) == 1
+
+    def test_elastic_job_defers_formation_to_coordinator(
+            self, tmp_path, monkeypatch):
+        """With a world file wired, the pod env describes the FULL gang
+        while the live membership is the controller's stamp; an eager
+        global initialize would block for never-admitted peers under
+        partial admission (and for a grow-back replacement joining a
+        shrunken world). The launcher must leave the first formation to
+        the ElasticCoordinator."""
+        monkeypatch.setenv(dist.ENV_WORLD_FILE, str(tmp_path / "world"))
+        assert self._run_main(tmp_path, monkeypatch) == []
+
+    def test_user_command_with_world_file_still_initializes(
+            self, tmp_path, monkeypatch):
+        # only the --config path wires an ElasticCoordinator; a user
+        # command keeps the eager env formation (no elastic resize)
+        from kubeflow_tpu.runtime import launcher
+
+        calls = []
+        monkeypatch.setattr(
+            dist, "initialize_from_env",
+            lambda *a, **k: calls.append(1) or dist.DistConfig.from_env({}))
+        monkeypatch.setattr(launcher, "run_user_command", lambda argv: 0)
+        monkeypatch.setenv(dist.ENV_WORLD_FILE, str(tmp_path / "world"))
+        assert launcher.main(["--", "true"]) == 0
+        assert len(calls) == 1
 
 
 # -- checkpoint resharding: save at N, restore at M --------------------------
