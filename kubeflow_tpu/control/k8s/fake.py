@@ -15,6 +15,7 @@ milliseconds, and the same Client interface retargets a live cluster via
 from __future__ import annotations
 
 import collections
+import contextlib
 import copy
 import queue
 import threading
@@ -129,6 +130,16 @@ class FakeCluster:
     def __init__(self, history_limit: int = 1024):
         self._lock = threading.RLock()
         self._store: dict[Key, dict] = {}
+        # Secondary index: (apiVersion, kind) -> namespace -> name -> obj,
+        # so list() scans only the matching kind/namespace bucket instead
+        # of the whole store (ISSUE 7: a 5k-node fleet's Pod list must
+        # not pay for its ConfigMaps). Every store mutation goes through
+        # _store_put/_store_pop to keep the two views in lockstep.
+        self._kinds: dict[tuple[str, str], dict[str, dict[str, dict]]] = {}
+        # Op-count stats (read via .stats/reset_stats): the scale
+        # benchmark and the tier-1 op-budget smoke assert list-scan work
+        # in objects, which is deterministic where wall time is not.
+        self.stats: dict[str, int] = collections.defaultdict(int)
         self._recorder = None  # lazy EventRecorder (obs/events.py)
         self._rv = 0
         self._watches: list[_Watch] = []
@@ -158,6 +169,40 @@ class FakeCluster:
     def _key(self, obj: dict) -> Key:
         m = ob.meta(obj)
         return Key(obj["apiVersion"], obj["kind"], m.get("namespace") or "", m["name"])
+
+    def _store_put(self, key: Key, obj: dict) -> None:
+        self._store[key] = obj
+        self._kinds.setdefault((key.api_version, key.kind), {}) \
+            .setdefault(key.namespace, {})[key.name] = obj
+
+    def _store_pop(self, key: Key) -> dict | None:
+        found = self._store.pop(key, None)
+        if found is not None:
+            buckets = self._kinds.get((key.api_version, key.kind))
+            if buckets is not None:
+                ns = buckets.get(key.namespace)
+                if ns is not None:
+                    ns.pop(key.name, None)
+                    if not ns:
+                        del buckets[key.namespace]
+        return found
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            self.stats = collections.defaultdict(int)
+
+    @contextlib.contextmanager
+    def stats_paused(self):
+        """Suspend op counting for harness reads: a benchmark's own
+        assertions and completion sweeps must not pollute the op
+        budgets it is measuring."""
+        with self._lock:
+            saved, self.stats = self.stats, collections.defaultdict(int)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self.stats = saved
 
     def _notify(self, etype: str, obj: dict) -> None:
         ev = WatchEvent(etype, ob.deep_copy(obj))
@@ -200,7 +245,8 @@ class FakeCluster:
             m["resourceVersion"] = self._next_rv()
             m.setdefault("creationTimestamp", ob.now_iso())
             m.setdefault("generation", 1)
-            self._store[key] = obj
+            self._store_put(key, obj)
+            self.stats["create"] += 1
             self._notify("ADDED", obj)
             self._gc_if_orphaned(key)
             return ob.deep_copy(obj)
@@ -214,25 +260,31 @@ class FakeCluster:
         obj = self._store.get(key)
         if obj is None:
             return
-        m = ob.meta(obj)
-        refs = m.get("ownerReferences") or []
+        refs = ob.meta(obj).get("ownerReferences") or []
         if not refs:
             return
         live = {ob.meta(o).get("uid") for o in self._store.values()}
         keep = [r for r in refs if not r.get("uid") or r["uid"] in live]
         if len(keep) == len(refs):
             return
+        # replace, never mutate in place: list_snapshot hands out store
+        # references as frozen-at-their-rv snapshots (informer caches
+        # alias them), so every rv bump must land on a FRESH dict
+        obj = ob.deep_copy(obj)
+        m = ob.meta(obj)
         if keep:
             # prune dangling refs only — with the rv bump + MODIFIED
             # every other mutation path performs, or a watcher's cache
             # could resurrect the dangling ref through update()
             m["ownerReferences"] = keep
             m["resourceVersion"] = self._next_rv()
+            self._store_put(key, obj)
             self._notify("MODIFIED", obj)
         elif m.get("finalizers"):
             m.pop("ownerReferences", None)
             m["deletionTimestamp"] = m.get("deletionTimestamp") or ob.now_iso()
             m["resourceVersion"] = self._next_rv()
+            self._store_put(key, obj)
             self._notify("MODIFIED", obj)
         else:
             self._delete_now(key)
@@ -243,6 +295,7 @@ class FakeCluster:
             found = self._store.get(key)
             if found is None:
                 raise ob.NotFound(f"{kind} {namespace or ''}/{name} not found")
+            self.stats["get"] += 1
             return ob.deep_copy(found)
 
     def list(
@@ -253,22 +306,60 @@ class FakeCluster:
         label_selector: dict | str | None = None,
         field_selector: dict[str, str] | None = None,
     ) -> list[dict]:
+        with self._lock:
+            out = [ob.deep_copy(o) for o in self._select(
+                api_version, kind, namespace, label_selector, field_selector)]
+            self.stats["list_copied"] += len(out)
+            return out
+
+    def list_snapshot(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None = None,
+        label_selector: dict | str | None = None,
+        field_selector: dict[str, str] | None = None,
+    ) -> tuple[list[dict], str]:
+        """``(items, resourceVersion)`` WITHOUT copying: the internal
+        read-only fast path for informer caches (``control/cache.py``)
+        whose initial sync would otherwise deep-copy the whole store
+        only to index it. Items are the STORED objects — callers must
+        treat them as immutable and write only through the verbs."""
+        with self._lock:
+            return (self._select(api_version, kind, namespace,
+                                 label_selector, field_selector),
+                    str(self._rv))
+
+    def _select(
+        self,
+        api_version: str,
+        kind: str,
+        namespace: str | None,
+        label_selector: dict | str | None,
+        field_selector: dict[str, str] | None,
+    ) -> list[dict]:
+        """Matching stored objects (no copy), via the kind/namespace
+        index: list cost is O(matching bucket), not O(store)."""
         if isinstance(label_selector, str):
             label_selector = ob.parse_label_selector(label_selector)
-        with self._lock:
-            out = []
-            for key, obj in self._store.items():
-                if (key.api_version, key.kind) != (api_version, kind):
-                    continue
-                if namespace is not None and key.namespace != (namespace or ""):
-                    continue
+        buckets = self._kinds.get((api_version, kind)) or {}
+        if namespace is not None:
+            spaces = [buckets.get(namespace or "", {})]
+        else:
+            spaces = list(buckets.values())
+        out = []
+        self.stats["list_calls"] += 1
+        for ns in spaces:
+            self.stats["list_scanned"] += len(ns)
+            for obj in ns.values():
                 if not ob.match_labels(ob.labels_of(obj), label_selector):
                     continue
                 if not ob.match_fields(obj, field_selector):
                     continue
-                out.append(ob.deep_copy(obj))
-            out.sort(key=lambda o: (ob.meta(o).get("namespace") or "", ob.meta(o)["name"]))
-            return out
+                out.append(obj)
+        out.sort(key=lambda o: (ob.meta(o).get("namespace") or "",
+                                ob.meta(o)["name"]))
+        return out
 
     def list_page(
         self,
@@ -340,7 +431,8 @@ class FakeCluster:
                 if "deletionTimestamp" in fm:
                     new["metadata"]["deletionTimestamp"] = fm["deletionTimestamp"]
             ob.meta(new)["resourceVersion"] = self._next_rv()
-            self._store[key] = new
+            self._store_put(key, new)
+            self.stats["update"] += 1
             self._notify("MODIFIED", new)
             self._maybe_finalize(key)
             return ob.deep_copy(self._store[key]) if key in self._store else ob.deep_copy(new)
@@ -361,6 +453,7 @@ class FakeCluster:
     ) -> dict:
         """dict → JSON merge patch; list → RFC6902 JSON patch."""
         with self._lock:
+            self.stats["patch"] += 1
             cur = self.get(api_version, kind, name, namespace)
             # a patch carrying metadata.resourceVersion is an optimistic-
             # concurrency precondition: stale -> 409 (apiserver semantics)
@@ -513,21 +606,25 @@ class FakeCluster:
             found = self._store.get(key)
             if found is None:
                 raise ob.NotFound(f"{kind} {namespace or ''}/{name} not found")
-            m = ob.meta(found)
-            if m.get("finalizers"):
+            if ob.meta(found).get("finalizers"):
                 # graceful deletion: mark and wait for finalizers to clear
-                # (the Profile finalizer path — profile_controller.go:48)
-                if "deletionTimestamp" not in m:
+                # (the Profile finalizer path — profile_controller.go:48).
+                # Replace-not-mutate: snapshot aliases stay frozen.
+                if "deletionTimestamp" not in ob.meta(found):
+                    found = ob.deep_copy(found)
+                    m = ob.meta(found)
                     m["deletionTimestamp"] = ob.now_iso()
                     m["resourceVersion"] = self._next_rv()
+                    self._store_put(key, found)
                     self._notify("MODIFIED", found)
                 return
             self._delete_now(key)
 
     def _delete_now(self, key: Key) -> None:
-        found = self._store.pop(key, None)
+        found = self._store_pop(key)
         if found is None:
             return
+        self.stats["delete"] += 1
         # the DELETED event carries a fresh RV (apiserver semantics) — and
         # watch resume replays strictly-greater RVs, so reusing the prior
         # event's RV would silently drop deletions from resumed streams
@@ -560,15 +657,21 @@ class FakeCluster:
             obj = self._store.get(k)
             if obj is None:
                 continue
+            refs = [r for r in ob.meta(obj).get("ownerReferences") or []
+                    if r.get("uid") != uid]
+            # replace-not-mutate (see _gc_if_orphaned): snapshot aliases
+            # must stay frozen at the rv they were handed out under
+            obj = ob.deep_copy(obj)
             m = ob.meta(obj)
-            refs = [r for r in m.get("ownerReferences") or [] if r.get("uid") != uid]
             if refs:
                 m["ownerReferences"] = refs
+                self._store_put(k, obj)
                 continue
             if m.get("finalizers"):
                 m.pop("ownerReferences", None)
                 m["deletionTimestamp"] = m.get("deletionTimestamp") or ob.now_iso()
                 m["resourceVersion"] = self._next_rv()
+                self._store_put(k, obj)
                 self._notify("MODIFIED", obj)
             else:
                 self._delete_now(k)

@@ -100,6 +100,7 @@ class Controller:
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._streams: list = []
+        self._resources: list = []  # lifecycle-coupled (see uses())
         self._elector = None  # set by with_leader_election
 
     # -- registration (kubebuilder For/Owns/Watches analogues) -------------
@@ -118,6 +119,16 @@ class Controller:
         self, api_version: str, kind: str, fn: Callable[[dict], list[Request]]
     ) -> "Controller":
         self._sources.append(_Source(api_version, kind, fn))
+        return self
+
+    def uses(self, resource) -> "Controller":
+        """Attach a lifecycle-coupled resource — e.g. a ``ClusterCache``
+        whose watch pumps must run exactly as long as this controller's
+        threads do. ``run()`` calls each resource's ``start()``,
+        ``stop()`` its ``stop()``. Hermetic ``run_until_idle`` drives
+        such resources synchronously instead (the reconciler calls
+        ``refresh()``), so no threads are started for them there."""
+        self._resources.append(resource)
         return self
 
     # -- queue --------------------------------------------------------------
@@ -237,6 +248,8 @@ class Controller:
 
     def run(self, workers: int = 1) -> "Controller":
         """Start watch threads + worker threads; returns immediately."""
+        for resource in self._resources:
+            resource.start()
         for src in self._sources:
             stream = self.client.watch(src.api_version, src.kind)
             with self._cv:
@@ -336,6 +349,8 @@ class Controller:
         self._stop.set()
         for s in self._streams:
             s.stop()
+        for resource in self._resources:
+            resource.stop()
         with self._cv:
             self._cv.notify_all()
 

@@ -31,10 +31,14 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 
+import prometheus_client as prom
+
+from kubeflow_tpu.control.cache import ClusterCache
 from kubeflow_tpu.control.jaxjob import types as JT
 from kubeflow_tpu.control.jaxjob.controller import (
-    schedule_latency, worker_index,
+    _metric, schedule_latency, worker_index,
 )
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.runtime import (
@@ -44,6 +48,7 @@ from kubeflow_tpu.control.scheduler import (
     ANNOTATION_ELASTIC_MIN, ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY,
     GATE_GANG, SCHEDULER_NAME,
 )
+from kubeflow_tpu.control.scheduler import capacity as CP
 from kubeflow_tpu.control.scheduler import nodes as N
 from kubeflow_tpu.control.scheduler.queue import GangQueue
 from kubeflow_tpu.obs import trace as obs_trace
@@ -52,6 +57,32 @@ from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
 # Queue-to-bound latency buckets: scheduling is sub-second when capacity
 # exists, minutes when a gang waits behind backoff/preemption.
 BIND_LATENCY_BUCKETS = (0.1, 0.5, 1, 2, 5, 10, 30, 60, 120, 300, 600)
+
+# Pass-duration buckets: an indexed pass is sub-millisecond at hundreds
+# of nodes; the tier-1 scale smoke budgets the tail (docs/scale.md).
+PASS_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+# Prometheus sink: the jaxjob controller's _metric lazy-singleton
+# registry, shared — one double-registration guard for the package.
+
+
+def pass_seconds_prom():
+    return _metric("scheduler_pass_seconds", prom.Histogram,
+                   "scheduling pass duration (sync + health + admit)",
+                   buckets=PASS_BUCKETS)
+
+
+def nodes_scanned_prom():
+    return _metric("scheduler_nodes_scanned_total", prom.Counter,
+                   "nodes examined by best-fit placement walks")
+
+
+def cache_reads_prom():
+    return _metric("scheduler_cache_reads_total", prom.Counter,
+                   "hot-path cluster reads by source",
+                   labelnames=("source",))
+
 
 log = logging.getLogger("kubeflow_tpu.scheduler")
 
@@ -102,6 +133,11 @@ def _gang_context(pods: list[dict]) -> obs_trace.SpanContext | None:
 
 
 class GangScheduler(Reconciler):
+    # Optional hook: called with each pass duration in seconds (the
+    # scale benchmark collects raw samples for p50/p99 — histogram
+    # buckets are too coarse for a tail assertion).
+    pass_observer = None
+
     def __init__(
         self,
         queue: GangQueue | None = None,
@@ -109,6 +145,7 @@ class GangScheduler(Reconciler):
         record_events: bool = True,
         clock=None,
         jitter: float = 0.0,
+        cache: ClusterCache | None = None,
     ):
         if queue is None:
             kw = {"jitter": jitter}
@@ -118,6 +155,19 @@ class GangScheduler(Reconciler):
         self.queue = queue
         self.registry = registry
         self.record_events = record_events
+        # The indexed cluster cache (ISSUE 7). With it, every hot-path
+        # read — gang pods, capacity, victim scan, node health — is an
+        # O(bucket) snapshot lookup; without it (cache=None, the
+        # pre-ISSUE-7 shape kept for the seed-vs-optimized benchmark)
+        # each read is a full apiserver relist.
+        self.cache = cache
+        # legacy-path node-set memory for the health-pass short-circuit
+        self._known_nodes: set[str] | None = None
+        # last published cache stats, for counter deltas; read-compute-
+        # update must be atomic or two workers publishing concurrently
+        # double-count the same delta
+        self._cache_stats: dict[str, int] = {}
+        self._stats_lock = threading.Lock()
         # admission is a read-compute-bind transaction over cluster
         # state; two run(workers=N) threads interleaving passes would
         # each see the same free chips and double-book a node, so the
@@ -128,10 +178,17 @@ class GangScheduler(Reconciler):
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self, client, req: Request) -> Result | None:
-        if req != RETRY_ALL:  # the sentinel names no gang to sync
-            self._sync(client, req)
         with self._pass_lock:
-            if req == RETRY_ALL:
+            t0 = time.perf_counter()
+            if self.cache is not None:
+                # catch the snapshot up BEFORE reading: the event that
+                # triggered this reconcile is already in the watch
+                # queues, and the serialized pass keeps event
+                # application single-writer
+                self.cache.refresh()
+            if req != RETRY_ALL:  # the sentinel names no gang to sync
+                self._sync(client, req)
+            else:
                 # node events land here: before admitting anything,
                 # evict gangs whose nodes died under them (freed chips
                 # then feed the same pass). Under the pass lock: two
@@ -139,15 +196,51 @@ class GangScheduler(Reconciler):
                 # evict (and double-count) the same pods.
                 self._health_pass(client)
             delay = self._schedule_pass(client)
+            self._observe_pass(time.perf_counter() - t0)
         self._publish_metrics()
         if delay is not None:
             return Result(requeue_after=max(delay, 0.01))
         return None
 
+    def _observe_pass(self, dt: float) -> None:
+        self.registry.histogram(
+            "scheduler_pass_seconds", dt,
+            help_="scheduling pass duration (sync + health + admit)",
+            buckets=PASS_BUCKETS)
+        pass_seconds_prom().observe(dt)
+        if self.pass_observer is not None:
+            self.pass_observer(dt)
+
+    def _note(self, obj: dict | None) -> None:
+        """Fold our own write response into the cache (assume-cache):
+        the next admission in this same pass must see this bind."""
+        if self.cache is not None and obj:
+            self.cache.note_write(obj)
+
+    def _count_read(self, source: str) -> None:
+        self.registry.counter_inc(
+            "scheduler_cache_reads_total",
+            help_="hot-path cluster reads by source (cache hit rate)",
+            source=source)
+        cache_reads_prom().labels(source=source).inc()
+
+    def _count_scanned(self, cap: CP.Capacity) -> None:
+        if cap.scanned:
+            self.registry.counter_inc(
+                "scheduler_nodes_scanned_total",
+                help_="nodes examined by best-fit placement walks",
+                by=cap.scanned)
+            nodes_scanned_prom().inc(cap.scanned)
+            cap.scanned = 0
+
     def _sync(self, client, req: Request) -> None:
         """Fold this gang's current cluster state into the queue."""
         pods = self._gang_pods(client, req.namespace, req.name)
         pending = [p for p in pods if self._unbound_pending(p)]
+        if not pending and self._cache_may_lag(pods, req.namespace,
+                                               req.name):
+            pods = self._confirm_gang(client, req.namespace, req.name)
+            pending = [p for p in pods if self._unbound_pending(p)]
         if not pending:
             self.queue.remove(req.namespace, req.name)
             return
@@ -251,24 +344,53 @@ class GangScheduler(Reconciler):
         shape — phase Failed, reason Evicted — so the JAXJob
         controller's existing ``_pod_preempted`` path gang-restarts the
         job on its preemption budget, and the recreated (gated) pods
-        requeue for admission on the surviving nodes."""
-        views = {v.name: v for v in (N.node_view(n)
-                                     for n in client.list("v1", "Node"))}
+        requeue for admission on the surviving nodes.
+
+        Steady-state cost (ISSUE 7 satellite): with every node Ready
+        this pass touches ZERO pods — the cache answers "any bound pod
+        on a dead node?" from its by-node index, and the legacy path
+        skips the pod list unless a node is unready or vanished since
+        the last pass (it previously listed every Pod in the cluster on
+        every RETRY_ALL reconcile)."""
         victims: list[tuple[dict, str]] = []
-        for p in client.list("v1", "Pod"):
-            spec = p.get("spec") or {}
-            if spec.get("schedulerName") != SCHEDULER_NAME:
-                continue
-            node = spec.get("nodeName")
-            if not node:
-                continue
-            if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
-                continue
-            view = views.get(node)
-            if view is not None and view.ready:
-                continue
-            why = "deleted" if view is None else "NotReady"
-            victims.append((p, f"node {node} {why} under gang"))
+        new_known: set[str] | None = None
+        if self.cache is not None:
+            self._count_read("cache")
+            for node, why in sorted(
+                    self.cache.unhealthy_bound_nodes().items()):
+                for p in self.cache.pods_on_node(node):
+                    if (p.get("spec") or {}).get("schedulerName") \
+                            != SCHEDULER_NAME:
+                        continue
+                    victims.append((p, f"node {node} {why} under gang"))
+        else:
+            self._count_read("list")
+            views = {v.name: v for v in (N.node_view(n)
+                                         for n in client.list("v1", "Node"))}
+            unready = {n for n, v in views.items() if not v.ready}
+            vanished = (self._known_nodes or set()) - set(views)
+            first = self._known_nodes is None
+            if not unready and not vanished and not first:
+                # all Ready, nothing vanished: skip the pod list (safe
+                # to commit the node set here — there is no work below
+                # whose failure could lose a signal)
+                self._known_nodes = set(views)
+                return
+            new_known = set(views)
+            for p in client.list("v1", "Pod"):
+                spec = p.get("spec") or {}
+                if spec.get("schedulerName") != SCHEDULER_NAME:
+                    continue
+                node = spec.get("nodeName")
+                if not node:
+                    continue
+                if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                    continue
+                view = views.get(node)
+                if view is not None and view.ready:
+                    continue
+                why = "deleted" if view is None else "NotReady"
+                victims.append((p, f"node {node} {why} under gang"))
         for p, message in victims:
             m = ob.meta(p)
             cur = client.get_or_none("v1", "Pod", m["name"],
@@ -279,7 +401,7 @@ class GangScheduler(Reconciler):
                 continue
             cur.setdefault("status", {})
             cur["status"].update(N.eviction_status(message))
-            client.update_status(cur)
+            self._note(client.update_status(cur))
             log.info("evicted %s/%s: %s", m.get("namespace"), m["name"],
                      message)
             self.registry.counter_inc(
@@ -289,16 +411,61 @@ class GangScheduler(Reconciler):
             if self.record_events and hasattr(client, "record_event"):
                 client.record_event(cur, "GangNodeLost", message, "Warning",
                                     component=SCHEDULER_NAME)
+        # commit the node-set memory only once every eviction landed: a
+        # raising list/update above leaves _known_nodes unchanged, so
+        # the retrying reconcile still sees the vanished node (eviction
+        # is idempotent — already-terminal victims are skipped)
+        if new_known is not None:
+            self._known_nodes = new_known
 
     # -- admission ----------------------------------------------------------
 
     def _gang_pods(self, client, namespace: str, name: str) -> list[dict]:
+        if self.cache is not None:
+            self._count_read("cache")
+            pods = self.cache.gang_pods(namespace, name)
+            return [p for p in pods
+                    if (p.get("spec") or {}).get("schedulerName")
+                    == SCHEDULER_NAME]
+        return self._gang_pods_listed(client, namespace, name)
+
+    def _gang_pods_listed(self, client, namespace: str,
+                          name: str) -> list[dict]:
+        self._count_read("list")
         pods = client.list(
             "v1", "Pod", namespace=namespace,
             label_selector={"matchLabels": {JT.LABEL_JOB_NAME: name}})
         return [p for p in pods
                 if (p.get("spec") or {}).get("schedulerName")
                 == SCHEDULER_NAME]
+
+    def _cache_may_lag(self, pods: list[dict], namespace: str,
+                       name: str) -> bool:
+        """Whether 'no pending pods' is trustworthy enough to drop the
+        gang. In pumped mode refresh() cannot drain the pump-owned
+        streams, so a reconcile can read a snapshot that predates its
+        own triggering event — and a gang dropped from the queue on
+        that basis has nothing left to requeue it (gated Pending pods
+        emit no further events). Only the states a stalled restart
+        actually leaves behind need confirming (no pods / all terminal
+        / still queued): live bound pods mean the gang is running, and
+        its eventual terminal transitions re-enter here."""
+        if self.cache is None or not self.cache.pumped:
+            return False
+        if not pods or all((p.get("status") or {}).get("phase")
+                           in N.TERMINAL_PHASES for p in pods):
+            return True
+        return self.queue.get(namespace, name) is not None
+
+    def _confirm_gang(self, client, namespace: str,
+                      name: str) -> list[dict]:
+        """Authoritative re-read before a destructive queue decision,
+        folded back into the lagging cache (rv-guarded, so it can only
+        advance the snapshot)."""
+        pods = self._gang_pods_listed(client, namespace, name)
+        for p in pods:
+            self._note(p)
+        return pods
 
     @staticmethod
     def _unbound_pending(pod: dict) -> bool:
@@ -331,6 +498,12 @@ class GangScheduler(Reconciler):
             pods = self._gang_pods(client, entry.namespace, entry.name)
         pending = sorted((p for p in pods if self._unbound_pending(p)),
                          key=lambda p: ob.meta(p)["name"])
+        if not pending and self.cache is not None and self.cache.pumped:
+            # a queued gang with nothing pending is about to be dropped
+            # — confirm the lagging snapshot against the apiserver first
+            pods = self._confirm_gang(client, entry.namespace, entry.name)
+            pending = sorted((p for p in pods if self._unbound_pending(p)),
+                             key=lambda p: ob.meta(p)["name"])
         if not pending:
             return _GONE  # bound elsewhere or deleted
         bound = [p for p in pods
@@ -347,33 +520,35 @@ class GangScheduler(Reconciler):
             # rigid gangs: bound residue (half-started bind) is the
             # JAXJob controller's to resolve — unchanged semantics
             return _WAIT
-        free, views = self._free_chips(client)
-        assignment = self._assign(pending, views, free,
-                                  prefer_spot=elastic)
-        if assignment is None and elastic:
-            # partial admission: any subset keeping the world at or
-            # above the elastic floor beats idling — the scheduler's
-            # half of shrink-to-survivors. Rigid gangs never get here:
-            # all-or-nothing stays the law.
-            floor = max(emin - len(bound), 1)
-            assignment = self._assign_partial(pending, views, free, floor)
-            if assignment is None and len(bound) >= emin:
-                return _GROW_WAIT
-        if assignment is None:
-            if self.record_events and hasattr(client, "record_event"):
-                # dedup (obs/events.py) collapses the retry storm: one
-                # Event whose count tracks the failed attempts
-                client.record_event(
-                    pending[0], "GangUnschedulable",
-                    f"gang {entry.namespace}/{entry.name}: no node set "
-                    f"fits all {len(pending)} workers"
-                    + (f" (nor >= the elastic floor of {emin})"
-                       if elastic else ""), "Warning",
-                    component=SCHEDULER_NAME)
-            return _UNPLACEABLE
+        cap = self._capacity(client)
+        try:
+            assignment = self._assign(pending, cap, prefer_spot=elastic)
+            if assignment is None and elastic:
+                # partial admission: any subset keeping the world at or
+                # above the elastic floor beats idling — the scheduler's
+                # half of shrink-to-survivors. Rigid gangs never get
+                # here: all-or-nothing stays the law.
+                floor = max(emin - len(bound), 1)
+                assignment = self._assign_partial(pending, cap, floor=floor)
+                if assignment is None and len(bound) >= emin:
+                    return _GROW_WAIT
+            if assignment is None:
+                if self.record_events and hasattr(client, "record_event"):
+                    # dedup (obs/events.py) collapses the retry storm:
+                    # one Event whose count tracks the failed attempts
+                    client.record_event(
+                        pending[0], "GangUnschedulable",
+                        f"gang {entry.namespace}/{entry.name}: no node set "
+                        f"fits all {len(pending)} workers"
+                        + (f" (nor >= the elastic floor of {emin})"
+                           if elastic else ""), "Warning",
+                        component=SCHEDULER_NAME)
+                return _UNPLACEABLE
+        finally:
+            self._count_scanned(cap)
         if not self._bind(client, entry, assignment):
             return _WAIT
-        if any(views[n].spot for n in assignment.values()):
+        if any(cap.views[n].spot for n in assignment.values()):
             self.registry.counter_inc(
                 "scheduler_spot_admissions_total",
                 help_="gang admissions that placed workers on "
@@ -390,9 +565,16 @@ class GangScheduler(Reconciler):
             return _PARTIAL
         return _ADMITTED
 
-    def _free_chips(self, client) -> tuple[dict[str, int], dict]:
-        """Per-node free chips = allocatable - requests of bound,
-        non-terminal pods (an evicted gang's chips free immediately)."""
+    def _capacity(self, client) -> CP.Capacity:
+        """The placement snapshot: per-node free chips = allocatable -
+        requests of bound, non-terminal pods (an evicted gang's chips
+        free immediately), plus the sorted per-pool buckets. Served
+        from the cache's incremental indexes, or (legacy path, kept for
+        the seed-vs-optimized benchmark) rebuilt from a full relist."""
+        if self.cache is not None:
+            self._count_read("cache")
+            return self.cache.capacity()
+        self._count_read("list")
         views = {v.name: v
                  for v in (N.node_view(n)
                            for n in client.list("v1", "Node"))}
@@ -404,36 +586,34 @@ class GangScheduler(Reconciler):
             if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
                 continue
             free[node] -= N.pod_tpu_request(p)
-        return free, views
+        return CP.Capacity.from_views(views, free)
 
     @staticmethod
-    def _assign(pods: list[dict], views: dict, free: dict[str, int],
-                prefer_spot: bool = False):
+    def _assign(pods: list[dict], cap: CP.Capacity,
+                prefer_spot: bool = False, txn: CP.CapacityTxn | None = None):
         """All-or-nothing placement: best-fit every worker or None.
-        Does not mutate ``free`` (callers simulate with copies).
+        Each worker is a bisect into its pool's sorted free-capacity
+        bucket plus a walk to the first feasible node (capacity.py) —
+        the semantics of the old full scan (min free chips, then
+        lexicographically-first name), minus the O(nodes) per worker.
+        Trials never disturb the snapshot: placement happens on a
+        copy-on-write ``CapacityTxn`` (``txn`` lets the preemption loop
+        seed one with victim credits).
 
         ``prefer_spot`` (elastic gangs): when any feasible spot node has
         room, best-fit among spot nodes only — spot capacity is
         reclaim-tolerant work's to burn, keeping on-demand pools free
         for rigid gangs. Preferred, not required: with the spot pool
         full, placement falls back to any feasible node."""
-        remaining = dict(free)
+        if txn is None:
+            txn = cap.txn()
         out: dict[str, str] = {}
         for pod in pods:
             need = N.pod_tpu_request(pod)
-            candidates = [name for name in sorted(views)
-                          if remaining[name] >= need
-                          and N.feasible(pod, views[name])]
-            if prefer_spot:
-                spot = [n for n in candidates if views[n].spot]
-                candidates = spot or candidates
-            best = None
-            for name in candidates:
-                if best is None or remaining[name] < remaining[best]:
-                    best = name
+            best = txn.best_fit(pod, need, prefer_spot)
             if best is None:
                 return None
-            remaining[best] -= need
+            txn.take(best, need)
             out[ob.meta(pod)["name"]] = best
         return out
 
@@ -449,14 +629,20 @@ class GangScheduler(Reconciler):
         name = ob.meta(pod)["name"]
         return (worker_index(name), name)
 
-    def _assign_partial(self, pods: list[dict], views: dict,
-                        free: dict[str, int], floor: int):
+    def _assign_partial(self, pods: list[dict], cap, free=None,
+                        floor: int = 1):
         """Largest placeable prefix of at least ``floor`` workers, or
         None. Gang workers are homogeneous (same selector/chips), so a
         deterministic index-ordered prefix loses no generality. Prefix
         placeability is monotone in k (dropping a worker from a valid
         assignment stays valid), so binary search: O(log n) full
-        best-fit passes instead of O(n) on the scheduler's hot path."""
+        best-fit passes instead of O(n) on the scheduler's hot path.
+
+        ``cap`` is a ``Capacity`` snapshot; the pre-ISSUE-7
+        ``(views, free)`` pair is still accepted (``free`` not None)
+        and wrapped on the spot."""
+        if free is not None:
+            cap = CP.Capacity.from_views(cap, free)
         if floor > len(pods):
             return None
         pods = sorted(pods, key=self._replica_order)
@@ -464,7 +650,7 @@ class GangScheduler(Reconciler):
         lo, hi = floor, len(pods) - 1
         while lo <= hi:
             mid = (lo + hi) // 2
-            a = self._assign(pods[:mid], views, free, prefer_spot=True)
+            a = self._assign(pods[:mid], cap, prefer_spot=True)
             if a is not None:
                 best = a
                 lo = mid + 1
@@ -495,9 +681,14 @@ class GangScheduler(Reconciler):
                         "v1", "Pod", pod_name,
                         {"spec": {"nodeName": node_name}},
                         entry.namespace)
+                    self._note(bound_objs[pod_name])
                     bound.append(pod_name)
                 for pod_name in sorted(assignment):
-                    self._lift_gate(client, entry.namespace, pod_name)
+                    # the bind-phase patch response already carries the
+                    # pod's gate list — one coalesced write per object,
+                    # no per-pod re-GET on the hot path
+                    self._lift_gate(client, entry.namespace, pod_name,
+                                    cur=bound_objs[pod_name])
             except ob.ApiError as e:
                 log.warning("gang %s/%s: bind failed (%s); releasing %d pods",
                             entry.namespace, entry.name, e, len(bound))
@@ -555,19 +746,20 @@ class GangScheduler(Reconciler):
                               namespace, ob.meta(p)["name"])
         return repaired
 
-    @staticmethod
-    def _lift_gate(client, namespace: str, pod_name: str) -> None:
+    def _lift_gate(self, client, namespace: str, pod_name: str,
+                   cur: dict | None = None) -> None:
         """Remove OUR gate only — another controller's gate (a quota
-        hold, say) is its to lift, never ours to clobber."""
-        cur = client.get("v1", "Pod", pod_name, namespace)
+        hold, say) is its to lift, never ours to clobber. ``cur`` (the
+        bind-phase patch response) saves the re-GET on the hot path."""
+        if cur is None:
+            cur = client.get("v1", "Pod", pod_name, namespace)
         gates = [g for g in (cur.get("spec") or {}).get("schedulingGates")
                  or [] if g.get("name") != GATE_GANG]
-        client.patch("v1", "Pod", pod_name,
-                     {"spec": {"schedulingGates": gates or None}},
-                     namespace)
+        self._note(client.patch(
+            "v1", "Pod", pod_name,
+            {"spec": {"schedulingGates": gates or None}}, namespace))
 
-    @staticmethod
-    def _release_pod(client, namespace: str, pod_name: str) -> None:
+    def _release_pod(self, client, namespace: str, pod_name: str) -> None:
         """Failed-bind rollback for one pod: unbind and restore OUR gate
         (preserving any foreign gates). Non-Pending pods are left alone
         — stripping a Running pod's binding would corrupt node
@@ -580,9 +772,10 @@ class GangScheduler(Reconciler):
         gates = list((cur.get("spec") or {}).get("schedulingGates") or [])
         if not any(g.get("name") == GATE_GANG for g in gates):
             gates.append({"name": GATE_GANG})
-        client.patch("v1", "Pod", pod_name,
-                     {"spec": {"nodeName": None, "schedulingGates": gates}},
-                     namespace)
+        self._note(client.patch(
+            "v1", "Pod", pod_name,
+            {"spec": {"nodeName": None, "schedulingGates": gates}},
+            namespace))
 
     # -- preemption ---------------------------------------------------------
 
@@ -607,30 +800,39 @@ class GangScheduler(Reconciler):
             return evicted
 
     def _preempt(self, client, entry, pending: list[dict]) -> bool:
-        free, views = self._free_chips(client)
-        if self._assign(pending, views, free) is not None:
-            # fits without evicting anyone (state moved since the failed
-            # admission attempt) — let the next pass admit it instead
+        cap = self._capacity(client)
+        try:
+            if self._assign(pending, cap) is not None:
+                # fits without evicting anyone (state moved since the
+                # failed admission attempt) — let the next pass admit it
+                return False
+            # only nodes the preemptor could actually use: evicting a
+            # gang from a different pool (topology/accelerator mismatch)
+            # frees nothing this gang can take, so such victims are
+            # never touched
+            usable = {name for name, v in cap.views.items()
+                      if any(N.feasible(p, v) for p in pending)}
+            # victim chips accumulate on ONE credits txn; each what-if
+            # assignment runs on a fork so its takes never leak into
+            # the next round's starting state
+            credits = cap.txn()
+            chosen: list[tuple[tuple[str, str], list[dict]]] = []
+            for gang_key, gang_pods in self._victim_gangs(
+                    client, entry.priority):
+                if not any((p.get("spec") or {}).get("nodeName") in usable
+                           for p in gang_pods):
+                    continue
+                for p in gang_pods:
+                    node = (p.get("spec") or {}).get("nodeName")
+                    if node in cap.free:
+                        credits.credit(node, N.pod_tpu_request(p))
+                chosen.append((gang_key, gang_pods))
+                if self._assign(pending, cap, txn=credits.fork()) is not None:
+                    self._evict(client, entry, chosen)
+                    return True
             return False
-        # only nodes the preemptor could actually use: evicting a gang
-        # from a different pool (topology/accelerator mismatch) frees
-        # nothing this gang can take, so such victims are never touched
-        usable = {name for name, v in views.items()
-                  if any(N.feasible(p, v) for p in pending)}
-        chosen: list[tuple[tuple[str, str], list[dict]]] = []
-        for gang_key, gang_pods in self._victim_gangs(client, entry.priority):
-            if not any((p.get("spec") or {}).get("nodeName") in usable
-                       for p in gang_pods):
-                continue
-            for p in gang_pods:
-                node = (p.get("spec") or {}).get("nodeName")
-                if node in free:
-                    free[node] += N.pod_tpu_request(p)
-            chosen.append((gang_key, gang_pods))
-            if self._assign(pending, views, free) is not None:
-                self._evict(client, entry, chosen)
-                return True
-        return False
+        finally:
+            self._count_scanned(cap)
 
     def _victim_gangs(self, client, priority: int):
         """Bound, non-terminal gangs of strictly lower priority, grouped
@@ -638,7 +840,13 @@ class GangScheduler(Reconciler):
         resort for determinism)."""
         gangs: dict[tuple[str, str], list[dict]] = {}
         prios: dict[tuple[str, str], int] = {}
-        for p in client.list("v1", "Pod"):
+        if self.cache is not None:
+            self._count_read("cache")
+            pods = self.cache.bound_pods()  # O(bound), no copies
+        else:
+            self._count_read("list")
+            pods = client.list("v1", "Pod")
+        for p in pods:
             spec = p.get("spec") or {}
             if spec.get("schedulerName") != SCHEDULER_NAME:
                 continue
@@ -671,7 +879,7 @@ class GangScheduler(Reconciler):
                     continue
                 cur.setdefault("status", {})
                 cur["status"].update(N.eviction_status(message))
-                client.update_status(cur)
+                self._note(client.update_status(cur))
             log.info("evicted gang %s/%s: %s", ns, name, message)
             self.registry.counter_inc(
                 "scheduler_preemptions_total",
@@ -689,6 +897,26 @@ class GangScheduler(Reconciler):
             self.registry.gauge(
                 "scheduler_queue_depth", depth,
                 help_="gangs queued awaiting admission", namespace=ns)
+        if self.cache is None:
+            return
+        helps = {
+            "events": "watch events applied to the cluster cache",
+            "stale_events": "out-of-order/replayed events dropped by "
+                            "the resourceVersion guard",
+            "relists": "full relists the cache performed (initial sync "
+                       "+ 410/expired recoveries)",
+            "resubscribes": "watch streams the cache resubscribed",
+        }
+        with self._stats_lock:
+            stats = self.cache.stats()
+            deltas = {key: stats.get(key, 0) - self._cache_stats.get(key, 0)
+                      for key in helps}
+            self._cache_stats = stats
+        for key, help_ in helps.items():
+            if deltas[key]:
+                self.registry.counter_inc(
+                    f"cluster_cache_{key}_total", help_=help_,
+                    by=deltas[key])
 
 
 def _pod_mapper(rec: GangScheduler, client):
@@ -756,11 +984,19 @@ def build_scheduler(
     clock=None,
     queue: GangQueue | None = None,
     jitter: float = 0.0,
+    cache: bool = True,
 ) -> Controller:
+    """``cache=True`` (the default) runs the scheduler on an indexed
+    ``ClusterCache`` — one initial list per kind, then incremental
+    watch maintenance. ``cache=False`` keeps the relist-per-pass shape
+    for A/B comparison (tools/sched_bench.py's "seed" arm)."""
+    cluster_cache = ClusterCache(client).connect() if cache else None
     rec = GangScheduler(queue=queue, registry=registry,
                         record_events=record_events, clock=clock,
-                        jitter=jitter)
+                        jitter=jitter, cache=cluster_cache)
     ctl = Controller("gang-scheduler", client, rec, registry=registry)
+    if cluster_cache is not None:
+        ctl.uses(cluster_cache)
     ctl.maps("v1", "Pod", _pod_mapper(rec, client))
     ctl.maps("v1", "Node", _node_mapper(rec))
     return ctl

@@ -1,0 +1,662 @@
+"""ClusterCache + capacity index + FakeCluster list-index (ISSUE 7).
+
+The fleet-scale contract: the informer-style cache must be
+indistinguishable from a fresh relist — after any sequence of cluster
+mutations, watch drops (ChaosWatchStream), 410-expired resumes, and
+out-of-order deliveries — while serving every hot-path read from its
+incremental indexes; and the bisect best-fit over sorted free-capacity
+buckets must place exactly like the old full scan.
+"""
+
+import random
+
+import pytest
+
+from kubeflow_tpu.control.cache import NODE, POD, ClusterCache
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.chaos import ChaosClient, ChaosPolicy
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.scheduler import capacity as CP
+from kubeflow_tpu.control.scheduler import nodes as N
+from kubeflow_tpu.control.scheduler import (
+    GATE_GANG, SCHEDULER_NAME,
+)
+
+# -- helpers -----------------------------------------------------------------
+
+
+def mk_pod(name, namespace="default", job=None, node=None, chips=2,
+           phase=None, selector=None, gates=False):
+    pod = ob.new_object(
+        "v1", "Pod", name, namespace,
+        labels={JT.LABEL_JOB_NAME: job} if job else None)
+    pod["spec"] = {
+        "schedulerName": SCHEDULER_NAME,
+        "containers": [{"name": "jax", "resources": {
+            "limits": {JT.RESOURCE_TPU: chips}}}],
+    }
+    if selector:
+        pod["spec"]["nodeSelector"] = selector
+    if node:
+        pod["spec"]["nodeName"] = node
+    if gates:
+        pod["spec"]["schedulingGates"] = [{"name": GATE_GANG}]
+    if phase:
+        pod["status"] = {"phase": phase}
+    return pod
+
+
+def recomputed_free(cluster) -> dict:
+    free = {}
+    for n in cluster.list("v1", "Node"):
+        v = N.node_view(n)
+        free[v.name] = v.allocatable_chips
+    for p in cluster.list("v1", "Pod"):
+        node = (p.get("spec") or {}).get("nodeName")
+        if not node or node not in free:
+            continue
+        if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+            continue
+        free[node] -= N.pod_tpu_request(p)
+    return free
+
+
+def assert_cache_equals_relist(cache: ClusterCache, cluster: FakeCluster):
+    """THE property: the cache's snapshot — raw objects, free-chip
+    accounting, and the sorted buckets — must equal a fresh relist."""
+    for api, kind in (NODE, POD):
+        want = {
+            (ob.meta(o).get("namespace") or "", ob.meta(o)["name"]):
+                ob.meta(o)["resourceVersion"]
+            for o in cluster.list(api, kind)}
+        got = {k: ob.meta(o)["resourceVersion"]
+               for k, o in cache.objects(api, kind).items()}
+        assert got == want, f"{kind} snapshot diverged from relist"
+    cap = cache.capacity()
+    want_free = recomputed_free(cluster)
+    assert cap.free == want_free, "free-chip accounting diverged"
+    # bucket integrity: the catch-all bucket is exactly {(free, name)}
+    flat = dict((name, free) for free, name in cap.buckets[CP.ALL_NODES].items)
+    assert flat == want_free, "sorted bucket diverged from free map"
+    assert cap.buckets[CP.ALL_NODES].items == \
+        sorted(cap.buckets[CP.ALL_NODES].items), "bucket lost sort order"
+    spot = {name for name, v in cap.views.items() if v.spot}
+    assert {n for _f, n in cap.buckets[CP.ALL_NODES].spot} == spot
+
+
+# -- FakeCluster list index (satellite) --------------------------------------
+
+
+class TestFakeClusterListIndex:
+    def _mixed_store(self):
+        c = FakeCluster()
+        for i in range(40):
+            c.create(ob.new_object("v1", "ConfigMap", f"cm-{i}", "ns"))
+        for i in range(10):
+            c.create(mk_pod(f"p-{i}", "ns", job="g1"))
+        for i in range(5):
+            c.create(mk_pod(f"q-{i}", "other", job="g2"))
+        c.create(N.new_tpu_node("n0"))
+        return c
+
+    def test_list_scans_only_the_matching_kind_bucket(self):
+        c = self._mixed_store()
+        c.reset_stats()
+        pods = c.list("v1", "Pod")
+        assert len(pods) == 15
+        # op-count pin: 56 objects live, only the 15 pods were scanned
+        assert c.stats["list_scanned"] == 15
+        assert c.stats["list_copied"] == 15
+
+    def test_namespaced_list_scans_only_that_namespace(self):
+        c = self._mixed_store()
+        c.reset_stats()
+        pods = c.list("v1", "Pod", namespace="other")
+        assert len(pods) == 5
+        assert c.stats["list_scanned"] == 5
+
+    def test_label_selector_scans_bucket_copies_matches_only(self):
+        c = self._mixed_store()
+        c.reset_stats()
+        pods = c.list("v1", "Pod", namespace="ns",
+                      label_selector={"matchLabels": {
+                          JT.LABEL_JOB_NAME: "g1"}})
+        assert len(pods) == 10
+        assert c.stats["list_scanned"] == 10
+        assert c.stats["list_copied"] == 10
+
+    def test_list_snapshot_copies_nothing(self):
+        c = self._mixed_store()
+        c.reset_stats()
+        items, rv = c.list_snapshot("v1", "Pod")
+        assert len(items) == 15
+        assert rv == c.current_rv
+        assert c.stats["list_copied"] == 0
+        # same content as the copying path, same order
+        assert [ob.meta(o)["name"] for o in items] == \
+            [ob.meta(o)["name"] for o in c.list("v1", "Pod")]
+
+    def test_index_tracks_update_and_delete(self):
+        c = self._mixed_store()
+        got = c.get("v1", "Pod", "p-0", "ns")
+        got["spec"]["nodeName"] = "n0"
+        c.update(got)
+        assert any(p["spec"].get("nodeName") == "n0"
+                   for p in c.list("v1", "Pod", namespace="ns"))
+        c.delete("v1", "Pod", "p-0", "ns")
+        assert len(c.list("v1", "Pod", namespace="ns")) == 9
+        c.reset_stats()
+        c.list("v1", "Pod", namespace="ns")
+        assert c.stats["list_scanned"] == 9
+
+    def test_stats_paused_suspends_counting(self):
+        c = self._mixed_store()
+        c.reset_stats()
+        with c.stats_paused():
+            c.list("v1", "Pod")
+        assert c.stats["list_scanned"] == 0
+
+
+# -- ClusterCache incremental maintenance ------------------------------------
+
+
+class TestClusterCacheIncremental:
+    def test_initial_sync_equals_relist(self):
+        cluster = FakeCluster()
+        cluster.create(N.new_tpu_node("n0"))
+        cluster.create(mk_pod("p0", job="g", node="n0"))
+        cache = ClusterCache(cluster).connect()
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_incremental_bind_terminal_delete(self):
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cluster.create(N.new_tpu_node("n0"))           # 4 chips
+        cluster.create(N.new_tpu_node("n1", spot=True))
+        cluster.create(mk_pod("p0", job="g", chips=2, gates=True))
+        cache.refresh()
+        assert_cache_equals_relist(cache, cluster)
+        assert cache.capacity().free == {"n0": 4, "n1": 4}
+        # bind
+        cluster.patch("v1", "Pod", "p0", {"spec": {"nodeName": "n0"}},
+                      "default")
+        cache.refresh()
+        assert cache.capacity().free == {"n0": 2, "n1": 4}
+        assert [ob.meta(p)["name"] for p in cache.pods_on_node("n0")] == \
+            ["p0"]
+        # terminal phase releases the chips
+        cur = cluster.get("v1", "Pod", "p0", "default")
+        cur.setdefault("status", {})["phase"] = "Succeeded"
+        cluster.update_status(cur)
+        cache.refresh()
+        assert cache.capacity().free == {"n0": 4, "n1": 4}
+        assert cache.pods_on_node("n0") == []
+        # delete drops the object entirely
+        cluster.delete("v1", "Pod", "p0", "default")
+        cache.refresh()
+        assert_cache_equals_relist(cache, cluster)
+        assert cache.gang_pods("default", "g") == []
+
+    def test_gang_index_and_ordering(self):
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        for i in (2, 0, 1):
+            cluster.create(mk_pod(f"w-{i}", job="train", gates=True))
+        cluster.create(mk_pod("other", job="noise", gates=True))
+        cache.refresh()
+        assert [ob.meta(p)["name"]
+                for p in cache.gang_pods("default", "train")] == \
+            ["w-0", "w-1", "w-2"]
+        assert cache.gang_pods("default", "missing") == []
+
+    def test_unhealthy_bound_nodes_short_circuit_surface(self):
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cluster.create(N.new_tpu_node("n0"))
+        cluster.create(N.new_tpu_node("n1"))
+        cluster.create(mk_pod("p0", job="g", node="n0"))
+        cluster.create(mk_pod("p1", job="g", node="n1"))
+        cache.refresh()
+        assert cache.unhealthy_bound_nodes() == {}   # all Ready: O(1)-ish
+        # NotReady under a bound pod
+        node = cluster.get("v1", "Node", "n0")
+        node["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+        cluster.update_status(node)
+        # deleted under a bound pod
+        cluster.delete("v1", "Node", "n1")
+        cache.refresh()
+        assert cache.unhealthy_bound_nodes() == \
+            {"n0": "NotReady", "n1": "deleted"}
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_note_write_gives_read_your_writes(self):
+        """The assume-cache path: a bind response folded in via
+        note_write is visible BEFORE any watch event is drained (the
+        real-apiserver case where the watch is asynchronous)."""
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cluster.create(N.new_tpu_node("n0"))
+        cluster.create(mk_pod("p0", job="g", gates=True))
+        cache.refresh()
+        resp = cluster.patch("v1", "Pod", "p0",
+                             {"spec": {"nodeName": "n0"}}, "default")
+        cache.note_write(resp)  # NO refresh
+        assert cache.capacity().free == {"n0": 2}
+        # the watch's later delivery of the same rv is a no-op
+        before = cache.stats()["stale_events"]
+        cache.refresh()
+        assert cache.capacity().free == {"n0": 2}
+        assert cache.stats()["stale_events"] > before
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_graceful_delete_under_aliasing_snapshot_applies(self):
+        """list_snapshot hands the cache STORE references; the fake must
+        therefore replace-not-mutate on every rv bump (graceful delete,
+        GC ref pruning), or the aliased object's rv advances in place
+        and the follow-up MODIFIED event is dropped as a replay."""
+        cluster = FakeCluster()
+        pod = mk_pod("p0", job="g", node=None)
+        ob.meta(pod)["finalizers"] = ["example.com/hold"]
+        cluster.create(pod)
+        cache = ClusterCache(cluster).connect()  # aliases the stored pod
+        stale_before = cache.stats()["stale_events"]
+        cluster.delete("v1", "Pod", "p0", "default")  # graceful: marks only
+        cache.refresh()
+        # the deletionTimestamp MODIFIED was a REAL change, not a replay
+        assert cache.stats()["stale_events"] == stale_before
+        cached = cache.objects("v1", "Pod")[("default", "p0")]
+        assert ob.meta(cached).get("deletionTimestamp")
+        assert_cache_equals_relist(cache, cluster)
+        cluster.remove_finalizer(  # updates AND reaps (no finalizers left)
+            cluster.get("v1", "Pod", "p0", "default"), "example.com/hold")
+        cache.refresh()
+        assert cache.objects("v1", "Pod") == {}
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_out_of_order_delivery_is_rv_guarded(self):
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cluster.create(mk_pod("p0", job="g"))
+        v1 = cluster.patch("v1", "Pod", "p0",
+                           {"metadata": {"annotations": {"step": "1"}}},
+                           "default")
+        v2 = cluster.patch("v1", "Pod", "p0",
+                           {"metadata": {"annotations": {"step": "2"}}},
+                           "default")
+        cache.note_write(v2)
+        cache.note_write(v1)  # stale: must NOT roll back
+        pods = cache.gang_pods("default", "g")
+        assert ob.annotations_of(pods[0])["step"] == "2"
+        cache.refresh()
+        assert_cache_equals_relist(cache, cluster)
+
+
+# -- chaos: watch drops, 410 relists, random churn ---------------------------
+
+
+class TestClusterCacheUnderChaos:
+    def _churn(self, rng, cluster, chaos, live_pods, live_nodes, step):
+        """One seeded mutation against the cluster."""
+        roll = rng.random()
+        if roll < 0.18 or not live_nodes:
+            name = f"cn-{step}"
+            cluster.create(N.new_tpu_node(
+                name, topology=rng.choice(["2x4", "4x4"]),
+                spot=rng.random() < 0.3))
+            live_nodes.append(name)
+        elif roll < 0.30:
+            name = f"cp-{step}"
+            cluster.create(mk_pod(name, job=f"g{step % 5}",
+                                  chips=rng.choice([1, 2, 4]), gates=True))
+            live_pods.append(name)
+        elif roll < 0.50 and live_pods:
+            name = rng.choice(live_pods)
+            cluster.patch("v1", "Pod", name,
+                          {"spec": {"nodeName": rng.choice(live_nodes)}},
+                          "default")
+        elif roll < 0.65 and live_pods:
+            name = rng.choice(live_pods)
+            cur = cluster.get("v1", "Pod", name, "default")
+            cur.setdefault("status", {})["phase"] = \
+                rng.choice(["Running", "Succeeded", "Failed"])
+            cluster.update_status(cur)
+        elif roll < 0.75 and live_pods:
+            name = live_pods.pop(rng.randrange(len(live_pods)))
+            cluster.delete("v1", "Pod", name, "default")
+        elif roll < 0.85 and live_nodes:
+            chaos.fail_node(rng.choice(live_nodes))
+        elif roll < 0.92 and live_nodes:
+            chaos.heal_node(rng.choice(live_nodes))
+        elif len(live_nodes) > 1:
+            name = live_nodes.pop(rng.randrange(len(live_nodes)))
+            chaos.delete_node(name)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_snapshot_equals_relist_across_watch_drops_and_410(self, seed):
+        """Property-style: random churn against a TINY watch history
+        (every resume overflows it -> 410 Expired -> relist) behind a
+        ChaosWatchStream that tears the stream down every few events;
+        at every checkpoint the cache must equal a fresh relist."""
+        cluster = FakeCluster(history_limit=8)
+        chaos = ChaosClient(cluster, ChaosPolicy(seed=seed, rate=0.0,
+                                                 watch_drop_every=4))
+        cache = ClusterCache(chaos).connect()
+        rng = random.Random(seed)
+        live_pods, live_nodes = [], []
+        for step in range(120):
+            self._churn(rng, cluster, chaos, live_pods, live_nodes, step)
+            if step % 10 == 9:
+                cache.refresh()
+                assert_cache_equals_relist(cache, cluster)
+        cache.refresh()
+        assert_cache_equals_relist(cache, cluster)
+        # the chaos stream really did drop (the test is non-vacuous)
+        assert cache.stats()["events"] > 0
+
+    def test_own_resubscribe_handles_410_with_truncated_history(self):
+        """The cache's OWN resume path (a stream that died, not a
+        ChaosWatchStream drop): with the resume rv fallen out of the
+        watch cache, resubscribe must 410 -> relist -> consistent."""
+        cluster = FakeCluster(history_limit=4)
+        cache = ClusterCache(cluster).connect()
+        cluster.create(N.new_tpu_node("n0"))
+        cache.refresh()
+        sub = next(s for s in cache._subs if s.kind == "Pod")
+        sub.stream.stop()  # the stream dies silently
+        for i in range(12):  # history (4) overflows: resume must 410
+            cluster.create(mk_pod(f"p-{i}", job="g", gates=True))
+        relists_before = cache.stats()["relists"]
+        cache._resubscribe(sub)
+        cache.refresh()
+        assert cache.stats()["relists"] > relists_before
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_relist_failure_keeps_serving_and_retries(self):
+        """A chaotic apiserver failing the relist must not break the
+        cache: it serves the last snapshot, marks the kind dirty, and
+        the next refresh retries to consistency."""
+        cluster = FakeCluster()
+        cluster.create(N.new_tpu_node("n0"))
+        cache = ClusterCache(cluster).connect()
+
+        calls = {"n": 0}
+        orig = cluster.list_snapshot
+
+        def failing(api, kind, *a, **kw):
+            calls["n"] += 1
+            raise ob.ApiError("chaos: relist refused")
+
+        cluster.list_snapshot = failing
+        try:
+            sub = next(s for s in cache._subs if s.kind == "Node")
+            assert cache._try_relist(sub) is False
+            assert (("v1", "Node") in cache._dirty)
+            # still serving the pre-failure snapshot
+            assert "n0" in cache.node_views()
+        finally:
+            cluster.list_snapshot = orig
+        cluster.create(N.new_tpu_node("n1"))
+        cache.refresh()  # retries the dirty kind
+        assert_cache_equals_relist(cache, cluster)
+
+
+# -- capacity: bisect best-fit equivalence -----------------------------------
+
+
+def brute_force_assign(pods, views, free, prefer_spot=False):
+    """The pre-ISSUE-7 linear-scan best-fit, verbatim semantics."""
+    remaining = dict(free)
+    out = {}
+    for pod in pods:
+        need = N.pod_tpu_request(pod)
+        candidates = [name for name in sorted(views)
+                      if remaining[name] >= need
+                      and N.feasible(pod, views[name])]
+        if prefer_spot:
+            spot = [n for n in candidates if views[n].spot]
+            candidates = spot or candidates
+        best = None
+        for name in candidates:
+            if best is None or remaining[name] < remaining[best]:
+                best = name
+        if best is None:
+            return None
+        remaining[best] -= need
+        out[ob.meta(pod)["name"]] = best
+    return out
+
+
+class TestCapacityBestFit:
+    def _world(self, rng, n_nodes):
+        views, free = {}, {}
+        for i in range(n_nodes):
+            topo = rng.choice(["2x4", "4x4", "2x2"])
+            node = N.new_tpu_node(
+                f"n{i:03d}", topology=topo,
+                chips_per_node=rng.choice([2, 4]),
+                ready=rng.random() > 0.1,
+                spot=rng.random() < 0.3)
+            v = N.node_view(node)
+            views[v.name] = v
+            free[v.name] = rng.randint(0, v.allocatable_chips)
+        return views, free
+
+    @pytest.mark.parametrize("seed", list(range(8)))
+    def test_bisect_matches_linear_scan(self, seed):
+        from kubeflow_tpu.control.scheduler.scheduler import GangScheduler
+
+        rng = random.Random(seed)
+        views, free = self._world(rng, rng.randint(3, 30))
+        topo = rng.choice(["2x4", "4x4", "2x2"])
+        sel = {JT.NODESELECTOR_ACCEL: "tpu-v5-lite-podslice",
+               JT.NODESELECTOR_TOPOLOGY: topo}
+        if rng.random() < 0.3:
+            sel = None  # un-pooled pod: the catch-all bucket path
+        pods = [mk_pod(f"w-{i}", chips=rng.choice([1, 2, 4]),
+                       selector=sel, gates=True)
+                for i in range(rng.randint(1, 6))]
+        for pod in pods:
+            if views and rng.random() < 0.5:
+                pod["spec"]["tolerations"] = [dict(N.spot_taint())]
+        prefer_spot = rng.random() < 0.5
+        want = brute_force_assign(pods, views, free, prefer_spot)
+        cap = CP.Capacity.from_views(views, free)
+        got = GangScheduler._assign(pods, cap, prefer_spot=prefer_spot)
+        assert got == want, f"seed {seed}: bisect diverged from scan"
+
+    def test_txn_fork_isolation_and_credit(self):
+        views = {v.name: v for v in
+                 (N.node_view(N.new_tpu_node(n)) for n in ("a", "b"))}
+        free = {"a": 2, "b": 4}
+        cap = CP.Capacity.from_views(views, free)
+        base = cap.txn()
+        base.credit("a", 2)           # a preemption what-if credit
+        trial = base.fork()
+        trial.take("a", 4)
+        assert trial.free_of("a") == 0
+        assert base.free_of("a") == 4     # fork never leaks into base
+        assert cap.free["a"] == 2         # snapshot untouched
+        trial2 = base.fork()
+        assert trial2.free_of("a") == 4
+
+    def test_scanned_counter_counts_walked_nodes(self):
+        views = {v.name: v for v in
+                 (N.node_view(N.new_tpu_node(n, ready=(n != "a")))
+                  for n in ("a", "b"))}
+        cap = CP.Capacity.from_views(views, {"a": 4, "b": 4})
+        txn = cap.txn()
+        pod = mk_pod("w", gates=True)
+        assert txn.best_fit(pod, 4) == "b"   # walks over unready "a"
+        assert cap.scanned == 2
+
+
+# -- hot-path metrics render in BOTH sinks -----------------------------------
+
+
+class TestHotPathMetrics:
+    def test_pass_metrics_in_both_sinks(self):
+        """ISSUE 7 satellite: scheduler_pass_seconds (native histogram)
+        + scheduler_nodes_scanned_total + the cache hit-rate counters
+        render in the MetricsRegistry sink AND the Prometheus sink."""
+        import prometheus_client as prom
+
+        from kubeflow_tpu.control.runtime import seed_controller
+        from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+        from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+        class Clock:
+            t = 0.0
+
+            def __call__(self):
+                return self.t
+
+        registry = MetricsRegistry()
+        cluster = FakeCluster()
+        ctl = seed_controller(build_scheduler(
+            cluster, registry=registry, record_events=False, clock=Clock()))
+        cluster.create(N.new_tpu_node("n0"))
+        for i in range(2):
+            pod = mk_pod(f"g-worker-{i}", job="g", chips=2, gates=True)
+            ob.set_annotation(pod, "scheduler.kubeflow.org/gang-size", "2")
+            cluster.create(pod)
+        before_pass = prom.REGISTRY.get_sample_value(
+            "scheduler_pass_seconds_count") or 0.0
+        ctl.run_until_idle(advance_delayed=True)
+        text = registry.render()
+        assert "# TYPE scheduler_pass_seconds histogram" in text
+        assert "scheduler_pass_seconds_count" in text
+        assert "scheduler_nodes_scanned_total" in text
+        assert 'scheduler_cache_reads_total{source="cache"}' in text
+        assert "cluster_cache_events_total" in text
+        # and the Prometheus sink saw the same pass
+        after_pass = prom.REGISTRY.get_sample_value(
+            "scheduler_pass_seconds_count")
+        assert after_pass > before_pass
+        assert (prom.REGISTRY.get_sample_value(
+            "scheduler_nodes_scanned_total") or 0.0) > 0
+        assert (prom.REGISTRY.get_sample_value(
+            "scheduler_cache_reads_total",
+            {"source": "cache"}) or 0.0) > 0
+        # the gang really bound (the pass did the work being measured)
+        assert all(p["spec"].get("nodeName") == "n0"
+                   for p in cluster.list("v1", "Pod"))
+
+    def test_legacy_mode_reports_list_source(self):
+        from kubeflow_tpu.control.runtime import seed_controller
+        from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+        from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+        registry = MetricsRegistry()
+        cluster = FakeCluster()
+        ctl = seed_controller(build_scheduler(
+            cluster, registry=registry, record_events=False, cache=False))
+        cluster.create(N.new_tpu_node("n0"))
+        pod = mk_pod("solo-worker-0", job="solo", chips=2, gates=True)
+        ob.set_annotation(pod, "scheduler.kubeflow.org/gang-size", "1")
+        cluster.create(pod)
+        ctl.run_until_idle(advance_delayed=True)
+        text = registry.render()
+        assert 'scheduler_cache_reads_total{source="list"}' in text
+        assert "cluster_cache_" not in text  # no cache, no cache stats
+
+
+# -- pumped-mode races: the snapshot may trail the triggering event ----------
+
+
+class TestPumpedModeRaces:
+    def test_pumped_stale_sync_keeps_gang_queued(self):
+        """In production (pumped) mode refresh() cannot drain the
+        pump-owned streams, so a reconcile can read a snapshot that
+        predates the pod event that triggered it. 'No pending pods'
+        must then be CONFIRMED against the apiserver before the gang is
+        dropped from the queue — gated Pending pods emit no further
+        events, so a wrong drop is a permanent stall."""
+        from kubeflow_tpu.control.runtime import Request
+        from kubeflow_tpu.control.scheduler.scheduler import build_scheduler
+
+        cluster = FakeCluster()
+        ctl = build_scheduler(cluster, record_events=False)
+        rec = ctl.reconciler
+        rec.cache._threads = ["pump"]  # production mode: no poll-drain
+        cluster.create(N.new_tpu_node("n0"))
+        for i in range(2):
+            pod = mk_pod(f"g-worker-{i}", job="g", chips=2, gates=True)
+            ob.set_annotation(
+                pod, "scheduler.kubeflow.org/gang-size", "2")
+            cluster.create(pod)
+        rec.reconcile(cluster, Request("default", "g"))
+        assert rec.queue.get("default", "g") is not None, \
+            "stale snapshot dropped the gang from the queue"
+        # the pump catches up: the still-queued gang admits normally
+        rec.cache._threads = []
+        rec.cache.refresh()
+        rec.queue.kick()
+        rec.reconcile(cluster, Request("default", "g"))
+        assert all(p["spec"].get("nodeName") == "n0"
+                   for p in cluster.list("v1", "Pod", namespace="default"))
+
+    def test_legacy_health_pass_survives_api_error(self):
+        """The legacy short-circuit must not commit its node-set memory
+        until the eviction loop lands: an ApiError mid-pass would
+        otherwise consume the vanished-node signal and the dead node's
+        gang pods would never be evicted."""
+        from kubeflow_tpu.control.scheduler.scheduler import (
+            RETRY_ALL, build_scheduler,
+        )
+
+        cluster = FakeCluster()
+        ctl = build_scheduler(cluster, record_events=False, cache=False)
+        rec = ctl.reconciler
+        cluster.create(N.new_tpu_node("n0"))
+        cluster.create(N.new_tpu_node("n1"))
+        cluster.create(mk_pod("w-0", job="g", node="n0", chips=2))
+        rec.reconcile(cluster, RETRY_ALL)      # seeds _known_nodes
+        cluster.delete("v1", "Node", "n0")
+        real_list = cluster.list
+        calls = {"pod_lists": 0}
+
+        def flaky_list(api, kind, **kw):
+            if kind == "Pod" and calls["pod_lists"] == 0:
+                calls["pod_lists"] += 1
+                raise ob.ApiError("transient 500 mid health pass")
+            return real_list(api, kind, **kw)
+
+        cluster.list = flaky_list
+        with pytest.raises(ob.ApiError):
+            rec.reconcile(cluster, RETRY_ALL)  # blows up after node list
+        cluster.list = real_list
+        rec.reconcile(cluster, RETRY_ALL)      # retry must still see it
+        p = cluster.get("v1", "Pod", "w-0", "default")
+        assert (p.get("status") or {}).get("phase") == "Failed"
+        assert (p.get("status") or {}).get("reason") == "Evicted"
+
+    def test_note_write_cannot_resurrect_deleted_pod(self):
+        """A write response noted AFTER the watch applied the object's
+        DELETED (reconcile thread vs pump thread) must not re-insert
+        the dead pod — the tombstone catches what the cached-old rv
+        guard cannot. A genuine recreation (higher rv) passes."""
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cluster.create(N.new_tpu_node("n0"))
+        cluster.create(mk_pod("p0", job="g", gates=True))
+        cache.refresh()
+        resp = cluster.patch("v1", "Pod", "p0",
+                             {"spec": {"nodeName": "n0"}}, "default")
+        cluster.delete("v1", "Pod", "p0", "default")
+        cache.refresh()          # the DELETED is applied first...
+        stale_before = cache.stats()["stale_events"]
+        cache.note_write(resp)   # ...then the older write response lands
+        assert cache.stats()["stale_events"] > stale_before
+        assert cache.objects("v1", "Pod") == {}
+        assert cache.gang_pods("default", "g") == []
+        assert cache.pods_on_node("n0") == []
+        assert cache.capacity().free == {"n0": 4}
+        assert_cache_equals_relist(cache, cluster)
+        # recreation under the same name: globally monotonic rvs beat
+        # the tombstone, the assume-note works again
+        cluster.create(mk_pod("p0", job="g", gates=True))
+        cache.note_write(cluster.get("v1", "Pod", "p0", "default"))
+        assert ("default", "p0") in cache.objects("v1", "Pod")
+        cache.refresh()
+        assert_cache_equals_relist(cache, cluster)

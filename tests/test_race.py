@@ -43,6 +43,7 @@ def _static_lockset_map():
         "kubeflow_tpu" / "control"
     return static_guarded_map([str(control / "runtime.py"),
                                str(control / "leases.py"),
+                               str(control / "cache.py"),
                                str(control / "scheduler" / "queue.py")])
 
 
@@ -56,6 +57,7 @@ def _dyntrace_tier():
         yield
         return
     from kubeflow_tpu.analysis.dyntrace import Tracer
+    from kubeflow_tpu.control.cache import ClusterCache
     from kubeflow_tpu.control.leases import LeaderElector
     from kubeflow_tpu.control.runtime import Controller
     from kubeflow_tpu.control.scheduler.queue import GangQueue
@@ -64,6 +66,7 @@ def _dyntrace_tier():
     tr.instrument(Controller)
     tr.instrument(LeaderElector)
     tr.instrument(GangQueue)
+    tr.instrument(ClusterCache)
     _TRACER = tr
     try:
         with tr:
@@ -191,6 +194,105 @@ def test_gang_queue_concurrent_offer_requeue_remove():
                 for i in range(RACE_ITERS) if i % 2 != 0}
     assert {e.name: e.priority for e in entries} == expected
     assert all(e.attempts == 1 for e in entries)
+
+
+def test_cluster_cache_concurrent_readers_during_churn():
+    """The ISSUE 7 cache under thread fire: writer threads churn
+    pods/nodes through the apiserver while reader threads hammer the
+    cache's snapshot methods and ONE consumer thread refreshes (the
+    documented single-writer discipline: event application happens only
+    inside refresh()/note_write(), reads are lock-guarded snapshots).
+    After quiescing, one final refresh must equal a fresh relist.
+    Under TPU_RACE_TRACE=1 the module fixture instruments ClusterCache,
+    so this churn also feeds the happens-before validator's
+    static/dynamic lockset diff."""
+    from kubeflow_tpu.control.cache import ClusterCache
+    from kubeflow_tpu.control.jaxjob import types as JT
+    from kubeflow_tpu.control.scheduler.nodes import new_tpu_node
+
+    # static pin: LOCK201's map must prove the cache state is guarded
+    static = _static_lockset_map()
+    assert static["ClusterCache"]["_objects"] == {"_lock"}
+    assert static["ClusterCache"]["_free"] == {"_lock"}
+    assert static["ClusterCache"]["_buckets"] == {"_lock"}
+
+    cluster = FakeCluster()
+    for i in range(4):
+        cluster.create(new_tpu_node(f"n{i}"))
+    cache = ClusterCache(cluster).connect()
+    errors: list[Exception] = []
+    stop = threading.Event()
+
+    def writer(wid: int):
+        try:
+            for i in range(RACE_ITERS):
+                name = f"rp-{wid}-{i}"
+                pod = ob.new_object(
+                    "v1", "Pod", name, "default",
+                    labels={JT.LABEL_JOB_NAME: f"gang-{wid}"})
+                pod["spec"] = {"containers": [{"name": "jax"}]}
+                cluster.create(pod)
+                cluster.patch("v1", "Pod", name,
+                              {"spec": {"nodeName": f"n{i % 4}"}},
+                              "default")
+                if i % 3 == 0:
+                    cluster.delete("v1", "Pod", name, "default")
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    def refresher():
+        try:
+            while not stop.is_set():
+                cache.refresh()
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    def reader():
+        try:
+            while not stop.is_set():
+                cache.capacity()
+                cache.node_views()
+                cache.unhealthy_bound_nodes()
+                cache.gang_pods("default", "gang-0")
+                cache.bound_pods()
+        except Exception as e:  # pragma: no cover - failure path
+            errors.append(e)
+
+    writers = [threading.Thread(target=writer, args=(w,))
+               for w in range(max(2, RACE_THREADS // 2))]
+    aux = [threading.Thread(target=refresher, daemon=True)] + \
+          [threading.Thread(target=reader, daemon=True)
+           for _ in range(max(2, RACE_THREADS // 2))]
+    for t in aux:
+        t.start()
+    for t in writers:
+        t.start()
+    for t in writers:
+        t.join()
+    stop.set()
+    for t in aux:
+        t.join(timeout=10)
+    assert not errors, errors
+    cache.refresh()
+    # the final snapshot equals a fresh relist: same keys, same rvs,
+    # and free-chip accounting recomputed from scratch agrees
+    want = {(ob.meta(o).get("namespace") or "", ob.meta(o)["name"]):
+            ob.meta(o)["resourceVersion"]
+            for o in cluster.list("v1", "Pod")}
+    got = {k: ob.meta(o)["resourceVersion"]
+           for k, o in cache.objects("v1", "Pod").items()}
+    assert got == want
+    from kubeflow_tpu.control.scheduler.nodes import (
+        TERMINAL_PHASES, node_view, pod_tpu_request,
+    )
+    free = {node_view(n).name: node_view(n).allocatable_chips
+            for n in cluster.list("v1", "Node")}
+    for p in cluster.list("v1", "Pod"):
+        node = (p.get("spec") or {}).get("nodeName")
+        if node in free and (p.get("status") or {}).get("phase") \
+                not in TERMINAL_PHASES:
+            free[node] -= pod_tpu_request(p)
+    assert cache.capacity().free == free
 
 
 def test_controller_threaded_mode_against_churn():

@@ -1,0 +1,116 @@
+"""Fleet-scale control-plane smoke (ISSUE 7 acceptance, tier-1 sized).
+
+Runs the deterministic synthetic-fleet benchmark (tools/sched_bench.py)
+at ~200 nodes and asserts the budgets that must not regress:
+
+- op budget (deterministic): the cached scheduler performs ZERO
+  per-pass FakeCluster list scans — every hot-path read is served by
+  the ClusterCache indexes; the legacy arm's scans stay nonzero, so
+  the >= 10x reduction holds by construction at any scale;
+- semantic budget: the cache and legacy arms produce byte-identical
+  final bindings (no drift from the indexed rewrite), and neither arm
+  ever oversubscribes a node or leaves a bound-but-gated pod;
+- latency budget: pass p99 under a deliberately generous wall-clock
+  ceiling (the sharp number lives in BENCH_SCHED_r01.json, gated by
+  ``sched_bench.py --check`` at 25%).
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+# generous CI ceiling; the banked budget (BENCH_SCHED_r01.json smoke
+# section) is the sharp one
+PASS_P99_CEILING_MS = 250.0
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "sched_bench", TOOLS / "sched_bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("sched_bench", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load_bench()
+
+
+@pytest.fixture(scope="module")
+def smoke_pair(bench):
+    """One cache/legacy pair at the banked smoke config, shared by the
+    budget and equivalence assertions (the runs are deterministic)."""
+    config = dict(bench.SMOKE_CONFIG)
+    cache = bench.run_bench(cache=True, **config)
+    legacy = bench.run_bench(cache=False, **config)
+    return bench, config, cache, legacy
+
+
+class TestScaleSmoke:
+    def test_cache_arm_makes_zero_per_pass_list_scans(self, smoke_pair):
+        _bench, _config, cache, legacy = smoke_pair
+        assert cache["passes"] > 100          # the run did real work
+        assert cache["ops"]["list_calls"] == 0
+        assert cache["ops"]["list_scanned"] == 0
+        assert cache["ops"]["list_copied"] == 0
+        # legacy still relists per pass: the >= 10x scan reduction of
+        # the acceptance criteria is structural, pinned here exactly
+        assert legacy["scan_per_pass"] > 10 * max(cache["scan_per_pass"],
+                                                  1.0)
+
+    def test_admission_results_identical_across_arms(self, smoke_pair):
+        _bench, _config, cache, legacy = smoke_pair
+        assert cache["bindings"] == legacy["bindings"]
+        assert cache["admitted_gangs"] == legacy["admitted_gangs"]
+        assert cache["admitted_gangs"] >= 40   # of 50: the fleet filled
+
+    def test_pass_p99_within_ceiling(self, smoke_pair):
+        _bench, _config, cache, _legacy = smoke_pair
+        assert 0.0 < cache["pass_p99_ms"] < PASS_P99_CEILING_MS
+
+    def test_banked_budget_gate(self, bench, tmp_path):
+        """--check fails loudly (exit 1) when the committed budget
+        regresses by > 25%, passes when it holds."""
+        config = {"nodes": 80, "gangs": 12, "pods": 100, "seed": 0,
+                  "waves": 3}
+        now = bench.run_bench(cache=True, **config)
+        banked = {
+            "smoke": {
+                "config": config,
+                "cache": {"scan_per_pass": now["scan_per_pass"],
+                          "pass_p99_ms": now["pass_p99_ms"]},
+            }
+        }
+        ok_path = tmp_path / "bank_ok.json"
+        ok_path.write_text(json.dumps(banked))
+        assert bench.check_against(str(ok_path)) == 0
+        # a banked budget 100x tighter than reality: must regress
+        banked["smoke"]["cache"] = {
+            "scan_per_pass": -1.0,
+            "pass_p99_ms": now["pass_p99_ms"] / 100.0}
+        bad_path = tmp_path / "bank_bad.json"
+        bad_path.write_text(json.dumps(banked))
+        assert bench.check_against(str(bad_path)) == 1
+
+    def test_committed_bank_exists_and_meets_acceptance(self):
+        """BENCH_SCHED_r01.json is committed with the 5k-node numbers
+        and the acceptance ratios: >= 10x list-scan reduction, >= 5x
+        p99 pass duration, identical bindings across arms."""
+        path = TOOLS.parent / "BENCH_SCHED_r01.json"
+        banked = json.loads(path.read_text())
+        full = banked["full"]
+        assert full["config"]["nodes"] == 5000
+        assert full["config"]["gangs"] == 1000
+        assert full["config"]["pods"] == 10000
+        cmp_ = full["comparison"]
+        assert cmp_["bindings_identical"] is True
+        assert cmp_["scan_reduction_x"] >= 10
+        assert cmp_["p99_speedup_x"] >= 5
+        assert banked["smoke"]["cache"]["pass_p99_ms"] > 0

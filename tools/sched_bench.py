@@ -1,0 +1,439 @@
+#!/usr/bin/env python
+"""sched_bench — deterministic synthetic-fleet control-plane benchmark.
+
+Stands up a FakeCluster fleet (default 5k nodes / 1k gangs / 10k pods),
+drives the gang scheduler over it in creation waves with completion and
+node-health churn, and measures the control plane's raw speed: pass
+duration percentiles, admissions/sec, and FakeCluster op counts (the
+deterministic half — objects scanned per pass does not depend on the
+machine). Two arms share one seeded workload:
+
+- ``cache``  — the ISSUE 7 scheduler on the indexed ``ClusterCache``;
+- ``legacy`` — the same scheduler with ``cache=False``: every hot-path
+  read is a full relist (the pre-ISSUE-7 shape, kept in-tree exactly
+  for this A/B).
+
+Everything runs on the injectable clock (``GangQueue(clock=...)``) and
+``run_until_idle(advance_delayed=True)`` — zero wall-clock sleeps, so
+the SCHEDULING DECISIONS and op counts replay exactly per seed; only
+the duration measurements vary with the machine.
+
+    python tools/sched_bench.py                      # full + smoke, write JSON
+    python tools/sched_bench.py --nodes 200 --gangs 50 --pods 500
+    python tools/sched_bench.py --check              # CI gate: rerun the
+        # smoke config and fail if the committed BENCH_SCHED_r01.json's
+        # cache-arm budget (scan/pass, p99) regresses by > 25%
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.control.jaxjob import types as JT  # noqa: E402
+from kubeflow_tpu.control.k8s import objects as ob  # noqa: E402
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.control.runtime import seed_controller  # noqa: E402
+from kubeflow_tpu.control.scheduler import (  # noqa: E402
+    ANNOTATION_ELASTIC_MIN, ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY,
+    GATE_GANG, SCHEDULER_NAME,
+)
+from kubeflow_tpu.control.scheduler import nodes as N  # noqa: E402
+from kubeflow_tpu.control.scheduler.scheduler import (  # noqa: E402
+    build_scheduler,
+)
+from kubeflow_tpu.runtime.metrics import MetricsRegistry  # noqa: E402
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_SCHED_r01.json")
+
+# The fleet's TPU pools: (accelerator, topology, weight). Node counts
+# and gang pool picks follow the weights, so pools are contended
+# unevenly — some gangs must queue, requeue and back off.
+POOLS = (
+    ("tpu-v5-lite-podslice", "2x4", 4),
+    ("tpu-v5-lite-podslice", "4x4", 3),
+    ("tpu-v5p-slice", "2x2", 2),
+    ("tpu-v6e-slice", "2x4", 1),
+)
+SPOT_FRACTION = 0.15   # of pool 0, rounded down
+TENANTS = 8
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _pool_of(i: int, total: int) -> tuple[str, str]:
+    wsum = sum(w for _, _, w in POOLS)
+    acc = 0
+    for accel, topo, w in POOLS:
+        acc += w
+        if i * wsum < total * acc:
+            return accel, topo
+    return POOLS[-1][0], POOLS[-1][1]
+
+
+def build_fleet(cluster: FakeCluster, nodes: int) -> None:
+    spot_cut = int(nodes * POOLS[0][2] / sum(w for _, _, w in POOLS)
+                   * SPOT_FRACTION)
+    for i in range(nodes):
+        accel, topo = _pool_of(i, nodes)
+        cluster.create(N.new_tpu_node(
+            f"node-{i:05d}", accelerator=accel, topology=topo,
+            chips_per_node=4, spot=i < spot_cut))
+
+
+def gang_sizes(rng: random.Random, gangs: int, pods: int,
+               lo: int = 2, hi: int = 16) -> list[int]:
+    """``gangs`` sizes in [lo, hi] summing exactly to ``pods``."""
+    sizes = []
+    remaining = pods
+    for i in range(gangs):
+        left = gangs - i - 1
+        a = max(lo, remaining - hi * left)
+        b = min(hi, remaining - lo * left)
+        size = rng.randint(a, b) if b >= a else max(lo, min(hi, remaining))
+        sizes.append(size)
+        remaining -= size
+    return sizes
+
+
+def make_gang(cluster: FakeCluster, rng: random.Random, namespace: str,
+              name: str, size: int, chips: int, pool: tuple[str, str],
+              priority: int, elastic_min: int | None) -> None:
+    annotations = {
+        ANNOTATION_GANG_SIZE: str(size),
+        ANNOTATION_PRIORITY: str(priority),
+    }
+    if elastic_min is not None:
+        annotations[ANNOTATION_ELASTIC_MIN] = str(elastic_min)
+    for i in range(size):
+        pod = ob.new_object(
+            "v1", "Pod", f"{name}-worker-{i}", namespace,
+            labels={JT.LABEL_JOB_NAME: name},
+            annotations=dict(annotations))
+        spec = {
+            "schedulerName": SCHEDULER_NAME,
+            "schedulingGates": [{"name": GATE_GANG}],
+            "nodeSelector": {
+                JT.NODESELECTOR_ACCEL: pool[0],
+                JT.NODESELECTOR_TOPOLOGY: pool[1],
+            },
+            "containers": [{"name": "jax", "resources": {
+                "limits": {JT.RESOURCE_TPU: chips}}}],
+        }
+        if elastic_min is not None:
+            spec["tolerations"] = [dict(N.spot_taint())]
+        pod["spec"] = spec
+        cluster.create(pod)
+
+
+def drain(ctl, clock: ManualClock, rounds: int = 6) -> int:
+    done = 0
+    for _ in range(rounds):
+        n = ctl.run_until_idle(max_rounds=100000, advance_delayed=True)
+        done += n
+        clock.advance(2.0)
+        if n == 0:
+            break
+    return done
+
+
+def complete_gangs(cluster: FakeCluster, fraction: float = 0.4) -> int:
+    """Mark the name-ordered first ``fraction`` of fully-bound running
+    gangs Succeeded — frees their chips and exercises terminal-phase
+    accounting + backoff kicks, deterministically."""
+    by_gang: dict[tuple[str, str], list[dict]] = {}
+    for p in cluster.list("v1", "Pod"):
+        spec = p.get("spec") or {}
+        if spec.get("schedulerName") != SCHEDULER_NAME:
+            continue
+        job = ob.labels_of(p).get(JT.LABEL_JOB_NAME)
+        if job:
+            m = ob.meta(p)
+            by_gang.setdefault((m.get("namespace") or "", job), []).append(p)
+    runnable = sorted(
+        key for key, pods in by_gang.items()
+        if all((p["spec"].get("nodeName")
+                and (p.get("status") or {}).get("phase")
+                not in N.TERMINAL_PHASES) for p in pods))
+    ncomplete = math.ceil(len(runnable) * fraction)
+    for key in runnable[:ncomplete]:
+        for p in by_gang[key]:
+            cur = cluster.get("v1", "Pod", ob.meta(p)["name"], key[0])
+            cur.setdefault("status", {})["phase"] = "Succeeded"
+            cluster.update_status(cur)
+    return ncomplete
+
+
+def verify_invariants(cluster: FakeCluster) -> list[str]:
+    """No node may be oversubscribed, and no pod may be bound while
+    still carrying our gate — whatever the arm, however the churn."""
+    problems = []
+    alloc = {ob.meta(n)["name"]:
+             int(((n.get("status") or {}).get("allocatable") or {})
+                 .get(JT.RESOURCE_TPU) or 0)
+             for n in cluster.list("v1", "Node")}
+    used: dict[str, int] = {}
+    for p in cluster.list("v1", "Pod"):
+        spec = p.get("spec") or {}
+        node = spec.get("nodeName")
+        gated = any(g.get("name") == GATE_GANG
+                    for g in spec.get("schedulingGates") or [])
+        if node and gated:
+            problems.append(f"bound-but-gated pod {ob.meta(p)['name']}")
+        if not node:
+            continue
+        if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+            continue
+        used[node] = used.get(node, 0) + N.pod_tpu_request(p)
+    for node, n in used.items():
+        if node in alloc and n > alloc[node]:
+            problems.append(f"node {node} oversubscribed: {n}/{alloc[node]}")
+    return problems
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(math.ceil(q * len(xs))) - 1)]
+
+
+def _admitted_total(registry: MetricsRegistry) -> int:
+    total = 0
+    for line in registry.render().splitlines():
+        if line.startswith("scheduler_gangs_admitted_total{"):
+            total += int(float(line.rsplit(" ", 1)[1]))
+    return total
+
+
+def bindings_fingerprint(cluster: FakeCluster) -> dict[str, str | None]:
+    """(namespace/pod) -> node for every scheduler pod — the two arms
+    must agree exactly (no semantic drift from the indexed rewrite)."""
+    out = {}
+    for p in cluster.list("v1", "Pod"):
+        if (p.get("spec") or {}).get("schedulerName") != SCHEDULER_NAME:
+            continue
+        m = ob.meta(p)
+        out[f"{m.get('namespace')}/{m['name']}"] = p["spec"].get("nodeName")
+    return out
+
+
+def run_bench(nodes: int, gangs: int, pods: int, seed: int = 0,
+              waves: int = 10, cache: bool = True,
+              node_churn: bool = True) -> dict:
+    rng = random.Random(seed)
+    clock = ManualClock()
+    cluster = FakeCluster(history_limit=65536)
+    registry = MetricsRegistry()
+    ctl = seed_controller(build_scheduler(
+        cluster, registry=registry, record_events=False, clock=clock,
+        cache=cache))
+    rec = ctl.reconciler
+    durations: list[float] = []
+    rec.pass_observer = durations.append
+
+    build_fleet(cluster, nodes)
+    drain(ctl, clock)
+
+    sizes = gang_sizes(rng, gangs, pods)
+    specs = []
+    for i, size in enumerate(sizes):
+        pool_i = rng.randrange(len(POOLS))
+        accel, topo, _w = POOLS[pool_i]
+        elastic = None
+        if i % 10 == 0 and size >= 4:
+            elastic = max(2, size // 2)
+        specs.append({
+            "namespace": f"tenant-{i % TENANTS}",
+            "name": f"gang-{i:04d}",
+            "size": size,
+            "chips": 1 if rng.random() < 0.2 else 2,
+            "pool": (accel, topo),
+            "priority": 0 if rng.random() < 0.7 else rng.randint(1, 10),
+            "elastic_min": elastic,
+        })
+
+    cluster.reset_stats()
+    durations.clear()
+    t0 = time.perf_counter()
+    per_wave = math.ceil(len(specs) / waves)
+    for wave in range(waves):
+        for spec in specs[wave * per_wave:(wave + 1) * per_wave]:
+            make_gang(cluster, rng, **spec)
+        drain(ctl, clock)
+        if node_churn and wave % 4 == 3:
+            # a node dies under whatever it was running, then heals
+            victim = f"node-{(wave * 131) % nodes:05d}"
+            node = cluster.get("v1", "Node", victim)
+            node["status"]["conditions"] = [
+                {"type": "Ready", "status": "False"}]
+            cluster.update_status(node)
+            drain(ctl, clock)
+            node = cluster.get("v1", "Node", victim)
+            node["status"]["conditions"] = [
+                {"type": "Ready", "status": "True"}]
+            cluster.update_status(node)
+            drain(ctl, clock)
+        if wave % 2 == 1:
+            with cluster.stats_paused():
+                complete_gangs(cluster)
+            drain(ctl, clock)
+    wall = time.perf_counter() - t0
+
+    stats = dict(cluster.stats)
+    with cluster.stats_paused():
+        problems = verify_invariants(cluster)
+    if problems:
+        raise AssertionError(f"invariants violated: {problems[:5]}")
+    passes = max(len(durations), 1)
+    admitted = _admitted_total(registry)
+    return {
+        "arm": "cache" if cache else "legacy",
+        "passes": len(durations),
+        "pass_p50_ms": round(_percentile(durations, 0.50) * 1e3, 4),
+        "pass_p99_ms": round(_percentile(durations, 0.99) * 1e3, 4),
+        "pass_max_ms": round(max(durations, default=0.0) * 1e3, 4),
+        "wall_s": round(wall, 3),
+        "admitted_gangs": admitted,
+        "admissions_per_sec": round(admitted / wall, 2) if wall else 0.0,
+        "ops": {k: stats.get(k, 0)
+                for k in ("list_calls", "list_scanned", "list_copied",
+                          "get", "patch", "update", "create", "delete")},
+        "scan_per_pass": round(stats.get("list_scanned", 0) / passes, 2),
+        "copies_per_pass": round(stats.get("list_copied", 0) / passes, 2),
+        "bindings": bindings_fingerprint(cluster),
+    }
+
+
+def _strip(arm: dict) -> dict:
+    arm.pop("bindings", None)
+    return arm
+
+
+def compare(legacy: dict, cache: dict) -> dict:
+    def ratio(a, b):
+        return round(a / b, 2) if b else float("inf")
+
+    return {
+        "scan_reduction_x": ratio(legacy["scan_per_pass"],
+                                  max(cache["scan_per_pass"], 0.01)),
+        "copy_reduction_x": ratio(legacy["copies_per_pass"],
+                                  max(cache["copies_per_pass"], 0.01)),
+        "p99_speedup_x": ratio(legacy["pass_p99_ms"], cache["pass_p99_ms"]),
+        "wall_speedup_x": ratio(legacy["wall_s"], cache["wall_s"]),
+        "bindings_identical": legacy["bindings"] == cache["bindings"],
+    }
+
+
+def run_pair(config: dict) -> dict:
+    cache = run_bench(cache=True, **config)
+    legacy = run_bench(cache=False, **config)
+    cmp_ = compare(legacy, cache)
+    # the fingerprint is an equivalence check, not a result to bank
+    return {"config": config, "legacy": _strip(legacy),
+            "cache": _strip(cache), "comparison": cmp_}
+
+
+SMOKE_CONFIG = {"nodes": 200, "gangs": 50, "pods": 500, "seed": 0,
+                "waves": 5}
+
+
+def check_against(banked_path: str) -> int:
+    """CI ratchet: rerun the banked smoke config; fail (1) when the
+    cache arm's scan-per-pass or pass p99 regresses by more than 25%
+    over the committed numbers."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    smoke = banked.get("smoke")
+    if not smoke:
+        print(f"check: no smoke section in {banked_path}", file=sys.stderr)
+        return 2
+    config = dict(smoke["config"])
+    now = run_bench(cache=True, **config)
+    now.pop("bindings")
+    budget_scan = smoke["cache"]["scan_per_pass"] * 1.25
+    budget_p99 = smoke["cache"]["pass_p99_ms"] * 1.25
+    ok = True
+    if now["scan_per_pass"] > budget_scan:
+        print(f"check: scan_per_pass {now['scan_per_pass']} exceeds "
+              f"budget {budget_scan:.2f} "
+              f"(banked {smoke['cache']['scan_per_pass']})",
+              file=sys.stderr)
+        ok = False
+    if now["pass_p99_ms"] > budget_p99:
+        print(f"check: pass_p99_ms {now['pass_p99_ms']} exceeds budget "
+              f"{budget_p99:.3f} (banked {smoke['cache']['pass_p99_ms']})",
+              file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "scan_per_pass": now["scan_per_pass"],
+                      "pass_p99_ms": now["pass_p99_ms"],
+                      "budget": {"scan_per_pass": round(budget_scan, 2),
+                                 "pass_p99_ms": round(budget_p99, 3)}},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--nodes", type=int, default=5000)
+    ap.add_argument("--gangs", type=int, default=1000)
+    ap.add_argument("--pods", type=int, default=10000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--waves", type=int, default=10)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-smoke", action="store_true",
+                    help="skip the 200-node smoke section")
+    ap.add_argument("--check", action="store_true",
+                    help="rerun the banked smoke config and gate on a "
+                         ">25%% budget regression")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_against(args.out)
+
+    config = {"nodes": args.nodes, "gangs": args.gangs, "pods": args.pods,
+              "seed": args.seed, "waves": args.waves}
+    result = {
+        "bench": "sched_bench",
+        "round": "r01",
+        "full": run_pair(config),
+    }
+    if not args.no_smoke:
+        result["smoke"] = run_pair(dict(SMOKE_CONFIG))
+    full = result["full"]
+    if not full["comparison"]["bindings_identical"]:
+        print("WARNING: cache and legacy arms disagree on final bindings",
+              file=sys.stderr)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"out": args.out,
+                      "full": full["comparison"],
+                      "cache_p99_ms": full["cache"]["pass_p99_ms"],
+                      "legacy_p99_ms": full["legacy"]["pass_p99_ms"],
+                      "scan_per_pass": {
+                          "cache": full["cache"]["scan_per_pass"],
+                          "legacy": full["legacy"]["scan_per_pass"]}},
+                     indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
