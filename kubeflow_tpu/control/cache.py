@@ -85,7 +85,12 @@ class _Sub:
 
 
 class ClusterCache:
-    def __init__(self, client, kinds=DEFAULT_KINDS):
+    def __init__(self, client, kinds=DEFAULT_KINDS,
+                 pod_labels: tuple[str, ...] = (JT.LABEL_JOB_NAME,)):
+        # ``pod_labels``: label keys to maintain secondary pod indexes
+        # for. The gang label is the scheduler's; other controllers
+        # (jaxservice, notebook) pass their own grouping label so their
+        # per-reconcile "pods of X" reads stay O(bucket).
         self._client = client
         self._lock = threading.RLock()
         # Stream management (teardown + resubscribe) is serialized
@@ -106,7 +111,17 @@ class ClusterCache:
         # pod-derived indexes
         self._pod_use: dict[tuple[str, str], tuple[str, int]] = {}
         self._by_node: dict[str, dict[tuple[str, str], None]] = {}
-        self._by_gang: dict[tuple[str, str], dict[tuple[str, str], None]] = {}
+        # generic per-kind namespace buckets: kind key -> ns -> okey set
+        # (a namespaced read over a high-cardinality kind — the notebook
+        # Event forward — must be O(namespace), not O(cluster))
+        self._by_ns: dict[tuple[str, str],
+                          dict[str, dict[tuple[str, str], None]]] = \
+            {s.key: {} for s in self._subs}
+        # label key -> (namespace, value) -> ordered okey set
+        self._pod_labels = tuple(pod_labels)
+        self._by_label: dict[str, dict[tuple[str, str],
+                                       dict[tuple[str, str], None]]] = \
+            {lbl: {} for lbl in self._pod_labels}
         # (kind key, object key) -> highest rv seen at deletion. A
         # note_write racing a pump-applied DELETED would otherwise
         # re-insert the dead object (the rv guard below only compares
@@ -350,14 +365,33 @@ class ClusterCache:
             store = self._objects[key]
             old = store.get(okey)
             if etype == "DELETED":
-                tomb = max((r for r in (_rv_of(obj),
-                                        _rv_of(old) if old else None)
+                rv_new = _rv_of(obj)
+                rv_old = _rv_of(old) if old is not None else None
+                if old is not None and rv_new is not None \
+                        and rv_old is not None and rv_new < rv_old:
+                    # late/replayed DELETED for an OLDER incarnation:
+                    # the cached object is a same-name recreation (e.g.
+                    # folded in by a reconciler's note_write before the
+                    # old incarnation's watch DELETED arrived). Evicting
+                    # it — and tombstoning at ITS rv, as the max() below
+                    # would — makes the live object unresurrectable when
+                    # its own ADDED is delivered. Tombstone only the
+                    # dead incarnation's rv; keep the live object.
+                    self._tombstone_locked((key, okey), rv_new)
+                    self._stats["stale_events"] += 1
+                    return
+                tomb = max((r for r in (rv_new, rv_old)
                             if r is not None), default=None)
                 if tomb is not None:
                     self._tombstone_locked((key, okey), tomb)
                 if old is None:
                     return
                 del store[okey]
+                bucket = self._by_ns[key].get(okey[0])
+                if bucket is not None:
+                    bucket.pop(okey, None)
+                    if not bucket:
+                        del self._by_ns[key][okey[0]]
                 new = None
             else:
                 # rv guard: never let an out-of-order or replayed event
@@ -378,6 +412,7 @@ class ClusterCache:
                         self._stats["stale_events"] += 1
                         return
                 store[okey] = new = obj
+                self._by_ns[key].setdefault(okey[0], {})[okey] = None
                 self._tombstones.pop((key, okey), None)
             self._stats["events"] += 1
             if key == NODE:
@@ -436,18 +471,21 @@ class ClusterCache:
 
     def _apply_pod_locked(self, okey: tuple[str, str], old: dict | None,
                           new: dict | None) -> None:
-        # gang-label index
-        old_job = ob.labels_of(old).get(JT.LABEL_JOB_NAME) if old else None
-        new_job = ob.labels_of(new).get(JT.LABEL_JOB_NAME) if new else None
-        if old_job != new_job:
-            if old_job:
-                gang = self._by_gang.get((okey[0], old_job))
-                if gang is not None:
-                    gang.pop(okey, None)
-                    if not gang:
-                        del self._by_gang[(okey[0], old_job)]
-            if new_job:
-                self._by_gang.setdefault((okey[0], new_job), {})[okey] = None
+        # label indexes (gang label + any controller-configured keys)
+        for lbl in self._pod_labels:
+            old_val = ob.labels_of(old).get(lbl) if old else None
+            new_val = ob.labels_of(new).get(lbl) if new else None
+            if old_val == new_val:
+                continue
+            index = self._by_label[lbl]
+            if old_val:
+                bucket = index.get((okey[0], old_val))
+                if bucket is not None:
+                    bucket.pop(okey, None)
+                    if not bucket:
+                        del index[(okey[0], old_val)]
+            if new_val:
+                index.setdefault((okey[0], new_val), {})[okey] = None
         # chip accounting + by-node index
         old_use = self._pod_use.get(okey)
         new_use = self._pod_contrib(new)
@@ -495,11 +533,18 @@ class ClusterCache:
         self._buckets = {C.ALL_NODES: C.Bucket()}
         self._pod_use = {}
         self._by_node = {}
-        self._by_gang = {}
+        self._by_label = {lbl: {} for lbl in self._pod_labels}
+        self._by_ns = {k: {} for k in self._objects}
+        for k, kind_store in self._objects.items():
+            for okey in kind_store:
+                self._by_ns[k].setdefault(okey[0], {})[okey] = None
         for okey, pod in self._objects.get(POD, {}).items():
-            job = ob.labels_of(pod).get(JT.LABEL_JOB_NAME)
-            if job:
-                self._by_gang.setdefault((okey[0], job), {})[okey] = None
+            labels = ob.labels_of(pod)
+            for lbl in self._pod_labels:
+                val = labels.get(lbl)
+                if val:
+                    self._by_label[lbl].setdefault(
+                        (okey[0], val), {})[okey] = None
             use = self._pod_contrib(pod)
             if use is not None:
                 node, chips = use
@@ -521,12 +566,30 @@ class ClusterCache:
         with self._lock:
             return dict(self._objects.get((api_version, kind), {}))
 
+    def objects_ns(self, api_version: str, kind: str,
+                   namespace: str) -> list[dict]:
+        """One kind's objects in one namespace — O(namespace bucket),
+        the namespaced-list analogue for snapshot reads."""
+        key = (api_version, kind)
+        with self._lock:
+            self._stats["reads"] += 1
+            store = self._objects.get(key, {})
+            keys = self._by_ns.get(key, {}).get(namespace, ())
+            return [store[k] for k in keys if k in store]
+
     def gang_pods(self, namespace: str, job: str) -> list[dict]:
         """Pods carrying the gang label, name-sorted (O(gang))."""
+        return self.pods_by_label(JT.LABEL_JOB_NAME, namespace, job)
+
+    def pods_by_label(self, label: str, namespace: str,
+                      value: str) -> list[dict]:
+        """Pods carrying ``label == value``, name-sorted (O(bucket)).
+        The label must be in this cache's ``pod_labels`` — an unindexed
+        key is a wiring bug, not a slow path."""
         with self._lock:
             self._stats["reads"] += 1
             store = self._objects[POD]
-            keys = self._by_gang.get((namespace, job), ())
+            keys = self._by_label[label].get((namespace, value), ())
             pods = [store[k] for k in keys if k in store]
         return sorted(pods, key=lambda p: ob.meta(p)["name"])
 
@@ -550,6 +613,15 @@ class ClusterCache:
         with self._lock:
             self._stats["reads"] += 1
             return dict(self._views)
+
+    def node(self, name: str) -> dict | None:
+        """The raw cached Node object (read-only reference) — for
+        callers whose health semantics need more than a NodeView (e.g.
+        the jaxjob slice-health check distinguishes 'no Ready condition
+        yet' from 'Ready False')."""
+        with self._lock:
+            self._stats["reads"] += 1
+            return self._objects[NODE].get(("", name))
 
     def unhealthy_bound_nodes(self) -> dict[str, str]:
         """Nodes that hold bound pods but are gone or NotReady —

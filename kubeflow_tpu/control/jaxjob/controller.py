@@ -42,13 +42,10 @@ from kubeflow_tpu.obs import trace as obs_trace
 log = logging.getLogger("kubeflow_tpu.jaxjob")
 
 # Prometheus (the bootstrap plane's deploy metrics analogue, server.go:68-132)
-_METRICS: dict[str, object] = {}
-
-
 def _metric(name, kind, doc, **kw):
-    if name not in _METRICS:
-        _METRICS[name] = kind(name, doc, **kw)
-    return _METRICS[name]
+    from kubeflow_tpu.runtime.metrics import prom_metric
+
+    return prom_metric(name, kind, doc, **kw)
 
 
 def jobs_created():
@@ -153,8 +150,14 @@ def job_world(job: dict) -> WorldSpec:
 
 
 class JAXJobReconciler(Reconciler):
-    def __init__(self, record_events: bool = True):
+    def __init__(self, record_events: bool = True, cache=None):
         self.record_events = record_events
+        # indexed ClusterCache (ISSUE 7, wired here per ROADMAP #3's
+        # remaining item): pod and node reads come from O(bucket)
+        # snapshot indexes instead of per-reconcile list calls. None =
+        # the legacy relist shape (kept for the FakeCluster op-count
+        # A/B pins in tests/test_cache.py).
+        self.cache = cache
         # open per-job root spans ("JAXJob created" -> gang running),
         # keyed by (namespace, name); their ids are exactly the
         # traceparent stamped into the job + pod annotations, so every
@@ -384,7 +387,36 @@ class JAXJobReconciler(Reconciler):
 
     # -- reconcile ----------------------------------------------------------
 
+    def _job_pods(self, client, namespace: str, name: str) -> list[dict]:
+        """The gang's pods: O(gang) from the cache's label index, or the
+        legacy label-selector list. Cache snapshots are READ-ONLY
+        references — this reconciler only reads pods and writes through
+        the client, never mutates them in place."""
+        if self.cache is not None:
+            return self.cache.gang_pods(namespace, name)
+        return client.list(
+            "v1", "Pod", namespace=namespace,
+            label_selector={"matchLabels": {T.LABEL_JOB_NAME: name}},
+        )
+
+    # read-your-own-writes over an ASYNC watch (real apiserver): every
+    # pod write this reconciler performs folds its response back into
+    # the cache immediately, so a reconcile racing the watch delivery
+    # can never re-create an existing gang or restart a healthy one
+    # from a stale snapshot (the jaxservice/scheduler note_write
+    # discipline; rv-guarded, so the watch's later delivery is benign).
+
+    def _note(self, obj) -> None:
+        if self.cache is not None and obj:
+            self.cache.note_write(obj)
+
+    def _note_gone(self, obj) -> None:
+        if self.cache is not None and obj:
+            self.cache.note_delete(obj)
+
     def reconcile(self, client, req: Request) -> Result | None:
+        if self.cache is not None:
+            self.cache.refresh()
         job = client.get_or_none(T.API_VERSION, T.KIND, req.name, req.namespace)
         if job is None:
             # deleted; ownerRef GC reaps children. Close any still-open
@@ -428,10 +460,7 @@ class JAXJobReconciler(Reconciler):
 
         spec = job["spec"]
         replicas = T.gang_size(spec)  # total pods across all slices
-        pods = client.list(
-            "v1", "Pod", namespace=req.namespace,
-            label_selector={"matchLabels": {T.LABEL_JOB_NAME: req.name}},
-        )
+        pods = self._job_pods(client, req.namespace, req.name)
 
         # condemned sweep: pods stamped with an OLDER gang epoch are the
         # leftovers of a recorded restart whose teardown was interrupted
@@ -446,8 +475,9 @@ class JAXJobReconciler(Reconciler):
                 try:
                     client.delete("v1", "Pod", ob.meta(p)["name"],
                                   req.namespace)
+                    self._note_gone(p)
                 except ob.NotFound:
-                    pass
+                    self._note_gone(p)
                 except ob.ApiError:
                     log.exception("condemned-pod delete of %s failed",
                                   ob.meta(p)["name"])
@@ -469,13 +499,16 @@ class JAXJobReconciler(Reconciler):
                     for i in missing:
                         pod = self.generate_pod(job, i)
                         ob.set_owner(pod, job)
-                        created.append(client.create(pod))
+                        resp = client.create(pod)
+                        self._note(resp)
+                        created.append(resp)
                 except ob.ApiError as e:
                     for p in created:
                         try:
                             client.delete("v1", "Pod", ob.meta(p)["name"], req.namespace)
+                            self._note_gone(p)
                         except ob.NotFound:
-                            pass
+                            self._note_gone(p)
                         except ob.ApiError:
                             # best-effort rollback: a transient error on
                             # one delete must not strand the rest; the
@@ -508,6 +541,7 @@ class JAXJobReconciler(Reconciler):
                         pod = self.generate_pod(job, i)
                         ob.set_owner(pod, job)
                         p = client.create(pod)
+                        self._note(p)
                         by_name[ob.meta(p)["name"]] = p
                 pods = list(by_name.values())
             else:
@@ -598,6 +632,7 @@ class JAXJobReconciler(Reconciler):
                 try:
                     client.delete("v1", "Pod", ob.meta(p)["name"],
                                   req.namespace)
+                    self._note_gone(p)
                 except (ob.NotFound, ob.ApiError):
                     pass  # ownerRef GC reaps any residue at job deletion
             if self.record_events:
@@ -705,12 +740,30 @@ class JAXJobReconciler(Reconciler):
 
     def _unhealthy_nodes(self, client, pods) -> list[str]:
         """Nodes under gang pods that are NotReady or tainted for
-        impending TPU maintenance. One GET per distinct node."""
+        impending TPU maintenance. With the cache: zero apiserver
+        reads (snapshot lookups). Legacy: one GET per distinct node."""
         names = {(p.get("spec") or {}).get("nodeName") for p in pods}
         names.discard(None)
+        if not names:
+            return []
         bad: set[str] = set()
         for node_name in names:
-            node = client.get_or_none("v1", "Node", node_name)
+            if self.cache is not None:
+                # raw cached object, not the NodeView: the legacy check
+                # treats a node with NO Ready condition yet as healthy,
+                # and that distinction must survive the index rewrite
+                node = self.cache.node(node_name)
+                if node is None and self.cache.pumped:
+                    # a pumped snapshot can lag the Node ADDED on its
+                    # independent stream (the pod got here via our own
+                    # note_write) — confirm the disappearance against
+                    # the apiserver before condemning a healthy gang;
+                    # the legacy read below was always authoritative
+                    node = client.get_or_none("v1", "Node", node_name)
+                    if node is not None:
+                        self.cache.note_write(node)
+            else:
+                node = client.get_or_none("v1", "Node", node_name)
             if node is None:
                 bad.add(node_name)
                 continue
@@ -926,10 +979,11 @@ class JAXJobReconciler(Reconciler):
             if ob.annotations_of(p).get(T.ANNOTATION_WORLD) == stamp:
                 continue
             try:
-                client.patch("v1", "Pod", name,
-                             {"metadata": {"annotations": {
-                                 T.ANNOTATION_WORLD: stamp}}},
-                             m["namespace"])
+                self._note(client.patch(
+                    "v1", "Pod", name,
+                    {"metadata": {"annotations": {
+                        T.ANNOTATION_WORLD: stamp}}},
+                    m["namespace"]))
             except ob.NotFound:
                 pass
             except ob.ApiError:
@@ -938,8 +992,9 @@ class JAXJobReconciler(Reconciler):
             try:
                 client.delete("v1", "Pod", ob.meta(p)["name"],
                               m["namespace"])
+                self._note_gone(p)
             except ob.NotFound:
-                pass
+                self._note_gone(p)
             except ob.ApiError:
                 log.exception("resize: delete of %s failed",
                               ob.meta(p)["name"])
@@ -958,7 +1013,7 @@ class JAXJobReconciler(Reconciler):
             pod = self.generate_pod(job, i)
             ob.set_owner(pod, job)
             try:
-                client.create(pod)
+                self._note(client.create(pod))
             except ob.Conflict:
                 pass  # old pod name still releasing; re-entry recreates
             except ob.ApiError:
@@ -1014,8 +1069,9 @@ class JAXJobReconciler(Reconciler):
         for p in pods:
             try:
                 client.delete("v1", "Pod", ob.meta(p)["name"], m["namespace"])
+                self._note_gone(p)
             except ob.NotFound:
-                pass
+                self._note_gone(p)
             except ob.ApiError:
                 # best-effort: the condemned sweep reaps survivors
                 log.exception("gang restart: delete of %s failed",
@@ -1023,21 +1079,27 @@ class JAXJobReconciler(Reconciler):
         return Result(requeue_after=0.1)
 
 
-def _node_mapper(client):
+def _node_mapper(client, cache=None):
     """A Node event re-enqueues exactly the JAXJobs with gang pods ON
-    that node (slice-health detection): one server-side-filtered pod
-    list (fieldSelector spec.nodeName — the same index kube-scheduler
-    and kubelet queries use) instead of fanning out to every job in the
-    cluster. O(pods-on-node), the right shape for a real cluster."""
+    that node (slice-health detection): the cache's by-node index, or
+    one server-side-filtered pod list (fieldSelector spec.nodeName —
+    the same index kube-scheduler and kubelet queries use) instead of
+    fanning out to every job in the cluster. O(pods-on-node), the
+    right shape for a real cluster."""
     from kubeflow_tpu.control.runtime import Request
 
     def fn(node: dict) -> list[Request]:
         name = ob.meta(node).get("name")
         if not name:
             return []
+        if cache is not None:
+            cache.refresh()
+            pods = cache.pods_on_node(name)
+        else:
+            pods = client.list("v1", "Pod",
+                               field_selector={"spec.nodeName": name})
         reqs = set()
-        for p in client.list("v1", "Pod",
-                             field_selector={"spec.nodeName": name}):
+        for p in pods:
             job = ob.labels_of(p).get(T.LABEL_JOB_NAME)
             if job:
                 reqs.add((ob.meta(p).get("namespace") or "default", job))
@@ -1047,9 +1109,21 @@ def _node_mapper(client):
 
 
 def build_controller(client, record_events: bool = True,
-                     registry=None) -> Controller:
-    rec = JAXJobReconciler(record_events=record_events)
+                     registry=None, cache: bool = True) -> Controller:
+    """``cache=True`` (default) runs the reconciler's pod/node reads on
+    an indexed ``ClusterCache`` (ROADMAP #3's remaining wiring): one
+    initial list per kind, then zero per-reconcile list calls — pinned
+    by FakeCluster op counters in tests/test_cache.py. ``cache=False``
+    keeps the legacy relist shape."""
+    cluster_cache = None
+    if cache:
+        from kubeflow_tpu.control.cache import ClusterCache
+
+        cluster_cache = ClusterCache(client).connect()
+    rec = JAXJobReconciler(record_events=record_events, cache=cluster_cache)
     ctl = Controller("jaxjob", client, rec, registry=registry)
+    if cluster_cache is not None:
+        ctl.uses(cluster_cache)
     ctl.watches_primary(T.API_VERSION, T.KIND).owns("v1", "Pod").owns("v1", "Service")
-    ctl.maps("v1", "Node", _node_mapper(client))
+    ctl.maps("v1", "Node", _node_mapper(client, cache=cluster_cache))
     return ctl

@@ -1,0 +1,47 @@
+"""kubeflow_tpu.control.jaxservice — the production serving plane CRD.
+
+A JAXService runs N interchangeable model-server replicas behind the
+token-aware router (``serving/router.py``), autoscaled on router queue
+depth and tokens/sec between ``spec.replicas.min`` and ``.max``, with
+drain-before-delete scale-down. See docs/serving.md.
+
+- ``types``      — CRD spec/validation, the endpoints annotation
+  re-export, condition vocabulary.
+- ``controller`` — the Reconciler: provisioning through the gang
+  scheduler, readiness tracking, endpoints publication, hysteretic
+  autoscaling, the cordon → drain → delete state machine.
+"""
+
+from __future__ import annotations
+
+
+def watch_endpoints(apiserver: str, namespace: str, name: str,
+                    router) -> None:  # pragma: no cover - container glue
+    """Router-side membership feed: watch ONE JAXService and apply its
+    endpoints annotation to the router on every event (plus an initial
+    read). Runs forever; stream death resubscribes (the control/runtime
+    watch discipline)."""
+    import logging
+    import time as _time
+
+    from kubeflow_tpu.control.jaxservice import types as T
+    from kubeflow_tpu.control.k8s.rest import RestClient
+    from kubeflow_tpu.serving.router import HttpTransport
+
+    log = logging.getLogger("kubeflow_tpu.jaxservice")
+    client = RestClient(base_url=apiserver or None)
+    factory = lambda ep: HttpTransport(ep["addr"])  # noqa: E731
+    while True:
+        try:
+            obj = client.get_or_none(T.API_VERSION, T.KIND, name, namespace)
+            if obj is not None:
+                router.sync_from_object(obj, transport_factory=factory)
+            for ev in client.watch(T.API_VERSION, T.KIND):
+                m = (ev.object.get("metadata") or {})
+                if m.get("name") == name \
+                        and (m.get("namespace") or "default") == namespace:
+                    router.sync_from_object(
+                        ev.object, transport_factory=factory)
+        except Exception:
+            log.exception("endpoints watch failed; resubscribing")
+        _time.sleep(0.5)

@@ -1,0 +1,4 @@
+from kubeflow_tpu.control.jaxservice.controller import build_controller
+from kubeflow_tpu.control.mains import run_controller
+
+run_controller("jaxservice", lambda client, args: build_controller(client))

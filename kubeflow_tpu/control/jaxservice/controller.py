@@ -1,0 +1,671 @@
+"""JAXService controller: replicated model serving with queue-driven
+autoscaling and drain-before-delete scale-down.
+
+The serving analogue of the JAXJob controller (ROADMAP #2). One
+reconcile loop owns four responsibilities:
+
+- **Provisioning**: keep exactly ``status.targetReplicas`` replica pods
+  (``<svc>-replica-<i>``) running the model server
+  (``serving/__main__.py``), each a gang of ONE for the gang scheduler
+  when ``spec.schedulerName`` opts in — replicas admit independently
+  (a fleet takes every replica it can get; all-or-nothing is a
+  training-world law), but inherit slice placement, priority and
+  spot-pool preference. A replica that dies (node loss, eviction,
+  crash) is reaped and re-provisioned at the same index.
+- **Endpoints**: the READY replica set is published on the JAXService's
+  ``ANNOTATION_ENDPOINTS`` annotation — the downward-style feed the
+  token router consumes (``serving/router.py``, the ONE spelling).
+  Cordoned replicas stay listed as ``state=cordoned`` so the router
+  keeps draining them without admitting new work.
+- **Autoscaling**: ``status.targetReplicas`` moves between
+  ``spec.replicas.min`` and ``.max`` on two router-exported signals
+  read back from the MetricsRegistry exposition (PR 4):
+  ``router_queue_depth`` (queued requests per replica the service
+  tolerates) and the ``router_tokens_total`` rate (tokens/sec vs the
+  per-replica throughput target). Both directions are HYSTERETIC: a
+  scale-up needs the demand to persist for
+  ``scaleUpStabilizationSeconds``, a scale-down for the (longer)
+  ``scaleDownStabilizationSeconds`` — and scale-down steps ONE replica
+  at a time, so a demand lull never mass-cordons the fleet. The target
+  is durable in status before any pod is touched (the _gang_restart
+  record-FIRST discipline), so interrupted scale operations re-enter
+  idempotently.
+- **Drain state machine** (scale-down): active → cordoned (the pod is
+  annotated, the endpoints entry flips to ``cordoned``, the router
+  stops new dispatch) → drained (the router's
+  ``router_tokens_inflight{replica}`` gauge reads zero) → deleted.
+  In-flight requests always finish; docs/serving.md draws the diagram.
+
+Every reconcile wraps its decision pass in a ``jaxservice.reconcile``
+span under the service's minted traceparent; the router's
+``router.dispatch`` spans ride each request's own traceparent — one
+timeline from client request through dispatch to the replica.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import time
+
+import prometheus_client as prom
+
+from kubeflow_tpu.control import reconcilehelper as rh
+from kubeflow_tpu.control.jaxjob import types as JT
+from kubeflow_tpu.control.jaxservice import types as T
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
+from kubeflow_tpu.control.scheduler import (
+    ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG, SCHEDULER_NAME,
+)
+from kubeflow_tpu.control.scheduler.topology import parse_topology
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.runtime.metrics import (
+    REGISTRY,
+    MetricsRegistry,
+    prom_metric as _metric,
+)
+from kubeflow_tpu.serving.router import render_endpoints
+
+log = logging.getLogger("kubeflow_tpu.jaxservice")
+
+# Re-provision pacing: deletes need their names freed before recreation
+_REQUEUE_FAST = 0.05
+# Steady-state autoscale poll (the registry signals are pull-only)
+_REQUEUE_POLL = 0.5
+
+REPLICA_STATES = ("desired", "ready", "pending", "cordoned")
+
+
+def replicas_gauge():
+    return _metric("jaxservice_replicas", prom.Gauge,
+                   "replica counts by state (desired/ready/pending/"
+                   "cordoned) per service",
+                   labelnames=("service", "state"))
+
+
+def scales_total():
+    return _metric("jaxservice_scale_total", prom.Counter,
+                   "autoscaler target moves by direction",
+                   labelnames=("direction",))
+
+
+def replica_restarts_total():
+    return _metric("jaxservice_replica_restarts_total", prom.Counter,
+                   "replicas reaped and re-provisioned after dying")
+
+
+class JAXServiceReconciler(Reconciler):
+    def __init__(self, record_events: bool = True,
+                 registry: MetricsRegistry | None = None,
+                 signals=None, clock=time.monotonic, cache=None):
+        self.record_events = record_events
+        self.registry = registry if registry is not None else REGISTRY
+        # autoscaling signal source (serving.router.RegistrySignals
+        # shape); None = no signal plane wired -> the service holds at
+        # status.targetReplicas (still min/max-clamped) and a Running
+        # cordoned replica is held for spec.drainSeconds before delete
+        # (the router routes to the fleet whether or not the controller
+        # can read its gauges — "nothing wired = drained" would delete
+        # replicas with live decodes in flight)
+        self.signals = signals
+        self.clock = clock
+        self.cache = cache
+        # per-service autoscaler memory: tokens-rate sample and the
+        # hysteresis pending-direction window. In-memory on purpose — a
+        # controller restart just re-observes demand for one window.
+        self._scale_state: dict[tuple[str, str], dict] = {}
+        # cordon observation times for the signal-less drain grace,
+        # keyed (namespace, pod). In-memory: a controller restart
+        # restarts the grace, which only ever drains LONGER.
+        self._drain_started: dict[tuple[str, str], float] = {}
+
+    # -- trace propagation (the jaxjob discipline) --------------------------
+
+    def _ensure_traceparent(self, client, svc: dict) -> dict:
+        m = ob.meta(svc)
+        if (m.get("annotations") or {}).get(obs_trace.TRACEPARENT_ANNOTATION):
+            return svc
+        ctx = obs_trace.SpanContext(
+            obs_trace.new_trace_id(), obs_trace.new_span_id())
+        # rv precondition: two racing first reconciles must not both
+        # mint a context (jaxjob controller: the loser 409s, benign)
+        return client.patch(
+            T.API_VERSION, T.KIND, m["name"],
+            {"metadata": {
+                "resourceVersion": m["resourceVersion"],
+                "annotations": {
+                    obs_trace.TRACEPARENT_ANNOTATION: ctx.to_traceparent()}}},
+            m["namespace"])
+
+    def _svc_context(self, svc: dict) -> obs_trace.SpanContext | None:
+        return obs_trace.parse_traceparent(
+            (ob.meta(svc).get("annotations") or {})
+            .get(obs_trace.TRACEPARENT_ANNOTATION))
+
+    # -- generate* ----------------------------------------------------------
+
+    def generate_service(self, svc: dict) -> dict:
+        """Headless service: stable per-replica DNS
+        (<pod>.<svc>.<ns>.svc) — the router's endpoint addresses."""
+        m = ob.meta(svc)
+        port = (svc.get("spec") or {}).get("port", T.DEFAULT_PORT)
+        return ob.new_object(
+            "v1", "Service", m["name"], m["namespace"],
+            labels={T.LABEL_SERVICE_NAME: m["name"]},
+            spec={
+                "clusterIP": "None",
+                "selector": {T.LABEL_SERVICE_NAME: m["name"]},
+                "ports": [{"name": "http-serving", "port": port}],
+            },
+        )
+
+    def _model_command(self, spec: dict) -> list[str]:
+        model = T.model_spec(spec)
+        cmd = ["python", "-m", "kubeflow_tpu.serving",
+               "--port", str(spec.get("port", T.DEFAULT_PORT)),
+               "--lm", f"{model['name']}={model['ref']}",
+               "--prompt-len", str(model["promptLen"]),
+               "--max-new-tokens", str(model["maxNewTokens"])]
+        if model["continuousBatching"]:
+            cmd += ["--continuous-batching",
+                    "--decode-slots", str(model["decodeSlots"])]
+        if model["paramDtype"]:
+            cmd += ["--param-dtype", model["paramDtype"]]
+        return cmd
+
+    def generate_pod(self, svc: dict, index: int) -> dict:
+        m = ob.meta(svc)
+        spec = svc.get("spec") or {}
+        name = T.replica_name(m["name"], index)
+        tmpl = ob.deep_copy(spec.get("template") or {"spec": {"containers": [
+            {"name": "serving", "image": spec.get(
+                "image", "kubeflow-tpu/platform:latest")}]}})
+        pod_spec = tmpl.setdefault("spec", {})
+        pod_spec.setdefault("restartPolicy", "Never")
+        pod_spec["hostname"] = name
+        pod_spec["subdomain"] = m["name"]
+        env = [
+            {"name": T.ENV_SERVICE, "value": m["name"]},
+            {"name": T.ENV_REPLICA, "value": str(index)},
+            {"name": T.ENV_NAMESPACE, "value": m["namespace"]},
+        ]
+        traceparent = (m.get("annotations") or {}).get(
+            obs_trace.TRACEPARENT_ANNOTATION)
+        if traceparent:
+            env.append({"name": obs_trace.TRACEPARENT_ENV,
+                        "value": traceparent})
+        tpu = spec.get("tpu") or {}
+        for c in pod_spec.get("containers", []):
+            c.setdefault("command", self._model_command(spec))
+            have = {e["name"] for e in c.get("env", [])}
+            c.setdefault("env", []).extend(
+                e for e in env if e["name"] not in have)
+            if tpu.get("chipsPerWorker"):
+                res = c.setdefault("resources", {}).setdefault("limits", {})
+                res.setdefault(JT.RESOURCE_TPU, tpu["chipsPerWorker"])
+        if tpu.get("accelerator"):
+            sel = pod_spec.setdefault("nodeSelector", {})
+            sel.setdefault(JT.NODESELECTOR_ACCEL, tpu["accelerator"])
+            if tpu.get("topology"):
+                try:
+                    topo = str(parse_topology(tpu["topology"]))
+                except ValueError:
+                    topo = tpu["topology"]  # validate() reports this
+                sel.setdefault(JT.NODESELECTOR_TOPOLOGY, topo)
+        labels = {
+            **(tmpl.get("metadata", {}).get("labels") or {}),
+            T.LABEL_SERVICE_NAME: m["name"],
+            T.LABEL_REPLICA_INDEX: str(index),
+        }
+        annotations = dict(tmpl.get("metadata", {}).get("annotations") or {})
+        if spec.get("schedulerName"):
+            pod_spec["schedulerName"] = spec["schedulerName"]
+        if spec.get("schedulerName") == SCHEDULER_NAME:
+            # each replica is its own gang of ONE: the scheduler keys
+            # gangs on the jaxjob gang label, so the pod's own name is
+            # the gang — independent admission per replica, topology
+            # feasibility and priority still enforced. Gate appended,
+            # never setdefault (the jaxjob lesson: a template gate must
+            # not displace ours).
+            labels[JT.LABEL_JOB_NAME] = name
+            gates = list(pod_spec.get("schedulingGates") or [])
+            if not any(g.get("name") == GATE_GANG for g in gates):
+                gates.append({"name": GATE_GANG})
+            pod_spec["schedulingGates"] = gates
+            annotations[ANNOTATION_GANG_SIZE] = "1"
+            annotations[ANNOTATION_PRIORITY] = str(spec.get("priority", 0))
+        if traceparent:
+            annotations[obs_trace.TRACEPARENT_ANNOTATION] = traceparent
+        return {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": {
+                "name": name,
+                "namespace": m["namespace"],
+                "labels": labels,
+                "annotations": annotations,
+            },
+            "spec": pod_spec,
+        }
+
+    # -- pod reads ----------------------------------------------------------
+
+    @staticmethod
+    def _write_status(client, svc: dict) -> None:
+        """update_status + rv rebind: a reconcile writes status more
+        than once (scale move, restart count, final publish) and the
+        fake apiserver 409s any write carrying a stale rv."""
+        resp = client.update_status(svc)
+        ob.meta(svc)["resourceVersion"] = ob.meta(resp)["resourceVersion"]
+
+    def _pods(self, client, namespace: str, name: str) -> list[dict]:
+        if self.cache is not None:
+            return self.cache.pods_by_label(
+                T.LABEL_SERVICE_NAME, namespace, name)
+        return client.list(
+            "v1", "Pod", namespace=namespace,
+            label_selector={"matchLabels": {T.LABEL_SERVICE_NAME: name}})
+
+    @staticmethod
+    def _cordoned(pod: dict) -> bool:
+        return ob.annotations_of(pod).get(T.ANNOTATION_CORDON) == "true"
+
+    def _replica_drained(self, namespace: str, service: str,
+                         pod: dict, drain_s: float) -> bool:
+        """Delete gate for a cordoned replica: a pod that is not
+        Running holds no connections; a Running one must read zero on
+        the router's in-flight gauge, or — when no signal plane is
+        wired (the production run_controller default) — outlive the
+        spec.drainSeconds grace measured from the first reconcile that
+        saw it cordoned. The router keeps routing regardless of the
+        controller's gauge access, so signal-less can never mean
+        "nothing in flight"."""
+        if (pod.get("status") or {}).get("phase") != "Running":
+            return True
+        name = ob.meta(pod)["name"]
+        if self.signals is not None:
+            return self.signals.replica_drained(namespace, service, name)
+        key = (namespace, name)
+        started = self._drain_started.setdefault(key, self.clock())
+        return self.clock() - started >= drain_s
+
+    # -- reconcile ----------------------------------------------------------
+
+    def reconcile(self, client, req: Request) -> Result | None:
+        if self.cache is not None:
+            self.cache.refresh()
+        svc = client.get_or_none(T.API_VERSION, T.KIND, req.name,
+                                 req.namespace)
+        if svc is None:
+            # deleted; ownerRef GC reaps replicas. Drop autoscaler and
+            # drain-grace memory
+            self._scale_state.pop((req.namespace, req.name), None)
+            prefix = req.name + "-replica-"
+            for k in [k for k in self._drain_started
+                      if k[0] == req.namespace and k[1].startswith(prefix)]:
+                del self._drain_started[k]
+            return None
+        if ob.meta(svc).get("deletionTimestamp"):
+            return None
+
+        errs = T.validate(svc)
+        if errs:
+            changed = ob.cond_set(svc, T.COND_DEGRADED, "True",
+                                  "ValidationFailed", "; ".join(errs))
+            if changed:
+                client.update_status(svc)
+            return None
+
+        if not ob.cond_get(svc, T.COND_CREATED):
+            svc = self._ensure_traceparent(client, svc)
+            ob.cond_set(svc, T.COND_CREATED, "True", "JAXServiceCreated",
+                        "replica set is being provisioned")
+            svc = client.update_status(svc)
+            if self.record_events:
+                client.record_event(svc, "JAXServiceCreated",
+                                    "provisioning serving replicas")
+
+        rh.reconcile_child(client, svc, self.generate_service(svc))
+
+        with obs_trace.TRACER.span(
+                "jaxservice.reconcile", parent=self._svc_context(svc),
+                namespace=req.namespace, service=req.name) as span:
+            return self._reconcile_replicas(client, svc, req, span)
+
+    def _reconcile_replicas(self, client, svc: dict, req: Request,
+                            span) -> Result | None:
+        spec = svc.get("spec") or {}
+        reps = T.replicas_spec(spec)
+        status = svc["status"] = svc.get("status") or {}
+        prev_status = ob.deep_copy(status)
+        target = min(max(status.get("targetReplicas") or reps["min"],
+                         reps["min"]), reps["max"])
+
+        pods = self._pods(client, req.namespace, req.name)
+        by_name = {ob.meta(p)["name"]: p for p in pods}
+        phases = {n: (p.get("status") or {}).get("phase", "Pending")
+                  for n, p in by_name.items()}
+
+        # -- autoscale decision (durable target move, record-FIRST) --------
+        new_target = self._autoscale(svc, target)
+        if new_target != target:
+            direction = "up" if new_target > target else "down"
+            status["targetReplicas"] = new_target
+            status["scales"] = status.get("scales", 0) + 1
+            # target lands in status BEFORE any pod is touched: an
+            # interrupted scale re-enters here idempotently
+            self._write_status(client, svc)
+            scales_total().labels(direction=direction).inc()
+            self.registry.counter_inc(
+                "jaxservice_scale_total",
+                help_="autoscaler target moves by direction",
+                namespace=req.namespace, service=req.name,
+                direction=direction)
+            if self.record_events:
+                client.record_event(
+                    svc, "ScaledUp" if direction == "up" else "ScaledDown",
+                    f"target replicas {target} -> {new_target}",
+                    "Normal")
+            target = new_target
+        span.attrs["target"] = target
+
+        # -- grow-back: a replica cordoned for a scale-down that was
+        # reversed before its drain completed returns to service (the
+        # uncordon arrow in docs/serving.md) — otherwise nothing ever
+        # clears the annotation and the service wedges below target
+        # (not reaped, not re-provisioned, endpoints stuck cordoned)
+        for i in range(target):
+            name = T.replica_name(req.name, i)
+            pod = by_name.get(name)
+            if pod is None or not self._cordoned(pod):
+                continue
+            try:
+                patched = client.patch(
+                    "v1", "Pod", name,
+                    {"metadata": {"annotations": {
+                        T.ANNOTATION_CORDON: "false"}}},
+                    req.namespace)
+                by_name[name] = patched
+                if self.cache is not None:
+                    self.cache.note_write(patched)
+            except ob.NotFound:
+                by_name.pop(name, None)
+                continue
+            self._drain_started.pop((req.namespace, name), None)
+            if self.record_events:
+                client.record_event(
+                    svc, "ReplicaUncordoned",
+                    f"{name} returned to service (scale-down reversed)")
+
+        # -- reap dead replicas below target (re-provision at same index) --
+        restarted = 0
+        for i in range(target):
+            name = T.replica_name(req.name, i)
+            pod = by_name.get(name)
+            if pod is not None and phases[name] in ("Failed", "Succeeded") \
+                    and not self._cordoned(pod):
+                try:
+                    client.delete("v1", "Pod", name, req.namespace)
+                except (ob.NotFound, ob.ApiError):
+                    pass
+                if self.cache is not None:
+                    # fold the delete in (the note_write discipline): a
+                    # stale snapshot would keep showing the dead pod and
+                    # stall its re-provision until the watch catches up
+                    self.cache.note_delete(pod)
+                by_name.pop(name, None)
+                restarted += 1
+        if restarted:
+            status["restarts"] = status.get("restarts", 0) + restarted
+            self._write_status(client, svc)
+            replica_restarts_total().inc(restarted)
+            self.registry.counter_inc(
+                "jaxservice_replica_restarts_total", by=float(restarted),
+                help_="replicas reaped and re-provisioned after dying",
+                namespace=req.namespace, service=req.name)
+            if self.record_events:
+                client.record_event(
+                    svc, "ReplicaRestarted",
+                    f"{restarted} dead replica(s) re-provisioned",
+                    "Warning")
+            # names must free before recreation — poll again shortly
+            self._publish_status(client, svc, req, by_name, phases,
+                                 target, prev_status)
+            return Result(requeue_after=_REQUEUE_FAST)
+
+        # -- provision missing replicas below target -----------------------
+        for i in range(target):
+            name = T.replica_name(req.name, i)
+            if name in by_name:
+                continue
+            pod = self.generate_pod(svc, i)
+            ob.set_owner(pod, svc)
+            try:
+                created = client.create(pod)
+            except ob.Conflict:
+                continue  # old name still releasing; next pass recreates
+            by_name[name] = created
+            phases[name] = (created.get("status") or {}).get(
+                "phase", "Pending")
+            if self.cache is not None:
+                self.cache.note_write(created)
+
+        # -- scale-down drain: indices >= target (the replica_index sort
+        # sentinel puts malformed leftovers here too — drained away, not
+        # aliased to a real slot) --------------------------------------
+        draining = 0
+        for name in sorted(by_name, key=T.replica_index):
+            if T.replica_index(name) < target:
+                continue
+            pod = by_name[name]
+            if not self._cordoned(pod):
+                try:
+                    patched = client.patch(
+                        "v1", "Pod", name,
+                        {"metadata": {"annotations": {
+                            T.ANNOTATION_CORDON: "true"}}},
+                        req.namespace)
+                    by_name[name] = patched
+                    if self.cache is not None:
+                        self.cache.note_write(patched)
+                except ob.NotFound:
+                    by_name.pop(name, None)
+                    continue
+                if self.record_events:
+                    client.record_event(
+                        svc, "ReplicaCordoned",
+                        f"{name} cordoned for scale-down (draining)")
+                draining += 1
+            elif self._replica_drained(req.namespace, req.name, pod,
+                                       T.drain_seconds(svc.get("spec")
+                                                       or {})):
+                try:
+                    client.delete("v1", "Pod", name, req.namespace)
+                except (ob.NotFound, ob.ApiError):
+                    pass
+                if self.cache is not None:
+                    self.cache.note_delete(pod)
+                self._drain_started.pop((req.namespace, name), None)
+                by_name.pop(name, None)
+                phases.pop(name, None)
+                if self.record_events:
+                    client.record_event(
+                        svc, "ReplicaRemoved",
+                        f"{name} drained and removed")
+            else:
+                draining += 1
+        span.attrs["draining"] = draining
+
+        res = self._publish_status(client, svc, req, by_name, phases,
+                                   target, prev_status)
+        span.attrs["ready"] = (status.get("replicas") or {}).get("ready", 0)
+        return res
+
+    # -- status + endpoints --------------------------------------------------
+
+    def _publish_status(self, client, svc, req, by_name, phases, target,
+                        prev_status) -> Result | None:
+        status = svc["status"]
+        ready, pending, cordoned = [], [], []
+        for name in sorted(by_name, key=T.replica_index):
+            pod = by_name[name]
+            if self._cordoned(pod):
+                cordoned.append(name)
+            elif phases.get(name) == "Running":
+                ready.append(name)
+            else:
+                pending.append(name)
+        status["targetReplicas"] = target
+        status["replicas"] = {
+            "desired": target,
+            "ready": len(ready),
+            "pending": len(pending),
+            "cordoned": len(cordoned),
+        }
+        status["replicaStatuses"] = {
+            n: ("Cordoned" if n in cordoned
+                else phases.get(n, "Pending")) for n in sorted(
+                by_name, key=T.replica_index)}
+        all_ready = len(ready) == target and not pending
+        ob.cond_set(svc, T.COND_READY,
+                    "True" if all_ready else "False",
+                    "AllReplicasReady" if all_ready else "ReplicasPending",
+                    f"{len(ready)}/{target} replicas ready")
+        if ob.cond_is_true(svc, T.COND_DEGRADED):
+            ob.cond_set(svc, T.COND_DEGRADED, "False", "Recovered", "")
+
+        self._publish_endpoints(client, svc, req, ready, cordoned, by_name)
+        self._publish_gauges(req, target, ready, pending, cordoned)
+
+        if svc.get("status") != prev_status:
+            self._write_status(client, svc)
+        if pending or cordoned:
+            return Result(requeue_after=_REQUEUE_FAST)
+        if self.signals is not None:
+            # the signal plane is pull-only: keep sampling for the
+            # autoscaler even when the replica set is steady
+            return Result(requeue_after=_REQUEUE_POLL)
+        return None
+
+    def _publish_endpoints(self, client, svc, req, ready, cordoned,
+                           by_name) -> None:
+        """Stamp the router-consumed endpoint list; no-op when the
+        rendered JSON is byte-identical (every write is a watch event —
+        the PR 5 status-storm lesson)."""
+        port = (svc.get("spec") or {}).get("port", T.DEFAULT_PORT)
+        eps = []
+        for name in ready:
+            eps.append({"name": name,
+                        "addr": f"http://{name}.{req.name}."
+                                f"{req.namespace}.svc:{port}",
+                        "state": T.STATE_ACTIVE})
+        for name in cordoned:
+            # only a live cordoned replica still drains; terminal ones
+            # are awaiting deletion and must leave membership entirely
+            if (by_name[name].get("status") or {}).get("phase") \
+                    == "Running":
+                eps.append({"name": name,
+                            "addr": f"http://{name}.{req.name}."
+                                    f"{req.namespace}.svc:{port}",
+                            "state": T.STATE_CORDONED})
+        rendered = render_endpoints(eps)
+        m = ob.meta(svc)
+        if (m.get("annotations") or {}).get(T.ANNOTATION_ENDPOINTS) \
+                == rendered:
+            return
+        try:
+            patched = client.patch(
+                T.API_VERSION, T.KIND, req.name,
+                {"metadata": {"annotations": {
+                    T.ANNOTATION_ENDPOINTS: rendered}}},
+                req.namespace)
+            m.setdefault("annotations", {})[T.ANNOTATION_ENDPOINTS] = \
+                rendered
+            m["resourceVersion"] = ob.meta(patched)["resourceVersion"]
+        except ob.ApiError:
+            log.exception("endpoints annotation patch failed for %s/%s",
+                          req.namespace, req.name)
+
+    def _publish_gauges(self, req, target, ready, pending,
+                        cordoned) -> None:
+        counts = {"desired": target, "ready": len(ready),
+                  "pending": len(pending), "cordoned": len(cordoned)}
+        for state in REPLICA_STATES:
+            self.registry.gauge(
+                "jaxservice_replicas", counts[state],
+                help_="replica counts by state per service",
+                namespace=req.namespace, service=req.name, state=state)
+            replicas_gauge().labels(req.name, state).set(counts[state])
+
+    # -- autoscaler ----------------------------------------------------------
+
+    def _autoscale(self, svc: dict, target: int) -> int:
+        """Demand-driven target with hysteresis. Deterministic given
+        the clock and signal sequence — the serve_bench replay law."""
+        spec = svc.get("spec") or {}
+        reps = T.replicas_spec(spec)
+        mn, mx = reps["min"], reps["max"]
+        target = min(max(target, mn), mx)
+        if self.signals is None or mn == mx:
+            return target
+        m = ob.meta(svc)
+        key = (m["namespace"], m["name"])
+        st = self._scale_state.setdefault(key, {})
+        auto = T.autoscaling_spec(spec)
+        now = self.clock()
+
+        queue = self.signals.queue_depth(m["namespace"], m["name"])
+        total = self.signals.tokens_total(m["namespace"], m["name"])
+        prev = st.get("sample")
+        if prev is not None and now > prev[0]:
+            st["rate"] = max(0.0, (total - prev[1]) / (now - prev[0]))
+            st["sample"] = (now, total)
+        elif prev is None:
+            st["sample"] = (now, total)
+        rate = st.get("rate", 0.0)
+
+        by_queue = math.ceil(queue / auto["targetQueueDepth"])
+        by_rate = math.ceil(rate / auto["targetTokensPerSec"])
+        demand = min(max(by_queue, by_rate, mn), mx)
+
+        if demand == target:
+            st.pop("pending", None)
+            return target
+        direction = "up" if demand > target else "down"
+        pending = st.get("pending")
+        if not pending or pending[0] != direction:
+            st["pending"] = (direction, now)
+            return target
+        window = (auto["scaleUpStabilizationSeconds"] if direction == "up"
+                  else auto["scaleDownStabilizationSeconds"])
+        if now - pending[1] < window:
+            return target
+        st.pop("pending", None)
+        if direction == "up":
+            return demand  # jump to demand: a queue spike wants capacity NOW
+        return target - 1  # step down one: lulls release capacity gently
+
+
+def build_controller(client, record_events: bool = True, registry=None,
+                     signals=None, clock=time.monotonic,
+                     cache: bool = True) -> Controller:
+    """``cache=True`` (default) reads replica pods from an indexed
+    ``ClusterCache`` keyed on the service label — zero per-reconcile
+    list calls (the ISSUE 7 discipline, pinned in tests)."""
+    cluster_cache = None
+    if cache:
+        from kubeflow_tpu.control.cache import ClusterCache
+
+        cluster_cache = ClusterCache(
+            client, kinds=(("v1", "Pod"),),
+            pod_labels=(T.LABEL_SERVICE_NAME,)).connect()
+    rec = JAXServiceReconciler(record_events=record_events,
+                               registry=registry, signals=signals,
+                               clock=clock, cache=cluster_cache)
+    ctl = Controller("jaxservice", client, rec, registry=registry)
+    if cluster_cache is not None:
+        ctl.uses(cluster_cache)
+    ctl.watches_primary(T.API_VERSION, T.KIND)
+    ctl.owns("v1", "Pod").owns("v1", "Service")
+    return ctl

@@ -1,0 +1,282 @@
+"""JAXService CRD: API types, defaults, validation.
+
+The serving analogue of JAXJob (ROADMAP #2): where a JAXJob is one gang
+that runs to completion, a JAXService is N interchangeable model-server
+replicas that run forever behind the token-aware router
+(``serving/router.py``), scaled between ``replicas.min`` and
+``replicas.max`` on router queue depth and tokens/sec. Each replica is
+its own gang of ONE for the gang scheduler — replicas admit
+independently (a serving fleet wants every replica it can get, not
+all-or-nothing), but still get slice-topology placement, spot-pool
+preference and priority from the same scheduler the training plane
+uses.
+
+Status contract: ``status.targetReplicas`` is the autoscaler's durable
+decision (level-triggered provisioning reconciles toward it across
+controller restarts); per-replica phases land in
+``status.replicaStatuses``; the READY endpoint set is published on the
+``ANNOTATION_ENDPOINTS`` metadata annotation — the downward-style feed
+the router consumes (docs/serving.md).
+"""
+
+from __future__ import annotations
+
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
+from kubeflow_tpu.control.scheduler.topology import parse_topology
+
+# The ONE spelling of the controller -> router endpoints wire contract
+# lives with its consumer (serving/router.py, the dist.py pattern);
+# re-exported here for the control plane.
+from kubeflow_tpu.serving.router import (  # noqa: F401
+    ANNOTATION_ENDPOINTS,
+    STATE_ACTIVE,
+    STATE_CORDONED,
+)
+
+GROUP = "kubeflow.org"
+VERSION = "v1alpha1"
+API_VERSION = f"{GROUP}/{VERSION}"
+KIND = "JAXService"
+
+# Condition types (the JAXJob Created/Running/Failed shape, serving
+# vocabulary: a service is Ready, never Succeeded)
+COND_CREATED = "Created"
+COND_READY = "Ready"
+COND_DEGRADED = "Degraded"
+
+# Pod labels (the jaxjob.kubeflow.org/job-name analogue)
+LABEL_SERVICE_NAME = "jaxservice.kubeflow.org/service-name"
+LABEL_REPLICA_INDEX = "jaxservice.kubeflow.org/replica-index"
+
+# Scale-down drain marker on replica PODS: a cordoned replica is
+# published to the router as state=cordoned (no new work), the
+# controller deletes it only once the router reports zero in-flight
+# tokens for it — the drain state machine in docs/serving.md.
+ANNOTATION_CORDON = "jaxservice.kubeflow.org/cordon"
+
+# Env injected into replica containers
+ENV_SERVICE = "JAXSERVICE_NAME"
+ENV_REPLICA = "JAXSERVICE_REPLICA"
+ENV_NAMESPACE = "JAXSERVICE_NAMESPACE"
+
+DEFAULT_PORT = 8500
+
+# Autoscaling defaults: targets are PER-REPLICA capacities; the
+# stabilization windows are the hysteresis (a demand spike shorter than
+# the up window scales nothing, a lull shorter than the down window
+# keeps every replica — docs/serving.md).
+DEFAULT_TARGET_QUEUE_DEPTH = 8
+DEFAULT_TARGET_TOKENS_PER_SEC = 2000.0
+DEFAULT_UP_STABILIZATION_S = 5.0
+DEFAULT_DOWN_STABILIZATION_S = 30.0
+
+# Scale-down drain grace when NO signal plane is wired to the
+# controller (the production default): a Running cordoned replica may
+# still hold multi-minute decodes the controller cannot observe, so it
+# is held this long after cordon before deletion. With signals wired,
+# the router's per-replica in-flight gauge gates the delete instead.
+DEFAULT_DRAIN_SECONDS = 60.0
+
+
+def drain_seconds(spec: dict) -> float:
+    return spec.get("drainSeconds", DEFAULT_DRAIN_SECONDS)
+
+
+def replica_name(service_name: str, index: int) -> str:
+    return f"{service_name}-replica-{index}"
+
+
+def replica_index(pod_name: str) -> int:
+    """Replica slot from a pod name; unparseable names sort AFTER every
+    real replica (the jaxjob worker_index discipline — a malformed
+    leftover must never alias slot 0)."""
+    import sys
+
+    try:
+        return int(pod_name.rsplit("-", 1)[1])
+    except (IndexError, ValueError):
+        return sys.maxsize
+
+
+def replicas_spec(spec: dict) -> dict:
+    """spec.replicas with defaults: {min, max}."""
+    r = spec.get("replicas")
+    if isinstance(r, int):  # shorthand: fixed size, autoscaler clamped
+        return {"min": r, "max": r}
+    r = r if isinstance(r, dict) else {}
+    mn = r.get("min", 1)
+    return {"min": mn, "max": r.get("max", mn)}
+
+
+def autoscaling_spec(spec: dict) -> dict:
+    a = spec.get("autoscaling")
+    a = a if isinstance(a, dict) else {}
+    return {
+        "targetQueueDepth": a.get("targetQueueDepth",
+                                  DEFAULT_TARGET_QUEUE_DEPTH),
+        "targetTokensPerSec": a.get("targetTokensPerSec",
+                                    DEFAULT_TARGET_TOKENS_PER_SEC),
+        "scaleUpStabilizationSeconds": a.get(
+            "scaleUpStabilizationSeconds", DEFAULT_UP_STABILIZATION_S),
+        "scaleDownStabilizationSeconds": a.get(
+            "scaleDownStabilizationSeconds", DEFAULT_DOWN_STABILIZATION_S),
+    }
+
+
+def model_spec(spec: dict) -> dict:
+    m = spec.get("model")
+    m = m if isinstance(m, dict) else {}
+    return {
+        "name": m.get("name", "model"),
+        "ref": m.get("ref", ""),           # zoo model[@checkpoint_dir]
+        "promptLen": m.get("promptLen", 128),
+        "maxNewTokens": m.get("maxNewTokens", 32),
+        "decodeSlots": m.get("decodeSlots", 8),
+        "continuousBatching": bool(m.get("continuousBatching", True)),
+        "paramDtype": m.get("paramDtype", ""),
+    }
+
+
+def new_jaxservice(
+    name: str,
+    namespace: str = "default",
+    *,
+    model: str = "gpt-125m",
+    model_name: str = "chat",
+    min_replicas: int = 1,
+    max_replicas: int | None = None,
+    port: int = DEFAULT_PORT,
+    accelerator: str | None = None,
+    topology: str | None = None,
+    chips_per_replica: int = 4,
+    priority: int = 0,
+    gang_schedule: bool = False,
+    target_queue_depth: int = DEFAULT_TARGET_QUEUE_DEPTH,
+    target_tokens_per_sec: float = DEFAULT_TARGET_TOKENS_PER_SEC,
+    up_stabilization_s: float = DEFAULT_UP_STABILIZATION_S,
+    down_stabilization_s: float = DEFAULT_DOWN_STABILIZATION_S,
+) -> dict:
+    """Convenience constructor (the new_jaxjob analogue)."""
+    spec: dict = {
+        "model": {"name": model_name, "ref": model},
+        "replicas": {"min": min_replicas,
+                     "max": max_replicas if max_replicas is not None
+                     else min_replicas},
+        "port": port,
+        "autoscaling": {
+            "targetQueueDepth": target_queue_depth,
+            "targetTokensPerSec": target_tokens_per_sec,
+            "scaleUpStabilizationSeconds": up_stabilization_s,
+            "scaleDownStabilizationSeconds": down_stabilization_s,
+        },
+    }
+    if priority:
+        spec["priority"] = priority
+    if gang_schedule:
+        spec["schedulerName"] = SCHEDULER_NAME
+    if accelerator:
+        spec["tpu"] = {
+            "accelerator": accelerator,
+            "topology": topology or "",
+            "chipsPerWorker": chips_per_replica,
+        }
+    return ob.new_object(API_VERSION, KIND, name, namespace, spec=spec)
+
+
+def _posint(v) -> bool:
+    return isinstance(v, int) and not isinstance(v, bool) and v >= 1
+
+
+def _posnum(v) -> bool:
+    return (isinstance(v, (int, float)) and not isinstance(v, bool)
+            and v > 0)
+
+
+def validate(svc: dict) -> list[str]:
+    """Spec validation; problems become Degraded-condition reasons."""
+    errs: list[str] = []
+    spec = svc.get("spec") or {}
+    model = model_spec(spec)
+    if not model["ref"] or not isinstance(model["ref"], str):
+        errs.append("spec.model.ref must name a zoo model "
+                    "(e.g. 'gpt-125m' or 'gpt-125m@/ckpt/dir')")
+    for k in ("promptLen", "maxNewTokens", "decodeSlots"):
+        if not _posint(model[k]):
+            errs.append(f"spec.model.{k} must be a positive int, "
+                        f"got {model[k]!r}")
+    reps = replicas_spec(spec)
+    mn, mx = reps["min"], reps["max"]
+    if not _posint(mn):
+        errs.append(f"spec.replicas.min must be a positive int, got {mn!r}")
+    if not _posint(mx):
+        errs.append(f"spec.replicas.max must be a positive int, got {mx!r}")
+    if _posint(mn) and _posint(mx) and mn > mx:
+        errs.append(f"spec.replicas.min {mn} > max {mx}")
+    port = spec.get("port", DEFAULT_PORT)
+    if not isinstance(port, int) or not (0 < port < 65536):
+        errs.append(f"spec.port invalid: {port!r}")
+    prio = spec.get("priority", 0)
+    if not isinstance(prio, int) or isinstance(prio, bool):
+        errs.append(f"spec.priority must be an int, got {prio!r}")
+    auto = autoscaling_spec(spec)
+    if not _posint(auto["targetQueueDepth"]):
+        errs.append("spec.autoscaling.targetQueueDepth must be a "
+                    f"positive int, got {auto['targetQueueDepth']!r}")
+    if not _posnum(auto["targetTokensPerSec"]):
+        errs.append("spec.autoscaling.targetTokensPerSec must be a "
+                    f"positive number, got {auto['targetTokensPerSec']!r}")
+    for k in ("scaleUpStabilizationSeconds",
+              "scaleDownStabilizationSeconds"):
+        v = auto[k]
+        if not (isinstance(v, (int, float)) and not isinstance(v, bool)
+                and v >= 0):
+            errs.append(f"spec.autoscaling.{k} must be a non-negative "
+                        f"number, got {v!r}")
+    drain = drain_seconds(spec)
+    if not (isinstance(drain, (int, float)) and not isinstance(drain, bool)
+            and drain >= 0):
+        errs.append("spec.drainSeconds must be a non-negative number, "
+                    f"got {drain!r}")
+    tpu = spec.get("tpu") or {}
+    topology = tpu.get("topology") or ""
+    if topology:
+        try:
+            parse_topology(topology)
+        except ValueError:
+            errs.append(f"spec.tpu.topology {topology!r} is not NxM[xK]")
+    return errs
+
+
+def crd_manifest() -> dict:
+    """The CustomResourceDefinition applied by tpctl."""
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": f"jaxservices.{GROUP}"},
+        "spec": {
+            "group": GROUP,
+            "names": {
+                "kind": KIND,
+                "listKind": "JAXServiceList",
+                "plural": "jaxservices",
+                "singular": "jaxservice",
+                "shortNames": ["jsvc"],
+            },
+            "scope": "Namespaced",
+            "versions": [
+                {
+                    "name": VERSION,
+                    "served": True,
+                    "storage": True,
+                    "subresources": {"status": {}},
+                    "schema": {
+                        "openAPIV3Schema": {
+                            "type": "object",
+                            "x-kubernetes-preserve-unknown-fields": True,
+                        }
+                    },
+                }
+            ],
+        },
+    }

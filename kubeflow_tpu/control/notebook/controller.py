@@ -23,13 +23,10 @@ from kubeflow_tpu.control.runtime import Controller, Reconciler, Request, Result
 
 log = logging.getLogger("kubeflow_tpu.notebook")
 
-_METRICS: dict[str, object] = {}
-
-
 def _metric(name, kind, doc):
-    if name not in _METRICS:
-        _METRICS[name] = kind(name, doc)
-    return _METRICS[name]
+    from kubeflow_tpu.runtime.metrics import prom_metric
+
+    return prom_metric(name, kind, doc)
 
 
 # metrics.go:27-61 names kept
@@ -110,8 +107,29 @@ def cluster_domain() -> str:
 
 
 class NotebookReconciler(Reconciler):
-    def __init__(self, probe=culler.default_probe):
+    def __init__(self, probe=culler.default_probe, cache=None):
         self.probe = probe
+        # indexed ClusterCache (ROADMAP #3's remaining wiring): pod and
+        # Event reads come from the snapshot instead of per-reconcile
+        # list calls; None keeps the legacy relist shape.
+        self.cache = cache
+
+    def _nb_pods(self, client, namespace: str, name: str) -> list[dict]:
+        if self.cache is not None:
+            return self.cache.pods_by_label(
+                T.LABEL_NOTEBOOK_NAME, namespace, name)
+        return client.list(
+            "v1", "Pod", namespace=namespace,
+            label_selector={"matchLabels": {T.LABEL_NOTEBOOK_NAME: name}},
+        )
+
+    def _ns_events(self, client, namespace: str) -> list[dict]:
+        if self.cache is not None:
+            # O(namespace bucket): Events are the churniest,
+            # highest-cardinality kind — a cluster-wide snapshot scan
+            # per reconcile would defeat the indexed-cache wiring
+            return self.cache.objects_ns("v1", "Event", namespace)
+        return client.list("v1", "Event", namespace=namespace)
 
     # -- generators ---------------------------------------------------------
 
@@ -190,6 +208,8 @@ class NotebookReconciler(Reconciler):
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self, client, req: Request) -> Result | None:
+        if self.cache is not None:
+            self.cache.refresh()
         nb = client.get_or_none(T.API_VERSION, T.KIND, req.name, req.namespace)
         if nb is None or ob.meta(nb).get("deletionTimestamp"):
             return None
@@ -210,10 +230,7 @@ class NotebookReconciler(Reconciler):
             rh.reconcile_child(client, nb, self.generate_virtual_service(nb))
 
         # -- status from pod container state (:200-231) --------------------
-        pods = client.list(
-            "v1", "Pod", namespace=req.namespace,
-            label_selector={"matchLabels": {T.LABEL_NOTEBOOK_NAME: req.name}},
-        )
+        pods = self._nb_pods(client, req.namespace, req.name)
         status = nb.setdefault("status", {})
         status["readyReplicas"] = sum(
             1 for p in pods
@@ -261,24 +278,49 @@ class NotebookReconciler(Reconciler):
         pod_names = {ob.meta(p)["name"] for p in pods}
         if not pod_names:
             return
-        for ev in client.list("v1", "Event", namespace=ob.meta(nb)["namespace"]):
+        events = self._ns_events(client, ob.meta(nb)["namespace"])
+        # forwarded-marker set computed ONCE per reconcile (the legacy
+        # shape re-listed the namespace's events per candidate)
+        forwarded = {
+            e.get("source", {}).get("component")
+            for e in events
+            if (e.get("involvedObject") or {}).get("uid") == nb_uid
+        }
+        for ev in events:
             inv = ev.get("involvedObject") or {}
             if inv.get("kind") != "Pod" or inv.get("name") not in pod_names:
                 continue
             marker = f"nb-fwd-{ev['metadata']['name']}"
-            if any(
-                e.get("source", {}).get("component") == marker
-                for e in client.list("v1", "Event", namespace=ob.meta(nb)["namespace"])
-                if (e.get("involvedObject") or {}).get("uid") == nb_uid
-            ):
+            if marker in forwarded:
                 continue
-            client.record_event(nb, ev.get("reason", ""), ev.get("message", ""),
-                                ev.get("type", "Normal"), component=marker)
+            rec = client.record_event(nb, ev.get("reason", ""),
+                                      ev.get("message", ""),
+                                      ev.get("type", "Normal"),
+                                      component=marker)
+            if self.cache is not None and rec:
+                # fold our own marker in (the note_write discipline): a
+                # pumped snapshot lagging the watch would re-forward the
+                # same pod event on the next reconcile
+                self.cache.note_write(rec)
 
 
-def build_controller(client, probe=culler.default_probe) -> Controller:
-    rec = NotebookReconciler(probe=probe)
+def build_controller(client, probe=culler.default_probe,
+                     cache: bool = True) -> Controller:
+    """``cache=True`` (default) serves the reconciler's pod and Event
+    reads from an indexed ``ClusterCache`` — zero per-reconcile list
+    calls (pinned in tests/test_cache.py); ``cache=False`` keeps the
+    legacy relist shape."""
+    cluster_cache = None
+    if cache:
+        from kubeflow_tpu.control.cache import ClusterCache
+
+        cluster_cache = ClusterCache(
+            client, kinds=(("v1", "Pod"), ("v1", "Event")),
+            pod_labels=(T.LABEL_NOTEBOOK_NAME,)).connect()
+    rec = NotebookReconciler(probe=probe, cache=cluster_cache)
     ctl = Controller("notebook", client, rec)
+    if cluster_cache is not None:
+        ctl.uses(cluster_cache)
     ctl.watches_primary(T.API_VERSION, T.KIND)
     ctl.owns("apps/v1", "StatefulSet").owns("v1", "Service")
 

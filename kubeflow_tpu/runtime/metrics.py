@@ -225,6 +225,25 @@ class MetricsRegistry:
         out.append(f"{name}_sum{suffix} {hist.sum}")
         out.append(f"{name}_count{suffix} {hist.count}")
 
+    def series(self, name: str) -> list[tuple[dict, float]]:
+        """Structured read of one scalar metric's samples as
+        ``(labels, value)`` pairs — the in-process fast path for
+        consumers like the JAXService autoscaler's ``RegistrySignals``
+        (parsing the full text exposition per signal read would cost
+        O(total series) per reconcile). Histogram samples are skipped;
+        read those through ``render()``."""
+        out: list[tuple[dict, float]] = []
+        with self._lock:
+            entry = self._metrics.get(name)
+            if entry is None:
+                return out
+            _, _, samples = entry
+            for key, value in samples.items():
+                if isinstance(value, _Histogram):
+                    continue
+                out.append((dict(key), float(value)))
+        return out
+
     def render(self) -> str:
         out = []
         with self._lock:
@@ -244,6 +263,22 @@ class MetricsRegistry:
 
 
 REGISTRY = MetricsRegistry()
+
+# -- prometheus_client interop ------------------------------------------------
+
+_PROM_METRICS: dict[str, object] = {}
+
+
+def prom_metric(name: str, kind, doc: str, **kw):
+    """Process-global memoized prometheus_client metric: registering a
+    name twice raises in prometheus_client, and several subsystems
+    (serving server, control plane, router) share one process in tests
+    and benches. The ONE spelling of that guard — the per-module copies
+    in serving/server.py and control/jaxjob/controller.py delegate
+    here."""
+    if name not in _PROM_METRICS:
+        _PROM_METRICS[name] = kind(name, doc, **kw)
+    return _PROM_METRICS[name]
 
 
 class _Handler(BaseHTTPRequestHandler):

@@ -1,0 +1,788 @@
+"""Token-aware serving router — the JAXService front door.
+
+A single replica server (``serving/server.py``) saturates at one
+decoder's throughput (BENCH_r05: 1.07 req/s); the serving plane runs N
+replicas behind this router. Replica choice is least-outstanding-TOKENS,
+not least-connections: decode cost scales with tokens (prompt prefill +
+requested continuation), so one 2k-token request weighs as much as
+thirty short ones — balancing on request counts would pile long prompts
+onto one replica while its neighbors idle.
+
+Design mirrors the gang scheduler's split (``scheduler/queue.py``): a
+DETERMINISTIC synchronous core (``TokenRouter`` — every transition
+happens in an explicit call under one lock, clock injectable) with a
+thin threaded/HTTP shell (``RouterFrontend``) for production. The core
+is what the JAXService benchmark (``tools/serve_bench.py``) replays
+decision-for-decision per seed, and what the drain/kill drills prove
+zero-drop on:
+
+- bounded admission queue: ``submit`` beyond ``max_queue`` raises
+  ``RouterBusy`` (the HTTP shell's 429) — backpressure instead of an
+  unbounded latency cliff;
+- membership is CONTROLLER-FED through the JAXService endpoints
+  annotation (``ANNOTATION_ENDPOINTS``, the ONE spelling — the
+  jaxservice controller re-exports it): only replicas the controller
+  reports Ready receive work, a cordoned replica finishes its in-flight
+  tokens but admits nothing new (connection draining), and a replica
+  REMOVED from membership (killed) has its in-flight requests shed back
+  to the queue FRONT and re-dispatched to survivors — zero drops;
+- every dispatch opens a ``router.dispatch`` span parented on the
+  request's W3C traceparent, so a request timeline connects through the
+  router hop to the replica's serving spans (docs/observability.md).
+
+Metrics go to BOTH sinks (the PR 4 convention): the MetricsRegistry
+(``router_queue_depth``, ``router_tokens_inflight{replica}``,
+``router_request_seconds`` native histogram, ``router_tokens_total``)
+that the JAXService autoscaler reads its signals from, and
+prometheus_client for the scrape surface.
+
+jax-free by design: the control plane imports this module (the
+endpoints wire contract and ``RegistrySignals``) without pulling a jax
+runtime in.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
+
+log = logging.getLogger("kubeflow_tpu.serving.router")
+
+# The controller -> router membership wire contract: a JSON list of
+# {"name", "addr", "state"} stamped on the JAXService object. "active"
+# members take new work; "cordoned" members only drain. The jaxservice
+# controller writes it, the router consumes it — one spelling, here
+# (control/jaxservice/types.py re-exports it, the dist.py pattern).
+ANNOTATION_ENDPOINTS = "jaxservice.kubeflow.org/endpoints"
+STATE_ACTIVE = "active"
+STATE_CORDONED = "cordoned"
+
+# Request-latency buckets: sub-second cache hits up to multi-minute
+# long-context decodes under queueing.
+REQUEST_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                   60.0, 120.0, 300.0)
+
+def _prom_metric(name, kind, doc, **kw):
+    from kubeflow_tpu.runtime.metrics import prom_metric
+
+    return prom_metric(name, kind, doc, **kw)
+
+
+def prom_queue_depth():
+    import prometheus_client as prom
+
+    return _prom_metric("router_queue_depth", prom.Gauge,
+                        "requests waiting in the router admission queue",
+                        labelnames=("service",))
+
+
+def prom_tokens_inflight():
+    import prometheus_client as prom
+
+    return _prom_metric("router_tokens_inflight", prom.Gauge,
+                        "outstanding token estimate per replica",
+                        labelnames=("service", "replica"))
+
+
+def prom_request_seconds():
+    import prometheus_client as prom
+
+    return _prom_metric("router_request_seconds", prom.Histogram,
+                        "submit -> completion latency through the router",
+                        labelnames=("service",), buckets=REQUEST_BUCKETS)
+
+
+def prom_requests_total():
+    import prometheus_client as prom
+
+    return _prom_metric("router_requests_total", prom.Counter,
+                        "requests by outcome (completed/rejected/shed)",
+                        labelnames=("service", "outcome"))
+
+
+def prom_tokens_total():
+    import prometheus_client as prom
+
+    return _prom_metric("router_tokens_total", prom.Counter,
+                        "tokens completed through the router "
+                        "(rate = the autoscaler's tokens/sec signal)",
+                        labelnames=("service",))
+
+
+class RouterBusy(Exception):
+    """Admission queue full — the HTTP shell's 429 Too Many Requests."""
+
+
+@dataclass
+class Member:
+    """One routable replica. ``transport`` is whatever the shell uses
+    to reach it (an HTTP base URL, an in-process callable, a bench
+    stub) — the core never calls it, it only hands it back on
+    dispatch."""
+
+    name: str
+    transport: Any = None
+    state: str = STATE_ACTIVE
+
+
+@dataclass
+class Ticket:
+    """One request's journey through the router. ``member`` is set at
+    dispatch (None while queued); ``done`` fires on dispatch AND on
+    completion so a blocking shell can wait on either transition.
+    ``tried`` holds replicas whose transport already FAILED this
+    ticket — re-dispatch prefers anyone else (the name-tie-break would
+    otherwise send every retry straight back to the dead replica)."""
+
+    tokens: int
+    item: Any = None
+    context: "obs_trace.SpanContext | None" = None
+    member: Member | None = None
+    done: threading.Event = field(default_factory=threading.Event,
+                                  repr=False)
+    tried: set = field(default_factory=set, repr=False)
+    _t0: float = 0.0
+    _span: "obs_trace.Span | None" = field(default=None, repr=False)
+    _queued_at: float = 0.0
+
+
+def estimate_tokens(instances: list, max_new_tokens: int) -> int:
+    """The in-flight cost estimate for a predict body: prompt tokens
+    (prefill) plus the full requested continuation per row. An estimate
+    on purpose — the router needs relative weight, not billing."""
+    total = 0
+    for inst in instances or [None]:
+        row = inst.get("tokens") if isinstance(inst, dict) else inst
+        total += (len(row) if hasattr(row, "__len__") else 1)
+        total += max_new_tokens
+    return max(total, 1)
+
+
+class TokenRouter:
+    """Deterministic least-outstanding-tokens dispatcher.
+
+    All state lives under one lock and is mutated only in locked
+    methods (the LOCK201-provable fresh-container idiom); transports
+    are never invoked here, so no I/O happens under the lock.
+    """
+
+    def __init__(self, service: str = "default", namespace: str = "default",
+                 max_queue: int = 256,
+                 replica_token_budget: int | None = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 registry: MetricsRegistry | None = None,
+                 tracer=None, prom_sink: bool = True):
+        self.service = service
+        self.namespace = namespace
+        self.max_queue = max_queue
+        # max outstanding tokens a replica accepts before the router
+        # queues instead (None = always eligible; the least-loaded
+        # replica still wins). Roughly slots * (prompt + continuation).
+        self.replica_token_budget = replica_token_budget
+        self.clock = clock
+        self.registry = registry if registry is not None else REGISTRY
+        self.tracer = tracer if tracer is not None else obs_trace.TRACER
+        # prometheus is process-global; the deterministic bench runs
+        # many routers per process and opts out of the shared sink
+        self._prom = prom_sink
+        self._lock = threading.Lock()
+        self._members: dict[str, Member] = {}
+        self._inflight: dict[str, dict[int, Ticket]] = {}  # name -> tickets
+        self._tokens: dict[str, int] = {}                  # name -> estimate
+        self._queue: list[Ticket] = []
+        self._closed = False
+
+    # -- membership (controller-fed) ----------------------------------------
+
+    def sync_endpoints(self, endpoints: list[dict],
+                       transport_factory: Callable[[dict], Any] | None = None,
+                       ) -> list[Ticket]:
+        """Apply a controller-published endpoint list (the parsed
+        ``ANNOTATION_ENDPOINTS`` value). Returns the tickets re-DISPATCHED
+        after shedding removed members (see ``set_members``)."""
+        members = []
+        for ep in endpoints:
+            name = ep.get("name")
+            if not name:
+                continue
+            members.append(Member(
+                name=name,
+                transport=(transport_factory(ep) if transport_factory
+                           else ep.get("addr")),
+                state=(STATE_CORDONED if ep.get("state") == STATE_CORDONED
+                       else STATE_ACTIVE)))
+        return self.set_members(members)
+
+    def sync_from_object(self, service_obj: dict,
+                         transport_factory=None) -> list[Ticket]:
+        """Membership straight from a JAXService object (a watch-driven
+        shell calls this per event)."""
+        return self.sync_endpoints(
+            parse_endpoints(service_obj), transport_factory)
+
+    def set_members(self, members: list[Member]) -> list[Ticket]:
+        """Replace membership. A member that disappears sheds its
+        in-flight tickets back to the queue FRONT (oldest first) and a
+        drain pass re-dispatches to survivors — the zero-drop half of
+        the replica-kill drill. Returns the newly dispatched tickets so
+        a synchronous driver can start their work on the survivors."""
+        with self._lock:
+            now = self.clock()
+            new = {m.name: m for m in members}
+            shed: list[Ticket] = []
+            for name in list(self._members):
+                if name not in new:
+                    shed.extend(self._shed_member_locked(name, now))
+            for name, m in new.items():
+                cur = self._members.get(name)
+                if cur is None:
+                    self._members[name] = m
+                    self._inflight.setdefault(name, {})
+                    self._tokens.setdefault(name, 0)
+                    self._publish_inflight_locked(name)
+                else:
+                    cur.state = m.state
+                    cur.transport = m.transport
+            # requeue shed tickets at the FRONT, original order. done is
+            # CLEARED: a blocking shell waiting on this ticket must park
+            # until the re-dispatch below (or a later drain) fires it
+            # again — a stale set() would busy-spin its retry loop
+            for t in reversed(shed):
+                t.member = None
+                t.done.clear()
+                self._queue.insert(0, t)
+            dispatched = self._drain_locked(now)
+            self._publish_queue_locked()
+        for t in dispatched:
+            t.done.set()
+        return dispatched
+
+    def cordon(self, name: str) -> None:
+        """Stop NEW dispatch to a replica; in-flight work finishes
+        (connection draining). The controller cordons before delete."""
+        with self._lock:
+            m = self._members.get(name)
+            if m is not None:
+                m.state = STATE_CORDONED
+
+    def uncordon(self, name: str) -> None:
+        with self._lock:
+            m = self._members.get(name)
+            if m is not None:
+                m.state = STATE_ACTIVE
+        self.kick()
+
+    def _shed_member_locked(self, name: str, now: float) -> list[Ticket]:
+        """Remove a member; return its in-flight tickets oldest-first."""
+        self._members.pop(name, None)
+        tickets = sorted(self._inflight.pop(name, {}).values(),
+                         key=lambda t: t._t0)
+        self._tokens.pop(name, None)
+        for t in tickets:
+            if t._span is not None:
+                # the dispatch to the dead replica exports as ERROR; the
+                # re-dispatch below opens a fresh span in the same trace
+                t._span.status = "ERROR"
+                t._span.error = f"replica {name} lost; shed to survivors"
+                self.tracer.finish(t._span)
+                t._span = None
+            self._count_locked("shed")
+        self.registry.gauge(
+            "router_tokens_inflight", 0,
+            help_="outstanding token estimate per replica",
+            namespace=self.namespace, service=self.service, replica=name)
+        if self._prom:
+            prom_tokens_inflight().labels(self.service, name).set(0)
+        return tickets
+
+    # -- admission -----------------------------------------------------------
+
+    def submit(self, tokens: int, item: Any = None,
+               context: "obs_trace.SpanContext | None" = None) -> Ticket:
+        """Admit one request of ``tokens`` estimated cost. Dispatches
+        immediately to the least-loaded eligible replica, else queues;
+        raises ``RouterBusy`` (429) when the bounded queue is full."""
+        t = Ticket(tokens=int(tokens), item=item, context=context)
+        with self._lock:
+            if self._closed:
+                raise RouterBusy("router is shut down")
+            now = self.clock()
+            t._t0 = t._queued_at = now
+            member = self._pick_locked(t.tokens)
+            if member is not None:
+                self._dispatch_locked(t, member, now)
+            elif len(self._queue) >= self.max_queue:
+                self._count_locked("rejected")
+                raise RouterBusy(
+                    f"admission queue full ({self.max_queue})")
+            else:
+                self._queue.append(t)
+            self._publish_queue_locked()
+        if t.member is not None:
+            t.done.set()
+        return t
+
+    def complete(self, ticket: Ticket, tokens_done: int | None = None,
+                 ) -> list[Ticket]:
+        """Mark a dispatched ticket finished; drain the queue into the
+        freed capacity. Returns newly dispatched tickets (their
+        ``member`` set) for synchronous drivers.
+
+        Shed-race safe, symmetric to ``fail``: if a concurrent
+        membership sync shed this ticket back into the queue while its
+        transport call was succeeding, the queued copy is removed here
+        — the handler thread has already returned the response, so a
+        re-dispatch would permanently inflate the survivor's in-flight
+        accounting (nobody is left to complete it) and wedge its drain
+        gate."""
+        with self._lock:
+            now = self.clock()
+            if ticket.member is None:
+                self._queue = [t for t in self._queue if t is not ticket]
+            self._finish_locked(ticket, now, tokens_done)
+            dispatched = self._drain_locked(now)
+            self._publish_queue_locked()
+        for t in dispatched:
+            t.done.set()
+        return dispatched
+
+    def fail(self, ticket: Ticket, requeue: bool = True) -> list[Ticket]:
+        """A transport-level failure for one dispatched ticket: take it
+        off its replica and (by default) requeue it at the FRONT for a
+        retry on whoever is least loaded now. ``requeue=False`` drops
+        it (the caller is surfacing the error to its client).
+
+        Safe against the shed race: if a concurrent membership sync
+        already shed this ticket back into the queue (``member`` is
+        None), a requeue is a no-op — inserting it AGAIN would have it
+        dispatched twice and permanently inflate a replica's in-flight
+        accounting — and a drop removes it from the queue so nothing
+        ghost-dispatches a request whose handler thread has given up."""
+        with self._lock:
+            now = self.clock()
+            member = ticket.member
+            if member is not None:
+                # remember the failed transport: the retry must prefer
+                # any OTHER replica (least-loaded + name-tie would
+                # otherwise re-pick the dead one forever)
+                ticket.tried.add(member.name)
+                bucket = self._inflight.get(member.name)
+                if bucket is not None and bucket.pop(id(ticket), None) \
+                        is not None:
+                    self._tokens[member.name] = max(
+                        0, self._tokens.get(member.name, 0) - ticket.tokens)
+                    self._publish_inflight_locked(member.name)
+            if ticket._span is not None:
+                ticket._span.status = "ERROR"
+                ticket._span.error = "transport failure"
+                self.tracer.finish(ticket._span)
+                ticket._span = None
+            ticket.member = None
+            queued = any(t is ticket for t in self._queue)
+            if requeue:
+                ticket.done.clear()
+                if not queued:
+                    self._queue.insert(0, ticket)
+                    self._count_locked("shed")
+            else:
+                if queued:
+                    self._queue = [t for t in self._queue
+                                   if t is not ticket]
+                self._count_locked("failed")
+            dispatched = self._drain_locked(now)
+            self._publish_queue_locked()
+        for t in dispatched:
+            t.done.set()
+        return dispatched
+
+    def kick(self) -> list[Ticket]:
+        """Re-try queued dispatch (capacity may have appeared through a
+        membership edit rather than a completion)."""
+        with self._lock:
+            dispatched = self._drain_locked(self.clock())
+            self._publish_queue_locked()
+        for t in dispatched:
+            t.done.set()
+        return dispatched
+
+    def close(self) -> list[Ticket]:
+        """Reject everything still queued (shell shutdown)."""
+        with self._lock:
+            self._closed = True
+            orphans, self._queue = self._queue, []
+            self._publish_queue_locked()
+        for t in orphans:
+            t.done.set()
+        return orphans
+
+    # -- introspection (the controller's drain checks ride on these) ---------
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def inflight_tokens(self, name: str | None = None) -> int:
+        with self._lock:
+            if name is not None:
+                return self._tokens.get(name, 0)
+            return sum(self._tokens.values())
+
+    def drained(self, name: str) -> bool:
+        """True when a cordoned replica holds no in-flight work — the
+        controller's delete gate."""
+        with self._lock:
+            return not self._inflight.get(name)
+
+    def members(self) -> dict[str, str]:
+        with self._lock:
+            return {n: m.state for n, m in self._members.items()}
+
+    # -- locked internals ----------------------------------------------------
+
+    def _pick_locked(self, tokens: int,
+                     exclude: set | frozenset = frozenset(),
+                     ) -> Member | None:
+        """Least-outstanding-tokens over ACTIVE members; name breaks
+        ties so replays are order-independent. Budget-full replicas are
+        skipped (the request queues for the next completion). Members
+        in ``exclude`` (a retrying ticket's failed transports) are
+        avoided — unless they are ALL that's left, in which case a
+        retry beats starvation."""
+        best = None
+        best_key = None
+        for name, m in self._members.items():
+            if m.state != STATE_ACTIVE:
+                continue
+            load = self._tokens.get(name, 0)
+            if self.replica_token_budget is not None and load > 0 \
+                    and load + tokens > self.replica_token_budget:
+                continue
+            key = (name in exclude, load, name)
+            if best_key is None or key < best_key:
+                best, best_key = m, key
+        return best
+
+    def _dispatch_locked(self, t: Ticket, member: Member,
+                         now: float) -> None:
+        t.member = member
+        self._inflight.setdefault(member.name, {})[id(t)] = t
+        self._tokens[member.name] = \
+            self._tokens.get(member.name, 0) + t.tokens
+        # detached: finish() runs in a LATER call (complete/fail/shed),
+        # so this span must never install itself as the ambient parent —
+        # an out-of-order reset would pollute the caller's contextvar
+        t._span = self.tracer.begin(
+            "router.dispatch", parent=t.context, detached=True,
+            service=self.service, namespace=self.namespace,
+            replica=member.name, tokens=t.tokens,
+            queue_wait_s=round(max(now - t._queued_at, 0.0), 6))
+        self._publish_inflight_locked(member.name)
+
+    def _finish_locked(self, t: Ticket, now: float,
+                       tokens_done: int | None) -> None:
+        member = t.member
+        if member is not None:
+            bucket = self._inflight.get(member.name)
+            if bucket is not None:
+                bucket.pop(id(t), None)
+            self._tokens[member.name] = max(
+                0, self._tokens.get(member.name, 0) - t.tokens)
+            self._publish_inflight_locked(member.name)
+        if t._span is not None:
+            self.tracer.finish(t._span)
+            t._span = None
+        latency = max(now - t._t0, 0.0)
+        done = t.tokens if tokens_done is None else int(tokens_done)
+        self.registry.histogram(
+            "router_request_seconds", latency,
+            help_="submit -> completion latency through the router",
+            buckets=REQUEST_BUCKETS,
+            namespace=self.namespace, service=self.service)
+        self.registry.counter_inc(
+            "router_tokens_total",
+            help_="tokens completed through the router (rate = the "
+                  "autoscaler's tokens/sec signal)",
+            by=float(done), namespace=self.namespace, service=self.service)
+        self._count_locked("completed")
+        if self._prom:
+            prom_request_seconds().labels(self.service).observe(latency)
+            prom_tokens_total().labels(self.service).inc(done)
+
+    def _drain_locked(self, now: float) -> list[Ticket]:
+        """FIFO-drain the queue into whatever capacity exists."""
+        dispatched: list[Ticket] = []
+        remaining: list[Ticket] = []
+        for t in self._queue:
+            member = self._pick_locked(t.tokens, exclude=t.tried)
+            if member is None:
+                remaining.append(t)
+                continue
+            self._dispatch_locked(t, member, now)
+            dispatched.append(t)
+        self._queue = remaining
+        return dispatched
+
+    def _publish_queue_locked(self) -> None:
+        self.registry.gauge(
+            "router_queue_depth", len(self._queue),
+            help_="requests waiting in the router admission queue",
+            namespace=self.namespace, service=self.service)
+        if self._prom:
+            prom_queue_depth().labels(self.service).set(len(self._queue))
+
+    def _publish_inflight_locked(self, name: str) -> None:
+        self.registry.gauge(
+            "router_tokens_inflight", self._tokens.get(name, 0),
+            help_="outstanding token estimate per replica",
+            namespace=self.namespace, service=self.service, replica=name)
+        if self._prom:
+            prom_tokens_inflight().labels(self.service, name).set(
+                self._tokens.get(name, 0))
+
+    def _count_locked(self, outcome: str) -> None:
+        self.registry.counter_inc(
+            "router_requests_total",
+            help_="requests by outcome (completed/rejected/shed/failed)",
+            namespace=self.namespace, service=self.service, outcome=outcome)
+        if self._prom:
+            prom_requests_total().labels(self.service, outcome).inc()
+
+
+# -- endpoints annotation helpers -------------------------------------------
+
+
+def render_endpoints(endpoints: list[dict]) -> str:
+    """Canonical JSON for the annotation (sorted, compact) so an
+    unchanged endpoint set patches to an identical string — the
+    controller's no-op write guard compares it byte-for-byte."""
+    return json.dumps(sorted(endpoints, key=lambda e: e.get("name", "")),
+                      separators=(",", ":"), sort_keys=True)
+
+
+def parse_endpoints(service_obj: dict) -> list[dict]:
+    """The endpoint list a JAXService object currently publishes."""
+    raw = ((service_obj.get("metadata") or {}).get("annotations") or {}) \
+        .get(ANNOTATION_ENDPOINTS)
+    if not raw:
+        return []
+    try:
+        eps = json.loads(raw)
+    except ValueError:
+        log.warning("malformed %s annotation ignored", ANNOTATION_ENDPOINTS)
+        return []
+    return [e for e in eps if isinstance(e, dict) and e.get("name")]
+
+
+# -- autoscaler signal source -----------------------------------------------
+
+
+class RegistrySignals:
+    """The JAXService autoscaler's signal reader: parses the router- and
+    replica-exported series back out of a MetricsRegistry's text
+    exposition (the PR 4 histograms ARE the wire — in production the
+    same text arrives by scraping the router's /metrics; hermetically
+    the registry is shared in-process). Series names are the catalog in
+    docs/observability.md."""
+
+    def __init__(self, registry):
+        # a MetricsRegistry (shared-process fast path), or a zero-arg
+        # callable returning an exposition body — the scraped-/metrics
+        # source for a controller running out-of-process from the router
+        self.registry = registry
+
+    def _series(self, name: str) -> list[tuple[dict, float]]:
+        # in-process fast path: structured samples straight off the
+        # registry (O(metric) instead of rendering + parsing the whole
+        # exposition per signal read). The text parser below serves
+        # callable sources (a scraped /metrics body).
+        reader = getattr(self.registry, "series", None)
+        if reader is not None:
+            return reader(name)
+        text = self.registry() if callable(self.registry) \
+            else self.registry.render()
+        out = []
+        for line in text.splitlines():
+            if not line.startswith(name) or line.startswith("#"):
+                continue
+            head, _, value = line.rpartition(" ")
+            if head.rstrip("}") == name:
+                head_name, labels = name, {}
+            else:
+                head_name, _, rest = head.partition("{")
+                if head_name != name or not rest.endswith("}"):
+                    continue
+                labels = {}
+                for kv in rest[:-1].split(","):
+                    k, _, v = kv.partition("=")
+                    labels[k] = v.strip('"')
+            try:
+                out.append((labels, float(value)))
+            except ValueError:
+                continue
+        return out
+
+    def _sum(self, name: str, **match) -> float:
+        total = 0.0
+        for labels, value in self._series(name):
+            if all(labels.get(k) == v for k, v in match.items()):
+                total += value
+        return total
+
+    def queue_depth(self, namespace: str, service: str) -> float:
+        return self._sum("router_queue_depth",
+                         namespace=namespace, service=service)
+
+    def tokens_total(self, namespace: str, service: str) -> float:
+        return self._sum("router_tokens_total",
+                         namespace=namespace, service=service)
+
+    def inflight_tokens(self, namespace: str, service: str,
+                        replica: str | None = None) -> float:
+        match = {"namespace": namespace, "service": service}
+        if replica is not None:
+            match["replica"] = replica
+        return self._sum("router_tokens_inflight", **match)
+
+    def replica_drained(self, namespace: str, service: str,
+                        replica: str) -> bool:
+        return self.inflight_tokens(namespace, service, replica) <= 0
+
+
+# -- threaded/HTTP shell ----------------------------------------------------
+
+
+class HttpTransport:
+    """POST a predict body to a replica server (urllib; stdlib-only,
+    the RestClient discipline)."""
+
+    def __init__(self, base_url: str, timeout: float = 300.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+
+    def predict(self, model: str, body: bytes,
+                headers: dict | None = None) -> bytes:
+        import urllib.request
+
+        req = urllib.request.Request(
+            f"{self.base_url}/v1/models/{model}:predict", data=body,
+            headers={"Content-Type": "application/json", **(headers or {})},
+            method="POST")
+        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            return resp.read()
+
+
+class RouterFrontend:
+    """The blocking HTTP face over the deterministic core: one handler
+    thread carries its request end-to-end (submit -> wait for dispatch
+    -> call the replica transport -> complete), so the router itself
+    never blocks under its lock."""
+
+    def __init__(self, router: TokenRouter, max_new_tokens: int = 32,
+                 dispatch_timeout: float = 120.0):
+        self.router = router
+        self.max_new_tokens = max_new_tokens
+        self.dispatch_timeout = dispatch_timeout
+
+    def predict(self, req):
+        from kubeflow_tpu.utils.httpd import ApiHttpError
+
+        model = req.params["model"]
+        body = req.json() or {}
+        instances = body.get("instances")
+        if instances is None:
+            raise ApiHttpError(400, 'request body must contain "instances"')
+        ctx = obs_trace.parse_traceparent(req.header("traceparent"))
+        tokens = estimate_tokens(instances, self.max_new_tokens)
+        try:
+            ticket = self.router.submit(tokens, item=model, context=ctx)
+        except RouterBusy as e:
+            raise ApiHttpError(429, str(e))
+        last_err: Exception | None = None
+        failures = 0
+        while failures < 3:
+            if ticket.member is None:
+                if not ticket.done.wait(self.dispatch_timeout):
+                    self.router.fail(ticket, requeue=False)
+                    raise ApiHttpError(503, "no replica capacity")
+            member = ticket.member
+            if member is None:  # shed mid-wait; loop waits again
+                continue
+            try:
+                raw = member.transport.predict(
+                    model, req.body,
+                    headers={"traceparent": req.header("traceparent")}
+                    if req.header("traceparent") else None)
+            except Exception as e:  # replica died mid-request: retry
+                last_err = e
+                failures += 1
+                self.router.fail(ticket, requeue=True)
+                continue
+            self.router.complete(ticket)
+            return json.loads(raw)
+        self.router.fail(ticket, requeue=False)
+        raise ApiHttpError(502, f"replica transport failed: {last_err}")
+
+    def build(self):
+        from kubeflow_tpu.utils import httpd
+
+        r = httpd.Router("jaxservice-router")
+        r.route("POST", "/v1/models/{model}:predict", self.predict)
+        httpd.add_health_routes(r)
+        httpd.add_metrics_route(r)
+        return r
+
+    def serve(self, host: str = "0.0.0.0", port: int = 8600):
+        from kubeflow_tpu.utils import httpd
+
+        return httpd.HttpService(self.build(), host, port)
+
+
+def main() -> None:  # pragma: no cover - container entry
+    import argparse
+    import os
+
+    p = argparse.ArgumentParser("kubeflow-tpu-router")
+    p.add_argument("--port", type=int, default=8600)
+    p.add_argument("--service", default=os.environ.get("JAXSERVICE_NAME",
+                                                       "default"))
+    p.add_argument("--namespace", default=os.environ.get("POD_NAMESPACE",
+                                                         "default"))
+    p.add_argument("--max-queue", type=int, default=256)
+    p.add_argument("--max-new-tokens", type=int, default=32)
+    p.add_argument("--endpoints", default="",
+                   help="static bootstrap: name=url[,name=url...] "
+                        "(the controller watch takes over in-cluster)")
+    p.add_argument("--apiserver", default="",
+                   help="watch the JAXService endpoints annotation")
+    args = p.parse_args()
+    router = TokenRouter(service=args.service, namespace=args.namespace,
+                         max_queue=args.max_queue)
+    if args.endpoints:
+        eps = [{"name": n, "addr": u, "state": STATE_ACTIVE}
+               for n, _, u in (e.partition("=")
+                               for e in args.endpoints.split(","))]
+        router.sync_endpoints(
+            eps, transport_factory=lambda ep: HttpTransport(ep["addr"]))
+    if args.apiserver:
+        from kubeflow_tpu.control.jaxservice import watch_endpoints
+
+        threading.Thread(
+            target=watch_endpoints,
+            args=(args.apiserver, args.namespace, args.service, router),
+            daemon=True, name="router-endpoints-watch").start()
+    frontend = RouterFrontend(router, max_new_tokens=args.max_new_tokens)
+    svc = frontend.serve(port=args.port)
+    log.info("jaxservice router %s/%s on :%d", args.namespace,
+             args.service, svc.port)
+    svc.serve_forever()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

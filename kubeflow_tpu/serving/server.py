@@ -24,20 +24,16 @@ from typing import Any, Callable
 
 import numpy as np
 
+from kubeflow_tpu.runtime.metrics import REGISTRY as METRICS_REGISTRY
 from kubeflow_tpu.utils import httpd
 from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
 
 log = logging.getLogger("kubeflow_tpu.serving")
 
-_METRICS: dict = {}
-
-
 def _metric(name, kind, doc, **kw):
-    import prometheus_client as prom  # noqa: F401
+    from kubeflow_tpu.runtime.metrics import prom_metric
 
-    if name not in _METRICS:
-        _METRICS[name] = kind(name, doc, **kw)
-    return _METRICS[name]
+    return prom_metric(name, kind, doc, **kw)
 
 
 def predict_latency():
@@ -74,6 +70,83 @@ def speculative_counters():
                     "draft tokens accepted by the target "
                     "(accepted/drafted = acceptance rate; low rates mean "
                     "the draft is wasting rounds)", labelnames=("model",)))
+
+
+class _ReplicaMeter:
+    """Replica-side serving signals, exported to BOTH sinks (the PR 4
+    convention): the MetricsRegistry text a JAXService control plane
+    scrapes for autoscaling (``serving_queue_depth``,
+    ``serving_tokens_generated_total``, ``serving_request_instances``) and
+    prometheus_client for dashboards. Queue depth counts requests that
+    have entered ``predict`` and not yet returned — the micro-batch
+    window plus the decode itself — which is exactly the congestion a
+    router should not add to."""
+
+    def __init__(self, registry=METRICS_REGISTRY):
+        self.registry = registry
+        self._lock = threading.Lock()
+        self._inflight: dict[str, int] = {}
+
+    def _publish_locked(self, model: str) -> None:
+        import prometheus_client as prom
+
+        depth = self._inflight.get(model, 0)
+        self.registry.gauge(
+            "serving_queue_depth", depth,
+            help_="requests inside predict (queued + decoding)",
+            model=model)
+        _metric("serving_queue_depth", prom.Gauge,
+                "requests inside predict (queued + decoding)",
+                labelnames=("model",)).labels(model).set(depth)
+
+    def enter(self, model: str, n_requests: int) -> None:
+        import prometheus_client as prom
+
+        with self._lock:
+            self._inflight[model] = self._inflight.get(model, 0) + 1
+            self._publish_locked(model)
+        self.registry.histogram(
+            "serving_request_instances", n_requests,
+            help_="instances per predict call",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128), model=model)
+        _metric("serving_request_instances", prom.Histogram,
+                "instances per predict call", labelnames=("model",),
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128)) \
+            .labels(model).observe(n_requests)
+
+    def exit(self, model: str) -> None:
+        with self._lock:
+            self._inflight[model] = max(0, self._inflight.get(model, 0) - 1)
+            self._publish_locked(model)
+
+    def tokens(self, model: str, n: int) -> None:
+        if n <= 0:
+            return
+        self.registry.counter_inc(
+            "serving_tokens_generated_total", by=float(n),
+            help_="new tokens generated (rate = this replica's "
+                  "tokens/sec, the autoscaler signal)",
+            model=model)
+        import prometheus_client as prom
+
+        _metric("serving_tokens_generated_total", prom.Counter,
+                "new tokens generated", labelnames=("model",)) \
+            .labels(model).inc(n)
+
+
+REPLICA_METER = _ReplicaMeter()
+
+
+def _generated_tokens(result: list, signature: dict) -> int:
+    """New-token count of a generate response (lists of token ids per
+    row after _unstack); non-generate signatures contribute none."""
+    if signature.get("method_name") != "generate":
+        return 0
+    total = 0
+    for row in result or []:
+        if hasattr(row, "__len__"):
+            total += len(row)
+    return total
 
 
 @dataclass
@@ -119,9 +192,17 @@ class ServedModel:
     def predict(self, instances: list) -> list:
         if not instances:
             raise ApiHttpError(400, "instances must be non-empty")
-        if self._batcher is not None:
-            return self._batcher.submit(instances)
-        return self._predict_now(instances)
+        REPLICA_METER.enter(self.name, len(instances))
+        try:
+            if self._batcher is not None:
+                result = self._batcher.submit(instances)
+            else:
+                result = self._predict_now(instances)
+        finally:
+            REPLICA_METER.exit(self.name)
+        REPLICA_METER.tokens(
+            self.name, _generated_tokens(result, self.signature))
+        return result
 
     def close(self) -> None:
         if self._batcher is not None:

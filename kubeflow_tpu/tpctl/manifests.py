@@ -109,14 +109,16 @@ def render(cfg: TpuDef) -> list[dict]:
 
     if "crds" in apps:
         from kubeflow_tpu.control.jaxjob import types as JT
+        from kubeflow_tpu.control.jaxservice import types as ST
         from kubeflow_tpu.control.notebook import types as NT
         from kubeflow_tpu.control.poddefault import webhook as PW
         from kubeflow_tpu.control.profile import types as PT
         from kubeflow_tpu.control.tensorboard import controller as TB
         from kubeflow_tpu.tune import studyjob as SJ
 
-        out += [JT.crd_manifest(), NT.crd_manifest(), PT.crd_manifest(),
-                PW.crd_manifest(), TB.crd_manifest(), SJ.crd_manifest()]
+        out += [JT.crd_manifest(), ST.crd_manifest(), NT.crd_manifest(),
+                PT.crd_manifest(), PW.crd_manifest(), TB.crd_manifest(),
+                SJ.crd_manifest()]
 
     if "namespace" in apps:
         out.append(ob.new_object(
@@ -149,6 +151,8 @@ def render(cfg: TpuDef) -> list[dict]:
     controllers = {
         "jaxjob-controller": ["python", "-m", "kubeflow_tpu.control.jaxjob"],
         "gang-scheduler": ["python", "-m", "kubeflow_tpu.control.scheduler"],
+        "jaxservice-controller": ["python", "-m",
+                                  "kubeflow_tpu.control.jaxservice"],
         "notebook-controller": ["python", "-m", "kubeflow_tpu.control.notebook"],
         "profile-controller": ["python", "-m", "kubeflow_tpu.control.profile"],
         "tensorboard-controller": ["python", "-m", "kubeflow_tpu.control.tensorboard"],

@@ -33,6 +33,7 @@ ALL_COMPONENTS = (
     "rbac",
     "jaxjob-controller",
     "gang-scheduler",
+    "jaxservice-controller",
     "notebook-controller",
     "profile-controller",
     "tensorboard-controller",

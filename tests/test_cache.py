@@ -73,6 +73,14 @@ def assert_cache_equals_relist(cache: ClusterCache, cluster: FakeCluster):
         got = {k: ob.meta(o)["resourceVersion"]
                for k, o in cache.objects(api, kind).items()}
         assert got == want, f"{kind} snapshot diverged from relist"
+        # the per-namespace buckets partition the same snapshot exactly
+        by_ns: dict = {}
+        for ns in {k[0] for k in want}:
+            for o in cache.objects_ns(api, kind, ns):
+                m = ob.meta(o)
+                by_ns[(m.get("namespace") or "", m["name"])] = \
+                    m["resourceVersion"]
+        assert by_ns == want, f"{kind} namespace buckets diverged"
     cap = cache.capacity()
     want_free = recomputed_free(cluster)
     assert cap.free == want_free, "free-chip accounting diverged"
@@ -596,6 +604,33 @@ class TestPumpedModeRaces:
         assert all(p["spec"].get("nodeName") == "n0"
                    for p in cluster.list("v1", "Pod", namespace="default"))
 
+    def test_pumped_node_snapshot_miss_confirms_live(self):
+        """A pumped snapshot can lag a Node ADDED riding its own stream
+        while the pod got in via the reconciler's note_write — a cache
+        miss must be CONFIRMED against the apiserver before the node is
+        condemned (the legacy per-node GET was authoritative; a false
+        'node gone' restarts a healthy gang)."""
+        from kubeflow_tpu.control.cache import ClusterCache
+        from kubeflow_tpu.control.jaxjob.controller import JAXJobReconciler
+
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        rec = JAXJobReconciler(record_events=False, cache=cache)
+        pod = ob.new_object("v1", "Pod", "j-worker-0", "default")
+        pod["spec"] = {"nodeName": "n-new"}
+        pod = cluster.create(pod)
+        cache.refresh()
+        # the node joins AFTER the last drain; its ADDED is still in
+        # the pump's stream when the reconcile reads the snapshot
+        cluster.create(ob.new_object("v1", "Node", "n-new"))
+        cache._threads = ["pump"]  # production mode: no poll-drain
+        try:
+            assert rec._unhealthy_nodes(cluster, [pod]) == []
+            # and folded back in: the next snapshot read hits
+            assert cache.node("n-new") is not None
+        finally:
+            cache._threads = []
+
     def test_legacy_health_pass_survives_api_error(self):
         """The legacy short-circuit must not commit its node-set memory
         until the eviction loop lands: an ApiError mid-pass would
@@ -660,3 +695,205 @@ class TestPumpedModeRaces:
         assert ("default", "p0") in cache.objects("v1", "Pod")
         cache.refresh()
         assert_cache_equals_relist(cache, cluster)
+
+
+class TestSameNameRecreation:
+    def test_noted_recreation_survives_old_incarnations_deleted(self):
+        """The elastic-shrink shape: a reconciler deletes a pod and
+        recreates its replacement under the SAME NAME, folding both in
+        via note_delete/note_write before the watch delivers. The old
+        incarnation's later watch DELETED must NOT evict the live
+        replacement — and must NOT tombstone at the replacement's rv,
+        which would drop the replacement's own ADDED as stale and lose
+        the pod forever (the WorkerDisappeared regression this guard
+        pins)."""
+        cluster = FakeCluster()
+        old = cluster.create(mk_pod("w-0", job="g"))
+        cache = ClusterCache(cluster).connect()
+        cache.refresh()
+        # out-of-band mutations (the controller's own writes)
+        cluster.delete("v1", "Pod", "w-0", "default")
+        replacement = cluster.create(mk_pod("w-0", job="g"))
+        cache.note_delete(old)
+        cache.note_write(replacement)
+        assert ("default", "w-0") in cache.objects("v1", "Pod")
+        # the watch now replays history: DELETED(old rv) then ADDED(new)
+        cache.refresh()
+        got = cache.objects("v1", "Pod").get(("default", "w-0"))
+        assert got is not None, "stale DELETED evicted the recreation"
+        assert ob.meta(got)["resourceVersion"] == \
+            ob.meta(replacement)["resourceVersion"]
+        assert_cache_equals_relist(cache, cluster)
+
+
+# -- controller wiring: reconcile paths off per-reconcile lists -------------
+
+
+class TestControllerCacheWiring:
+    """ROADMAP #3's remaining item: the jaxjob and notebook controllers
+    ride the indexed cache via ``Controller.uses()``. The pin is the
+    FakeCluster op counters — once a controller's caches are synced,
+    steady-state reconciles issue ZERO list calls (every pod/node/event
+    read is an index lookup); the legacy ``cache=False`` arms still
+    list, proving the counter actually measures the path."""
+
+    def _drain(self, ctl, kubelet=None, rounds=6):
+        for _ in range(rounds):
+            ctl.run_until_idle(advance_delayed=True)
+            if kubelet is not None:
+                # the kubelet is test harness, not the controller under
+                # measurement — its full-store list must not pollute the
+                # zero-list pins (the sched_bench stats_paused pattern)
+                with ctl.client.stats_paused():
+                    kubelet.step()
+
+    def test_jaxjob_reconcile_zero_list_calls(self):
+        from kubeflow_tpu.control.jaxjob.controller import build_controller
+        from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+        from kubeflow_tpu.control.runtime import Request, seed_controller
+
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, record_events=False))
+        kubelet = FakeKubelet(cluster)
+        cluster.create(JT.new_jaxjob(
+            "train", replicas=2, accelerator="tpu-v5-lite-podslice",
+            topology="2x4", chips_per_worker=4))
+        self._drain(ctl, kubelet)
+        job = cluster.get(JT.API_VERSION, JT.KIND, "train", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+
+        cluster.reset_stats()
+        ctl.enqueue(Request("default", "train"))
+        self._drain(ctl, kubelet)
+        assert cluster.stats["list_calls"] == 0, dict(cluster.stats)
+
+        # the legacy arm DOES list — the counter measures the real path
+        legacy = seed_controller(build_controller(
+            cluster, record_events=False, cache=False))
+        cluster.reset_stats()
+        legacy.enqueue(Request("default", "train"))
+        self._drain(legacy, kubelet)
+        assert cluster.stats["list_calls"] > 0
+
+    def test_jaxjob_node_mapper_zero_list_calls(self):
+        from kubeflow_tpu.control.jaxjob.controller import build_controller
+        from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+        from kubeflow_tpu.control.runtime import seed_controller
+
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, record_events=False))
+        kubelet = FakeKubelet(cluster)
+        cluster.create(JT.new_jaxjob("train", replicas=1,
+                                     accelerator="tpu-v5-lite-podslice",
+                                     topology="2x2", chips_per_worker=4))
+        self._drain(ctl, kubelet)
+        cluster.reset_stats()
+        node = cluster.get("v1", "Node", "fake-node")
+        node["status"]["conditions"] = [{"type": "Ready", "status": "False"}]
+        cluster.update_status(node)
+        self._drain(ctl, kubelet)
+        assert cluster.stats["list_calls"] == 0, dict(cluster.stats)
+        # and the slice-health path actually fired off the cached node
+        job = cluster.get(JT.API_VERSION, JT.KIND, "train", "default")
+        assert (job.get("status") or {}).get("preemptions", 0) >= 1
+
+    def test_notebook_reconcile_zero_list_calls(self):
+        from kubeflow_tpu.control.notebook import types as NT
+        from kubeflow_tpu.control.notebook.controller import build_controller
+        from kubeflow_tpu.control.runtime import Request, seed_controller
+
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster))
+        nb = ob.new_object(NT.API_VERSION, NT.KIND, "nb", "default")
+        nb["spec"] = {"template": {"spec": {"containers": [
+            {"name": "nb", "image": "jupyter"}]}}}
+        cluster.create(nb)
+        self._drain(ctl)
+        # a pod with the notebook label, plus a pod Event to forward
+        pod = ob.new_object("v1", "Pod", "nb-0", "default",
+                            labels={NT.LABEL_NOTEBOOK_NAME: "nb"})
+        pod["status"] = {"phase": "Running",
+                         "containerStatuses": [{"name": "nb", "ready": True,
+                                                "state": {"running": {}}}]}
+        cluster.create(pod)
+        cluster.record_event(pod, "Pulled", "image pulled")
+        self._drain(ctl)
+
+        cluster.reset_stats()
+        ctl.enqueue(Request("default", "nb"))
+        self._drain(ctl)
+        assert cluster.stats["list_calls"] == 0, dict(cluster.stats)
+        nb = cluster.get(NT.API_VERSION, NT.KIND, "nb", "default")
+        assert (nb.get("status") or {}).get("readyReplicas") == 1
+        # the Event-forwarding path ran off the cache too
+        fwd = [e for e in cluster.list("v1", "Event", namespace="default")
+               if (e.get("involvedObject") or {}).get("name") == "nb"
+               and e.get("reason") == "Pulled"]
+        assert fwd, "pod event should forward onto the Notebook"
+
+        legacy = seed_controller(build_controller(cluster, cache=False))
+        cluster.reset_stats()
+        legacy.enqueue(Request("default", "nb"))
+        self._drain(legacy)
+        assert cluster.stats["list_calls"] > 0
+
+    def test_notebook_event_forward_notes_own_marker(self):
+        # read-your-own-writes for the forwarded-marker events: the
+        # marker must be folded into the cache AT RECORD TIME — under
+        # production pumped watches the next reconcile can run before
+        # the pump delivers it, and a snapshot without the marker would
+        # re-forward the same pod event (count-dedup inflating the
+        # Notebook event's count past the real occurrence count).
+        from kubeflow_tpu.control.cache import ClusterCache
+        from kubeflow_tpu.control.notebook import types as NT
+        from kubeflow_tpu.control.notebook.controller import (
+            NotebookReconciler,
+        )
+
+        cluster = FakeCluster()
+        cache = ClusterCache(
+            cluster, kinds=(("v1", "Pod"), ("v1", "Event")),
+            pod_labels=(NT.LABEL_NOTEBOOK_NAME,)).connect()
+        rec = NotebookReconciler(cache=cache)
+        nb = cluster.create(
+            ob.new_object(NT.API_VERSION, NT.KIND, "nb", "default"))
+        pod = ob.new_object("v1", "Pod", "nb-0", "default",
+                            labels={NT.LABEL_NOTEBOOK_NAME: "nb"})
+        pod = cluster.create(pod)
+        cluster.record_event(pod, "Pulled", "image pulled")
+        cache.refresh()
+
+        rec._forward_pod_events(cluster, nb, [pod])
+        # noted without a refresh: the snapshot already has the marker
+        markers = [e for e in cache.objects("v1", "Event").values()
+                   if (e.get("source") or {}).get(
+                       "component", "").startswith("nb-fwd-")]
+        assert markers, "recorded marker must be note_write'n"
+        # a second pass over the SAME (stale) snapshot forwards nothing
+        rec._forward_pod_events(cluster, nb, [pod])
+        fwd = [e for e in cluster.list("v1", "Event", namespace="default")
+               if (e.get("involvedObject") or {}).get("name") == "nb"
+               and e.get("reason") == "Pulled"]
+        assert len(fwd) == 1 and fwd[0].get("count", 1) == 1, fwd
+
+    def test_jaxservice_reconcile_zero_list_calls(self):
+        from kubeflow_tpu.control.jaxservice import types as ST
+        from kubeflow_tpu.control.jaxservice.controller import (
+            build_controller,
+        )
+        from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+        from kubeflow_tpu.control.runtime import Request, seed_controller
+
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, record_events=False))
+        kubelet = FakeKubelet(cluster)
+        cluster.create(ST.new_jaxservice("chat", model="gpt-125m",
+                                         min_replicas=2, max_replicas=2))
+        self._drain(ctl, kubelet)
+        svc = cluster.get(ST.API_VERSION, ST.KIND, "chat", "default")
+        assert ob.cond_is_true(svc, ST.COND_READY)
+
+        cluster.reset_stats()
+        ctl.enqueue(Request("default", "chat"))
+        self._drain(ctl, kubelet)
+        assert cluster.stats["list_calls"] == 0, dict(cluster.stats)

@@ -25,7 +25,8 @@ def test_all_examples_are_covered_here():
                "llama-1b-singlechip.yaml", "tpudef.yaml",
                "studyjob-sweep.yaml", "multislice-2slice.yaml",
                "packed-pretrain.yaml",
-               "mistral-style-window-serving.yaml"}
+               "mistral-style-window-serving.yaml",
+               "jaxservice.yaml"}
     assert have == covered, f"new example needs a parse test: {have - covered}"
 
 
@@ -54,6 +55,21 @@ def test_tpudef_example_parses():
 
     cfg = TpuDef.from_dict(_load("tpudef.yaml"))
     assert cfg.applications
+
+
+def test_jaxservice_example_validates():
+    """The serving-plane example must pass CRD validation, opt into the
+    gang scheduler by its real name, and keep min <= max."""
+    from kubeflow_tpu.control.jaxservice import types as ST
+    from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
+
+    svc = _load("jaxservice.yaml")
+    assert svc["kind"] == "JAXService"
+    assert ST.validate(svc) == []
+    spec = svc["spec"]
+    assert spec["schedulerName"] == SCHEDULER_NAME
+    reps = ST.replicas_spec(spec)
+    assert 1 <= reps["min"] <= reps["max"]
 
 
 def test_studyjob_example_is_schedulable():

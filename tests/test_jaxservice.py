@@ -1,0 +1,1090 @@
+"""The JAXService serving plane: controller + token router + drills.
+
+Four layers, mirroring the subsystem's split (docs/serving.md):
+
+1. ``TokenRouter`` semantics in isolation: least-outstanding-tokens
+   dispatch, bounded admission (429), cordon draining, member-loss
+   shedding with zero drops, the endpoints wire contract, and the
+   ``RegistrySignals`` reader the autoscaler consumes.
+2. Controller semantics against the fake cluster: validation,
+   provisioning + readiness, endpoints publication, dead-replica
+   re-provisioning, gang-scheduler opt-in surface.
+3. The closed loop: router-exported signals driving the hysteretic
+   autoscaler up and down on a manual clock, and the cordon -> drain ->
+   delete state machine gated on the router's in-flight gauge.
+4. Drills: the scripted replica kill mid-load (router sheds to
+   survivors with ZERO dropped in-flight requests, controller
+   re-provisions) — plain, and re-run under armed apiserver chaos; plus
+   the chaos-parameterized rerun of the controller suite across
+   CHAOS_SEEDS (the test_chaos.py convention).
+
+The deterministic benchmark arm of the same machinery lives in
+tools/serve_bench.py (banked as BENCH_SERVE_r01.json).
+"""
+
+import pytest
+
+from conftest import CHAOS_RATE, CHAOS_SEEDS
+
+from kubeflow_tpu.control.jaxservice import types as T
+from kubeflow_tpu.control.jaxservice.controller import build_controller
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.chaos import (
+    ChaosClient, ChaosPolicy, arm_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.control.runtime import Request, seed_controller
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+from kubeflow_tpu.serving.router import (
+    Member, RegistrySignals, RouterBusy, TokenRouter, estimate_tokens,
+    parse_endpoints, render_endpoints,
+)
+
+pytestmark = pytest.mark.serving
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture()
+def world():
+    cluster = FakeCluster()
+    ctl = seed_controller(build_controller(cluster, record_events=True))
+    kubelet = FakeKubelet(cluster)
+    return cluster, ctl, kubelet
+
+
+def drain(ctl, kubelet=None, rounds=6):
+    for _ in range(rounds):
+        ctl.run_until_idle(advance_delayed=True)
+        if kubelet is not None:
+            kubelet.step()
+
+
+def make_service(cluster, name="chat", **kw):
+    kw.setdefault("model", "gpt-125m")
+    return cluster.create(T.new_jaxservice(name, **kw))
+
+
+def rep(i, name="chat"):
+    return T.replica_name(name, i)
+
+
+# -- the token router in isolation -------------------------------------------
+
+
+def _router(**kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("prom_sink", False)
+    kw.setdefault("tracer", obs_trace.Tracer())
+    return TokenRouter(service="svc", namespace="ns", **kw)
+
+
+def _members(r, n, state=None):
+    r.set_members([Member(name=f"r{i}",
+                          state=state or "active") for i in range(n)])
+
+
+class TestTokenRouter:
+    def test_least_outstanding_tokens_wins(self):
+        r = _router()
+        _members(r, 3)
+        t1 = r.submit(100)
+        t2 = r.submit(10)
+        t3 = r.submit(10)
+        # r0 took 100; the two light requests spread over r1/r2
+        assert t1.member.name == "r0"
+        assert {t2.member.name, t3.member.name} == {"r1", "r2"}
+        t4 = r.submit(5)  # r1/r2 at 10, r0 at 100 -> name-tie to r1
+        assert t4.member.name == "r1"
+
+    def test_name_breaks_ties_deterministically(self):
+        r = _router()
+        _members(r, 3)
+        assert r.submit(1).member.name == "r0"
+
+    def test_budget_full_replica_queues_not_dispatches(self):
+        r = _router(replica_token_budget=100)
+        _members(r, 1)
+        t1 = r.submit(80)
+        t2 = r.submit(80)  # 80+80 > 100: queue, do not overload
+        assert t1.member is not None and t2.member is None
+        assert r.queue_depth() == 1
+        done = r.complete(t1)
+        assert [d is t2 for d in done] == [True]
+        assert t2.member.name == "r0"
+
+    def test_oversized_request_still_dispatches_to_idle_replica(self):
+        # budget gates only loaded replicas: a request bigger than the
+        # whole budget must not queue forever in an idle fleet
+        r = _router(replica_token_budget=100)
+        _members(r, 1)
+        assert r.submit(500).member is not None
+
+    def test_bounded_queue_raises_busy(self):
+        r = _router(max_queue=2)
+        _members(r, 0)  # no capacity at all
+        r.submit(1)
+        r.submit(1)
+        with pytest.raises(RouterBusy):
+            r.submit(1)
+        reg = r.registry.render()
+        assert 'outcome="rejected"' in reg
+
+    def test_cordoned_member_gets_no_new_work_but_drains(self):
+        r = _router()
+        _members(r, 2)
+        t1 = r.submit(50)
+        assert t1.member.name == "r0"
+        r.cordon("r0")
+        t2 = r.submit(10)
+        assert t2.member.name == "r1"  # r0 is least-loaded-after-complete
+        assert not r.drained("r0")
+        r.complete(t1)  # in-flight work finishes on a cordoned replica
+        assert r.drained("r0")
+        assert r.inflight_tokens("r0") == 0
+
+    def test_uncordon_drains_queue_back_in(self):
+        r = _router()
+        r.set_members([Member(name="r0", state="cordoned")])
+        t = r.submit(10)
+        assert t.member is None
+        r.uncordon("r0")
+        assert t.member is not None
+
+    def test_member_loss_sheds_to_survivors_zero_drop(self):
+        r = _router()
+        _members(r, 2)
+        tickets = [r.submit(10) for _ in range(4)]  # 2 on each
+        on_r1 = [t for t in tickets if t.member.name == "r1"]
+        assert len(on_r1) == 2
+        redis = r.set_members([Member(name="r0")])  # r1 vanishes
+        assert sorted(id(t) for t in redis) == sorted(id(t) for t in on_r1)
+        assert all(t.member.name == "r0" for t in on_r1)
+        # zero drops: every ticket is still dispatched somewhere
+        assert all(t.member is not None for t in tickets)
+        assert r.queue_depth() == 0
+        assert 'outcome="shed"' in r.registry.render()
+
+    def test_shed_requeues_at_front_in_original_order(self):
+        r = _router(replica_token_budget=100)
+        _members(r, 2)
+        a = r.submit(90)   # r0
+        b = r.submit(90)   # r1
+        c = r.submit(90)   # queued (both full)
+        assert c.member is None
+        redis = r.set_members([Member(name="r1")])  # r0 dies
+        # a (oldest in-flight) goes to the queue FRONT, ahead of c; r1
+        # is full so nothing dispatches until b completes
+        assert redis == []
+        r.complete(b)
+        assert a.member is not None and a.member.name == "r1"
+        assert c.member is None  # behind a, still waiting
+
+    def test_complete_after_shed_removes_queued_copy(self):
+        # the symmetric race to fail()'s guard: the transport call
+        # SUCCEEDS on a replica a concurrent sync just removed. The
+        # handler completes the shed (queued) ticket — the queued copy
+        # must go with it, or _drain_locked ghost-dispatches a request
+        # whose handler already returned (permanently inflating the
+        # survivor's in-flight gauge and wedging its drain gate).
+        r = _router(replica_token_budget=100)
+        _members(r, 2)
+        a = r.submit(90)   # r0
+        b = r.submit(90)   # r1
+        assert a.member.name == "r0"
+        r.set_members([Member(name="r1")])  # r0 vanishes mid-transport
+        assert a.member is None and r.queue_depth() == 1
+        r.complete(a)      # ...but r0 actually served it
+        assert r.queue_depth() == 0
+        redis = r.complete(b)
+        assert redis == []  # nothing ghost-dispatches a onto r1
+        assert r.inflight_tokens("r1") == 0
+        assert r.drained("r1")
+
+    def test_fail_requeues_for_retry(self):
+        r = _router()
+        _members(r, 2)
+        t = r.submit(10)
+        r.fail(t, requeue=True)
+        assert t.member is not None   # re-dispatched immediately
+        assert r.inflight_tokens() == 10  # accounted exactly once
+        assert 'outcome="shed"' in r.registry.render()
+
+    def test_retry_prefers_untried_replica(self):
+        """A transport failure must NOT retry the same replica while an
+        untried one exists — the (load, name) tie-break alone would
+        send every retry straight back to the dead replica (found live:
+        3 attempts -> 502 with a healthy survivor sitting idle)."""
+        r = _router()
+        _members(r, 2)
+        t = r.submit(10)
+        assert t.member.name == "r0"
+        r.fail(t, requeue=True)
+        assert t.member.name == "r1"
+        # both tried: retry beats starvation, back to the least-loaded
+        r.fail(t, requeue=True)
+        assert t.member.name == "r0"
+
+    def test_single_replica_retry_falls_back(self):
+        r = _router()
+        _members(r, 1)
+        t = r.submit(10)
+        r.fail(t, requeue=True)
+        assert t.member is not None and t.member.name == "r0"
+
+    def test_fail_no_requeue_drops_with_outcome(self):
+        r = _router()
+        _members(r, 1)
+        t = r.submit(10)
+        r.fail(t, requeue=False)
+        assert t.member is None
+        assert r.inflight_tokens("r0") == 0
+        assert 'outcome="failed"' in r.registry.render()
+
+    def test_close_rejects_queued_and_new(self):
+        r = _router()
+        t = r.submit(10)  # no members: queued
+        orphans = r.close()
+        assert orphans == [t]
+        with pytest.raises(RouterBusy):
+            r.submit(1)
+
+    def test_estimate_tokens(self):
+        assert estimate_tokens([{"tokens": [1, 2, 3]}], 32) == 35
+        assert estimate_tokens([[1, 2], [3]], 10) == 23
+        assert estimate_tokens([], 32) == 33  # empty body still costs
+        assert estimate_tokens([{"x": 1}], 0) >= 1
+
+    def test_metrics_both_sinks(self):
+        import prometheus_client as prom
+
+        reg = MetricsRegistry()
+        r = TokenRouter(service="promtest", namespace="ns", registry=reg,
+                        prom_sink=True, tracer=obs_trace.Tracer())
+        r.set_members([Member(name="r0")])
+        t = r.submit(40)
+        r.complete(t)
+        text = reg.render()
+        assert "router_tokens_total" in text
+        assert "router_request_seconds_bucket" in text  # native histogram
+        assert 'replica="r0"' in text
+        ptext = prom.generate_latest(prom.REGISTRY).decode()
+        assert 'router_queue_depth{service="promtest"} 0.0' in ptext
+        assert 'router_tokens_total{service="promtest"} 40.0' in ptext
+
+
+class TestRouterSpans:
+    def test_dispatch_span_parents_on_request_traceparent(self):
+        tracer = obs_trace.Tracer()
+        r = _router(tracer=tracer)
+        _members(r, 1)
+        ctx = obs_trace.SpanContext(obs_trace.new_trace_id(),
+                                    obs_trace.new_span_id())
+        t = r.submit(10, context=ctx)
+        r.complete(t)
+        spans = [s for s in tracer.collector.spans()
+                 if s.name == "router.dispatch"]
+        assert len(spans) == 1
+        assert spans[0].trace_id == ctx.trace_id
+        assert spans[0].parent_id == ctx.span_id
+        assert spans[0].attrs["replica"] == "r0"
+
+    def test_shed_dispatch_exports_error_then_fresh_span(self):
+        tracer = obs_trace.Tracer()
+        r = _router(tracer=tracer)
+        _members(r, 2)
+        ctx = obs_trace.SpanContext(obs_trace.new_trace_id(),
+                                    obs_trace.new_span_id())
+        t = r.submit(10, context=ctx)
+        dead = t.member.name
+        survivor = "r1" if dead == "r0" else "r0"
+        r.set_members([Member(name=survivor)])
+        r.complete(t)
+        spans = [s for s in tracer.collector.spans()
+                 if s.name == "router.dispatch"]
+        assert [s.status for s in spans] == ["ERROR", "OK"]
+        # both halves of the journey stay in the request's ONE trace
+        assert {s.trace_id for s in spans} == {ctx.trace_id}
+
+
+class TestEndpointsContract:
+    def test_render_parse_roundtrip(self):
+        eps = [{"name": "b", "addr": "http://b:1", "state": "active"},
+               {"name": "a", "addr": "http://a:1", "state": "cordoned"}]
+        svc = {"metadata": {"annotations": {
+            T.ANNOTATION_ENDPOINTS: render_endpoints(eps)}}}
+        back = parse_endpoints(svc)
+        assert [e["name"] for e in back] == ["a", "b"]  # canonical order
+
+    def test_render_is_canonical(self):
+        a = [{"name": "x", "addr": "u", "state": "active"},
+             {"name": "y", "addr": "v", "state": "active"}]
+        assert render_endpoints(a) == render_endpoints(list(reversed(a)))
+
+    def test_malformed_annotation_is_empty(self):
+        svc = {"metadata": {"annotations": {T.ANNOTATION_ENDPOINTS: "{oops"}}}
+        assert parse_endpoints(svc) == []
+        assert parse_endpoints({}) == []
+
+    def test_sync_from_object_applies_states(self):
+        r = _router()
+        eps = [{"name": "r0", "addr": "u0", "state": "active"},
+               {"name": "r1", "addr": "u1", "state": "cordoned"}]
+        svc = {"metadata": {"annotations": {
+            T.ANNOTATION_ENDPOINTS: render_endpoints(eps)}}}
+        r.sync_from_object(svc)
+        assert r.members() == {"r0": "active", "r1": "cordoned"}
+        assert r.submit(5).member.name == "r0"
+
+
+class TestRegistrySignals:
+    def test_reads_router_series_back_out(self):
+        reg = MetricsRegistry()
+        r = TokenRouter(service="svc", namespace="ns", registry=reg,
+                        prom_sink=False, tracer=obs_trace.Tracer())
+        sig = RegistrySignals(reg)
+        _ = r  # members empty: everything queues
+        r.submit(10)
+        r.submit(10)
+        assert sig.queue_depth("ns", "svc") == 2
+        r.set_members([Member(name="r0")])
+        assert sig.queue_depth("ns", "svc") == 0
+        assert sig.inflight_tokens("ns", "svc", "r0") == 20
+        assert not sig.replica_drained("ns", "svc", "r0")
+        for t in list(r._inflight["r0"].values()):
+            r.complete(t)
+        assert sig.tokens_total("ns", "svc") == 20
+        assert sig.replica_drained("ns", "svc", "r0")
+
+    def test_unknown_service_reads_zero(self):
+        sig = RegistrySignals(MetricsRegistry())
+        assert sig.queue_depth("ns", "nope") == 0
+        assert sig.replica_drained("ns", "nope", "r0")
+
+    def test_scraped_text_source_matches_registry(self):
+        # the out-of-process source: a callable returning a scraped
+        # /metrics body goes through the text parser, and must read the
+        # same values (labels included) as the in-process fast path
+        reg = MetricsRegistry()
+        r = TokenRouter(service="svc", namespace="ns", registry=reg,
+                        prom_sink=False, tracer=obs_trace.Tracer())
+        r.set_members([Member(name="r0")])
+        r.submit(10)
+        r.submit(5)
+        fast = RegistrySignals(reg)
+        scraped = RegistrySignals(lambda: reg.render())
+        assert scraped.queue_depth("ns", "svc") \
+            == fast.queue_depth("ns", "svc")
+        assert scraped.inflight_tokens("ns", "svc", "r0") \
+            == fast.inflight_tokens("ns", "svc", "r0") == 15
+        assert not scraped.replica_drained("ns", "svc", "r0")
+
+
+class TestReplicaMeter:
+    def test_replica_signals_in_both_sinks(self):
+        """The replica side of the signal plane (serving/server.py):
+        queue depth + request-size histogram + generated-token counter
+        land in the MetricsRegistry (the autoscaler's wire) AND
+        prometheus_client (the scrape surface)."""
+        import prometheus_client as prom
+
+        from kubeflow_tpu.serving.server import (
+            _generated_tokens, _ReplicaMeter,
+        )
+
+        reg = MetricsRegistry()
+        m = _ReplicaMeter(reg)
+        m.enter("m1", 3)
+        text = reg.render()
+        assert 'serving_queue_depth{model="m1"} 1' in text
+        assert "serving_request_instances_bucket" in text
+        m.exit("m1")
+        m.tokens("m1", 8)
+        text = reg.render()
+        assert 'serving_queue_depth{model="m1"} 0' in text
+        assert 'serving_tokens_generated_total{model="m1"} 8' in text
+        ptext = prom.generate_latest(prom.REGISTRY).decode()
+        assert 'serving_queue_depth{model="m1"} 0.0' in ptext
+        assert 'serving_request_instances_count{model="m1"} 1.0' in ptext
+        # only generate responses count tokens
+        assert _generated_tokens([[1, 2, 3]],
+                                 {"method_name": "generate"}) == 3
+        assert _generated_tokens([[1, 2, 3]],
+                                 {"method_name": "predict"}) == 0
+
+
+# -- controller: validation ---------------------------------------------------
+
+
+class TestValidation:
+    def test_valid_spec_no_errors(self):
+        assert T.validate(T.new_jaxservice("s", model="gpt-125m")) == []
+
+    def test_bad_specs_report(self):
+        bad = T.new_jaxservice("s", model="gpt-125m", min_replicas=3,
+                               max_replicas=1)
+        errs = T.validate(bad)
+        assert any("min 3 > max 1" in e for e in errs)
+        svc = T.new_jaxservice("s", model="gpt-125m")
+        svc["spec"]["port"] = 99999
+        assert any("port" in e for e in T.validate(svc))
+        svc = T.new_jaxservice("s", model="gpt-125m",
+                               accelerator="tpu-v5-lite-podslice",
+                               topology="2xbroken")
+        assert any("NxM" in e for e in T.validate(svc))
+        svc = T.new_jaxservice("s", model="gpt-125m")
+        del svc["spec"]["model"]["ref"]
+        assert any("model.ref" in e for e in T.validate(svc))
+        svc = T.new_jaxservice("s", model="gpt-125m")
+        svc["spec"]["drainSeconds"] = -1
+        assert any("drainSeconds" in e for e in T.validate(svc))
+
+    def test_replicas_shorthand_int(self):
+        assert T.replicas_spec({"replicas": 3}) == {"min": 3, "max": 3}
+
+    def test_replica_index_sentinel_sorts_last(self):
+        import sys
+
+        assert T.replica_index(rep(2)) == 2
+        assert T.replica_index("garbage") == sys.maxsize
+
+    def test_invalid_spec_sets_degraded(self, world):
+        cluster, ctl, _ = world
+        make_service(cluster, min_replicas=2, max_replicas=1)
+        drain(ctl)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert ob.cond_is_true(svc, T.COND_DEGRADED)
+        assert cluster.list("v1", "Pod", namespace="default") == []
+
+
+# -- controller: provisioning + endpoints ------------------------------------
+
+
+class TestProvisioning:
+    def test_creates_headless_service_and_replicas(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        hs = cluster.get("v1", "Service", "chat", "default")
+        assert hs["spec"]["clusterIP"] == "None"
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert {ob.meta(p)["name"] for p in pods} == {rep(0), rep(1)}
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert ob.cond_is_true(svc, T.COND_READY)
+        assert svc["status"]["replicas"] == {
+            "desired": 2, "ready": 2, "pending": 0, "cordoned": 0}
+        assert svc["status"]["replicaStatuses"] == {
+            rep(0): "Running", rep(1): "Running"}
+
+    def test_replica_pod_surface(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1,
+                     accelerator="tpu-v5-lite-podslice", topology="2x2",
+                     chips_per_replica=4)
+        drain(ctl, kubelet)
+        pod = cluster.get("v1", "Pod", rep(0), "default")
+        c = pod["spec"]["containers"][0]
+        env = {e["name"]: e["value"] for e in c["env"]}
+        assert env[T.ENV_SERVICE] == "chat"
+        assert env[T.ENV_REPLICA] == "0"
+        assert env[T.ENV_NAMESPACE] == "default"
+        assert c["command"][:3] == ["python", "-m", "kubeflow_tpu.serving"]
+        assert "--continuous-batching" in c["command"]
+        assert c["resources"]["limits"]["google.com/tpu"] == 4
+        assert pod["spec"]["hostname"] == rep(0)
+        assert pod["spec"]["subdomain"] == "chat"
+        assert pod["metadata"]["labels"][T.LABEL_SERVICE_NAME] == "chat"
+        # stable DNS + ownerRef GC
+        assert ob.meta(pod)["ownerReferences"][0]["name"] == "chat"
+
+    def test_endpoints_annotation_published(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=2, port=9000)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        eps = parse_endpoints(svc)
+        assert [(e["name"], e["state"]) for e in eps] == [
+            (rep(0), "active"), (rep(1), "active")]
+        assert eps[0]["addr"] == f"http://{rep(0)}.chat.default.svc:9000"
+
+    def test_pending_replicas_not_in_endpoints(self, world):
+        cluster, ctl, _ = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl)  # no kubelet: pods stay Pending
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert parse_endpoints(svc) == []
+        assert not ob.cond_is_true(svc, T.COND_READY)
+
+    def test_steady_state_issues_no_writes(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        cluster.reset_stats()
+        ctl.enqueue(Request("default", "chat"))
+        drain(ctl)
+        assert cluster.stats["update"] == 0, dict(cluster.stats)
+        assert cluster.stats["patch"] == 0, dict(cluster.stats)
+        assert cluster.stats["list_calls"] == 0, dict(cluster.stats)
+
+    def test_events_recorded(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1)
+        drain(ctl, kubelet)
+        reasons = {e["reason"]
+                   for e in cluster.list("v1", "Event", namespace="default")}
+        assert "JAXServiceCreated" in reasons
+
+    def test_reconcile_span_parented_on_minted_traceparent(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        tp = (ob.meta(svc).get("annotations") or {}).get(
+            obs_trace.TRACEPARENT_ANNOTATION)
+        ctx = obs_trace.parse_traceparent(tp)
+        assert ctx is not None
+        # the global TRACER accumulates across tests: key on OUR trace id
+        spans = [s for s in obs_trace.TRACER.collector.trace(ctx.trace_id)
+                 if s.name == "jaxservice.reconcile"]
+        assert spans and all(s.attrs.get("service") == "chat"
+                             for s in spans)
+        # the traceparent also rides into replica pods for the server side
+        pod = cluster.get("v1", "Pod", rep(0), "default")
+        env = {e["name"]: e["value"]
+               for e in pod["spec"]["containers"][0]["env"]}
+        assert env[obs_trace.TRACEPARENT_ENV] == tp
+
+
+class TestReplicaRestart:
+    def test_dead_replica_reaped_and_reprovisioned(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        first_uid = ob.meta(cluster.get("v1", "Pod", rep(1), "default"))["uid"]
+        kubelet.fail(rep(1))
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["restarts"] == 1
+        pod = cluster.get("v1", "Pod", rep(1), "default")
+        assert ob.meta(pod)["uid"] != first_uid  # a NEW incarnation
+        assert (pod["status"] or {}).get("phase") == "Running"
+        assert ob.cond_is_true(svc, T.COND_READY)
+
+    def test_succeeded_replica_also_restarts(self, world):
+        # a serving replica never legitimately exits: Succeeded is a
+        # crash in disguise and must be replaced like a failure
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1)
+        drain(ctl, kubelet)
+        kubelet.succeed(rep(0))
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["restarts"] == 1
+        assert (cluster.get("v1", "Pod", rep(0), "default")["status"]
+                or {}).get("phase") == "Running"
+
+    def test_deleting_service_cascades(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        cluster.delete(T.API_VERSION, T.KIND, "chat", "default")
+        drain(ctl, kubelet)
+        assert cluster.list("v1", "Pod", namespace="default") == []
+
+
+class TestGangScheduledMode:
+    def test_gang_surface_per_replica(self, world):
+        cluster, ctl, _ = world
+        make_service(cluster, min_replicas=2, gang_schedule=True,
+                     priority=7, accelerator="tpu-v5-lite-podslice",
+                     topology="2x2", chips_per_replica=4)
+        drain(ctl)
+        from kubeflow_tpu.control.jaxjob import types as JT
+        from kubeflow_tpu.control.scheduler import (
+            ANNOTATION_GANG_SIZE, ANNOTATION_PRIORITY, GATE_GANG,
+            SCHEDULER_NAME,
+        )
+
+        for i in range(2):
+            pod = cluster.get("v1", "Pod", rep(i), "default")
+            assert pod["spec"]["schedulerName"] == SCHEDULER_NAME
+            gates = [g["name"] for g in pod["spec"]["schedulingGates"]]
+            assert GATE_GANG in gates
+            ann = ob.annotations_of(pod)
+            # each replica is its own gang of ONE: independent admission
+            assert ann[ANNOTATION_GANG_SIZE] == "1"
+            assert ann[ANNOTATION_PRIORITY] == "7"
+            assert pod["metadata"]["labels"][JT.LABEL_JOB_NAME] == rep(i)
+
+    def test_ungated_without_gang_schedule(self, world):
+        cluster, ctl, _ = world
+        make_service(cluster, min_replicas=1)
+        drain(ctl)
+        pod = cluster.get("v1", "Pod", rep(0), "default")
+        assert not pod["spec"].get("schedulingGates")
+        assert not pod["spec"].get("schedulerName")
+
+
+# -- the closed loop: signals -> autoscaler -> drain --------------------------
+
+
+def signal_world(min_replicas=1, max_replicas=4, target_queue_depth=4,
+                 up_s=1.0, down_s=2.0, tokens_per_sec=1e9):
+    """Controller + router sharing one registry and one manual clock —
+    the serve_bench wiring, sized for unit assertions."""
+    clock = ManualClock()
+    cluster = FakeCluster()
+    registry = MetricsRegistry()
+    signals = RegistrySignals(registry)
+    ctl = seed_controller(build_controller(
+        cluster, record_events=False, registry=registry, signals=signals,
+        clock=clock))
+    kubelet = FakeKubelet(cluster)
+    cluster.create(T.new_jaxservice(
+        "chat", model="gpt-125m", min_replicas=min_replicas,
+        max_replicas=max_replicas, target_queue_depth=target_queue_depth,
+        target_tokens_per_sec=tokens_per_sec, up_stabilization_s=up_s,
+        down_stabilization_s=down_s))
+    router = TokenRouter(service="chat", namespace="default", clock=clock,
+                         registry=registry, prom_sink=False,
+                         tracer=obs_trace.Tracer(),
+                         replica_token_budget=64)
+    return cluster, ctl, kubelet, router, clock
+
+
+def sync(cluster, router):
+    svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+    return svc, router.sync_from_object(svc)
+
+
+class TestAutoscaling:
+    def test_queue_pressure_scales_up_after_window(self):
+        cluster, ctl, kubelet, router, clock = signal_world(up_s=1.0)
+        drain(ctl, kubelet)
+        sync(cluster, router)
+        for _ in range(30):
+            router.submit(32)  # budget 64: ~2 dispatch, ~28 queue
+        assert router.queue_depth() >= 20
+        drain(ctl, kubelet)  # demand seen; hysteresis pending, no move yet
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] == 1
+        clock.advance(1.5)  # past the up window: demand persisted
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        # scale-up jumps straight to demand (a spike wants capacity NOW)
+        assert svc["status"]["targetReplicas"] == 4
+        assert svc["status"]["scales"] == 1
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert {ob.meta(p)["name"] for p in pods} == {rep(i)
+                                                      for i in range(4)}
+
+    def test_short_spike_does_not_scale(self):
+        cluster, ctl, kubelet, router, clock = signal_world(up_s=10.0)
+        drain(ctl, kubelet)
+        sync(cluster, router)
+        tickets = [router.submit(32) for _ in range(30)]
+        drain(ctl, kubelet)  # pending-up starts
+        # the spike clears before the window elapses
+        for t in tickets:
+            if t.member is not None:
+                router.complete(t)
+        while router.queue_depth() or router.inflight_tokens():
+            for t in router.kick():
+                pass
+            for name, bucket in list(router._inflight.items()):
+                for t in list(bucket.values()):
+                    router.complete(t)
+        clock.advance(11.0)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] == 1
+        assert svc["status"].get("scales", 0) == 0
+
+    def test_tokens_rate_scales_up(self):
+        cluster, ctl, kubelet, router, clock = signal_world(
+            target_queue_depth=10**6, tokens_per_sec=100.0, up_s=1.0)
+        drain(ctl, kubelet)
+        sync(cluster, router)
+        # complete 1000 tokens across 2 virtual seconds: rate 500/s vs a
+        # 100/s per-replica target -> demand 4 (clamped)
+        drain(ctl, kubelet)  # sample 0 at t0
+        clock.advance(2.0)
+        done = 0
+        while done < 1000:
+            t = router.submit(50)
+            router.complete(t)
+            done += 50
+        drain(ctl, kubelet)  # rate observed; pending-up
+        clock.advance(1.5)
+        # keep the demand hot through the second sample window too —
+        # the hysteresis re-confirms demand before committing
+        done = 0
+        while done < 600:
+            t = router.submit(50)
+            router.complete(t)
+            done += 50
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] > 1
+
+    def test_autoscale_deterministic_same_inputs(self):
+        def run():
+            cluster, ctl, kubelet, router, clock = signal_world(up_s=1.0)
+            drain(ctl, kubelet)
+            sync(cluster, router)
+            for _ in range(30):
+                router.submit(32)
+            drain(ctl, kubelet)
+            clock.advance(1.5)
+            drain(ctl, kubelet)
+            svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+            return (svc["status"]["targetReplicas"],
+                    svc["status"].get("scales", 0))
+
+        assert run() == run() == (4, 1)
+
+
+class TestDrainStateMachine:
+    def _three_up(self):
+        cluster, ctl, kubelet, router, clock = signal_world(
+            min_replicas=1, max_replicas=3, down_s=2.0)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["status"] = {"targetReplicas": 3}
+        cluster.update_status(svc)
+        drain(ctl, kubelet)
+        svc, _ = sync(cluster, router)
+        assert svc["status"]["replicas"]["ready"] == 3
+        return cluster, ctl, kubelet, router, clock
+
+    def test_cordon_drain_delete_cycle(self):
+        cluster, ctl, kubelet, router, clock = self._three_up()
+        tickets = [router.submit(30) for _ in range(3)]  # one per replica
+        assert {t.member.name for t in tickets} == {rep(i) for i in range(3)}
+        drain(ctl, kubelet)          # demand=1 < 3: pending-down starts
+        clock.advance(3.0)           # past the down window
+        drain(ctl, kubelet)
+        svc, _ = sync(cluster, router)
+        # ONE step down (lulls release capacity gently), highest index
+        assert svc["status"]["targetReplicas"] == 2
+        pod2 = cluster.get("v1", "Pod", rep(2), "default")
+        assert ob.annotations_of(pod2)[T.ANNOTATION_CORDON] == "true"
+        eps = {e["name"]: e["state"] for e in parse_endpoints(svc)}
+        assert eps[rep(2)] == "cordoned"
+        assert router.members()[rep(2)] == "cordoned"
+        # in-flight work pins the replica: NOT deleted while draining
+        assert svc["status"]["replicas"]["cordoned"] == 1
+        # new work avoids the cordoned replica
+        extra = router.submit(5)
+        assert extra.member.name != rep(2)
+        router.complete(extra)
+        # finish the in-flight request -> drained -> deleted
+        t2 = next(t for t in tickets if t.member.name == rep(2))
+        router.complete(t2)
+        drain(ctl, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(2), "default") is None
+        svc, _ = sync(cluster, router)
+        eps = {e["name"]: e["state"] for e in parse_endpoints(svc)}
+        assert rep(2) not in eps
+        # the surviving in-flight work was never touched: zero drops
+        for t in tickets:
+            if t is not t2:
+                assert t.member is not None
+                router.complete(t)
+
+    def test_unsignalled_world_holds_running_cordoned_for_drain_grace(self):
+        # signals=None (the production run_controller wiring): the
+        # router keeps routing whether or not the controller can read
+        # its gauges, so a Running cordoned replica is held for
+        # spec.drainSeconds after cordon, THEN deleted.
+        clock = ManualClock()
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, clock=clock))
+        kubelet = FakeKubelet(cluster)
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["spec"]["replicas"] = {"min": 1, "max": 1}
+        cluster.update(svc)
+        drain(ctl, kubelet)
+        # cordoned but inside the grace: held, status shows draining
+        pod = cluster.get("v1", "Pod", rep(1), "default")
+        assert ob.annotations_of(pod)[T.ANNOTATION_CORDON] == "true"
+        clock.advance(T.DEFAULT_DRAIN_SECONDS - 1.0)
+        drain(ctl, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") \
+            is not None
+        # past the grace: deleted
+        clock.advance(2.0)
+        drain(ctl, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") is None
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["replicas"]["desired"] == 1
+
+    def test_growback_uncordons_before_drain_completes(self):
+        # the uncordon arrow: target drops (replica cordoned), then
+        # grows back before the drain grace elapses — the replica must
+        # return to service, not wedge the fleet below target forever
+        clock = ManualClock()
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, clock=clock))
+        kubelet = FakeKubelet(cluster)
+        make_service(cluster, min_replicas=2)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["spec"]["replicas"] = {"min": 1, "max": 1}
+        cluster.update(svc)
+        drain(ctl, kubelet)
+        pod = cluster.get("v1", "Pod", rep(1), "default")
+        assert ob.annotations_of(pod)[T.ANNOTATION_CORDON] == "true"
+        # scale-down reversed inside the grace window
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["spec"]["replicas"] = {"min": 2, "max": 2}
+        cluster.update(svc)
+        drain(ctl, kubelet)
+        pod = cluster.get("v1", "Pod", rep(1), "default")
+        assert ob.annotations_of(pod)[T.ANNOTATION_CORDON] != "true"
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["replicas"] == {
+            "desired": 2, "ready": 2, "pending": 0, "cordoned": 0}
+        eps = {e["name"]: e["state"] for e in parse_endpoints(svc)}
+        assert eps[rep(1)] == "active"
+        # the drain timer cleared: a LATER cordon gets a full grace
+        assert ctl.reconciler._drain_started == {}
+
+    def test_unsignalled_world_deletes_nonrunning_cordoned_immediately(
+            self, world):
+        # a cordoned pod that never went Running holds no connections —
+        # no grace needed (world has no kubelet stepping: pods Pending)
+        cluster, ctl, _ = world
+        make_service(cluster, min_replicas=2)
+        drain(ctl)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["spec"]["replicas"] = {"min": 1, "max": 1}
+        cluster.update(svc)
+        drain(ctl)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") is None
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["replicas"]["desired"] == 1
+
+
+# -- drills -------------------------------------------------------------------
+
+
+def kill_drill(world_tuple, router=None, registry=None):
+    """The scripted drill (ISSUE 8): kill one replica mid-load; the
+    router must shed its in-flight requests to survivors with ZERO
+    drops and the controller must re-provision the replica."""
+    cluster, ctl, kubelet = world_tuple
+    registry = registry if registry is not None else MetricsRegistry()
+    if router is None:
+        router = TokenRouter(service="chat", namespace="default",
+                             registry=registry, prom_sink=False,
+                             tracer=obs_trace.Tracer())
+    make_service(cluster, min_replicas=2, max_replicas=2)
+    drain(ctl, kubelet)
+    svc, _ = sync(cluster, router)
+    assert svc["status"]["replicas"]["ready"] == 2
+
+    tickets = [router.submit(25) for _ in range(4)]  # 2 per replica
+    assert all(t.member is not None for t in tickets)
+    on_dead = [t for t in tickets if t.member.name == rep(1)]
+    assert len(on_dead) == 2
+
+    # the kill: replica-1's pod dies mid-load. Drain WITHOUT the kubelet
+    # so the router syncs the intermediate truth — replica-1 reaped, its
+    # replacement still Pending and so absent from the endpoint set
+    kubelet.fail(rep(1), message="node reclaimed", exit_code=137)
+    drain(ctl)
+    svc, redispatched = sync(cluster, router)
+
+    # shed to survivors: every in-flight request re-dispatched, zero lost
+    assert sorted(id(t) for t in redispatched) == \
+        sorted(id(t) for t in on_dead)
+    assert all(t.member is not None and t.member.name == rep(0)
+               for t in tickets)
+    for t in tickets:
+        router.complete(t)
+    assert router.queue_depth() == 0 and router.inflight_tokens() == 0
+
+    # the controller re-provisioned the replica; let the kubelet run it
+    drain(ctl, kubelet)
+    svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+    assert svc["status"]["restarts"] >= 1
+    assert ob.cond_is_true(svc, T.COND_READY)
+    pod = cluster.get("v1", "Pod", rep(1), "default")
+    assert (pod["status"] or {}).get("phase") == "Running"
+    svc, _ = sync(cluster, router)
+    assert {e["name"] for e in parse_endpoints(svc)} == {rep(0), rep(1)}
+    # all four requests completed exactly once
+    assert 'outcome="completed"' in registry.render()
+    sig = RegistrySignals(registry)
+    assert sig.tokens_total("default", "chat") == 100.0
+
+
+class TestKillDrill:
+    def test_replica_kill_sheds_to_survivors_zero_drop(self, world):
+        registry = MetricsRegistry()
+        kill_drill(world, registry=registry)
+
+
+# -- chaos: the controller suite re-run with faults armed ---------------------
+
+
+def _policy(seed, **over):
+    base = dict(seed=seed, rate=CHAOS_RATE, watch_drop_every=25)
+    base.update(over)
+    return ChaosPolicy(**base)
+
+
+def _chaos_world(seed):
+    """The ``world`` fixture, chaos edition (the test_chaos.py
+    convention): one FakeCluster, faults armed ONLY during reconciles,
+    retry delays zeroed so retries complete inside the tests' drains."""
+    inner = FakeCluster()
+    chaos = ChaosClient(inner, _policy(seed), always_on=False)
+    ctl = arm_controller(
+        seed_controller(build_controller(chaos, record_events=True)), chaos)
+    ctl.CONFLICT_RETRY = (0, 0)
+    ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner)
+    return inner, ctl, kubelet
+
+
+def _methods(cls):
+    return [(cls, n) for n in sorted(dir(cls))
+            if n.startswith("test_")]
+
+
+# Every controller-suite test that drives ONLY through the world tuple.
+# (TestProvisioning.test_steady_state_issues_no_writes pins exact op
+# counts — chaos retries legitimately change them, so it stays out.)
+JAXSERVICE_HAPPY = [
+    case for cls in (TestProvisioning, TestReplicaRestart,
+                     TestGangScheduledMode, TestValidation)
+    for case in _methods(cls)
+    if case[1] not in ("test_steady_state_issues_no_writes",
+                       "test_valid_spec_no_errors", "test_bad_specs_report",
+                       "test_replicas_shorthand_int",
+                       "test_replica_index_sentinel_sorts_last",
+                       "test_estimate_tokens")
+]
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS)
+@pytest.mark.parametrize(
+    "case", JAXSERVICE_HAPPY,
+    ids=[f"{cls.__name__}.{name}" for cls, name in JAXSERVICE_HAPPY])
+def test_jaxservice_happy_paths_survive_chaos(case, seed):
+    cls, name = case
+    getattr(cls(), name)(_chaos_world(seed))
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("seed", CHAOS_SEEDS[:2])
+def test_kill_drill_survives_chaos(seed):
+    """The scripted drill under armed apiserver faults: zero dropped
+    in-flight requests and re-provisioning hold even while the control
+    plane is being conflicted/errored (the PR 6 drill discipline)."""
+    kill_drill(_chaos_world(seed))
+
+
+# -- the banked benchmark stays meaningful -----------------------------------
+
+
+class TestServeBenchContract:
+    def test_banked_results_satisfy_acceptance(self):
+        """BENCH_SERVE_r01.json is the PR's acceptance artifact: the
+        multi-replica arm must beat single-replica at peak, both drills
+        must have passed, and the banked decisions must be non-trivial
+        (a real scale-up AND the scale-down half of the cycle)."""
+        import json
+        import os
+
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(here, "BENCH_SERVE_r01.json")) as fh:
+            banked = json.load(fh)
+        r = banked["router"]
+        cmp_ = r["comparison"]
+        assert cmp_["zero_dropped"] is True
+        assert cmp_["kill_drill_survived"] is True
+        assert cmp_["scale_cycle_complete"] is True
+        assert cmp_["decisions_replay_identical"] is True
+        assert cmp_["peak_tokens_per_sec_x"] >= 2.0
+        assert r["multi"]["max_target"] > 1
+        assert r["multi"]["final_target"] < r["multi"]["max_target"]
+        assert r["multi"]["replica_restarts"] >= 1
+        assert r["single"]["dropped"] == 0 and r["multi"]["dropped"] == 0
+
+    @staticmethod
+    def _bench():
+        import os
+        import sys
+
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(here, "tools"))
+        try:
+            import serve_bench as sb
+        finally:
+            sys.path.pop(0)
+        return sb
+
+    def test_router_bench_small_config_is_deterministic(self):
+        """A miniature end-to-end run of the serve_bench harness itself
+        (CI-speed): same seed, same decisions, zero drops."""
+        sb = self._bench()
+        cfg = dict(sb.ROUTER_CONFIG)
+        cfg.update(seed=7, max_replicas=2, kill_at_s=6.0)
+        old_phases = sb.PHASES
+        sb.PHASES = ((2.0, 2.0), (6.0, 12.0), (6.0, 1.0))
+        try:
+            a = sb.run_router_arm("multi", cfg)
+            b = sb.run_router_arm("multi", cfg)
+        finally:
+            sb.PHASES = old_phases
+        assert a["dropped"] == 0
+        assert a["replica_restarts"] >= 1
+        assert a["decisions"] == b["decisions"]
+        assert a["tokens_done"] == b["tokens_done"]
+
+    def test_check_gate_round_trip(self, tmp_path):
+        """``--check`` passes against a just-banked run of the same
+        config and fails loudly (exit 1) when the banked decision
+        fingerprint or throughput budget regresses — the sched_bench
+        ratchet discipline."""
+        import json
+
+        sb = self._bench()
+        cfg = dict(sb.ROUTER_CONFIG)
+        cfg.update(seed=3, max_replicas=2, kill_at_s=4.0)
+        old_phases = sb.PHASES
+        sb.PHASES = ((1.0, 2.0), (4.0, 12.0), (4.0, 1.0))
+        try:
+            banked = {"router": sb.run_router_bench(cfg)}
+            ok = tmp_path / "bank_ok.json"
+            ok.write_text(json.dumps(banked))
+            assert sb.check_router_bench(str(ok)) == 0
+            bad = json.loads(ok.read_text())
+            bad["router"]["multi"]["decisions"] = [[0.0, 99]]
+            bad_path = tmp_path / "bank_bad.json"
+            bad_path.write_text(json.dumps(bad))
+            assert sb.check_router_bench(str(bad_path)) == 1
+            slow = json.loads(ok.read_text())
+            slow["router"]["multi"]["tokens_per_sec"] = \
+                banked["router"]["multi"]["tokens_per_sec"] * 10
+            slow_path = tmp_path / "bank_slow.json"
+            slow_path.write_text(json.dumps(slow))
+            assert sb.check_router_bench(str(slow_path)) == 1
+        finally:
+            sb.PHASES = old_phases

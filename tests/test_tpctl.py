@@ -44,9 +44,11 @@ class TestManifests:
         objs = manifests.render(cfg)
         kinds = [(o["kind"], ob.meta(o)["name"]) for o in objs]
         assert ("CustomResourceDefinition", "jaxjobs.kubeflow.org") in kinds
+        assert ("CustomResourceDefinition", "jaxservices.kubeflow.org") in kinds
         assert ("CustomResourceDefinition", "studyjobs.kubeflow.org") in kinds
         assert ("Namespace", "kubeflow") in kinds
         assert ("Deployment", "jaxjob-controller") in kinds
+        assert ("Deployment", "jaxservice-controller") in kinds
         assert ("Deployment", "centraldashboard") in kinds
         assert ("MutatingWebhookConfiguration", "poddefault-webhook") in kinds
         assert ("ClusterRole", "kubeflow-admin") in kinds

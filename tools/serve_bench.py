@@ -1,33 +1,57 @@
 #!/usr/bin/env python3
-"""Serving benchmark: decode throughput + latency percentiles under load.
+"""Serving benchmarks: single-replica decode modes AND the JAXService
+serving plane.
 
-Drives the in-process serving stack (no HTTP overhead) with a Poisson-ish
-open-loop arrival stream of pre-tokenized prompts and reports ONE JSON
-line per mode:
+Two families share this tool:
 
-  {"mode": "continuous", "tokens_per_sec": ..., "p50_ms": ...,
-   "p95_ms": ..., "requests": N, "slots": S, ...}
+1. **Decode modes** (the original ledger): drives the in-process
+   serving stack (no HTTP overhead) with an open-loop arrival stream of
+   pre-tokenized prompts and reports ONE JSON line per mode — `micro`
+   (MicroBatcher + whole-batch generate) vs `continuous` (slot
+   decoder). Run on real TPU for numbers that matter.
 
-Modes: `micro` (MicroBatcher + whole-batch generate) vs `continuous`
-(slot decoder). Run on real TPU for the numbers that matter; runs on the
-CPU mesh for plumbing validation. The training headline stays bench.py;
-this is the serving-side ledger (reference had none — TF-Serving was an
-integration, never measured in-tree).
+     python tools/serve_bench.py --model gpt-350m --param-dtype bfloat16 \\
+         --prompt-len 512 --max-new-tokens 64 --requests 64 --concurrency 16
 
-  python tools/serve_bench.py --model gpt-350m --param-dtype bfloat16 \\
-      --prompt-len 512 --max-new-tokens 64 --requests 64 --concurrency 16
+2. **The serving plane** (`--router`, ISSUE 8): a DETERMINISTIC
+   virtual-time benchmark of the token router + JAXService controller —
+   manual clock, seeded arrival trace, stub replicas with a fixed
+   tokens/sec service rate, zero wall-clock dependence, so every
+   latency/throughput number and every autoscaling decision replays
+   identically per seed. Two arms share one trace:
+
+   - ``single`` — replicas pinned at 1 (the pre-JAXService shape);
+   - ``multi``  — autoscaling 1..4 on router queue depth + tokens/sec,
+     WITH the scripted drills: a replica kill mid-load (the router must
+     shed its in-flight requests to survivors with zero drops and the
+     controller must re-provision) and a full scale-up/scale-down cycle
+     (cordon -> drain -> delete proven on the virtual clock).
+
+   Banked as BENCH_SERVE_r01.json; ``--check`` reruns the banked config
+   and gates on regression (the sched_bench.py ratchet mold):
+   any dropped request, a changed decision fingerprint (determinism),
+   or multi-arm throughput below 75% of the banked number fails CI.
+
+     python tools/serve_bench.py --router          # run + bank
+     python tools/serve_bench.py --check           # CI gate
 """
 
 from __future__ import annotations
 
 import argparse
+import heapq
 import json
+import math
 import os
+import random
 import sys
 import threading
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ROUTER_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_SERVE_r01.json")
 
 
 def run_mode(mode: str, args) -> dict:
@@ -116,6 +140,340 @@ def run_mode(mode: str, args) -> dict:
         served.close()
 
 
+# ---------------------------------------------------------------------------
+# The deterministic serving-plane benchmark (--router / --check)
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance_to(self, t: float) -> None:
+        self.t = max(self.t, t)
+
+
+# Virtual-time workload: (duration_s, arrivals_per_s) phases — a ramp
+# from a trickle into ~3x one replica's capacity (30 req/s x ~64 tokens
+# ~= 1900 tokens/s vs 600), then a lull so the scale-down half of the
+# cycle runs inside the measured window. The single arm queues the
+# whole overload and drains it for ~45 extra virtual seconds; the multi
+# arm scales to 4 and absorbs it.
+PHASES = ((5.0, 2.0), (20.0, 30.0), (25.0, 1.0))
+ROUTER_CONFIG = {
+    "seed": 0,
+    "tokens_lo": 32, "tokens_hi": 96,      # per-request new tokens
+    "replica_tokens_per_sec": 600.0,       # stub service rate
+    "replica_token_budget": 256,           # router queues beyond this
+    "max_queue": 2048,
+    "max_replicas": 4,
+    "target_queue_depth": 4,
+    "target_tokens_per_sec": 450.0,
+    "up_stabilization_s": 1.0,
+    "down_stabilization_s": 8.0,
+    "control_tick_s": 0.25,                # reconcile + endpoint sync cadence
+    "kill_at_s": 15.0,                     # multi arm: replica-1 dies here
+}
+
+
+def build_trace(cfg: dict, rng: random.Random) -> list[tuple[float, int]]:
+    """Seeded open-loop arrival trace: (time, tokens) per request."""
+    out = []
+    t = 0.0
+    for duration, rate in PHASES:
+        end = t + duration
+        while True:
+            t += rng.expovariate(rate)
+            if t >= end:
+                t = end
+                break
+            out.append((t, rng.randrange(cfg["tokens_lo"],
+                                         cfg["tokens_hi"])))
+    return out
+
+
+def run_router_arm(arm: str, cfg: dict) -> dict:
+    """One virtual-time run: the REAL JAXService controller against a
+    FakeCluster, the REAL token router, stub replicas modeled as
+    fixed-rate FIFO servers. Single-threaded event loop — every
+    transition is an explicit call, so decisions replay per seed."""
+    from kubeflow_tpu.control.jaxservice import types as T
+    from kubeflow_tpu.control.jaxservice.controller import build_controller
+    from kubeflow_tpu.control.k8s import objects as ob
+    from kubeflow_tpu.control.k8s.fake import FakeCluster
+    from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+    from kubeflow_tpu.control.runtime import seed_controller
+    from kubeflow_tpu.runtime.metrics import MetricsRegistry
+    from kubeflow_tpu.serving.router import (
+        RegistrySignals, RouterBusy, TokenRouter, parse_endpoints,
+    )
+
+    rng = random.Random(cfg["seed"])
+    trace = build_trace(cfg, rng)
+    clock = ManualClock()
+    cluster = FakeCluster(history_limit=65536)
+    registry = MetricsRegistry()
+    signals = RegistrySignals(registry)
+    ctl = seed_controller(build_controller(
+        cluster, record_events=False, registry=registry, signals=signals,
+        clock=clock))
+    kubelet = FakeKubelet(cluster)
+    max_replicas = 1 if arm == "single" else cfg["max_replicas"]
+    cluster.create(T.new_jaxservice(
+        "bench", model="gpt-125m", min_replicas=1,
+        max_replicas=max_replicas,
+        target_queue_depth=cfg["target_queue_depth"],
+        target_tokens_per_sec=cfg["target_tokens_per_sec"],
+        up_stabilization_s=cfg["up_stabilization_s"],
+        down_stabilization_s=cfg["down_stabilization_s"]))
+    router = TokenRouter(
+        service="bench", namespace="default", clock=clock,
+        registry=registry, prom_sink=False,
+        max_queue=cfg["max_queue"],
+        replica_token_budget=cfg["replica_token_budget"])
+
+    free_at: dict[str, float] = {}
+    seq: dict[int, int] = {}          # ticket id -> dispatch generation
+    events: list[tuple] = []          # (due, order, kind, payload)
+    order = [0]
+
+    def push(due: float, kind: str, payload) -> None:
+        order[0] += 1
+        heapq.heappush(events, (due, order[0], kind, payload))
+
+    def schedule(ticket) -> None:
+        name = ticket.member.name
+        due = max(clock.t, free_at.get(name, 0.0)) \
+            + ticket.tokens / cfg["replica_tokens_per_sec"]
+        free_at[name] = due
+        seq[id(ticket)] = seq.get(id(ticket), 0) + 1
+        push(due, "complete", (ticket, name, seq[id(ticket)]))
+
+    latencies: list[float] = []
+    tokens_done = 0
+    # peak-demand window (the overload phase): where capacity, not the
+    # workload, bounds throughput — the multi-vs-single scaling claim
+    ramp_start = PHASES[0][0]
+    ramp_end = ramp_start + PHASES[1][0]
+    ramp_tokens = 0
+    completed = rejected = shed_redispatches = 0
+    decisions: list[list] = []
+    kill_done = {"t": None, "restart_seen": False}
+
+    def control_tick() -> None:
+        nonlocal shed_redispatches
+        for _ in range(4):
+            if ctl.run_until_idle(max_rounds=1000,
+                                  advance_delayed=True) == 0:
+                break
+            kubelet.step()
+        svc = cluster.get(T.API_VERSION, T.KIND, "bench", "default")
+        target = (svc.get("status") or {}).get("targetReplicas", 1)
+        if not decisions or decisions[-1][1] != target:
+            # a list, not a tuple: the fingerprint must compare equal
+            # after a JSON round-trip through the banked file
+            decisions.append([round(clock.t, 2), target])
+        eps = parse_endpoints(svc)
+        live = {e["name"] for e in eps}
+        for name in list(free_at):
+            if name not in live:
+                free_at.pop(name)
+        redispatched = router.sync_endpoints(eps)
+        shed_redispatches += len(redispatched)
+        for t in redispatched:
+            schedule(t)
+        if (svc.get("status") or {}).get("restarts", 0) > 0:
+            kill_done["restart_seen"] = True
+
+    def kill_replica() -> None:
+        pod = cluster.get_or_none("v1", "Pod", "bench-replica-1",
+                                  "default")
+        if pod is None:
+            return
+        pod.setdefault("status", {})["phase"] = "Failed"
+        pod["status"]["reason"] = "Evicted"
+        cluster.update_status(pod)
+        free_at.pop("bench-replica-1", None)
+        kill_done["t"] = clock.t
+
+    # seed the event heap
+    for t_arr, tokens in trace:
+        push(t_arr, "arrive", tokens)
+    tick = 0.0
+    horizon = sum(d for d, _ in PHASES) + 120.0
+    while tick < horizon:
+        push(tick, "tick", None)
+        tick += cfg["control_tick_s"]
+    if arm == "multi":
+        push(cfg["kill_at_s"], "kill", None)
+
+    submitted: dict[int, float] = {}  # ticket id -> arrival time
+    pending = len(trace)
+    while events:
+        due, _, kind, payload = heapq.heappop(events)
+        clock.advance_to(due)
+        if kind == "tick":
+            control_tick()
+            if pending == 0 and router.queue_depth() == 0 \
+                    and router.inflight_tokens() == 0:
+                # drained: let the scale-down tail keep running a bit,
+                # then stop once no completion events remain
+                if not any(k == "complete" for _, _, k, _ in events):
+                    break
+        elif kind == "arrive":
+            try:
+                t = router.submit(payload)
+            except RouterBusy:
+                rejected += 1
+                pending -= 1
+                continue
+            submitted[id(t)] = clock.t
+            if t.member is not None:
+                schedule(t)
+        elif kind == "kill":
+            kill_replica()
+        elif kind == "complete":
+            ticket, name, gen = payload
+            if ticket.member is None or ticket.member.name != name \
+                    or seq.get(id(ticket)) != gen:
+                continue  # stale: the ticket was shed and rescheduled
+            latencies.append(clock.t - submitted.pop(id(ticket), clock.t))
+            tokens_done += ticket.tokens
+            if ramp_start <= clock.t <= ramp_end:
+                ramp_tokens += ticket.tokens
+            completed += 1
+            pending -= 1
+            for t in router.complete(ticket):
+                schedule(t)
+
+    svc = cluster.get(T.API_VERSION, T.KIND, "bench", "default")
+    status = svc.get("status") or {}
+    latencies.sort()
+
+    def pct(q: float) -> float:
+        if not latencies:
+            return 0.0
+        return round(latencies[min(len(latencies) - 1,
+                                   int(q * len(latencies)))], 3)
+
+    dropped = len(trace) - completed - rejected
+    return {
+        "arm": arm,
+        "requests": len(trace),
+        "completed": completed,
+        "rejected": rejected,
+        "dropped": dropped,
+        "tokens_done": tokens_done,
+        "virtual_makespan_s": round(clock.t, 2),
+        "tokens_per_sec": round(tokens_done / clock.t, 1) if clock.t else 0,
+        "peak_tokens_per_sec": round(
+            ramp_tokens / (ramp_end - ramp_start), 1),
+        "p50_s": pct(0.50),
+        "p95_s": pct(0.95),
+        "p99_s": pct(0.99),
+        "max_target": max((t for _, t in decisions), default=1),
+        "final_target": decisions[-1][1] if decisions else 1,
+        "scales": status.get("scales", 0),
+        "replica_restarts": status.get("restarts", 0),
+        "shed_redispatches": shed_redispatches,
+        "kill_at_s": kill_done["t"],
+        "decisions": decisions,
+    }
+
+
+def run_router_bench(cfg: dict) -> dict:
+    single = run_router_arm("single", cfg)
+    multi = run_router_arm("multi", cfg)
+    replay = run_router_arm("multi", cfg)  # determinism self-check
+    identical = (multi["decisions"] == replay["decisions"]
+                 and multi["tokens_done"] == replay["tokens_done"]
+                 and multi["p95_s"] == replay["p95_s"])
+    return {
+        "config": dict(cfg),
+        "single": single,
+        "multi": multi,
+        "comparison": {
+            "tokens_per_sec_x": round(
+                multi["tokens_per_sec"]
+                / max(single["tokens_per_sec"], 1e-9), 2),
+            "peak_tokens_per_sec_x": round(
+                multi["peak_tokens_per_sec"]
+                / max(single["peak_tokens_per_sec"], 1e-9), 2),
+            "p95_speedup_x": round(
+                single["p95_s"] / max(multi["p95_s"], 1e-9), 2),
+            "zero_dropped": single["dropped"] == 0
+            and multi["dropped"] == 0,
+            "kill_drill_survived": multi["replica_restarts"] >= 1
+            and multi["dropped"] == 0,
+            "scale_cycle_complete": multi["max_target"] > 1
+            and multi["final_target"] < multi["max_target"],
+            "decisions_replay_identical": identical,
+        },
+    }
+
+
+def check_router_bench(banked_path: str) -> int:
+    """CI ratchet: rerun the banked config; fail on any dropped
+    request, a broken drill, a changed decision fingerprint, or
+    multi-arm throughput below 75% of the banked number."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    section = banked.get("router")
+    if not section:
+        print(f"check: no router section in {banked_path}",
+              file=sys.stderr)
+        return 2
+    now = run_router_bench(dict(section["config"]))
+    ok = True
+    cmp_ = now["comparison"]
+    if not cmp_["zero_dropped"] or not cmp_["kill_drill_survived"]:
+        print("check: drill regression — dropped requests or the kill "
+              "drill failed", file=sys.stderr)
+        ok = False
+    if not cmp_["decisions_replay_identical"]:
+        print("check: determinism regression — same-seed replay "
+              "diverged", file=sys.stderr)
+        ok = False
+    if now["multi"]["decisions"] != section["multi"]["decisions"]:
+        print("check: autoscaling decisions diverged from the banked "
+              "fingerprint", file=sys.stderr)
+        ok = False
+    floor = section["multi"]["tokens_per_sec"] * 0.75
+    if now["multi"]["tokens_per_sec"] < floor:
+        print(f"check: multi tokens_per_sec "
+              f"{now['multi']['tokens_per_sec']} below budget "
+              f"{floor:.1f} (banked "
+              f"{section['multi']['tokens_per_sec']})", file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "multi_tokens_per_sec":
+                          now["multi"]["tokens_per_sec"],
+                      "comparison": cmp_}, indent=2))
+    return 0 if ok else 1
+
+
+def router_main(args) -> int:
+    if args.check:
+        return check_router_bench(args.out)
+    cfg = dict(ROUTER_CONFIG)
+    cfg["seed"] = args.seed
+    result = {"bench": "serve_bench", "round": "r01",
+              "router": run_router_bench(cfg)}
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"out": args.out,
+                      "comparison": result["router"]["comparison"],
+                      "single_tokens_per_sec":
+                          result["router"]["single"]["tokens_per_sec"],
+                      "multi_tokens_per_sec":
+                          result["router"]["multi"]["tokens_per_sec"]},
+                     indent=2))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser("serve_bench")
     p.add_argument("--model", default="gpt-350m")
@@ -142,7 +500,17 @@ def main() -> int:
     p.add_argument("--mesh", default="",
                    help="axis=n[,axis=n...] to shard the served params")
     p.add_argument("--modes", default="micro,continuous")
+    p.add_argument("--router", action="store_true",
+                   help="run the deterministic JAXService router+"
+                        "autoscaler benchmark and bank BENCH_SERVE_r01")
+    p.add_argument("--check", action="store_true",
+                   help="CI gate: rerun the banked router config and "
+                        "fail on drops/divergence/throughput regression")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--out", default=ROUTER_OUT)
     args = p.parse_args()
+    if args.router or args.check:
+        return router_main(args)
     if args.mesh:
         args.mesh = {k: int(v) for k, v in
                      (kv.split("=", 1) for kv in args.mesh.split(","))}
