@@ -93,6 +93,17 @@ class TransformerConfig:
     # O(max_seq). Requires attention_window > 0. Exact: token-for-token
     # equal to the full cache under the same window (pinned by tests).
     rolling_kv_cache: bool = False
+    # Paged decode KV cache (serving): both > 0 turns the decode cache
+    # into a fixed pool of `kv_pages` pages of `kv_page_size` positions
+    # each, SHARED across decode slots; callers pass per-slot page
+    # tables as a traced `page_table` [B, max_pages] argument
+    # (runtime/kvcache.py owns allocation/prefix-sharing on the host).
+    # Page 0 is the trash page: idle slots' writes land there so a
+    # freed page can be re-owned by another slot without a stale
+    # lockstep write corrupting it. Exact: token-for-token equal to
+    # the dense cache (pinned by tests).
+    kv_pages: int = 0
+    kv_page_size: int = 0
     remat: bool = False
     # "full": nothing_saveable — minimum memory, recompute everything.
     # "dots": keep matmul outputs, recompute only elementwise — most of
@@ -230,6 +241,68 @@ def _remat_policy(cfg: "TransformerConfig"):
 class Attention(nn.Module):
     cfg: TransformerConfig
 
+    def _decode_paged(self, q, k, v, decode_index, pad_len, page_table):
+        """Paged decode: the cache is a pool of [kv_pages, kv_page_size]
+        position pages shared across slots; `page_table` [B, MP] maps
+        each slot's logical page j (positions j*PS..(j+1)*PS-1) to a
+        physical pool page. Writes scatter the chunk's K/V to
+        (table[pos//PS], pos%PS) BEFORE attending (the full-cache
+        write-then-attend discipline, so speculative verify chunks
+        self-heal identically); reads gather the slot's pages back into
+        a logical [B, MP*PS] view and run the same masked attention as
+        the dense path — token-for-token equal by construction.
+
+        Why it's safe that the gather sees unallocated (0 = trash-page)
+        table entries: the allocator guarantees every position <= the
+        slot's current decode index is backed by an owned or shared
+        page, so trash content is only ever visible at masked
+        (pos > qpos) positions. Idle lockstep slots have their whole
+        row zeroed at free time, steering their stale writes into the
+        trash page instead of a page another slot now owns."""
+        cfg = self.cfg
+        b, lq = q.shape[0], q.shape[1]
+        hkv, hd = cfg.n_kv_heads, cfg.head_dim
+        NP, PS = cfg.kv_pages, cfg.kv_page_size
+        MP = page_table.shape[1]
+        ck = self.variable("cache", "key_pages",
+                           lambda: jnp.zeros((NP, PS, hkv, hd), cfg.dtype))
+        cv = self.variable("cache", "value_pages",
+                           lambda: jnp.zeros((NP, PS, hkv, hd), cfg.dtype))
+        idx = jnp.asarray(decode_index, jnp.int32)
+        if idx.ndim == 0:
+            idx = jnp.full((b,), idx, jnp.int32)
+        pos_q = idx[:, None] + jnp.arange(lq, dtype=jnp.int32)[None, :]
+        k_w = k.astype(cfg.dtype)
+        v_w = v.astype(cfg.dtype)
+        # ---- write the chunk, THEN attend ----
+        flat = pos_q.reshape(-1)                       # [b*lq] positions
+        rows = jnp.repeat(jnp.arange(b, dtype=jnp.int32), lq)
+        pages = page_table[rows, flat // PS]
+        offs = flat % PS
+        ck.value = ck.value.at[pages, offs].set(k_w.reshape(b * lq, hkv, hd))
+        cv.value = cv.value.at[pages, offs].set(v_w.reshape(b * lq, hkv, hd))
+        # gather the logical view (reference impl: a TPU kernel would
+        # stream pages instead of materializing the gather)
+        k_all = ck.value[page_table].reshape(b, MP * PS, hkv, hd)
+        v_all = cv.value[page_table].reshape(b, MP * PS, hkv, hd)
+        g = cfg.n_heads // hkv
+        qg = q.reshape(b, lq, hkv, g, hd)
+        logits = jnp.einsum(
+            "bqhgd,bshd->bhgqs", qg, k_all,
+            preferred_element_type=jnp.float32) * (hd ** -0.5)
+        pos = jnp.arange(MP * PS)[None, None, None, None, :]
+        qpos = pos_q[:, None, None, :, None]
+        mask = pos <= qpos
+        if cfg.attention_window:
+            mask = mask & (pos > qpos - cfg.attention_window)
+        if pad_len is not None:
+            mask = mask & (pos >= pad_len[:, None, None, None, None])
+        logits = jnp.where(mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum(
+            "bhgqs,bshd->bqhgd", probs.astype(cfg.dtype), v_all
+        ).reshape(b, lq, cfg.n_heads, hd)
+
     def _decode_rolling(self, q, k, v, decode_index, pad_len):
         """Bounded-window decode: the cache keeps only the last W
         positions (slot = position % W), so memory and per-step cache
@@ -324,6 +397,11 @@ class Attention(nn.Module):
                            >= pad_len[:, None, None])
         else:
             # per-row positions (continuous batching): lq == 1
+            if lq != 1:
+                raise ValueError(
+                    "rolling_kv_cache vector decode is single-token "
+                    f"(got chunk width {lq}); speculative/paged chunks "
+                    "need the full or paged cache")
             cur_old = idx - 1                                   # [b]
             pos_abs = cur_old[:, None] - (
                 (cur_old[:, None] - slots[None, :]) % W)        # [b, W]
@@ -377,7 +455,7 @@ class Attention(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, decode_index=None,
-                 pad_len=None):
+                 pad_len=None, page_table=None):
         cfg = self.cfg
         init = nn.initializers.normal(0.02)
         dense = lambda feats, names, name: nn.DenseGeneral(  # noqa: E731
@@ -402,7 +480,24 @@ class Attention(nn.Module):
         k = checkpoint_name(k, "attn_qkv")
         v = checkpoint_name(v, "attn_qkv")
 
-        if decode_index is not None and cfg.rolling_kv_cache:
+        if decode_index is not None and page_table is not None:
+            if not (cfg.kv_pages and cfg.kv_page_size):
+                raise ValueError(
+                    "page_table passed but the model was built without "
+                    "kv_pages/kv_page_size")
+            if cfg.rolling_kv_cache:
+                raise ValueError(
+                    "paged decode is exclusive with rolling_kv_cache "
+                    "(the page pool already bounds cache memory)")
+            if cfg.kv_cache_dtype != "auto":
+                raise ValueError(
+                    "paged decode supports kv_cache_dtype='auto' only "
+                    "(int8 page pools are not composed yet)")
+            # falls through to the SHARED output projection below, like
+            # the rolling path — 'o' must stay single-sited
+            out = self._decode_paged(q, k, v, decode_index, pad_len,
+                                     page_table)
+        elif decode_index is not None and cfg.rolling_kv_cache:
             if not cfg.attention_window:
                 raise ValueError(
                     "rolling_kv_cache requires attention_window > 0")
@@ -462,7 +557,7 @@ class Attention(nn.Module):
                 if quant:
                     cks.value = dus(cks.value, ks_w, (0, idx, 0, 0))
                     cvs.value = dus(cvs.value, vs_w, (0, idx, 0, 0))
-            else:
+            elif x.shape[1] == 1:
                 # per-row positions (continuous batching: every slot is at
                 # its own decode index): one-hot scatter along seq — a
                 # [B, S] elementwise select per layer, the static-shape
@@ -474,6 +569,33 @@ class Attention(nn.Module):
                 if quant:
                     cks.value = jnp.where(hot, ks_w, cks.value)
                     cvs.value = jnp.where(hot, vs_w, cvs.value)
+            else:
+                # per-row positions, MULTI-token chunk (lockstep
+                # speculative verify: every slot consumes its own
+                # [cur, d_1..d_k] chunk at its own position): row c of
+                # slot b lands at idx[b] + c. One-hot over (row, seq)
+                # folded by an einsum — the [B, lq, S] static-shape
+                # scatter; per-slot chunk positions are distinct so the
+                # fold never sums two writes
+                lw = x.shape[1]
+                posw = idx[:, None] + jnp.arange(lw, dtype=jnp.int32)[None, :]
+                hotw = (jnp.arange(cfg.max_seq_len)[None, None, :]
+                        == posw[:, :, None])
+                hitw = hotw.any(axis=1)                          # [B, S]
+
+                def _wr(old, new):
+                    upd = jnp.einsum("bls,bl...->bs...",
+                                     hotw.astype(new.dtype),
+                                     new).astype(old.dtype)
+                    keep = jnp.reshape(
+                        ~hitw, hitw.shape + (1,) * (old.ndim - 2))
+                    return jnp.where(keep, old, upd)
+
+                ck.value = _wr(ck.value, k_w)
+                cv.value = _wr(cv.value, v_w)
+                if quant:
+                    cks.value = _wr(cks.value, ks_w)
+                    cvs.value = _wr(cvs.value, vs_w)
             if quant:
                 # The int8 cache feeds the matmuls DIRECTLY (int8->bf16
                 # convert is exact for [-127,127] and fuses into the
@@ -513,7 +635,10 @@ class Attention(nn.Module):
                 qpos = (idx + jnp.arange(lq, dtype=jnp.int32)
                         )[None, None, None, :, None]
             else:
-                qpos = idx[:, None, None, None, None]
+                # vector idx: row c of slot b queries from idx[b] + c
+                # (degenerates to the old idx[:,None,...] at lq == 1)
+                qpos = (idx[:, None] + jnp.arange(lq, dtype=jnp.int32)
+                        [None, :])[:, None, None, :, None]
             mask = pos <= qpos
             if cfg.attention_window:
                 # same sliding window as training (train/serve parity);
@@ -635,7 +760,7 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, positions, segment_ids=None, decode_index=None,
-                 pad_len=None):
+                 pad_len=None, page_table=None):
         cfg = self.cfg
         # "block_norm" anchors both norm outputs: they are the weight-grad
         # inputs of the q/k/v and gate/up matmuls, so saving these d-wide
@@ -644,7 +769,7 @@ class Block(nn.Module):
         ln1 = checkpoint_name(
             RMSNorm(dtype=cfg.dtype, name="ln_attn")(x), "block_norm")
         x = x + Attention(cfg, name="attn")(
-            ln1, positions, segment_ids, decode_index, pad_len
+            ln1, positions, segment_ids, decode_index, pad_len, page_table
         )
         ln2 = checkpoint_name(
             RMSNorm(dtype=cfg.dtype, name="ln_mlp")(x), "block_norm")
@@ -689,7 +814,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = True, segment_ids=None,
-                 decode_index=None, pad_len=None, return_hidden=False):
+                 decode_index=None, pad_len=None, page_table=None,
+                 return_hidden=False):
         cfg = self.cfg
         del train  # no dropout in the speed-run configuration
         emb = self.param(
@@ -721,11 +847,11 @@ class TransformerLM(nn.Module):
             # single-token only)
             offs = jnp.arange(tokens.shape[1], dtype=jnp.int32)
             positions = (jnp.broadcast_to(idx + offs, tokens.shape)
-                         if idx.ndim == 0 else idx[:, None])
+                         if idx.ndim == 0 else idx[:, None] + offs[None, :])
             for i in range(cfg.n_layers):
                 use_moe = cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
                 x = Block(cfg, use_moe=use_moe, name=f"layer_{i}")(
-                    x, positions, None, decode_index, pad_len)
+                    x, positions, None, decode_index, pad_len, page_table)
             x = RMSNorm(dtype=cfg.dtype, name="ln_f")(x)
             return LMHead(cfg, name="lm_head")(x)
         positions = jnp.broadcast_to(

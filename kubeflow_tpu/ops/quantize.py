@@ -1,8 +1,13 @@
-"""The symmetric int8 primitive shared by weight quantization
+"""The symmetric int8/int4 primitives shared by weight quantization
 (serving/quant.py, per-output-channel) and the decode KV cache
 (models/transformer.py, per-token-head): one copy of the
 scale/round/clip recipe so the zero-amax guard and clip range can never
-drift between the two users."""
+drift between the users.
+
+int4 is stored PACKED — two nibbles per int8 byte along the last axis —
+so HBM holds and streams a quarter of the bf16 bytes; the unpack
+(shift/mask/sign-extend) runs inside whatever jit consumes the weights,
+where XLA fuses it into the dequantizing multiply."""
 
 from __future__ import annotations
 
@@ -26,3 +31,40 @@ def symmetric_int8(x, reduce_axes) -> tuple:
     scale = jnp.where(amax > 0, amax / 127.0, 1.0)
     q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
+
+
+def symmetric_int4(x, reduce_axes) -> tuple:
+    """Quantize ``x`` to UNPACKED int4 (int8 values in [-7, 7]) with a
+    shared scale per slice: ``q * scale ~= x``, error <= scale/2 per
+    element (scale = amax/7, so the bound is amax/14 — 127/7 ~= 18x
+    looser than int8's amax/254; the round-trip test pins both)."""
+    xf = jnp.asarray(x, jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=reduce_axes, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale), -7, 7).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def pack_int4(q) -> jnp.ndarray:
+    """Pack int4 values (int8 in [-8, 7]) pairwise along the LAST axis
+    into uint8 bytes: even index -> low nibble, odd -> high. The last
+    axis must be even (callers with odd trailing dims keep int8)."""
+    if q.shape[-1] % 2:
+        raise ValueError(
+            f"pack_int4 needs an even last axis, got shape {q.shape}")
+    lo = (q[..., 0::2] & 0xF).astype(jnp.uint8)
+    hi = (q[..., 1::2] & 0xF).astype(jnp.uint8)
+    return lo | (hi << 4)
+
+
+def unpack_int4(packed) -> jnp.ndarray:
+    """Inverse of pack_int4: uint8 bytes -> int8 values in [-8, 7],
+    last axis twice the packed size. Pure shift/mask/select — fusion
+    fodder inside the consuming jit, never an HBM resident."""
+    lo = (packed & 0xF).astype(jnp.int8)
+    hi = ((packed >> 4) & 0xF).astype(jnp.int8)
+    # sign-extend the nibble: values 8..15 are negatives 8-16..-1
+    lo = jnp.where(lo > 7, lo - 16, lo)
+    hi = jnp.where(hi > 7, hi - 16, hi)
+    out_shape = packed.shape[:-1] + (packed.shape[-1] * 2,)
+    return jnp.stack([lo, hi], axis=-1).reshape(out_shape)

@@ -71,6 +71,83 @@ def _verify_chunk(model, params, cache, chunk, n, pad_len=None):
     return mut["cache"], logits
 
 
+def greedy_accept(drafted, targets, k: int) -> int:
+    """Longest prefix of the k proposals the target's own greedy
+    argmaxes agree with — the ONE acceptance rule, shared between the
+    batch-1 loop and the lockstep slot decoder so they can never
+    drift."""
+    a = 0
+    while a < k and drafted[a] == targets[a]:
+        a += 1
+    return a
+
+
+# ---------------------------------------------------------------------------
+# Lockstep generalization: the same propose/verify round over [S, k]
+# slots at per-slot positions — what SlotDecoder._tick drives. Accept
+# lengths are data-dependent PER SLOT, so the host resyncs each slot's
+# draft cache by re-feeding the tokens emitted last round (a fixed
+# [S, k+1] buffer with a per-slot valid length) before proposing again.
+# Pad rows of that buffer write garbage K/V at future positions; the
+# write-then-attend discipline (every position is rewritten by a later
+# chunk before any query at or beyond it attends) makes the caches
+# self-heal — the same argument that already covers rejected proposals.
+
+
+@functools.partial(jax.jit, static_argnames=("model", "k"),
+                   donate_argnums=(2,))
+def lockstep_propose(model, params, cache, emitted, start, elen, *, k,
+                     pad_len=None):
+    """Resync + propose for S slots in lockstep.
+
+    emitted: [S, k+1] tokens emitted last round (right-padded),
+    start: [S] position of each row 0, elen: [S] valid lengths (the
+    last valid token of slot s sits at start[s] + elen[s] - 1).
+    Returns (cache', proposals [S, k]): one chunk apply (resync +
+    first proposal from the last valid row's logits) plus k-1 fused
+    single steps — k draft forwards per round, same as batch-1."""
+    pad_kw = {"pad_len": pad_len} if pad_len is not None else {}
+    logits, mut = model.apply(
+        params | {"cache": cache}, emitted, train=False,
+        decode_index=start, mutable=["cache"], **pad_kw)
+    cache = mut["cache"]
+    last = jnp.take_along_axis(
+        logits, (elen - 1)[:, None, None], axis=1)[:, 0]      # [S, V]
+    cur = jnp.argmax(last, axis=-1).astype(jnp.int32)         # d_1
+
+    def tick(carry, _):
+        cache, tok, idx = carry
+        lg, mut = model.apply(
+            params | {"cache": cache}, tok[:, None], train=False,
+            decode_index=idx, mutable=["cache"], **pad_kw)
+        nxt = jnp.argmax(lg[:, 0], axis=-1).astype(jnp.int32)
+        return (mut["cache"], nxt, idx + 1), tok
+
+    if k > 1:
+        (cache, last_tok, _), fed = jax.lax.scan(
+            tick, (cache, cur, start + elen), None, length=k - 1)
+        props = jnp.concatenate([fed.T, last_tok[:, None]], axis=1)
+    else:
+        props = cur[:, None]
+    return cache, props
+
+
+@functools.partial(jax.jit, static_argnames=("model",),
+                   donate_argnums=(2,))
+def lockstep_verify(model, params, cache, chunk, n, pad_len=None,
+                    page_table=None):
+    """Target forward over [S, C] chunks at per-slot positions n[s]
+    (dense or paged cache). Returns (cache', argmax ids [S, C]) — the
+    greedy targets the host's accept rule compares against."""
+    kw = {"pad_len": pad_len} if pad_len is not None else {}
+    if page_table is not None:
+        kw["page_table"] = page_table
+    logits, mut = model.apply(
+        params | {"cache": cache}, chunk, train=False,
+        decode_index=n, mutable=["cache"], **kw)
+    return mut["cache"], jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("model",))
 def _prefill(model, params, cache, prompt, pad_len=None):
     """Jitted prompt prefill (prefill_scan re-traces eagerly; a served
@@ -136,9 +213,7 @@ def speculative_generate(target, target_vars, draft, draft_vars,
             target, t_params, t_cache, chunk, jnp.int32(n), pad_len=pad_len)
         y = np.asarray(jnp.argmax(logits, axis=-1))[0]      # [k+1] targets
         d = np.asarray(props)[0]                            # [k] proposals
-        a = 0
-        while a < k and d[a] == y[a]:
-            a += 1
+        a = greedy_accept(d, y, k)
         emitted = list(d[:a]) + [y[a]]                      # a + 1 tokens
         if a == k:
             # full accept: the draft never consumed d_k, so its cache

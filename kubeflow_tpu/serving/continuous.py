@@ -16,6 +16,25 @@ static shape [S, 1] forever — no per-arrival recompiles — with per-slot
 positions (models/transformer.py vector `decode_index`), one-hot cache
 scatters instead of dynamic shapes, and masked sampling for idle slots.
 
+Three per-replica speed levers compose on top of the slot machinery
+(docs/serving.md "Per-replica decode path"):
+
+- **Paged KV cache** (model built with cfg.kv_pages/kv_page_size): the
+  dense [S, P+N] cache becomes a fixed page pool shared across slots;
+  admission is gated on PAGE availability (runtime/kvcache.py), so a
+  request holds only the pages its actual prompt + its own token
+  budget needs and short requests stop reserving P+N positions of HBM
+  for their whole life.
+- **Prefix reuse**: page-granular chained prompt hashes map to
+  read-only shared pages (copy-on-write on divergence), so a fleet of
+  requests sharing a system prompt skips most prefill compute.
+- **Speculative lockstep decode** (draft_model): greedy slots draft k
+  tokens (runtime/speculative.py lockstep_propose) and the target
+  verifies every slot's whole chunk in ONE [S, k+1] forward; per-slot
+  variable accept lengths ride the same masking discipline the tick
+  already uses, and output stays token-for-token equal to plain
+  greedy decode.
+
 Single-host scheduler; the decode/prefill programs themselves run under
 whatever mesh the variables are sharded over.
 """
@@ -26,30 +45,129 @@ import queue
 import threading
 from typing import Any
 
+from kubeflow_tpu.runtime.metrics import REGISTRY as METRICS_REGISTRY
+
 log = __import__("logging").getLogger("kubeflow_tpu.serving.continuous")
+
+
+def _prom(name, kind, doc, **kw):
+    from kubeflow_tpu.runtime.metrics import prom_metric
+
+    return prom_metric(name, kind, doc, **kw)
+
+
+class _DecodeMeter:
+    """Per-replica decode-path signals, exported to BOTH sinks (the
+    PR 4 convention): the MetricsRegistry text the control plane
+    scrapes and prometheus_client for dashboards. Catalogued in
+    docs/observability.md."""
+
+    def __init__(self, model: str, registry=METRICS_REGISTRY):
+        self.model = model
+        self.registry = registry
+
+    def pages(self, free: int, used: int) -> None:
+        import prometheus_client as prom
+
+        self.registry.gauge(
+            "serving_kv_pages_free", free,
+            help_="KV-cache pages available for admission", model=self.model)
+        self.registry.gauge(
+            "serving_kv_pages_used", used,
+            help_="KV-cache pages held by live or cached-prefix sequences",
+            model=self.model)
+        _prom("serving_kv_pages_free", prom.Gauge,
+              "KV-cache pages available for admission",
+              labelnames=("model",)).labels(self.model).set(free)
+        _prom("serving_kv_pages_used", prom.Gauge,
+              "KV-cache pages held by live or cached-prefix sequences",
+              labelnames=("model",)).labels(self.model).set(used)
+
+    def prefix_hits(self, pages: int) -> None:
+        # inc-by-zero on a miss keeps the series visible from the
+        # first admission
+        import prometheus_client as prom
+
+        self.registry.counter_inc(
+            "serving_prefix_cache_hits_total", by=float(pages),
+            help_="prompt pages served from the shared prefix cache "
+                  "(each hit skips page_size positions of prefill)",
+            model=self.model)
+        _prom("serving_prefix_cache_hits_total", prom.Counter,
+              "prompt pages served from the shared prefix cache",
+              labelnames=("model",)).labels(self.model).inc(pages)
+
+    def prefill_tokens(self, n: int) -> None:
+        if n <= 0:
+            return
+        import prometheus_client as prom
+
+        self.registry.counter_inc(
+            "serving_prefill_tokens_total", by=float(n),
+            help_="prompt positions actually computed by prefill "
+                  "(prefix reuse drives this below tokens submitted)",
+            model=self.model)
+        _prom("serving_prefill_tokens_total", prom.Counter,
+              "prompt positions actually computed by prefill",
+              labelnames=("model",)).labels(self.model).inc(n)
+
+    def spec_round(self, slots: int, accepted: int) -> None:
+        import prometheus_client as prom
+
+        self.registry.counter_inc(
+            "serving_spec_rounds_total", by=float(slots),
+            help_="speculative verify forwards, one per active slot "
+                  "per round (tokens emitted / rounds = tokens per "
+                  "target forward)", model=self.model)
+        _prom("serving_spec_rounds_total", prom.Counter,
+              "speculative verify forwards (slot-rounds)",
+              labelnames=("model",)).labels(self.model).inc(slots)
+        # inc-by-zero keeps the series visible: a disagreeing draft
+        # shows an explicit 0, not a missing metric
+        self.registry.counter_inc(
+            "serving_spec_tokens_accepted_total", by=float(accepted),
+            help_="draft tokens accepted by the target verify",
+            model=self.model)
+        _prom("serving_spec_tokens_accepted_total", prom.Counter,
+              "draft tokens accepted by the target verify",
+              labelnames=("model",)).labels(self.model).inc(accepted)
 
 
 class SlotDecoder:
     """S-slot continuous decoder over a KV-cache LM.
 
-    Host API: ``submit(tokens) -> list[int]`` blocks the calling thread
-    until that request's continuation is done; many threads may submit
-    concurrently. A background loop admits pending requests into free
-    slots at step boundaries and advances all active slots one token per
-    tick.
+    Host API: ``submit(tokens, max_new=None) -> list[int]`` blocks the
+    calling thread until that request's continuation is done; many
+    threads may submit concurrently. A background loop admits pending
+    requests into free slots at step boundaries and advances all
+    active slots one token (or one speculative chunk) per tick.
+
+    Modes (orthogonal where meaningful):
+
+    - dense (default): per-slot [S, max_seq] cache rows, batched
+      idle-burst prefill — the original shape.
+    - paged: the model was built with cfg.kv_pages/kv_page_size; a
+      PageAllocator gates admission on page availability, prompts
+      reuse shared prefix pages, per-request prefill computes only the
+      uncached suffix.
+    - speculative (draft_model given): greedy-only lockstep
+      propose/verify rounds; composes with dense or paged target.
     """
 
     def __init__(self, model, variables, *, slots: int = 8,
                  prompt_len: int = 128, max_new_tokens: int = 32,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 mesh=None):
+                 mesh=None, prefix_cache: bool = True,
+                 draft_model=None, draft_variables=None, draft_k: int = 4,
+                 metrics_name: str | None = None):
         import jax
         import jax.numpy as jnp
 
         from kubeflow_tpu.runtime.generate import (
             check_decode_geometry, init_cache, prefill_scan)
+        from kubeflow_tpu.runtime.kvcache import (
+            PageAllocator, init_paged_cache, pages_for)
 
-        check_decode_geometry(model, prompt_len, max_new_tokens)
         self.model = model
         self.variables = variables
         self.S = slots
@@ -59,6 +177,54 @@ class SlotDecoder:
         self._jnp = jnp
         self._jax = jax
         cfg_vocab = model.cfg.vocab_size
+        self.spec = draft_model is not None
+        self.draft_k = draft_k if self.spec else 0
+        self.paged = bool(getattr(model.cfg, "kv_pages", 0))
+        check_decode_geometry(model, prompt_len,
+                              max_new_tokens + self.draft_k)
+        if self.spec:
+            if temperature != 0.0:
+                raise ValueError("speculative lockstep decode is "
+                                 "greedy-only (temperature must be 0)")
+            if draft_k < 1:
+                raise ValueError("draft_k must be >= 1")
+            for name, m in (("target", model), ("draft", draft_model)):
+                if getattr(m.cfg, "rolling_kv_cache", False):
+                    raise ValueError(
+                        f"speculative decoding requires the full or "
+                        f"paged KV cache; {name} has rolling_kv_cache")
+            if getattr(draft_model.cfg, "kv_pages", 0):
+                raise ValueError("the draft model keeps a dense cache "
+                                 "(build it without kv_pages)")
+            check_decode_geometry(draft_model, prompt_len,
+                                  max_new_tokens + draft_k)
+        # a slot's worst-case sequence: prompt + its budget + the
+        # speculative verify chunk's overhang past the last token
+        self._total_len = prompt_len + max_new_tokens + self.draft_k
+        if self.paged:
+            cfg = model.cfg
+            self.page_size = cfg.kv_page_size
+            self._mp = pages_for(self._total_len, self.page_size)
+            usable = cfg.kv_pages - 1  # page 0 is trash
+            if usable < self._mp:
+                raise ValueError(
+                    f"kv_pages={cfg.kv_pages} cannot hold even one "
+                    f"sequence ({self._mp} pages of {self.page_size} "
+                    "needed, page 0 is trash)")
+            self.alloc = PageAllocator(
+                cfg.kv_pages, self.page_size, slots, self._mp,
+                prefix_cache=prefix_cache)
+        else:
+            self.alloc = None
+        self.meter = _DecodeMeter(metrics_name) if metrics_name else None
+
+        # host-truth counters (stats(); the meter mirrors into sinks)
+        self._counters = {
+            "admitted": 0, "completed": 0, "peak_active": 0,
+            "prefill_tokens_computed": 0, "prompt_tokens_submitted": 0,
+            "spec_rounds": 0, "spec_tokens_emitted": 0,
+            "spec_tokens_accepted": 0, "spec_drafted": 0,
+        }
 
         # Params are jit ARGUMENTS everywhere below, never closure
         # captures: a closed-over weight tree is serialized into the
@@ -69,6 +235,9 @@ class SlotDecoder:
         # predict path (fwd(params, x)) always did it right; this
         # decoder now matches.
         self._params = {"params": variables["params"]}
+        if self.spec:
+            self._d_params = {"params": draft_variables["params"]}
+            self.draft = draft_model
 
         # -- compiled: batch-K prefill (the ONE prefill implementation,
         #    shared with generate(): runtime/generate.py prefill_scan).
@@ -84,8 +253,8 @@ class SlotDecoder:
 
         # -- compiled: install K prefilled rows into K slots in ONE
         #    program (K static, unrolled; slot ids traced) --------------
-        def _install(state, cache_k, logits_k, slots_k, pads_k):
-            cache, last, pos, remaining, out, pads, rng = state
+        def _install(state, cache_k, logits_k, slots_k, pads_k, news_k):
+            cache, last, pos, remaining, out, pads, req, rng = state
             k = logits_k.shape[0]
             for i in range(k):  # static unroll: K is a compile-time size
                 si = slots_k[i]
@@ -97,51 +266,92 @@ class SlotDecoder:
                 last = jax.lax.dynamic_update_slice(
                     last, logits_k[i][None], (si, 0))
                 pos = _set1(jnp, pos, si, self.P)
-                remaining = _set1(jnp, remaining, si, self.N)
+                remaining = _set1(jnp, remaining, si, news_k[i])
                 out = jax.lax.dynamic_update_slice(
                     out, jnp.zeros((1, self.N), jnp.int32), (si, 0))
                 pads = _set1(jnp, pads, si, pads_k[i])
-            return (cache, last, pos, remaining, out, pads, rng)
+                req = _set1(jnp, req, si, news_k[i])
+            return (cache, last, pos, remaining, out, pads, req, rng)
 
         self._install = jax.jit(_install, donate_argnums=(0,))
 
         # -- compiled: deactivate slots (dummy prefill targets) ----------
         def _clear_slots(state, slots_k):
-            cache, last, pos, remaining, out, pads, rng = state
+            cache, last, pos, remaining, out, pads, req, rng = state
             clear = (jnp.arange(self.S)[:, None]
                      == slots_k[None, :]).any(axis=1)
             remaining = jnp.where(clear, 0, remaining)
-            return (cache, last, pos, remaining, out, pads, rng)
+            return (cache, last, pos, remaining, out, pads, req, rng)
 
         self._clear_slots = jax.jit(_clear_slots, donate_argnums=(0,))
 
+        # -- compiled: paged prefill of ONE request's uncached prompt
+        #    suffix + install (the suffix length is one of a bounded
+        #    set of page-aligned sizes, so compiles stay bounded) -------
+        def _paged_prefill_install(params, state, toks, start, pt_row,
+                                   pad, slot, req_n):
+            cache, last, pos, remaining, out, pads, req, rng = state
+            logits, mut = model.apply(
+                params | {"cache": cache}, toks, train=False,
+                decode_index=start, mutable=["cache"], pad_len=pad,
+                page_table=pt_row)
+            cache = mut["cache"]
+            last = jax.lax.dynamic_update_slice(
+                last, logits[:, -1], (slot, 0))
+            pos = _set1(jnp, pos, slot, self.P)
+            remaining = _set1(jnp, remaining, slot, req_n)
+            out = jax.lax.dynamic_update_slice(
+                out, jnp.zeros((1, self.N), jnp.int32), (slot, 0))
+            pads = _set1(jnp, pads, slot, pad[0])
+            req = _set1(jnp, req, slot, req_n)
+            return (cache, last, pos, remaining, out, pads, req, rng)
+
+        self._paged_prefill_install = jax.jit(
+            _paged_prefill_install, donate_argnums=(1,))
+
+        # -- compiled: apply COW page clones before a program writes ----
+        def _apply_copies(state, src, dst):
+            from kubeflow_tpu.runtime.kvcache import copy_pages
+
+            return (copy_pages(state[0], src, dst),) + tuple(state[1:])
+
+        self._apply_copies = jax.jit(_apply_copies, donate_argnums=(0,))
+
         # -- compiled: one lockstep decode tick for all S slots ----------
-        def _tick(params, state):
-            cache, last, pos, remaining, out, pads, rng = state
+        def _tick(params, state, page_table=None):
+            cache, last, pos, remaining, out, pads, req, rng = state
             from kubeflow_tpu.runtime.generate import _sample
 
             active = remaining > 0
             rng, sub = jax.random.split(rng)
             tok = _sample(last, temperature, top_k, sub)
             # record the sampled token at each active slot's next column
-            # (column index = tokens generated so far = N - remaining)
-            ncol = self.N - remaining
+            # (column index = tokens generated so far = req - remaining)
+            ncol = req - remaining
             hot = (jnp.arange(self.N)[None, :] == ncol[:, None]) \
                 & active[:, None]
             out = jnp.where(hot, tok[:, None], out)
             # advance the model one position for every slot (idle slots
             # compute too — lockstep static shape — but their state is
-            # frozen by the masks below and their cache rows are fully
-            # overwritten at the next install)
+            # frozen by the masks below; their cache writes land in
+            # their own dead rows (dense) or the trash page (paged))
             logits_next, mut = model.apply(
                 params | {"cache": cache}, tok[:, None], train=False,
-                decode_index=pos, mutable=["cache"], pad_len=pads)
+                decode_index=pos, mutable=["cache"], pad_len=pads,
+                **({"page_table": page_table}
+                   if page_table is not None else {}))
             pos = jnp.where(active, pos + 1, pos)
             remaining = jnp.where(active, remaining - 1, remaining)
             last = jnp.where(active[:, None], logits_next[:, 0], last)
-            return (mut["cache"], last, pos, remaining, out, pads, rng)
+            return (mut["cache"], last, pos, remaining, out, pads, req, rng)
 
-        self._step = jax.jit(_tick, donate_argnums=(1,))
+        if self.paged:
+            self._step = jax.jit(_tick, donate_argnums=(1,))
+        else:
+            # dense signature stays (params, state): the trace spies in
+            # tests and the fused scan below rely on it
+            self._step = jax.jit(lambda params, state: _tick(params, state),
+                                 donate_argnums=(1,))
 
         # -- compiled: FUSE ticks in one dispatched program. Each
         #    dispatch costs a host round-trip; through a remote tunnel
@@ -156,66 +366,140 @@ class SlotDecoder:
         #    and every active slot has >= FUSE tokens to go. ------------
         FUSE = 8
 
-        def _step_fused(params, state):
+        def _step_fused(params, state, page_table=None):
             def body(st, _):
-                return _tick(params, st), None
+                return _tick(params, st, page_table), None
 
             st, _ = jax.lax.scan(body, state, None, length=FUSE)
             return st
 
-        self._step_fused = jax.jit(_step_fused, donate_argnums=(1,))
+        if self.paged:
+            self._step_fused = jax.jit(_step_fused, donate_argnums=(1,))
+        else:
+            self._step_fused = jax.jit(
+                lambda params, state: _step_fused(params, state),
+                donate_argnums=(1,))
         self._fuse = FUSE
+
+        # -- compiled: speculative admission (prefill target + draft,
+        #    install into slot rows, return the first greedy token) ----
+        if self.spec:
+            draft = draft_model
+
+            def _row_install(big_tree, row_tree, slot):
+                return jax.tree.map(
+                    lambda big, kk: jax.lax.dynamic_update_slice(
+                        big, kk.astype(big.dtype),
+                        (slot,) + (0,) * (big.ndim - 1)),
+                    big_tree, row_tree)
+
+            def _spec_admit_dense(t_params, d_params, t_cache, d_cache,
+                                  prompt, pad, slot):
+                tc1, tlogits = prefill_scan(
+                    model, t_params, init_cache(model, 1), prompt, pad)
+                dc1, _ = prefill_scan(
+                    draft, d_params, init_cache(draft, 1), prompt, pad)
+                t_cache = _row_install(t_cache, tc1, slot)
+                d_cache = _row_install(d_cache, dc1, slot)
+                first = jnp.argmax(tlogits[0], axis=-1).astype(jnp.int32)
+                return t_cache, d_cache, first
+
+            self._spec_admit_dense = jax.jit(
+                _spec_admit_dense, donate_argnums=(2, 3))
+
+            def _spec_admit_paged(t_params, d_params, t_cache, d_cache,
+                                  toks, start, pt_row, prompt, pad, slot):
+                logits, mut = model.apply(
+                    t_params | {"cache": t_cache}, toks, train=False,
+                    decode_index=start, mutable=["cache"], pad_len=pad,
+                    page_table=pt_row)
+                t_cache = mut["cache"]
+                dc1, _ = prefill_scan(
+                    draft, d_params, init_cache(draft, 1), prompt, pad)
+                d_cache = _row_install(d_cache, dc1, slot)
+                first = jnp.argmax(logits[0, -1], axis=-1).astype(jnp.int32)
+                return t_cache, d_cache, first
+
+            self._spec_admit_paged = jax.jit(
+                _spec_admit_paged, donate_argnums=(2, 3))
 
         # -- device state (rebuildable: a failed donated call leaves the
         #    old buffers dead, so recovery re-creates from scratch) ------
+        def _fresh_cache():
+            if self.paged:
+                return init_paged_cache(model, self._mp)
+            return init_cache(model, self.S)
+
         def _fresh_state():
             return (
-                init_cache(model, self.S),
+                _fresh_cache(),
                 jnp.zeros((self.S, cfg_vocab), jnp.float32),
                 jnp.zeros((self.S,), jnp.int32),            # pos
                 jnp.zeros((self.S,), jnp.int32),            # remaining
                 jnp.zeros((self.S, self.N), jnp.int32),     # out
                 jnp.zeros((self.S,), jnp.int32),            # pad_len
+                jnp.zeros((self.S,), jnp.int32),            # req budget
                 jax.random.PRNGKey(seed),
             )
 
+        self._fresh_cache = _fresh_cache
         self._fresh_state = _fresh_state
-        self.state = _fresh_state()
+        if self.spec:
+            self.t_cache = _fresh_cache()
+            self.d_cache = init_cache(draft_model, self.S)
+            self._fresh_d_cache = lambda: init_cache(draft_model, self.S)
+        else:
+            self.state = _fresh_state()
+        # bytes the decode cache holds on-device (shape truth: the
+        # density claims in tools/serve_bench.py --decode assert on it)
+        probe = jax.eval_shape(_fresh_cache)
+        self._cache_bytes = sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree.leaves(probe))
         # prefill batch sizes we're willing to compile (smallest >= the
         # waiting count is used; idle bursts prefill together)
         self._PREFILL_SIZES = tuple(sorted(
             {n for n in (1, 2, 4, 8, 16, 32) if n < self.S} | {self.S}))
         self._free: list[int] = list(range(self.S))
         self._pending: "queue.Queue[tuple]" = queue.Queue()
+        self._carry: tuple | None = None  # page-gated head of the queue
         # guards the _stop flag vs submit(): an enqueue must strictly
         # precede the shutdown drain or the caller waits forever
         self._lock = threading.Lock()
         self._active = 0  # host-side mirror (device state is donated)
         self._wake = threading.Event()
         self._stop = False
-        self._thread = threading.Thread(target=self._loop, daemon=True,
-                                        name="slot-decoder")
+        self._thread = threading.Thread(
+            target=self._loop_spec if self.spec else self._loop,
+            daemon=True, name="slot-decoder")
         self._thread.start()
 
     # -- host API ----------------------------------------------------------
 
-    def submit(self, tokens: list[int]) -> list[int]:
-        """Block until the continuation for this prompt is decoded."""
+    def submit(self, tokens: list[int], max_new: int | None = None
+               ) -> list[int]:
+        """Block until the continuation for this prompt is decoded.
+        `max_new` caps THIS request's budget below the decoder-wide
+        max_new_tokens (a paged decoder then reserves fewer pages)."""
         row = [int(t) for t in tokens][-self.P:]
         pad = self.P - len(row)
-        return self.submit_padded([0] * pad + row, pad)
+        return self.submit_padded([0] * pad + row, pad, max_new)
 
-    def submit_padded(self, padded_row, pad: int) -> list[int]:
+    def submit_padded(self, padded_row, pad: int,
+                      max_new: int | None = None) -> list[int]:
         """Pre-padded variant for callers that already align rows."""
         import numpy as np
 
+        req = self.N if max_new is None else int(max_new)
+        if not 1 <= req <= self.N:
+            raise ValueError(f"max_new must be in 1..{self.N}, got {req}")
         prompt = np.asarray(padded_row, dtype=np.int32)
         ev = threading.Event()
         sink: list = []
         with self._lock:  # enqueue-before-drain or fail fast, atomically
             if self._stop:
                 raise RuntimeError("decoder shut down")
-            self._pending.put((prompt, pad, ev, sink))
+            self._pending.put((prompt, pad, req, ev, sink))
         self._wake.set()
         ev.wait()
         if sink and isinstance(sink[0], Exception):
@@ -234,7 +518,78 @@ class SlotDecoder:
         # the loop's buffer donation (donate_argnums)
         return self._active
 
-    # -- scheduler loop ----------------------------------------------------
+    def stats(self) -> dict:
+        """Host-truth counters (deterministic; what serve_bench banks)."""
+        out = dict(self._counters)
+        out["mode"] = "paged" if self.paged else "dense"
+        out["speculative"] = self.spec
+        out["cache_bytes"] = self._cache_bytes
+        if self.paged:
+            out.update(
+                kv_pages_total=self.alloc.num_pages - 1,  # sans trash
+                kv_page_size=self.page_size,
+                kv_pages_free=self.alloc.free_pages,
+                kv_pages_used=self.alloc.used_pages,
+                prefix_hit_pages=self.alloc.prefix_hit_pages,
+                prefix_hit_tokens=self.alloc.prefix_hit_tokens,
+                cow_clones=self.alloc.cow_clones,
+            )
+        return out
+
+    # -- shared loop pieces ------------------------------------------------
+
+    def _note_active(self, owners) -> None:
+        self._active = len(owners)
+        if len(owners) > self._counters["peak_active"]:
+            self._counters["peak_active"] = len(owners)
+
+    def _publish_pages(self) -> None:
+        if self.meter and self.paged:
+            self.meter.pages(self.alloc.free_pages, self.alloc.used_pages)
+
+    def _cow_arrays(self, copies):
+        """[(src, dst)] page clones -> traced index arrays; the ONE
+        conversion every COW-apply site shares."""
+        jnp = self._jnp
+        return (jnp.asarray([c[0] for c in copies], jnp.int32),
+                jnp.asarray([c[1] for c in copies], jnp.int32))
+
+    def _drain_shutdown(self, owners: dict) -> None:
+        for ev, sink, _req in list(owners.values()):
+            sink.append(RuntimeError("decoder shut down"))
+            ev.set()
+        if self._carry is not None:
+            _p, _pad, _req, ev, sink = self._carry
+            sink.append(RuntimeError("decoder shut down"))
+            ev.set()
+            self._carry = None
+        while not self._pending.empty():
+            _p, _pad, _req, ev, sink = self._pending.get_nowait()
+            sink.append(RuntimeError("decoder shut down"))
+            ev.set()
+
+    def _next_pending(self):
+        """FIFO head: the page-gated carry first, then the queue."""
+        if self._carry is not None:
+            item, self._carry = self._carry, None
+            return item
+        if not self._pending.empty():
+            return self._pending.get_nowait()
+        return None
+
+    def _validate(self, item) -> bool:
+        """Row-shape validation; a malformed row fails ONLY its caller
+        and never reaches a slot."""
+        prompt, _pad, _req, ev, sink = item
+        if prompt.shape != (self.P,):
+            sink.append(ValueError(
+                f"padded row must have length {self.P}, "
+                f"got {prompt.shape}"))
+            ev.set()
+            return False
+        return True
+
+    # -- scheduler loop (plain greedy/sampled decode) ----------------------
 
     def _loop(self) -> None:
         import contextlib
@@ -242,7 +597,7 @@ class SlotDecoder:
         import numpy as np
 
         jnp = self._jnp
-        owners: dict[int, tuple[threading.Event, list]] = {}
+        owners: dict[int, tuple] = {}   # slot -> (ev, sink, req)
         ctx = self.mesh if self.mesh is not None else None
 
         def fail_all(err, batch=()):
@@ -250,90 +605,27 @@ class SlotDecoder:
             failed donated call the old buffers are dead — continuing on
             them would turn the decoder into a zombie that errors every
             future request while still accepting submits."""
-            for _p, _pad, ev, sink in batch:
+            for _p, _pad, _req, ev, sink in batch:
                 sink.append(err)
                 ev.set()
-            for s_, (ev, sink) in list(owners.items()):
+            for s_, (ev, sink, _req) in list(owners.items()):
                 sink.append(err)
                 ev.set()
             owners.clear()
             self._free = list(range(self.S))
+            if self.alloc is not None:
+                self.alloc.reset()
             self.state = self._fresh_state()
 
         last_rem = np.zeros(self.S, np.int64)  # host mirror of remaining
+        last_pos = np.zeros(self.S, np.int64)  # host mirror of pos
         while not self._stop:
             try:
-                # admit pending requests into free slots (step boundary).
-                # Idle decoder: take a BATCH of waiting prompts (padded
-                # up to the next supported prefill size) so an idle
-                # burst prefills together. Anything mid-generation:
-                # admit at most ONE per tick — a burst must not stall
-                # in-flight decodes.
-                if self._free and not self._pending.empty():
-                    want = 1 if owners else len(self._free)
-                    batch = []
-                    while len(batch) < want and not self._pending.empty():
-                        batch.append(self._pending.get_nowait())
-                    # validate rows FIRST; a wrong-length row (the
-                    # submit_padded caller's bug) fails THAT caller only
-                    # and never enters the batch, so row indices below
-                    # stay aligned with the prefill outputs
-                    valid = []
-                    for prompt, pad, ev, sink in batch:
-                        if prompt.shape != (self.P,):
-                            sink.append(ValueError(
-                                f"padded row must have length {self.P}, "
-                                f"got {prompt.shape}"))
-                            ev.set()
-                        else:
-                            valid.append((prompt, pad, ev, sink))
-                    batch = valid
-                    if batch:
-                        k = next(n for n in self._PREFILL_SIZES
-                                 if n >= len(batch))
-                        prompts = np.zeros((k, self.P), np.int32)
-                        pads = np.zeros((k,), np.int32)
-                        for i, (prompt, pad, _ev, _sink) in enumerate(batch):
-                            prompts[i] = prompt
-                            pads[i] = pad
-                        slots = [self._free.pop()
-                                 for _ in range(len(batch))]
-                        # dummy rows (k > len(batch)) target REMAINING
-                        # free slots: they hold no generation, and any
-                        # future real install fully overwrites the row.
-                        # Idle admission guarantees enough free slots
-                        # (batch <= free == S >= k); active admission is
-                        # always k == batch == 1.
-                        dummies = self._free[:k - len(slots)]
-                        pad_slots = slots + dummies
-                        assert len(pad_slots) == k, (k, slots, dummies)
-                        try:
-                            with (ctx or contextlib.nullcontext()):
-                                cache_k, logits_k = self._prefill(
-                                    self._params,
-                                    jnp.asarray(prompts), jnp.asarray(pads))
-                                new_state = self._install(
-                                    self.state, cache_k, logits_k,
-                                    jnp.asarray(pad_slots, jnp.int32),
-                                    jnp.asarray(pads))
-                        except Exception as e:
-                            self._free.extend(slots)
-                            fail_all(e, batch)
-                        else:
-                            self.state = new_state
-                            # dummy installs left remaining>0 on their
-                            # free slots: zero them so the step loop
-                            # never decodes an unowned slot
-                            if dummies:
-                                self.state = self._clear_slots(
-                                    self.state,
-                                    jnp.asarray(dummies, jnp.int32))
-                            last_rem = np.array(last_rem)  # writable copy
-                            for s_, (prompt, pad, ev, sink) in zip(
-                                    slots, batch):
-                                owners[s_] = (ev, sink)
-                                last_rem[s_] = self.N
-                self._active = len(owners)
+                if self.paged:
+                    self._admit_paged(owners, fail_all, last_rem, last_pos)
+                else:
+                    self._admit_dense(owners, fail_all, last_rem)
+                self._note_active(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -345,37 +637,387 @@ class SlotDecoder:
                 # SATURATED (no free slot) a queued request loses zero
                 # ticks to fusion — that saturated case is exactly the
                 # latency-bound regime the fusion exists for (host-side
-                # remaining mirror: last readback, N for fresh installs)
-                fuse = ((self._pending.empty() or not self._free)
+                # remaining mirror: last readback, req for fresh installs)
+                waiting = (self._carry is not None
+                           or not self._pending.empty())
+                fuse = ((not waiting or not self._free)
                         and all(int(last_rem[s_]) >= self._fuse
                                 for s_ in owners))
+                ticks = self._fuse if fuse else 1
+                if self.paged:
+                    # decode writes march forward: hand out the pages
+                    # the window will cross (reserved at admission) and
+                    # run the COW barrier over the write range
+                    for s_ in owners:
+                        start = int(last_pos[s_])
+                        self.alloc.append(s_, start + ticks)
+                        copies = self.alloc.write_barrier(
+                            s_, start, start + ticks)
+                        if copies:
+                            self.state = self._apply_copies(
+                                self.state, *self._cow_arrays(copies))
+                    pt = jnp.asarray(self.alloc.table)
+                    args = (self._params, self.state, pt)
+                else:
+                    args = (self._params, self.state)
                 with (ctx or contextlib.nullcontext()):
                     self.state = (self._step_fused if fuse else
-                                  self._step)(self._params, self.state)
+                                  self._step)(*args)
                 remaining = np.asarray(self.state[3])
-                last_rem = remaining
+                # writable copies: admission writes fresh slots' mirrors
+                last_rem = np.array(remaining)
+                last_pos = np.array(self.state[2])
                 out = None
                 for s_ in list(owners):
                     if remaining[s_] <= 0:
                         if out is None:  # one readback per tick, lazily
                             out = np.asarray(self.state[4])
-                        ev, sink = owners.pop(s_)
-                        sink.extend(int(t) for t in out[s_])
+                        ev, sink, req = owners.pop(s_)
+                        sink.extend(int(t) for t in out[s_][:req])
                         ev.set()
                         self._free.append(s_)
-                self._active = len(owners)
+                        self._counters["completed"] += 1
+                        if self.paged:
+                            self.alloc.free(s_)
+                self._publish_pages()
+                self._note_active(owners)
             except Exception as e:  # a broken step: poison + rebuild
                 log.exception("slot-decoder loop failed")
                 fail_all(e)
                 self._active = 0
         # shutdown: fail any stragglers
-        for ev, sink in list(owners.values()):
-            sink.append(RuntimeError("decoder shut down"))
+        self._drain_shutdown(owners)
+
+    # -- admission: dense (batched idle-burst prefill) ---------------------
+
+    def _admit_dense(self, owners, fail_all, last_rem) -> None:
+        import contextlib
+
+        import numpy as np
+
+        jnp = self._jnp
+        ctx = self.mesh if self.mesh is not None else None
+        if not (self._free and not self._pending.empty()):
+            return
+        # admit pending requests into free slots (step boundary).
+        # Idle decoder: take a BATCH of waiting prompts (padded
+        # up to the next supported prefill size) so an idle
+        # burst prefills together. Anything mid-generation:
+        # admit at most ONE per tick — a burst must not stall
+        # in-flight decodes.
+        want = 1 if owners else len(self._free)
+        batch = []
+        while len(batch) < want and not self._pending.empty():
+            batch.append(self._pending.get_nowait())
+        # validate rows FIRST; a wrong-length row (the submit_padded
+        # caller's bug) fails THAT caller only and never enters the
+        # batch, so row indices below stay aligned with the prefill
+        # outputs
+        batch = [item for item in batch if self._validate(item)]
+        if not batch:
+            return
+        k = next(n for n in self._PREFILL_SIZES if n >= len(batch))
+        prompts = np.zeros((k, self.P), np.int32)
+        pads = np.zeros((k,), np.int32)
+        news = np.zeros((k,), np.int32)
+        for i, (prompt, pad, req, _ev, _sink) in enumerate(batch):
+            prompts[i] = prompt
+            pads[i] = pad
+            news[i] = req
+        slots = [self._free.pop() for _ in range(len(batch))]
+        # dummy rows (k > len(batch)) target REMAINING free slots: they
+        # hold no generation, and any future real install fully
+        # overwrites the row. Idle admission guarantees enough free
+        # slots (batch <= free == S >= k); active admission is always
+        # k == batch == 1.
+        dummies = self._free[:k - len(slots)]
+        pad_slots = slots + dummies
+        assert len(pad_slots) == k, (k, slots, dummies)
+        try:
+            with (ctx or contextlib.nullcontext()):
+                cache_k, logits_k = self._prefill(
+                    self._params, jnp.asarray(prompts), jnp.asarray(pads))
+                new_state = self._install(
+                    self.state, cache_k, logits_k,
+                    jnp.asarray(pad_slots, jnp.int32),
+                    jnp.asarray(pads), jnp.asarray(news))
+        except Exception as e:
+            self._free.extend(slots)
+            fail_all(e, batch)
+            return
+        self.state = new_state
+        # dummy installs left remaining>0 on their free slots: zero
+        # them so the step loop never decodes an unowned slot
+        if dummies:
+            self.state = self._clear_slots(
+                self.state, jnp.asarray(dummies, jnp.int32))
+        self._counters["admitted"] += len(batch)
+        self._counters["prefill_tokens_computed"] += len(batch) * self.P
+        self._counters["prompt_tokens_submitted"] += len(batch) * self.P
+        if self.meter:
+            self.meter.prefill_tokens(len(batch) * self.P)
+        for s_, (prompt, pad, req, ev, sink) in zip(slots, batch):
+            owners[s_] = (ev, sink, req)
+            last_rem[s_] = req
+
+    # -- admission: paged (per-request suffix prefill, page-gated) ---------
+
+    def _admit_paged(self, owners, fail_all, last_rem, last_pos) -> None:
+        import contextlib
+
+        import numpy as np
+
+        jnp = self._jnp
+        ctx = self.mesh if self.mesh is not None else None
+        want = 1 if owners else self.S
+        admitted = 0
+        while admitted < want and self._free:
+            item = self._next_pending()
+            if item is None:
+                return
+            if not self._validate(item):
+                continue
+            prompt, pad, req, ev, sink = item
+            row = [int(t) for t in prompt]
+            total = self.P + req + self.draft_k
+            if not self.alloc.can_admit(row, pad, total):
+                # head-of-line page gate: FIFO order is preserved (no
+                # bypass) — the request waits for completions to free
+                # pages, and everything behind it waits too
+                self._carry = item
+                return
+            slot = self._free.pop()
+            plan = self.alloc.admit(slot, row, pad, total)
+            suffix = np.asarray(row[plan.compute_start:], np.int32)
+            try:
+                with (ctx or contextlib.nullcontext()):
+                    if plan.copies:
+                        self.state = self._apply_copies(
+                            self.state, *self._cow_arrays(plan.copies))
+                    self.state = self._paged_prefill_install(
+                        self._params, self.state, suffix[None, :],
+                        jnp.asarray([plan.compute_start], jnp.int32),
+                        jnp.asarray(self.alloc.table[slot:slot + 1]),
+                        jnp.asarray([pad], jnp.int32),
+                        jnp.int32(slot), jnp.int32(req))
+            except Exception as e:
+                self._free.append(slot)
+                fail_all(e, [item])
+                return
+            owners[slot] = (ev, sink, req)
+            last_rem[slot] = req
+            last_pos[slot] = self.P
+            self._counters["admitted"] += 1
+            self._counters["prefill_tokens_computed"] += len(suffix)
+            self._counters["prompt_tokens_submitted"] += self.P
+            if self.meter:
+                self.meter.prefill_tokens(len(suffix))
+                self.meter.prefix_hits(plan.shared_pages)
+            self._publish_pages()
+            admitted += 1
+
+    # -- scheduler loop (speculative lockstep) -----------------------------
+
+    def _loop_spec(self) -> None:
+        import contextlib
+
+        import numpy as np
+
+        from kubeflow_tpu.runtime.speculative import (
+            greedy_accept, lockstep_propose, lockstep_verify)
+
+        jnp = self._jnp
+        k = self.draft_k
+        K1 = k + 1
+        owners: dict[int, tuple] = {}    # slot -> (ev, sink, req)
+        out_h: dict[int, list] = {}      # slot -> emitted tokens
+        ebuf: dict[int, list] = {}       # slot -> last round's emissions
+        pos_h = np.zeros(self.S, np.int64)   # position of each cur token
+        rem_h = np.zeros(self.S, np.int64)
+        pads_h = np.zeros(self.S, np.int32)
+        ctx = self.mesh if self.mesh is not None else None
+
+        def fail_all(err, batch=()):
+            for _p, _pad, _req, ev, sink in batch:
+                sink.append(err)
+                ev.set()
+            for s_, (ev, sink, _req) in list(owners.items()):
+                sink.append(err)
+                ev.set()
+            owners.clear()
+            out_h.clear()
+            ebuf.clear()
+            self._free = list(range(self.S))
+            if self.alloc is not None:
+                self.alloc.reset()
+            self.t_cache = self._fresh_cache()
+            self.d_cache = self._fresh_d_cache()
+
+        def complete(slot) -> None:
+            ev, sink, _req = owners.pop(slot)
+            sink.extend(out_h.pop(slot))
+            ebuf.pop(slot, None)
             ev.set()
-        while not self._pending.empty():
-            _p, _pad, ev, sink = self._pending.get_nowait()
-            sink.append(RuntimeError("decoder shut down"))
-            ev.set()
+            self._free.append(slot)
+            self._counters["completed"] += 1
+            if self.paged:
+                self.alloc.free(slot)
+            self._publish_pages()
+
+        def admit() -> None:
+            want = 1 if owners else self.S
+            admitted = 0
+            while admitted < want and self._free:
+                item = self._next_pending()
+                if item is None:
+                    return
+                if not self._validate(item):
+                    continue
+                prompt, pad, req, ev, sink = item
+                row = [int(t) for t in prompt]
+                total = self.P + req + k
+                if self.paged:
+                    if not self.alloc.can_admit(row, pad, total):
+                        self._carry = item
+                        return
+                slot = self._free.pop()
+                try:
+                    with (ctx or contextlib.nullcontext()):
+                        if self.paged:
+                            plan = self.alloc.admit(slot, row, pad, total)
+                            if plan.copies:
+                                from kubeflow_tpu.runtime.kvcache import \
+                                    copy_pages
+                                self.t_cache = copy_pages(
+                                    self.t_cache,
+                                    *self._cow_arrays(plan.copies))
+                            suffix = np.asarray(
+                                row[plan.compute_start:], np.int32)
+                            self.t_cache, self.d_cache, first = \
+                                self._spec_admit_paged(
+                                    self._params, self._d_params,
+                                    self.t_cache, self.d_cache,
+                                    suffix[None, :],
+                                    jnp.asarray([plan.compute_start],
+                                                jnp.int32),
+                                    jnp.asarray(
+                                        self.alloc.table[slot:slot + 1]),
+                                    jnp.asarray([row], jnp.int32),
+                                    jnp.asarray([pad], jnp.int32),
+                                    jnp.int32(slot))
+                            n_pref = len(suffix)
+                            hits = plan.shared_pages
+                        else:
+                            self.t_cache, self.d_cache, first = \
+                                self._spec_admit_dense(
+                                    self._params, self._d_params,
+                                    self.t_cache, self.d_cache,
+                                    jnp.asarray([row], jnp.int32),
+                                    jnp.asarray([pad], jnp.int32),
+                                    jnp.int32(slot))
+                            n_pref = self.P
+                            hits = 0
+                except Exception as e:
+                    self._free.append(slot)
+                    fail_all(e, [item])
+                    return
+                cur = int(first)
+                owners[slot] = (ev, sink, req)
+                out_h[slot] = [cur]
+                ebuf[slot] = [cur]
+                pos_h[slot] = self.P
+                rem_h[slot] = req - 1
+                pads_h[slot] = pad
+                self._counters["admitted"] += 1
+                self._counters["prefill_tokens_computed"] += n_pref
+                self._counters["prompt_tokens_submitted"] += self.P
+                if self.meter:
+                    self.meter.prefill_tokens(n_pref)
+                    if self.paged:
+                        self.meter.prefix_hits(hits)
+                self._publish_pages()
+                if rem_h[slot] <= 0:
+                    # the prefill logits already satisfied a 1-token
+                    # budget
+                    complete(slot)
+                else:
+                    admitted += 1
+
+        while not self._stop:
+            try:
+                admit()
+                self._note_active(owners)
+                if not owners:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+                    continue
+                # ---- one propose/verify round over every active slot
+                order = sorted(owners)
+                emitted = np.zeros((self.S, K1), np.int32)
+                starts = np.zeros(self.S, np.int32)
+                elen = np.ones(self.S, np.int32)
+                curv = np.zeros(self.S, np.int32)
+                for s_ in order:
+                    e = ebuf[s_]
+                    emitted[s_, :len(e)] = e
+                    starts[s_] = pos_h[s_] - len(e) + 1
+                    elen[s_] = len(e)
+                    curv[s_] = e[-1]
+                    if self.paged:
+                        # verify rewrites positions pos..pos+k
+                        self.alloc.append(s_, int(pos_h[s_]) + K1)
+                        copies = self.alloc.write_barrier(
+                            s_, int(pos_h[s_]), int(pos_h[s_]) + K1)
+                        if copies:
+                            from kubeflow_tpu.runtime.kvcache import \
+                                copy_pages
+                            self.t_cache = copy_pages(
+                                self.t_cache, *self._cow_arrays(copies))
+                pads_dev = jnp.asarray(pads_h)
+                with (ctx or contextlib.nullcontext()):
+                    self.d_cache, props = lockstep_propose(
+                        self.draft, self._d_params, self.d_cache,
+                        jnp.asarray(emitted), jnp.asarray(starts),
+                        jnp.asarray(elen), k=k, pad_len=pads_dev)
+                    props_h = np.asarray(props)
+                    chunk = np.zeros((self.S, K1), np.int32)
+                    chunk[:, 0] = curv
+                    chunk[:, 1:] = props_h
+                    self.t_cache, y = lockstep_verify(
+                        self.model, self._params, self.t_cache,
+                        jnp.asarray(chunk),
+                        jnp.asarray(pos_h, np.int32), pad_len=pads_dev,
+                        **({"page_table": jnp.asarray(self.alloc.table)}
+                           if self.paged else {}))
+                y_h = np.asarray(y)
+                round_slots = 0
+                round_accepted = 0
+                for s_ in order:
+                    a = greedy_accept(props_h[s_], y_h[s_], k)
+                    emit = [int(t) for t in props_h[s_][:a]]
+                    emit.append(int(y_h[s_][a]))
+                    take = min(len(emit), int(rem_h[s_]))
+                    emit = emit[:take]
+                    out_h[s_].extend(emit)
+                    ebuf[s_] = emit
+                    pos_h[s_] += take
+                    rem_h[s_] -= take
+                    round_slots += 1
+                    round_accepted += min(a, take)
+                    self._counters["spec_rounds"] += 1
+                    self._counters["spec_tokens_emitted"] += take
+                    self._counters["spec_tokens_accepted"] += min(a, take)
+                    self._counters["spec_drafted"] += k
+                    if rem_h[s_] <= 0:
+                        complete(s_)
+                if self.meter:
+                    self.meter.spec_round(round_slots, round_accepted)
+                self._note_active(owners)
+            except Exception as e:
+                log.exception("speculative slot-decoder loop failed")
+                fail_all(e)
+                self._active = 0
+        self._drain_shutdown(owners)
 
 
 def _set1(jnp, vec, i, val):

@@ -1,4 +1,4 @@
-"""Weight-only int8 quantization for serving.
+"""Weight-only int8/int4 quantization for serving.
 
 KV-cache decode is HBM-bandwidth-bound on WEIGHT reads (the batch is
 small; every step streams the full parameter set). Serving already
@@ -6,16 +6,21 @@ halves that traffic with the bf16 cast (server.cast_params); int8
 halves it AGAIN: each >=2-D kernel is stored as int8 with a per-output-
 channel f32 scale, and the dequantize (one multiply) happens inside the
 jitted decode step where XLA fuses it into the consumer matmul — HBM
-holds and streams int8, the MXU still sees bf16 operands.
+holds and streams int8, the MXU still sees bf16 operands. int4 halves
+it a THIRD time: two nibbles packed per int8 byte (ops/quantize.py
+pack_int4), unpacked by shift/mask inside the same jit, at the cost of
+an 18x looser per-element error bound (amax/14 vs amax/254 — the
+round-trip test pins both bounds side by side).
 
-Symmetric per-channel quantization (scale = amax/127 over all axes but
+Symmetric per-channel quantization (scale = amax/N over all axes but
 the last) is the standard quality-safe weight-only recipe: activations
 stay bf16, so there is no calibration step and the error per channel is
-bounded by half an int8 ulp of that channel's largest weight.
+bounded by half a ulp of that channel's largest weight.
 
-Usage (serving/server.py wires this behind param_dtype="int8"):
+Usage (serving/server.py wires this behind param_dtype="int8"/"int4"):
 
-    qvars = quantize_params(variables)
+    qvars = quantize_params(variables)              # int8
+    qvars = quantize_params(variables, bits=4)      # packed int4
     qmodel = QuantizedModel(model)
     generate(qmodel, qvars, ...)   # dequant inside the jit
 
@@ -34,23 +39,32 @@ import jax.numpy as jnp
 # Marker keys of a quantized leaf. A dict so the pytree structure stays
 # transparent to jax (checkpoint/save, device_put, jit all just work).
 _QKEYS = frozenset({"int8", "scale"})
+_QKEYS4 = frozenset({"int4", "scale"})
 
 
 def _is_qleaf(node: Any) -> bool:
-    return isinstance(node, dict) and set(node) == _QKEYS
+    return isinstance(node, dict) and set(node) in (_QKEYS, _QKEYS4)
 
 
-def quantize_params(variables: Any, min_size: int = 4096) -> Any:
-    """int8-quantize every floating leaf with ndim >= 2 and at least
+def quantize_params(variables: Any, min_size: int = 4096,
+                    bits: int = 8) -> Any:
+    """Quantize every floating leaf with ndim >= 2 and at least
     ``min_size`` elements (norm scales / biases stay exact — they are a
     rounding error of total bytes but matter for quality).
 
     Matmul kernels scale per-output-channel (amax over all axes but the
     last). Embedding-like tables scale per-ROW instead: their rows are
     looked up independently, and a trailing-axis-shared scale would
-    quantize every rare token's row against the largest row's amax."""
+    quantize every rare token's row against the largest row's amax.
 
-    from kubeflow_tpu.ops.quantize import symmetric_int8
+    ``bits=4`` packs two values per byte along the last axis; a leaf
+    with an odd last axis falls back to int8 (packing needs pairs)."""
+
+    from kubeflow_tpu.ops.quantize import (
+        pack_int4, symmetric_int4, symmetric_int8)
+
+    if bits not in (4, 8):
+        raise ValueError(f"quantize_params bits must be 4 or 8, got {bits}")
 
     def leaf(path, x):
         if not (hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)
@@ -61,6 +75,9 @@ def quantize_params(variables: Any, min_size: int = 4096) -> Any:
             axes = tuple(range(1, x.ndim))       # per-row (vocab entry)
         else:
             axes = tuple(range(x.ndim - 1))      # per-output-channel
+        if bits == 4 and x.shape[-1] % 2 == 0:
+            q, scale = symmetric_int4(x, axes)
+            return {"int4": pack_int4(q), "scale": scale}
         q, scale = symmetric_int8(x, axes)
         return {"int8": q, "scale": scale}
 
@@ -68,15 +85,20 @@ def quantize_params(variables: Any, min_size: int = 4096) -> Any:
 
 
 def dequantize_params(variables: Any, dtype=jnp.bfloat16) -> Any:
-    """Inverse of quantize_params: int8 * scale in f32, cast to
+    """Inverse of quantize_params: (unpack +) q * scale in f32, cast to
     ``dtype``. Called INSIDE jit so the bf16 tensors are fusion fodder,
     not HBM residents."""
 
+    from kubeflow_tpu.ops.quantize import unpack_int4
+
     def leaf(node):
-        if _is_qleaf(node):
-            return (node["int8"].astype(jnp.float32)
-                    * node["scale"]).astype(dtype)
-        return node
+        if not _is_qleaf(node):
+            return node
+        if "int4" in node:
+            q = unpack_int4(node["int4"])
+        else:
+            q = node["int8"]
+        return (q.astype(jnp.float32) * node["scale"]).astype(dtype)
 
     return jax.tree.map(leaf, variables, is_leaf=_is_qleaf)
 

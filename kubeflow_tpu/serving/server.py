@@ -371,6 +371,9 @@ def _unstack(out: Any, n: int) -> list:
     if isinstance(out, dict):
         arrs = {k: np.asarray(v)[:n] for k, v in out.items()}
         return [{k: arrs[k][i].tolist() for k in arrs} for i in range(n)]
+    if isinstance(out, list):
+        # ragged rows (per-request max_new_tokens budgets differ)
+        return [list(r) for r in out[:n]]
     return np.asarray(out)[:n].tolist()
 
 
@@ -628,12 +631,14 @@ def serve_flax_classifier(name: str, model_name: str, input_key: str | None = No
 
 
 def _prepare_serving_params(variables, param_dtype):
-    """Serving-time weight preparation: 'int8' quantizes (weight-only,
-    serving/quant.py), any other dtype casts, None passes through."""
-    if param_dtype == "int8":
+    """Serving-time weight preparation: 'int8'/'int4' quantize
+    (weight-only, serving/quant.py), any other dtype casts, None
+    passes through."""
+    if param_dtype in ("int8", "int4"):
         from kubeflow_tpu.serving.quant import quantize_params
 
-        return quantize_params(variables)
+        return quantize_params(variables,
+                               bits=4 if param_dtype == "int4" else 8)
     return cast_params(variables, param_dtype) if param_dtype else variables
 
 
@@ -669,6 +674,8 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        mesh: "Any | None" = None,
                        continuous_batching: bool = False,
                        decode_slots: int = 8,
+                       kv_pages: int = 0, kv_page_size: int = 0,
+                       prefix_cache: bool = True,
                        param_dtype: str | None = None,
                        draft_model: str | None = None,
                        draft_checkpoint_dir: str | None = None,
@@ -692,12 +699,20 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
 
     # speculative decoding needs k positions of verify-chunk headroom
     seq_budget = prompt_len + max_new_tokens + (draft_k if draft_model else 0)
+    if kv_pages and not continuous_batching:
+        raise ValueError("kv_pages (the paged KV cache) requires "
+                         "continuous_batching — the page pool is shared "
+                         "across decode slots")
+    if kv_pages and mesh is not None:
+        raise ValueError("the paged KV cache is single-chip for now "
+                         "(no mesh)")
+    if kv_pages and not kv_page_size:
+        raise ValueError("kv_pages requires kv_page_size > 0")
+    if kv_pages:
+        model_kwargs = dict(model_kwargs,
+                            kv_pages=kv_pages, kv_page_size=kv_page_size)
     model = get_model(model_name, max_seq_len=seq_budget, **model_kwargs)
     if draft_model:
-        if continuous_batching:
-            raise ValueError("speculative decoding (draft_model) and "
-                             "continuous batching are mutually exclusive; "
-                             "pick the one that fits the load")
         if temperature > 0:
             raise ValueError("speculative decoding is greedy-only "
                              "(temperature must be 0)")
@@ -711,13 +726,18 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             raise ValueError("speculative decoding requires the full KV "
                              "cache (rolling_kv_cache evicts positions a "
                              "rejected draft must rewind over)")
-    quantized = param_dtype == "int8"
+    if kv_pages and getattr(model.cfg, "rolling_kv_cache", False):
+        raise ValueError("the paged KV cache is exclusive with "
+                         "rolling_kv_cache")
+    quantized = param_dtype in ("int8", "int4")
     if quantized and mesh is not None:
-        raise ValueError("param_dtype='int8' serving is single-chip for "
-                         "now (mesh-sharded weights stay bf16)")
+        raise ValueError(f"param_dtype={param_dtype!r} serving is "
+                         "single-chip for now (mesh-sharded weights "
+                         "stay bf16)")
     if quantized:
-        # weight-only int8 (serving/quant.py): HBM streams int8, the
-        # dequant fuses into the decode matmuls inside jit
+        # weight-only int8/int4 (serving/quant.py): HBM streams the
+        # narrow ints, the (unpack+)dequant fuses into the decode
+        # matmuls inside jit
         from kubeflow_tpu.serving.quant import QuantizedModel
 
         model = QuantizedModel(model)
@@ -793,10 +813,45 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
             rows.append([0] * (prompt_len - len(row)) + row)
         return rows, pad_lens
 
+    def _validated_max_news(batch, n):
+        """Optional per-instance "max_new_tokens" cap (every instance
+        must carry the key or none): a paged decoder reserves pages for
+        the REQUEST's budget, not the server-wide ceiling."""
+        caps = (batch.get("max_new_tokens")
+                if isinstance(batch, dict) else None)
+        if caps is None:
+            return [None] * n
+        flat = np.asarray(caps, dtype=object).reshape(-1)
+        if len(flat) != n:
+            # a short list would silently zip-truncate the batch,
+            # dropping requests and misaligning instance -> prediction
+            raise ApiHttpError(
+                400, f"max_new_tokens must be one value per instance "
+                     f"(got {len(flat)} for {n} instances)")
+        out = []
+        for c in flat:
+            c = int(c)
+            if not 1 <= c <= max_new_tokens:
+                raise ApiHttpError(
+                    400, f"max_new_tokens must be in 1..{max_new_tokens}, "
+                         f"got {c}")
+            out.append(c)
+        return out
+
+    def _capped_rows(out_rows, maxnews):
+        """Apply per-instance budgets to whole-batch decode output:
+        every path honors the documented cap, not just the slot
+        decoder (ragged results when budgets differ)."""
+        if all(c is None for c in maxnews):
+            return out_rows
+        return [list(np.asarray(row)[:c if c is not None else len(row)])
+                for row, c in zip(out_rows, maxnews)]
+
     def predict(batch):
         nonlocal variables
         toks = batch["tokens"] if isinstance(batch, dict) else batch
         rows, pad_lens = _validated_rows(toks)
+        maxnews = _validated_max_news(batch, len(rows))
         if continuous_batching:
             # slot-based lockstep decode: rows join the shared decoder at
             # step boundaries and finish independently — a long
@@ -811,20 +866,32 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                     else:
                         use_vars = variables or _materialize(
                             jnp.zeros((1, 1), jnp.int32))
+                    dm = dv = None
+                    if draft_model:
+                        dm, dv = _draft()
                     decoder_box.append(SlotDecoder(
                         model, use_vars, slots=decode_slots,
                         prompt_len=prompt_len,
                         max_new_tokens=max_new_tokens,
                         temperature=temperature, top_k=top_k, seed=seed,
-                        mesh=sm.mesh if sm is not None else None))
+                        mesh=sm.mesh if sm is not None else None,
+                        prefix_cache=prefix_cache,
+                        draft_model=dm, draft_variables=dv,
+                        draft_k=draft_k, metrics_name=name))
             dec = decoder_box[0]
             if len(rows) == 1:  # hot path: no thread churn per request
-                outs = [dec.submit_padded(rows[0], pad_lens[0])]
+                outs = [dec.submit_padded(rows[0], pad_lens[0],
+                                          maxnews[0])]
             else:
                 import concurrent.futures as cf
 
                 with cf.ThreadPoolExecutor(max_workers=len(rows)) as pool:
-                    outs = list(pool.map(dec.submit_padded, rows, pad_lens))
+                    outs = list(pool.map(dec.submit_padded, rows,
+                                         pad_lens, maxnews))
+            # per-request budgets produce ragged rows; pad the response
+            # rows only when a caller actually mixed budgets
+            if len({len(o) for o in outs}) > 1:
+                return [list(o) for o in outs]
             return np.asarray(outs, dtype=np.int64)
         prompt = jnp.asarray(rows, jnp.int32)
         if sm is not None:
@@ -849,14 +916,14 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                 drafted_c.labels(model=name).inc(stats["drafted"])
                 accepted_c.labels(model=name).inc(stats["accepted"])
                 outs.append(np.asarray(toks)[0])
-            return np.stack(outs)[:, prompt_len:]
+            return _capped_rows(np.stack(outs)[:, prompt_len:], maxnews)
         with (sm.mesh if sm is not None else contextlib.nullcontext()):
             out = np.asarray(generate(
                 model, use_vars, prompt, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k,
                 seed=request_seed() if temperature > 0 else seed,
                 pad_len=jnp.asarray(pad_lens, jnp.int32)))
-        return out[:, prompt_len:]  # new tokens only
+        return _capped_rows(out[:, prompt_len:], maxnews)  # new tokens only
 
     served = ServedModel(
         name=name, predict_fn=predict,
@@ -872,6 +939,10 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                    **({"continuous_batching": True,
                        "decode_slots": decode_slots}
                       if continuous_batching else {}),
+                   **({"kv_pages": kv_pages,
+                       "kv_page_size": kv_page_size,
+                       "prefix_cache": prefix_cache}
+                      if kv_pages else {}),
                    **({"param_dtype": param_dtype} if param_dtype else {}),
                    **({"draft_model": draft_model, "draft_k": draft_k}
                       if draft_model else {}),
@@ -905,10 +976,12 @@ def main() -> None:  # pragma: no cover - container entry
     p.add_argument("--prompt-len", type=int, default=128)
     p.add_argument("--max-new-tokens", type=int, default=32)
     p.add_argument("--param-dtype", default=None,
-                   choices=["bfloat16", "float32", "int8"],
+                   choices=["bfloat16", "float32", "int8", "int4"],
                    help="cast served LM parameters (bfloat16 halves the "
                         "weight HBM reads that dominate decode; int8 is "
-                        "weight-only quantization, halving them again)")
+                        "weight-only quantization, halving them again; "
+                        "int4 packs two nibbles per byte for one more "
+                        "halving at a looser error bound)")
     p.add_argument("--attention-window", type=int, default=0,
                    help="sliding-window attention width for served LMs "
                         "(0 = full causal)")
@@ -935,6 +1008,17 @@ def main() -> None:  # pragma: no cover - container entry
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
     p.add_argument("--decode-slots", type=int, default=8)
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="paged KV cache: total pool pages shared across "
+                        "decode slots (page 0 is trash); admission is "
+                        "gated on page availability and shared prompt "
+                        "prefixes reuse pages. Requires "
+                        "--continuous-batching and --kv-page-size")
+    p.add_argument("--kv-page-size", type=int, default=0,
+                   help="positions per KV-cache page")
+    p.add_argument("--no-prefix-cache", action="store_true",
+                   help="disable prompt-prefix page sharing (A/B lever; "
+                        "pages are still pooled)")
     p.add_argument("--mesh", default=None,
                    help="shard served params over a mesh, e.g. "
                         "'model=4,fsdp=2' — required for models whose "
@@ -967,6 +1051,8 @@ def main() -> None:  # pragma: no cover - container entry
             max_new_tokens=args.max_new_tokens, mesh=mesh_spec,
             continuous_batching=args.continuous_batching,
             decode_slots=args.decode_slots,
+            kv_pages=args.kv_pages, kv_page_size=args.kv_page_size,
+            prefix_cache=not args.no_prefix_cache,
             param_dtype=args.param_dtype,
             checkpoint_dir=ckpt or None,
             draft_model=args.draft_model, draft_k=args.draft_k,

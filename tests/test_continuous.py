@@ -387,3 +387,53 @@ class TestFailureContainment:
         with pytest.raises(ValueError, match="max_seq_len"):
             generate(model, variables, jnp.ones((1, 12), jnp.int32),
                      max_new_tokens=8)
+
+
+class TestPerRequestBudgets:
+    """Per-instance max_new_tokens caps (ISSUE 9): honored on EVERY
+    decode path, not just the slot decoder, and validated hard."""
+
+    def test_continuous_budget_is_ragged_and_exact(self, lm):
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        model, variables = lm
+        served = serve_lm_generator(
+            "cb-budget", "transformer-test", prompt_len=8,
+            max_new_tokens=4, vocab_size=64,
+            continuous_batching=True, decode_slots=2)
+        try:
+            full = reference_generate(model, variables, [1, 2, 3])
+            out = served.predict([
+                {"tokens": [1, 2, 3], "max_new_tokens": 2},
+                {"tokens": [1, 2, 3], "max_new_tokens": 4}])
+            assert out[0] == full[:2] and out[1] == full
+        finally:
+            served.close()
+
+    def test_plain_generate_budget_applies_too(self):
+        from kubeflow_tpu.serving.server import serve_lm_generator
+
+        served = serve_lm_generator(
+            "plain-budget", "transformer-test", prompt_len=8,
+            max_new_tokens=4, vocab_size=64)
+        try:
+            full = served.predict([{"tokens": [1, 2, 3]}])[0]
+            capped = served.predict(
+                [{"tokens": [1, 2, 3], "max_new_tokens": 2}])[0]
+            assert capped == full[:2]
+        finally:
+            served.close()
+
+    def test_out_of_range_budget_is_400(self):
+        from kubeflow_tpu.serving.server import serve_lm_generator
+        from kubeflow_tpu.utils.httpd import ApiHttpError
+
+        served = serve_lm_generator(
+            "bad-budget", "transformer-test", prompt_len=8,
+            max_new_tokens=4, vocab_size=64)
+        try:
+            with pytest.raises(ApiHttpError):
+                served.predict(
+                    [{"tokens": [1, 2, 3], "max_new_tokens": 9}])
+        finally:
+            served.close()

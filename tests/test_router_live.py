@@ -1,0 +1,189 @@
+"""Real-server router load test (ROADMAP #2 leftover): two ACTUAL
+model-server replicas — real HTTP, real continuous-batching decode with
+the paged KV cache — behind the real RouterFrontend, driven by
+concurrent predict requests. The serve_bench --router arms use stub
+fixed-rate replicas; this is the one test where every hop is live."""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+pytestmark = [pytest.mark.slow, pytest.mark.serving]
+
+
+class _CountingTransport:
+    """HttpTransport wrapper: per-replica dispatch counter so the test
+    can assert the router actually spread load across both replicas."""
+
+    def __init__(self, inner, counts, name):
+        self.inner = inner
+        self.counts = counts
+        self.name = name
+
+    def predict(self, model, body, headers=None):
+        self.counts[self.name] = self.counts.get(self.name, 0) + 1
+        return self.inner.predict(model, body, headers)
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+
+    from kubeflow_tpu.models.registry import get_model
+
+    model = get_model("transformer-test", vocab_size=64, max_seq_len=16)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 1), np.int32), train=False)
+    return model, variables
+
+
+def reference_generate(model, variables, tokens, prompt_len=8, max_new=4):
+    import jax.numpy as jnp
+
+    from kubeflow_tpu.runtime.generate import generate
+
+    row = [int(t) for t in tokens][-prompt_len:]
+    pad = prompt_len - len(row)
+    prompt = jnp.asarray([[0] * pad + row], jnp.int32)
+    out = generate(model, variables, prompt, max_new_tokens=max_new,
+                   pad_len=jnp.asarray([pad], jnp.int32))
+    return [int(t) for t in np.asarray(out)[0, prompt_len:]]
+
+
+def _boot_replica(name: str):
+    del name  # the decoder meters under its served-model name
+    from kubeflow_tpu.serving.server import ModelServer, serve_lm_generator
+
+    srv = ModelServer()
+    srv.register(serve_lm_generator(
+        "lm", "transformer-test", prompt_len=8, max_new_tokens=4,
+        vocab_size=64, continuous_batching=True, decode_slots=4,
+        kv_pages=33, kv_page_size=4))
+    svc = srv.serve(host="127.0.0.1", port=0)
+    svc.serve_background()
+    return srv, svc
+
+
+def test_two_real_replicas_behind_router_under_concurrent_load(lm):
+    import requests
+
+    from kubeflow_tpu.serving.router import (
+        STATE_ACTIVE, HttpTransport, RouterFrontend, TokenRouter)
+
+    model, variables = lm
+    srv_a, svc_a = _boot_replica("replica-a")
+    srv_b, svc_b = _boot_replica("replica-b")
+    counts: dict = {}
+    router = TokenRouter(service="live", namespace="default",
+                         max_queue=256, replica_token_budget=64)
+    try:
+        eps = [{"name": "replica-a",
+                "addr": f"http://127.0.0.1:{svc_a.port}",
+                "state": STATE_ACTIVE},
+               {"name": "replica-b",
+                "addr": f"http://127.0.0.1:{svc_b.port}",
+                "state": STATE_ACTIVE}]
+        router.sync_endpoints(
+            eps, transport_factory=lambda ep: _CountingTransport(
+                HttpTransport(ep["addr"]), counts, ep["name"]))
+        frontend = RouterFrontend(router, max_new_tokens=4)
+        fsvc = frontend.serve(host="127.0.0.1", port=0)
+        fsvc.serve_background()
+        try:
+            base = f"http://127.0.0.1:{fsvc.port}"
+            prompts = [[i % 5 + 1, i % 7 + 1, i % 3 + 1]
+                       for i in range(16)]
+            want = [reference_generate(model, variables, p)
+                    for p in prompts]
+            results: list = [None] * len(prompts)
+            errs: list = []
+
+            def one(i):
+                try:
+                    r = requests.post(
+                        f"{base}/v1/models/lm:predict",
+                        json={"instances": [{"tokens": prompts[i]}]},
+                        timeout=300)
+                    assert r.status_code == 200, r.text
+                    results[i] = r.json()["predictions"][0]
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+
+            threads = [threading.Thread(target=one, args=(i,))
+                       for i in range(len(prompts))]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert not errs, errs
+            assert results == want        # every hop decode-exact
+            # the token budget (64 < 16 requests x 12 estimated
+            # tokens) forces real spreading: both replicas served
+            assert counts.get("replica-a", 0) > 0, counts
+            assert counts.get("replica-b", 0) > 0, counts
+            assert sum(counts.values()) == len(prompts)
+            # both replicas' paged decode paths really ran: the
+            # replica-side /metrics carry the page-pool gauges
+            for svc in (svc_a, svc_b):
+                m = requests.get(
+                    f"http://127.0.0.1:{svc.port}/metrics",
+                    timeout=30).text
+                assert "serving_kv_pages_used" in m
+                assert "serving_prefill_tokens_total" in m
+        finally:
+            fsvc.shutdown()
+    finally:
+        router.close()
+        for srv, svc in ((srv_a, svc_a), (srv_b, svc_b)):
+            svc.shutdown()
+            srv.close()
+
+
+def test_router_returns_429_when_saturated_by_real_replicas(lm):
+    """Zero-capacity admission against live replicas: max_queue=0 and a
+    tiny budget turn the 17th concurrent request into an HTTP 429, not
+    a hang."""
+    import requests
+
+    from kubeflow_tpu.serving.router import (
+        STATE_ACTIVE, HttpTransport, RouterFrontend, TokenRouter)
+
+    srv_a, svc_a = _boot_replica("busy-a")
+    router = TokenRouter(service="busy", namespace="default",
+                         max_queue=0, replica_token_budget=4)
+    try:
+        router.sync_endpoints(
+            [{"name": "busy-a",
+              "addr": f"http://127.0.0.1:{svc_a.port}",
+              "state": STATE_ACTIVE}],
+            transport_factory=lambda ep: HttpTransport(ep["addr"]))
+        frontend = RouterFrontend(router, max_new_tokens=4)
+        fsvc = frontend.serve(host="127.0.0.1", port=0)
+        fsvc.serve_background()
+        try:
+            base = f"http://127.0.0.1:{fsvc.port}"
+            body = {"instances": [{"tokens": [1, 2, 3]} for _ in range(4)]}
+            codes = []
+            lock = threading.Lock()
+
+            def one():
+                r = requests.post(f"{base}/v1/models/lm:predict",
+                                  json=body, timeout=300)
+                with lock:
+                    codes.append(r.status_code)
+
+            threads = [threading.Thread(target=one) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            assert 200 in codes          # the admitted ones complete
+            assert 429 in codes, codes   # the overflow sheds cleanly
+        finally:
+            fsvc.shutdown()
+    finally:
+        router.close()
+        svc_a.shutdown()
+        srv_a.close()

@@ -98,14 +98,23 @@ def test_served_speculative_matches_plain_served_generate():
 def test_served_speculative_rejects_bad_combos():
     from kubeflow_tpu.serving.server import serve_lm_generator
 
-    with pytest.raises(ValueError, match="mutually exclusive"):
-        serve_lm_generator("x", "transformer-test",
-                           draft_model="transformer-test",
-                           continuous_batching=True)
+    # draft + continuous batching is now the LOCKSTEP speculative path
+    # (ISSUE 9) — valid; the remaining hard exclusions still refuse at
+    # registration
     with pytest.raises(ValueError, match="greedy-only"):
         serve_lm_generator("y", "transformer-test",
                            draft_model="transformer-test",
                            temperature=0.7)
+    with pytest.raises(ValueError, match="continuous_batching"):
+        serve_lm_generator("z", "transformer-test",
+                           kv_pages=16, kv_page_size=4)
+    with pytest.raises(ValueError, match="kv_page_size"):
+        serve_lm_generator("z2", "transformer-test",
+                           continuous_batching=True, kv_pages=16)
+    with pytest.raises(ValueError, match="single-chip"):
+        serve_lm_generator("z3", "transformer-test",
+                           continuous_batching=True, kv_pages=16,
+                           kv_page_size=4, mesh={"data": 2})
 
 
 def test_served_speculative_exports_acceptance_metrics():

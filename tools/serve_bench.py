@@ -34,6 +34,16 @@ Two families share this tool:
 
      python tools/serve_bench.py --router          # run + bank
      python tools/serve_bench.py --check           # CI gate
+
+3. **The per-replica decode path** (``--decode``, ISSUE 9): a
+   deterministic counter benchmark of the paged KV cache, prefix
+   reuse, and speculative lockstep decode on the tiny test
+   transformer — dense-vs-paged concurrency at the same cache bytes,
+   prefill tokens saved by the prefix cache, tokens per target
+   forward under speculation, all token-identical across arms. Banked
+   as BENCH_SERVE_r02.json; ``--check`` gates BOTH banks.
+
+     python tools/serve_bench.py --decode          # run + bank r02
 """
 
 from __future__ import annotations
@@ -52,6 +62,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 ROUTER_OUT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_SERVE_r01.json")
+DECODE_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_SERVE_r02.json")
 
 
 def run_mode(mode: str, args) -> dict:
@@ -62,6 +74,8 @@ def run_mode(mode: str, args) -> dict:
         max_new_tokens=args.max_new_tokens,
         continuous_batching=(mode == "continuous"),
         decode_slots=args.slots,
+        **({"kv_pages": args.kv_pages, "kv_page_size": args.kv_page_size}
+           if args.kv_pages and mode == "continuous" else {}),
         batch_window_ms=(args.window_ms if mode == "micro" else 0.0),
         param_dtype=args.param_dtype or None,
         mesh=args.mesh or None,
@@ -138,6 +152,263 @@ def run_mode(mode: str, args) -> dict:
         }
     finally:
         served.close()
+
+
+# ---------------------------------------------------------------------------
+# The deterministic per-replica decode benchmark (--decode / --check,
+# ISSUE 9): dense-vs-paged KV cache density, prefix-cache prefill
+# savings, greedy-vs-speculative tokens per target forward — all on the
+# tiny test transformer with seeded prompts, so every claim is a
+# COUNTER (array shapes, allocator stats, prefill/accept totals) that
+# replays identically per seed. CPU wall seconds are banked alongside
+# for context but never gated (the TPU backend is unavailable in this
+# image; ROADMAP bench policy).
+
+
+DECODE_CONFIG = {
+    "seed": 0,
+    "model": "transformer-test",
+    "vocab_size": 64,
+    "prompt_len": 32,          # 4 full pages of prompt
+    "max_new_tokens": 16,      # server-wide ceiling
+    "req_new": 8,              # per-request budget (density arms)
+    "page_size": 8,
+    "dense_slots": 4,
+    "paged_slots": 8,
+    "requests": 8,
+    "shared_prefix": 24,       # 3 pages shared across all 8 prompts
+    "draft_k": 4,
+    "spec_requests": 4,
+}
+
+
+def _decode_prompts(cfg: dict, rng: random.Random) -> list[list[int]]:
+    """Full-length (no padding) prompts sharing a page-aligned system
+    prefix — the workload the prefix cache exists for."""
+    pre = [rng.randrange(1, cfg["vocab_size"])
+           for _ in range(cfg["shared_prefix"])]
+    tail = cfg["prompt_len"] - cfg["shared_prefix"]
+    return [pre + [rng.randrange(1, cfg["vocab_size"]) for _ in range(tail)]
+            for _ in range(cfg["requests"])]
+
+
+def _drive_burst(dec, prompts, max_new) -> tuple[list, float]:
+    """Queue every request while admission is held, then release: the
+    decoder sees one deterministic FIFO burst (admission order == list
+    order), which pins prefix-hit and peak-concurrency counters."""
+    results: list = [None] * len(prompts)
+    held, dec._free = dec._free, []
+    threads = [threading.Thread(
+        target=lambda i=i: results.__setitem__(
+            i, dec.submit(prompts[i], max_new)))
+        for i in range(len(prompts))]
+    t0 = time.perf_counter()
+    for th in threads:
+        th.start()
+    time.sleep(0.4)  # queue fully populated while no slot is "free"
+    dec._free = held
+    dec._wake.set()
+    for th in threads:
+        th.join()
+    return results, time.perf_counter() - t0
+
+
+def _arm_stats(dec, wall: float) -> dict:
+    keep = ("admitted", "completed", "peak_active",
+            "prefill_tokens_computed", "prompt_tokens_submitted",
+            "cache_bytes", "spec_rounds", "spec_tokens_emitted",
+            "spec_tokens_accepted", "spec_drafted", "kv_pages_total",
+            "kv_page_size", "prefix_hit_pages", "prefix_hit_tokens",
+            "cow_clones", "mode")
+    st = dec.stats()
+    out = {k: st[k] for k in keep if k in st}
+    out["wall_s"] = round(wall, 2)
+    return out
+
+
+def run_decode_bench(cfg: dict) -> dict:
+    import hashlib
+
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized (tests force cpu themselves)
+    import numpy as np
+
+    from kubeflow_tpu.models.registry import get_model
+    from kubeflow_tpu.serving.continuous import SlotDecoder
+
+    P, N, PS = cfg["prompt_len"], cfg["max_new_tokens"], cfg["page_size"]
+    dense_seq = P + N
+    # SAME cache-byte budget by construction: pool positions (pages x
+    # page_size, trash page included) == dense positions (slots x P+N)
+    kv_pages = cfg["dense_slots"] * dense_seq // PS
+    rng = random.Random(cfg["seed"])
+    prompts = _decode_prompts(cfg, rng)
+
+    dense_m = get_model(cfg["model"], vocab_size=cfg["vocab_size"],
+                        max_seq_len=dense_seq)
+    variables = dense_m.init(jax.random.PRNGKey(cfg["seed"]),
+                             np.zeros((1, 1), np.int32), train=False)
+
+    # -- density: dense S_d slots vs paged pool at the same bytes ------
+    dd = SlotDecoder(dense_m, variables, slots=cfg["dense_slots"],
+                     prompt_len=P, max_new_tokens=N)
+    try:
+        dense_out, dense_wall = _drive_burst(dd, prompts, cfg["req_new"])
+        dense = _arm_stats(dd, dense_wall)
+    finally:
+        dd.close()
+    paged_m = get_model(cfg["model"], vocab_size=cfg["vocab_size"],
+                        max_seq_len=dense_seq, kv_pages=kv_pages,
+                        kv_page_size=PS)
+    pd = SlotDecoder(paged_m, variables, slots=cfg["paged_slots"],
+                     prompt_len=P, max_new_tokens=N)
+    try:
+        paged_out, paged_wall = _drive_burst(pd, prompts, cfg["req_new"])
+        paged = _arm_stats(pd, paged_wall)
+    finally:
+        pd.close()
+
+    # -- prefix reuse: the same paged pool with the cache disabled -----
+    po = SlotDecoder(paged_m, variables, slots=cfg["paged_slots"],
+                     prompt_len=P, max_new_tokens=N, prefix_cache=False)
+    try:
+        off_out, off_wall = _drive_burst(po, prompts, cfg["req_new"])
+        off = _arm_stats(po, off_wall)
+    finally:
+        po.close()
+
+    # -- speculative lockstep: draft == target weights (a perfectly
+    #    agreeing draft — the tokens-per-forward ceiling) vs greedy ----
+    k = cfg["draft_k"]
+    spec_m = get_model(cfg["model"], vocab_size=cfg["vocab_size"],
+                       max_seq_len=P + N + k)
+    sprompts = prompts[:cfg["spec_requests"]]
+    gd = SlotDecoder(spec_m, variables, slots=cfg["spec_requests"],
+                     prompt_len=P, max_new_tokens=N)
+    try:
+        greedy_out, greedy_wall = _drive_burst(gd, sprompts, N)
+        greedy = _arm_stats(gd, greedy_wall)
+    finally:
+        gd.close()
+    sd = SlotDecoder(spec_m, variables, slots=cfg["spec_requests"],
+                     prompt_len=P, max_new_tokens=N,
+                     draft_model=spec_m, draft_variables=variables,
+                     draft_k=k)
+    try:
+        spec_out, spec_wall = _drive_burst(sd, sprompts, N)
+        spec = _arm_stats(sd, spec_wall)
+    finally:
+        sd.close()
+
+    fingerprint = hashlib.sha256(json.dumps(
+        [dense_out, paged_out, off_out, greedy_out, spec_out],
+        sort_keys=True).encode()).hexdigest()
+    tokens_per_forward = (spec["spec_tokens_emitted"]
+                          / max(spec["spec_rounds"], 1))
+    saving_pct = round(100.0 * (1 - paged["prefill_tokens_computed"]
+                                / max(off["prefill_tokens_computed"], 1)), 1)
+    return {
+        "config": dict(cfg),
+        "density": {
+            "dense": dense, "paged": paged,
+            "identical_tokens": paged_out == dense_out,
+            "same_cache_bytes":
+                paged["cache_bytes"] == dense["cache_bytes"],
+            "concurrency_x": round(paged["peak_active"]
+                                   / max(dense["peak_active"], 1), 2),
+        },
+        "prefix": {
+            "off": off,
+            "identical_tokens": off_out == paged_out,
+            "prefill_tokens_with_cache": paged["prefill_tokens_computed"],
+            "prefill_tokens_without": off["prefill_tokens_computed"],
+            "saving_pct": saving_pct,
+        },
+        "speculative": {
+            "greedy": greedy, "spec": spec,
+            "identical_tokens": spec_out == greedy_out,
+            "tokens_per_forward": round(tokens_per_forward, 2),
+        },
+        "fingerprint": fingerprint,
+    }
+
+
+def check_decode_bench(banked_path: str) -> int:
+    """CI ratchet over BENCH_SERVE_r02: rerun the banked config and
+    fail on any broken invariant (tokens diverging between arms, the
+    paged pool admitting < 2x dense at the same bytes, prefix savings
+    below 40%, speculative <= 1 token per target forward) or on a
+    changed deterministic fingerprint."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    section = banked.get("decode")
+    if not section:
+        print(f"check: no decode section in {banked_path}", file=sys.stderr)
+        return 2
+    now = run_decode_bench(dict(section["config"]))
+    ok = True
+    if not (now["density"]["identical_tokens"]
+            and now["prefix"]["identical_tokens"]
+            and now["speculative"]["identical_tokens"]):
+        print("check: decode regression — arms no longer token-identical",
+              file=sys.stderr)
+        ok = False
+    if not now["density"]["same_cache_bytes"]:
+        print("check: decode regression — cache byte budgets diverged",
+              file=sys.stderr)
+        ok = False
+    if now["density"]["concurrency_x"] < 2.0:
+        print(f"check: decode regression — paged admits only "
+              f"{now['density']['concurrency_x']}x dense (< 2x)",
+              file=sys.stderr)
+        ok = False
+    if now["prefix"]["saving_pct"] < 40.0:
+        print(f"check: decode regression — prefix cache saves only "
+              f"{now['prefix']['saving_pct']}% prefill tokens (< 40%)",
+              file=sys.stderr)
+        ok = False
+    if now["speculative"]["tokens_per_forward"] <= 1.0:
+        print("check: decode regression — speculative emits <= 1 token "
+              "per target forward", file=sys.stderr)
+        ok = False
+    if now["fingerprint"] != section["fingerprint"]:
+        print("check: decode regression — deterministic token "
+              "fingerprint diverged from the bank", file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "concurrency_x": now["density"]["concurrency_x"],
+                      "saving_pct": now["prefix"]["saving_pct"],
+                      "tokens_per_forward":
+                          now["speculative"]["tokens_per_forward"]},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def decode_main(args) -> int:
+    if args.check:
+        return check_decode_bench(args.decode_out)
+    cfg = dict(DECODE_CONFIG)
+    cfg["seed"] = args.seed
+    result = {"bench": "serve_bench", "round": "r02",
+              "decode": run_decode_bench(cfg)}
+    with open(args.decode_out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    d = result["decode"]
+    print(json.dumps({"out": args.decode_out,
+                      "concurrency_x": d["density"]["concurrency_x"],
+                      "saving_pct": d["prefix"]["saving_pct"],
+                      "tokens_per_forward":
+                          d["speculative"]["tokens_per_forward"],
+                      "identical": d["density"]["identical_tokens"]
+                      and d["prefix"]["identical_tokens"]
+                      and d["speculative"]["identical_tokens"]},
+                     indent=2))
+    return 0
 
 
 # ---------------------------------------------------------------------------
@@ -486,7 +757,11 @@ def main() -> int:
     p.add_argument("--window-ms", type=float, default=5.0,
                    help="micro-batching window for the micro mode")
     p.add_argument("--param-dtype", default="bfloat16",
-                   choices=["bfloat16", "float32", "int8", ""])
+                   choices=["bfloat16", "float32", "int8", "int4", ""])
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="paged KV cache pool size for the continuous "
+                        "mode (0 = dense per-slot cache)")
+    p.add_argument("--kv-page-size", type=int, default=0)
     p.add_argument("--attention-window", type=int, default=0,
                    help="sliding-window width for the served model "
                         "(0 = full causal)")
@@ -503,13 +778,33 @@ def main() -> int:
     p.add_argument("--router", action="store_true",
                    help="run the deterministic JAXService router+"
                         "autoscaler benchmark and bank BENCH_SERVE_r01")
+    p.add_argument("--decode", action="store_true",
+                   help="run the deterministic per-replica decode "
+                        "benchmark (dense-vs-paged KV cache, prefix "
+                        "reuse, speculative lockstep) and bank "
+                        "BENCH_SERVE_r02")
     p.add_argument("--check", action="store_true",
-                   help="CI gate: rerun the banked router config and "
-                        "fail on drops/divergence/throughput regression")
+                   help="CI gate: rerun every banked config and fail on "
+                        "drops/divergence/counter regression (with "
+                        "--router or --decode: gate only that bank)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=ROUTER_OUT)
+    p.add_argument("--decode-out", default=DECODE_OUT)
     args = p.parse_args()
-    if args.router or args.check:
+    if args.check:
+        if args.decode:
+            return check_decode_bench(args.decode_out)
+        if args.router:
+            return check_router_bench(args.out)
+        rc = 0
+        if os.path.exists(args.out):
+            rc = max(rc, check_router_bench(args.out))
+        if os.path.exists(args.decode_out):
+            rc = max(rc, check_decode_bench(args.decode_out))
+        return rc
+    if args.decode:
+        return decode_main(args)
+    if args.router:
         return router_main(args)
     if args.mesh:
         args.mesh = {k: int(v) for k, v in
