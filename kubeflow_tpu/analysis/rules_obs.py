@@ -1,4 +1,5 @@
-"""tpulint observability rule (OBS301): wall-clock duration math.
+"""tpulint observability rules: OBS301 wall-clock duration math,
+OBS302 metrics-catalog drift.
 
 ``time.time()`` is wall clock: NTP slew/step can make consecutive
 readings go backwards or jump, so a latency computed as
@@ -18,10 +19,13 @@ expiry comparisons (``exp < time.time()``), plain timestamping, and all
 from __future__ import annotations
 
 import ast
+import fnmatch
+import pathlib
+import re
 from typing import Iterator
 
 from kubeflow_tpu.analysis.core import (
-    Finding, Module, Rule, dotted, register,
+    Finding, Module, ProgramRule, Rule, dotted, register,
 )
 
 
@@ -85,3 +89,181 @@ class WallClockDuration(Rule):
                     module, node,
                     "duration computed from time.time(); wall clock can "
                     "step/slew under NTP — use time.perf_counter()")
+
+
+# -- OBS302: metrics-catalog drift -------------------------------------------
+
+# A catalog row is a markdown TABLE row inside the "## Metrics catalog"
+# section whose first cell is a backtick-quoted series name:
+# "| `metric_name` | ...". Wildcards (`*`) cover dynamic families
+# (f-string names like jaxrt_eval_{k}). Tables in OTHER sections
+# (events, alert pack, goodput buckets) are not catalog rows.
+_CATALOG_ROW_RE = re.compile(
+    r"^\|\s*`(?P<name>[a-zA-Z_:][a-zA-Z0-9_*:]*)`")
+_CATALOG_HEADING_RE = re.compile(r"^##\s+Metrics catalog\b")
+_HEADING_RE = re.compile(r"^##\s")
+CATALOG_DOC = "docs/observability.md"
+
+# Registration spellings this repo uses — MetricsRegistry methods, the
+# memoized prometheus_client helpers, and direct prom.<Kind> ctors.
+_REG_METHODS = frozenset({"gauge", "counter_inc", "histogram"})
+_REG_HELPERS = frozenset({"prom_metric", "_prom_metric", "_metric",
+                          "_counter"})
+_PROM_KINDS = frozenset({"Gauge", "Counter", "Histogram", "Summary"})
+
+# Doc-side (stale row) findings are only provable when the whole tree
+# was scanned: the sentinel module must be present AND the scan must
+# cover a real slice of the package (a single-file scan of metrics.py
+# itself must not declare every other catalog row stale). Corpus tests
+# inject catalog_override, which waives the size floor.
+_FULL_SCAN_SENTINEL = "kubeflow_tpu.runtime.metrics"
+_MIN_FULL_SCAN_MODULES = 10
+
+
+def _name_pattern(node: ast.AST) -> str | None:
+    """First-arg metric name as a literal or an f-string glob
+    (``f"jaxrt_eval_{k}"`` -> ``jaxrt_eval_*``). Non-string args
+    (helper passthrough params) return None — unknowable statically."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            parts.append(v.value if isinstance(v, ast.Constant) else "*")
+        pat = "".join(parts)
+        return pat if pat.strip("*") else None
+    return None
+
+
+def _registrations(module: Module) -> Iterator[tuple[ast.AST, str]]:
+    """(node, name-or-glob) for every metric registration site."""
+    for node in ast.walk(module.tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        fn = node.func
+        hit = False
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in _REG_METHODS or fn.attr in _REG_HELPERS:
+                hit = True
+            elif (fn.attr in _PROM_KINDS and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "prom"):
+                hit = True
+        elif isinstance(fn, ast.Name) and fn.id in _REG_HELPERS:
+            hit = True
+        if not hit:
+            continue
+        pat = _name_pattern(node.args[0])
+        if pat:
+            yield node, pat
+
+
+def _patterns_match(a: str, b: str) -> bool:
+    """Either glob covering the other counts as a match (a doc family
+    row matches a dynamic code name and vice versa)."""
+    return fnmatch.fnmatchcase(a, b) or fnmatch.fnmatchcase(b, a)
+
+
+@register
+class MetricsCatalogDrift(ProgramRule):
+    """OBS302: every metric registered under ``kubeflow_tpu/`` must
+    have a row in the docs/observability.md catalog tables, and every
+    catalog row must correspond to a live registration (stale rows are
+    drift in the other direction — an operator paging through the
+    catalog must be able to trust it)."""
+
+    id = "OBS302"
+    name = "metrics-catalog-drift"
+    short = ("metric registration and the docs/observability.md catalog "
+             "must agree")
+
+    # tests inject catalog text here (the committed doc is the default)
+    catalog_override: str | None = None
+
+    def _catalog(self, program) -> tuple[list[tuple[int, str]], str]:
+        """-> ([(line, name-or-glob), ...], doc_path)."""
+        if self.catalog_override is not None:
+            text, path = self.catalog_override, CATALOG_DOC
+        else:
+            path = self._find_doc(program)
+            if path is None:
+                return [], CATALOG_DOC
+            try:
+                text = pathlib.Path(path).read_text()
+            except OSError:
+                return [], str(path)
+        rows = []
+        in_catalog = False
+        for i, line in enumerate(text.splitlines(), start=1):
+            if _CATALOG_HEADING_RE.match(line):
+                in_catalog = True
+                continue
+            if in_catalog and _HEADING_RE.match(line):
+                in_catalog = False
+            if not in_catalog:
+                continue
+            m = _CATALOG_ROW_RE.match(line)
+            if m:
+                rows.append((i, m.group("name")))
+        return rows, str(path)
+
+    @staticmethod
+    def _find_doc(program) -> str | None:
+        """Walk up from any scanned module to the repo's docs/ dir;
+        falls back to the installed package's parent."""
+        candidates = []
+        for module in program.modules.values():
+            candidates.append(pathlib.Path(module.path).resolve().parent)
+        try:
+            import kubeflow_tpu
+
+            candidates.append(
+                pathlib.Path(kubeflow_tpu.__file__).resolve().parent.parent)
+        except ImportError:  # pragma: no cover - always importable here
+            pass
+        seen = set()
+        for base in candidates:
+            for parent in (base, *base.parents):
+                if parent in seen:
+                    continue
+                seen.add(parent)
+                doc = parent / CATALOG_DOC
+                if doc.is_file():
+                    return str(doc)
+        return None
+
+    def check_program(self, program) -> Iterator[Finding]:
+        regs: list[tuple[Module, ast.AST, str]] = []
+        pkg_modules = 0
+        for modname, module in sorted(program.modules.items()):
+            in_pkg = modname.startswith("kubeflow_tpu.") \
+                or modname == "kubeflow_tpu" \
+                or "kubeflow_tpu/" in module.path.replace("\\", "/")
+            if not in_pkg:
+                continue  # tools/bench registrations are not platform API
+            pkg_modules += 1
+            for node, pat in _registrations(module):
+                regs.append((module, node, pat))
+        full_scan = _FULL_SCAN_SENTINEL in program.modules and (
+            self.catalog_override is not None
+            or pkg_modules >= _MIN_FULL_SCAN_MODULES)
+        if not regs and not full_scan:
+            return
+        rows, doc_path = self._catalog(program)
+        row_names = [name for _, name in rows]
+        for module, node, pat in regs:
+            if not any(_patterns_match(pat, row) for row in row_names):
+                yield self.finding(
+                    module, node,
+                    f"metric '{pat}' is registered here but has no row "
+                    f"in the {CATALOG_DOC} catalog — document it (or it "
+                    "is invisible to operators)")
+        # stale doc rows are only provable on a full-package scan
+        if not full_scan:
+            return
+        code_pats = {pat for _, _, pat in regs}
+        for line, row in rows:
+            if not any(_patterns_match(row, pat) for pat in code_pats):
+                yield Finding(
+                    self.id, doc_path, line, 0,
+                    f"catalog row '{row}' matches no metric registration "
+                    "in kubeflow_tpu/ — stale doc row, delete or fix it")

@@ -102,6 +102,15 @@ class Controller:
         self._streams: list = []
         self._resources: list = []  # lifecycle-coupled (see uses())
         self._elector = None  # set by with_leader_election
+        # Pre-register the outcome counter at 0 for every result label:
+        # rate()/increase() need two samples to see a delta, so a series
+        # born AT its first error (value 1, then flat) never shows an
+        # increase — the fleet plane's ReconcileErrorRate alert would be
+        # blind to a controller's first failure window.
+        for result in ("success", "requeue", "error", "conflict"):
+            self.registry.counter_inc(
+                "controller_reconcile_total", help_="reconciles by outcome",
+                by=0.0, controller=self.name, result=result)
 
     # -- registration (kubebuilder For/Owns/Watches analogues) -------------
 

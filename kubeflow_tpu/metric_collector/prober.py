@@ -5,6 +5,12 @@ authenticated GET against the platform endpoint sets a binary Prometheus
 gauge (:20-22, metric_update :25-37). Auth is pluggable (the reference
 used OIDC-through-IAP; header-identity and none are provided here), and
 a multi-target mode probes every component the TpuDef deployed.
+
+Results land in BOTH sinks (the PR 4 convention): prometheus_client
+for the prober's own scrape port, and the ``MetricsRegistry`` so the
+fleet observability plane (``obs/tsdb.ScrapeLoop``) can pull the same
+series through a ``RegistryTarget`` or the registry's ``/metrics``
+endpoint — catalogued in docs/observability.md.
 """
 
 from __future__ import annotations
@@ -14,6 +20,8 @@ import time
 from typing import Callable
 
 import prometheus_client as prom
+
+from kubeflow_tpu.runtime.metrics import REGISTRY, MetricsRegistry
 
 log = logging.getLogger("kubeflow_tpu.metric_collector")
 
@@ -48,16 +56,26 @@ class AvailabilityProber:
         targets: dict[str, str],
         checker: Callable[[str], bool] | None = None,
         user_header: str | None = None,
+        registry: MetricsRegistry | None = None,
     ):
         headers = {"kubeflow-userid": user_header} if user_header else {}
         self.targets = targets
         self.checker = checker or (lambda url: http_check(url, headers))
+        self.registry = registry if registry is not None else REGISTRY
 
     def probe_once(self) -> dict[str, bool]:
         out = {}
         for name, url in self.targets.items():
             up = self.checker(url)
             availability_gauge().labels(target=name).set(1 if up else 0)
+            self.registry.gauge(
+                "kubeflow_availability", 1 if up else 0,
+                help_="whether the kubeflow-tpu endpoint answers "
+                      "(1 up / 0 down)", target=name)
+            self.registry.counter_inc(
+                "kubeflow_probe_total",
+                help_="availability probes by result",
+                target=name, result="up" if up else "down")
             out[name] = up
         return out
 

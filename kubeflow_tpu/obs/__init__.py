@@ -13,6 +13,18 @@ start?" across layers. This package is the missing spine:
   objects written through the k8s client, with count-dedup (a repeated
   identical event bumps ``count``/``lastTimestamp`` instead of
   flooding etcd).
+- ``expofmt`` — the ONE Prometheus text-exposition parser (shared by
+  the router's ``RegistrySignals`` and the fleet scraper).
+- ``tsdb`` — bounded ring timeseries store + ``ScrapeLoop`` pulling
+  in-process registries, HTTP ``/metrics``, and JAXService replica
+  endpoints; staleness markers on target loss.
+- ``rules`` — PromQL-lite evaluation, recording rules, and alerting
+  with a pending→firing→resolved state machine emitting dedup'd
+  Events.
+- ``goodput`` — chip-seconds accounting from the span stream
+  (conservation-checked buckets) + serving SLO/error-budget math.
+- ``plane``  — the assembled ``FleetPlane`` the dashboard serves
+  (``/api/alerts``, ``/api/query``, ``/api/goodput``).
 
 Propagation contract: the JAXJob controller stamps the job's
 ``traceparent`` into generated pod annotations and a ``TRACEPARENT``

@@ -1,0 +1,140 @@
+"""FleetPlane — the assembled observability plane, one handle.
+
+Bundles the three ISSUE-10 layers (``tsdb`` scrape plane, ``rules``
+engine, ``goodput`` accounting) behind the object the dashboard routes
+(``GET /api/alerts`` / ``/api/query`` / ``/api/goodput``) and
+``run_controller``-style mains wire up. Hermetic harnesses build their
+own with fake clocks; a process that just wants "the plane" uses the
+module-level ``default_plane()`` singleton (the REGISTRY/COLLECTOR/
+TRACER convention from runtime/metrics.py and obs/trace.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.obs.rules import RuleEngine, default_rule_pack
+from kubeflow_tpu.obs.tsdb import ScrapeLoop, Target, TimeSeriesStore
+
+
+class FleetPlane:
+    """store + scraper + rule engine + goodput reads, one lifecycle.
+
+    ``tick()`` is the deterministic unit (one scrape cycle + one rule
+    pass at the shared clock) — drills, tests and the bench drive it on
+    virtual time; ``start()``/``stop()`` run it on wall time."""
+
+    def __init__(self, registry=None, recorder=None,
+                 targets: list[Target] = (),
+                 discover: Callable[[], list[Target]] | None = None,
+                 rules: list | None = None,
+                 interval_s: float = 15.0,
+                 clock: Callable[[], float] = time.time,
+                 collector: "obs_trace.TraceCollector | None" = None,
+                 max_points: int = 512, max_series: int = 50000,
+                 lookback_s: float | None = None):
+        from kubeflow_tpu.runtime.metrics import REGISTRY
+
+        self.registry = registry if registry is not None else REGISTRY
+        self.clock = clock
+        self.collector = collector if collector is not None \
+            else obs_trace.COLLECTOR
+        self.store = TimeSeriesStore(max_points=max_points,
+                                     max_series=max_series)
+        self.scraper = ScrapeLoop(
+            self.store, targets=targets, discover=discover,
+            interval_s=interval_s, clock=clock, registry=self.registry)
+        # instant-selector lookback tracks the scrape interval: a
+        # series is "current" while it misses fewer than ~4 scrapes
+        self.engine = RuleEngine(
+            self.store,
+            rules=default_rule_pack() if rules is None else rules,
+            recorder=recorder, registry=self.registry, clock=clock,
+            lookback_s=(lookback_s if lookback_s is not None
+                        else max(interval_s * 4, 60.0)))
+        self.slos = [gp.ServingSLO()]
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- deterministic core --------------------------------------------------
+
+    def tick(self, at: float | None = None) -> dict:
+        """One scrape + rule pass; returns {'scrape': ..., 'transitions':
+        [...]} — the unit the bench fingerprints."""
+        scrape = self.scraper.scrape_once()
+        transitions = self.engine.evaluate_once(at=at)
+        return {"scrape": scrape, "transitions": transitions}
+
+    # -- dashboard reads -----------------------------------------------------
+
+    def alerts(self) -> dict:
+        return {"alerts": self.engine.active_alerts()}
+
+    def query(self, text: str, at: float | None = None) -> dict:
+        result = self.engine.query(text, at=at)
+        return {"query": text,
+                "result": [{"labels": labels, "value": value}
+                           for labels, value in result]}
+
+    def goodput(self, chips: int = 1, window_s: float | None = None,
+                at: float | None = None) -> dict:
+        """Training goodput from the span stream + serving SLO status
+        from the TSDB — the /api/goodput body."""
+        spans = self.collector.spans()
+        report = gp.job_report(spans, chips=chips)
+        now = self.clock() if at is None else at
+        slos = [slo.from_store(self.store, now,
+                               window_s=window_s or 300.0)
+                for slo in self.slos]
+        return {"training": report.check().to_dict(), "serving": slos}
+
+    # -- thread shell --------------------------------------------------------
+
+    def start(self) -> "FleetPlane":  # pragma: no cover - thread shell
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="obs-plane", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:  # pragma: no cover - thread shell
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.scraper.interval_s + 5)
+            self._thread = None
+
+    def _run(self) -> None:  # pragma: no cover - thread shell
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # the plane must outlive a bad pass
+                import logging
+
+                logging.getLogger("kubeflow_tpu.obs.plane").exception(
+                    "plane tick failed")
+            self._stop.wait(self.scraper.interval_s)
+
+
+_default: FleetPlane | None = None
+_default_lock = threading.Lock()
+
+
+def default_plane() -> FleetPlane:
+    """The process-wide plane (lazily built, self-scraping the global
+    MetricsRegistry). The dashboard serves this one unless handed
+    another. STARTED on first build — a plane that is never ticked
+    would serve a permanently empty store and a silent alert surface,
+    which is worse than no plane at all."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            from kubeflow_tpu.obs.tsdb import RegistryTarget
+            from kubeflow_tpu.runtime.metrics import REGISTRY
+
+            _default = FleetPlane(
+                targets=[RegistryTarget("self", REGISTRY)]).start()
+        return _default

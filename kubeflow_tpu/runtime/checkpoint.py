@@ -115,6 +115,18 @@ class Checkpointer:
 
         self._ocp = ocp
         self.directory = _normalize_dir(directory)
+        # Pre-register the failure counter at 0 (both ops): the
+        # CheckpointFailures alert reads increase(), which needs a
+        # 0-sample BEFORE the first failure to see a delta — a counter
+        # born at 1 and flat thereafter never alerts on the very first
+        # failed save, the rare event the alert exists for.
+        from kubeflow_tpu.runtime import metrics as rt_metrics
+
+        for op in ("save", "restore"):
+            rt_metrics.REGISTRY.counter_inc(
+                "checkpoint_failures_total",
+                help_="checkpoint saves/restores that raised",
+                by=0.0, op=op)
         # elastic bookkeeping: the world size each step was SAVED at,
         # recorded into the manifest so dashboards/preflight can answer
         # "this resume reshards 8 -> 2" without opening orbax metadata.
@@ -156,11 +168,27 @@ class Checkpointer:
             # orbax raises StepAlreadyExistsError even with force=True;
             # delete-then-save is the overwrite.
             self._mgr.delete(int(step))
-        saved = self._mgr.save(
-            int(step),
-            args=self._ocp.args.StandardSave(_payload(state)),
-            force=force,
-        )
+        # train.checkpoint span: the device->host + queue window this
+        # call blocks the step loop for — the goodput ledger's
+        # `checkpoint` bucket (obs/goodput.py) reads exactly this name.
+        from kubeflow_tpu.obs import trace as obs_trace
+        from kubeflow_tpu.runtime import metrics as rt_metrics
+
+        try:
+            with obs_trace.TRACER.span("train.checkpoint", step=int(step)):
+                saved = self._mgr.save(
+                    int(step),
+                    args=self._ocp.args.StandardSave(_payload(state)),
+                    force=force,
+                )
+        except Exception:
+            # alertable (CheckpointFailures in the default rule pack):
+            # a job silently failing to persist progress is the outage
+            # an operator finds out about at the NEXT preemption
+            rt_metrics.REGISTRY.counter_inc(
+                "checkpoint_failures_total",
+                help_="checkpoint saves/restores that raised", op="save")
+            raise
         if saved:
             if self.world_size:
                 self._world_sizes[int(step)] = self.world_size
@@ -208,6 +236,12 @@ class Checkpointer:
             try:
                 return self.restore(step, template_state)
             except Exception as e:  # orbax raises backend-specific types
+                from kubeflow_tpu.runtime import metrics as rt_metrics
+
+                rt_metrics.REGISTRY.counter_inc(
+                    "checkpoint_failures_total",
+                    help_="checkpoint saves/restores that raised",
+                    op="restore")
                 last_error = e
                 log.warning(
                     "checkpoint: step %d in %s is unrestorable (%s: %s); "

@@ -226,7 +226,27 @@ class ElasticCoordinator:
         worlds: list[int] = [world.size]
         resizes = 0
         state = summary = None
+        from kubeflow_tpu.obs import trace as obs_trace
+
+        # A RE-formation (any pass after the first) is resize-rebuild
+        # time: teardown + world re-form + mesh/shardings/trainer
+        # rebuild. The span feeds the goodput ledger's `resize_rebuild`
+        # bucket (obs/goodput.py); the FIRST formation is cold start
+        # and stays un-spanned (it lands in blocked_on_admission with
+        # the rest of startup).
+        rebuild_span = None
+
+        def _finish_rebuild(status: str = "OK") -> None:
+            nonlocal rebuild_span
+            if rebuild_span is not None:
+                rebuild_span.status = status
+                obs_trace.TRACER.finish(rebuild_span)
+                rebuild_span = None
+
         while True:
+            if resizes and rebuild_span is None:
+                rebuild_span = obs_trace.TRACER.begin(
+                    "elastic.rebuild", gen=world.gen, size=world.size)
             try:
                 self.form_world(world)
             except Exception as e:
@@ -242,7 +262,10 @@ class ElasticCoordinator:
                 cur = self._member_world()
                 if cur is None or (cur.gen, cur.members) == \
                         (world.gen, world.members):
+                    _finish_rebuild("ERROR")
                     raise
+                # the stamp moved: the retry below is STILL rebuild
+                # time — the open span keeps covering it
                 log.warning(
                     "world formation at size %d failed (%s: %s); the "
                     "world moved to gen %d size %d — retrying there",
@@ -261,6 +284,7 @@ class ElasticCoordinator:
                 trainer = make_trainer(wcfg, world.size)
             except ValueError:
                 if world.size == full_world:
+                    _finish_rebuild("ERROR")
                     raise  # a bad config at FULL size fails loudly
                 # the RESIZED world is incompatible with the config
                 # (e.g. global_batch not divisible by the survivor
@@ -274,7 +298,9 @@ class ElasticCoordinator:
                     "exiting for a gang restart instead of crash-looping",
                     world.size)
                 exit_ = ResizeExit("preempted", resizes, worlds)
+                _finish_rebuild("ERROR")
                 break
+            _finish_rebuild()  # re-formation + rebuild done: fit resumes
             state, summary = trainer.fit(stop=self._stop_flag(world),
                                          callback=callback)
             if not summary.get("preempted"):

@@ -600,33 +600,17 @@ class RegistrySignals:
     def _series(self, name: str) -> list[tuple[dict, float]]:
         # in-process fast path: structured samples straight off the
         # registry (O(metric) instead of rendering + parsing the whole
-        # exposition per signal read). The text parser below serves
-        # callable sources (a scraped /metrics body).
+        # exposition per signal read). Scraped bodies go through the
+        # ONE exposition parser (obs/expofmt.py) shared with the fleet
+        # scrape plane — no second spelling.
         reader = getattr(self.registry, "series", None)
         if reader is not None:
             return reader(name)
+        from kubeflow_tpu.obs import expofmt
+
         text = self.registry() if callable(self.registry) \
             else self.registry.render()
-        out = []
-        for line in text.splitlines():
-            if not line.startswith(name) or line.startswith("#"):
-                continue
-            head, _, value = line.rpartition(" ")
-            if head.rstrip("}") == name:
-                head_name, labels = name, {}
-            else:
-                head_name, _, rest = head.partition("{")
-                if head_name != name or not rest.endswith("}"):
-                    continue
-                labels = {}
-                for kv in rest[:-1].split(","):
-                    k, _, v = kv.partition("=")
-                    labels[k] = v.strip('"')
-            try:
-                out.append((labels, float(value)))
-            except ValueError:
-                continue
-        return out
+        return expofmt.samples(text, name)
 
     def _sum(self, name: str, **match) -> float:
         total = 0.0

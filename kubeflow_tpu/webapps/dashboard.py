@@ -95,7 +95,7 @@ class Dashboard:
     def __init__(self, client, kfam: KfamService | None = None,
                  metrics: MetricsService | None = None,
                  serving_url: str | None = None,
-                 fetch_json=None):
+                 fetch_json=None, plane=None):
         import os
 
         self.client = client
@@ -104,6 +104,9 @@ class Dashboard:
         self.serving_url = serving_url or os.environ.get(
             "SERVING_URL", "http://serving.kubeflow.svc")
         self.fetch_json = fetch_json or self._default_fetch
+        # fleet observability plane (obs/plane.py); None -> the
+        # process-wide default, built lazily on first /api/alerts read
+        self.plane = plane
 
     @staticmethod
     def _default_fetch(url: str) -> dict:
@@ -341,6 +344,56 @@ class Dashboard:
             return {"values": self.metrics.tpu_chips()}
         raise ApiHttpError(404, f"unknown metric type {mtype!r}")
 
+    # -- fleet observability plane (obs/plane.py) ----------------------------
+
+    def _plane(self):
+        if self.plane is not None:
+            return self.plane
+        from kubeflow_tpu.obs.plane import default_plane
+
+        return default_plane()
+
+    def alerts(self, req: HttpReq):
+        """Active alerts (pending + firing) from the plane's rule
+        engine — the structured face of the AlertFiring/AlertResolved
+        Events in the activities feed."""
+        self._user(req)
+        return self._plane().alerts()
+
+    def obs_query(self, req: HttpReq):
+        """PromQL-lite over the fleet TSDB: /api/query?q=<expr>[&at=]
+        (docs/observability.md documents the grammar)."""
+        from kubeflow_tpu.obs.rules import QueryError
+
+        self._user(req)
+        text = req.q1("q")
+        if not text:
+            raise ApiHttpError(400, "missing ?q=<expression>")
+        at = req.q1("at")
+        try:
+            at_f = float(at) if at else None
+        except ValueError:
+            raise ApiHttpError(400, f"bad ?at= value: {at!r}")
+        try:
+            return self._plane().query(text, at=at_f)
+        except QueryError as e:
+            raise ApiHttpError(400, f"bad query: {e}")
+
+    def goodput(self, req: HttpReq):
+        """Training goodput buckets (conservation-checked) + serving
+        SLO attainment — "what fraction of chip-seconds were
+        productive, and where did the rest go?"."""
+        self._user(req)
+        chips = req.q1("chips")
+        window = req.q1("window_s")
+        try:
+            chips_i = int(chips) if chips else 1
+            window_f = float(window) if window else None
+        except ValueError:
+            raise ApiHttpError(
+                400, "chips must be an int, window_s a number")
+        return self._plane().goodput(chips=chips_i, window_s=window_f)
+
     # -- wiring -------------------------------------------------------------
 
     def router(self) -> Router:
@@ -362,6 +415,9 @@ class Dashboard:
         r.route("GET", "/api/activities/{namespace}", self.activities)
         r.route("GET", "/api/traces", self.traces)
         r.route("GET", "/api/metrics/{type}", self.get_metrics)
+        r.route("GET", "/api/alerts", self.alerts)
+        r.route("GET", "/api/query", self.obs_query)
+        r.route("GET", "/api/goodput", self.goodput)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)
         from kubeflow_tpu.webapps.dashboard_ui import add_ui_routes
 

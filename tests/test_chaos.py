@@ -1034,6 +1034,24 @@ def _soak(seed, rounds=200):
             f"{name}: disconnected spans "
             f"{[s.name for s in spans if s.span_id not in reach]}")
 
+        # -- goodput ledger conservation (ISSUE 10) --------------------------
+        # however chaotic the run, the ledger's buckets must sum to the
+        # job's wall window EXACTLY — check() raises on any double-
+        # counted or dropped time (2 workers x 4 chips = 8 chips)
+        from kubeflow_tpu.obs import goodput as gp
+
+        report = gp.job_report(spans, chips=8)
+        report.check()
+        assert report.wall_s > 0
+        assert all(v >= 0 for v in report.buckets.values())
+        # restarts the drills forced show up as ACCOUNTED rebuild time:
+        # any provision beyond the first must land in restart_rebuild,
+        # never vanish into unclassified loss
+        provisions = [s for s in spans if s.name == "jaxjob.provision"
+                      and s.end is not None]
+        if len(provisions) > 1:
+            assert report.buckets[gp.RESTART] > 0, report.buckets
+
     return chaos.fault_log(), lease_chaos.fault_log(), failover_took
 
 
@@ -1136,6 +1154,21 @@ def test_spot_reclaim_drill_keeps_budgets_and_trace_connected():
     reach = tr.reachable(spans, ctx.span_id)
     assert reach >= {s.span_id for s in spans}, (
         [s.name for s in spans if s.span_id not in reach])
+    # goodput ledger conservation across the resize drill (ISSUE 10):
+    # shrink + grow re-provisions are ACCOUNTED (restart_rebuild /
+    # admission buckets), and everything sums to the wall window
+    from kubeflow_tpu.obs import goodput as gp
+
+    report = gp.job_report(spans, chips=16)  # 4 workers x 4 chips
+    report.check()
+    assert report.wall_s > 0
+    assert all(v >= 0 for v in report.buckets.values())
+    # the drill re-provisioned replacements after the first provision:
+    # that time must land in restart_rebuild, not vanish
+    provisions = [s for s in spans if s.name == "jaxjob.provision"
+                  and s.end is not None]
+    if len(provisions) > 1:
+        assert report.buckets[gp.RESTART] > 0
 
 
 @pytest.mark.parametrize("seed", CHAOS_SEEDS[:2])

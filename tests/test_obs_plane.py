@@ -1,0 +1,1023 @@
+"""Fleet observability plane tier (ISSUE 10): the shared exposition
+parser, the ring TSDB + ScrapeLoop, PromQL-lite + recording/alerting
+rules, goodput accounting, the dashboard surface, and the obs bench
+contract.
+
+The acceptance drill lives here too: a scripted kill drill over a REAL
+TokenRouter on a virtual clock — healthy traffic, then a fault window
+(slow completions + a killed replica + reconcile errors) — must fire
+the RouterLatencySLOBurn and ReconcileErrorRate alerts DURING the
+window, emit AlertFiring Events through the EventRecorder, and resolve
+both after heal. Goodput-ledger conservation is additionally asserted
+inside the chaos soak and the elastic resize drill (tests/test_chaos.py).
+"""
+
+import json
+import math
+import urllib.request
+
+import pytest
+
+from kubeflow_tpu.obs import expofmt
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs import rules as R
+from kubeflow_tpu.obs import trace as tr
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.obs.plane import FleetPlane
+from kubeflow_tpu.obs.tsdb import (
+    HttpTarget, RegistryTarget, ScrapeLoop, TimeSeriesStore,
+    jaxservice_targets, series_key,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.runtime.metrics import (
+    DEFAULT_BUCKETS, MetricsRegistry, serve_metrics,
+)
+from kubeflow_tpu.serving.router import (
+    REQUEST_BUCKETS, Member, RegistrySignals, TokenRouter,
+)
+
+pytestmark = pytest.mark.obs
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- the ONE exposition parser (satellite 1) ---------------------------------
+
+
+class TestExpofmt:
+    def test_parse_round_trips_registry_render(self):
+        """Parsing render() must reproduce the registry's own
+        structured samples — the scraped path and the fast path agree
+        byte-for-byte (fast-vs-scraped parity)."""
+        reg = MetricsRegistry()
+        reg.gauge("g_metric", 1.5, service="a", zone="x")
+        reg.gauge("g_metric", 2.5, service="b", zone="x")
+        reg.counter_inc("c_total", by=3.0, job="j")
+        parsed = {}
+        for s in expofmt.parse(reg.render()):
+            parsed.setdefault(s.name, []).append(
+                (tuple(sorted(s.labels_dict().items())), s.value))
+        for name in ("g_metric", "c_total"):
+            fast = sorted((tuple(sorted(ls.items())), v)
+                          for ls, v in reg.series(name))
+            assert sorted(parsed[name]) == fast
+
+    def test_escaped_label_values_round_trip(self):
+        """The naive split-on-comma parser this replaces corrupted
+        quoted commas and escapes; the shared parser must not."""
+        reg = MetricsRegistry()
+        nasty = 'a,b="c"\\d\ne'
+        reg.gauge("esc_metric", 7.0, path=nasty, other="plain")
+        samples = expofmt.samples(reg.render(), "esc_metric")
+        assert samples == [({"other": "plain", "path": nasty}, 7.0)]
+
+    def test_histograms_parse_as_component_series(self):
+        reg = MetricsRegistry()
+        reg.histogram("h_seconds", 0.3, buckets=(0.1, 0.5), svc="s")
+        names = {s.name for s in expofmt.parse(reg.render())}
+        assert names == {"h_seconds_bucket", "h_seconds_sum",
+                         "h_seconds_count"}
+        buckets = expofmt.samples(reg.render(), "h_seconds_bucket")
+        by_le = {ls["le"]: v for ls, v in buckets}
+        assert by_le == {"0.1": 0.0, "0.5": 1.0, "+Inf": 1.0}
+
+    def test_garbage_lines_are_skipped_not_raised(self):
+        text = ("# HELP x y\n# TYPE x gauge\nx 1\n"
+                "!!!garbage\nname{borked 2\nx{a=\"b\"} nope\n"
+                "ok_metric{a=\"b\"} 3\n")
+        got = [(s.name, s.value) for s in expofmt.parse(text)]
+        assert got == [("x", 1.0), ("ok_metric", 3.0)]
+
+    def test_registry_signals_scraped_equals_fast(self):
+        """RegistrySignals over a scraped body (callable source) must
+        agree with the in-process fast path — now THROUGH the shared
+        parser."""
+        reg = MetricsRegistry()
+        reg.gauge("router_queue_depth", 4, namespace="ns", service="s")
+        reg.counter_inc("router_tokens_total", by=123.0,
+                        namespace="ns", service="s")
+        fast = RegistrySignals(reg)
+        scraped = RegistrySignals(lambda: reg.render())
+        assert fast.queue_depth("ns", "s") == scraped.queue_depth("ns", "s")
+        assert fast.tokens_total("ns", "s") == \
+            scraped.tokens_total("ns", "s")
+
+    def test_router_has_no_second_parser_spelling(self):
+        """The hoist pin: serving/router.py must consume obs/expofmt
+        and may not retain (or regrow) an inline exposition parser."""
+        import inspect
+
+        from kubeflow_tpu.serving.router import RegistrySignals
+
+        src = inspect.getsource(RegistrySignals)
+        assert "expofmt" in src
+        for fragment in ('rpartition(" ")', "partition(\"{\")",
+                         "rstrip(\"}\")", 'split(",")'):
+            assert fragment not in src, (
+                f"RegistrySignals regrew inline parsing: {fragment}")
+
+
+# -- the TSDB ----------------------------------------------------------------
+
+
+class TestTimeSeriesStore:
+    def test_instant_latest_within_lookback(self):
+        st = TimeSeriesStore()
+        st.append("m", {"a": "1"}, 10.0, t=100.0)
+        st.append("m", {"a": "1"}, 20.0, t=200.0)
+        st.append("m", {"a": "2"}, 5.0, t=50.0)
+        assert st.instant("m", None, at=210.0, lookback=60.0) == \
+            [({"a": "1"}, 20.0)]
+        # a=2's point aged out of the lookback; at=49 sees nothing
+        assert st.instant("m", {"a": "2"}, at=49.0, lookback=60.0) == []
+        assert st.instant("m", {"a": "2"}, at=60.0, lookback=60.0) == \
+            [({"a": "2"}, 5.0)]
+
+    def test_ring_bounds_points(self):
+        st = TimeSeriesStore(max_points=4)
+        for i in range(10):
+            st.append("m", None, float(i), t=float(i))
+        pts = st.window("m", None, -1.0, 99.0)[0][1]
+        assert [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+
+    def test_series_cap_drops_and_counts(self):
+        st = TimeSeriesStore(max_series=2)
+        assert st.append("a", None, 1.0, 0.0)
+        assert st.append("b", None, 1.0, 0.0)
+        assert not st.append("c", None, 1.0, 0.0)  # over cap: dropped
+        assert st.append("a", None, 2.0, 1.0)      # existing: fine
+        assert st.stats()["dropped"] == 1
+        assert st.series_count() == 2
+
+    def test_real_nan_data_is_not_staleness(self):
+        """A worker legitimately exporting NaN (diverged loss) must
+        stay visible as data — only the TSDB's own marker bit pattern
+        hides a series (the Prometheus staleness convention)."""
+        st = TimeSeriesStore()
+        st.append("jaxrt_loss", {"i": "w0"}, float("nan"), t=10.0)
+        got = st.instant("jaxrt_loss", None, at=11.0)
+        assert len(got) == 1 and math.isnan(got[0][1])
+        assert not expofmt.is_stale(float("nan"))
+        assert expofmt.is_stale(expofmt.STALE_NAN)
+
+    def test_staleness_marker_hides_from_instant(self):
+        st = TimeSeriesStore()
+        st.append("m", {"i": "x"}, 3.0, t=10.0)
+        st.mark_stale(series_key("m", {"i": "x"}), t=20.0)
+        assert st.instant("m", None, at=25.0) == []
+        # range reads skip the NaN marker but keep real samples
+        assert st.window("m", None, 0.0, 30.0) == \
+            [({"i": "x"}, [(10.0, 3.0)])]
+        # fresh data after the marker revives the series
+        st.append("m", {"i": "x"}, 4.0, t=30.0)
+        assert st.instant("m", None, at=31.0) == [({"i": "x"}, 4.0)]
+
+
+# -- the scrape loop ---------------------------------------------------------
+
+
+class TestScrapeLoop:
+    def _world(self):
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("fleet_gauge", 1.0, shard="s0")
+        store = TimeSeriesStore()
+        loop = ScrapeLoop(store, targets=[
+            RegistryTarget("w0", reg, labels={"job": "worker"})],
+            clock=clock)
+        return clock, reg, store, loop
+
+    def test_ingest_attaches_instance_and_job_labels(self):
+        clock, reg, store, loop = self._world()
+        stats = loop.scrape_once()
+        assert stats["ok"] == 1 and stats["failed"] == 0
+        got = store.instant("fleet_gauge", None, at=0.0)
+        assert got == [({"instance": "w0", "job": "worker",
+                         "shard": "s0"}, 1.0)]
+        assert store.instant("up", None, at=0.0) == \
+            [({"instance": "w0", "job": "worker"}, 1.0)]
+
+    def test_scrape_op_counts_replay_exactly(self):
+        """The zero-rescan pin: identical registries scrape to
+        IDENTICAL op counts — no hidden re-reads, machine-independent
+        (the obs_bench --check gate compares these numbers)."""
+        runs = []
+        for _ in range(2):
+            clock, reg, store, loop = self._world()
+            for _ in range(3):
+                loop.scrape_once()
+                clock.advance(15.0)
+            runs.append((store.stats(), loop.stats()))
+        assert runs[0] == runs[1]
+        # exact pin: 1 gauge sample + 1 up per cycle x 3 cycles
+        assert runs[0][0]["appends"] == 6
+        assert runs[0][1] == {"scrapes": 3, "failures": 0, "samples": 3}
+
+    def test_target_loss_marks_stale_and_up_zero(self):
+        clock, reg, store, loop = self._world()
+        loop.scrape_once()
+        clock.advance(15.0)
+        loop.targets[0].fetch = lambda: (_ for _ in ()).throw(
+            ConnectionError("down"))
+        loop.scrape_once()
+        assert not loop.up("w0")
+        # the gauge is stale-marked (hidden), up reads 0
+        assert store.instant("fleet_gauge", None, at=15.0) == []
+        assert store.instant("up", None, at=15.0) == \
+            [({"instance": "w0", "job": "worker"}, 0.0)]
+        # markers land once; a second failed cycle appends only up=0
+        before = store.stats()["appends"]
+        clock.advance(15.0)
+        loop.scrape_once()
+        assert store.stats()["appends"] == before + 1
+
+    def test_never_up_target_writes_labeled_up_zero(self):
+        """A target unreachable from its FIRST scrape still produces
+        `up` with its full label set — `up{job="..."} == 0` alerting
+        must match it."""
+        clock = ManualClock()
+        store = TimeSeriesStore()
+        bad = RegistryTarget("r9", MetricsRegistry(),
+                             labels={"job": "serving"})
+        bad.fetch = lambda: (_ for _ in ()).throw(OSError("refused"))
+        loop = ScrapeLoop(store, targets=[bad], clock=clock)
+        loop.scrape_once()
+        assert store.instant("up", {"job": "serving"}, at=0.0) == \
+            [({"instance": "r9", "job": "serving"}, 0.0)]
+
+    def test_vanished_series_within_live_target_goes_stale(self):
+        """A label set the target STOPS exposing (a replica leaving a
+        gauge family) must not linger as last-known-value."""
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("inflight", 5.0, replica="r0")
+        reg.gauge("inflight", 7.0, replica="r1")
+        store = TimeSeriesStore()
+        loop = ScrapeLoop(store, targets=[RegistryTarget("x", reg)],
+                          clock=clock)
+        loop.scrape_once()
+        assert len(store.instant("inflight", None, at=0.0)) == 2
+        # registry drops r1 (fresh registry without it)
+        reg2 = MetricsRegistry()
+        reg2.gauge("inflight", 6.0, replica="r0")
+        loop.targets[0].registry = reg2
+        clock.advance(15.0)
+        loop.scrape_once()
+        got = store.instant("inflight", None, at=15.0)
+        assert [(ls["replica"], v) for ls, v in got] == [("r0", 6.0)]
+
+    def test_vanished_target_is_forgotten_and_alerts_resolve(self):
+        """A replica REMOVED from discovery (drained + deleted, gone
+        from the endpoints annotation) must stale-mark everything it
+        exposed — up included — and stop counting as a tracked target,
+        so alerts over it resolve instead of riding last-known values
+        to lookback expiry."""
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("serving_kv_pages_free", 0.0, model="m")
+        store = TimeSeriesStore()
+        fleet = [RegistryTarget("r0", reg)]
+        loop = ScrapeLoop(store, discover=lambda: list(fleet),
+                          clock=clock)
+        eng = R.RuleEngine(store, rules=[
+            R.AlertRule("KVPagesExhausted",
+                        "serving_kv_pages_free == 0", for_s=0.0)],
+            clock=clock, lookback_s=600.0)
+        loop.scrape_once()
+        assert [t["to"] for t in eng.evaluate_once()] == \
+            ["pending", "firing"]
+        fleet.clear()  # the replica leaves discovery entirely
+        clock.advance(15.0)
+        loop.scrape_once()
+        assert [t["to"] for t in eng.evaluate_once()] == ["resolved"]
+        # up is stale-marked too, and the target is no longer tracked
+        assert store.instant("up", None, at=15.0) == []
+        assert not loop.up("r0")
+
+    def test_discovery_blip_does_not_mass_forget(self):
+        """One failed discovery cycle (apiserver hiccup) must not
+        forget the fleet — that would falsely resolve live alerts and
+        reset their for-duration."""
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("serving_kv_pages_free", 0.0, model="m")
+        store = TimeSeriesStore()
+        state = {"fail": False}
+
+        def discover():
+            if state["fail"]:
+                raise ConnectionError("apiserver blip")
+            return [RegistryTarget("r0", reg)]
+
+        loop = ScrapeLoop(store, discover=discover, clock=clock)
+        loop.scrape_once()
+        assert loop.up("r0")
+        state["fail"] = True
+        clock.advance(15.0)
+        loop.scrape_once()
+        # still tracked, series still live (not stale-marked)
+        assert loop.up("r0")
+        assert store.instant("serving_kv_pages_free", None, at=15.0)
+
+    def test_never_up_target_forgotten_resolves_up_alert(self):
+        """A replica that crashlooped from provisioning onward (never
+        one good scrape) and then leaves discovery must have its
+        synthesized up=0 series stale-marked on the removal cycle."""
+        clock = ManualClock()
+        store = TimeSeriesStore()
+        bad = RegistryTarget("r9", MetricsRegistry(),
+                             labels={"job": "serving"})
+        bad.fetch = lambda: (_ for _ in ()).throw(OSError("refused"))
+        fleet = [bad]
+        loop = ScrapeLoop(store, discover=lambda: list(fleet),
+                          clock=clock)
+        loop.scrape_once()
+        assert store.instant("up", None, at=0.0) == \
+            [({"instance": "r9", "job": "serving"}, 0.0)]
+        fleet.clear()
+        clock.advance(15.0)
+        loop.scrape_once()
+        assert store.instant("up", None, at=15.0) == []
+
+    def test_http_target_over_real_metrics_endpoint(self):
+        reg = MetricsRegistry()
+        reg.gauge("served_gauge", 42.0)
+        srv = serve_metrics(port=0, registry=reg)
+        try:
+            url = f"http://127.0.0.1:{srv.server_address[1]}/metrics"
+            store = TimeSeriesStore()
+            loop = ScrapeLoop(store, targets=[HttpTarget("h0", url)],
+                              clock=ManualClock())
+            stats = loop.scrape_once()
+            assert stats["ok"] == 1
+            assert store.instant("served_gauge", None, at=0.0) == \
+                [({"instance": "h0"}, 42.0)]
+        finally:
+            srv.shutdown()
+
+    def test_jaxservice_target_discovery_from_endpoints_annotation(self):
+        from kubeflow_tpu.control.jaxservice import types as ST
+        from kubeflow_tpu.serving.router import render_endpoints
+
+        cluster = FakeCluster()
+        svc = ST.new_jaxservice("chat", model="m")
+        svc["metadata"].setdefault("annotations", {})[
+            ST.ANNOTATION_ENDPOINTS] = render_endpoints([
+                {"name": "chat-replica-0", "addr": "10.0.0.1:9000",
+                 "state": "active"},
+                {"name": "chat-replica-1", "addr": "10.0.0.2:9000",
+                 "state": "cordoned"},   # cordoned stays scraped
+                {"name": "half", "state": "active"},  # no addr: skipped
+            ])
+        cluster.create(svc)
+        # a SECOND namespace with the same service + replica names must
+        # not collide in the instance keyspace (scrape dedups on it)
+        svc_b = ST.new_jaxservice("chat", model="m", namespace="team-b")
+        svc_b["metadata"].setdefault("annotations", {})[
+            ST.ANNOTATION_ENDPOINTS] = render_endpoints(
+                [{"name": "chat-replica-0", "addr": "10.1.0.1:9000",
+                  "state": "active"}])
+        cluster.create(svc_b)
+        targets = jaxservice_targets(cluster)
+        assert [(t.instance, t.url) for t in targets] == [
+            ("default/chat-replica-0", "http://10.0.0.1:9000/metrics"),
+            ("default/chat-replica-1", "http://10.0.0.2:9000/metrics"),
+            ("team-b/chat-replica-0", "http://10.1.0.1:9000/metrics"),
+        ]
+        assert targets[0].labels["service"] == "chat"
+        assert targets[0].labels["replica"] == "chat-replica-0"
+
+    def test_discovery_through_cluster_cache_zero_list_calls(self):
+        """Steady-state discovery must read the cache's indexed
+        objects, never relist — the PR 7 op-count discipline."""
+        from kubeflow_tpu.control.cache import ClusterCache
+        from kubeflow_tpu.control.jaxservice import types as ST
+        from kubeflow_tpu.serving.router import render_endpoints
+
+        cluster = FakeCluster()
+        svc = ST.new_jaxservice("chat", model="m")
+        svc["metadata"].setdefault("annotations", {})[
+            ST.ANNOTATION_ENDPOINTS] = render_endpoints(
+                [{"name": "chat-replica-0", "addr": "10.0.0.1:9000",
+                  "state": "active"}])
+        cluster.create(svc)
+        cache = ClusterCache(cluster,
+                             kinds=((ST.API_VERSION, ST.KIND),)).connect()
+        cluster.reset_stats()
+        for _ in range(5):
+            targets = jaxservice_targets(cache)
+            assert len(targets) == 1
+        assert cluster.stats.get("list_calls", 0) == 0
+
+
+# -- PromQL-lite + rules -----------------------------------------------------
+
+
+class TestEvaluator:
+    def _store(self):
+        st = TimeSeriesStore()
+        for t in range(0, 120, 15):
+            st.append("c_total", {"svc": "a"}, float(t), t=float(t))
+            st.append("c_total", {"svc": "b"}, float(2 * t), t=float(t))
+        return st
+
+    def test_instant_and_matchers(self):
+        st = self._store()
+        ev = R.Evaluator(st)
+        assert ev.query('c_total{svc="a"}', 105.0) == [({"svc": "a"},
+                                                        105.0)]
+
+    def test_rate_and_sum_by(self):
+        st = self._store()
+        ev = R.Evaluator(st)
+        rates = dict((ls["svc"], v)
+                     for ls, v in ev.query("rate(c_total[1m])", 105.0))
+        assert rates["a"] == pytest.approx(1.0)
+        assert rates["b"] == pytest.approx(2.0)
+        total = ev.query("sum (rate(c_total[1m]))", 105.0)
+        assert total == [({}, pytest.approx(3.0))]
+
+    def test_rate_handles_counter_reset(self):
+        st = TimeSeriesStore()
+        for t, v in [(0, 0), (15, 30), (30, 5), (45, 35)]:
+            st.append("c_total", None, float(v), t=float(t))
+        ev = R.Evaluator(st)
+        # increases: 30, reset->5, +30 => 65 over 45s
+        got = ev.query("increase(c_total[1m])", 45.0)
+        assert got == [({}, pytest.approx(65.0))]
+
+    def test_arithmetic_division_by_zero_drops(self):
+        st = TimeSeriesStore()
+        st.append("num", {"k": "x"}, 4.0, t=0.0)
+        st.append("den", {"k": "x"}, 2.0, t=0.0)
+        st.append("num", {"k": "y"}, 4.0, t=0.0)
+        st.append("den", {"k": "y"}, 0.0, t=0.0)
+        ev = R.Evaluator(st)
+        assert ev.query("num / den", 0.0) == [({"k": "x"}, 2.0)]
+
+    def test_scientific_notation_thresholds_parse(self):
+        """A five-nines SLO budget interpolates as 1.0000...e-05; the
+        tokenizer must accept exponents or the strictest deployments'
+        burn rules silently never evaluate."""
+        st = TimeSeriesStore()
+        st.append("x", None, 1.0, t=0.0)
+        ev = R.Evaluator(st)
+        assert ev.query("x > 1e-05", 0.0) == [({}, 1.0)]
+        assert ev.query("x * 2E3", 0.0) == [({}, 2000.0)]
+        # the full five-nines pack must parse end-to-end
+        eng = R.RuleEngine(st, rules=R.default_rule_pack(
+            objective=0.99999), clock=lambda: 0.0)
+        eng.evaluate_once(at=0.0)
+        assert eng._failures == 0
+
+    def test_comparison_filters_and_multiwindow_and(self):
+        st = TimeSeriesStore()
+        st.append("short_burn", {"svc": "a"}, 5.0, t=0.0)
+        st.append("long_burn", {"svc": "a"}, 0.2, t=0.0)
+        st.append("short_burn", {"svc": "b"}, 5.0, t=0.0)
+        st.append("long_burn", {"svc": "b"}, 3.0, t=0.0)
+        ev = R.Evaluator(st)
+        got = ev.query("short_burn > 1 and long_burn > 1", 0.0)
+        # only b exceeds BOTH windows — the blip (a) is damped
+        assert got == [({"svc": "b"}, 5.0)]
+
+
+class TestHistogramQuantile:
+    """Satellite 4: histogram_quantile against MetricsRegistry native
+    histograms — exact-bucket-boundary, empty-histogram, and
+    counter-reset cases."""
+
+    def _scrape(self, reg, store, clock):
+        loop = ScrapeLoop(store, targets=[RegistryTarget("m", reg)],
+                          clock=clock)
+        loop.scrape_once()
+        return loop
+
+    def test_exact_bucket_boundary(self):
+        """A rank landing exactly on a cumulative bucket count returns
+        the bucket's upper bound, no interpolation overshoot."""
+        reg = MetricsRegistry()
+        for _ in range(5):
+            reg.histogram("h", 0.05, buckets=(0.1, 1.0), m="x")
+        for _ in range(5):
+            reg.histogram("h", 0.5, buckets=(0.1, 1.0), m="x")
+        store, clock = TimeSeriesStore(), ManualClock()
+        self._scrape(reg, store, clock)
+        ev = R.Evaluator(store)
+        got = ev.query("histogram_quantile(0.5, h_bucket)", 0.0)
+        assert got[0][1] == pytest.approx(0.1)
+        # interpolation inside the second bucket
+        got = ev.query("histogram_quantile(0.75, h_bucket)", 0.0)
+        assert 0.1 < got[0][1] <= 1.0
+
+    def test_empty_histogram_yields_nan_and_no_alert(self):
+        reg = MetricsRegistry()
+        reg.histogram("h", 0.2, buckets=(0.1, 1.0), m="x")
+        store, clock = TimeSeriesStore(), ManualClock()
+        self._scrape(reg, store, clock)
+        # an all-zero bucket family: synthesize via rate over ONE point
+        # (no increase -> total 0)
+        ev = R.Evaluator(store)
+        got = ev.query("histogram_quantile(0.9, rate(h_bucket[1m]))", 0.0)
+        assert len(got) == 1 and math.isnan(got[0][1])
+        eng = R.RuleEngine(store, rules=[R.AlertRule(
+            "Q", "histogram_quantile(0.9, rate(h_bucket[1m])) >= 0")],
+            clock=lambda: 0.0)
+        assert eng.evaluate_once(at=0.0) == []
+        assert eng.active_alerts() == []
+
+    def test_quantile_in_inf_bucket_reports_highest_finite_bound(self):
+        reg = MetricsRegistry()
+        reg.histogram("h", 99.0, buckets=(0.1, 1.0), m="x")  # +Inf only
+        store, clock = TimeSeriesStore(), ManualClock()
+        self._scrape(reg, store, clock)
+        got = R.Evaluator(store).query(
+            "histogram_quantile(0.5, h_bucket)", 0.0)
+        assert got[0][1] == pytest.approx(1.0)
+
+    def test_quantile_over_rate_survives_counter_reset(self):
+        """A replica restart zeroes its histogram counters mid-window;
+        rate()'s reset handling must keep the quantile sane instead of
+        producing a negative increase."""
+        store = TimeSeriesStore()
+        # cumulative bucket counts, reset between t=30 and t=45
+        series = {
+            "0.1": [(0, 10), (15, 20), (30, 30), (45, 5), (60, 15)],
+            "1.0": [(0, 20), (15, 40), (30, 60), (45, 10), (60, 30)],
+            "+Inf": [(0, 20), (15, 40), (30, 60), (45, 10), (60, 30)],
+        }
+        for le, pts in series.items():
+            for t, v in pts:
+                store.append("h_bucket", {"le": le}, float(v),
+                             t=float(t))
+        got = R.Evaluator(store).query(
+            "histogram_quantile(0.5, rate(h_bucket[1m]))", 60.0)
+        assert len(got) == 1
+        v = got[0][1]
+        assert not math.isnan(v) and 0.0 < v <= 1.0
+
+
+class TestRuleEngine:
+    def test_recording_rule_materializes_selectable_series(self):
+        store = TimeSeriesStore()
+        clock = ManualClock()
+        eng = R.RuleEngine(store, rules=[
+            R.RecordingRule("job:c:rate1m", "rate(c_total[1m])")],
+            clock=clock)
+        for t in range(0, 90, 15):
+            store.append("c_total", {"svc": "a"}, float(t * 2),
+                         t=float(t))
+            clock.t = float(t)
+            eng.evaluate_once()
+        got = eng.query("job:c:rate1m", at=75.0)
+        assert got == [({"svc": "a"}, pytest.approx(2.0))]
+
+    def test_alert_machine_pending_firing_resolved_with_events(self):
+        cluster = FakeCluster()
+        store = TimeSeriesStore()
+        clock = ManualClock()
+        eng = R.RuleEngine(
+            store,
+            rules=[R.AlertRule("HotZone", "temp > 10", for_s=30.0,
+                               summary="too hot")],
+            recorder=EventRecorder(cluster), clock=clock)
+        log = []
+        for t in range(0, 300, 15):
+            clock.t = float(t)
+            store.append("temp", {"namespace": "default", "zone": "z"},
+                         50.0 if 30 <= t <= 120 else 1.0, t=float(t))
+            for tr_ in eng.evaluate_once():
+                log.append((t, tr_["to"]))
+        assert log == [(30, "pending"), (60, "firing"),
+                       (135, "resolved")]
+        events = cluster.list("v1", "Event", namespace="default")
+        reasons = {e["reason"]: e for e in events}
+        assert reasons["AlertFiring"]["type"] == "Warning"
+        assert "HotZone" in reasons["AlertFiring"]["message"]
+        assert reasons["AlertResolved"]["type"] == "Normal"
+
+    def test_refiring_bumps_event_count_dedup(self):
+        cluster = FakeCluster()
+        store = TimeSeriesStore()
+        clock = ManualClock()
+        eng = R.RuleEngine(
+            store, rules=[R.AlertRule("Flappy", "temp > 10",
+                                      for_s=0.0)],
+            recorder=EventRecorder(cluster), clock=clock)
+        for t in range(0, 150, 15):
+            clock.t = float(t)
+            hot = (t // 30) % 2 == 0  # flaps every other pair of cycles
+            store.append("temp", {"namespace": "default"},
+                         50.0 if hot else 0.0, t=float(t))
+            eng.evaluate_once()
+        events = [e for e in cluster.list("v1", "Event",
+                                          namespace="default")
+                  if e["reason"] == "AlertFiring"]
+        # dedup: ONE Event object whose count climbed, not one per flap
+        assert len(events) == 1
+        assert events[0]["count"] >= 2
+
+    def test_pending_blip_never_fires_no_event(self):
+        cluster = FakeCluster()
+        store = TimeSeriesStore()
+        eng = R.RuleEngine(
+            store, rules=[R.AlertRule("Slow", "lat > 1", for_s=60.0)],
+            recorder=EventRecorder(cluster), clock=lambda: 0.0)
+        # hot for one cycle only — shorter than for_s
+        store.append("lat", {"namespace": "default"}, 5.0, t=0.0)
+        eng.evaluate_once(at=0.0)
+        store.append("lat", {"namespace": "default"}, 0.1, t=15.0)
+        eng.evaluate_once(at=15.0)
+        assert cluster.list("v1", "Event", namespace="default") == []
+        assert eng.active_alerts() == []
+
+    def test_alerts_series_and_registry_gauges_publish(self):
+        store = TimeSeriesStore()
+        reg = MetricsRegistry()
+        eng = R.RuleEngine(store, rules=[
+            R.AlertRule("A", "temp > 0", for_s=0.0)],
+            registry=reg, clock=lambda: 0.0)
+        store.append("temp", None, 1.0, t=0.0)
+        eng.evaluate_once(at=0.0)
+        assert store.instant("ALERTS", {"alertname": "A"}, at=0.0)
+        rendered = reg.render()
+        assert 'obs_alerts{alertname="A",state="firing"} 1' in rendered
+        assert "obs_alert_transitions_total" in rendered
+
+    def test_staleness_resolves_alert_when_target_dies(self):
+        """Satellite 4: ScrapeLoop target loss -> staleness marker ->
+        the alert over that series RESOLVES instead of firing forever
+        on the last-known-bad value."""
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("serving_kv_pages_free", 0.0, model="m")  # exhausted!
+        store = TimeSeriesStore()
+        loop = ScrapeLoop(store, targets=[
+            RegistryTarget("r0", reg)], clock=clock)
+        eng = R.RuleEngine(store, rules=[
+            R.AlertRule("KVPagesExhausted",
+                        "serving_kv_pages_free == 0", for_s=0.0)],
+            clock=clock, lookback_s=60.0)
+        loop.scrape_once()
+        trs = eng.evaluate_once()
+        assert [t["to"] for t in trs] == ["pending", "firing"]
+        # the replica dies; its gauge goes stale
+        clock.advance(15.0)
+        loop.targets[0].fetch = lambda: (_ for _ in ()).throw(
+            OSError("gone"))
+        loop.scrape_once()
+        trs = eng.evaluate_once()
+        assert [t["to"] for t in trs] == ["resolved"]
+        assert eng.active_alerts() == []
+
+
+# -- goodput (tentpole layer 3) ----------------------------------------------
+
+
+def mkspan(name, start, end, **attrs):
+    s = tr.Span(name=name, trace_id="t" * 32, span_id=tr.new_span_id(),
+                start=start, attrs=attrs)
+    s.end = end
+    return s
+
+
+class TestGoodput:
+    def test_buckets_sum_to_wall_clock(self):
+        spans = [
+            mkspan("jaxjob.provision", 1.0, 2.0),
+            mkspan("train.step", 3.0, 4.0, compile=True, step=0),
+            mkspan("train.step", 4.0, 5.0, step=1),
+            mkspan("train.checkpoint", 5.0, 5.5, step=2),
+            mkspan("train.step", 5.5, 6.5, step=2),
+        ]
+        rep = gp.account(spans, 0.0, 10.0, chips=8).check()
+        b = rep.buckets
+        assert b[gp.ADMISSION] == pytest.approx(3.0)  # 0..3 incl prov
+        assert b[gp.COMPILE] == pytest.approx(1.0)
+        assert b[gp.PRODUCTIVE] == pytest.approx(2.0)
+        assert b[gp.CHECKPOINT] == pytest.approx(0.5)
+        assert b[gp.OTHER] == pytest.approx(3.5)
+        assert rep.goodput == pytest.approx(0.2)
+        assert rep.chip_seconds_lost()[gp.ADMISSION] == pytest.approx(24.0)
+
+    def test_overlap_never_double_counts(self):
+        """A checkpoint inside a step window and nested fit/step spans
+        must resolve by priority, conserving total time."""
+        spans = [
+            mkspan("train.step", 1.0, 5.0, step=7),
+            mkspan("train.checkpoint", 2.0, 3.0, step=7),  # inside step
+            mkspan("train.step", 1.0, 5.0, step=7),        # duplicate
+        ]
+        rep = gp.account(spans, 0.0, 6.0).check()
+        assert rep.buckets[gp.PRODUCTIVE] == pytest.approx(4.0)
+        assert rep.buckets[gp.CHECKPOINT] == pytest.approx(0.0)
+
+    def test_second_provision_is_restart_rebuild(self):
+        spans = [
+            mkspan("jaxjob.provision", 0.5, 1.0),
+            mkspan("train.step", 1.0, 2.0, step=0),
+            mkspan("jaxjob.provision", 3.0, 4.0),  # the gang restart
+            mkspan("train.step", 4.0, 5.0, step=1),
+        ]
+        rep = gp.account(spans, 0.0, 5.0).check()
+        assert rep.buckets[gp.RESTART] == pytest.approx(1.0)
+        assert rep.buckets[gp.ADMISSION] == pytest.approx(1.0)
+
+    def test_resize_rebuild_classified(self):
+        spans = [
+            mkspan("train.step", 1.0, 2.0, step=0),
+            mkspan("elastic.rebuild", 2.0, 3.5, gen=2, size=2),
+            mkspan("train.step", 3.5, 4.5, step=1),
+        ]
+        rep = gp.account(spans, 1.0, 4.5).check()
+        assert rep.buckets[gp.RESIZE] == pytest.approx(1.5)
+        assert rep.buckets[gp.ADMISSION] == pytest.approx(0.0)
+
+    def test_window_clipping_and_open_spans_skipped(self):
+        open_span = tr.Span(name="train.step", trace_id="t" * 32,
+                            span_id="s" * 16, start=2.0)  # end=None
+        spans = [mkspan("train.step", 0.0, 4.0, step=0), open_span]
+        rep = gp.account(spans, 1.0, 3.0).check()
+        assert rep.wall_s == pytest.approx(2.0)
+        assert rep.buckets[gp.PRODUCTIVE] == pytest.approx(2.0)
+
+    def test_conservation_violation_raises(self):
+        rep = gp.GoodputReport(wall_s=10.0, chips=1,
+                               buckets={gp.PRODUCTIVE: 3.0,
+                                        gp.OTHER: 3.0})
+        with pytest.raises(AssertionError, match="buckets sum"):
+            rep.check()
+
+    def test_serving_slo_from_registry(self):
+        reg = MetricsRegistry()
+        for lat in [0.1] * 98 + [3.0, 4.0]:  # 98% under 0.5s
+            reg.histogram("router_request_seconds", lat,
+                          buckets=REQUEST_BUCKETS,
+                          namespace="default", service="chat")
+        slo = gp.ServingSLO(latency_target_s=0.5, objective=0.99)
+        st = slo.from_registry(reg, "default", "chat")
+        assert st["requests"] == 100
+        assert st["attainment"] == pytest.approx(0.98)
+        assert st["budget_burn"] == pytest.approx(2.0)
+        assert not st["met"]
+
+    def test_serving_slo_int_target_matches_rendered_buckets(self):
+        """The registry renders le bounds as str(float) ("1.0"); an
+        int-valued target must still count its fast samples instead of
+        reporting a false 100x burn."""
+        reg = MetricsRegistry()
+        for lat in [0.2] * 10:
+            reg.histogram("router_request_seconds", lat,
+                          buckets=REQUEST_BUCKETS,
+                          namespace="default", service="chat")
+        slo = gp.ServingSLO(latency_target_s=1, objective=0.99)  # int!
+        st = slo.from_registry(reg, "default", "chat")
+        assert st["attainment"] == pytest.approx(1.0)
+        assert st["met"]
+        # the burn expression embeds the same normalized spelling
+        assert 'le="1.0"' in R.burn_rate_expr(1, 0.99, "1m")
+
+    def test_job_report_pinned_start_with_only_open_spans(self):
+        open_span = tr.Span(name="train.step", trace_id="t" * 32,
+                            span_id="s" * 16, start=5.0)  # still open
+        rep = gp.job_report([open_span], window_start=2.0)
+        rep.check()
+        assert rep.wall_s == 0.0  # all-admission zero window, no crash
+
+    def test_serving_slo_from_store_windowed(self):
+        store = TimeSeriesStore()
+        # 10 fast then 10 slow requests across two windows
+        for t, fast, total in [(0, 0, 0), (60, 10, 10), (120, 10, 20)]:
+            store.append("router_request_seconds_bucket",
+                         {"le": "0.5", "service": "chat"}, float(fast),
+                         t=float(t))
+            store.append("router_request_seconds_count",
+                         {"service": "chat"}, float(total), t=float(t))
+        # fractional windows round instead of truncating to "[0s]" (an
+        # empty window read a burning service as trivially met)
+        empty = gp.ServingSLO().from_store(store, at=120.0,
+                                           window_s=0.4, service="chat")
+        assert empty["requests"] == 0.0
+        slo = gp.ServingSLO(latency_target_s=0.5, objective=0.9)
+        st = slo.from_store(store, at=120.0, window_s=70.0,
+                            service="chat")
+        # window (50,120]: fast 0->10... increase(fast)=10, total=20-?: (60->120): 10
+        assert st["requests"] == pytest.approx(10.0)
+        assert st["attainment"] == pytest.approx(0.0)
+        assert st["budget_burn"] == pytest.approx(10.0)
+
+
+# -- the acceptance kill drill -----------------------------------------------
+
+
+class TestKillDrill:
+    """Scripted chaos kill drill on a virtual clock: a REAL TokenRouter
+    serves healthy traffic, then a fault window (replica killed, slow
+    completions, reconcile errors) — the router-SLO and reconcile
+    alerts must FIRE during the window and RESOLVE after heal, with
+    Events through the EventRecorder. Pinned per the ISSUE acceptance
+    criteria."""
+
+    HEALTHY_LAT = 0.06
+    FAULT_LAT = 2.0
+    FAULT = range(8, 14)  # fault-window cycles (15s each)
+
+    def _drive_cycle(self, router, clock, cycle):
+        latency = self.FAULT_LAT if cycle in self.FAULT \
+            else self.HEALTHY_LAT
+        tickets = [router.submit(40) for _ in range(12)]
+        if cycle == self.FAULT.start:
+            # the kill: one replica vanishes; its in-flight work sheds
+            router.set_members([Member("r0")])
+        if cycle == self.FAULT.stop:
+            router.set_members([Member("r0"), Member("r1")])  # heal
+        clock.advance(latency)
+        for t in tickets:
+            router.complete(t)
+        clock.advance(15.0 - latency)
+
+    def test_router_slo_and_reconcile_alerts_fire_then_resolve(self):
+        clock = ManualClock()
+        cluster = FakeCluster()
+        reg = MetricsRegistry()
+        router = TokenRouter(service="chat", namespace="default",
+                             clock=clock, registry=reg, prom_sink=False,
+                             tracer=tr.Tracer())
+        router.set_members([Member("r0"), Member("r1")])
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            recorder=EventRecorder(cluster),
+            targets=[RegistryTarget("router", reg)],
+            rules=R.default_rule_pack(latency_target_s=0.5,
+                                      short_window="30s",
+                                      long_window="2m"),
+            interval_s=15.0, clock=clock)
+        by_cycle: dict[int, list] = {}
+        for cycle in range(40):
+            self._drive_cycle(router, clock, cycle)
+            # reconcile traffic: errors only inside the fault window
+            reg.counter_inc("controller_reconcile_total", by=20.0,
+                            controller="jaxjob", result="success")
+            if cycle in self.FAULT:
+                reg.counter_inc("controller_reconcile_total", by=10.0,
+                                controller="jaxjob", result="error")
+            out = plane.tick(at=clock.t)
+            for trans in out["transitions"]:
+                by_cycle.setdefault(cycle, []).append(
+                    (trans["alert"], trans["to"]))
+        flat = [(c, a, to) for c, moves in sorted(by_cycle.items())
+                for a, to in moves]
+
+        def cycle_of(alert, to):
+            return next((c for c, a, t_ in flat
+                         if a == alert and t_ == to), None)
+
+        # both alerts FIRE inside the fault window...
+        for alert in ("RouterLatencySLOBurn", "ReconcileErrorRate"):
+            fired_at = cycle_of(alert, "firing")
+            assert fired_at is not None, (alert, flat)
+            assert self.FAULT.start <= fired_at <= self.FAULT.stop, \
+                (alert, fired_at, flat)
+            # ...and RESOLVE at/after the heal cycle (the short burn
+            # window clears fast — that speed is the point of
+            # multi-window burn alerts)
+            resolved_at = cycle_of(alert, "resolved")
+            assert resolved_at is not None, (alert, flat)
+            assert resolved_at >= self.FAULT.stop, (alert, resolved_at)
+        assert plane.engine.active_alerts() == []
+        # the Events made it through the recorder, dedup'd per alert
+        events = cluster.list("v1", "Event", namespace="default")
+        reasons = [(e["reason"],
+                    e["involvedObject"]["name"]) for e in events]
+        assert ("AlertFiring", "routerlatencysloburn") in reasons
+        assert ("AlertResolved", "routerlatencysloburn") in reasons
+        assert ("AlertFiring", "reconcileerrorrate") in reasons
+        assert ("AlertResolved", "reconcileerrorrate") in reasons
+        # zero drops through the kill: the shed tickets completed
+        completed = reg.series("router_requests_total")
+        outcomes = {ls["outcome"]: v for ls, v in completed}
+        assert outcomes.get("failed", 0) == 0
+        assert outcomes["completed"] == 12 * 40
+
+
+# -- dashboard surface -------------------------------------------------------
+
+
+class TestDashboardRoutes:
+    def _dash(self):
+        from kubeflow_tpu.utils.httpd import HttpReq
+        from kubeflow_tpu.webapps.dashboard import Dashboard
+
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("router_queue_depth", 3.0, namespace="default",
+                  service="chat")
+        plane = FleetPlane(registry=MetricsRegistry(), recorder=None,
+                           targets=[RegistryTarget("router", reg)],
+                           interval_s=15.0, clock=clock,
+                           collector=tr.TraceCollector())
+        plane.tick(at=0.0)
+        router = Dashboard(FakeCluster(), plane=plane).router()
+
+        def get(path, query=None):
+            resp = router.dispatch(HttpReq(
+                method="GET", path=path, params={},
+                query=query or {},
+                headers={"kubeflow-userid": "alice@example.com"}))
+            return resp.status, json.loads(resp.body)
+
+        return get, plane
+
+    def test_api_query_evaluates_promql_lite(self):
+        get, _ = self._dash()
+        status, doc = get("/api/query",
+                          {"q": ['router_queue_depth{service="chat"}']})
+        assert status == 200
+        assert doc["result"] == [{
+            "labels": {"instance": "router", "namespace": "default",
+                       "service": "chat"}, "value": 3.0}]
+
+    def test_api_query_bad_expression_is_400(self):
+        get, _ = self._dash()
+        status, doc = get("/api/query", {"q": ["sum by ("]})
+        assert status == 400
+
+    def test_bad_numeric_params_are_400_not_500(self):
+        get, _ = self._dash()
+        assert get("/api/query", {"q": ["up"], "at": ["abc"]})[0] == 400
+        assert get("/api/goodput", {"chips": ["abc"]})[0] == 400
+        assert get("/api/goodput", {"window_s": ["x"]})[0] == 400
+
+    def test_api_alerts_and_goodput_shapes(self):
+        get, plane = self._dash()
+        status, doc = get("/api/alerts")
+        assert status == 200 and doc == {"alerts": []}
+        plane.collector.add(mkspan("train.step", 1.0, 2.0, step=0))
+        status, doc = get("/api/goodput")
+        assert status == 200
+        assert doc["training"]["goodput_pct"] == pytest.approx(100.0)
+        assert "serving" in doc
+
+
+# -- bench contract (CI ratchet) ---------------------------------------------
+
+
+class TestObsBenchContract:
+    def test_smoke_is_deterministic_and_fires_the_pack(self):
+        from tools.obs_bench import SMOKE_CONFIG, run_bench
+
+        r1 = run_bench(**SMOKE_CONFIG)
+        r2 = run_bench(**SMOKE_CONFIG)
+        # byte-stable decisions + exact scrape op counts per seed
+        assert r1["decision_fingerprint"] == r2["decision_fingerprint"]
+        assert r1["appends"] == r2["appends"]
+        assert r1["samples_total"] == r2["samples_total"]
+        assert r1["series"] == r2["series"]
+        assert r1["dropped"] == 0
+        assert r1["alerts_fired"] == [
+            "CheckpointFailures", "KVPagesExhausted",
+            "ReconcileErrorRate", "RouterLatencySLOBurn",
+            "SchedulerPassSlow"]
+        assert set(r1["alerts_resolved"]) >= {
+            "KVPagesExhausted", "ReconcileErrorRate",
+            "RouterLatencySLOBurn"}
+
+    def test_check_green_against_committed_bank(self):
+        from tools.obs_bench import DEFAULT_OUT, check_against
+
+        assert check_against(DEFAULT_OUT) == 0
+
+    def test_check_fails_on_poisoned_bank(self, tmp_path):
+        from tools.obs_bench import DEFAULT_OUT, check_against
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        bank["smoke"]["decision_fingerprint"] = "0" * 64
+        poisoned = tmp_path / "bank.json"
+        poisoned.write_text(json.dumps(bank))
+        assert check_against(str(poisoned)) == 1
+
+    def test_banked_full_run_meets_acceptance(self):
+        """The committed bank must show >=10k series with rule eval
+        inside a sane budget — the ISSUE acceptance row."""
+        from tools.obs_bench import DEFAULT_OUT
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        full = bank["full"]
+        assert full["series"] >= 10000
+        assert full["eval_p99_ms"] > 0
+        assert full["eval_p99_ms"] < 1000.0  # budget: well under 1s
+        assert full["alerts_fired"] == [
+            "CheckpointFailures", "KVPagesExhausted",
+            "ReconcileErrorRate", "RouterLatencySLOBurn",
+            "SchedulerPassSlow"]

@@ -667,7 +667,10 @@ def test_clean_fragment_stays_clean(rule, src):
 def test_at_least_ten_rules_each_with_both_cases():
     ids = {r.id for r in all_rules()}
     assert len(ids) >= 10, ids
-    assert ids == set(BAD) == set(CLEAN), (
+    # OBS302 needs an injected catalog + in-package module paths, which
+    # the generic "<corpus>" harness cannot express — its firing AND
+    # non-firing pins live in TestOBS302 below instead
+    assert ids - {"OBS302"} == set(BAD) == set(CLEAN), (
         "every registered rule needs a firing AND a non-firing corpus case")
 
 
@@ -1367,3 +1370,121 @@ def test_dyntrace_condition_and_rebind_tracking():
     assert tr.confirmed(static) == ["Q.pending"]
     div = tr.divergences(static)
     assert len(div) == 1 and div[0].startswith("Q.sealed")
+
+
+# -- OBS302: metrics-catalog drift (ISSUE 10 satellite) ----------------------
+
+
+class TestOBS302:
+    """Corpus pins for the catalog-drift rule. OBS302 is a ProgramRule
+    with path semantics (only ``kubeflow_tpu/`` registrations count)
+    and an external catalog, so it gets a dedicated harness instead of
+    the generic BAD/CLEAN tables: tests inject ``catalog_override``
+    (which also waives the full-scan size floor for the doc-side
+    direction)."""
+
+    CATALOG = """\
+## Metrics catalog
+
+| Series | Type | Labels | Meaning |
+|---|---|---|---|
+| `known_metric_total` | counter | — | documented |
+| `jaxrt_family_*` | gauge | — | dynamic family row |
+| `ghost_metric_seconds` | histogram | — | stale: nothing registers it |
+
+## Next section
+
+| `not_a_catalog_row` | x | y | tables outside the section are ignored |
+"""
+
+    @pytest.fixture(autouse=True)
+    def _catalog(self):
+        from kubeflow_tpu.analysis.core import REGISTRY
+
+        all_rules()  # REGISTRY populates lazily
+        rule = REGISTRY["OBS302"]
+        rule.catalog_override = self.CATALOG
+        try:
+            yield
+        finally:
+            rule.catalog_override = None
+
+    def _scan(self, sources):
+        from kubeflow_tpu.analysis.core import REGISTRY
+
+        return scan_sources(sources, rules=[REGISTRY["OBS302"]])
+
+    def test_uncatalogued_registration_fires(self):
+        findings = self._scan({"kubeflow_tpu.widget": """\
+from kubeflow_tpu.runtime.metrics import REGISTRY
+
+
+def publish():
+    REGISTRY.counter_inc("rogue_metric_total", by=1.0)
+"""})
+        assert [(f.rule, f.line) for f in findings] == [("OBS302", 5)]
+        assert "rogue_metric_total" in findings[0].message
+
+    def test_catalogued_registrations_clean(self):
+        findings = self._scan({"kubeflow_tpu.widget": """\
+import prometheus_client as prom
+
+from kubeflow_tpu.runtime.metrics import REGISTRY, prom_metric
+
+
+def publish(k, name, doc):
+    REGISTRY.counter_inc("known_metric_total", by=1.0)
+    REGISTRY.gauge(f"jaxrt_family_{k}", 1.0)      # glob row covers it
+    prom_metric(name, prom.Counter, doc)           # passthrough: unknowable
+"""})
+        assert findings == []
+
+    def test_outside_package_is_exempt(self):
+        findings = self._scan({"tools.bench_helper": """\
+from kubeflow_tpu.runtime.metrics import REGISTRY
+
+
+def publish():
+    REGISTRY.gauge("bench_only_metric", 1.0)
+"""})
+        assert findings == []
+
+    def test_stale_doc_row_fires_on_full_scan(self):
+        # the sentinel module marks a full-package scan: the doc-side
+        # direction runs and flags the row with no live registration
+        findings = self._scan({
+            "kubeflow_tpu.runtime.metrics": "x = 1\n",
+            "kubeflow_tpu.widget": """\
+from kubeflow_tpu.runtime.metrics import REGISTRY
+
+
+def publish(k):
+    REGISTRY.counter_inc("known_metric_total", by=1.0)
+    REGISTRY.gauge(f"jaxrt_family_{k}", 1.0)
+"""})
+        assert [(f.rule, f.path) for f in findings] == \
+            [("OBS302", "docs/observability.md")]
+        assert "ghost_metric_seconds" in findings[0].message
+        assert "no metric registration" in findings[0].message
+        # rows outside the "## Metrics catalog" section never count:
+        # not_a_catalog_row is unregistered too, yet only ghost fires
+
+    def test_partial_scan_skips_doc_side(self):
+        findings = self._scan({"kubeflow_tpu.widget": """\
+from kubeflow_tpu.runtime.metrics import REGISTRY
+
+
+def publish():
+    REGISTRY.counter_inc("known_metric_total", by=1.0)
+"""})
+        assert findings == []  # stale rows unprovable without full scan
+
+    def test_real_tree_catalog_is_in_sync(self):
+        """THE gate: the committed package and the committed catalog
+        agree in both directions (also enforced by tools/lint_all.sh
+        pass 1)."""
+        from kubeflow_tpu.analysis.core import REGISTRY, scan_paths
+
+        REGISTRY["OBS302"].catalog_override = None
+        findings = scan_paths(["kubeflow_tpu"], select={"OBS302"})
+        assert findings == []

@@ -21,7 +21,10 @@ cd "$(dirname "$0")/.."
 PY=${PYTHON:-python}
 BASELINE=tools/lint_baseline.json
 
-# pass 1: tpulint rules over the package and executable round tooling
+# pass 1: tpulint rules over the package and executable round tooling.
+# This is also the OBS302 metrics-catalog gate: the full-package scan
+# includes the sentinel module, so BOTH drift directions run (code
+# metric missing a docs/observability.md row, and stale doc rows).
 RULE_PATHS=(kubeflow_tpu tools bench.py __graft_entry__.py)
 # pass 2: stdlib hygiene (HYG001-003) over everything shipped
 HYG_PATHS=(kubeflow_tpu tools tests examples bench.py __graft_entry__.py)
