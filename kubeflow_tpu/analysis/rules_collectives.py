@@ -1,0 +1,77 @@
+"""tpulint collectives rule: COLL401 backend-encapsulation drift.
+
+``parallel/backends.py`` is the repo's ONE spelling of the multi-slice
+transport contract: the ``jax.distributed.initialize``/``shutdown``
+lifecycle pair and the ``MEGASCALE_*`` env keys libtpu's DCN transport
+reads at backend init. Every other module reaches collectives through
+``get_backend()`` (or the ``dist.initialize_from_env`` facade that
+routes into it), so swapping the backend — loopback in tests, TPU
+ICI/DCN in production — swaps EVERY formation path at once. A second
+call site or a re-spelled env key silently forks that contract: the
+loopback tier stops covering it and a backend change misses it.
+
+What fires: any call whose dotted name ends in ``distributed.initialize``
+or ``distributed.shutdown`` (``jax.distributed.initialize``,
+``from jax import distributed`` + ``distributed.shutdown``, aliased
+roots), and any string literal that IS a ``MEGASCALE_*`` env key.
+What stays silent (FP pins in tests/test_tpulint.py): the sanctioned
+``get_backend()`` route, ``JAXJOB_*`` keys, and prose that merely
+mentions megascale. ``parallel/backends.py`` itself is exempt — it is
+the contract.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import Finding, Module, Rule, dotted, register
+
+# the one module allowed to spell the transport contract
+_HOME = "parallel/backends.py"
+
+# a string literal that IS an env key of the MEGASCALE block (prose
+# mentioning megascale, regex patterns, and partial words don't match)
+_MS_KEY_RE = re.compile(r"MEGASCALE_[A-Z_]*\Z")
+
+# dotted-call suffixes of the jax.distributed lifecycle pair; the bare
+# spellings cover ``from jax import distributed`` imports
+_LIFECYCLE = ("distributed.initialize", "distributed.shutdown")
+
+
+def _exempt(path: str) -> bool:
+    return path.replace("\\", "/").endswith(_HOME)
+
+
+@register
+class CollectivesEncapsulation(Rule):
+    id = "COLL401"
+    name = "collectives-encapsulation"
+    short = ("jax.distributed lifecycle call or MEGASCALE env key outside "
+             "parallel/backends.py; route through get_backend()")
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if _exempt(module.path):
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                name = dotted(node.func)
+                if name and (name in _LIFECYCLE
+                             or name.endswith(
+                                 tuple("." + s for s in _LIFECYCLE))):
+                    yield self.finding(
+                        module, node,
+                        f"'{name}' called outside parallel/backends.py — "
+                        "the distributed lifecycle belongs to the "
+                        "collectives backend; route through "
+                        "backends.get_backend().join()/leave()")
+            elif (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and _MS_KEY_RE.fullmatch(node.value)):
+                yield self.finding(
+                    module, node,
+                    f"MEGASCALE env key '{node.value}' spelled outside "
+                    "parallel/backends.py — use backends.slice_env() / "
+                    "the MS_* constants so the transport contract has "
+                    "one spelling")

@@ -66,6 +66,13 @@ def gang_resizes():
                    labelnames=("direction",))
 
 
+def slice_resizes():
+    return _metric("jaxjob_slice_resizes_total", prom.Counter,
+                   "whole-slice elastic resizes (slice-loss shrink / "
+                   "slice-readmission grow)",
+                   labelnames=("direction",))
+
+
 def schedule_latency():
     return _metric(
         "jaxjob_gang_schedule_seconds",
@@ -118,6 +125,35 @@ def recreate_indices(pods: list[dict], replicas: int) -> list[int]:
     return [i for i in idx if i < replicas]
 
 
+def member_slice(name: str, per_slice: int) -> int:
+    """ORIGINAL slice id of a member (contiguous-rank assignment:
+    ranks [s*R, (s+1)*R) form slice s — generate_pod's layout). Derived
+    from the immutable worker index, so the id survives any shrink:
+    a world that lost slice 0 reads slices=(1, 1), never (0, 0)."""
+    return worker_index(name) // max(per_slice, 1)
+
+
+def member_slices(members, spec: dict) -> tuple[int, ...] | None:
+    """Per-member slice assignment for a world stamp; None on
+    single-slice jobs (the stamp stays byte-identical to PR 6)."""
+    if spec.get("sliceCount", 1) <= 1:
+        return None
+    per_slice = spec.get("replicas", 1)
+    return tuple(member_slice(n, per_slice) for n in members)
+
+
+def slice_aligned(names, per_slice: int) -> list[str]:
+    """The subset of ``names`` forming COMPLETE slices, ordered by
+    worker index. A multislice world only ever resizes in whole
+    slices — a partial slice can't hold its shard of the dcn axis."""
+    by_slice: dict[int, list[str]] = {}
+    for n in names:
+        by_slice.setdefault(member_slice(n, per_slice), []).append(n)
+    return sorted(
+        (n for ns in by_slice.values() if len(ns) == per_slice for n in ns),
+        key=worker_index)
+
+
 def member_coordinator(job: dict, member: str) -> str:
     """Stable DNS of a member's coordinator port (the headless-service
     name scheme the gang's env contract already uses)."""
@@ -132,21 +168,24 @@ def job_world(job: dict) -> WorldSpec:
     record a resize writes; absent (fresh job, or after a gang restart
     cleared it) the world is implicitly the full gang."""
     status = job.get("status") or {}
+    spec = job.get("spec") or {}
     w = status.get("world")
     if isinstance(w, dict):
         try:
             members = tuple(str(x) for x in w["members"])
             return WorldSpec(gen=int(w["gen"]), size=len(members),
                              members=members,
-                             coordinator=w.get("coordinator") or None)
+                             coordinator=w.get("coordinator") or None,
+                             slices=member_slices(members, spec))
         except (KeyError, TypeError, ValueError):
             pass  # malformed status residue: fall back to the full gang
     m = ob.meta(job)
-    total = T.gang_size(job.get("spec") or {})
+    total = T.gang_size(spec)
     members = tuple(worker_name(m["name"], i) for i in range(total))
     return WorldSpec(gen=status.get("resizes", 0), size=total,
                      members=members,
-                     coordinator=member_coordinator(job, members[0]))
+                     coordinator=member_coordinator(job, members[0]),
+                     slices=member_slices(members, spec))
 
 
 class JAXJobReconciler(Reconciler):
@@ -321,7 +360,16 @@ class JAXJobReconciler(Reconciler):
         if tpu.get("accelerator"):
             sel = pod_spec.setdefault("nodeSelector", {})
             sel.setdefault(T.NODESELECTOR_ACCEL, tpu["accelerator"])
-            if tpu.get("topology"):
+            if slices > 1 and spec.get("schedulerName") == SCHEDULER_NAME:
+                # multislice under OUR gang scheduler: the scheduler
+                # picks ONE (accelerator, topology) pool PER SLICE —
+                # different slices may land in different pools, so a
+                # job-wide topology pin here would overconstrain it.
+                # The accelerator selector stays (slices never mix
+                # chip generations); the per-slice topology comes out
+                # of admission, not the pod template.
+                pass
+            elif tpu.get("topology"):
                 # normalized spelling ("2X4" -> "2x4"): node labels use
                 # the canonical form, and selector matching is exact
                 try:
@@ -369,9 +417,12 @@ class JAXJobReconciler(Reconciler):
             annotations[ANNOTATION_PRIORITY] = str(spec.get("priority", 0))
             if T.is_elastic(spec):
                 # partial-admission floor: the scheduler may bind any
-                # subset >= this instead of all-or-nothing
+                # subset >= this instead of all-or-nothing. For a
+                # slice-elastic job the floor is minSlices x replicas
+                # (admission is slice-aligned); single-slice elastic
+                # keeps minReplicas — elastic_floor spells both.
                 annotations[ANNOTATION_ELASTIC_MIN] = str(
-                    T.elastic_spec(spec)["minReplicas"])
+                    T.elastic_floor(spec))
         pod = {
             "apiVersion": "v1",
             "kind": "Pod",
@@ -835,16 +886,43 @@ class JAXJobReconciler(Reconciler):
     def _elastic_shrink(self, client, job, pods, lost, recreate,
                         reason: str, message: str) -> Result | None:
         """Shrink-to-survivors, or None when a shrink is not viable
-        (survivors below minReplicas / resize ceiling spent) — the
-        caller then falls back to the restart path."""
-        el = T.elastic_spec(job["spec"])
+        (survivors below the elastic floor / resize ceiling spent) —
+        the caller then falls back to the restart path.
+
+        Slice-elastic jobs (slicePolicy Shrink) resize at SLICE
+        granularity: losing any worker condemns its WHOLE slice (the
+        slice's shard of the dcn axis is gone either way), the world
+        shrinks to the surviving complete slices, and the floor is
+        minSlices x replicas."""
+        spec = job["spec"]
+        el = T.elastic_spec(spec)
         lost_names = {ob.meta(p)["name"] for p in lost}
+        if T.is_slice_elastic(spec):
+            per_slice = spec.get("replicas", 1)
+            affected = {member_slice(n, per_slice) for n in lost_names}
+            affected |= {i // per_slice for i in recreate}
+            extra = [p for p in pods
+                     if ob.meta(p)["name"] not in lost_names
+                     and member_slice(ob.meta(p)["name"], per_slice)
+                     in affected
+                     and (p.get("status") or {}).get("phase")
+                     not in ("Succeeded", "Failed")]
+            lost = list(lost) + extra
+            lost_names |= {ob.meta(p)["name"] for p in extra}
+            # every slot of an affected slice goes back in the grow
+            # queue — a slice only ever readmits complete
+            recreate = sorted({r for s in affected
+                               for r in range(s * per_slice,
+                                              (s + 1) * per_slice)}
+                              | {i for i in recreate})
         survivors = sorted(
             (ob.meta(p)["name"] for p in pods
              if ob.meta(p)["name"] not in lost_names
              and (p.get("status") or {}).get("phase") == "Running"),
             key=worker_index)
-        if len(survivors) < el["minReplicas"]:
+        if T.is_slice_elastic(spec):
+            survivors = slice_aligned(survivors, spec.get("replicas", 1))
+        if len(survivors) < T.elastic_floor(spec):
             return None
         world = job_world(job)
         if tuple(survivors) != world.members \
@@ -865,10 +943,16 @@ class JAXJobReconciler(Reconciler):
         spec = job["spec"]
         el = T.elastic_spec(spec)
         replicas = T.gang_size(spec)
+        floor = T.elastic_floor(spec)
         world = job_world(job)
         members = set(world.members)
         running = sorted((n for n, ph in phases.items() if ph == "Running"),
                          key=worker_index)
+        if T.is_slice_elastic(spec):
+            # a multislice world only resizes in whole slices: a
+            # replacement slice joins when ALL its workers are up, and
+            # a half-admitted slice never enters the world
+            running = slice_aligned(running, spec.get("replicas", 1))
         budget_left = (job.get("status") or {}).get("resizes", 0) \
             < el["maxResizes"]
 
@@ -884,8 +968,8 @@ class JAXJobReconciler(Reconciler):
                 direction="grow")
 
         if 0 < len(running) < world.size \
-                and len(running) >= el["minReplicas"] and budget_left:
-            waiting = [n for n, ph in phases.items() if ph != "Running"]
+                and len(running) >= floor and budget_left:
+            waiting = [n for n in phases if n not in set(running)]
             if all(phases[n] == "Pending" and self._gang_gated(by_name[n])
                    for n in waiting):
                 # partial admission at start: every non-running worker
@@ -897,7 +981,7 @@ class JAXJobReconciler(Reconciler):
                     client, job, list(by_name.values()), members=running,
                     remove=[], recreate=[], reason="PartialAdmission",
                     message=f"scheduler admitted {len(running)}/{replicas} "
-                            f"workers (elastic floor {el['minReplicas']})",
+                            f"workers (elastic floor {floor})",
                     direction="shrink")
 
         if running and tuple(running) == world.members \
@@ -942,14 +1026,21 @@ class JAXJobReconciler(Reconciler):
             status = job["status"] = job.get("status") or {}
             gen = status.get("resizes", 0) + 1
             coordinator = member_coordinator(job, members[0])
+            slices = member_slices(members, spec)
+            slices_changed = slices is not None and \
+                set(slices) != set(world.slices or ())
             world = WorldSpec(gen=gen, size=len(members),
                               members=tuple(members),
-                              coordinator=coordinator)
+                              coordinator=coordinator,
+                              slices=slices)
             status["resizes"] = gen
             status["activeReplicas"] = len(members)
             status["world"] = {"gen": gen, "size": len(members),
                                "members": list(members),
                                "coordinator": coordinator}
+            if slices is not None:
+                status["world"]["slices"] = list(slices)
+                status["activeSlices"] = len(set(slices))
             full = len(members) == replicas
             ob.cond_set(job, T.COND_RESIZING,
                         "False" if full else "True", reason,
@@ -960,6 +1051,8 @@ class JAXJobReconciler(Reconciler):
             # increment
             client.update_status(job)
             gang_resizes().labels(direction=direction).inc()
+            if slices_changed:
+                slice_resizes().labels(direction=direction).inc()
             if self.record_events:
                 client.record_event(
                     job,

@@ -61,6 +61,16 @@ WORLD_FILE_PATH = "/etc/jaxjob/world"
 # Elastic resize policies (spec.elastic.resizePolicy)
 RESIZE_RESIZE = "Resize"
 RESIZE_RESTART = "Restart"
+# Slice-failure policies (spec.elastic.slicePolicy, multislice jobs):
+# worker-granular Resize cannot shrink a sliceCount > 1 gang (the dcn
+# mesh axis moves in whole slices), so slice elasticity is its own
+# knob. Shrink: losing any worker of a slice condemns exactly that
+# slice's pods and the world shrinks to the surviving slices (gen bump,
+# dcn axis shrinks, batchPolicy applies); below minSlices the normal
+# restart path takes over. Restart (default): any loss restarts the
+# whole gang — the pre-slice semantics.
+SLICE_SHRINK = "Shrink"
+SLICE_RESTART = "Restart"
 # Global-batch policies across a resize (spec.elastic.batchPolicy):
 # Preserve keeps the global batch (the loss curve is continuous);
 # Scale shrinks/grows the global batch with the world. Values are the
@@ -128,15 +138,44 @@ def elastic_spec(spec: dict) -> dict | None:
         "resizePolicy": el.get("resizePolicy", RESIZE_RESIZE),
         "batchPolicy": el.get("batchPolicy", BATCH_PRESERVE),
         "maxResizes": el.get("maxResizes", DEFAULT_MAX_RESIZES),
+        "slicePolicy": el.get("slicePolicy", SLICE_RESTART),
+        "minSlices": el.get("minSlices", 1),
     }
 
 
-def is_elastic(spec: dict) -> bool:
-    """True when the controller should resize instead of restart:
-    spec.elastic present with resizePolicy Resize (Restart keeps the
-    restart semantics while still opting into spot-pool scheduling)."""
+def is_slice_elastic(spec: dict) -> bool:
+    """True when slice loss shrinks the world instead of restarting the
+    gang: a multislice job with spec.elastic.slicePolicy Shrink."""
     el = elastic_spec(spec)
-    return bool(el and el["resizePolicy"] == RESIZE_RESIZE)
+    return bool(el and spec.get("sliceCount", 1) > 1
+                and el["slicePolicy"] == SLICE_SHRINK)
+
+
+def is_elastic(spec: dict) -> bool:
+    """True when the controller should resize instead of restart.
+    Single-slice jobs: spec.elastic with resizePolicy Resize (Restart
+    keeps restart semantics while still opting into spot-pool
+    scheduling). Multislice jobs resize at SLICE granularity only —
+    slicePolicy Shrink (worker-granular Resize cannot move the dcn
+    axis)."""
+    el = elastic_spec(spec)
+    if not el:
+        return False
+    if spec.get("sliceCount", 1) > 1:
+        return el["slicePolicy"] == SLICE_SHRINK
+    return el["resizePolicy"] == RESIZE_RESIZE
+
+
+def elastic_floor(spec: dict) -> int:
+    """The smallest world (in WORKERS) a shrink may reach: minReplicas
+    for worker-granular elasticity, minSlices x replicas for
+    slice-granular (slices shrink whole)."""
+    el = elastic_spec(spec)
+    if el is None:
+        return gang_size(spec)
+    if is_slice_elastic(spec):
+        return el["minSlices"] * spec.get("replicas", 1)
+    return el["minReplicas"]
 
 
 def new_jaxjob(
@@ -157,6 +196,8 @@ def new_jaxjob(
     elastic_min: int | None = None,
     resize_policy: str = RESIZE_RESIZE,
     batch_policy: str = BATCH_PRESERVE,
+    slice_policy: str | None = None,
+    min_slices: int | None = None,
 ) -> dict:
     """Convenience constructor (the create_job_specs.py analogue).
 
@@ -200,12 +241,17 @@ def new_jaxjob(
         spec["sliceCount"] = slice_count
     if priority:
         spec["priority"] = priority
-    if elastic_min is not None:
-        spec["elastic"] = {
-            "minReplicas": elastic_min,
-            "resizePolicy": resize_policy,
-            "batchPolicy": batch_policy,
-        }
+    if elastic_min is not None or slice_policy is not None:
+        el: dict = {}
+        if elastic_min is not None:
+            el["minReplicas"] = elastic_min
+            el["resizePolicy"] = resize_policy
+        el["batchPolicy"] = batch_policy
+        if slice_policy is not None:
+            el["slicePolicy"] = slice_policy
+        if min_slices is not None:
+            el["minSlices"] = min_slices
+        spec["elastic"] = el
     if gang_schedule:
         spec["schedulerName"] = SCHEDULER_NAME
     if accelerator:
@@ -284,12 +330,30 @@ def _validate_elastic(spec: dict) -> list[str]:
     if not _posint(el["maxResizes"]):
         errs.append(f"spec.elastic.maxResizes must be a positive int, "
                     f"got {el['maxResizes']!r}")
-    if el["resizePolicy"] == RESIZE_RESIZE and spec.get("sliceCount", 1) > 1:
-        # shrinking a multislice gang would change the dcn axis under a
-        # sharded mesh — only pure data-parallel worlds resize freely
-        errs.append("spec.elastic with resizePolicy Resize requires "
-                    "sliceCount 1 (elastic resize is data-parallel only)")
-    if el["resizePolicy"] == RESIZE_RESIZE:
+    multislice = spec.get("sliceCount", 1) > 1
+    if el["slicePolicy"] not in (SLICE_SHRINK, SLICE_RESTART):
+        errs.append(f"spec.elastic.slicePolicy must be {SLICE_SHRINK} "
+                    f"or {SLICE_RESTART}")
+    if not _posint(el["minSlices"]):
+        errs.append(f"spec.elastic.minSlices must be a positive int, "
+                    f"got {el['minSlices']!r}")
+    elif el["minSlices"] > spec.get("sliceCount", 1):
+        errs.append(f"spec.elastic.minSlices {el['minSlices']} > "
+                    f"sliceCount {spec.get('sliceCount', 1)}")
+    if (multislice and el["resizePolicy"] == RESIZE_RESIZE
+            and "slicePolicy" not in raw):
+        # the pre-slicePolicy shape: worker-granular Resize cannot
+        # shrink a multislice gang (the dcn axis moves in whole
+        # slices). Point at the migration instead of silently changing
+        # what the old spelling meant.
+        errs.append(
+            "spec.elastic on a multislice job resizes at SLICE "
+            f"granularity: add elastic.slicePolicy: {SLICE_SHRINK} to "
+            "shrink to surviving slices on slice loss (or "
+            f"{SLICE_RESTART} to keep whole-gang restarts); "
+            "worker-granular resizePolicy Resize alone is not "
+            "supported with sliceCount > 1")
+    if is_elastic(spec):
         argv = _worker_argv(spec)
         if "--" in argv and "--config" not in argv:
             # only the launcher's built-in-trainer path wires the
@@ -297,10 +361,12 @@ def _validate_elastic(spec: dict) -> list[str]:
             # see a resize — its world file updates unread while the
             # controller shrinks the gang around it
             errs.append(
-                "spec.elastic with resizePolicy Resize requires the "
-                "built-in trainer (launcher --config): a user command "
-                f"after '--' cannot follow a resize (use {RESIZE_RESTART} "
-                "for spot tolerance without in-place resize)")
+                "spec.elastic with in-place resize (resizePolicy "
+                f"{RESIZE_RESIZE} / slicePolicy {SLICE_SHRINK}) "
+                "requires the built-in trainer (launcher --config): a "
+                "user command after '--' cannot follow a resize (use "
+                f"{RESIZE_RESTART} for spot tolerance without in-place "
+                "resize)")
     return errs
 
 

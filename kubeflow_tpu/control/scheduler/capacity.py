@@ -35,6 +35,11 @@ from kubeflow_tpu.control.scheduler import nodes as N
 # holding every node, so placement stays correct, just unbucketed.
 ALL_NODES = None
 
+# best_fit(bucket_key=...) sentinel: "derive the bucket from the pod's
+# selector" (ALL_NODES/None is itself a meaningful key, so the default
+# can't be None).
+AUTO_BUCKET = object()
+
 
 def node_bucket_key(labels: dict) -> tuple | None:
     """The (accelerator, topology) pool a node belongs to, or None."""
@@ -187,12 +192,32 @@ class CapacityTxn:
         chips free the moment its eviction status lands)."""
         self._shift(name, chips)
 
-    def best_fit(self, pod: dict, need: int,
-                 prefer_spot: bool = False) -> str | None:
+    def bucket_keys(self) -> list[tuple]:
+        """The REAL (accelerator, topology) pool keys of the underlying
+        snapshot (never ALL_NODES). Pool membership is label-static, so
+        a txn's overlays can only re-sort nodes within these keys."""
+        return [k for k in self.cap.buckets if k is not ALL_NODES]
+
+    def bucket_free(self, key: tuple | None) -> int:
+        """Total free chips in one pool AS THIS TXN SEES IT — the
+        pool-level best-fit ordering key for slice-aware admission."""
+        b = self._bucket(key)
+        if b is None:
+            return 0
+        return sum(f for f, _ in b.items)
+
+    def best_fit(self, pod: dict, need: int, prefer_spot: bool = False,
+                 bucket_key=AUTO_BUCKET) -> str | None:
         """The node this pod best-fits onto, or None. Spot preference is
         a preference: when no feasible spot node has room, placement
-        falls back to the whole bucket (legacy semantics, pinned)."""
-        key = pod_bucket_key(pod)
+        falls back to the whole bucket (legacy semantics, pinned).
+
+        ``bucket_key`` confines the search to ONE explicit pool instead
+        of the pod's selector-derived bucket — slice-aware admission
+        places every worker of a slice in a single (accelerator,
+        topology) pool even when the pod's selector names no topology."""
+        key = pod_bucket_key(pod) if bucket_key is AUTO_BUCKET \
+            else bucket_key
         bucket = self._bucket(key)
         if bucket is None:
             return None

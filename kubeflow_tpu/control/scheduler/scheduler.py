@@ -554,6 +554,13 @@ class GangScheduler(Reconciler):
                 help_="gang admissions that placed workers on "
                       "spot-pool nodes",
                 namespace=entry.namespace)
+        if self._slice_groups(pending) is not None:
+            self.registry.counter_inc(
+                "scheduler_slice_admissions_total",
+                help_="multislice gang admissions placed slice-by-slice "
+                      "(one pool per slice, all-or-nothing across "
+                      "slices)",
+                namespace=entry.namespace)
         if len(assignment) < len(pending):
             if self.record_events and hasattr(client, "record_event"):
                 client.record_event(
@@ -589,7 +596,24 @@ class GangScheduler(Reconciler):
         return CP.Capacity.from_views(views, free)
 
     @staticmethod
-    def _assign(pods: list[dict], cap: CP.Capacity,
+    def _slice_groups(pods: list[dict]) -> dict[int, list[dict]] | None:
+        """Pods grouped by their slice label (JAXJob controller stamps
+        LABEL_SLICE_INDEX on sliceCount > 1 gangs), slice ids ascending;
+        None when the gang is not sliced (any pod without the label) —
+        single-slice admission stays byte-identical to the flat path."""
+        groups: dict[int, list[dict]] = {}
+        for p in pods:
+            idx = ob.labels_of(p).get(JT.LABEL_SLICE_INDEX)
+            if idx is None:
+                return None
+            try:
+                groups.setdefault(int(idx), []).append(p)
+            except ValueError:
+                return None
+        return dict(sorted(groups.items()))
+
+    @classmethod
+    def _assign(cls, pods: list[dict], cap: CP.Capacity,
                 prefer_spot: bool = False, txn: CP.CapacityTxn | None = None):
         """All-or-nothing placement: best-fit every worker or None.
         Each worker is a bisect into its pool's sorted free-capacity
@@ -600,6 +624,10 @@ class GangScheduler(Reconciler):
         copy-on-write ``CapacityTxn`` (``txn`` lets the preemption loop
         seed one with victim credits).
 
+        Sliced gangs (LABEL_SLICE_INDEX on every pod) place slice by
+        slice with same-pool-per-slice affinity — see _assign_sliced;
+        all-or-nothing still holds ACROSS slices.
+
         ``prefer_spot`` (elastic gangs): when any feasible spot node has
         room, best-fit among spot nodes only — spot capacity is
         reclaim-tolerant work's to burn, keeping on-demand pools free
@@ -607,7 +635,16 @@ class GangScheduler(Reconciler):
         full, placement falls back to any feasible node."""
         if txn is None:
             txn = cap.txn()
-        out: dict[str, str] = {}
+        groups = cls._slice_groups(pods)
+        if groups is not None:
+            out: dict[str, str] = {}
+            for spods in groups.values():
+                placed = cls._assign_slice(spods, txn, prefer_spot)
+                if placed is None:
+                    return None  # all-or-nothing across slices
+                out.update(placed)
+            return out
+        out = {}
         for pod in pods:
             need = N.pod_tpu_request(pod)
             best = txn.best_fit(pod, need, prefer_spot)
@@ -616,6 +653,50 @@ class GangScheduler(Reconciler):
             txn.take(best, need)
             out[ob.meta(pod)["name"]] = best
         return out
+
+    @classmethod
+    def _assign_slice(cls, spods: list[dict], txn: CP.CapacityTxn,
+                      prefer_spot: bool) -> dict[str, str] | None:
+        """Place ONE slice entirely inside ONE (accelerator, topology)
+        pool — the ICI domain is pool-shaped, so a slice split across
+        pools could never form its mesh. Candidate pools are walked in
+        pool-level best-fit order (ascending total free chips as this
+        txn sees them, then key, deterministic); each trial runs on a
+        FORK of the txn so a failed pool leaves no residue, and the
+        first pool that fits the whole slice is replayed onto the
+        parent txn. Different slices of one gang may land in different
+        pools (the dcn axis crosses pools; only ici stays inside one).
+
+        Nodes without BOTH pool labels live only in the catch-all
+        bucket and are never slice candidates — a slice needs a pool
+        identity to pin its topology."""
+        sel = (spods[0].get("spec") or {}).get("nodeSelector") or {}
+        accel = sel.get(JT.NODESELECTOR_ACCEL)
+        topo = sel.get(JT.NODESELECTOR_TOPOLOGY)
+        candidates = sorted(
+            (key for key in txn.bucket_keys()
+             if (accel is None or key[0] == accel)
+             and (topo is None or key[1] == topo)),
+            key=lambda k: (txn.bucket_free(k), k))
+        ordered = sorted(spods, key=cls._replica_order)
+        needs = [N.pod_tpu_request(p) for p in ordered]
+        for key in candidates:
+            trial = txn.fork()
+            placed: dict[str, str] = {}
+            for pod, need in zip(ordered, needs):
+                best = trial.best_fit(pod, need, prefer_spot,
+                                      bucket_key=key)
+                if best is None:
+                    placed = {}
+                    break
+                trial.take(best, need)
+                placed[ob.meta(pod)["name"]] = best
+            if placed:
+                # commit: replay the winning takes on the parent txn
+                for pod, need in zip(ordered, needs):
+                    txn.take(placed[ob.meta(pod)["name"]], need)
+                return placed
+        return None
 
     @staticmethod
     def _replica_order(pod: dict):
@@ -645,6 +726,30 @@ class GangScheduler(Reconciler):
             cap = CP.Capacity.from_views(cap, free)
         if floor > len(pods):
             return None
+        groups = self._slice_groups(pods)
+        if groups is not None:
+            # slice-elastic: the world only ever holds COMPLETE slices,
+            # so the admitted subset is a prefix of whole slices (lowest
+            # slice ids first — slice 0 carries worker 0, the
+            # coordinator pick). Same monotone binary search, over
+            # slice count instead of worker count.
+            sids = sorted(groups)
+            per = max(len(g) for g in groups.values())
+            floor_slices = max(1, -(-floor // per))
+            if floor_slices > len(sids):
+                return None
+            best = None
+            lo, hi = floor_slices, len(sids) - 1
+            while lo <= hi:
+                mid = (lo + hi) // 2
+                subset = [p for s in sids[:mid] for p in groups[s]]
+                a = self._assign(subset, cap, prefer_spot=True)
+                if a is not None:
+                    best = a
+                    lo = mid + 1
+                else:
+                    hi = mid - 1
+            return best
         pods = sorted(pods, key=self._replica_order)
         best = None
         lo, hi = floor, len(pods) - 1

@@ -118,19 +118,13 @@ class DistConfig:
 def slice_env(num_slices: int, slice_id: int,
               coordinator_address: str | None) -> dict[str, str]:
     """Multislice env block: the JAXJOB_* contract plus the MEGASCALE_*
-    vars libtpu's DCN transport reads at backend init. The megascale
-    coordinator rides the same host as the jax.distributed one."""
-    env = {
-        ENV_NUM_SLICES: str(num_slices),
-        ENV_SLICE_ID: str(slice_id),
-        "MEGASCALE_NUM_SLICES": str(num_slices),
-        "MEGASCALE_SLICE_ID": str(slice_id),
-        "MEGASCALE_PORT": str(MEGASCALE_PORT),
-    }
-    host = (coordinator_address or "").partition(":")[0]
-    if host:
-        env["MEGASCALE_COORDINATOR_ADDRESS"] = f"{host}:{MEGASCALE_PORT}"
-    return env
+    vars libtpu's DCN transport reads at backend init. The spelling
+    lives in parallel/backends.py (the ONE module allowed to name the
+    MEGASCALE keys — tpulint COLL401); this delegator keeps the
+    jax-free import surface the controller relies on."""
+    from kubeflow_tpu.parallel import backends as B
+
+    return B.slice_env(num_slices, slice_id, coordinator_address)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -143,13 +137,17 @@ class WorldSpec:
     world: a member's rank is its position, and the coordinator is
     members[0]'s stable DNS address. ``gen`` increments with every
     resize, so a worker distinguishes 4→2→4 from never having resized.
-    This is the ONE spelling of the resize wire contract — the
-    controller writes it, runtime/elastic.py reads it."""
+    ``slices`` (multi-slice jobs only) is each member's slice id,
+    aligned with ``members`` — slice identity survives a shrink, so a
+    2-slice world that lost slice 0 reads slices=(1, 1), not a
+    renumbered (0, 0). This is the ONE spelling of the resize wire
+    contract — the controller writes it, runtime/elastic.py reads it."""
 
     gen: int
     size: int
     members: tuple[str, ...]
     coordinator: str | None = None
+    slices: tuple[int, ...] | None = None
 
     def rank_of(self, name: str) -> int | None:
         """This worker's rank in the current world; None = not a member
@@ -159,11 +157,26 @@ class WorldSpec:
         except ValueError:
             return None
 
+    @property
+    def num_slices(self) -> int:
+        """Distinct surviving slices (1 when the world is single-slice
+        or predates slice stamping)."""
+        return len(set(self.slices)) if self.slices else 1
+
+    def slice_of(self, name: str) -> int | None:
+        """The member's ORIGINAL slice id (None when untracked)."""
+        rank = self.rank_of(name)
+        if rank is None or not self.slices:
+            return None
+        return self.slices[rank]
+
     def to_json(self) -> str:
         return json.dumps({
             "gen": self.gen, "size": self.size,
             "members": list(self.members),
             **({"coordinator": self.coordinator} if self.coordinator
+               else {}),
+            **({"slices": list(self.slices)} if self.slices is not None
                else {}),
         }, sort_keys=True)
 
@@ -177,12 +190,18 @@ class WorldSpec:
         try:
             d = json.loads(text)
             members = tuple(str(m) for m in d["members"])
+            slices = d.get("slices")
+            if slices is not None:
+                slices = tuple(int(s) for s in slices)
             spec = cls(gen=int(d["gen"]), size=int(d["size"]),
                        members=members,
-                       coordinator=d.get("coordinator") or None)
+                       coordinator=d.get("coordinator") or None,
+                       slices=slices)
         except (ValueError, TypeError, KeyError):
             return None
         if spec.size != len(members) or spec.gen < 0:
+            return None
+        if spec.slices is not None and len(spec.slices) != spec.size:
             return None
         return spec
 
@@ -218,7 +237,8 @@ def wait_for_coordinator(address: str, timeout_s: float = 300.0) -> None:
 # (same world — idempotent) or tears the prior state down first.
 _WORLD_LOCK = threading.RLock()
 _ACTIVE: DistConfig | None = None
-_DIST_LIVE = False  # jax.distributed.initialize was called by this module
+_DIST_LIVE = False  # the active backend holds live world state
+_BACKEND = None     # the CollectivesBackend that formed the active world
 
 
 class WorldTeardownError(RuntimeError):
@@ -244,32 +264,42 @@ def active_world() -> DistConfig | None:
 
 
 def _jax_initialize(cfg: DistConfig) -> None:
-    import jax  # deferred: must happen before any backend init
+    """Monkeypatchable seam (tests fake world formation here). The real
+    jax.distributed call lives in parallel/backends.py — the ONE module
+    allowed to spell it (tpulint COLL401)."""
+    from kubeflow_tpu.parallel import backends as B
 
-    jax.distributed.initialize(
-        coordinator_address=cfg.coordinator_address,
-        num_processes=cfg.num_processes,
-        process_id=cfg.process_id,
-    )
+    B._raw_jax_initialize(cfg)
 
 
 def _jax_shutdown() -> None:
-    import jax
+    from kubeflow_tpu.parallel import backends as B
 
-    jax.distributed.shutdown()
+    B._raw_jax_shutdown()
+
+
+def active_backend():
+    """The CollectivesBackend that formed the active world (None before
+    the first initialize_from_env)."""
+    with _WORLD_LOCK:
+        return _BACKEND
 
 
 def _teardown_locked() -> None:
-    global _ACTIVE, _DIST_LIVE
+    global _ACTIVE, _DIST_LIVE, _BACKEND
     if _DIST_LIVE:
         try:
-            _jax_shutdown()
+            if _BACKEND is not None:
+                _BACKEND.leave()
+            else:
+                _jax_shutdown()
         except Exception as e:
             raise WorldTeardownError(
-                f"could not shut down the previous jax.distributed world "
+                f"could not shut down the previous distributed world "
                 f"({_ACTIVE}): {type(e).__name__}: {e}") from e
         _DIST_LIVE = False
     _ACTIVE = None
+    _BACKEND = None
 
 
 def shutdown() -> None:
@@ -292,14 +322,22 @@ def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True)
     rank, slices) is an idempotent no-op; a CHANGED world first tears
     down the prior distributed state (raising WorldTeardownError if that
     fails) and then forms the new one — the elastic resize path.
+
+    Formation is delegated to the selected CollectivesBackend
+    (env JAXJOB_COLLECTIVES_BACKEND ∈ {single, loopback, tpu};
+    parallel/backends.py). The default (single) is byte-compatible with
+    the pre-backend behavior.
     """
+    from kubeflow_tpu.parallel import backends as B
+
     cfg = DistConfig.from_env(env)
     if cfg.distributed and cfg.coordinator_address is None:
         # validate before touching world state: a bad env must not tear
         # down a healthy world
         raise ValueError(f"{ENV_NPROC}>1 but {ENV_COORD} unset")
+    backend = B.get_backend(env=env)
     with _WORLD_LOCK:
-        global _ACTIVE, _DIST_LIVE
+        global _ACTIVE, _DIST_LIVE, _BACKEND
         if _ACTIVE is not None:
             if _world_key(cfg) == _world_key(_ACTIVE):
                 _ACTIVE = cfg  # refresh metadata (job name etc.)
@@ -307,23 +345,8 @@ def initialize_from_env(env: dict[str, str] | None = None, *, wait: bool = True)
             log.info("world changed (%s -> %s): tearing down prior state",
                      _world_key(_ACTIVE), _world_key(cfg))
             _teardown_locked()
-        if cfg.multislice:
-            # libtpu reads MEGASCALE_* at backend init; when only the
-            # JAXJOB_* contract is present (bare launch, tests) derive
-            # them here so the DCN transport still configures itself
-            # before jax imports
-            for k, v in cfg.to_env().items():
-                if k.startswith("MEGASCALE_"):
-                    os.environ.setdefault(k, v)
-        if cfg.distributed:
-            if wait and cfg.process_id != 0:
-                wait_for_coordinator(cfg.coordinator_address)
-            log.info(
-                "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
-                cfg.coordinator_address, cfg.num_processes, cfg.process_id,
-            )
-            _jax_initialize(cfg)
-            _DIST_LIVE = True
+        _DIST_LIVE = backend.join(cfg, wait=wait)
+        _BACKEND = backend if _DIST_LIVE else None
         _ACTIVE = cfg
     return cfg
 

@@ -24,6 +24,15 @@ The GPipe bubble is (pp-1)/(ticks) — amortized by raising
 `n_microbatches`. Backward runs through the scan transpose automatically;
 activations for the backward pass can be rematerialized per-tick with the
 model's usual remat flag.
+
+Multi-slice placement: on a multislice deployment the `pipe` axis may be
+laid OVER the DCN boundary so each slice holds whole pipeline stages and
+only the per-tick stage handoff (one activation shift) crosses DCN — the
+classic stages-across-pods shape. That is purely a mesh-construction
+concern: pass ``dcn_pipeline_levels()`` (or set JAXJOB_MESH_DCN_AXES=pipe)
+to the backend's mesh builder (``parallel/backends.build_level_mesh``)
+and this module runs unchanged — the axes→levels map IS the placement
+policy, there is no second pipeline code path.
 """
 
 from __future__ import annotations
@@ -44,6 +53,16 @@ from kubeflow_tpu.parallel.mesh import (
 
 # Activation-buffer layout: [stage, microbatch, seq, features]
 STATE_SPEC = P(AXIS_PIPELINE, BATCH_AXES, AXIS_SEQ, None)
+
+
+def dcn_pipeline_levels() -> dict[str, str]:
+    """The mesh-axes→levels map for stages-across-slices: `pipe` rides
+    DCN (stage handoff is the only cross-slice traffic), everything
+    else stays ICI. Feed to CollectivesBackend.mesh(levels=...)."""
+    from kubeflow_tpu.parallel import backends as B
+    from kubeflow_tpu.parallel.mesh import AXIS_DCN
+
+    return {AXIS_DCN: B.LEVEL_DCN, AXIS_PIPELINE: B.LEVEL_DCN}
 
 
 class SPMDPipeline(nn.Module):

@@ -110,7 +110,7 @@ class Checkpointer:
     """
 
     def __init__(self, directory: str, keep: int = 3, async_save: bool = True,
-                 world_size: int | None = None):
+                 world_size: int | None = None, num_slices: int | None = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -135,6 +135,12 @@ class Checkpointer:
         # mesh) — this is provenance, not a restore precondition.
         self.world_size = world_size
         self._world_sizes: dict[int, int] = {}
+        # multi-slice provenance (same contract as world_size): the
+        # slice count each step was saved at, so "this resume reshards
+        # 2 slices -> 1" reads from the manifest. Restore stays
+        # slice-agnostic — resharding is the template-mesh path.
+        self.num_slices = num_slices
+        self._slice_counts: dict[int, int] = {}
         self._mgr = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -192,6 +198,8 @@ class Checkpointer:
         if saved:
             if self.world_size:
                 self._world_sizes[int(step)] = self.world_size
+            if self.num_slices:
+                self._slice_counts[int(step)] = self.num_slices
             log.info("checkpoint: queued save at step %d -> %s", step, self.directory)
         return bool(saved)
 
@@ -286,11 +294,24 @@ class Checkpointer:
             mine = getattr(self, "_world_sizes", {})
             sizes.update({str(s): w for s, w in mine.items()
                           if s in steps})
+            slice_counts: dict[str, int] = {}
+            try:
+                with open(path) as f:
+                    prior = json.load(f).get("slice_counts") or {}
+                slice_counts = {k: v for k, v in prior.items()
+                                if k.isdigit() and int(k) in steps}
+            except (OSError, ValueError, AttributeError, TypeError):
+                pass
+            slice_counts.update(
+                {str(s): n for s, n in
+                 getattr(self, "_slice_counts", {}).items() if s in steps})
             atomic_write_text(
                 path,
                 json.dumps({"latest_step": steps[-1] if steps else None,
                             "steps": steps,
-                            "world_sizes": sizes}, sort_keys=True) + "\n")
+                            "world_sizes": sizes,
+                            "slice_counts": slice_counts},
+                           sort_keys=True) + "\n")
         except OSError as e:
             log.warning("checkpoint: manifest write failed: %s", e)
 

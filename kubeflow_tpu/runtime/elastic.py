@@ -132,7 +132,14 @@ class ElasticCoordinator:
     def world_env(self, world: D.WorldSpec,
                   base_env: dict | None = None) -> dict:
         """The JAXJOB_* env describing this worker's place in ``world``
-        (rank = membership position, coordinator = members[0])."""
+        (rank = membership position, coordinator = members[0]).
+
+        Slice-stamped worlds additionally override the pod's static
+        JAXJOB_NUM_SLICES/SLICE_ID: after a slice shrink the SURVIVING
+        slice set is smaller than the pod env's full-gang values, and
+        the backend must re-form (and lay the dcn mesh axis) over
+        survivors only. Slice ranks are renumbered dense (original ids
+        stay in the world stamp; the env is the backend's view)."""
         env = dict(os.environ if base_env is None else base_env)
         env[D.ENV_NPROC] = str(world.size)
         if self.my_name is None:
@@ -146,6 +153,10 @@ class ElasticCoordinator:
         env[D.ENV_PID] = str(rank)
         if world.coordinator:
             env[D.ENV_COORD] = world.coordinator
+        if world.slices is not None:
+            survivors = sorted(set(world.slices))
+            env[D.ENV_NUM_SLICES] = str(len(survivors))
+            env[D.ENV_SLICE_ID] = str(survivors.index(world.slices[rank]))
         return env
 
     def _default_form_world(self, world: D.WorldSpec) -> None:

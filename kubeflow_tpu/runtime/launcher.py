@@ -168,7 +168,10 @@ def main(argv: list[str] | None = None) -> int:
 
         jax.config.update("jax_platforms", plat)
 
+    from kubeflow_tpu.parallel import backends as B
     from kubeflow_tpu.parallel import dist as D
+
+    log.info("collectives backend: %s", B.get_backend().name)
 
     # Adopt the job's trace context before any spans open: the JAXJob
     # controller stamped TRACEPARENT into the pod env, and attaching it

@@ -203,7 +203,17 @@ class Trainer:
 
     def __init__(self, cfg: TrainConfig, mesh=None):
         self.cfg = cfg
-        self.mesh = mesh if mesh is not None else build_mesh(cfg.mesh)
+        if mesh is None:
+            # default mesh construction rides the selected collectives
+            # backend (parallel/backends.py): ONE placement code path,
+            # parameterized by the mesh-axes→levels map. The default
+            # (single) backend with the default map is build_mesh
+            # byte-for-byte; loopback/tpu lay DCN-level axes over the
+            # slice boundary.
+            from kubeflow_tpu.parallel import backends as B
+
+            mesh = B.get_backend().mesh(cfg.mesh)
+        self.mesh = mesh
         log.info("trainer mesh: %s", mesh_summary(self.mesh))
         # LM models remat per-block inside the model (see _model_kwargs);
         # everything else gets whole-forward jax.checkpoint in _build.
@@ -679,8 +689,12 @@ class Trainer:
         if cfg.checkpoint_dir:
             from kubeflow_tpu.runtime.checkpoint import Checkpointer
 
+            from kubeflow_tpu.parallel import dist as D
+
+            world = D.active_world()
             ckpt = Checkpointer(cfg.checkpoint_dir, keep=cfg.checkpoint_keep,
-                                world_size=jax.process_count())
+                                world_size=jax.process_count(),
+                                num_slices=world.num_slices if world else 1)
             if cfg.resume:
                 restored = ckpt.restore_latest(state)
                 if restored is not None:

@@ -25,20 +25,32 @@ jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from kubeflow_tpu.parallel import backends as B  # noqa: E402
+from kubeflow_tpu.parallel import dist as D  # noqa: E402
 from kubeflow_tpu.parallel.dist import initialize_from_env  # noqa: E402
 
 
 def main() -> int:
     dist = initialize_from_env()
-    # the world-formation proof: every process sees every process's
-    # devices (ranks that failed to join would leave this at 1)
-    assert jax.device_count() == dist.num_processes, \
-        (jax.device_count(), dist.num_processes)
-    assert jax.process_count() == dist.num_processes
+    if isinstance(D.active_backend(), B.LoopbackBackend):
+        # tier-1 mode: the TCP join barrier only releases once every
+        # rank has checked in, so reaching this line IS the formation
+        # proof; the world stamp carries the agreed size
+        world = D.active_world()
+        assert world is not None, "loopback world did not form"
+        size = world.num_processes
+    else:
+        # real jax.distributed: every process sees every process's
+        # devices (ranks that failed to join would leave this at 1)
+        assert jax.device_count() == dist.num_processes, \
+            (jax.device_count(), dist.num_processes)
+        assert jax.process_count() == dist.num_processes
+        size = jax.device_count()
+    assert size == dist.num_processes, (size, dist.num_processes)
 
     with open(os.environ["GANG_LOG"], "a") as f:
         f.write(json.dumps({"rank": dist.process_id,
-                            "world": jax.device_count()}) + "\n")
+                            "world": size}) + "\n")
     return 0
 
 

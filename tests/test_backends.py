@@ -1,0 +1,353 @@
+"""Collectives backend protocol (ISSUE 12 tentpole, parallel/backends.py).
+
+Four contract surfaces, all hermetic on the conftest 8-device CPU mesh:
+
+- **selection**: the JAXJOB_COLLECTIVES_BACKEND registry — default
+  ``single`` (byte-compatible), explicit name > caller env > process
+  env, unknown names rejected loudly;
+- **level-mapped meshes**: axes mapped to LEVEL_DCN lay outermost on
+  slice boundaries; the degenerate map reproduces ``mesh.build_mesh``
+  exactly; JAXJOB_MESH_DCN_AXES rides extra axes (``pipe``) over DCN;
+- **loopback formation**: the TCP join barrier forms/blocks/tears down
+  real multi-process worlds with sockets only (no multiprocess jax —
+  this image's CPU backend cannot run it), and in-process slice
+  partitioning drives the dcn axis;
+- **reduction equivalence**: the hierarchical reduce-scatter →
+  all-reduce → all-gather shape is numerically the flat psum, and a
+  model trained under Single vs Loopback(1 slice) lands on IDENTICAL
+  params (the backend-equivalence property the elastic plane leans on).
+"""
+
+import socket
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from kubeflow_tpu.parallel import backends as B
+from kubeflow_tpu.parallel import dist as D
+from kubeflow_tpu.parallel import mesh as M
+
+
+@pytest.fixture(autouse=True)
+def clean_world(monkeypatch):
+    """Backend selection rides env vars and dist holds module world
+    state — isolate both so tests compose in any order."""
+    monkeypatch.delenv(B.ENV_BACKEND, raising=False)
+    monkeypatch.delenv(B.ENV_DCN_AXES, raising=False)
+    yield
+    D.shutdown()
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+# -- selection ---------------------------------------------------------------
+
+
+class TestSelection:
+    def test_default_is_single_and_a_singleton(self):
+        bk = B.get_backend()
+        assert isinstance(bk, B.SingleBackend)
+        assert bk.name == B.BACKEND_SINGLE
+        assert B.get_backend() is bk
+
+    def test_every_contract_name_resolves(self):
+        for name in (B.BACKEND_SINGLE, B.BACKEND_LOOPBACK, B.BACKEND_TPU):
+            assert B.get_backend(name).name == name
+
+    def test_process_env_selects(self, monkeypatch):
+        monkeypatch.setenv(B.ENV_BACKEND, B.BACKEND_LOOPBACK)
+        assert isinstance(B.get_backend(), B.LoopbackBackend)
+
+    def test_caller_env_beats_process_env(self, monkeypatch):
+        monkeypatch.setenv(B.ENV_BACKEND, B.BACKEND_LOOPBACK)
+        bk = B.get_backend(env={B.ENV_BACKEND: B.BACKEND_TPU})
+        assert isinstance(bk, B.TpuIciDcnBackend)
+
+    def test_explicit_name_beats_everything(self, monkeypatch):
+        monkeypatch.setenv(B.ENV_BACKEND, B.BACKEND_TPU)
+        bk = B.get_backend(B.BACKEND_SINGLE,
+                           env={B.ENV_BACKEND: B.BACKEND_LOOPBACK})
+        assert isinstance(bk, B.SingleBackend)
+
+    def test_unknown_backend_rejected_loudly(self):
+        with pytest.raises(ValueError, match="known"):
+            B.get_backend("nccl")
+
+
+# -- the mesh-axes→levels map ------------------------------------------------
+
+
+class TestLevelMap:
+    def test_default_map_is_dcn_only(self):
+        assert B.get_backend().level_map(env={}) == {M.AXIS_DCN: B.LEVEL_DCN}
+
+    def test_env_rides_extra_axes_over_dcn(self):
+        lv = B.get_backend().level_map(env={B.ENV_DCN_AXES: "pipe, seq"})
+        assert lv[M.AXIS_PIPELINE] == B.LEVEL_DCN
+        assert lv[M.AXIS_SEQ] == B.LEVEL_DCN
+        assert lv[M.AXIS_DCN] == B.LEVEL_DCN
+
+    def test_dcn_axes_parsing(self):
+        assert B.dcn_axes_from_env({}) == ()
+        assert B.dcn_axes_from_env({B.ENV_DCN_AXES: ""}) == ()
+        assert B.dcn_axes_from_env({B.ENV_DCN_AXES: " pipe ,expert"}) == \
+            ("pipe", "expert")
+
+
+class TestLevelMesh:
+    def test_degenerate_map_is_byte_compatible(self, devices8):
+        """The default map must reproduce mesh.build_mesh EXACTLY —
+        same device ids in the same positions (the single-slice
+        byte-compat guarantee)."""
+        spec = M.MeshSpec(dcn=2, data=4)
+        got = B.build_level_mesh(spec, devices8)
+        want = M.build_mesh(spec, devices8)
+        np.testing.assert_array_equal(
+            np.vectorize(lambda d: d.id)(got.devices),
+            np.vectorize(lambda d: d.id)(want.devices))
+
+    def test_pipe_over_dcn_falls_on_slice_boundaries(self, devices8):
+        """pipe mapped to LEVEL_DCN lays pipeline stages OUTERMOST: with
+        contiguous-rank slices, stage 0 is slice {0..3} and stage 1 is
+        slice {4..7} — the pipe-axis-over-dcn placement the pipeline
+        runtime selects for cross-slice stages."""
+        mesh = B.build_level_mesh(
+            M.MeshSpec(data=2, pipe=2, model=2), devices8,
+            levels={M.AXIS_PIPELINE: B.LEVEL_DCN})
+        assert mesh.shape[M.AXIS_PIPELINE] == 2
+        devs = mesh.devices  # (dcn, data, fsdp, pipe, expert, seq, model)
+        stage0 = {d.id for d in devs[:, :, :, 0].flat}
+        stage1 = {d.id for d in devs[:, :, :, 1].flat}
+        assert stage0 == {0, 1, 2, 3} and stage1 == {4, 5, 6, 7}
+
+    def test_dcn_stays_outermost_of_the_dcn_level(self, devices8):
+        """With dcn AND pipe both at LEVEL_DCN, dcn is still the
+        outermost: slice = dcn group, stages split inside it."""
+        mesh = B.build_level_mesh(
+            M.MeshSpec(dcn=2, data=2, pipe=2), devices8,
+            levels={M.AXIS_PIPELINE: B.LEVEL_DCN})
+        devs = mesh.devices
+        dcn0 = {d.id for d in devs[0].flat}
+        assert dcn0 == {0, 1, 2, 3}
+        stage0_in_dcn0 = {d.id for d in devs[0, :, :, 0].flat}
+        assert stage0_in_dcn0 == {0, 1}
+
+    def test_backend_mesh_honors_dcn_axes_env(self, monkeypatch, devices8):
+        """End to end through the backend: JAXJOB_MESH_DCN_AXES=pipe
+        changes placement without touching any call site."""
+        monkeypatch.setenv(B.ENV_DCN_AXES, "pipe")
+        mesh = B.SingleBackend().mesh(
+            M.MeshSpec(data=4, pipe=2), devices8)
+        stage0 = {d.id for d in mesh.devices[:, :, :, 0].flat}
+        assert stage0 == {0, 1, 2, 3}
+
+
+# -- loopback formation ------------------------------------------------------
+
+
+class TestLoopbackFormation:
+    def test_slice_groups_partition(self, devices8):
+        groups = B.LoopbackBackend.slice_groups(devices8, 2)
+        assert [len(g) for g in groups] == [4, 4]
+        assert [d.id for d in groups[0]] == [0, 1, 2, 3]
+        with pytest.raises(ValueError, match="partition"):
+            B.LoopbackBackend.slice_groups(devices8, 3)
+
+    def test_tcp_barrier_forms_a_three_rank_world(self, monkeypatch):
+        """Rank 0 binds the coordinator port and releases nobody until
+        every peer checked in — real gang-formation semantics over plain
+        sockets. All three joins return live state; leave() is
+        idempotent."""
+        monkeypatch.setenv(B.ENV_LOOPBACK_JOIN_TIMEOUT, "10")
+        port = _free_port()
+        backends = [B.LoopbackBackend() for _ in range(3)]
+        cfgs = [D.DistConfig(coordinator_address=f"127.0.0.1:{port}",
+                             num_processes=3, process_id=i)
+                for i in range(3)]
+        results: dict[int, bool] = {}
+        errors: list[BaseException] = []
+
+        def join(rank):
+            try:
+                results[rank] = backends[rank].join(cfgs[rank])
+            except BaseException as e:  # surfaced below, not swallowed
+                errors.append(e)
+
+        threads = [threading.Thread(target=join, args=(r,), daemon=True)
+                   for r in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15)
+        assert not errors, errors
+        assert results == {0: True, 1: True, 2: True}
+        for bk in backends:
+            bk.leave()
+            bk.leave()  # idempotent
+
+    def test_barrier_blocks_until_timeout_without_peers(self, monkeypatch):
+        """A missing peer blocks the gang — rank 0 must NOT release a
+        partial world."""
+        monkeypatch.setenv(B.ENV_LOOPBACK_JOIN_TIMEOUT, "0.6")
+        cfg = D.DistConfig(
+            coordinator_address=f"127.0.0.1:{_free_port()}",
+            num_processes=2, process_id=0)
+        bk = B.LoopbackBackend()
+        with pytest.raises(TimeoutError, match="peers"):
+            bk.join(cfg)
+
+    def test_multislice_world_needs_no_sockets(self):
+        """num_slices>1 in ONE process is the in-process slice world:
+        join holds live state (teardown must run) but opens nothing."""
+        bk = B.LoopbackBackend()
+        cfg = D.DistConfig(coordinator_address=None, num_processes=1,
+                           process_id=0, num_slices=2, slice_id=0)
+        assert bk.join(cfg) is True
+        bk.leave()
+
+    def test_form_reshape_teardown_lifecycle(self, devices8):
+        """The full protocol surface the elastic coordinator drives:
+        form a 2-slice world (dcn=2 mesh on the slice partition),
+        reshape to 1 slice through the same code path, tear down."""
+        lb = B.get_backend(B.BACKEND_LOOPBACK)
+        env = {B.ENV_BACKEND: B.BACKEND_LOOPBACK, D.ENV_NPROC: "1",
+               D.ENV_NUM_SLICES: "2", D.ENV_SLICE_ID: "0"}
+        mesh = lb.form(env)
+        assert mesh.shape[M.AXIS_DCN] == 2
+        assert D.active_world().num_slices == 2
+        assert D.active_backend() is lb
+        mesh1 = lb.reshape({B.ENV_BACKEND: B.BACKEND_LOOPBACK,
+                            D.ENV_NPROC: "1"})
+        assert mesh1.shape[M.AXIS_DCN] == 1
+        assert D.active_world().num_slices == 1
+        lb.teardown()
+        assert D.active_world() is None
+
+    def test_dist_routes_through_selected_backend(self):
+        """dist.initialize_from_env hands world formation to the env's
+        backend — the ONE seam COLL401 funnels every caller through."""
+        env = {B.ENV_BACKEND: B.BACKEND_LOOPBACK, D.ENV_NPROC: "1",
+               D.ENV_NUM_SLICES: "2", D.ENV_SLICE_ID: "1"}
+        cfg = D.initialize_from_env(env)
+        assert cfg.multislice and cfg.slice_id == 1
+        assert isinstance(D.active_backend(), B.LoopbackBackend)
+
+
+# -- reduction equivalence ---------------------------------------------------
+
+
+def _reduce_under(bk, mesh, x):
+    """Run bk.hierarchical_reduce over a (dcn, data)-sharded tree inside
+    shard_map; the result is replicated (it is a global sum)."""
+    def body(xl):
+        return bk.hierarchical_reduce({"g": xl})["g"]
+
+    # check_rep=False: the psum_scatter→psum→all_gather chain IS fully
+    # replicated, but shard_map's static rep-checker can't prove it
+    return shard_map(body, mesh=mesh,
+                     in_specs=P((M.AXIS_DCN, M.AXIS_DATA)),
+                     out_specs=P(), check_rep=False)(x)
+
+
+class TestHierarchicalReduce:
+    """reduce-scatter(ici) → all-reduce(dcn) → all-gather(ici) must be
+    numerically the flat psum — integer-valued floats make both exact,
+    so equality is bitwise, not allclose."""
+
+    @pytest.fixture()
+    def mesh2x4(self, devices8):
+        bk = B.TpuIciDcnBackend()
+        return bk, bk.mesh(M.MeshSpec(dcn=2, data=4), devices8)
+
+    def test_scatter_path_matches_flat_sum(self, mesh2x4):
+        bk, mesh = mesh2x4
+        # local leading dim 4 tiles over the data extent 4 → the
+        # reduce-scatter path runs (not the fallback)
+        x = jnp.arange(32.0 * 3).reshape(32, 3)
+        got = _reduce_under(bk, mesh, x)
+        ref = np.asarray(x).reshape(8, 4, 3).sum(0)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+    def test_untileable_shape_falls_back_flat(self, mesh2x4):
+        bk, mesh = mesh2x4
+        x = jnp.arange(16.0 * 3).reshape(16, 3)  # local dim 2, ici 4
+        got = _reduce_under(bk, mesh, x)
+        ref = np.asarray(x).reshape(8, 2, 3).sum(0)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+    @pytest.mark.parametrize("maker", [B.SingleBackend, B.LoopbackBackend],
+                             ids=["single", "loopback"])
+    def test_every_backend_agrees_with_the_sum(self, maker, mesh2x4):
+        _, mesh = mesh2x4
+        bk = maker()
+        bk._mesh = mesh
+        x = jnp.arange(32.0 * 3).reshape(32, 3)
+        got = _reduce_under(bk, mesh, x)
+        ref = np.asarray(x).reshape(8, 4, 3).sum(0)
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+class TestBackendEquivalence:
+    """The property the hermetic e2e leans on: training under
+    LoopbackBackend is the SAME computation as under SingleBackend."""
+
+    @staticmethod
+    def _train(bk, mesh, seed, steps=6):
+        rng = np.random.RandomState(seed)
+        X = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+        Y = jnp.asarray(rng.randn(16).astype(np.float32))
+
+        def local_loss(w, xl, yl):
+            return 0.5 * jnp.sum((xl @ w - yl) ** 2)
+
+        grad = shard_map(
+            lambda w, xl, yl: bk.hierarchical_reduce(
+                jax.grad(local_loss)(w, xl, yl)),
+            mesh=mesh,
+            in_specs=(P(), P((M.AXIS_DCN, M.AXIS_DATA)),
+                      P((M.AXIS_DCN, M.AXIS_DATA))),
+            out_specs=P(), check_rep=False)
+
+        @jax.jit
+        def step(w, X, Y):
+            return w - 0.05 * grad(w, X, Y) / X.shape[0]
+
+        w = jnp.zeros((4,))
+        for _ in range(steps):
+            w = step(w, X, Y)
+        return np.asarray(w)
+
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_single_vs_loopback_one_slice_identical_params(
+            self, seed, devices8):
+        """One-slice loopback defaults to the SAME mesh as single — the
+        trained params must be bit-identical, not just close."""
+        single = B.SingleBackend()
+        loop = B.LoopbackBackend()
+        w_s = self._train(single, single.mesh(devices=devices8), seed)
+        w_l = self._train(loop, loop.mesh(devices=devices8), seed)
+        assert np.array_equal(w_s, w_l), (w_s, w_l)
+
+    def test_two_slice_loopback_matches_single_math(self, devices8):
+        """A formed 2-slice in-process world (dcn=2 on the partition
+        boundary) trains to the single-backend answer — the cross-slice
+        reduce is a real dcn-axis collective, same math."""
+        env = {B.ENV_BACKEND: B.BACKEND_LOOPBACK, D.ENV_NPROC: "1",
+               D.ENV_NUM_SLICES: "2", D.ENV_SLICE_ID: "0"}
+        D.initialize_from_env(env)
+        loop = B.get_backend(B.BACKEND_LOOPBACK)
+        mesh2 = loop.mesh(devices=devices8)
+        assert mesh2.shape[M.AXIS_DCN] == 2
+        w_2slice = self._train(loop, mesh2, seed=3)
+        single = B.SingleBackend()
+        w_ref = self._train(single, single.mesh(devices=devices8), seed=3)
+        np.testing.assert_allclose(w_2slice, w_ref, rtol=1e-6)

@@ -667,7 +667,7 @@ class TestCheckpointResilience:
         ck.wait()
         manifest = json.loads((tmp_path / "manifest.json").read_text())
         assert manifest == {"latest_step": 5, "steps": [1, 2, 5],
-                            "world_sizes": {}}
+                            "world_sizes": {}, "slice_counts": {}}
         # remote URIs skip the local manifest (orbax owns metadata there)
         ck.directory = "gs://bucket/ckpt"
         ck.close()

@@ -89,7 +89,9 @@ class TestElasticSpec:
         assert el == {"minReplicas": 2, "maxReplicas": 4,
                       "resizePolicy": T.RESIZE_RESIZE,
                       "batchPolicy": T.BATCH_PRESERVE,
-                      "maxResizes": T.DEFAULT_MAX_RESIZES}
+                      "maxResizes": T.DEFAULT_MAX_RESIZES,
+                      "slicePolicy": T.SLICE_RESTART,
+                      "minSlices": 1}
         assert T.is_elastic(elastic_job()["spec"])
         assert not T.is_elastic(T.new_jaxjob("rigid")["spec"])
 
@@ -105,15 +107,39 @@ class TestElasticSpec:
                    for e in T.validate(job))
 
     def test_multislice_resize_rejected(self):
+        # worker-granular Resize on a multislice gang: the pre-slice
+        # spelling gets a MIGRATION error pointing at slicePolicy, not
+        # a silent behavior change
         job = T.new_jaxjob("ms", replicas=2, slice_count=2,
                            accelerator="tpu-v5-lite-podslice",
                            topology="2x4", chips_per_worker=4,
                            elastic_min=2)
         job["spec"]["elastic"]["maxReplicas"] = 4
-        assert any("data-parallel only" in e for e in T.validate(job))
+        assert any("add elastic.slicePolicy" in e for e in T.validate(job))
         # resizePolicy Restart (spot opt-in only) IS allowed multislice
         job["spec"]["elastic"]["resizePolicy"] = T.RESIZE_RESTART
         assert T.validate(job) == []
+        assert not T.is_elastic(job["spec"])
+
+    def test_multislice_slice_policy_shrink_accepted(self):
+        job = T.new_jaxjob("ms", replicas=2, slice_count=2,
+                           accelerator="tpu-v5-lite-podslice",
+                           topology="2x4", chips_per_worker=4,
+                           elastic_min=2,
+                           slice_policy=T.SLICE_SHRINK, min_slices=1)
+        job["spec"]["elastic"]["maxReplicas"] = 4
+        assert T.validate(job) == []
+        assert T.is_slice_elastic(job["spec"])
+        assert T.is_elastic(job["spec"])
+        # floor is slice-granular: minSlices x replicas
+        assert T.elastic_floor(job["spec"]) == 2
+        # bad values are rejected with field-specific messages
+        job["spec"]["elastic"]["slicePolicy"] = "Halve"
+        assert any("slicePolicy must be" in e for e in T.validate(job))
+        job["spec"]["elastic"]["slicePolicy"] = T.SLICE_SHRINK
+        job["spec"]["elastic"]["minSlices"] = 3
+        assert any("minSlices 3 > sliceCount 2" in e
+                   for e in T.validate(job))
 
     @pytest.mark.parametrize("field,value,needle", [
         ("minReplicas", 0, "positive int"),
@@ -1326,16 +1352,17 @@ def _mesh(n):
     return build_mesh(MeshSpec(data=1, fsdp=n), jax.devices()[:n])
 
 
-def _sharded_state(n, step=7):
+def _sharded_state(n, step=7, mesh=None):
     """Params + adamw optimizer state laid out over an n-way fsdp mesh
-    via the shared sharding inference (parallel/shardings.py)."""
+    (or a caller-supplied mesh) via the shared sharding inference
+    (parallel/shardings.py)."""
     import jax
     import jax.numpy as jnp
     import optax
 
     from kubeflow_tpu.parallel.shardings import infer_shardings
 
-    mesh = _mesh(n)
+    mesh = mesh if mesh is not None else _mesh(n)
     rng = np.random.RandomState(0)
     host = {
         "dense": {"kernel": rng.randn(128, 256).astype(np.float32),
@@ -1385,6 +1412,53 @@ def test_checkpoint_reshards_bitwise(tmp_path, devices8,
         for leaf, a in host[key].items():
             assert np.array_equal(got[key][leaf], a), (key, leaf)
     # optimizer moments reshard bitwise too
+    want_opt = _unshard(state.opt_state)
+    got_opt = _unshard(restored.opt_state)
+    import jax
+
+    for w, g in zip(jax.tree.leaves(want_opt), jax.tree.leaves(got_opt)):
+        assert np.array_equal(w, g)
+
+
+def _slice_mesh(ns):
+    """The multi-slice layout a slice shrink/grow actually swaps
+    between: dcn outermost over the slice partition, fsdp inside."""
+    import jax
+
+    from kubeflow_tpu.parallel.mesh import MeshSpec, build_mesh
+
+    return build_mesh(MeshSpec(dcn=ns, fsdp=2), jax.devices()[:2 * ns])
+
+
+@pytest.mark.parametrize("save_slices,restore_slices",
+                         [(2, 1), (1, 2), (4, 2), (2, 4), (4, 1)])
+def test_checkpoint_reshards_across_slice_counts(tmp_path, devices8,
+                                                 save_slices,
+                                                 restore_slices):
+    """ISSUE 12 multi-slice corollary of the bitwise contract: a
+    whole-slice shrink/grow changes the DCN extent of the mesh (and
+    with it every array's replication layout), not just the device
+    count — params and optimizer moments must still restore bitwise.
+    The dcn axis is a compiler input like any other mesh axis."""
+    from kubeflow_tpu.runtime.checkpoint import Checkpointer
+
+    save_n, restore_n = 2 * save_slices, 2 * restore_slices
+    state, host = _sharded_state(save_n, mesh=_slice_mesh(save_slices))
+    ck = Checkpointer(str(tmp_path), world_size=save_n)
+    assert ck.save(7, state)
+    ck.wait()
+    ck.close()
+
+    template, _ = _sharded_state(restore_n, step=0,
+                                 mesh=_slice_mesh(restore_slices))
+    ck2 = Checkpointer(str(tmp_path), world_size=restore_n)
+    restored = ck2.restore(7, template)
+    ck2.close()
+    assert int(restored.step) == 7
+    got = _unshard(restored.params)
+    for key in ("dense", "head"):
+        for leaf, a in host[key].items():
+            assert np.array_equal(got[key][leaf], a), (key, leaf)
     want_opt = _unshard(state.opt_state)
     got_opt = _unshard(restored.opt_state)
     import jax

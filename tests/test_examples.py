@@ -109,6 +109,7 @@ def test_multislice_example_validates_and_builds_mesh():
     """The JAXJob half must pass CRD validation; the TrainConfig half's
     dcn mesh must resolve on sliceCount x replicas x chips devices."""
     from kubeflow_tpu.control.jaxjob import types as JT
+    from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
     from kubeflow_tpu.parallel.mesh import MeshSpec
     from kubeflow_tpu.runtime.trainer import TrainConfig
 
@@ -116,6 +117,13 @@ def test_multislice_example_validates_and_builds_mesh():
         job, train = list(yaml.safe_load_all(f))
     assert JT.validate(job) == []
     assert JT.gang_size(job["spec"]) == 4
+    # slice-elastic: scheduled by the slice-aware gang scheduler, and a
+    # whole-slice loss is a Shrink resize (ISSUE 12), never a restart
+    assert job["spec"]["schedulerName"] == SCHEDULER_NAME
+    el = job["spec"]["elastic"]
+    assert el["slicePolicy"] == JT.SLICE_SHRINK
+    assert 1 <= el["minSlices"] < job["spec"]["sliceCount"]
+    assert el["minReplicas"] == JT.gang_size(job["spec"])
     cfg = TrainConfig.from_dict(train)
     chips = (job["spec"]["sliceCount"] * job["spec"]["replicas"]
              * job["spec"]["tpu"]["chipsPerWorker"])

@@ -1,0 +1,117 @@
+"""Multi-slice bench contract (ISSUE 12 acceptance, tier-1 sized).
+
+Runs tools/mslice_bench.py's smoke config + slice-reclaim drill and
+pins what the bank promises:
+
+- **determinism**: the decision fingerprint (placements + slice
+  vectors + virtual-time latencies hashed canonically) is byte-stable
+  across runs — everything rides the manual clock, so ANY drift is a
+  semantic change in admission, not noise;
+- **placement quality**: every admitted slice lives in exactly one
+  (accelerator, topology) pool (``slices_intact == 1.0``);
+- **reclaim semantics**: the drill shrinks to the surviving slice and
+  grows back without burning a single restart;
+- **ratchet**: ``mslice_bench --check`` passes against the committed
+  BENCH_MSLICE_r01.json and fails loudly against a poisoned bank —
+  the same gate tools/lint_all.sh-adjacent CI wiring runs.
+"""
+
+import importlib.util
+import json
+import pathlib
+import sys
+
+import pytest
+
+TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+BANK = TOOLS.parent / "BENCH_MSLICE_r01.json"
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "mslice_bench", TOOLS / "mslice_bench.py")
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("mslice_bench", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def bench():
+    return _load_bench()
+
+
+@pytest.fixture(scope="module")
+def smoke(bench):
+    return bench.run_admission(**bench.SMOKE_CONFIG)
+
+
+@pytest.fixture(scope="module")
+def drill(bench):
+    return bench.run_drill()
+
+
+class TestMsliceBench:
+    def test_double_run_fingerprint_byte_stable(self, bench, smoke):
+        again = bench.run_admission(**bench.SMOKE_CONFIG)
+        assert again["fingerprint"] == smoke["fingerprint"]
+        assert again == smoke  # not just the hash: every banked number
+
+    def test_every_gang_admits_with_intact_slices(self, smoke, bench):
+        assert smoke["admitted_gangs"] == bench.SMOKE_CONFIG["gangs"]
+        q = smoke["quality"]
+        assert q["slices_intact"] == 1.0
+        assert q["placed_gangs"] == smoke["admitted_gangs"]
+        assert q["slices_total"] >= 2 * smoke["admitted_gangs"]
+        # the scheduler counted each multislice admission
+        assert smoke["slice_admissions_metric"] >= 1
+        assert 0.0 < smoke["admission_p50_s"] <= smoke["admission_p99_s"]
+
+    def test_drill_shrinks_and_grows_without_restarts(self, drill):
+        assert drill["restarts"] == 0
+        assert drill["preemptions"] == 0
+        assert drill["admit_s"] > 0
+        assert drill["shrink_s"] > 0
+        assert drill["grow_s"] > 0
+        assert drill["complete_s"] >= 0
+
+    def test_drill_fingerprint_byte_stable(self, bench, drill):
+        assert bench.run_drill()["fingerprint"] == drill["fingerprint"]
+
+    def test_banked_budget_gate(self, bench, smoke, drill, tmp_path):
+        """--check passes against an honest bank and fails (exit 1)
+        against a poisoned one — both directions, before trusting the
+        committed bank below."""
+        banked = {
+            "smoke_config": dict(bench.SMOKE_CONFIG),
+            "smoke": dict(smoke),
+            "drill": dict(drill),
+        }
+        ok_path = tmp_path / "bank_ok.json"
+        ok_path.write_text(json.dumps(banked))
+        assert bench.check_against(str(ok_path)) == 0
+        poisoned = json.loads(ok_path.read_text())
+        poisoned["smoke"]["fingerprint"] = "0" * 64
+        poisoned["smoke"]["admission_p99_s"] = smoke["admission_p99_s"] / 100
+        bad_path = tmp_path / "bank_bad.json"
+        bad_path.write_text(json.dumps(poisoned))
+        assert bench.check_against(str(bad_path)) == 1
+        # a missing bank is a usage error, not a silent pass
+        assert bench.check_against(str(tmp_path / "nope.json")) == 2
+
+    def test_committed_bank_check_is_green(self, bench):
+        """THE CI wiring: the committed BENCH_MSLICE_r01.json gates
+        exactly like sched/serve/obs banks do."""
+        assert bench.check_against(str(BANK)) == 0
+
+    def test_committed_bank_meets_acceptance(self):
+        banked = json.loads(BANK.read_text())
+        assert banked["bench"] == "mslice_bench"
+        full = banked["full"]
+        assert banked["config"]["gangs"] == 64
+        assert full["admitted_gangs"] == 64
+        assert full["quality"]["slices_intact"] == 1.0
+        # admission exercised its slice-spread freedom at least once
+        assert full["quality"]["cross_pool_gangs"] >= 1
+        drill = banked["drill"]
+        assert drill["restarts"] == 0 and drill["preemptions"] == 0

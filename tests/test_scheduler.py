@@ -795,3 +795,92 @@ class TestExecutorBindOnce:
             ex.shutdown()
         pod = cluster.get("v1", "Pod", "p0", "default")
         assert (pod.get("status") or {}).get("phase") is None
+
+
+# -- slice-aware admission ---------------------------------------------------
+
+
+class TestSliceAwareAdmission:
+    """Multi-slice gangs: each slice lands entirely inside ONE
+    (accelerator, topology) pool, different slices may use different
+    pools, and admission stays all-or-nothing ACROSS slices."""
+
+    def _pool(self, cluster, prefix, topology, n):
+        for i in range(n):
+            cluster.create(new_tpu_node(f"{prefix}{i}", topology=topology))
+
+    def test_multislice_gang_admits_across_two_pools(self):
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        self._pool(cluster, "a", "2x4", 2)   # pool A: 2 hosts x 4 chips
+        self._pool(cluster, "b", "4x4", 2)   # pool B: 2 hosts x 4 chips
+        cluster.create(gang_job("ms", replicas=2, chips=4, topology="2x4",
+                                slice_count=2))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        assert all(b.values()), b
+        # slice 0 (workers 0-1) in one pool, slice 1 (workers 2-3) in
+        # the other — never a slice straddling pools
+        slice0 = {b["ms-worker-0"], b["ms-worker-1"]}
+        slice1 = {b["ms-worker-2"], b["ms-worker-3"]}
+        assert slice0 == {"a0", "a1"} and slice1 == {"b0", "b1"}, b
+        # gang-scheduled multislice pods carry NO topology pin — the
+        # pool choice is admission's, not the template's
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            sel = p["spec"].get("nodeSelector") or {}
+            assert JT.NODESELECTOR_TOPOLOGY not in sel
+            assert sel[JT.NODESELECTOR_ACCEL] == "tpu-v5-lite-podslice"
+        assert 'scheduler_slice_admissions_total{namespace="default"} 1.0' \
+            in reg.render()
+        job = cluster.get(JT.API_VERSION, JT.KIND, "ms", "default")
+        assert ob.cond_is_true(job, JT.COND_RUNNING)
+
+    def test_slice_split_across_pools_never_binds(self):
+        """Capacity for every WORKER exists, but slice 1 would have to
+        straddle two pools — the gang must not bind at all (a split
+        slice could never form its ICI mesh)."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        self._pool(cluster, "a", "2x4", 1)   # 4 chips: half a slice
+        self._pool(cluster, "b", "4x4", 3)   # 12 chips: 1.5 slices
+        cluster.create(gang_job("ms", replicas=2, chips=4, topology="2x4",
+                                slice_count=2))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        assert len(b) == 4 and all(v is None for v in b.values()), b
+        for p in cluster.list("v1", "Pod", namespace="default"):
+            assert p["spec"]["schedulingGates"] == [{"name": GATE_GANG}]
+        assert 'scheduler_queue_depth{namespace="default"} 1' in reg.render()
+
+    def test_slice_aligned_partial_admission_and_grow_back(self):
+        """Slice-elastic gang, room for one slice: exactly slice 0
+        binds (whole slices only — never a sub-slice prefix), the world
+        starts at one slice, and the second slice grows back into a
+        DIFFERENT pool when capacity appears."""
+        fc = FakeClock()
+        cluster, jax_ctl, sched_ctl, kubelet, reg = sched_world(fc)
+        self._pool(cluster, "a", "2x4", 2)
+        cluster.create(gang_job(
+            "ms", replicas=2, chips=4, topology="2x4", slice_count=2,
+            elastic_min=4, slice_policy=JT.SLICE_SHRINK, min_slices=1))
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        b = bindings(cluster)
+        bound = {k for k, v in b.items() if v}
+        assert bound == {"ms-worker-0", "ms-worker-1"}, b
+        st = (cluster.get(JT.API_VERSION, JT.KIND, "ms", "default")
+              .get("status") or {})
+        assert st["activeReplicas"] == 2
+        assert st["activeSlices"] == 1
+        assert st["world"]["members"] == ["ms-worker-0", "ms-worker-1"]
+        assert st["world"]["slices"] == [0, 0]
+        # grow-back: slice 1 admits into a different pool, whole-slice
+        self._pool(cluster, "b", "4x4", 2)
+        pump([jax_ctl, sched_ctl], fc, kubelet)
+        st = (cluster.get(JT.API_VERSION, JT.KIND, "ms", "default")
+              .get("status") or {})
+        assert st["activeReplicas"] == 4
+        assert st["activeSlices"] == 2
+        assert st["world"]["slices"] == [0, 0, 1, 1]
+        assert st.get("restarts", 0) == 0 and st.get("preemptions", 0) == 0
+        b = bindings(cluster)
+        assert {b["ms-worker-2"], b["ms-worker-3"]} == {"b0", "b1"}, b

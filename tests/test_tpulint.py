@@ -248,6 +248,25 @@ def remaining(deadline):
     return deadline - time.time()
 """, 5),
     ],
+    "COLL401": [
+        # a second jax.distributed lifecycle call site forks the
+        # backend contract (the loopback tier stops covering it)
+        ("""\
+import jax
+
+
+def boot(coord, n, rank):
+    jax.distributed.initialize(coord, num_processes=n, process_id=rank)
+""", 5),
+        # re-spelled MEGASCALE env key outside the backend module
+        ("""\
+import os
+
+
+def slice_block(num):
+    os.environ["MEGASCALE_NUM_SLICES"] = str(num)
+""", 5),
+    ],
 }
 
 CLEAN = {
@@ -630,6 +649,29 @@ def diff(t0, t1):
     return t1 - t0
 """,
     ],
+    "COLL401": [
+        # the sanctioned route: world formation through the backend seam
+        """\
+from kubeflow_tpu.parallel import backends as B
+
+
+def boot(cfg):
+    return B.get_backend().join(cfg, wait=True)
+""",
+        # JAXJOB_* keys and prose mentions of megascale are not the
+        # transport contract; is_initialized is not a lifecycle call
+        """\
+import os
+
+import jax
+
+
+def status(n):
+    os.environ["JAXJOB_NUM_SLICES"] = str(n)
+    note = "megascale transport handles cross-slice reduce"
+    return jax.distributed.is_initialized(), note
+""",
+    ],
 }
 
 
@@ -647,7 +689,7 @@ def _clean_cases():
 
 @pytest.mark.parametrize("rule,src,line", _bad_cases(),
                          ids=lambda v: v if isinstance(v, str) and
-                         v.startswith(("TPU", "LOCK", "OBS")) else None)
+                         v.startswith(("TPU", "LOCK", "OBS", "COLL")) else None)
 def test_rule_fires_with_id_and_line(rule, src, line):
     findings = _scan(src)
     hits = [f for f in findings if f.rule == rule]
@@ -658,7 +700,7 @@ def test_rule_fires_with_id_and_line(rule, src, line):
 
 @pytest.mark.parametrize("rule,src", _clean_cases(),
                          ids=lambda v: v if isinstance(v, str) and
-                         v.startswith(("TPU", "LOCK", "OBS")) else None)
+                         v.startswith(("TPU", "LOCK", "OBS", "COLL")) else None)
 def test_clean_fragment_stays_clean(rule, src):
     findings = [f for f in _scan(src) if f.rule == rule]
     assert not findings, [f.render() for f in findings]

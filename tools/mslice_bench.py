@@ -1,0 +1,521 @@
+#!/usr/bin/env python
+"""mslice_bench — deterministic multi-slice admission + reclaim benchmark.
+
+Drives the JAXJob controller AND the gang scheduler against one
+FakeCluster (the production loop: JAXJob renders a gated multi-slice
+gang -> scheduler admits slice-by-slice, all-or-nothing across slices
+-> kubelet runs bound pods) over a 4-pool fleet, measuring what the
+multi-slice plane promises:
+
+- **admission latency** (virtual seconds on the injectable clock) for
+  64 multi-slice gangs created in waves with completion churn;
+- **placement quality**: every admitted slice confined to ONE
+  (accelerator, topology) pool — ``slices_intact`` must be 1.0 — plus
+  how often admission exercised its freedom to spread a gang's slices
+  across pools;
+- a scripted **slice-reclaim drill**: a slice-elastic gang loses a
+  whole pool mid-run, shrinks to the surviving slice (zero restart
+  budget), grows back when the pool heals, and runs to Succeeded —
+  each phase's virtual-time latency is banked. (Loss-curve continuity
+  through the same shrink is proven end-to-end on the loopback
+  collectives backend in tests/test_mslice_e2e.py; this drill banks
+  the control-plane state machine.)
+
+Everything runs on the manual clock — zero wall-clock sleeps — so the
+scheduling DECISIONS replay exactly per seed: the bench hashes them
+into a decision fingerprint that must be byte-stable across runs and
+machines (the tier-1 contract in tests/test_mslice_scale.py reruns it
+twice and against the committed bank).
+
+    python tools/mslice_bench.py                 # full + smoke + drill,
+                                                 # write BENCH_MSLICE_r01.json
+    python tools/mslice_bench.py --gangs 16 --waves 4
+    python tools/mslice_bench.py --check         # CI gate: rerun the banked
+        # smoke + drill; fail on fingerprint drift or a > 25% latency
+        # regression (virtual time, so any drift is a semantic change)
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.control.jaxjob import types as JT  # noqa: E402
+from kubeflow_tpu.control.jaxjob.controller import (  # noqa: E402
+    build_controller,
+)
+from kubeflow_tpu.control.k8s import objects as ob  # noqa: E402
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet  # noqa: E402
+from kubeflow_tpu.control.runtime import seed_controller  # noqa: E402
+from kubeflow_tpu.control.scheduler import SCHEDULER_NAME  # noqa: E402
+from kubeflow_tpu.control.scheduler import nodes as N  # noqa: E402
+from kubeflow_tpu.control.scheduler.scheduler import (  # noqa: E402
+    build_scheduler,
+)
+from kubeflow_tpu.control.scheduler.topology import chip_count  # noqa: E402
+from kubeflow_tpu.runtime.metrics import MetricsRegistry  # noqa: E402
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_MSLICE_r01.json")
+
+# The fleet's pools: (accelerator, topology, hosts). Two pools share an
+# accelerator so a v5-lite gang's slices may legally spread across
+# them; the v5p/v6e pools are single-home.
+POOLS = (
+    ("tpu-v5-lite-podslice", "2x4", 12),
+    ("tpu-v5-lite-podslice", "4x4", 8),
+    ("tpu-v5p-slice", "2x2", 6),
+    ("tpu-v6e-slice", "2x4", 6),
+)
+TENANTS = 4
+REPLICAS_PER_SLICE = 2   # hosts per slice; chips_per_worker=4 fills a host
+ROUNDS_PER_WAVE = 12
+DRAIN_EPOCHS = 24
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def build_world(clock: ManualClock):
+    cluster = FakeCluster()
+    registry = MetricsRegistry()
+    jax_ctl = seed_controller(build_controller(cluster, record_events=False))
+    sched_ctl = seed_controller(build_scheduler(
+        cluster, registry=registry, record_events=False, clock=clock))
+    kubelet = FakeKubelet(cluster, auto_bind=False)
+    return cluster, jax_ctl, sched_ctl, kubelet, registry
+
+
+def build_fleet(cluster: FakeCluster) -> None:
+    for pi, (accel, topo, hosts) in enumerate(POOLS):
+        for i in range(hosts):
+            cluster.create(N.new_tpu_node(
+                f"p{pi}-{i:03d}", accelerator=accel, topology=topo,
+                chips_per_node=4))
+
+
+def step(ctls, kubelet, clock: ManualClock, dt: float = 1.0) -> None:
+    for c in ctls:
+        c.run_until_idle(max_rounds=100000, advance_delayed=True)
+    kubelet.step()
+    clock.advance(dt)
+
+
+def gang_specs(rng: random.Random, gangs: int) -> list[dict]:
+    """Deterministic workload: every gang is feasible (a v5p/v6e gang
+    never asks for more slices than its single pool can hold, so strict
+    FIFO can't head-block forever), and each tiles its pool's slice
+    topology exactly (replicas x 4 chips == chips per slice)."""
+    specs = []
+    for i in range(gangs):
+        pool_i = rng.choice((0, 0, 1, 2, 3))   # v5-lite-heavy, like fleets
+        accel, topo, hosts = POOLS[pool_i]
+        replicas = chip_count(topo) // 4       # hosts per slice
+        max_slices = min(4 if accel == "tpu-v5-lite-podslice" else 2,
+                         hosts // replicas)
+        specs.append({
+            "namespace": f"tenant-{i % TENANTS}",
+            "name": f"ms-{i:04d}",
+            "accelerator": accel,
+            "topology": topo,
+            "replicas": replicas,
+            "slice_count": rng.randint(2, max(max_slices, 2)),
+        })
+    return specs
+
+
+def make_gang(cluster: FakeCluster, spec: dict) -> None:
+    cluster.create(JT.new_jaxjob(
+        spec["name"], namespace=spec["namespace"],
+        replicas=spec["replicas"], slice_count=spec["slice_count"],
+        accelerator=spec["accelerator"], topology=spec["topology"],
+        chips_per_worker=4, gang_schedule=True))
+
+
+def _jobs(cluster: FakeCluster):
+    return cluster.list(JT.API_VERSION, JT.KIND)
+
+
+def complete_running(cluster: FakeCluster) -> int:
+    """Mark every fully-Running gang's pods Succeeded — frees its hosts
+    for the queue, deterministically (name order via list)."""
+    done = 0
+    for job in _jobs(cluster):
+        if not ob.cond_is_true(job, JT.COND_RUNNING):
+            continue
+        m = ob.meta(job)
+        for p in cluster.list("v1", "Pod", namespace=m["namespace"]):
+            if ob.labels_of(p).get(JT.LABEL_JOB_NAME) != m["name"]:
+                continue
+            if (p.get("status") or {}).get("phase") in N.TERMINAL_PHASES:
+                continue
+            cur = cluster.get("v1", "Pod", ob.meta(p)["name"], m["namespace"])
+            cur.setdefault("status", {})["phase"] = "Succeeded"
+            cluster.update_status(cur)
+        done += 1
+    return done
+
+
+def _pool_of_node(cluster: FakeCluster, name: str) -> tuple[str, str]:
+    labels = ob.labels_of(cluster.get("v1", "Node", name))
+    return (labels.get(JT.NODESELECTOR_ACCEL),
+            labels.get(JT.NODESELECTOR_TOPOLOGY))
+
+
+def snapshot_placement(cluster: FakeCluster, spec: dict) -> dict[str, str]:
+    """pod -> node for one gang, captured the moment it turns Running
+    (the controller garbage-collects pods after completion, so the
+    decision must be recorded when it's made)."""
+    out = {}
+    per = spec.get("replicas", REPLICAS_PER_SLICE)
+    count = spec["slice_count"]
+    for i in range(count * per):
+        try:
+            pod = cluster.get("v1", "Pod", f"{spec['name']}-worker-{i}",
+                              spec["namespace"])
+        except ob.NotFound:
+            continue
+        node = (pod.get("spec") or {}).get("nodeName")
+        if node:
+            out[f"{spec['name']}-worker-{i}"] = node
+    return out
+
+
+def placement_quality(cluster: FakeCluster, specs: list[dict],
+                      placements: dict[str, dict[str, str]]) -> dict:
+    """Slice integrity + pool spread over the admission-time snapshots
+    (nodes persist, so pool lookup stays live)."""
+    slices_total = slices_intact = 0
+    cross_pool_gangs = pools_per_gang_sum = placed_gangs = 0
+    for spec in specs:
+        placed = placements.get(
+            f"{spec['namespace']}/{spec['name']}")
+        if not placed:
+            continue
+        per = spec.get("replicas", REPLICAS_PER_SLICE)
+        count = spec["slice_count"]
+        nodes_by_slice: dict[int, set[str]] = {}
+        for pod_name, node in placed.items():
+            idx = int(pod_name.rsplit("-", 1)[1])
+            nodes_by_slice.setdefault(idx // per, set()).add(node)
+        gang_pools = set()
+        bound_slices = 0
+        for _sid, nodes in sorted(nodes_by_slice.items()):
+            if len(nodes) < per:
+                continue   # partially bound slice: integrity unjudgeable
+            bound_slices += 1
+            slices_total += 1
+            pools = {_pool_of_node(cluster, n) for n in nodes}
+            if len(pools) == 1:
+                slices_intact += 1
+            gang_pools |= pools
+        if bound_slices == count:
+            placed_gangs += 1
+            pools_per_gang_sum += len(gang_pools)
+            if len(gang_pools) > 1:
+                cross_pool_gangs += 1
+    return {
+        "slices_total": slices_total,
+        "slices_intact": round(slices_intact / slices_total, 4)
+        if slices_total else 0.0,
+        "placed_gangs": placed_gangs,
+        "cross_pool_gangs": cross_pool_gangs,
+        "mean_pools_per_gang": round(pools_per_gang_sum / placed_gangs, 3)
+        if placed_gangs else 0.0,
+    }
+
+
+def decision_fingerprint(payload: dict) -> str:
+    """sha256 over a canonical-JSON decision record — byte-stable
+    across runs and machines iff the DECISIONS (placements, slice
+    vectors, virtual-time latencies) are."""
+    blob = json.dumps(payload, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(math.ceil(q * len(xs))) - 1)]
+
+
+def run_admission(gangs: int = 64, seed: int = 0, waves: int = 8) -> dict:
+    rng = random.Random(seed)
+    clock = ManualClock()
+    cluster, jax_ctl, sched_ctl, kubelet, registry = build_world(clock)
+    ctls = [jax_ctl, sched_ctl]
+    build_fleet(cluster)
+    step(ctls, kubelet, clock)
+
+    specs = gang_specs(rng, gangs)
+    by_key = {f"{s['namespace']}/{s['name']}": s for s in specs}
+    created: dict[str, float] = {}
+    admitted_at: dict[str, float] = {}
+    placements: dict[str, dict[str, str]] = {}
+
+    per_wave = math.ceil(len(specs) / waves)
+
+    def observe() -> None:
+        for job in _jobs(cluster):
+            m = ob.meta(job)
+            key = f"{m['namespace']}/{m['name']}"
+            if key not in admitted_at and ob.cond_is_true(
+                    job, JT.COND_RUNNING):
+                admitted_at[key] = clock.t
+                placements[key] = snapshot_placement(cluster, by_key[key])
+
+    for wave in range(waves):
+        for spec in specs[wave * per_wave:(wave + 1) * per_wave]:
+            make_gang(cluster, spec)
+            created[f"{spec['namespace']}/{spec['name']}"] = clock.t
+        for _ in range(ROUNDS_PER_WAVE):
+            step(ctls, kubelet, clock)
+            observe()
+        complete_running(cluster)
+    # drain: keep completing until the queue is empty or stalls. Bigger
+    # virtual steps (dt=4) burn through exponential requeue backoffs
+    # that the 1s wave cadence would idle under.
+    for _ in range(DRAIN_EPOCHS):
+        progressed = False
+        for _ in range(ROUNDS_PER_WAVE):
+            step(ctls, kubelet, clock, dt=4.0)
+            observe()
+        if complete_running(cluster):
+            progressed = True
+        if len(admitted_at) == len(created) and not progressed:
+            break
+
+    latencies = [admitted_at[k] - created[k] for k in admitted_at]
+    return {
+        "gangs": gangs,
+        "admitted_gangs": len(admitted_at),
+        "admission_p50_s": _percentile(latencies, 0.50),
+        "admission_p99_s": _percentile(latencies, 0.99),
+        "admission_max_s": max(latencies, default=0.0),
+        "quality": placement_quality(cluster, specs, placements),
+        "slice_admissions_metric": registry.render().count(
+            "scheduler_slice_admissions_total{"),
+        "fingerprint": decision_fingerprint({
+            "placements": placements,
+            "latencies": {k: admitted_at[k] - created[k]
+                          for k in admitted_at}}),
+    }
+
+
+# -- the scripted slice-reclaim drill ----------------------------------------
+
+
+def _drill_status(cluster: FakeCluster) -> dict:
+    return (cluster.get(JT.API_VERSION, JT.KIND, "drill", "default")
+            .get("status") or {})
+
+
+def _set_pool_ready(cluster: FakeCluster, prefix: str, n: int,
+                    ready: bool) -> None:
+    for i in range(n):
+        node = cluster.get("v1", "Node", f"{prefix}{i}")
+        node.setdefault("status", {})["conditions"] = [
+            {"type": "Ready", "status": "True" if ready else "False"}]
+        cluster.update_status(node)
+
+
+def _pump_until(ctls, kubelet, clock, pred, limit: int = 120) -> float:
+    t0 = clock.t
+    for _ in range(limit):
+        if pred():
+            return clock.t - t0
+        step(ctls, kubelet, clock)
+    raise AssertionError(f"drill phase did not converge in {limit} steps")
+
+
+def run_drill(seed: int = 0) -> dict:
+    """Shrink -> resume -> grow -> Succeeded on the real controller +
+    scheduler paths: a 2-slice slice-elastic gang loses its second
+    pool, shrinks to the survivor (zero restart budget), grows back
+    into the healed pool, and completes."""
+    clock = ManualClock()
+    cluster, jax_ctl, sched_ctl, kubelet, _registry = build_world(clock)
+    ctls = [jax_ctl, sched_ctl]
+    for i in range(2):
+        cluster.create(N.new_tpu_node(f"a{i}", topology="2x4"))
+        cluster.create(N.new_tpu_node(f"b{i}", topology="4x4"))
+    step(ctls, kubelet, clock)
+
+    cluster.create(JT.new_jaxjob(
+        "drill", replicas=REPLICAS_PER_SLICE, slice_count=2,
+        accelerator="tpu-v5-lite-podslice", topology="2x4",
+        chips_per_worker=4, gang_schedule=True,
+        elastic_min=2 * REPLICAS_PER_SLICE,
+        slice_policy=JT.SLICE_SHRINK, min_slices=1))
+    drill_spec = {"name": "drill", "namespace": "default", "slice_count": 2}
+    # full admission stamps no status.world (only resizes do): Running
+    # with all four workers bound IS the 2-slice steady state
+    t_admit = _pump_until(
+        ctls, kubelet, clock,
+        lambda: ob.cond_is_true(
+            cluster.get(JT.API_VERSION, JT.KIND, "drill", "default"),
+            JT.COND_RUNNING)
+        and len(snapshot_placement(cluster, drill_spec)) == 4)
+    placed_admit = snapshot_placement(cluster, drill_spec)
+
+    # which pool did slice 1 land in? kill it whole (the reclaim shape:
+    # a slice dies as a unit)
+    victim_prefix = "b" if any(
+        (cluster.get("v1", "Pod", f"drill-worker-{i}", "default")
+         .get("spec") or {}).get("nodeName", "").startswith("b")
+        for i in (2, 3)) else "a"
+    _set_pool_ready(cluster, victim_prefix, 2, ready=False)
+    t_shrink = _pump_until(
+        ctls, kubelet, clock,
+        lambda: _drill_status(cluster).get("activeSlices") == 1)
+
+    _set_pool_ready(cluster, victim_prefix, 2, ready=True)
+    t_grow = _pump_until(
+        ctls, kubelet, clock,
+        lambda: _drill_status(cluster).get("activeSlices") == 2)
+    placed_grow = snapshot_placement(cluster, drill_spec)
+    st = _drill_status(cluster)
+    restarts = st.get("restarts", 0)
+    preemptions = st.get("preemptions", 0)
+
+    complete_running(cluster)
+    t_done = _pump_until(
+        ctls, kubelet, clock,
+        lambda: ob.cond_is_true(
+            cluster.get(JT.API_VERSION, JT.KIND, "drill", "default"),
+            JT.COND_SUCCEEDED))
+    return {
+        "admit_s": t_admit,
+        "shrink_s": t_shrink,
+        "grow_s": t_grow,
+        "complete_s": t_done,
+        "restarts": restarts,
+        "preemptions": preemptions,
+        "fingerprint": decision_fingerprint({
+            "admit": placed_admit, "grow": placed_grow,
+            "latencies": [t_admit, t_shrink, t_grow]}),
+    }
+
+
+# -- bank + ratchet ----------------------------------------------------------
+
+SMOKE_CONFIG = {"gangs": 16, "seed": 0, "waves": 4}
+
+
+def check_against(banked_path: str) -> int:
+    """CI ratchet: rerun the banked smoke + drill. Fails (1) when the
+    decision fingerprints drift (virtual time: ANY drift is a semantic
+    change, not noise) or a virtual-time latency regresses > 25%."""
+    try:
+        with open(banked_path) as fh:
+            banked = json.load(fh)
+    except (OSError, ValueError) as e:
+        print(f"check: cannot read {banked_path}: {e}", file=sys.stderr)
+        return 2
+    smoke, drill = banked.get("smoke"), banked.get("drill")
+    if not smoke or not drill:
+        print(f"check: no smoke/drill sections in {banked_path}",
+              file=sys.stderr)
+        return 2
+    now = run_admission(**banked["smoke_config"])
+    now_drill = run_drill()
+    ok = True
+    if now["fingerprint"] != smoke["fingerprint"]:
+        print("check: smoke decision fingerprint drifted "
+              f"({now['fingerprint'][:12]} != banked "
+              f"{smoke['fingerprint'][:12]}) — the multislice admission "
+              "DECISIONS changed; rerun tools/mslice_bench.py to re-bank "
+              "if intended", file=sys.stderr)
+        ok = False
+    if now_drill["fingerprint"] != drill["fingerprint"]:
+        print("check: drill decision fingerprint drifted", file=sys.stderr)
+        ok = False
+    if now["admitted_gangs"] < smoke["admitted_gangs"]:
+        print(f"check: admitted_gangs {now['admitted_gangs']} < banked "
+              f"{smoke['admitted_gangs']}", file=sys.stderr)
+        ok = False
+    for section, fresh, keys in (
+            ("smoke", now, ("admission_p99_s",)),
+            ("drill", now_drill, ("shrink_s", "grow_s"))):
+        for key in keys:
+            budget = banked[section][key] * 1.25
+            if fresh[key] > budget:
+                print(f"check: {section}.{key} {fresh[key]} exceeds budget "
+                      f"{budget:.2f} (banked {banked[section][key]})",
+                      file=sys.stderr)
+                ok = False
+    if now_drill["restarts"] != 0:
+        print(f"check: drill burned {now_drill['restarts']} restarts "
+              "(slice shrink must be restart-free)", file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "admission_p99_s": now["admission_p99_s"],
+                      "admitted_gangs": now["admitted_gangs"],
+                      "drill": {k: now_drill[k] for k in
+                                ("shrink_s", "grow_s", "restarts")}},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--gangs", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--waves", type=int, default=8)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--check", action="store_true",
+                    help="rerun the banked smoke + drill and gate on "
+                         "fingerprint drift or a >25%% latency regression")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_against(args.out)
+
+    full = run_admission(gangs=args.gangs, seed=args.seed, waves=args.waves)
+    smoke = run_admission(**SMOKE_CONFIG)
+    drill = run_drill()
+    if full["quality"]["slices_intact"] != 1.0:
+        print("WARNING: a bound slice straddles pools — slice affinity "
+              "is broken", file=sys.stderr)
+    result = {
+        "bench": "mslice_bench",
+        "round": "r01",
+        "config": {"gangs": args.gangs, "seed": args.seed,
+                   "waves": args.waves},
+        "smoke_config": dict(SMOKE_CONFIG),
+        "full": full,
+        "smoke": smoke,
+        "drill": drill,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"out": args.out,
+                      "admitted": f"{full['admitted_gangs']}/{args.gangs}",
+                      "admission_p99_s": full["admission_p99_s"],
+                      "quality": full["quality"],
+                      "drill": {k: drill[k] for k in
+                                ("admit_s", "shrink_s", "grow_s",
+                                 "restarts")}},
+                     indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
