@@ -263,6 +263,22 @@ class ClusterCache:
         if obj and obj.get("kind"):
             self._apply("DELETED", obj)
 
+    def mark_dirty(self, kinds=None) -> int:
+        """Force a relist of ``kinds`` (``[(api_version, kind), ...]``;
+        None = every subscribed kind) on the next ``refresh()`` — the
+        cache's own watch-gap repair path, exposed for operators and
+        the remediation engine (a slow scheduler pass with a healthy
+        fleet usually means a drifted index). Returns how many kinds
+        were marked."""
+        wanted = None if kinds is None else {tuple(k) for k in kinds}
+        marked = 0
+        with self._lock:
+            for sub in self._subs:
+                if wanted is None or sub.key in wanted:
+                    self._dirty[sub.key] = None
+                    marked += 1
+        return marked
+
     def _ingest(self, sub: _Sub, ev) -> None:
         rv = ob.meta(ev.object).get("resourceVersion")
         if rv:

@@ -98,7 +98,8 @@ def replica_restarts_total():
 class JAXServiceReconciler(Reconciler):
     def __init__(self, record_events: bool = True,
                  registry: MetricsRegistry | None = None,
-                 signals=None, clock=time.monotonic, cache=None):
+                 signals=None, clock=time.monotonic, cache=None,
+                 store=None):
         self.record_events = record_events
         self.registry = registry if registry is not None else REGISTRY
         # autoscaling signal source (serving.router.RegistrySignals
@@ -111,6 +112,15 @@ class JAXServiceReconciler(Reconciler):
         self.signals = signals
         self.clock = clock
         self.cache = cache
+        # optional obs TimeSeriesStore for PREDICTIVE autoscaling: when
+        # wired (and on the same clock), the scale-up demand projects
+        # the queue-depth trend over the stabilization window instead
+        # of reading only the instantaneous depth — killing the lag
+        # where a steadily-growing queue waits a full window before the
+        # first move. None (the default, and every pre-existing caller)
+        # keeps the instantaneous behavior bit-for-bit: BENCH_SERVE_r01
+        # replays identically.
+        self.store = store
         # per-service autoscaler memory: tokens-rate sample and the
         # hysteresis pending-direction window. In-memory on purpose — a
         # controller restart just re-observes demand for one window.
@@ -349,6 +359,12 @@ class JAXServiceReconciler(Reconciler):
 
         # -- autoscale decision (durable target move, record-FIRST) --------
         new_target = self._autoscale(svc, target)
+        # remediation nudge: a one-shot floor from obs/remediate.py,
+        # consumed (cleared) here so it can only act once — and flows
+        # through the same record-first write as any scale decision
+        nudge = self._consume_nudge(client, svc)
+        if nudge is not None and nudge > new_target:
+            new_target = min(nudge, reps["max"])
         if new_target != target:
             direction = "up" if new_target > target else "down"
             status["targetReplicas"] = new_target
@@ -600,6 +616,56 @@ class JAXServiceReconciler(Reconciler):
 
     # -- autoscaler ----------------------------------------------------------
 
+    def _consume_nudge(self, client, svc: dict) -> int | None:
+        """Read-and-clear the remediation scale nudge annotation.
+        Returns the requested floor (un-clamped), or None. The clear is
+        a merge patch deleting the key; clear failures leave the nudge
+        for the next reconcile (idempotent: it is a floor, not an
+        increment)."""
+        m = ob.meta(svc)
+        raw = (m.get("annotations") or {}).get(T.ANNOTATION_SCALE_NUDGE)
+        if raw is None:
+            return None
+        try:
+            resp = client.patch(
+                T.API_VERSION, T.KIND, m["name"],
+                {"metadata": {"annotations": {
+                    T.ANNOTATION_SCALE_NUDGE: None}}},
+                m["namespace"])
+            # rebind rv (and annotations) so the record-first status
+            # write later this reconcile doesn't 409 on the stale rv
+            m["resourceVersion"] = ob.meta(resp)["resourceVersion"]
+            m["annotations"] = dict(ob.meta(resp).get("annotations") or {})
+        except Exception:
+            log.warning("scale-nudge clear failed for %s/%s; will retry",
+                        m["namespace"], m["name"])
+        try:
+            return int(raw)
+        except (TypeError, ValueError):
+            log.warning("ignoring malformed scale nudge %r on %s/%s",
+                        raw, m["namespace"], m["name"])
+            return None
+
+    def _queue_slope(self, namespace: str, name: str,
+                     start: float, end: float) -> float:
+        """Summed least-squares slope (queue items/s) of every
+        ``router_queue_depth`` series for the service over the window —
+        the TSDB trend read behind predictive scale-up."""
+        total = 0.0
+        for _labels, pts in self.store.window(
+                "router_queue_depth",
+                {"namespace": namespace, "service": name}, start, end):
+            if len(pts) < 2:
+                continue
+            n = len(pts)
+            mt = sum(t for t, _ in pts) / n
+            mv = sum(v for _, v in pts) / n
+            denom = sum((t - mt) ** 2 for t, _ in pts)
+            if denom <= 0:
+                continue
+            total += sum((t - mt) * (v - mv) for t, v in pts) / denom
+        return total
+
     def _autoscale(self, svc: dict, target: int) -> int:
         """Demand-driven target with hysteresis. Deterministic given
         the clock and signal sequence — the serve_bench replay law."""
@@ -625,6 +691,19 @@ class JAXServiceReconciler(Reconciler):
             st["sample"] = (now, total)
         rate = st.get("rate", 0.0)
 
+        if self.store is not None:
+            # predictive scale-up: project the queue along its TSDB
+            # trend over the stabilization window. A positive slope
+            # raises effective demand NOW (the hysteresis window then
+            # confirms it); a negative slope never shrinks the signal —
+            # prediction accelerates scale-up only, scale-down keeps
+            # its observe-then-step gentleness.
+            window = auto["scaleUpStabilizationSeconds"]
+            slope = self._queue_slope(m["namespace"], m["name"],
+                                      now - window, now)
+            if slope > 0:
+                queue = max(queue, queue + slope * window)
+
         by_queue = math.ceil(queue / auto["targetQueueDepth"])
         by_rate = math.ceil(rate / auto["targetTokensPerSec"])
         demand = min(max(by_queue, by_rate, mn), mx)
@@ -649,7 +728,7 @@ class JAXServiceReconciler(Reconciler):
 
 def build_controller(client, record_events: bool = True, registry=None,
                      signals=None, clock=time.monotonic,
-                     cache: bool = True) -> Controller:
+                     cache: bool = True, store=None) -> Controller:
     """``cache=True`` (default) reads replica pods from an indexed
     ``ClusterCache`` keyed on the service label — zero per-reconcile
     list calls (the ISSUE 7 discipline, pinned in tests)."""
@@ -662,7 +741,8 @@ def build_controller(client, record_events: bool = True, registry=None,
             pod_labels=(T.LABEL_SERVICE_NAME,)).connect()
     rec = JAXServiceReconciler(record_events=record_events,
                                registry=registry, signals=signals,
-                               clock=clock, cache=cluster_cache)
+                               clock=clock, cache=cluster_cache,
+                               store=store)
     ctl = Controller("jaxservice", client, rec, registry=registry)
     if cluster_cache is not None:
         ctl.uses(cluster_cache)

@@ -55,6 +55,13 @@ LABEL_REPLICA_INDEX = "jaxservice.kubeflow.org/replica-index"
 # tokens for it — the drain state machine in docs/serving.md.
 ANNOTATION_CORDON = "jaxservice.kubeflow.org/cordon"
 
+# One-shot replica floor on the JAXSERVICE, written by the alert-driven
+# remediation engine (obs/remediate.py, KVPagesExhausted -> scale up).
+# The autoscaler consumes and CLEARS it inside its normal reconcile, so
+# the move flows through the record-first durable target write and the
+# max-replica clamp like any other scale decision.
+ANNOTATION_SCALE_NUDGE = "jaxservice.kubeflow.org/scale-nudge"
+
 # Env injected into replica containers
 ENV_SERVICE = "JAXSERVICE_NAME"
 ENV_REPLICA = "JAXSERVICE_REPLICA"

@@ -68,11 +68,26 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     import prometheus_client as prom
 
     prom.start_http_server(args.metrics_port)
+
+    # goodput_* export (the PR 10 ledger finally leaves the process):
+    # every controller manager publishes its span-stream accounting
+    # into the registry its /metrics endpoint serves, so the fleet
+    # scrape plane aggregates goodput like any other series.
+    # TPU_GOODPUT_CHIPS sizes chip-seconds-lost; 0 disables the loop.
+    from kubeflow_tpu.obs.goodput import GoodputExporter
+
+    goodput_chips = int(os.environ.get("TPU_GOODPUT_CHIPS", "1") or 0)
+    goodput_exporter = None
+    if goodput_chips > 0:
+        goodput_exporter = GoodputExporter(chips=goodput_chips).start()
+
     ctl.run(workers=2)
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
     stop.wait()
     ctl.stop()
+    if goodput_exporter is not None:
+        goodput_exporter.stop()
     if elector is not None:
         elector.release()  # immediate hand-off on clean shutdown

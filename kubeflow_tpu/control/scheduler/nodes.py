@@ -54,6 +54,9 @@ class NodeView:
     # spot/preemptible pool membership (LABEL_SPOT): lowest-priority
     # capacity — preferred for elastic gangs, reclaimed without notice
     spot: bool = False
+    # spec.unschedulable (kubectl cordon / the remediation engine's
+    # cordon-and-drain): existing pods keep running, nothing new lands
+    unschedulable: bool = False
 
 
 def new_tpu_node(
@@ -112,6 +115,7 @@ def node_view(node: dict) -> NodeView:
         ready=ready,
         taints=taints,
         spot=labels.get(LABEL_SPOT) == "true",
+        unschedulable=bool((node.get("spec") or {}).get("unschedulable")),
     )
 
 
@@ -151,9 +155,10 @@ def tolerates(pod: dict, taint: dict) -> bool:
 
 def feasible(pod: dict, view: NodeView) -> bool:
     """Can this pod land on this node at all (ignoring free capacity)?
-    NotReady nodes and untolerated NoSchedule/NoExecute taints — which
-    include the impending-TPU-maintenance taint — exclude the node."""
-    if not view.ready:
+    NotReady nodes, cordoned (spec.unschedulable) nodes, and
+    untolerated NoSchedule/NoExecute taints — which include the
+    impending-TPU-maintenance taint — exclude the node."""
+    if not view.ready or view.unschedulable:
         return False
     if not selector_matches(pod, view):
         return False

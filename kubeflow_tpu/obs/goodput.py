@@ -291,3 +291,83 @@ class ServingSLO:
         total = sum(v for _, v in ev.query(
             f"increase(router_request_seconds_count{match}{win})", at))
         return self._status(fast, total)
+
+
+# -- the goodput exporter -----------------------------------------------------
+
+
+class GoodputExporter:
+    """Publish the goodput ledger as ``goodput_*`` series.
+
+    The PR 10 ledger could be *queried* (``GET /api/goodput``) but no
+    production process ever exported it — fleet dashboards had nothing
+    to scrape. This exporter closes that open: each ``export_once``
+    recomputes the report from the process's span stream and publishes
+
+    - ``goodput_ratio``                      (0..1)
+    - ``goodput_wall_seconds``               (accounted window)
+    - ``goodput_bucket_seconds{bucket=}``    (per-cause time)
+    - ``goodput_chip_seconds_lost{cause=}``  (per-cause chip cost)
+
+    into the MetricsRegistry, so the scrape plane picks them up like
+    any other series. ``run_controller`` mains start one; harnesses
+    call ``export_once(at=...)`` on virtual time."""
+
+    def __init__(self, registry=None, collector=None, chips: int = 1,
+                 interval_s: float = 30.0):
+        from kubeflow_tpu.obs import trace as obs_trace
+        from kubeflow_tpu.runtime.metrics import REGISTRY
+
+        self.registry = registry if registry is not None else REGISTRY
+        self.collector = collector if collector is not None \
+            else obs_trace.COLLECTOR
+        self.chips = max(int(chips), 1)
+        self.interval_s = interval_s
+        self._thread = None
+        self._stop = None
+
+    def export_once(self, at: float | None = None) -> GoodputReport:
+        spans = self.collector.spans()
+        report = job_report(spans, chips=self.chips, window_end=at)
+        self.registry.gauge("goodput_ratio", report.goodput,
+                            help_="fraction of wall time in productive "
+                                  "steps (0..1)")
+        self.registry.gauge("goodput_wall_seconds", report.wall_s,
+                            help_="wall-clock window the ledger "
+                                  "accounted")
+        for name, secs in sorted(report.buckets.items()):
+            self.registry.gauge("goodput_bucket_seconds", secs,
+                                help_="accounted seconds by cause",
+                                bucket=name)
+        for cause, cost in sorted(report.chip_seconds_lost().items()):
+            self.registry.gauge("goodput_chip_seconds_lost", cost,
+                                help_="chip-seconds lost by "
+                                      "non-productive cause", cause=cause)
+        return report
+
+    def start(self) -> "GoodputExporter":  # pragma: no cover - thread
+        import threading
+
+        if self._thread is None:
+            self._stop = threading.Event()
+            self._thread = threading.Thread(
+                target=self._run, name="goodput-export", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:  # pragma: no cover - thread shell
+        if self._stop is not None:
+            self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _run(self) -> None:  # pragma: no cover - thread shell
+        import logging
+
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.export_once()
+            except Exception:  # telemetry must never kill the process
+                logging.getLogger("kubeflow_tpu.obs.goodput").exception(
+                    "goodput export failed")

@@ -1,18 +1,28 @@
 """FleetPlane — the assembled observability plane, one handle.
 
-Bundles the three ISSUE-10 layers (``tsdb`` scrape plane, ``rules``
-engine, ``goodput`` accounting) behind the object the dashboard routes
-(``GET /api/alerts`` / ``/api/query`` / ``/api/goodput``) and
+Bundles the ISSUE-10 layers (``tsdb`` scrape plane, ``rules`` engine,
+``goodput`` accounting) plus the ISSUE-13 closing-the-loop layers —
+the alert-driven ``RemediationEngine``, per-alert routing, and
+silences — behind the object the dashboard routes (``GET /api/alerts``
+/ ``/api/query`` / ``/api/goodput`` / ``/api/silences``) and
 ``run_controller``-style mains wire up. Hermetic harnesses build their
 own with fake clocks; a process that just wants "the plane" uses the
 module-level ``default_plane()`` singleton (the REGISTRY/COLLECTOR/
 TRACER convention from runtime/metrics.py and obs/trace.py).
+
+Routing and silences follow Alertmanager's split: a *route* maps an
+alert (by severity and label matchers) to a receiver name — operators
+read it off ``route_for``; a *silence* (matchers + expiry) mutes
+notification and remediation for matching alerts WITHOUT touching the
+alert state machine, so un-silencing reveals true current state.
 """
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
+from dataclasses import dataclass, field
 from typing import Callable
 
 from kubeflow_tpu.obs import goodput as gp
@@ -21,12 +31,95 @@ from kubeflow_tpu.obs.rules import RuleEngine, default_rule_pack
 from kubeflow_tpu.obs.tsdb import ScrapeLoop, Target, TimeSeriesStore
 
 
+@dataclass
+class Route:
+    """severity + label matchers -> receiver. First match wins; a
+    ``severity`` of "" matches every severity."""
+
+    receiver: str
+    severity: str = ""
+    matchers: dict = field(default_factory=dict)
+
+
+DEFAULT_ROUTES = (
+    Route(receiver="page", severity="critical"),
+    Route(receiver="ticket", severity="warning"),
+    Route(receiver="log"),
+)
+
+
+class SilenceStore:
+    """Bounded set of active silences (id, matchers, until, comment).
+
+    ``silenced(alertname, labels, at)`` is the predicate both the rule
+    engine (Events) and the remediation engine (actions) consult; a
+    matcher key of ``alertname`` matches the rule name, every other
+    key matches the alert's labels. Expired silences are pruned on
+    every read."""
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 limit: int = 256):
+        self.clock = clock
+        self.limit = limit
+        self._lock = threading.Lock()
+        self._silences: dict[str, dict] = {}
+        self._ids = itertools.count(1)
+
+    def add(self, matchers: dict, until: float,
+            comment: str = "", created_by: str = "") -> dict:
+        if not matchers:
+            raise ValueError("a silence needs at least one matcher")
+        with self._lock:
+            self._prune(self.clock())
+            if len(self._silences) >= self.limit:
+                raise ValueError("silence store full")
+            sid = f"s{next(self._ids)}"
+            entry = {"id": sid,
+                     "matchers": {str(k): str(v)
+                                  for k, v in matchers.items()},
+                     "until": float(until), "comment": comment,
+                     "createdBy": created_by,
+                     "createdAt": self.clock()}
+            self._silences[sid] = entry
+            return dict(entry)
+
+    def delete(self, sid: str) -> bool:
+        with self._lock:
+            return self._silences.pop(sid, None) is not None
+
+    def list(self, at: float | None = None) -> list[dict]:
+        now = self.clock() if at is None else at
+        with self._lock:
+            self._prune(now)
+            return [dict(s) for _, s in sorted(self._silences.items())]
+
+    def silenced(self, alertname: str, labels: dict,
+                 at: float | None = None) -> bool:
+        now = self.clock() if at is None else at
+        with self._lock:
+            self._prune(now)
+            for s in self._silences.values():
+                if all(alertname == v if k == "alertname"
+                       else (labels or {}).get(k) == v
+                       for k, v in s["matchers"].items()):
+                    return True
+        return False
+
+    def _prune(self, now: float) -> None:
+        dead = [sid for sid, s in self._silences.items()
+                if s["until"] <= now]
+        for sid in dead:
+            del self._silences[sid]
+
+
 class FleetPlane:
-    """store + scraper + rule engine + goodput reads, one lifecycle.
+    """store + scraper + rule engine + goodput reads + remediation,
+    one lifecycle.
 
     ``tick()`` is the deterministic unit (one scrape cycle + one rule
-    pass at the shared clock) — drills, tests and the bench drive it on
-    virtual time; ``start()``/``stop()`` run it on wall time."""
+    pass + one remediation pass at the shared clock) — drills, tests
+    and the bench drive it on virtual time; ``start()``/``stop()`` run
+    it on wall time."""
 
     def __init__(self, registry=None, recorder=None,
                  targets: list[Target] = (),
@@ -36,7 +129,9 @@ class FleetPlane:
                  clock: Callable[[], float] = time.time,
                  collector: "obs_trace.TraceCollector | None" = None,
                  max_points: int = 512, max_series: int = 50000,
-                 lookback_s: float | None = None):
+                 lookback_s: float | None = None,
+                 remediator=None,
+                 routes: tuple = DEFAULT_ROUTES):
         from kubeflow_tpu.runtime.metrics import REGISTRY
 
         self.registry = registry if registry is not None else REGISTRY
@@ -48,6 +143,7 @@ class FleetPlane:
         self.scraper = ScrapeLoop(
             self.store, targets=targets, discover=discover,
             interval_s=interval_s, clock=clock, registry=self.registry)
+        self.silences = SilenceStore(clock=clock)
         # instant-selector lookback tracks the scrape interval: a
         # series is "current" while it misses fewer than ~4 scrapes
         self.engine = RuleEngine(
@@ -55,7 +151,15 @@ class FleetPlane:
             rules=default_rule_pack() if rules is None else rules,
             recorder=recorder, registry=self.registry, clock=clock,
             lookback_s=(lookback_s if lookback_s is not None
-                        else max(interval_s * 4, 60.0)))
+                        else max(interval_s * 4, 60.0)),
+            silenced=self.silences.silenced)
+        # alert-driven remediation (obs/remediate.py). The plane owns
+        # the silence hookup so an operator's POST /api/silences mutes
+        # both notification AND action in one move.
+        self.remediator = remediator
+        if remediator is not None and remediator.silenced is None:
+            remediator.silenced = self.silences.silenced
+        self.routes: tuple = tuple(routes)
         self.slos = [gp.ServingSLO()]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
@@ -63,16 +167,46 @@ class FleetPlane:
     # -- deterministic core --------------------------------------------------
 
     def tick(self, at: float | None = None) -> dict:
-        """One scrape + rule pass; returns {'scrape': ..., 'transitions':
-        [...]} — the unit the bench fingerprints."""
+        """One scrape + rule pass + remediation pass; returns
+        {'scrape': ..., 'transitions': [...], 'remediations': [...]} —
+        the unit the benches fingerprint."""
         scrape = self.scraper.scrape_once()
         transitions = self.engine.evaluate_once(at=at)
-        return {"scrape": scrape, "transitions": transitions}
+        remediations: list = []
+        if self.remediator is not None:
+            remediations = self.remediator.observe(transitions, at=at)
+        return {"scrape": scrape, "transitions": transitions,
+                "remediations": remediations}
+
+    # -- routing -------------------------------------------------------------
+
+    def route_for(self, alertname: str, severity: str,
+                  labels: dict | None = None) -> str:
+        """First-match routing: the receiver this alert notifies."""
+        for r in self.routes:
+            if r.severity and r.severity != severity:
+                continue
+            if any((labels or {}).get(k) != v
+                   for k, v in r.matchers.items()):
+                continue
+            return r.receiver
+        return "log"
 
     # -- dashboard reads -----------------------------------------------------
 
     def alerts(self) -> dict:
-        return {"alerts": self.engine.active_alerts()}
+        out = self.engine.active_alerts()
+        by_name = {r.name: r for r in self.engine.rules
+                   if hasattr(r, "severity")}
+        now = self.clock()
+        for a in out:
+            rule = by_name.get(a["alert"])
+            a["severity"] = rule.severity if rule else "warning"
+            a["receiver"] = self.route_for(
+                a["alert"], a["severity"], a["labels"])
+            a["silenced"] = self.silences.silenced(
+                a["alert"], a["labels"], now)
+        return {"alerts": out}
 
     def query(self, text: str, at: float | None = None) -> dict:
         result = self.engine.query(text, at=at)
@@ -91,6 +225,11 @@ class FleetPlane:
                                window_s=window_s or 300.0)
                 for slo in self.slos]
         return {"training": report.check().to_dict(), "serving": slos}
+
+    def remediation_audit(self) -> dict:
+        if self.remediator is None:
+            return {"audit": []}
+        return {"audit": self.remediator.audit()}
 
     # -- thread shell --------------------------------------------------------
 

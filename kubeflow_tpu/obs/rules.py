@@ -652,12 +652,19 @@ class RuleEngine:
                  rules: list | None = None,
                  recorder=None, registry=None,
                  clock: Callable[[], float] = time.time,
-                 lookback_s: float = DEFAULT_LOOKBACK_S):
+                 lookback_s: float = DEFAULT_LOOKBACK_S,
+                 silenced: Callable[[str, dict, float], bool] | None = None):
         self.store = store
         self.rules: list = list(rules or [])
         self.recorder = recorder
         self.registry = registry
         self.clock = clock
+        # silenced(alertname, labels, now) -> bool. Alertmanager
+        # semantics: a silence mutes NOTIFICATION (the k8s Events),
+        # never the state machine — the alert still walks
+        # pending/firing/resolved, still publishes gauges, still
+        # appears in transitions, so un-silencing reveals true state.
+        self.silenced = silenced
         self.evaluator = Evaluator(store, lookback_s=lookback_s)
         # (alert name, labels key) -> AlertState. One lock serializes
         # evaluation passes against dashboard reads: the FleetPlane
@@ -741,7 +748,14 @@ class RuleEngine:
 
     def _transition(self, rule: AlertRule, st: AlertState, to: str,
                     now: float) -> dict:
-        if self.recorder is not None and to in (FIRING, "resolved"):
+        muted = False
+        if self.silenced is not None:
+            try:
+                muted = bool(self.silenced(rule.name, st.labels, now))
+            except Exception:
+                log.exception("silence check failed")
+        if self.recorder is not None and not muted \
+                and to in (FIRING, "resolved"):
             involved = {
                 "apiVersion": "obs.kubeflow.org/v1",
                 "kind": "AlertRule",
@@ -817,23 +831,55 @@ class RuleEngine:
 
 
 def burn_rate_expr(latency_target_s: float, objective: float,
-                   window: str) -> str:
+                   window: str, by: str = "service") -> str:
     """Error-budget burn rate for the router latency SLO over one
     window: (fraction of requests slower than the target) divided by
     the budget (1 - objective). 1.0 = burning exactly the budget;
     >1 = burning faster. The bucket bound must exist in
-    ``REQUEST_BUCKETS`` — use a bound, not an arbitrary number."""
+    ``REQUEST_BUCKETS`` — use a bound, not an arbitrary number.
+    ``by`` picks the blast-radius dimension: ``service`` (the SLO as
+    the user sees it) or ``node`` (scoping a burn to the machine whose
+    replicas are producing it, the cordon-and-drain trigger)."""
     budget = max(1.0 - objective, 1e-9)
     # normalized through float(): the registry renders le bounds as
     # str(float) ("0.5", "1.0"), so an int-valued target must still
     # match the bucket series
     le = str(float(latency_target_s))
     return (
-        f"(1 - sum by (service) "
+        f"(1 - sum by ({by}) "
         f"(rate(router_request_seconds_bucket{{le=\"{le}\"}}"
-        f"[{window}])) / sum by (service) "
+        f"[{window}])) / sum by ({by}) "
         f"(rate(router_request_seconds_count[{window}]))) / {budget}"
     )
+
+
+def node_burn_rules(latency_target_s: float = 0.5,
+                    objective: float = 0.99,
+                    short_window: str = "1m",
+                    long_window: str = "5m",
+                    burn_threshold: float = 1.0) -> list:
+    """Node-scoped burn: the same multi-window SLO-burn shape as the
+    router rules, grouped by the ``node`` label replicas stamp on
+    their request histograms. A single machine burning the budget
+    while the service-wide burn stays green is the cordon-and-drain
+    signal — the remediation engine's node action requires the
+    ``node`` label this grouping provides."""
+    short_burn = burn_rate_expr(latency_target_s, objective,
+                                short_window, by="node")
+    long_burn = burn_rate_expr(latency_target_s, objective,
+                               long_window, by="node")
+    return [
+        RecordingRule("slo:node_burn:short", short_burn),
+        RecordingRule("slo:node_burn:long", long_burn),
+        AlertRule(
+            "NodeSLOBurn",
+            f"slo:node_burn:short > {burn_threshold} "
+            f"and slo:node_burn:long > {burn_threshold}",
+            for_s=30.0, severity="critical",
+            summary=f"a node's replicas are burning the latency error "
+                    f"budget >{burn_threshold}x (target "
+                    f"{latency_target_s}s @ {objective:.2%})"),
+    ]
 
 
 def default_rule_pack(latency_target_s: float = 0.5,

@@ -161,6 +161,23 @@ class TimeSeriesStore:
                     out.append((dict(key[1]), pts))
         return out
 
+    def dump_since(self, since: float | None = None,
+                   ) -> list[tuple[str, dict, list[tuple[float, float]]]]:
+        """Every series' points with ``t > since`` (None = everything),
+        in deterministic (name, labels) order — the persistence read
+        (``obs/persist.py``). Staleness markers are INCLUDED: a restore
+        must reproduce them or a dead target's series would look live
+        again."""
+        out: list[tuple[str, dict, list[tuple[float, float]]]] = []
+        with self._lock:
+            for name in sorted(self._by_name):
+                for key in sorted(self._by_name[name]):
+                    pts = [(t, v) for t, v in self._series[key]
+                           if since is None or t > since]
+                    if pts:
+                        out.append((name, dict(key[1]), pts))
+        return out
+
     def latest(self, key: SeriesKey) -> tuple[float, float] | None:
         with self._lock:
             ring = self._series.get(key)

@@ -394,6 +394,52 @@ class Dashboard:
                 400, "chips must be an int, window_s a number")
         return self._plane().goodput(chips=chips_i, window_s=window_f)
 
+    def silences(self, req: HttpReq):
+        """Active silences: GET lists, POST creates (body:
+        {"matchers": {...}, "until": <unix-s> | "duration_s": <s>,
+        "comment": ...}), DELETE /api/silences/{id} expires one. A
+        silence mutes notification Events AND remediation actions for
+        matching alerts; the alert state machine keeps running."""
+        user = self._user(req)
+        plane = self._plane()
+        if req.method == "GET":
+            return {"silences": plane.silences.list()}
+        try:
+            body = req.json()
+        except ValueError:
+            raise ApiHttpError(400, "body must be JSON")
+        if not isinstance(body, dict) or \
+                not isinstance(body.get("matchers"), dict):
+            raise ApiHttpError(
+                400, "body needs a matchers object "
+                     "(e.g. {\"alertname\": \"KVPagesExhausted\"})")
+        until = body.get("until")
+        if until is None and body.get("duration_s") is not None:
+            try:
+                until = plane.clock() + float(body["duration_s"])
+            except (TypeError, ValueError):
+                raise ApiHttpError(400, "duration_s must be a number")
+        try:
+            until_f = float(until)
+        except (TypeError, ValueError):
+            raise ApiHttpError(
+                400, "silence needs until=<unix seconds> or "
+                     "duration_s=<seconds>")
+        try:
+            entry = plane.silences.add(
+                body["matchers"], until_f,
+                comment=str(body.get("comment", "")), created_by=user)
+        except ValueError as e:
+            raise ApiHttpError(400, str(e))
+        return 201, entry
+
+    def delete_silence(self, req: HttpReq):
+        self._user(req)
+        sid = req.params["id"]
+        if not self._plane().silences.delete(sid):
+            raise ApiHttpError(404, f"no silence {sid!r}")
+        return 200, {"deleted": sid}
+
     # -- wiring -------------------------------------------------------------
 
     def router(self) -> Router:
@@ -418,6 +464,9 @@ class Dashboard:
         r.route("GET", "/api/alerts", self.alerts)
         r.route("GET", "/api/query", self.obs_query)
         r.route("GET", "/api/goodput", self.goodput)
+        r.route("GET", "/api/silences", self.silences)
+        r.route("POST", "/api/silences", self.silences)
+        r.route("DELETE", "/api/silences/{id}", self.delete_silence)
         # browser UI (the Polymer SPA equivalent, webapps/dashboard_ui.py)
         from kubeflow_tpu.webapps.dashboard_ui import add_ui_routes
 

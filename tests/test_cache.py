@@ -897,3 +897,44 @@ class TestControllerCacheWiring:
         ctl.enqueue(Request("default", "chat"))
         self._drain(ctl, kubelet)
         assert cluster.stats["list_calls"] == 0, dict(cluster.stats)
+
+
+class TestMarkDirty:
+    """The remediation engine's watch-gap repair path (ISSUE 13): force
+    a wholesale relist of cached kinds without restarting the cache."""
+
+    def test_mark_dirty_all_kinds_relists_on_refresh(self):
+        cluster = FakeCluster()
+        cluster.create(N.new_tpu_node("n0"))
+        cache = ClusterCache(cluster).connect()
+        base = cache.stats()["relists"]
+        marked = cache.mark_dirty()
+        assert marked == len(cache._subs)
+        cache.refresh()
+        assert cache.stats()["relists"] == base + marked
+        assert_cache_equals_relist(cache, cluster)
+
+    def test_mark_dirty_scoped_to_named_kinds(self):
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        base = cache.stats()["relists"]
+        assert cache.mark_dirty([NODE]) == 1
+        cache.refresh()
+        assert cache.stats()["relists"] == base + 1
+
+    def test_mark_dirty_repairs_a_silently_desynced_index(self):
+        """The incident the action exists for: a watch gap leaves the
+        snapshot stale; mark_dirty + refresh restores relist parity."""
+        cluster = FakeCluster()
+        cluster.create(N.new_tpu_node("n0"))
+        cache = ClusterCache(cluster).connect()
+        cache.refresh()
+        # simulate a dropped watch event: mutate the cluster while the
+        # cache's streams are silently broken
+        for sub in cache._subs:
+            sub.stream = None
+        cluster.create(N.new_tpu_node("n1"))
+        assert "n1" not in cache.node_views()
+        cache.mark_dirty([NODE])
+        cache.refresh()
+        assert "n1" in cache.node_views()

@@ -1088,3 +1088,141 @@ class TestServeBenchContract:
             assert sb.check_router_bench(str(slow_path)) == 1
         finally:
             sb.PHASES = old_phases
+
+
+# -- remediation nudge + predictive autoscaling (ISSUE 13) --------------------
+
+
+class TestScaleNudge:
+    """The obs/remediate.py -> autoscaler handshake: a one-shot floor
+    annotation, consumed (cleared) inside the normal reconcile so it
+    flows through the record-first durable target move."""
+
+    def _nudge(self, cluster, value, name="chat"):
+        cluster.patch(T.API_VERSION, T.KIND, name,
+                      {"metadata": {"annotations": {
+                          T.ANNOTATION_SCALE_NUDGE: value}}}, "default")
+
+    def test_nudge_raises_target_and_is_consumed(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1, max_replicas=4)
+        drain(ctl, kubelet)
+        self._nudge(cluster, "3")
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] == 3
+        assert svc["status"]["scales"] == 1
+        # one-shot: the annotation was cleared in the same reconcile
+        assert T.ANNOTATION_SCALE_NUDGE not in (
+            ob.annotations_of(svc) or {})
+        pods = cluster.list("v1", "Pod", namespace="default")
+        assert {ob.meta(p)["name"] for p in pods} == {rep(i)
+                                                      for i in range(3)}
+
+    def test_nudge_clamps_to_max_replicas(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1, max_replicas=4)
+        drain(ctl, kubelet)
+        self._nudge(cluster, "99")
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] == 4
+
+    def test_nudge_is_a_floor_never_a_scale_down(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=3, max_replicas=4)
+        drain(ctl, kubelet)
+        self._nudge(cluster, "2")
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"].get("targetReplicas", 3) == 3
+        assert svc["status"].get("scales", 0) == 0
+        assert T.ANNOTATION_SCALE_NUDGE not in (
+            ob.annotations_of(svc) or {})
+
+    def test_malformed_nudge_is_cleared_and_ignored(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster, min_replicas=1, max_replicas=4)
+        drain(ctl, kubelet)
+        self._nudge(cluster, "lots")
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"].get("targetReplicas", 1) == 1
+        assert T.ANNOTATION_SCALE_NUDGE not in (
+            ob.annotations_of(svc) or {})
+
+
+def predictive_world(store, up_s=10.0):
+    """signal_world wired with a fleet TSDB: the controller reads
+    router_queue_depth trends from ``store`` for predictive scale-up."""
+    clock = ManualClock()
+    cluster = FakeCluster()
+    registry = MetricsRegistry()
+    signals = RegistrySignals(registry)
+    ctl = seed_controller(build_controller(
+        cluster, record_events=False, registry=registry,
+        signals=signals, clock=clock, store=store))
+    kubelet = FakeKubelet(cluster)
+    cluster.create(T.new_jaxservice(
+        "chat", model="gpt-125m", min_replicas=1, max_replicas=8,
+        target_queue_depth=4, target_tokens_per_sec=1e9,
+        up_stabilization_s=up_s, down_stabilization_s=300.0))
+    return cluster, ctl, kubelet, registry, clock
+
+
+class TestPredictiveAutoscaling:
+    def _rising_store(self):
+        from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+
+        store = TimeSeriesStore()
+        # queue growing 2 items/s across the stabilization window
+        for k, t in enumerate((2.0, 4.0, 6.0, 8.0, 10.0)):
+            store.append("router_queue_depth",
+                         {"namespace": "default", "service": "chat"},
+                         4.0 * (k + 1), t)
+        return store
+
+    def test_rising_trend_raises_the_confirmed_target(self):
+        store = self._rising_store()
+        cluster, ctl, kubelet, reg, clock = predictive_world(store)
+        reg.gauge("router_queue_depth", 8.0, namespace="default",
+                  service="chat")
+        drain(ctl, kubelet)  # demand seen, hysteresis pending
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        # prediction never bypasses the stabilization window
+        assert svc["status"].get("targetReplicas", 1) == 1
+        clock.advance(11.0)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        # slope 2/s projected over the 10s window: queue 8 -> 28,
+        # ceil(28/4) = 7 — capacity lands BEFORE the queue does
+        assert svc["status"]["targetReplicas"] == 7
+
+    def test_without_store_same_signals_scale_reactively(self):
+        cluster, ctl, kubelet, reg, clock = predictive_world(None)
+        reg.gauge("router_queue_depth", 8.0, namespace="default",
+                  service="chat")
+        drain(ctl, kubelet)
+        clock.advance(11.0)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        assert svc["status"]["targetReplicas"] == 2  # ceil(8/4) only
+
+    def test_falling_trend_never_shrinks_the_signal(self):
+        from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+
+        store = TimeSeriesStore()
+        for k, t in enumerate((2.0, 4.0, 6.0, 8.0, 10.0)):
+            store.append("router_queue_depth",
+                         {"namespace": "default", "service": "chat"},
+                         40.0 - 10.0 * k, t)
+        cluster, ctl, kubelet, reg, clock = predictive_world(store)
+        reg.gauge("router_queue_depth", 8.0, namespace="default",
+                  service="chat")
+        drain(ctl, kubelet)
+        clock.advance(11.0)
+        drain(ctl, kubelet)
+        svc = cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+        # prediction accelerates scale-UP only: the negative slope is
+        # ignored and the instantaneous queue drives the target
+        assert svc["status"]["targetReplicas"] == 2

@@ -651,3 +651,58 @@ class TestEndToEnd:
         doc = get("/api/traces")
         assert {e["name"] for e in doc["traceEvents"]} >= {
             "reconcile", "scheduler.admit", "scheduler.bind"}
+
+
+class TestAlertFlapAmplification:
+    """ISSUE 13 regression: a series oscillating around its threshold
+    below the rule's for-duration walks pending -> inactive forever —
+    that oscillation must NEVER reach the remediation engine (no
+    action, no audit entry) and must never burn an action's cooldown,
+    so the first sustained breach still remediates instantly."""
+
+    def test_pending_inactive_flaps_never_remediate_or_burn_cooldown(self):
+        from kubeflow_tpu.obs.remediate import (
+            EXECUTED,
+            Remediation,
+            RemediationEngine,
+        )
+        from kubeflow_tpu.obs.rules import AlertRule, RuleEngine
+        from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+
+        clock = FakeClock()
+        store = TimeSeriesStore()
+        rules = RuleEngine(
+            store,
+            rules=[AlertRule(name="Flappy", expr="pressure > 10",
+                             for_s=60.0)],
+            registry=MetricsRegistry(), clock=clock)
+        ran = []
+        engine = RemediationEngine(
+            [Remediation("fix", "Flappy",
+                         lambda tr: ran.append(tr) or "acted",
+                         cooldown_s=600.0)],
+            registry=MetricsRegistry(), clock=clock)
+
+        # 20 flap cycles at the 15s scrape cadence: one breach sample,
+        # one clear sample — the alert enters pending and drops back to
+        # inactive before for_s ever elapses
+        decisions = []
+        for i in range(20):
+            t = i * 30.0
+            store.append("pressure", {"zone": "a"}, 99.0, t)
+            decisions += engine.observe(rules.evaluate_once(at=t), at=t)
+            store.append("pressure", {"zone": "a"}, 1.0, t + 15.0)
+            decisions += engine.observe(
+                rules.evaluate_once(at=t + 15.0), at=t + 15.0)
+        assert ran == []
+        assert decisions == [] and engine.audit() == []
+
+        # the real incident: sustained breach past for_s fires and the
+        # action runs IMMEDIATELY — no flap burned the 600s cooldown
+        t0 = 20 * 30.0
+        for k in range(6):
+            t = t0 + k * 15.0
+            store.append("pressure", {"zone": "a"}, 99.0, t)
+            decisions += engine.observe(rules.evaluate_once(at=t), at=t)
+        assert [d["result"] for d in decisions] == [EXECUTED]
+        assert len(ran) == 1

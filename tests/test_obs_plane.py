@@ -1021,3 +1021,341 @@ class TestObsBenchContract:
             "CheckpointFailures", "KVPagesExhausted",
             "ReconcileErrorRate", "RouterLatencySLOBurn",
             "SchedulerPassSlow"]
+
+
+# -- silences + routing (ISSUE 13 satellite a) --------------------------------
+
+
+class TestSilenceStore:
+    def _store(self):
+        from kubeflow_tpu.obs.plane import SilenceStore
+
+        clock = ManualClock()
+        return SilenceStore(clock=clock), clock
+
+    def test_alertname_matcher_matches_rule_name(self):
+        store, _ = self._store()
+        store.add({"alertname": "KVPagesExhausted"}, until=100.0)
+        assert store.silenced("KVPagesExhausted",
+                              {"service": "chat"}, at=0.0)
+        assert not store.silenced("NodeSLOBurn", {}, at=0.0)
+
+    def test_label_matchers_must_all_match(self):
+        store, _ = self._store()
+        store.add({"alertname": "A", "namespace": "prod"}, until=100.0)
+        assert store.silenced("A", {"namespace": "prod"}, at=0.0)
+        assert not store.silenced("A", {"namespace": "dev"}, at=0.0)
+        assert not store.silenced("A", {}, at=0.0)
+
+    def test_expiry_prunes_and_unmutes(self):
+        store, clock = self._store()
+        store.add({"alertname": "A"}, until=50.0)
+        assert store.silenced("A", {}, at=49.0)
+        assert not store.silenced("A", {}, at=50.0)  # until <= now
+        clock.t = 60.0
+        assert store.list() == []  # pruned on read
+
+    def test_add_validates_and_delete_round_trips(self):
+        store, _ = self._store()
+        with pytest.raises(ValueError):
+            store.add({}, until=100.0)
+        entry = store.add({"alertname": "A"}, until=100.0,
+                          comment="maint", created_by="alice")
+        assert entry["id"] == "s1"
+        assert [s["id"] for s in store.list(at=0.0)] == ["s1"]
+        assert store.delete("s1") is True
+        assert store.delete("s1") is False
+
+    def test_store_capacity_is_bounded(self):
+        from kubeflow_tpu.obs.plane import SilenceStore
+
+        store = SilenceStore(clock=ManualClock(), limit=2)
+        store.add({"a": "1"}, until=100.0)
+        store.add({"a": "2"}, until=100.0)
+        with pytest.raises(ValueError):
+            store.add({"a": "3"}, until=100.0)
+
+
+class TestSilencedRuleEngine:
+    def test_silence_mutes_events_but_not_the_state_machine(self):
+        """Alertmanager semantics: a silenced alert still walks
+        pending/firing/resolved and still publishes gauges — only the
+        notification Events (and remediation) are muted."""
+        clock = ManualClock()
+        store = TimeSeriesStore()
+        cluster = FakeCluster()
+        muted = {"on": True}
+        eng = R.RuleEngine(
+            store,
+            rules=[R.AlertRule(name="Hot", expr="temp > 10",
+                               for_s=0.0)],
+            recorder=EventRecorder(cluster),
+            registry=MetricsRegistry(), clock=clock,
+            silenced=lambda alert, labels, at: muted["on"])
+        store.append("temp", {"zone": "a"}, 99.0, 10.0)
+        trs = eng.evaluate_once(at=10.0)
+        assert [t["to"] for t in trs] == ["pending", "firing"]
+        assert cluster.list("v1", "Event", namespace="default") == []
+        # silence lifts -> the next transition notifies again
+        muted["on"] = False
+        store.append("temp", {"zone": "a"}, 1.0, 20.0)
+        (t2,) = eng.evaluate_once(at=20.0)
+        assert t2["to"] == "resolved"
+        reasons = [e["reason"] for e in
+                   cluster.list("v1", "Event", namespace="default")]
+        assert reasons == ["AlertResolved"]
+
+
+class TestRouting:
+    def test_first_match_routing_by_severity_and_matchers(self):
+        from kubeflow_tpu.obs.plane import Route
+
+        plane = FleetPlane(
+            registry=MetricsRegistry(), targets=[],
+            clock=ManualClock(), collector=tr.TraceCollector(),
+            routes=(
+                Route(receiver="prod-page", severity="critical",
+                      matchers={"namespace": "prod"}),
+                Route(receiver="page", severity="critical"),
+                Route(receiver="ticket", severity="warning"),
+                Route(receiver="log"),
+            ))
+        assert plane.route_for("A", "critical",
+                               {"namespace": "prod"}) == "prod-page"
+        assert plane.route_for("A", "critical",
+                               {"namespace": "dev"}) == "page"
+        assert plane.route_for("A", "warning", {}) == "ticket"
+        assert plane.route_for("A", "info", {}) == "log"
+
+    def test_alerts_read_enriched_with_severity_receiver_silenced(self):
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("temp", 99.0, zone="a")
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            targets=[RegistryTarget("t", reg)],
+            clock=clock, collector=tr.TraceCollector(),
+            rules=[R.AlertRule(name="Hot", expr="temp > 10",
+                               for_s=0.0, severity="critical")])
+        plane.tick(at=0.0)
+        (alert,) = plane.alerts()["alerts"]
+        assert alert["severity"] == "critical"
+        assert alert["receiver"] == "page"
+        assert alert["silenced"] is False
+        plane.silences.add({"alertname": "Hot"}, until=1000.0)
+        (alert,) = plane.alerts()["alerts"]
+        assert alert["silenced"] is True
+
+
+class TestPlaneRemediation:
+    def test_tick_runs_remediation_and_audit_is_readable(self):
+        from kubeflow_tpu.obs.remediate import (
+            Remediation, RemediationEngine,
+        )
+
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("temp", 99.0, zone="a")
+        ran = []
+        engine = RemediationEngine(
+            [Remediation("cool", "Hot",
+                         lambda trn: ran.append(trn) or "cooled")],
+            registry=MetricsRegistry(), clock=clock)
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            targets=[RegistryTarget("t", reg)],
+            clock=clock, collector=tr.TraceCollector(),
+            rules=[R.AlertRule(name="Hot", expr="temp > 10",
+                               for_s=0.0)],
+            remediator=engine)
+        out = plane.tick(at=0.0)
+        assert [d["result"] for d in out["remediations"]] == ["executed"]
+        assert len(ran) == 1
+        (entry,) = plane.remediation_audit()["audit"]
+        assert entry["action"] == "cool" and entry["alert"] == "Hot"
+
+    def test_plane_silence_mutes_remediation_too(self):
+        """The plane owns the hookup: one POST /api/silences mutes
+        notification AND action."""
+        from kubeflow_tpu.obs.remediate import (
+            Remediation, RemediationEngine,
+        )
+
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        reg.gauge("temp", 99.0, zone="a")
+        ran = []
+        engine = RemediationEngine(
+            [Remediation("cool", "Hot",
+                         lambda trn: ran.append(trn) or "")],
+            registry=MetricsRegistry(), clock=clock)
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            targets=[RegistryTarget("t", reg)],
+            clock=clock, collector=tr.TraceCollector(),
+            rules=[R.AlertRule(name="Hot", expr="temp > 10",
+                               for_s=0.0)],
+            remediator=engine)
+        plane.silences.add({"alertname": "Hot"}, until=1000.0)
+        out = plane.tick(at=0.0)
+        assert [d["result"] for d in out["remediations"]] \
+            == ["silenced"]
+        assert ran == []
+
+
+class TestSilencesApi:
+    def _dash(self):
+        from kubeflow_tpu.utils.httpd import HttpReq
+        from kubeflow_tpu.webapps.dashboard import Dashboard
+
+        clock = ManualClock()
+        plane = FleetPlane(registry=MetricsRegistry(), targets=[],
+                           clock=clock, collector=tr.TraceCollector())
+        router = Dashboard(FakeCluster(), plane=plane).router()
+
+        def call(method, path, body=None, params=None):
+            resp = router.dispatch(HttpReq(
+                method=method, path=path, params=params or {},
+                query={},
+                headers={"kubeflow-userid": "alice@example.com"},
+                body=json.dumps(body).encode() if body is not None
+                else b""))
+            return resp.status, json.loads(resp.body)
+
+        return call, plane, clock
+
+    def test_post_list_delete_lifecycle(self):
+        call, plane, _ = self._dash()
+        status, entry = call(
+            "POST", "/api/silences",
+            {"matchers": {"alertname": "KVPagesExhausted"},
+             "until": 500.0, "comment": "maint window"})
+        assert status == 201
+        assert entry["createdBy"] == "alice@example.com"
+        assert plane.silences.silenced("KVPagesExhausted", {}, at=0.0)
+        status, doc = call("GET", "/api/silences")
+        assert status == 200
+        assert [s["id"] for s in doc["silences"]] == [entry["id"]]
+        status, doc = call("DELETE", f"/api/silences/{entry['id']}",
+                           params={"id": entry["id"]})
+        assert status == 200 and doc == {"deleted": entry["id"]}
+        assert call("GET", "/api/silences")[1] == {"silences": []}
+
+    def test_post_duration_s_relative_expiry(self):
+        call, plane, clock = self._dash()
+        clock.t = 100.0
+        status, entry = call(
+            "POST", "/api/silences",
+            {"matchers": {"alertname": "A"}, "duration_s": 60})
+        assert status == 201 and entry["until"] == 160.0
+
+    def test_post_validation_is_400(self):
+        call, _, _ = self._dash()
+        assert call("POST", "/api/silences", {"until": 5.0})[0] == 400
+        assert call("POST", "/api/silences",
+                    {"matchers": {"a": "b"}})[0] == 400
+        assert call("POST", "/api/silences",
+                    {"matchers": {}, "until": 5.0})[0] == 400
+
+    def test_delete_unknown_is_404(self):
+        call, _, _ = self._dash()
+        assert call("DELETE", "/api/silences/s99",
+                    params={"id": "s99"})[0] == 404
+
+
+# -- goodput exporter (ISSUE 13 satellite b) ----------------------------------
+
+
+class TestGoodputExporter:
+    def test_export_once_publishes_the_ledger_as_series(self):
+        reg = MetricsRegistry()
+        collector = tr.TraceCollector()
+        collector.add(mkspan("jaxjob.provision", 0.0, 10.0))
+        collector.add(mkspan("train.step", 10.0, 90.0, step=0))
+        collector.add(mkspan("train.checkpoint", 90.0, 100.0))
+        exp = gp.GoodputExporter(registry=reg, collector=collector,
+                                 chips=8)
+        report = exp.export_once(at=100.0)
+        assert report.goodput == pytest.approx(0.8)
+        assert reg.series("goodput_ratio")[0][1] == pytest.approx(0.8)
+        assert reg.series("goodput_wall_seconds")[0][1] \
+            == pytest.approx(100.0)
+        buckets = {ls["bucket"]: v
+                   for ls, v in reg.series("goodput_bucket_seconds")}
+        assert buckets["productive_step"] == pytest.approx(80.0)
+        assert buckets["checkpoint"] == pytest.approx(10.0)
+        lost = {ls["cause"]: v
+                for ls, v in reg.series("goodput_chip_seconds_lost")}
+        # chips scale the cost: 20 non-productive seconds * 8 chips
+        assert sum(lost.values()) == pytest.approx(160.0)
+
+    def test_scrape_plane_picks_the_series_up(self):
+        reg = MetricsRegistry()
+        collector = tr.TraceCollector()
+        collector.add(mkspan("train.step", 0.0, 10.0, step=0))
+        gp.GoodputExporter(registry=reg,
+                           collector=collector).export_once(at=10.0)
+        clock = ManualClock()
+        plane = FleetPlane(registry=MetricsRegistry(),
+                           targets=[RegistryTarget("ctl", reg)],
+                           clock=clock, collector=collector)
+        plane.tick(at=0.0)
+        out = plane.query("goodput_ratio")
+        assert out["result"][0]["value"] == pytest.approx(1.0)
+
+
+# -- heal bench contract (ISSUE 13 satellite f) -------------------------------
+
+
+class TestHealBenchContract:
+    def test_smoke_is_deterministic_and_heals(self):
+        from tools.heal_bench import SMOKE_CONFIG, run_bench
+
+        r1 = run_bench(**SMOKE_CONFIG)
+        r2 = run_bench(**SMOKE_CONFIG)
+        assert r1["decision_fingerprint"] == r2["decision_fingerprint"]
+        assert r1["appends"] == r2["appends"]
+        assert r1["heals"] == r2["heals"]
+        assert r1["remediation_results"] == r2["remediation_results"]
+        # the smoke window heals the KV incident and the node burn
+        # end-to-end (cluster-state clear conditions, zero reconciles)
+        assert r1["heals"]["KVPagesExhausted"]["healed"] is True
+        assert r1["heals"]["NodeSLOBurn"]["healed"] is True
+        assert r1["cordoned"] == ["tpu-0"]
+        assert r1["remediation_results"] == {"executed": 3}
+
+    def test_check_green_against_committed_bank(self):
+        from tools.heal_bench import DEFAULT_OUT, check_against
+
+        assert check_against(DEFAULT_OUT) == 0
+
+    def test_check_fails_on_poisoned_bank(self, tmp_path):
+        from tools.heal_bench import DEFAULT_OUT, check_against
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        bank["smoke"]["decision_fingerprint"] = "0" * 64
+        poisoned = tmp_path / "bank.json"
+        poisoned.write_text(json.dumps(bank))
+        assert check_against(str(poisoned)) == 1
+
+    def test_banked_full_run_meets_acceptance(self):
+        """The ISSUE acceptance row: every staged incident heals
+        end-to-end with zero human reconciles — remediation fired, the
+        breached signal cleared, and the topology moves happened."""
+        from tools.heal_bench import DEFAULT_OUT
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        full = bank["full"]
+        for incident in ("KVPagesExhausted", "SchedulerPassSlow",
+                         "NodeSLOBurn"):
+            heal = full["heals"][incident]
+            assert heal["healed"] is True, incident
+            assert heal["remediated"] is not None
+            assert heal["resolved"] > heal["fired"]
+        assert full["remediation_results"] == {"executed": 3}
+        assert full["cordoned"] == ["tpu-0"]
+        # the drained gang shrank elastically and grew back
+        assert full["train_status"]["resizes"] >= 2
+        assert full["train_status"]["activeReplicas"] == 2

@@ -1,0 +1,289 @@
+"""TSDB durability (ISSUE 13 tentpole): segment/snapshot persistence
+round-trips, the crash-recovery contract (kill mid-segment-write, no
+loss beyond one flush interval, no torn reads), and the remote-write
+exporter's batching/backoff/lossy-watermark semantics."""
+
+import json
+import os
+
+import pytest
+
+from kubeflow_tpu.obs import persist as P
+from kubeflow_tpu.obs.expofmt import STALE_NAN, is_stale
+from kubeflow_tpu.obs.tsdb import STALE, TimeSeriesStore
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def fill(store, n, t0=0.0, name="m", labels=None):
+    for i in range(n):
+        store.append(name, labels or {"job": "x"}, float(i), t0 + i)
+
+
+def persister(store, tmp_path, **kw):
+    kw.setdefault("clock", ManualClock())
+    return P.TsdbPersister(store, str(tmp_path / "tsdb"), **kw)
+
+
+class TestSegmentsAndSnapshots:
+    def test_segment_snapshot_restore_roundtrip(self, tmp_path):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, snapshot_every=3)
+        fill(store, 4, t0=0.0)
+        assert p.flush(at=10.0)["kind"] == "segment"
+        fill(store, 4, t0=100.0, name="m2")
+        assert p.flush(at=20.0)["samples"] == 4
+        fill(store, 2, t0=200.0)
+        assert p.flush(at=30.0)["kind"] == "snapshot"  # 3rd flush
+        # snapshot subsumed the segments
+        assert p._segment_files() == []
+
+        fresh = TimeSeriesStore()
+        p2 = persister(fresh, tmp_path)
+        counts = p2.restore()
+        assert counts["snapshot_samples"] == 10
+        assert fresh.dump_since(None) == store.dump_since(None)
+
+    def test_segments_only_restore_preserves_order_and_seq(
+            self, tmp_path):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, snapshot_every=100)
+        fill(store, 3, t0=0.0)
+        p.flush(at=10.0)
+        fill(store, 3, t0=50.0)
+        p.flush(at=20.0)
+
+        fresh = TimeSeriesStore()
+        p2 = persister(fresh, tmp_path, snapshot_every=100)
+        counts = p2.restore()
+        assert counts == {"snapshot_samples": 0, "segment_samples": 6,
+                          "segments": 2}
+        assert fresh.dump_since(None) == store.dump_since(None)
+        # the restored persister continues the sequence: a new flush
+        # must not overwrite a replayed segment
+        fill(fresh, 1, t0=99.0)
+        p2.flush(at=30.0)
+        assert len(p2._segment_files()) == 3
+
+    def test_empty_flush_writes_no_segment(self, tmp_path):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, snapshot_every=100)
+        fill(store, 2)
+        p.flush(at=10.0)
+        out = p.flush(at=20.0)  # nothing new since the watermark
+        assert out["samples"] == 0
+        assert len(p._segment_files()) == 1
+
+    def test_stale_marker_survives_the_json_roundtrip(self, tmp_path):
+        store = TimeSeriesStore()
+        store.append("up", {"job": "x"}, 1.0, 1.0)
+        store.append("up", {"job": "x"}, STALE, 2.0)
+        p = persister(store, tmp_path)
+        p.flush(at=10.0)
+        # on disk it is the string "stale", not a NaN the JSON round
+        # trip would have destroyed
+        seg = tmp_path / "tsdb" / p._segment_files()[0]
+        doc = json.loads(seg.read_text())
+        assert doc["series"][0][2][1][1] == "stale"
+
+        fresh = TimeSeriesStore()
+        persister(fresh, tmp_path).restore()
+        (_, _, pts), = fresh.dump_since(None)
+        assert pts[0] == (1.0, 1.0)
+        assert pts[1][0] == 2.0 and is_stale(pts[1][1])
+        assert STALE_NAN  # the marker is a real bit pattern
+
+    def test_restore_tolerates_missing_dir_and_corrupt_docs(
+            self, tmp_path):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path)
+        assert p.restore() == {"snapshot_samples": 0,
+                               "segment_samples": 0, "segments": 0}
+        d = tmp_path / "tsdb"
+        d.mkdir()
+        (d / "segment-00000000.json").write_text("{torn")
+        (d / "segment-00000001.json").write_text(
+            json.dumps({"v": 99, "series": [["m", {}, [[1.0, 1.0]]]]}))
+        good = {"v": 1, "seq": 2, "at": 5.0,
+                "series": [["m", {"job": "x"}, [[1.0, 7.0]]]]}
+        (d / "segment-00000002.json").write_text(json.dumps(good))
+        counts = p.restore()
+        assert counts["segments"] == 1
+        assert counts["segment_samples"] == 1
+        assert p._seq == 3  # continues past the replayed seq
+
+    def test_restored_samples_counted_in_registry(self, tmp_path):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path)
+        fill(store, 5)
+        p.flush(at=10.0)
+        reg = MetricsRegistry()
+        persister(TimeSeriesStore(), tmp_path, registry=reg).restore()
+        assert "obs_persist_restored_samples_total 5" in reg.render()
+
+    def test_flush_gauges_published(self, tmp_path):
+        reg = MetricsRegistry()
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, registry=reg,
+                      snapshot_every=100)
+        fill(store, 3)
+        p.flush(at=10.0)
+        text = reg.render()
+        assert "obs_persist_flushes_total 1" in text
+        assert "obs_persist_samples_total 3" in text
+        assert "obs_persist_segments 1" in text
+
+
+class TestCrashRecovery:
+    """ISSUE 13 satellite (d): kill the persist loop mid-segment-write,
+    restart, and verify no sample loss beyond the last flush interval
+    and no torn reads."""
+
+    def test_kill_mid_segment_write_loses_at_most_one_interval(
+            self, tmp_path, monkeypatch):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, snapshot_every=100)
+        fill(store, 4, t0=0.0)
+        p.flush(at=10.0)  # completed flush: its samples are durable
+
+        # the kill: atomic_write_text dies after writing the temp file
+        # but before the rename — exactly what SIGKILL mid-write leaves
+        def dying_write(path, text):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(text[: len(text) // 2])
+            raise KeyboardInterrupt("SIGKILL mid-write")
+
+        monkeypatch.setattr(P, "atomic_write_text", dying_write)
+        fill(store, 4, t0=50.0)
+        with pytest.raises(KeyboardInterrupt):
+            p.flush(at=20.0)
+        monkeypatch.undo()
+
+        # restart: a fresh process restores from disk
+        fresh = TimeSeriesStore()
+        counts = persister(fresh, tmp_path).restore()
+        # no torn read: the half-written .tmp is never even considered
+        assert counts["segments"] == 1
+        restored = {t for _, _, pts in fresh.dump_since(None)
+                    for t, _ in pts}
+        # every pre-kill-flush sample survived...
+        assert restored == {0.0, 1.0, 2.0, 3.0}
+        # ...and the loss is exactly the samples of the killed
+        # interval, nothing older
+        lost = {t for _, _, pts in store.dump_since(None)
+                for t, _ in pts} - restored
+        assert lost == {50.0, 51.0, 52.0, 53.0}
+
+    def test_kill_between_snapshot_and_segment_cleanup_is_idempotent(
+            self, tmp_path, monkeypatch):
+        store = TimeSeriesStore()
+        p = persister(store, tmp_path, snapshot_every=100)
+        fill(store, 3, t0=0.0)
+        p.flush(at=10.0)
+        # kill AFTER the snapshot rename but BEFORE segment cleanup
+        monkeypatch.setattr(os, "unlink",
+                            lambda *a, **k: (_ for _ in ()).throw(
+                                KeyboardInterrupt()))
+        with pytest.raises(KeyboardInterrupt):
+            p.snapshot_now(at=20.0)
+        monkeypatch.undo()
+        # both the snapshot and the now-redundant segment exist
+        assert (tmp_path / "tsdb" / P.SNAPSHOT_FILE).exists()
+        assert len(p._segment_files()) == 1
+
+        fresh = TimeSeriesStore()
+        persister(fresh, tmp_path).restore()
+        # replaying the redundant segment is idempotent: restore skips
+        # points at/below the snapshot's high-water mark
+        assert fresh.dump_since(None) == store.dump_since(None)
+
+    def test_stop_final_flush_makes_tail_durable(self, tmp_path):
+        store = TimeSeriesStore()
+        clock = ManualClock(10.0)
+        p = persister(store, tmp_path, clock=clock, snapshot_every=100)
+        fill(store, 3, t0=0.0)
+        p.stop(final_flush=True)  # never started: stop still flushes
+        fresh = TimeSeriesStore()
+        persister(fresh, tmp_path).restore()
+        assert fresh.dump_since(None) == store.dump_since(None)
+
+
+class TestRemoteWrite:
+    def _exporter(self, store, posts, fail_first=0, **kw):
+        state = {"n": 0}
+
+        def post(url, body):
+            state["n"] += 1
+            if state["n"] <= fail_first:
+                raise OSError("conn refused")
+            posts.append((url, body))
+
+        kw.setdefault("clock", ManualClock())
+        kw.setdefault("sleep", lambda s: None)
+        kw.setdefault("rng", lambda: 1.0)
+        return P.RemoteWriteExporter(store, "http://agg/write",
+                                     post=post, **kw)
+
+    def test_batched_jsonl_lines_and_watermark(self):
+        store = TimeSeriesStore()
+        fill(store, 5, t0=0.0)
+        posts = []
+        exp = self._exporter(store, posts, batch=2)
+        assert exp.export_once(at=10.0) == 5
+        assert len(posts) == 3  # 2 + 2 + 1
+        lines = [json.loads(ln) for _, body in posts
+                 for ln in body.decode().splitlines()]
+        assert lines[0] == {"name": "m", "labels": {"job": "x"},
+                            "t": 0.0, "v": 0.0}
+        assert len(lines) == 5
+        # watermark: nothing new -> nothing sent
+        assert exp.export_once(at=20.0) == 0
+        assert len(posts) == 3
+
+    def test_backoff_is_capped_exponential_with_jitter(self):
+        store = TimeSeriesStore()
+        fill(store, 1)
+        delays = []
+        exp = self._exporter(store, [], fail_first=4,
+                             retry_base=0.1, retry_cap=0.5,
+                             max_retries=5, sleep=delays.append)
+        assert exp.export_once(at=10.0) == 1
+        # rng()==1.0 -> delays are the full min(cap, base*2^attempt)
+        assert delays == [0.1, 0.2, 0.4, 0.5]
+
+    def test_exhausted_batch_dropped_and_watermark_advances(self):
+        store = TimeSeriesStore()
+        fill(store, 3, t0=0.0)
+        reg = MetricsRegistry()
+        exp = self._exporter(store, [], fail_first=10 ** 6,
+                             max_retries=2, registry=reg)
+        assert exp.export_once(at=10.0) == 0
+        assert exp.dropped == 3
+        # lossy-by-design: the next pass does NOT retry the old window
+        fill(store, 1, t0=100.0)
+        exp.post = lambda url, body: None  # network heals
+        assert exp.export_once(at=20.0) == 1
+        text = reg.render()
+        assert "obs_remote_write_sent_total 1" in text
+        assert "obs_remote_write_dropped_total 3" in text
+
+    def test_stale_marker_encoded_as_string(self):
+        store = TimeSeriesStore()
+        store.append("up", {}, STALE, 1.0)
+        posts = []
+        self._exporter(store, posts).export_once(at=10.0)
+        (line,) = posts[0][1].decode().splitlines()
+        assert json.loads(line)["v"] == "stale"

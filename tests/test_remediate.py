@@ -1,0 +1,319 @@
+"""Alert-driven remediation (ISSUE 13 tentpole): the RemediationEngine
+decision pass (firing-only triggering, matchers, silences, cooldowns,
+the global rate limit, dry-run byte-parity, the audit ring and its
+dual-sink counter), the three shipped actions over a FakeCluster, and
+the default alert->action pack."""
+
+import pytest
+
+from kubeflow_tpu.control.jaxservice import types as JS
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
+from kubeflow_tpu.obs import remediate as RM
+from kubeflow_tpu.obs.events import EventRecorder
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.obs
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def firing(alert="HotZone", labels=None, at=0.0, value=1.0):
+    return {"alert": alert, "to": "firing",
+            "labels": labels or {"namespace": "default"},
+            "value": value, "at": at}
+
+
+def engine(actions=None, **kw):
+    kw.setdefault("clock", ManualClock())
+    kw.setdefault("registry", MetricsRegistry())
+    return RM.RemediationEngine(actions or [], **kw)
+
+
+class TestDecisionPass:
+    def test_firing_transition_executes_the_bound_action(self):
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "HotZone", lambda tr: ran.append(tr) or "fixed")])
+        out = eng.observe([firing()], at=10.0)
+        assert len(ran) == 1 and ran[0]["alert"] == "HotZone"
+        assert out[0]["result"] == RM.EXECUTED
+        assert out[0]["detail"] == "fixed"
+        assert out[0]["at"] == 10.0
+
+    def test_only_firing_triggers_never_pending_or_resolved(self):
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "HotZone", lambda tr: ran.append(tr) or "")])
+        for to in ("pending", "resolved"):
+            assert eng.observe(
+                [dict(firing(), to=to)], at=0.0) == []
+        assert ran == []
+
+    def test_matchers_scope_the_binding(self):
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "HotZone", lambda tr: ran.append(tr) or "",
+            matchers={"namespace": "prod"})])
+        assert eng.observe(
+            [firing(labels={"namespace": "dev"})], at=0.0) == []
+        out = eng.observe(
+            [firing(labels={"namespace": "prod"})], at=0.0)
+        assert len(ran) == 1 and out[0]["result"] == RM.EXECUTED
+
+    def test_unbound_alert_is_ignored(self):
+        eng = engine([RM.Remediation("fix", "HotZone", lambda tr: "")])
+        assert eng.observe([firing(alert="Other")], at=0.0) == []
+
+    def test_cooldown_suppresses_within_window_allows_after(self):
+        clock = ManualClock()
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "HotZone", lambda tr: ran.append(1) or "",
+            cooldown_s=120.0)], clock=clock)
+        assert eng.observe([firing()], at=0.0)[0]["result"] == RM.EXECUTED
+        out = eng.observe([firing()], at=60.0)
+        assert out[0]["result"] == RM.COOLDOWN
+        assert len(ran) == 1  # the action itself never ran
+        assert eng.observe([firing()], at=120.0)[0]["result"] \
+            == RM.EXECUTED
+        assert len(ran) == 2
+
+    def test_global_rate_limit_bounds_an_alert_storm(self):
+        eng = engine(
+            [RM.Remediation(f"fix-{i}", f"A{i}", lambda tr: "",
+                            cooldown_s=0.0) for i in range(4)],
+            max_actions=2, rate_window_s=600.0)
+        out = eng.observe([firing(alert=f"A{i}") for i in range(4)],
+                          at=0.0)
+        assert [d["result"] for d in out] == [
+            RM.EXECUTED, RM.EXECUTED, RM.RATE_LIMITED, RM.RATE_LIMITED]
+        # window slides: capacity returns after rate_window_s
+        out = eng.observe([firing(alert="A2")], at=600.0)
+        assert out[0]["result"] == RM.EXECUTED
+
+    def test_dry_run_burns_cooldown_and_rate_budget(self):
+        """Byte-identical decision log law: a dry-run fleet must make
+        the SAME suppression decisions a live one would."""
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "HotZone", lambda tr: ran.append(1) or "",
+            cooldown_s=120.0)], dry_run=True)
+        assert eng.observe([firing()], at=0.0)[0]["result"] == RM.DRY_RUN
+        assert ran == []  # never executed...
+        # ...but the cooldown was burned exactly as live would
+        assert eng.observe([firing()], at=60.0)[0]["result"] \
+            == RM.COOLDOWN
+
+    def test_silence_mutes_action_without_burning_cooldown(self):
+        muted = {"on": True}
+        eng = engine(
+            [RM.Remediation("fix", "HotZone", lambda tr: "",
+                            cooldown_s=300.0)],
+            silenced=lambda alert, labels, at: muted["on"])
+        assert eng.observe([firing()], at=0.0)[0]["result"] \
+            == RM.SILENCED
+        muted["on"] = False
+        # un-silencing acts immediately: silence never burned cooldown
+        assert eng.observe([firing()], at=1.0)[0]["result"] \
+            == RM.EXECUTED
+
+    def test_skip_action_and_error_results(self):
+        def skip(tr):
+            raise RM.SkipAction("no node label")
+
+        def boom(tr):
+            raise RuntimeError("apiserver down")
+
+        eng = engine([RM.Remediation("s", "A", skip, cooldown_s=0.0),
+                      RM.Remediation("e", "B", boom, cooldown_s=0.0)])
+        out = eng.observe([firing(alert="A"), firing(alert="B")], at=0.0)
+        assert out[0]["result"] == RM.SKIPPED
+        assert out[0]["detail"] == "no node label"
+        assert out[1]["result"] == RM.ERROR
+        assert "apiserver down" in out[1]["detail"]
+
+    def test_audit_ring_is_bounded_and_ordered(self):
+        eng = engine([RM.Remediation("fix", "A", lambda tr: "",
+                                     cooldown_s=0.0)],
+                     max_actions=10**6, audit_limit=3)
+        for i in range(5):
+            eng.observe([firing(alert="A", at=float(i))], at=float(i))
+        audit = eng.audit()
+        assert len(audit) == 3
+        assert [d["at"] for d in audit] == [2.0, 3.0, 4.0]
+
+    def test_decisions_counted_in_both_sinks_and_events_emitted(self):
+        cluster = FakeCluster()
+        reg = MetricsRegistry()
+        eng = engine(
+            [RM.Remediation("fix", "HotZone", lambda tr: "did it",
+                            cooldown_s=0.0)],
+            registry=reg, recorder=EventRecorder(cluster))
+        eng.observe([firing()], at=0.0)
+        eng.observe([firing()], at=1.0)
+        text = reg.render()
+        assert 'obs_remediations_total{action="fix",result="executed"}' \
+            in text
+        events = cluster.list("v1", "Event", namespace="default")
+        execd = [e for e in events if e["reason"] == "RemediationExecuted"]
+        assert len(execd) == 1  # dedup'd, count bumped
+        assert "did it" in execd[0]["message"]
+        assert execd[0]["count"] == 2
+
+    def test_failed_action_emits_warning_event(self):
+        cluster = FakeCluster()
+
+        def boom(tr):
+            raise RuntimeError("nope")
+
+        eng = engine([RM.Remediation("fix", "HotZone", boom,
+                                     cooldown_s=0.0)],
+                     recorder=EventRecorder(cluster))
+        eng.observe([firing()], at=0.0)
+        events = [e for e in cluster.list("v1", "Event",
+                                          namespace="default")
+                  if e["reason"] == "RemediationFailed"]
+        assert len(events) == 1 and events[0]["type"] == "Warning"
+
+    def test_suppressed_decisions_do_not_spam_events(self):
+        cluster = FakeCluster()
+        eng = engine([RM.Remediation("fix", "HotZone", lambda tr: "",
+                                     cooldown_s=600.0)],
+                     recorder=EventRecorder(cluster))
+        eng.observe([firing()], at=0.0)
+        eng.observe([firing()], at=10.0)  # cooldown decision
+        events = cluster.list("v1", "Event", namespace="default")
+        assert len([e for e in events
+                    if e["reason"] == "RemediationExecuted"]) == 1
+
+
+class TestFlapDamping:
+    def test_pending_inactive_oscillation_never_acts_or_burns_cooldown(
+            self):
+        """The structural flap guard: a series oscillating below the
+        for-duration produces pending/inactive transitions only — no
+        decision is made AND no cooldown is burned, so the first REAL
+        firing still remediates instantly."""
+        clock = ManualClock()
+        ran = []
+        eng = engine([RM.Remediation(
+            "fix", "Flappy", lambda tr: ran.append(1) or "",
+            cooldown_s=600.0)], clock=clock)
+        # ten flap cycles: pending, then back to inactive (the rule
+        # engine emits no transition dict at all for the quiet half)
+        for i in range(10):
+            assert eng.observe(
+                [dict(firing(alert="Flappy"), to="pending")],
+                at=float(i * 30)) == []
+        assert ran == [] and eng.audit() == []
+        # the real sustained breach fires -> acts immediately (no
+        # cooldown was burned by the flaps)
+        out = eng.observe([firing(alert="Flappy")], at=300.0)
+        assert out[0]["result"] == RM.EXECUTED and ran == [1]
+
+
+class TestActions:
+    def _svc_world(self):
+        cluster = FakeCluster()
+        cluster.create(JS.new_jaxservice(
+            "chat", model="m", min_replicas=2, max_replicas=4))
+        svc = cluster.get(JS.API_VERSION, JS.KIND, "chat", "default")
+        svc.setdefault("status", {})["targetReplicas"] = 2
+        cluster.update_status(svc)
+        return cluster
+
+    def test_scale_up_nudge_annotates_target_plus_one(self):
+        cluster = self._svc_world()
+        act = RM.scale_up_nudge_action(cluster)
+        detail = act(firing(labels={"namespace": "default",
+                                    "service": "chat"}))
+        assert "3" in detail
+        svc = cluster.get(JS.API_VERSION, JS.KIND, "chat", "default")
+        assert svc["metadata"]["annotations"][
+            JS.ANNOTATION_SCALE_NUDGE] == "3"
+
+    def test_scale_up_nudge_without_service_label_skips(self):
+        act = RM.scale_up_nudge_action(self._svc_world())
+        with pytest.raises(RM.SkipAction):
+            act(firing(labels={"namespace": "default"}))
+
+    def test_cache_relist_marks_and_refreshes(self):
+        from kubeflow_tpu.control.cache import ClusterCache
+
+        cluster = FakeCluster()
+        cache = ClusterCache(cluster).connect()
+        cache.refresh()
+        base = cache.stats()["relists"]
+        act = RM.cache_relist_action(cache)
+        detail = act(firing(alert="SchedulerPassSlow", labels={}))
+        assert "relisted" in detail
+        assert cache.stats()["relists"] > base
+
+    def test_cordon_drain_cordons_and_evicts_only_gang_pods(self):
+        cluster = FakeCluster()
+        from kubeflow_tpu.control.scheduler.nodes import new_tpu_node
+        cluster.create(new_tpu_node("tpu-0", topology="2x4"))
+
+        def pod(name, node, sched=None, phase="Running"):
+            p = {"apiVersion": "v1", "kind": "Pod",
+                 "metadata": {"name": name, "namespace": "default"},
+                 "spec": {"nodeName": node,
+                          "containers": [{"name": "jax"}]},
+                 "status": {"phase": phase}}
+            if sched:
+                p["spec"]["schedulerName"] = sched
+            return cluster.create(p)
+
+        pod("gang-0", "tpu-0", sched=SCHEDULER_NAME)
+        pod("gang-done", "tpu-0", sched=SCHEDULER_NAME,
+            phase="Succeeded")
+        pod("plain-0", "tpu-0")               # default scheduler: kept
+        pod("gang-elsewhere", "tpu-1", sched=SCHEDULER_NAME)
+        act = RM.cordon_drain_action(cluster)
+        detail = act(firing(alert="NodeSLOBurn",
+                            labels={"node": "tpu-0"}))
+        assert "cordoned tpu-0" in detail and "1 pod" in detail
+        node = cluster.get("v1", "Node", "tpu-0")
+        assert node["spec"]["unschedulable"] is True
+        st = cluster.get("v1", "Pod", "gang-0", "default")["status"]
+        assert st["phase"] == "Failed" and st["reason"] == "Evicted"
+        for untouched in ("plain-0", "gang-elsewhere"):
+            assert cluster.get("v1", "Pod", untouched,
+                               "default")["status"]["phase"] == "Running"
+        assert cluster.get("v1", "Pod", "gang-done",
+                           "default")["status"]["phase"] == "Succeeded"
+
+    def test_cordon_drain_without_node_label_skips(self):
+        act = RM.cordon_drain_action(FakeCluster())
+        with pytest.raises(RM.SkipAction):
+            act(firing(alert="NodeSLOBurn", labels={}))
+
+
+class TestDefaultPack:
+    def test_bindings_cover_the_three_staged_incidents(self):
+        from kubeflow_tpu.control.cache import ClusterCache
+
+        cluster = FakeCluster()
+        rems = RM.default_remediations(
+            client=cluster, cache=ClusterCache(cluster).connect())
+        assert {r.alert for r in rems} == {
+            "KVPagesExhausted", "NodeSLOBurn", "SchedulerPassSlow"}
+        # every binding carries a nonzero cooldown (remediations act on
+        # control loops whose effect takes time to land)
+        assert all(r.cooldown_s > 0 for r in rems)
+
+    def test_missing_dependencies_drop_their_bindings(self):
+        assert RM.default_remediations() == []
+        only_client = RM.default_remediations(client=FakeCluster())
+        assert {r.alert for r in only_client} == {
+            "KVPagesExhausted", "NodeSLOBurn"}

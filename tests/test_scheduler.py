@@ -884,3 +884,19 @@ class TestSliceAwareAdmission:
         assert st.get("restarts", 0) == 0 and st.get("preemptions", 0) == 0
         b = bindings(cluster)
         assert {b["ms-worker-2"], b["ms-worker-3"]} == {"b0", "b1"}, b
+
+
+class TestCordonFeasibility:
+    """spec.unschedulable (kubectl cordon / the ISSUE 13 remediation
+    engine's cordon-and-drain) must exclude a node from placement."""
+
+    def test_cordoned_node_is_infeasible(self):
+        node = new_tpu_node("n0", topology="2x4")
+        pod = ob.new_object("v1", "Pod", "p", "default")
+        pod["spec"] = {"containers": [{"name": "jax", "resources": {
+            "limits": {JT.RESOURCE_TPU: 4}}}]}
+        assert feasible(pod, node_view(node))
+        node.setdefault("spec", {})["unschedulable"] = True
+        v = node_view(node)
+        assert v.unschedulable
+        assert not feasible(pod, v)

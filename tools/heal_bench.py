@@ -1,0 +1,434 @@
+#!/usr/bin/env python
+"""heal_bench — deterministic self-healing fleet drill.
+
+Builds a REAL control plane over a FakeCluster — gang scheduler,
+JAXJob and JAXService controllers, FakeKubelet — plus the ISSUE-13
+observability plane (TSDB scraper + rule engine + RemediationEngine)
+on a shared VIRTUAL clock, then stages three incidents whose synthetic
+symptoms only clear when the CLUSTER STATE shows the remediation
+landed (zero human reconciles — the generator reads the cluster, not a
+script flag):
+
+- KVPagesExhausted: ``serving_kv_pages_free == 0`` until the
+  JAXService autoscaler target moves (the scale-up nudge annotation
+  consumed through the record-first status path);
+- SchedulerPassSlow: slow ``scheduler_pass_seconds`` samples until the
+  scheduler's ClusterCache relist counter moves (the dirty-kind relist
+  repair path);
+- NodeSLOBurn: node-scoped router latency burn until the victim Node
+  is cordoned (``spec.unschedulable``), which also drains the gang
+  worker bound there through the PR 6 elastic shrink path — the gang
+  shrinks to survivors and grows back on healthy capacity.
+
+Measures the deterministic half (alert transitions + remediation
+decisions, fingerprinted; store op counts; heal timelines) and the
+machine half (plane-tick and control-tick wall percentiles).
+
+    python tools/heal_bench.py            # full + smoke, write JSON
+    python tools/heal_bench.py --check    # CI gate: rerun the banked
+        # smoke config; fail when the decision fingerprint, op counts
+        # or heal timelines drift, or p99 regresses past 3x budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.control.jaxjob import types as JJ  # noqa: E402
+from kubeflow_tpu.control.jaxjob.controller import (  # noqa: E402
+    build_controller as build_jaxjob_controller,
+)
+from kubeflow_tpu.control.jaxservice import types as JS  # noqa: E402
+from kubeflow_tpu.control.jaxservice.controller import (  # noqa: E402
+    build_controller as build_jaxservice_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet  # noqa: E402
+from kubeflow_tpu.control.runtime import seed_controller  # noqa: E402
+from kubeflow_tpu.control.scheduler.nodes import new_tpu_node  # noqa: E402
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler  # noqa: E402
+from kubeflow_tpu.obs.events import EventRecorder  # noqa: E402
+from kubeflow_tpu.obs.plane import FleetPlane  # noqa: E402
+from kubeflow_tpu.obs.remediate import (  # noqa: E402
+    EXECUTED, RemediationEngine, default_remediations,
+)
+from kubeflow_tpu.obs.rules import (  # noqa: E402
+    default_rule_pack, node_burn_rules,
+)
+from kubeflow_tpu.obs.tsdb import RegistryTarget  # noqa: E402
+from kubeflow_tpu.runtime.metrics import (  # noqa: E402
+    DEFAULT_BUCKETS, MetricsRegistry,
+)
+from kubeflow_tpu.serving.router import (  # noqa: E402
+    REQUEST_BUCKETS, RegistrySignals,
+)
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_HEAL_r01.json")
+
+SCRAPE_INTERVAL_S = 15.0
+TPU_NODES = ("tpu-0", "tpu-1", "tpu-2")
+# the three staged incidents and the alert that heals each
+INCIDENT_ALERTS = ("KVPagesExhausted", "SchedulerPassSlow", "NodeSLOBurn")
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class HealFleet:
+    """Symptom generator whose clear-conditions READ THE CLUSTER.
+
+    Each incident keeps emitting its broken series until the object
+    the remediation mutates actually changed — so a green run proves
+    the alert->action->cluster->resolution loop end to end, with no
+    scripted 'and then it got better'."""
+
+    def __init__(self, seed: int, cluster: FakeCluster, sched_cache):
+        self.rng = random.Random(seed)
+        self.cluster = cluster
+        self.sched_cache = sched_cache
+        self.router = MetricsRegistry()
+        self.serving = MetricsRegistry()
+        self.control = MetricsRegistry()
+        self.victim: str | None = None
+        self.a_healed = False
+        self.b_healed = False
+        self.c_healed = False
+        self._relist_base: int | None = None
+
+    def targets(self) -> list[RegistryTarget]:
+        return [
+            RegistryTarget("router", self.router, labels={"job": "router"}),
+            RegistryTarget("serving", self.serving,
+                           labels={"job": "serving"}),
+            RegistryTarget("control", self.control,
+                           labels={"job": "control"}),
+        ]
+
+    def _pick_victim(self) -> str:
+        """The TPU node hosting the (sorted-)first bound gang worker —
+        deterministic, and guarantees the cordon exercises the elastic
+        drain path."""
+        bound = []
+        for pod in self.cluster.list("v1", "Pod"):
+            node = (pod.get("spec") or {}).get("nodeName")
+            if node in TPU_NODES:
+                bound.append((pod["metadata"]["name"], node))
+        if bound:
+            return sorted(bound)[0][1]
+        return TPU_NODES[0]
+
+    def stage(self, cycle: int, cfg: dict) -> None:
+        rng = self.rng
+        # --- incident A: KV pages exhausted until the autoscaler moved
+        a_active = cycle >= cfg["kv_at"] and not self.a_healed
+        if a_active:
+            svc = self.cluster.get_or_none(JS.API_VERSION, JS.KIND,
+                                           "chat", "default")
+            tgt = int(((svc or {}).get("status") or {})
+                      .get("targetReplicas", 0))
+            if tgt >= cfg["kv_heal_target"]:
+                self.a_healed, a_active = True, False
+        self.serving.gauge("serving_kv_pages_free",
+                           0.0 if a_active else 64.0,
+                           namespace="default", service="chat",
+                           model="llama-1b")
+        # --- incident B: slow scheduler passes until the cache relisted
+        if cycle == cfg["pass_at"]:
+            self._relist_base = self.sched_cache.stats()["relists"]
+        b_active = cycle >= cfg["pass_at"] and not self.b_healed
+        if b_active and self._relist_base is not None \
+                and self.sched_cache.stats()["relists"] > self._relist_base:
+            self.b_healed, b_active = True, False
+        for _ in range(3):
+            dur = rng.uniform(1.5, 3.0) if b_active \
+                else rng.uniform(0.004, 0.02)
+            self.control.histogram("scheduler_pass_seconds", dur,
+                                   buckets=DEFAULT_BUCKETS)
+        # --- incident C: node-scoped burn until the victim is cordoned
+        if cycle >= cfg["burn_at"] and self.victim is None:
+            self.victim = self._pick_victim()
+        c_active = self.victim is not None and not self.c_healed
+        if c_active:
+            node = self.cluster.get_or_none("v1", "Node", self.victim)
+            if node is not None \
+                    and (node.get("spec") or {}).get("unschedulable"):
+                self.c_healed, c_active = True, False
+        for nname in TPU_NODES:
+            for _ in range(20):
+                slow = c_active and nname == self.victim
+                lat = rng.uniform(0.9, 2.0) if slow \
+                    else rng.uniform(0.02, 0.3)
+                self.router.histogram(
+                    "router_request_seconds", lat,
+                    buckets=REQUEST_BUCKETS,
+                    namespace="default", service="chat", node=nname)
+        # steady autoscaler signals: demand stays at min, so the only
+        # target move the drill sees is the remediation nudge
+        self.router.gauge("router_queue_depth", 2.0,
+                          namespace="default", service="chat")
+        self.router.counter_inc("router_tokens_total", by=600.0,
+                                namespace="default", service="chat")
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(math.ceil(q * len(xs))) - 1)]
+
+
+def build_world(clock: ManualClock, seed: int) -> dict:
+    cluster = FakeCluster()
+    for name in TPU_NODES:
+        cluster.create(new_tpu_node(name, topology="2x4"))
+    recorder = EventRecorder(cluster, component="obs-remediator")
+    sched_ctl = seed_controller(build_scheduler(
+        cluster, registry=MetricsRegistry(), record_events=False,
+        clock=clock))
+    sched_cache = sched_ctl.reconciler.cache
+    job_ctl = seed_controller(build_jaxjob_controller(
+        cluster, record_events=False, registry=MetricsRegistry()))
+    fleet = HealFleet(seed, cluster, sched_cache)
+    plane_reg = MetricsRegistry()
+    engine = RemediationEngine(
+        default_remediations(client=cluster, cache=sched_cache),
+        recorder=recorder, registry=plane_reg, clock=clock)
+    plane = FleetPlane(
+        registry=plane_reg, recorder=recorder, discover=fleet.targets,
+        rules=default_rule_pack() + node_burn_rules(),
+        interval_s=SCRAPE_INTERVAL_S, clock=clock,
+        max_points=256, max_series=10000, remediator=engine)
+    svc_ctl = seed_controller(build_jaxservice_controller(
+        cluster, record_events=False, registry=MetricsRegistry(),
+        signals=RegistrySignals(fleet.router), clock=clock,
+        store=plane.store))
+    kubelet = FakeKubelet(cluster)  # auto-binds the ungated serving pods
+    cluster.create(JS.new_jaxservice(
+        "chat", model="llama-1b", min_replicas=2, max_replicas=4,
+        down_stabilization_s=3600.0))
+    cluster.create(JJ.new_jaxjob(
+        "train", replicas=2, accelerator="tpu-v5-lite-podslice",
+        topology="2x4", chips_per_worker=4, gang_schedule=True,
+        elastic_min=1))
+    return {"cluster": cluster, "fleet": fleet, "plane": plane,
+            "engine": engine, "sched_ctl": sched_ctl, "job_ctl": job_ctl,
+            "svc_ctl": svc_ctl, "kubelet": kubelet,
+            "sched_cache": sched_cache}
+
+
+def control_tick(world: dict, rounds: int = 3) -> None:
+    """Drain every controller to a fixpoint, kubelet between rounds
+    (the scheduler binds, the kubelet runs, the job controller sees)."""
+    for _ in range(rounds):
+        for ctl in (world["sched_ctl"], world["job_ctl"],
+                    world["svc_ctl"]):
+            ctl.run_until_idle(advance_delayed=True)
+        world["kubelet"].step()
+
+
+def _heal_timelines(transitions: list[dict],
+                    remediations: list[dict]) -> dict:
+    out = {}
+    for alert in INCIDENT_ALERTS:
+        fired = [t["cycle"] for t in transitions
+                 if t["alert"] == alert and t["to"] == "firing"]
+        resolved = [t["cycle"] for t in transitions
+                    if t["alert"] == alert and t["to"] == "resolved"]
+        acted = [r["cycle"] for r in remediations
+                 if r["alert"] == alert and r["result"] == EXECUTED]
+        out[alert] = {
+            "fired": fired[0] if fired else None,
+            "remediated": acted[0] if acted else None,
+            "resolved": resolved[0] if resolved else None,
+            "healed": bool(fired and acted and resolved),
+        }
+    return out
+
+
+def run_bench(cycles: int, seed: int = 0, kv_at: int = 6,
+              pass_at: int = 14, burn_at: int = 30,
+              kv_heal_target: int = 3) -> dict:
+    clock = ManualClock()
+    world = build_world(clock, seed)
+    cfg = {"kv_at": kv_at, "pass_at": pass_at, "burn_at": burn_at,
+           "kv_heal_target": kv_heal_target}
+    control_tick(world, rounds=4)  # settle: schedule the gang, serve
+
+    plane = world["plane"]
+    fleet = world["fleet"]
+    plane_ms: list[float] = []
+    control_ms: list[float] = []
+    transitions: list[dict] = []
+    remediations: list[dict] = []
+    samples_per_cycle: list[int] = []
+    for cycle in range(cycles):
+        fleet.stage(cycle, cfg)
+        t0 = time.perf_counter()
+        control_tick(world)
+        t1 = time.perf_counter()
+        res = plane.tick(at=clock.t)
+        t2 = time.perf_counter()
+        control_tick(world)  # remediation mutations reconcile this cycle
+        t3 = time.perf_counter()
+        control_ms.append((t1 - t0 + t3 - t2) * 1e3)
+        plane_ms.append((t2 - t1) * 1e3)
+        samples_per_cycle.append(res["scrape"]["samples"])
+        for tr in res["transitions"]:
+            transitions.append({"cycle": cycle, **tr})
+        for rm in res["remediations"]:
+            remediations.append({"cycle": cycle, **rm})
+        clock.advance(SCRAPE_INTERVAL_S)
+
+    cluster = world["cluster"]
+    store_stats = plane.store.stats()
+    decision_log = json.dumps(
+        {"transitions": transitions, "remediations": remediations},
+        sort_keys=True)
+    heals = _heal_timelines(transitions, remediations)
+    train = (cluster.get_or_none(JJ.API_VERSION, JJ.KIND, "train",
+                                 "default") or {}).get("status") or {}
+    chat = (cluster.get_or_none(JS.API_VERSION, JS.KIND, "chat",
+                                "default") or {}).get("status") or {}
+    cordoned = sorted(
+        n["metadata"]["name"] for n in cluster.list("v1", "Node")
+        if (n.get("spec") or {}).get("unschedulable"))
+    results = {}
+    for r in remediations:
+        results[r["result"]] = results.get(r["result"], 0) + 1
+    return {
+        "config": {"cycles": cycles, "seed": seed, **cfg},
+        "series": store_stats["series"],
+        "points": store_stats["points"],
+        "appends": store_stats["appends"],
+        "dropped": store_stats["dropped"],
+        "samples_first_cycle": samples_per_cycle[0],
+        "samples_total": sum(samples_per_cycle),
+        "plane_p50_ms": round(_percentile(plane_ms, 0.50), 3),
+        "plane_p99_ms": round(_percentile(plane_ms, 0.99), 3),
+        "control_p50_ms": round(_percentile(control_ms, 0.50), 3),
+        "control_p99_ms": round(_percentile(control_ms, 0.99), 3),
+        "alerts_fired": sorted({t["alert"] for t in transitions
+                                if t["to"] == "firing"}),
+        "alerts_resolved": sorted({t["alert"] for t in transitions
+                                   if t["to"] == "resolved"}),
+        "transitions": len(transitions),
+        "remediation_results": results,
+        "heals": heals,
+        "cordoned": cordoned,
+        "train_status": {"resizes": train.get("resizes", 0),
+                         "activeReplicas": train.get("activeReplicas", 0)},
+        "chat_target": chat.get("targetReplicas"),
+        "decision_fingerprint": hashlib.sha256(
+            decision_log.encode()).hexdigest(),
+    }
+
+
+# FULL: all three incidents fire, remediate AND resolve (the
+# SchedulerPassSlow [10m] rate window needs ~40 cycles to slide the
+# slow samples out). SMOKE: the CI-gate config — A and C heal fully;
+# B fires and remediates but its resolution outlives the window.
+FULL_CONFIG = {"cycles": 80, "seed": 0, "kv_at": 6, "pass_at": 14,
+               "burn_at": 30}
+SMOKE_CONFIG = {"cycles": 44, "seed": 0, "kv_at": 4, "pass_at": 8,
+                "burn_at": 14}
+
+
+def check_against(banked_path: str) -> int:
+    """CI ratchet: rerun the banked smoke config. Fail (1) when the
+    decision fingerprint, op counts or heal timelines drift (the fleet
+    DECIDED differently on identical input), or when plane/control p99
+    regresses past 3x the committed budget (floored at 250 ms so CI
+    contention cannot flake the gate)."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    smoke = banked.get("smoke")
+    if not smoke:
+        print(f"check: no smoke section in {banked_path}", file=sys.stderr)
+        return 2
+    now = run_bench(**smoke["config"])
+    ok = True
+    if now["decision_fingerprint"] != smoke["decision_fingerprint"]:
+        print("check: decision fingerprint drifted "
+              f"({now['decision_fingerprint'][:12]} != banked "
+              f"{smoke['decision_fingerprint'][:12]}) — alerting or "
+              "remediation decided differently on identical input",
+              file=sys.stderr)
+        ok = False
+    for key in ("appends", "series", "samples_total", "heals",
+                "cordoned", "remediation_results"):
+        if now[key] != smoke[key]:
+            print(f"check: {key} {now[key]!r} != banked {smoke[key]!r} "
+                  "(the drill must replay exactly)", file=sys.stderr)
+            ok = False
+    for key in ("plane_p99_ms", "control_p99_ms"):
+        budget = max(smoke[key] * 3.0, 250.0)
+        if now[key] > budget:
+            print(f"check: {key} {now[key]} exceeds budget {budget:.3f} "
+                  f"(banked {smoke[key]})", file=sys.stderr)
+            ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "plane_p99_ms": now["plane_p99_ms"],
+                      "control_p99_ms": now["control_p99_ms"],
+                      "fingerprint": now["decision_fingerprint"][:12]},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-smoke", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="rerun the banked smoke config and gate on "
+                         "fingerprint/op-count/heal drift or a >3x "
+                         "p99 budget regression")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_against(args.out)
+
+    config = dict(FULL_CONFIG, seed=args.seed)
+    if args.cycles:
+        config["cycles"] = args.cycles
+    full = run_bench(**config)
+    result = {"bench": "heal_bench", "round": "r01", "full": full}
+    if not args.no_smoke:
+        result["smoke"] = run_bench(**SMOKE_CONFIG)
+    unhealed = [a for a, h in full["heals"].items() if not h["healed"]]
+    if unhealed:
+        print(f"WARNING: full config left incidents unhealed: {unhealed}",
+              file=sys.stderr)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({
+        "out": args.out,
+        "heals": full["heals"],
+        "cordoned": full["cordoned"],
+        "train_status": full["train_status"],
+        "plane_p99_ms": full["plane_p99_ms"],
+        "control_p99_ms": full["control_p99_ms"]}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

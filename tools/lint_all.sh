@@ -10,6 +10,10 @@
 #   tools/lint_all.sh --json     # write tools/lint_baseline.json
 #   tools/lint_all.sh --diff     # ratchet: fail only on NEW findings
 #                                # vs the committed baseline
+#   tools/lint_all.sh --bench    # decision ratchet: rerun every banked
+#                                # bench smoke config (sched / serve /
+#                                # obs / mslice / heal --check) and fail
+#                                # on fingerprint/op-count drift
 #
 # The ratchet (ISSUE 2 satellite) lets a rule tighten without a
 # flag-day: commit today's findings with --json, gate on --diff, and
@@ -79,8 +83,22 @@ EOF
         --baseline "$BASELINE" "${OBS_PATHS[@]}" || rc=1
     exit $rc
     ;;
+--bench)
+    # the decision-ratchet tier: each bench reruns its committed smoke
+    # bank and fails when the decision fingerprint or exact op counts
+    # drift — the "scheduler/plane/fleet DECIDED differently" gate that
+    # static analysis can't see. Wall-clock gates inside each --check
+    # are 3x-budgeted so a loaded CI box cannot flake this tier.
+    rc=0
+    for bench in sched_bench serve_bench obs_bench mslice_bench \
+            heal_bench; do
+        echo "== $bench --check"
+        JAX_PLATFORMS=cpu "$PY" "tools/$bench.py" --check || rc=1
+    done
+    exit $rc
+    ;;
 *)
-    echo "usage: tools/lint_all.sh [--json|--diff]" >&2
+    echo "usage: tools/lint_all.sh [--json|--diff|--bench]" >&2
     exit 2
     ;;
 esac
