@@ -166,8 +166,8 @@ def _load_builtin_rules() -> None:
     # import for the @register side effect; lazy so core stays importable
     # from rule modules without a cycle
     from kubeflow_tpu.analysis import (  # noqa: F401
-        rules_collectives, rules_jax, rules_lockset, rules_obs, rules_order,
-        rules_sharding,
+        rules_collectives, rules_jax, rules_lockset, rules_net, rules_obs,
+        rules_order, rules_sharding,
     )
 
 

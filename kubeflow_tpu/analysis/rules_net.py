@@ -1,0 +1,75 @@
+"""tpulint network/liveness rule (NET501) for the request path.
+
+The serving and control planes are built from threads that call each
+other over HTTP and park on events. A single missing timeout in that
+web is how a replica brownout (slow, not dead) wedges the whole plane:
+the PR 14 resilience layer (deadlines, hedges, breakers) only works if
+no hop can block forever underneath it. NET501 makes "every wait is
+bounded" a static property of ``serving/`` and ``control/``:
+
+- ``urlopen(...)`` must pass an explicit ``timeout`` (kwarg or the
+  third positional) — the stdlib default is the global socket timeout,
+  which is None unless someone set it process-wide;
+- bare ``.wait()`` on an event/condition must pass a timeout. The few
+  parks that are provably bounded by protocol (a loop that fires the
+  event on every exit path) carry a per-line suppression with the
+  justification, so the invariant is auditable instead of implicit.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, Rule, call_name, register,
+)
+
+
+@register
+class UnboundedNetworkWait(Rule):
+    """NET501: unbounded block on the request path. A browned-out peer
+    (slow, not dead) turns every missing timeout into a stuck thread —
+    and stuck threads are what deadlines/hedges exist to prevent."""
+
+    id = "NET501"
+    name = "unbounded-network-wait"
+    short = "blocking wait / urlopen without an explicit timeout"
+
+    # the planes where a wedged thread takes requests down with it;
+    # non-file paths ("<corpus>", "<string>") are always in scope so the
+    # corpus pins exercise the rule directly
+    _SCOPES = ("serving/", "control/")
+
+    def _in_scope(self, module: Module) -> bool:
+        p = module.path.replace("\\", "/")
+        if not p.endswith(".py"):
+            return True
+        return any(s in p for s in self._SCOPES)
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not self._in_scope(module):
+            return
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name and (name == "urlopen" or name.endswith(".urlopen")):
+                has_timeout = (
+                    any(kw.arg == "timeout" for kw in node.keywords)
+                    # urlopen(url, data, timeout): third positional
+                    or len(node.args) >= 3)
+                if not has_timeout:
+                    yield self.finding(
+                        module, node,
+                        f"{name}() without an explicit timeout: a "
+                        "browned-out replica blocks this thread forever "
+                        "— pass timeout= so the deadline layer can act")
+            elif (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "wait"
+                    and not node.args and not node.keywords):
+                yield self.finding(
+                    module, node,
+                    "bare .wait() with no timeout on the request path; "
+                    "pass a timeout (or suppress with the protocol that "
+                    "guarantees the event fires)")

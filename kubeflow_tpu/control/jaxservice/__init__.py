@@ -16,11 +16,16 @@ from __future__ import annotations
 
 
 def watch_endpoints(apiserver: str, namespace: str, name: str,
-                    router) -> None:  # pragma: no cover - container glue
+                    router,
+                    frontend=None,
+                    ) -> None:  # pragma: no cover - container glue
     """Router-side membership feed: watch ONE JAXService and apply its
     endpoints annotation to the router on every event (plus an initial
-    read). Runs forever; stream death resubscribes (the control/runtime
-    watch discipline)."""
+    read). When a ``RouterFrontend`` is passed, the spec's resilience
+    defaults (band/deadline/hedge) are adopted per event too, so a spec
+    edit retunes the request path without a router restart. Runs
+    forever; stream death resubscribes (the control/runtime watch
+    discipline)."""
     import logging
     import time as _time
 
@@ -31,17 +36,22 @@ def watch_endpoints(apiserver: str, namespace: str, name: str,
     log = logging.getLogger("kubeflow_tpu.jaxservice")
     client = RestClient(base_url=apiserver or None)
     factory = lambda ep: HttpTransport(ep["addr"])  # noqa: E731
+
+    def apply(obj: dict) -> None:
+        router.sync_from_object(obj, transport_factory=factory)
+        if frontend is not None:
+            frontend.apply_spec(obj)
+
     while True:
         try:
             obj = client.get_or_none(T.API_VERSION, T.KIND, name, namespace)
             if obj is not None:
-                router.sync_from_object(obj, transport_factory=factory)
+                apply(obj)
             for ev in client.watch(T.API_VERSION, T.KIND):
                 m = (ev.object.get("metadata") or {})
                 if m.get("name") == name \
                         and (m.get("namespace") or "default") == namespace:
-                    router.sync_from_object(
-                        ev.object, transport_factory=factory)
+                    apply(ev.object)
         except Exception:
             log.exception("endpoints watch failed; resubscribing")
         _time.sleep(0.5)

@@ -182,6 +182,12 @@ class JAXServiceReconciler(Reconciler):
                     "--decode-slots", str(model["decodeSlots"])]
         if model["paramDtype"]:
             cmd += ["--param-dtype", model["paramDtype"]]
+        res = T.resilience_spec(spec)
+        if res["maxInflight"]:
+            # replica-side overload gate: beyond this many concurrent
+            # requests the server 429s with Retry-After instead of
+            # queueing unboundedly (docs/robustness.md)
+            cmd += ["--max-inflight", str(res["maxInflight"])]
         return cmd
 
     def generate_pod(self, svc: dict, index: int) -> dict:

@@ -30,6 +30,8 @@ from kubeflow_tpu.control.scheduler.topology import parse_topology
 # re-exported here for the control plane.
 from kubeflow_tpu.serving.router import (  # noqa: F401
     ANNOTATION_ENDPOINTS,
+    BAND_DEFAULT,
+    BAND_RANK,
     STATE_ACTIVE,
     STATE_CORDONED,
 )
@@ -128,6 +130,32 @@ def autoscaling_spec(spec: dict) -> dict:
             "scaleUpStabilizationSeconds", DEFAULT_UP_STABILIZATION_S),
         "scaleDownStabilizationSeconds": a.get(
             "scaleDownStabilizationSeconds", DEFAULT_DOWN_STABILIZATION_S),
+    }
+
+
+def resilience_spec(spec: dict) -> dict:
+    """spec.resilience with defaults — the namespace-level request
+    resilience knobs the router frontend adopts through the endpoints
+    watch (``RouterFrontend.apply_spec``):
+
+    - ``defaultBand``: criticality band for requests without an
+      x-request-band header (the ROADMAP #3 multi-tenancy bridge —
+      a tenant's JAXService declares how sheddable its traffic is);
+    - ``deadlineSeconds``: deadline for requests without an
+      x-request-deadline-s header (0 = no default deadline);
+    - ``hedge``: whether the frontend may race a second replica leg;
+    - ``maxInflight``: per-REPLICA concurrent-request admission cap
+      (0 = unbounded), threaded into the model-server command line so
+      an overloaded replica 429s with Retry-After instead of queueing
+      unboundedly.
+    """
+    r = spec.get("resilience")
+    r = r if isinstance(r, dict) else {}
+    return {
+        "defaultBand": r.get("defaultBand", BAND_DEFAULT),
+        "deadlineSeconds": r.get("deadlineSeconds", 0.0),
+        "hedge": bool(r.get("hedge", True)),
+        "maxInflight": r.get("maxInflight", 0),
     }
 
 
@@ -245,6 +273,19 @@ def validate(svc: dict) -> list[str]:
             and drain >= 0):
         errs.append("spec.drainSeconds must be a non-negative number, "
                     f"got {drain!r}")
+    res = resilience_spec(spec)
+    if res["defaultBand"] not in BAND_RANK:
+        errs.append("spec.resilience.defaultBand must be one of "
+                    f"{sorted(BAND_RANK)}, got {res['defaultBand']!r}")
+    dl = res["deadlineSeconds"]
+    if not (isinstance(dl, (int, float)) and not isinstance(dl, bool)
+            and dl >= 0):
+        errs.append("spec.resilience.deadlineSeconds must be a "
+                    f"non-negative number, got {dl!r}")
+    mi = res["maxInflight"]
+    if not (isinstance(mi, int) and not isinstance(mi, bool) and mi >= 0):
+        errs.append("spec.resilience.maxInflight must be a non-negative "
+                    f"int, got {mi!r}")
     tpu = spec.get("tpu") or {}
     topology = tpu.get("topology") or ""
     if topology:

@@ -85,7 +85,9 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     stop = threading.Event()
     signal.signal(signal.SIGTERM, lambda *a: stop.set())
     signal.signal(signal.SIGINT, lambda *a: stop.set())
-    stop.wait()
+    # process lifetime park, released only by SIGTERM/SIGINT — not a
+    # request-path wait, nothing upstream is blocked on this thread
+    stop.wait()  # tpulint: disable=NET501  signal-released process park
     ctl.stop()
     if goodput_exporter is not None:
         goodput_exporter.stop()

@@ -43,9 +43,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any
 
 from kubeflow_tpu.runtime.metrics import REGISTRY as METRICS_REGISTRY
+# the ONE spelling of the 504 across the serving plane (router.py is
+# jax-free, so this import costs nothing)
+from kubeflow_tpu.serving.router import DeadlineExceeded
 
 log = __import__("logging").getLogger("kubeflow_tpu.serving.continuous")
 
@@ -159,7 +163,7 @@ class SlotDecoder:
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  mesh=None, prefix_cache: bool = True,
                  draft_model=None, draft_variables=None, draft_k: int = 4,
-                 metrics_name: str | None = None):
+                 metrics_name: str | None = None, clock=None):
         import jax
         import jax.numpy as jnp
 
@@ -174,6 +178,9 @@ class SlotDecoder:
         self.P = prompt_len
         self.N = max_new_tokens
         self.mesh = mesh
+        # deadline clock (injectable for deterministic cancel tests);
+        # submit deadlines are ABSOLUTE values on this clock
+        self.clock = clock if clock is not None else time.monotonic
         self._jnp = jnp
         self._jax = jax
         cfg_vocab = model.cfg.vocab_size
@@ -224,6 +231,7 @@ class SlotDecoder:
             "prefill_tokens_computed": 0, "prompt_tokens_submitted": 0,
             "spec_rounds": 0, "spec_tokens_emitted": 0,
             "spec_tokens_accepted": 0, "spec_drafted": 0,
+            "deadline_canceled": 0,
         }
 
         # Params are jit ARGUMENTS everywhere below, never closure
@@ -476,17 +484,22 @@ class SlotDecoder:
 
     # -- host API ----------------------------------------------------------
 
-    def submit(self, tokens: list[int], max_new: int | None = None
-               ) -> list[int]:
+    def submit(self, tokens: list[int], max_new: int | None = None,
+               deadline: float | None = None) -> list[int]:
         """Block until the continuation for this prompt is decoded.
         `max_new` caps THIS request's budget below the decoder-wide
-        max_new_tokens (a paged decoder then reserves fewer pages)."""
+        max_new_tokens (a paged decoder then reserves fewer pages).
+        `deadline` is an ABSOLUTE time on self.clock: past it the
+        request is canceled wherever it is (queued, carried, or
+        mid-decode — its slot and KV pages return to the pool) and the
+        caller sees DeadlineExceeded."""
         row = [int(t) for t in tokens][-self.P:]
         pad = self.P - len(row)
-        return self.submit_padded([0] * pad + row, pad, max_new)
+        return self.submit_padded([0] * pad + row, pad, max_new, deadline)
 
     def submit_padded(self, padded_row, pad: int,
-                      max_new: int | None = None) -> list[int]:
+                      max_new: int | None = None,
+                      deadline: float | None = None) -> list[int]:
         """Pre-padded variant for callers that already align rows."""
         import numpy as np
 
@@ -499,9 +512,19 @@ class SlotDecoder:
         with self._lock:  # enqueue-before-drain or fail fast, atomically
             if self._stop:
                 raise RuntimeError("decoder shut down")
-            self._pending.put((prompt, pad, req, ev, sink))
+            self._pending.put((prompt, pad, req, ev, sink, deadline))
         self._wake.set()
-        ev.wait()
+        if deadline is None:
+            # the loop fires ev on EVERY exit path (complete, cancel,
+            # fail_all, shutdown drain), so the unbounded park is safe
+            ev.wait()  # tpulint: disable=NET501  loop guarantees ev.set
+        else:
+            # bounded wait: the loop cancels the slot at the next round
+            # boundary; the grace poll only guards a wedged loop thread
+            while not ev.wait(timeout=0.25):
+                if self.clock() >= deadline + 30.0:
+                    raise DeadlineExceeded(
+                        "decoder unresponsive past request deadline")
         if sink and isinstance(sink[0], Exception):
             raise sink[0]
         return sink
@@ -555,16 +578,16 @@ class SlotDecoder:
                 jnp.asarray([c[1] for c in copies], jnp.int32))
 
     def _drain_shutdown(self, owners: dict) -> None:
-        for ev, sink, _req in list(owners.values()):
+        for ev, sink, _req, _dl in list(owners.values()):
             sink.append(RuntimeError("decoder shut down"))
             ev.set()
         if self._carry is not None:
-            _p, _pad, _req, ev, sink = self._carry
+            _p, _pad, _req, ev, sink, _dl = self._carry
             sink.append(RuntimeError("decoder shut down"))
             ev.set()
             self._carry = None
         while not self._pending.empty():
-            _p, _pad, _req, ev, sink = self._pending.get_nowait()
+            _p, _pad, _req, ev, sink, _dl = self._pending.get_nowait()
             sink.append(RuntimeError("decoder shut down"))
             ev.set()
 
@@ -579,8 +602,16 @@ class SlotDecoder:
 
     def _validate(self, item) -> bool:
         """Row-shape validation; a malformed row fails ONLY its caller
-        and never reaches a slot."""
-        prompt, _pad, _req, ev, sink = item
+        and never reaches a slot. Also the queue-side deadline gate: a
+        request that expired while waiting (or carried at the page gate)
+        is shed here, BEFORE it costs a prefill."""
+        prompt, _pad, _req, ev, sink, dl = item
+        if dl is not None and self.clock() >= dl:
+            sink.append(DeadlineExceeded(
+                "deadline elapsed before admission"))
+            ev.set()
+            self._counters["deadline_canceled"] += 1
+            return False
         if prompt.shape != (self.P,):
             sink.append(ValueError(
                 f"padded row must have length {self.P}, "
@@ -588,6 +619,24 @@ class SlotDecoder:
             ev.set()
             return False
         return True
+
+    def _expired_slots(self, owners: dict) -> list[int]:
+        """Active slots whose request deadline has passed."""
+        now = self.clock()
+        return [s_ for s_, own in owners.items()
+                if own[3] is not None and now >= own[3]]
+
+    def _cancel_slot(self, owners: dict, slot: int) -> None:
+        """Cancel ONE mid-decode slot: waiter gets DeadlineExceeded, the
+        slot and (paged) its KV pages go back to the pool. Zero-leak is
+        the contract — alloc.check() stays clean after any cancel."""
+        ev, sink, _req, _dl = owners.pop(slot)
+        sink.append(DeadlineExceeded("deadline exceeded during decode"))
+        ev.set()
+        self._free.append(slot)
+        self._counters["deadline_canceled"] += 1
+        if self.paged:
+            self.alloc.free(slot)
 
     # -- scheduler loop (plain greedy/sampled decode) ----------------------
 
@@ -597,7 +646,7 @@ class SlotDecoder:
         import numpy as np
 
         jnp = self._jnp
-        owners: dict[int, tuple] = {}   # slot -> (ev, sink, req)
+        owners: dict[int, tuple] = {}   # slot -> (ev, sink, req, deadline)
         ctx = self.mesh if self.mesh is not None else None
 
         def fail_all(err, batch=()):
@@ -605,10 +654,10 @@ class SlotDecoder:
             failed donated call the old buffers are dead — continuing on
             them would turn the decoder into a zombie that errors every
             future request while still accepting submits."""
-            for _p, _pad, _req, ev, sink in batch:
+            for _p, _pad, _req, ev, sink, _dl in batch:
                 sink.append(err)
                 ev.set()
-            for s_, (ev, sink, _req) in list(owners.items()):
+            for s_, (ev, sink, _req, _dl) in list(owners.items()):
                 sink.append(err)
                 ev.set()
             owners.clear()
@@ -625,6 +674,18 @@ class SlotDecoder:
                     self._admit_paged(owners, fail_all, last_rem, last_pos)
                 else:
                     self._admit_dense(owners, fail_all, last_rem)
+                # cancel expired slots at the round boundary: zero their
+                # remaining (the masked step then treats them as idle)
+                # and return slot + pages to the pool before the next
+                # admission pass can want them
+                expired = self._expired_slots(owners)
+                if expired:
+                    self.state = self._clear_slots(
+                        self.state, jnp.asarray(expired, jnp.int32))
+                    for s_ in expired:
+                        self._cancel_slot(owners, s_)
+                        last_rem[s_] = 0
+                    self._publish_pages()
                 self._note_active(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)
@@ -672,7 +733,7 @@ class SlotDecoder:
                     if remaining[s_] <= 0:
                         if out is None:  # one readback per tick, lazily
                             out = np.asarray(self.state[4])
-                        ev, sink, req = owners.pop(s_)
+                        ev, sink, req, _dl = owners.pop(s_)
                         sink.extend(int(t) for t in out[s_][:req])
                         ev.set()
                         self._free.append(s_)
@@ -720,7 +781,7 @@ class SlotDecoder:
         prompts = np.zeros((k, self.P), np.int32)
         pads = np.zeros((k,), np.int32)
         news = np.zeros((k,), np.int32)
-        for i, (prompt, pad, req, _ev, _sink) in enumerate(batch):
+        for i, (prompt, pad, req, _ev, _sink, _dl) in enumerate(batch):
             prompts[i] = prompt
             pads[i] = pad
             news[i] = req
@@ -756,8 +817,8 @@ class SlotDecoder:
         self._counters["prompt_tokens_submitted"] += len(batch) * self.P
         if self.meter:
             self.meter.prefill_tokens(len(batch) * self.P)
-        for s_, (prompt, pad, req, ev, sink) in zip(slots, batch):
-            owners[s_] = (ev, sink, req)
+        for s_, (prompt, pad, req, ev, sink, dl) in zip(slots, batch):
+            owners[s_] = (ev, sink, req, dl)
             last_rem[s_] = req
 
     # -- admission: paged (per-request suffix prefill, page-gated) ---------
@@ -777,7 +838,7 @@ class SlotDecoder:
                 return
             if not self._validate(item):
                 continue
-            prompt, pad, req, ev, sink = item
+            prompt, pad, req, ev, sink, dl = item
             row = [int(t) for t in prompt]
             total = self.P + req + self.draft_k
             if not self.alloc.can_admit(row, pad, total):
@@ -804,7 +865,7 @@ class SlotDecoder:
                 self._free.append(slot)
                 fail_all(e, [item])
                 return
-            owners[slot] = (ev, sink, req)
+            owners[slot] = (ev, sink, req, dl)
             last_rem[slot] = req
             last_pos[slot] = self.P
             self._counters["admitted"] += 1
@@ -829,7 +890,7 @@ class SlotDecoder:
         jnp = self._jnp
         k = self.draft_k
         K1 = k + 1
-        owners: dict[int, tuple] = {}    # slot -> (ev, sink, req)
+        owners: dict[int, tuple] = {}    # slot -> (ev, sink, req, deadline)
         out_h: dict[int, list] = {}      # slot -> emitted tokens
         ebuf: dict[int, list] = {}       # slot -> last round's emissions
         pos_h = np.zeros(self.S, np.int64)   # position of each cur token
@@ -838,10 +899,10 @@ class SlotDecoder:
         ctx = self.mesh if self.mesh is not None else None
 
         def fail_all(err, batch=()):
-            for _p, _pad, _req, ev, sink in batch:
+            for _p, _pad, _req, ev, sink, _dl in batch:
                 sink.append(err)
                 ev.set()
-            for s_, (ev, sink, _req) in list(owners.items()):
+            for s_, (ev, sink, _req, _dl) in list(owners.items()):
                 sink.append(err)
                 ev.set()
             owners.clear()
@@ -854,7 +915,7 @@ class SlotDecoder:
             self.d_cache = self._fresh_d_cache()
 
         def complete(slot) -> None:
-            ev, sink, _req = owners.pop(slot)
+            ev, sink, _req, _dl = owners.pop(slot)
             sink.extend(out_h.pop(slot))
             ebuf.pop(slot, None)
             ev.set()
@@ -873,7 +934,7 @@ class SlotDecoder:
                     return
                 if not self._validate(item):
                     continue
-                prompt, pad, req, ev, sink = item
+                prompt, pad, req, ev, sink, dl = item
                 row = [int(t) for t in prompt]
                 total = self.P + req + k
                 if self.paged:
@@ -922,7 +983,7 @@ class SlotDecoder:
                     fail_all(e, [item])
                     return
                 cur = int(first)
-                owners[slot] = (ev, sink, req)
+                owners[slot] = (ev, sink, req, dl)
                 out_h[slot] = [cur]
                 ebuf[slot] = [cur]
                 pos_h[slot] = self.P
@@ -946,6 +1007,17 @@ class SlotDecoder:
         while not self._stop:
             try:
                 admit()
+                # round-boundary deadline sweep: the canceled slot's
+                # host mirrors are dropped, so the next round simply
+                # never emits for it (caches hold only dead rows)
+                expired = self._expired_slots(owners)
+                if expired:
+                    for s_ in expired:
+                        self._cancel_slot(owners, s_)
+                        out_h.pop(s_, None)
+                        ebuf.pop(s_, None)
+                        rem_h[s_] = 0
+                    self._publish_pages()
                 self._note_active(owners)
                 if not owners:
                     self._wake.wait(timeout=0.05)

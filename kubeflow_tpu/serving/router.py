@@ -43,8 +43,10 @@ runtime in.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
+import math
 import threading
 import time
 from dataclasses import dataclass, field
@@ -68,6 +70,28 @@ STATE_CORDONED = "cordoned"
 # long-context decodes under queueing.
 REQUEST_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                    60.0, 120.0, 300.0)
+
+# Criticality bands (the ROADMAP #3 multi-tenancy bridge): under
+# overload the router sheds the HIGHEST rank first, so interactive
+# traffic survives a batch-traffic wave. Namespace-defaulted through the
+# JAXService spec (control/jaxservice/types.py resilience_spec).
+BAND_CRITICAL = "critical"
+BAND_DEFAULT = "default"
+BAND_SHEDDABLE = "sheddable"
+BAND_RANK = {BAND_CRITICAL: 0, BAND_DEFAULT: 1, BAND_SHEDDABLE: 2}
+BANDS = tuple(BAND_RANK)
+
+# Circuit-breaker states (gauge values for router_breaker_state)
+BREAKER_CLOSED = "closed"
+BREAKER_HALF_OPEN = "half-open"
+BREAKER_OPEN = "open"
+_BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+
+# Request headers the shell understands (and forwards replica-ward):
+# the remaining deadline budget in seconds — it SHRINKS across retry
+# hops — and the criticality band.
+HEADER_DEADLINE = "x-request-deadline-s"
+HEADER_BAND = "x-request-band"
 
 def _prom_metric(name, kind, doc, **kw):
     from kubeflow_tpu.runtime.metrics import prom_metric
@@ -116,8 +140,105 @@ def prom_tokens_total():
                         labelnames=("service",))
 
 
+def prom_hedges_total():
+    import prometheus_client as prom
+
+    return _prom_metric("router_hedges_total", prom.Counter,
+                        "hedged dispatches by outcome "
+                        "(started/won/canceled)",
+                        labelnames=("service", "outcome"))
+
+
+def prom_deadline_exceeded_total():
+    import prometheus_client as prom
+
+    return _prom_metric("router_deadline_exceeded_total", prom.Counter,
+                        "requests dropped because their deadline elapsed",
+                        labelnames=("service",))
+
+
+def prom_breaker_state():
+    import prometheus_client as prom
+
+    return _prom_metric("router_breaker_state", prom.Gauge,
+                        "per-replica circuit breaker "
+                        "(0=closed 1=half-open 2=open)",
+                        labelnames=("service", "replica"))
+
+
+def prom_shed_total():
+    import prometheus_client as prom
+
+    return _prom_metric("router_shed_total", prom.Counter,
+                        "queued requests evicted by criticality band "
+                        "under overload",
+                        labelnames=("service", "band"))
+
+
+def prom_retry_budget():
+    import prometheus_client as prom
+
+    return _prom_metric("router_retry_budget", prom.Gauge,
+                        "retry/hedge token bucket level — 0 means the "
+                        "fleet is failing faster than it refills",
+                        labelnames=("service",))
+
+
 class RouterBusy(Exception):
-    """Admission queue full — the HTTP shell's 429 Too Many Requests."""
+    """Admission queue full — the HTTP shell's 429 Too Many Requests.
+    ``retry_after`` (seconds, derived from the queue drain rate) rides
+    along so the 429 response can carry a Retry-After header."""
+
+    retry_after: float | None = None
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline elapsed before it could be served — the
+    HTTP shell's 504. Raised by ``submit`` for dead-on-arrival requests
+    and by the continuous batcher when it cancels an expired slot."""
+
+
+@dataclass
+class ResilienceConfig:
+    """Tuning for the request-resilience layer. ``TokenRouter`` built
+    WITHOUT one (the default) behaves exactly like the pre-resilience
+    router — same pick key, same FIFO drain, no breakers/hedges — so
+    banked decision replays (BENCH_SERVE_r01) stay byte-identical."""
+
+    # EWMA smoothing for per-replica completion latency
+    ewma_alpha: float = 0.3
+    # consecutive transport failures that trip a breaker open
+    breaker_failures: int = 3
+    # open -> half-open probe delay (seconds on the router clock)
+    breaker_cooloff_s: float = 5.0
+    # hedge after this quantile of recent completion latencies...
+    hedge_quantile: float = 0.95
+    # ...but never sooner than this (protects against hedging every
+    # request when the fleet is uniformly fast)
+    hedge_min_s: float = 0.25
+    # minimum completed samples before hedging activates
+    hedge_min_samples: int = 16
+    # token-bucket retry budget: refilled per ADMITTED request, spent
+    # 1.0 per retry or hedge — a failing fleet cannot amplify its own
+    # load beyond ~ratio of offered traffic
+    retry_budget_ratio: float = 0.1
+    retry_budget_cap: float = 32.0
+    # completion-latency window feeding the hedge quantile
+    latency_window: int = 128
+
+
+class _Health:
+    """Per-replica health the breaker and scorer read. Lives outside
+    membership so a replica that flaps out and back keeps its history."""
+
+    __slots__ = ("lat", "fails", "state", "opened_at", "probing")
+
+    def __init__(self) -> None:
+        self.lat: float | None = None   # EWMA completion latency (s)
+        self.fails = 0                  # consecutive transport failures
+        self.state = BREAKER_CLOSED
+        self.opened_at = 0.0
+        self.probing = False            # half-open probe outstanding
 
 
 @dataclass
@@ -151,6 +272,17 @@ class Ticket:
     _t0: float = 0.0
     _span: "obs_trace.Span | None" = field(default=None, repr=False)
     _queued_at: float = 0.0
+    # -- resilience layer -----------------------------------------------
+    band: str = BAND_DEFAULT
+    deadline: float | None = None       # absolute, on the router clock
+    hedge_member: Member | None = field(default=None, repr=False)
+    # why the router dropped this ticket without the shell asking
+    # ("deadline" / "shed_band" / "retry_budget"); the shell maps it to
+    # 504 / 429 / 503 after its done-event fires
+    dropped_reason: str | None = None
+    retry_after: float | None = None    # rides with "shed_band" drops
+    _dispatched_at: float = 0.0
+    _hedge_at: float = 0.0
 
 
 def estimate_tokens(instances: list, max_new_tokens: int) -> int:
@@ -178,7 +310,9 @@ class TokenRouter:
                  replica_token_budget: int | None = None,
                  clock: Callable[[], float] = time.monotonic,
                  registry: MetricsRegistry | None = None,
-                 tracer=None, prom_sink: bool = True):
+                 tracer=None, prom_sink: bool = True,
+                 resilience: ResilienceConfig | None = None,
+                 on_decision: Callable[[dict], None] | None = None):
         self.service = service
         self.namespace = namespace
         self.max_queue = max_queue
@@ -192,12 +326,26 @@ class TokenRouter:
         # prometheus is process-global; the deterministic bench runs
         # many routers per process and opts out of the shared sink
         self._prom = prom_sink
+        # None = legacy behavior, decision-for-decision (the banked
+        # BENCH_SERVE_r01 replay depends on it)
+        self.resilience = resilience
+        # deterministic decision tap for the resilience bench: called
+        # UNDER the lock with {"kind", "t", ...} on breaker transitions,
+        # hedges, band sheds, and deadline drops
+        self.on_decision = on_decision
         self._lock = threading.Lock()
         self._members: dict[str, Member] = {}
         self._inflight: dict[str, dict[int, Ticket]] = {}  # name -> tickets
         self._tokens: dict[str, int] = {}                  # name -> estimate
         self._queue: list[Ticket] = []
         self._closed = False
+        self._health: dict[str, _Health] = {}              # name -> health
+        self._lat_samples: collections.deque = collections.deque(
+            maxlen=(resilience.latency_window if resilience else 64))
+        # recent completion stamps -> queue drain rate -> Retry-After
+        self._completions: collections.deque = collections.deque(maxlen=64)
+        self._retry_tokens = (resilience.retry_budget_cap
+                              if resilience else 0.0)
 
     # -- membership (controller-fed) ----------------------------------------
 
@@ -305,35 +453,99 @@ class TokenRouter:
     # -- admission -----------------------------------------------------------
 
     def submit(self, tokens: int, item: Any = None,
-               context: "obs_trace.SpanContext | None" = None) -> Ticket:
+               context: "obs_trace.SpanContext | None" = None,
+               band: str = BAND_DEFAULT,
+               deadline: float | None = None) -> Ticket:
         """Admit one request of ``tokens`` estimated cost. Dispatches
         immediately to the least-loaded eligible replica, else queues;
-        raises ``RouterBusy`` (429) when the bounded queue is full."""
-        t = Ticket(tokens=int(tokens), item=item, context=context)
-        with self._lock:
-            if self._closed:
-                raise RouterBusy("router is shut down")
-            now = self.clock()
-            t._t0 = t._queued_at = now
-            member = self._pick_locked(t.tokens)
-            if member is not None:
-                self._dispatch_locked(t, member, now)
-            elif len(self._queue) >= self.max_queue:
-                self._count_locked("rejected")
-                raise RouterBusy(
-                    f"admission queue full ({self.max_queue})")
-            else:
-                self._queue.append(t)
-            self._publish_queue_locked()
+        raises ``RouterBusy`` (429) when the bounded queue is full —
+        unless a strictly-less-critical ticket is queued, in which case
+        THAT one is shed instead (band shedding; resilience mode only).
+        ``deadline`` is absolute on the router clock; a dead-on-arrival
+        request raises ``DeadlineExceeded`` (504) without queueing."""
+        t = Ticket(tokens=int(tokens), item=item, context=context,
+                   band=band if band in BAND_RANK else BAND_DEFAULT,
+                   deadline=deadline)
+        victim: Ticket | None = None
+        expired: list[Ticket] = []
+        try:
+            with self._lock:
+                if self._closed:
+                    raise RouterBusy("router is shut down")
+                now = self.clock()
+                t._t0 = t._queued_at = now
+                if self.resilience is not None:
+                    self._refill_budget_locked()
+                if t.deadline is not None and now >= t.deadline:
+                    self._drop_deadline_locked(t, now)
+                    raise DeadlineExceeded(
+                        "deadline elapsed before admission")
+                expired = self._sweep_deadlines_locked(now)
+                member = self._pick_locked(t.tokens)
+                if member is not None:
+                    self._dispatch_locked(t, member, now)
+                elif len(self._queue) >= self.max_queue:
+                    victim = self._shed_band_locked(t, now)
+                    if victim is None:
+                        self._count_locked("rejected")
+                        e = RouterBusy(
+                            f"admission queue full ({self.max_queue})")
+                        e.retry_after = self._retry_after_locked(now)
+                        self._publish_queue_locked()
+                        raise e
+                    self._queue.append(t)
+                else:
+                    self._queue.append(t)
+                self._publish_queue_locked()
+        finally:
+            # fire drop notifications even on the raise paths — a shell
+            # thread parked on a swept/shed ticket must wake regardless
+            # of how THIS submit exits
+            for dead in expired:
+                dead.done.set()
+            if victim is not None:
+                victim.done.set()
         if t.member is not None:
             t.done.set()
         return t
 
+    def _shed_band_locked(self, t: Ticket, now: float) -> Ticket | None:
+        """Full queue + new arrival: evict the NEWEST queued ticket of
+        the most-sheddable band strictly less critical than the
+        arrival. Returns the victim (caller fires its done event), or
+        None when nothing queued is less critical — then the ARRIVAL is
+        the right thing to reject."""
+        if self.resilience is None or not self._queue:
+            return None
+        my_rank = BAND_RANK.get(t.band, BAND_RANK[BAND_DEFAULT])
+        ranks = [BAND_RANK.get(q.band, BAND_RANK[BAND_DEFAULT])
+                 for q in self._queue]
+        worst = max(ranks)
+        if worst <= my_rank:
+            return None
+        idx = len(ranks) - 1 - ranks[::-1].index(worst)
+        victim = self._queue.pop(idx)
+        victim.dropped_reason = "shed_band"
+        victim.retry_after = self._retry_after_locked(now)
+        self._count_locked("shed_band")
+        self.registry.counter_inc(
+            "router_shed_total",
+            help_="queued requests evicted by criticality band under "
+                  "overload",
+            namespace=self.namespace, service=self.service,
+            band=victim.band)
+        if self._prom:
+            prom_shed_total().labels(self.service, victim.band).inc()
+        self._decide_locked("shed", now, band=victim.band)
+        return victim
+
     def complete(self, ticket: Ticket, tokens_done: int | None = None,
-                 ) -> list[Ticket]:
+                 winner: str | None = None) -> list[Ticket]:
         """Mark a dispatched ticket finished; drain the queue into the
         freed capacity. Returns newly dispatched tickets (their
-        ``member`` set) for synchronous drivers.
+        ``member`` set) for synchronous drivers. ``winner`` names the
+        replica whose response was used (a hedged ticket has two legs;
+        the loser's accounting is released here and its leg canceled).
 
         Shed-race safe, symmetric to ``fail``: if a concurrent
         membership sync shed this ticket back into the queue while its
@@ -346,12 +558,42 @@ class TokenRouter:
             now = self.clock()
             if ticket.member is None:
                 self._queue = [t for t in self._queue if t is not ticket]
+            hedge_won = self._resolve_hedge_locked(ticket, winner, now)
+            if self.resilience is not None and ticket.member is not None:
+                wname = winner or ticket.member.name
+                start = ticket._hedge_at if hedge_won \
+                    else ticket._dispatched_at
+                sample = max(now - start, 0.0)
+                self._record_success_locked(wname, sample, now)
+                self._lat_samples.append(sample)
+            self._completions.append(now)
             self._finish_locked(ticket, now, tokens_done)
+            expired = self._sweep_deadlines_locked(now)
             dispatched = self._drain_locked(now)
             self._publish_queue_locked()
+        for t in expired:
+            t.done.set()
         for t in dispatched:
             t.done.set()
         return dispatched
+
+    def _resolve_hedge_locked(self, ticket: Ticket, winner: str | None,
+                              now: float) -> bool:
+        """Release the hedge leg's accounting; True when the hedge leg
+        is the winner (latency/health credit then belongs to it)."""
+        h = ticket.hedge_member
+        if h is None:
+            return False
+        ticket.hedge_member = None
+        if h.name in self._tokens:
+            self._tokens[h.name] = max(
+                0, self._tokens.get(h.name, 0) - ticket.tokens)
+            self._publish_inflight_locked(h.name)
+        won = winner is not None and winner == h.name
+        self._hedge_count_locked("won" if won else "canceled")
+        if won:
+            self._decide_locked("hedge_win", now, replica=h.name)
+        return won
 
     def fail(self, ticket: Ticket, requeue: bool = True) -> list[Ticket]:
         """A transport-level failure for one dispatched ticket: take it
@@ -379,12 +621,40 @@ class TokenRouter:
                     self._tokens[member.name] = max(
                         0, self._tokens.get(member.name, 0) - ticket.tokens)
                     self._publish_inflight_locked(member.name)
+                if self.resilience is not None:
+                    self._record_failure_locked(member.name, now)
+            # a hedged ticket fails as a WHOLE (the shell only calls
+            # fail after both legs failed or it is giving up): release
+            # the hedge leg's accounting and penalize it too
+            h = ticket.hedge_member
+            if h is not None:
+                ticket.hedge_member = None
+                ticket.tried.add(h.name)
+                if h.name in self._tokens:
+                    self._tokens[h.name] = max(
+                        0, self._tokens.get(h.name, 0) - ticket.tokens)
+                    self._publish_inflight_locked(h.name)
+                if self.resilience is not None:
+                    self._record_failure_locked(h.name, now)
+                self._hedge_count_locked("canceled")
             if ticket._span is not None:
                 ticket._span.status = "ERROR"
                 ticket._span.error = "transport failure"
                 self.tracer.finish(ticket._span)
                 ticket._span = None
             ticket.member = None
+            if requeue and self.resilience is not None:
+                # retries draw on the deadline AND the retry budget: an
+                # expired or budget-less ticket drops instead, with the
+                # reason stamped for the shell (504 / 503)
+                if ticket.deadline is not None and now >= ticket.deadline:
+                    requeue = False
+                    ticket.dropped_reason = "deadline"
+                elif not self._spend_budget_locked(1.0):
+                    requeue = False
+                    ticket.dropped_reason = "retry_budget"
+                    ticket.retry_after = self._retry_after_locked(now)
+                    self._decide_locked("retry_budget_drop", now)
             queued = any(t is ticket for t in self._queue)
             if requeue:
                 ticket.done.clear()
@@ -395,9 +665,15 @@ class TokenRouter:
                 if queued:
                     self._queue = [t for t in self._queue
                                    if t is not ticket]
-                self._count_locked("failed")
+                if ticket.dropped_reason == "deadline":
+                    self._drop_deadline_locked(ticket, now)
+                else:
+                    self._count_locked("failed")
+            expired = self._sweep_deadlines_locked(now)
             dispatched = self._drain_locked(now)
             self._publish_queue_locked()
+        for t in expired:
+            t.done.set()
         for t in dispatched:
             t.done.set()
         return dispatched
@@ -406,11 +682,79 @@ class TokenRouter:
         """Re-try queued dispatch (capacity may have appeared through a
         membership edit rather than a completion)."""
         with self._lock:
-            dispatched = self._drain_locked(self.clock())
+            now = self.clock()
+            expired = self._sweep_deadlines_locked(now)
+            dispatched = self._drain_locked(now)
             self._publish_queue_locked()
+        for t in expired:
+            t.done.set()
         for t in dispatched:
             t.done.set()
         return dispatched
+
+    # -- resilience: hedging and introspection --------------------------------
+
+    def hedge_delay(self) -> float | None:
+        """Seconds a shell should wait on the primary leg before
+        hedging: the configured quantile of recent completion
+        latencies, floored at ``hedge_min_s``. None = hedging off
+        (no config, or not enough samples yet)."""
+        with self._lock:
+            r = self.resilience
+            if r is None or len(self._lat_samples) < r.hedge_min_samples:
+                return None
+            lat = sorted(self._lat_samples)
+            q = lat[min(int(len(lat) * r.hedge_quantile), len(lat) - 1)]
+            return max(q, r.hedge_min_s)
+
+    def try_hedge(self, ticket: Ticket) -> Member | None:
+        """Open a second leg for a slow dispatched ticket: charges the
+        retry budget, accounts the ticket's tokens against the hedge
+        replica too (it really is doing the work twice), and returns
+        the hedge member for the shell to call — or None when hedging
+        is off, no distinct eligible replica exists, the deadline
+        already passed, or the budget is dry."""
+        with self._lock:
+            r = self.resilience
+            if r is None or self._closed:
+                return None
+            primary = ticket.member
+            if primary is None or ticket.hedge_member is not None:
+                return None
+            now = self.clock()
+            if ticket.deadline is not None and now >= ticket.deadline:
+                return None
+            exclude = set(ticket.tried) | {primary.name}
+            m = self._pick_locked(ticket.tokens, exclude=exclude)
+            # _pick treats exclude as a soft preference (retry beats
+            # starvation); a hedge to the SAME replica is pointless, so
+            # enforce it hard here
+            if m is None or m.name in exclude:
+                return None
+            if not self._spend_budget_locked(1.0):
+                return None
+            ticket.hedge_member = m
+            ticket._hedge_at = now
+            self._tokens[m.name] = \
+                self._tokens.get(m.name, 0) + ticket.tokens
+            self._publish_inflight_locked(m.name)
+            self._hedge_count_locked("started")
+            self._decide_locked("hedge", now, replica=m.name)
+            return m
+
+    def retry_after(self) -> float:
+        """Seconds a rejected client should back off, from the current
+        queue depth over the recent completion rate."""
+        with self._lock:
+            return self._retry_after_locked(self.clock())
+
+    def breaker_states(self) -> dict[str, str]:
+        with self._lock:
+            return {n: h.state for n, h in self._health.items()}
+
+    def retry_budget(self) -> float:
+        with self._lock:
+            return self._retry_tokens
 
     def close(self) -> list[Ticket]:
         """Reject everything still queued (shell shutdown)."""
@@ -454,9 +798,23 @@ class TokenRouter:
         skipped (the request queues for the next completion). Members
         in ``exclude`` (a retrying ticket's failed transports) are
         avoided — unless they are ALL that's left, in which case a
-        retry beats starvation."""
+        retry beats starvation.
+
+        With resilience on, the key becomes (breaker-rank, tried,
+        score-adjusted load, name): open breakers are ineligible, a
+        half-open breaker admits exactly one probe, and load is scaled
+        by EWMA latency relative to the fleet's fastest replica — a
+        browned-out (slow but alive) member looks proportionally more
+        expensive and drains naturally instead of wedging."""
         best = None
         best_key = None
+        resilient = self.resilience is not None
+        min_lat = None
+        if resilient:
+            lats = [h.lat for n, h in self._health.items()
+                    if h.lat is not None and n in self._members]
+            min_lat = min(lats) if lats else None
+        now = self.clock() if resilient else 0.0
         for name, m in self._members.items():
             if m.state != STATE_ACTIVE:
                 continue
@@ -464,7 +822,17 @@ class TokenRouter:
             if self.replica_token_budget is not None and load > 0 \
                     and load + tokens > self.replica_token_budget:
                 continue
-            key = (name in exclude, load, name)
+            if not resilient:
+                key = (0, name in exclude, load, name)
+            else:
+                rank = self._breaker_rank_locked(name, now)
+                if rank >= 3:  # open (or probe already out): ineligible
+                    continue
+                score = 1.0
+                h = self._health.get(name)
+                if h is not None and h.lat is not None and min_lat:
+                    score = max(h.lat / min_lat, 1.0)
+                key = (rank, name in exclude, load * score, name)
             if best_key is None or key < best_key:
                 best, best_key = m, key
         return best
@@ -472,9 +840,14 @@ class TokenRouter:
     def _dispatch_locked(self, t: Ticket, member: Member,
                          now: float) -> None:
         t.member = member
+        t._dispatched_at = now
         self._inflight.setdefault(member.name, {})[id(t)] = t
         self._tokens[member.name] = \
             self._tokens.get(member.name, 0) + t.tokens
+        if self.resilience is not None:
+            h = self._health.get(member.name)
+            if h is not None and h.state == BREAKER_HALF_OPEN:
+                h.probing = True  # exactly one probe per half-open
         # detached: finish() runs in a LATER call (complete/fail/shed),
         # so this span must never install itself as the ambient parent —
         # an out-of-order reset would pollute the caller's contextvar
@@ -516,18 +889,174 @@ class TokenRouter:
             prom_tokens_total().labels(self.service).inc(done)
 
     def _drain_locked(self, now: float) -> list[Ticket]:
-        """FIFO-drain the queue into whatever capacity exists."""
+        """Drain the queue into whatever capacity exists. Legacy mode
+        is strict FIFO; resilience mode drains by (band, FIFO) so a
+        critical request never waits behind a sheddable backlog —
+        band-priority dispatch is the other half of band shedding."""
         dispatched: list[Ticket] = []
-        remaining: list[Ticket] = []
-        for t in self._queue:
+        if self.resilience is None:
+            remaining: list[Ticket] = []
+            for t in self._queue:
+                member = self._pick_locked(t.tokens, exclude=t.tried)
+                if member is None:
+                    remaining.append(t)
+                    continue
+                self._dispatch_locked(t, member, now)
+                dispatched.append(t)
+            self._queue = remaining
+            return dispatched
+        order = sorted(
+            range(len(self._queue)),
+            key=lambda i: (BAND_RANK.get(self._queue[i].band,
+                                         BAND_RANK[BAND_DEFAULT]), i))
+        taken: set[int] = set()
+        for i in order:
+            t = self._queue[i]
             member = self._pick_locked(t.tokens, exclude=t.tried)
             if member is None:
-                remaining.append(t)
                 continue
             self._dispatch_locked(t, member, now)
             dispatched.append(t)
-        self._queue = remaining
+            taken.add(i)
+        if taken:
+            self._queue = [t for i, t in enumerate(self._queue)
+                           if i not in taken]
         return dispatched
+
+    # -- locked resilience internals ------------------------------------------
+
+    def _sweep_deadlines_locked(self, now: float) -> list[Ticket]:
+        """Shed queued tickets whose deadline passed BEFORE spending
+        replica capacity on them. Caller fires each one's done event
+        outside the lock; the shell reads ``dropped_reason``."""
+        if not self._queue or all(t.deadline is None for t in self._queue):
+            return []
+        expired = [t for t in self._queue
+                   if t.deadline is not None and now >= t.deadline]
+        if not expired:
+            return []
+        dead = set(map(id, expired))
+        self._queue = [t for t in self._queue if id(t) not in dead]
+        for t in expired:
+            t.dropped_reason = "deadline"
+            self._drop_deadline_locked(t, now)
+        return expired
+
+    def _drop_deadline_locked(self, t: Ticket, now: float) -> None:
+        t.dropped_reason = "deadline"
+        self._count_locked("deadline")
+        self.registry.counter_inc(
+            "router_deadline_exceeded_total",
+            help_="requests dropped because their deadline elapsed",
+            namespace=self.namespace, service=self.service)
+        if self._prom:
+            prom_deadline_exceeded_total().labels(self.service).inc()
+        self._decide_locked("deadline", now, band=t.band)
+
+    def _refill_budget_locked(self) -> None:
+        r = self.resilience
+        self._retry_tokens = min(r.retry_budget_cap,
+                                 self._retry_tokens + r.retry_budget_ratio)
+        self._publish_budget_locked()
+
+    def _spend_budget_locked(self, cost: float) -> bool:
+        if self.resilience is None:
+            return True
+        if self._retry_tokens < cost:
+            return False
+        self._retry_tokens -= cost
+        self._publish_budget_locked()
+        return True
+
+    def _publish_budget_locked(self) -> None:
+        self.registry.gauge(
+            "router_retry_budget", round(self._retry_tokens, 6),
+            help_="retry/hedge token bucket level — 0 means the fleet "
+                  "is failing faster than it refills",
+            namespace=self.namespace, service=self.service)
+        if self._prom:
+            prom_retry_budget().labels(self.service).set(self._retry_tokens)
+
+    def _health_locked(self, name: str) -> _Health:
+        h = self._health.get(name)
+        if h is None:
+            h = self._health[name] = _Health()
+        return h
+
+    def _record_success_locked(self, name: str, sample: float,
+                               now: float) -> None:
+        h = self._health_locked(name)
+        a = self.resilience.ewma_alpha
+        h.lat = sample if h.lat is None else a * sample + (1 - a) * h.lat
+        h.fails = 0
+        h.probing = False
+        if h.state != BREAKER_CLOSED:
+            self._set_breaker_locked(name, h, BREAKER_CLOSED, now)
+
+    def _record_failure_locked(self, name: str, now: float) -> None:
+        h = self._health_locked(name)
+        h.fails += 1
+        h.probing = False
+        if h.state == BREAKER_HALF_OPEN or (
+                h.state == BREAKER_CLOSED
+                and h.fails >= self.resilience.breaker_failures):
+            h.opened_at = now
+            self._set_breaker_locked(name, h, BREAKER_OPEN, now)
+
+    def _breaker_rank_locked(self, name: str, now: float) -> int:
+        """0 = closed, 1 = half-open probe slot free, 3 = ineligible
+        (open and cooling off, or probe already dispatched). The
+        open -> half-open transition is time-driven and happens on the
+        first pick after cooloff."""
+        h = self._health.get(name)
+        if h is None or h.state == BREAKER_CLOSED:
+            return 0
+        if h.state == BREAKER_OPEN:
+            if now - h.opened_at < self.resilience.breaker_cooloff_s:
+                return 3
+            self._set_breaker_locked(name, h, BREAKER_HALF_OPEN, now)
+            h.probing = False
+        return 3 if h.probing else 1
+
+    def _set_breaker_locked(self, name: str, h: _Health, state: str,
+                            now: float) -> None:
+        h.state = state
+        self.registry.gauge(
+            "router_breaker_state", _BREAKER_GAUGE[state],
+            help_="per-replica circuit breaker "
+                  "(0=closed 1=half-open 2=open)",
+            namespace=self.namespace, service=self.service, replica=name)
+        if self._prom:
+            prom_breaker_state().labels(self.service, name).set(
+                _BREAKER_GAUGE[state])
+        self._decide_locked("breaker", now, replica=name, state=state)
+
+    def _hedge_count_locked(self, outcome: str) -> None:
+        self.registry.counter_inc(
+            "router_hedges_total",
+            help_="hedged dispatches by outcome (started/won/canceled)",
+            namespace=self.namespace, service=self.service,
+            outcome=outcome)
+        if self._prom:
+            prom_hedges_total().labels(self.service, outcome).inc()
+
+    def _retry_after_locked(self, now: float) -> float:
+        """Queue depth over the recent completion rate, clamped to
+        [1, 120] whole seconds — what a 429/503 Retry-After should
+        say. With no completion history yet, 1s (the optimistic
+        floor beats telling clients to go away for minutes)."""
+        depth = len(self._queue) + 1
+        dq = self._completions
+        if len(dq) >= 2 and dq[-1] > dq[0]:
+            rate = (len(dq) - 1) / (dq[-1] - dq[0])
+            est = depth / rate if rate > 0 else 1.0
+        else:
+            est = 1.0
+        return float(min(max(math.ceil(est), 1), 120))
+
+    def _decide_locked(self, kind: str, now: float, **kv: Any) -> None:
+        if self.on_decision is not None:
+            self.on_decision(dict(kind=kind, t=round(now, 6), **kv))
 
     def _publish_queue_locked(self) -> None:
         self.registry.gauge(
@@ -642,6 +1171,19 @@ class RegistrySignals:
 # -- threaded/HTTP shell ----------------------------------------------------
 
 
+class TransportError(Exception):
+    """A replica answered with an HTTP error. Carries the status and
+    the parsed Retry-After (seconds) so the frontend's retry loop can
+    honor the replica's backpressure as a backoff FLOOR instead of
+    hammering it on a fixed schedule (the PR 5 RestClient discipline)."""
+
+    def __init__(self, status: int, message: str,
+                 retry_after: float | None = None):
+        super().__init__(message)
+        self.status = status
+        self.retry_after = retry_after
+
+
 class HttpTransport:
     """POST a predict body to a replica server (urllib; stdlib-only,
     the RestClient discipline)."""
@@ -652,27 +1194,91 @@ class HttpTransport:
 
     def predict(self, model: str, body: bytes,
                 headers: dict | None = None) -> bytes:
+        import urllib.error
         import urllib.request
 
         req = urllib.request.Request(
             f"{self.base_url}/v1/models/{model}:predict", data=body,
             headers={"Content-Type": "application/json", **(headers or {})},
             method="POST")
-        with urllib.request.urlopen(req, timeout=self.timeout) as resp:
-            return resp.read()
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            ra = None
+            try:
+                raw_ra = e.headers.get("Retry-After") if e.headers else None
+                if raw_ra is not None:
+                    ra = max(float(raw_ra), 0.0)
+            except (TypeError, ValueError):
+                ra = None
+            raise TransportError(
+                e.code, f"replica returned {e.code}: {e.reason}",
+                retry_after=ra) from e
+
+
+def _retry_after_headers(retry_after: float | None) -> dict | None:
+    if retry_after is None:
+        return None
+    return {"Retry-After": str(int(math.ceil(retry_after)))}
 
 
 class RouterFrontend:
     """The blocking HTTP face over the deterministic core: one handler
     thread carries its request end-to-end (submit -> wait for dispatch
     -> call the replica transport -> complete), so the router itself
-    never blocks under its lock."""
+    never blocks under its lock.
+
+    Resilience responsibilities live here too: parse the deadline/band
+    headers, forward the SHRINKING deadline budget replica-ward on
+    every attempt, honor Retry-After as a backoff floor between
+    retries, race a hedge leg when the core says the primary is slow,
+    and map router drop reasons to 504/429/503."""
 
     def __init__(self, router: TokenRouter, max_new_tokens: int = 32,
-                 dispatch_timeout: float = 120.0):
+                 dispatch_timeout: float = 120.0,
+                 default_deadline_s: float | None = None,
+                 default_band: str = BAND_DEFAULT,
+                 sleep: Callable[[float], None] = time.sleep):
         self.router = router
         self.max_new_tokens = max_new_tokens
         self.dispatch_timeout = dispatch_timeout
+        self.default_deadline_s = default_deadline_s
+        self.default_band = default_band
+        self.hedging = True
+        self.retry_backoff_s = 0.05   # doubles per failure
+        self.retry_backoff_cap_s = 5.0
+        self._sleep = sleep
+
+    def apply_spec(self, service_obj: dict) -> None:
+        """Adopt the JAXService spec's resilience defaults (namespace-
+        defaulted band/deadline — the multi-tenancy bridge). The
+        endpoints watch calls this per event, so a spec edit takes
+        effect without a router restart."""
+        from kubeflow_tpu.control.jaxservice.types import resilience_spec
+
+        r = resilience_spec((service_obj or {}).get("spec") or {})
+        self.default_band = r["defaultBand"]
+        self.default_deadline_s = r["deadlineSeconds"] or None
+        self.hedging = bool(r["hedge"])
+
+    @staticmethod
+    def _drop_error(ticket: Ticket):
+        """Map a router-side drop to the client-facing status."""
+        from kubeflow_tpu.utils.httpd import ApiHttpError
+
+        if ticket.dropped_reason == "deadline":
+            return ApiHttpError(504, "deadline exceeded")
+        if ticket.dropped_reason == "shed_band":
+            return ApiHttpError(
+                429, f"shed under overload (band={ticket.band})",
+                headers=_retry_after_headers(ticket.retry_after))
+        if ticket.dropped_reason == "retry_budget":
+            return ApiHttpError(
+                503, "retry budget exhausted",
+                headers=_retry_after_headers(ticket.retry_after))
+        return None
 
     def predict(self, req):
         from kubeflow_tpu.utils.httpd import ApiHttpError
@@ -684,34 +1290,162 @@ class RouterFrontend:
             raise ApiHttpError(400, 'request body must contain "instances"')
         ctx = obs_trace.parse_traceparent(req.header("traceparent"))
         tokens = estimate_tokens(instances, self.max_new_tokens)
+        band = req.header(HEADER_BAND) or self.default_band
+        if band not in BAND_RANK:
+            band = BAND_DEFAULT
+        # the real HTTP shell returns "" for a missing header (httpd
+        # HttpReq.header default) while stubs return None — both mean
+        # "no deadline requested"
+        raw_deadline = req.header(HEADER_DEADLINE)
+        if raw_deadline:
+            try:
+                deadline_s = float(raw_deadline)
+            except ValueError:
+                raise ApiHttpError(
+                    400, f"bad {HEADER_DEADLINE} header: {raw_deadline!r}")
+        else:
+            deadline_s = self.default_deadline_s
+        deadline = (self.router.clock() + deadline_s
+                    if deadline_s is not None and deadline_s > 0 else None)
         try:
-            ticket = self.router.submit(tokens, item=model, context=ctx)
+            ticket = self.router.submit(tokens, item=model, context=ctx,
+                                        band=band, deadline=deadline)
+        except DeadlineExceeded:
+            raise ApiHttpError(504, "deadline exceeded")
         except RouterBusy as e:
-            raise ApiHttpError(429, str(e))
+            raise ApiHttpError(
+                429, str(e),
+                headers=_retry_after_headers(e.retry_after))
         last_err: Exception | None = None
         failures = 0
         while failures < 3:
             if ticket.member is None:
-                if not ticket.done.wait(self.dispatch_timeout):
+                wait_s = self.dispatch_timeout
+                if deadline is not None:
+                    wait_s = min(
+                        wait_s,
+                        max(deadline - self.router.clock(), 0.0) + 0.05)
+                fired = ticket.done.wait(wait_s)
+                err = self._drop_error(ticket)
+                if err is not None:
+                    raise err
+                if not fired:
                     self.router.fail(ticket, requeue=False)
+                    err = self._drop_error(ticket)
+                    if err is not None:  # fail() resolved it as a drop
+                        raise err
+                    if deadline is not None \
+                            and self.router.clock() >= deadline:
+                        raise ApiHttpError(504, "deadline exceeded")
                     raise ApiHttpError(503, "no replica capacity")
             member = ticket.member
             if member is None:  # shed mid-wait; loop waits again
                 continue
+            hdrs: dict[str, str] = {}
+            if req.header("traceparent"):
+                hdrs["traceparent"] = req.header("traceparent")
+            if band != BAND_DEFAULT:
+                hdrs[HEADER_BAND] = band
+            if deadline is not None:
+                remaining = deadline - self.router.clock()
+                if remaining <= 0:
+                    self.router.fail(ticket, requeue=False)
+                    raise ApiHttpError(504, "deadline exceeded")
+                # the budget SHRINKS across retries: each hop sees only
+                # what's left, so a retried request cannot overstay
+                hdrs[HEADER_DEADLINE] = f"{remaining:.3f}"
             try:
-                raw = member.transport.predict(
-                    model, req.body,
-                    headers={"traceparent": req.header("traceparent")}
-                    if req.header("traceparent") else None)
+                delay = (self.router.hedge_delay()
+                         if self.hedging else None)
+                if delay is None:
+                    raw = member.transport.predict(
+                        model, req.body, headers=hdrs or None)
+                    winner = None
+                else:
+                    raw, winner = self._hedged_predict(
+                        ticket, member, model, req.body, hdrs, delay,
+                        deadline)
             except Exception as e:  # replica died mid-request: retry
                 last_err = e
                 failures += 1
                 self.router.fail(ticket, requeue=True)
+                err = self._drop_error(ticket)
+                if err is not None:  # deadline/budget ended the retries
+                    raise err
+                floor = getattr(e, "retry_after", None) or 0.0
+                backoff = max(
+                    self.retry_backoff_s * (2 ** (failures - 1)), floor)
+                if backoff > 0:
+                    self._sleep(min(backoff, self.retry_backoff_cap_s))
                 continue
-            self.router.complete(ticket)
+            self.router.complete(ticket, winner=winner)
             return json.loads(raw)
         self.router.fail(ticket, requeue=False)
         raise ApiHttpError(502, f"replica transport failed: {last_err}")
+
+    def _hedged_predict(self, ticket: Ticket, member: Member, model: str,
+                        body: bytes, hdrs: dict, delay: float,
+                        deadline: float | None):
+        """Race the primary transport against a hedge leg opened after
+        ``delay`` seconds of silence. First SUCCESS wins; the loser is
+        abandoned (its replica-side deadline cancels it and frees its
+        pages — the core already released its token accounting via
+        ``complete(winner=...)``). Raises the primary's error when
+        every started leg failed."""
+        box: dict[str, Any] = {"raw": None, "winner": None, "errors": []}
+        box_lock = threading.Lock()
+        wake = threading.Event()
+        legs: list[Member] = [member]
+
+        def leg(m: Member, leg_hdrs: dict | None) -> None:
+            try:
+                out = m.transport.predict(model, body, headers=leg_hdrs)
+            except Exception as e:
+                with box_lock:
+                    box["errors"].append(e)
+                wake.set()
+                return
+            with box_lock:
+                if box["winner"] is None:
+                    box["winner"] = m.name
+                    box["raw"] = out
+            wake.set()
+
+        threading.Thread(target=leg, args=(member, dict(hdrs) or None),
+                         daemon=True, name="router-hedge-primary").start()
+        if not wake.wait(delay):
+            hedge = self.router.try_hedge(ticket)
+            if hedge is not None:
+                leg_hdrs = dict(hdrs)
+                if deadline is not None:
+                    leg_hdrs[HEADER_DEADLINE] = \
+                        f"{max(deadline - self.router.clock(), 0.0):.3f}"
+                legs.append(hedge)
+                threading.Thread(
+                    target=leg, args=(hedge, leg_hdrs or None),
+                    daemon=True, name="router-hedge-secondary").start()
+        # wait for a winner or for every started leg to fail, bounded
+        # by the deadline (plus grace for the replica-side cancel)
+        t_end = None
+        if deadline is not None:
+            t_end = deadline + 1.0
+        while True:
+            with box_lock:
+                if box["winner"] is not None:
+                    return box["raw"], box["winner"]
+                if len(box["errors"]) >= len(legs):
+                    raise box["errors"][0]
+                wake.clear()
+            budget = self.dispatch_timeout
+            if t_end is not None:
+                budget = min(budget,
+                             max(t_end - self.router.clock(), 0.0))
+            if not wake.wait(budget):
+                with box_lock:
+                    if box["winner"] is not None:
+                        return box["raw"], box["winner"]
+                raise TransportError(
+                    504, "all legs exceeded the request deadline")
 
     def build(self):
         from kubeflow_tpu.utils import httpd
@@ -745,23 +1479,38 @@ def main() -> None:  # pragma: no cover - container entry
                         "(the controller watch takes over in-cluster)")
     p.add_argument("--apiserver", default="",
                    help="watch the JAXService endpoints annotation")
+    p.add_argument("--no-resilience", action="store_true",
+                   help="disable deadlines/hedging/breakers/band "
+                        "shedding (legacy dispatch)")
+    p.add_argument("--default-deadline-s", type=float, default=0.0,
+                   help="deadline for requests without an "
+                        "x-request-deadline-s header (0 = none)")
+    p.add_argument("--default-band", default=BAND_DEFAULT,
+                   choices=BANDS,
+                   help="criticality band for unlabeled requests")
     args = p.parse_args()
     router = TokenRouter(service=args.service, namespace=args.namespace,
-                         max_queue=args.max_queue)
+                         max_queue=args.max_queue,
+                         resilience=(None if args.no_resilience
+                                     else ResilienceConfig()))
     if args.endpoints:
         eps = [{"name": n, "addr": u, "state": STATE_ACTIVE}
                for n, _, u in (e.partition("=")
                                for e in args.endpoints.split(","))]
         router.sync_endpoints(
             eps, transport_factory=lambda ep: HttpTransport(ep["addr"]))
+    frontend = RouterFrontend(
+        router, max_new_tokens=args.max_new_tokens,
+        default_deadline_s=args.default_deadline_s or None,
+        default_band=args.default_band)
     if args.apiserver:
         from kubeflow_tpu.control.jaxservice import watch_endpoints
 
         threading.Thread(
             target=watch_endpoints,
             args=(args.apiserver, args.namespace, args.service, router),
+            kwargs={"frontend": frontend},
             daemon=True, name="router-endpoints-watch").start()
-    frontend = RouterFrontend(router, max_new_tokens=args.max_new_tokens)
     svc = frontend.serve(port=args.port)
     log.info("jaxservice router %s/%s on :%d", args.namespace,
              args.service, svc.port)

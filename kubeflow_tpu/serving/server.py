@@ -17,18 +17,37 @@ small set of compiled programs instead of recompiling per request size
 from __future__ import annotations
 
 import contextlib
+import contextvars
 import logging
+import math
 import threading
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 import numpy as np
 
 from kubeflow_tpu.runtime.metrics import REGISTRY as METRICS_REGISTRY
+from kubeflow_tpu.serving.router import (DeadlineExceeded, HEADER_DEADLINE,
+                                         _retry_after_headers)
 from kubeflow_tpu.utils import httpd
 from kubeflow_tpu.utils.httpd import ApiHttpError, HttpReq, Router
 
 log = logging.getLogger("kubeflow_tpu.serving")
+
+# the request deadline (ABSOLUTE time.monotonic value), set by the HTTP
+# handler from the x-request-deadline-s header and read by predict
+# closures on the SAME thread (the direct / continuous-batching path;
+# the micro-batch worker thread intentionally doesn't see it — there the
+# deadline is enforced at admission, see docs/robustness.md)
+_REQUEST_DEADLINE: contextvars.ContextVar[float | None] = \
+    contextvars.ContextVar("request_deadline", default=None)
+
+
+def request_deadline() -> float | None:
+    """Absolute monotonic deadline of the request being handled on this
+    thread, or None."""
+    return _REQUEST_DEADLINE.get()
 
 def _metric(name, kind, doc, **kw):
     from kubeflow_tpu.runtime.metrics import prom_metric
@@ -83,9 +102,15 @@ class _ReplicaMeter:
     router should not add to."""
 
     def __init__(self, registry=METRICS_REGISTRY):
+        import collections
+
         self.registry = registry
         self._lock = threading.Lock()
         self._inflight: dict[str, int] = {}
+        # completion timestamps (perf_counter) per model: the drain-rate
+        # window behind Retry-After on the overload 429
+        self._done: dict[str, Any] = {}
+        self._deque = collections.deque
 
     def _publish_locked(self, model: str) -> None:
         import prometheus_client as prom
@@ -117,7 +142,30 @@ class _ReplicaMeter:
     def exit(self, model: str) -> None:
         with self._lock:
             self._inflight[model] = max(0, self._inflight.get(model, 0) - 1)
+            if model not in self._done:
+                self._done[model] = self._deque(maxlen=64)
+            self._done[model].append(time.perf_counter())
             self._publish_locked(model)
+
+    def depth(self, model: str) -> int:
+        with self._lock:
+            return self._inflight.get(model, 0)
+
+    def retry_after(self, model: str) -> float:
+        """Seconds until the current queue should have drained, from the
+        observed completion rate (the Retry-After a 429 carries; the
+        router's backoff floor honors it). Conservative default of 1s
+        before any completion history exists."""
+        with self._lock:
+            done = self._done.get(model)
+            depth = self._inflight.get(model, 0)
+            if not done or len(done) < 2:
+                return 1.0
+            span = done[-1] - done[0]
+            if span <= 0:
+                return 1.0
+            rate = (len(done) - 1) / span
+            return float(min(max(math.ceil((depth + 1) / rate), 1.0), 120.0))
 
     def tokens(self, model: str, n: int) -> None:
         if n <= 0:
@@ -168,6 +216,10 @@ class ServedModel:
     # minimum padded batch (power of two): mesh-sharded models need the
     # batch divisible by the product of data-parallel axis sizes
     pad_multiple: int = 1
+    # replica-side overload gate: >0 caps concurrent predict calls; the
+    # excess gets 429 + Retry-After (queue-drain estimate) instead of
+    # stacking unbounded latency the router can't see
+    max_inflight: int = 0
     _batcher: "MicroBatcher | None" = field(default=None, repr=False)
 
     def _predict_now(self, instances: list) -> list:
@@ -192,6 +244,12 @@ class ServedModel:
     def predict(self, instances: list) -> list:
         if not instances:
             raise ApiHttpError(400, "instances must be non-empty")
+        if self.max_inflight > 0 \
+                and REPLICA_METER.depth(self.name) >= self.max_inflight:
+            ra = REPLICA_METER.retry_after(self.name)
+            raise ApiHttpError(
+                429, f"replica overloaded ({self.max_inflight} in flight)",
+                headers=_retry_after_headers(ra))
         REPLICA_METER.enter(self.name, len(instances))
         try:
             if self._batcher is not None:
@@ -252,7 +310,9 @@ class MicroBatcher:
             if self._closed:
                 raise RuntimeError("MicroBatcher is closed")
             self._q.put(p)
-        p.event.wait()
+        # the worker sets the event on every dispatch outcome (result,
+        # error, shutdown drain), so the park cannot leak
+        p.event.wait()  # tpulint: disable=NET501  worker guarantees set
         if p.error is not None:
             raise p.error
         return p.result  # type: ignore[return-value]
@@ -454,16 +514,36 @@ class ModelServer:
         model = self._get(name, version)
         import time as _time
 
+        # deadline propagation, replica hop: the header carries REMAINING
+        # seconds (the router re-derives it per attempt); expose the
+        # absolute monotonic deadline to same-thread predict closures
+        deadline = None
+        raw = req.headers.get(HEADER_DEADLINE)
+        if raw:  # missing OR empty ("" is the shell's missing-header)
+            try:
+                remaining = float(raw)
+            except ValueError:
+                raise ApiHttpError(
+                    400, f"bad {HEADER_DEADLINE} header: {raw!r}")
+            if remaining <= 0:
+                raise ApiHttpError(504, "deadline exceeded")
+            deadline = _time.monotonic() + remaining
+        token = _REQUEST_DEADLINE.set(deadline)
         t0 = _time.perf_counter()
         try:
             preds = model.predict(instances)
         except ApiHttpError:
             predict_errors().labels(name).inc()
             raise
+        except DeadlineExceeded as e:
+            predict_errors().labels(name).inc()
+            raise ApiHttpError(504, f"deadline exceeded: {e}")
         except Exception as e:
             predict_errors().labels(name).inc()
             log.exception("predict failed for %s", name)
             raise ApiHttpError(400, f"prediction failed: {e}")
+        finally:
+            _REQUEST_DEADLINE.reset(token)
         predict_latency().labels(name).observe(_time.perf_counter() - t0)
         return {"predictions": preds}
 
@@ -680,6 +760,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                        draft_model: str | None = None,
                        draft_checkpoint_dir: str | None = None,
                        draft_k: int = 4,
+                       max_inflight: int = 0,
                        **model_kwargs) -> ServedModel:
     """Wrap a zoo LM into a generative ServedModel (the transformer-era
     analogue of the TF-Serving classifier path).
@@ -879,15 +960,21 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
                         draft_model=dm, draft_variables=dv,
                         draft_k=draft_k, metrics_name=name))
             dec = decoder_box[0]
+            # capture the handler thread's deadline HERE: pool.map runs
+            # submit_padded on worker threads that don't inherit the
+            # contextvar (micro-batched callers see None — admission-time
+            # enforcement only, docs/robustness.md)
+            dl = request_deadline()
             if len(rows) == 1:  # hot path: no thread churn per request
                 outs = [dec.submit_padded(rows[0], pad_lens[0],
-                                          maxnews[0])]
+                                          maxnews[0], dl)]
             else:
                 import concurrent.futures as cf
 
                 with cf.ThreadPoolExecutor(max_workers=len(rows)) as pool:
                     outs = list(pool.map(dec.submit_padded, rows,
-                                         pad_lens, maxnews))
+                                         pad_lens, maxnews,
+                                         [dl] * len(rows)))
             # per-request budgets produce ragged rows; pad the response
             # rows only when a caller actually mixed budgets
             if len({len(o) for o in outs}) > 1:
@@ -933,6 +1020,7 @@ def serve_lm_generator(name: str, model_name: str, *, prompt_len: int = 128,
         pad_batches=not (continuous_batching or draft_model),
         batch_window_ms=batch_window_ms, max_batch=max_batch,
         pad_multiple=sm.pad_multiple if sm else 1,
+        max_inflight=max_inflight,
         signature={"inputs": "tokens", "method_name": "generate",
                    "prompt_len": prompt_len,
                    "max_new_tokens": max_new_tokens,
@@ -1004,6 +1092,10 @@ def main() -> None:  # pragma: no cover - container entry
                    help="orbax checkpoint for the draft model — a "
                         "randomly initialized draft accepts ~nothing "
                         "and makes speculative serving SLOWER")
+    p.add_argument("--max-inflight", type=int, default=0,
+                   help="replica overload gate: cap concurrent predict "
+                        "calls per LM; excess gets 429 + Retry-After "
+                        "(0 = uncapped)")
     p.add_argument("--continuous-batching", action="store_true",
                    help="slot-based lockstep decode: requests join at any "
                         "step boundary and finish independently")
@@ -1054,6 +1146,7 @@ def main() -> None:  # pragma: no cover - container entry
             kv_pages=args.kv_pages, kv_page_size=args.kv_page_size,
             prefix_cache=not args.no_prefix_cache,
             param_dtype=args.param_dtype,
+            max_inflight=args.max_inflight,
             checkpoint_dir=ckpt or None,
             draft_model=args.draft_model, draft_k=args.draft_k,
             draft_checkpoint_dir=args.draft_checkpoint_dir,

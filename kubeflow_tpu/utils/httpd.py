@@ -95,7 +95,8 @@ class Router:
                 try:
                     return to_resp(fn(req))
                 except ApiHttpError as e:
-                    return json_resp({"error": e.message}, e.status)
+                    return json_resp({"error": e.message}, e.status,
+                                     headers=e.headers)
                 except Exception as e:  # 500 with structured body
                     log.exception("%s: %s %s failed", self.name, req.method, req.path)
                     return json_resp({"error": str(e)}, 500)
@@ -103,14 +104,19 @@ class Router:
 
 
 class ApiHttpError(Exception):
-    def __init__(self, status: int, message: str):
+    def __init__(self, status: int, message: str,
+                 headers: dict[str, str] | None = None):
         super().__init__(message)
         self.status = status
         self.message = message
+        # extra response headers (e.g. Retry-After on a 429/503)
+        self.headers = headers or {}
 
 
-def json_resp(obj: Any, status: int = 200) -> HttpResp:
-    return HttpResp(status=status, body=json.dumps(obj).encode())
+def json_resp(obj: Any, status: int = 200,
+              headers: dict[str, str] | None = None) -> HttpResp:
+    return HttpResp(status=status, body=json.dumps(obj).encode(),
+                    headers=headers or {})
 
 
 def to_resp(out: Any) -> HttpResp:

@@ -468,6 +468,76 @@ class TestValidation:
         assert cluster.list("v1", "Pod", namespace="default") == []
 
 
+class TestResilienceSpec:
+    """spec.resilience — the namespace-level request-resilience knobs
+    (ISSUE 14): parsing defaults, validation, the controller threading
+    maxInflight into the replica command, and the frontend adopting
+    band/deadline/hedge through the endpoints watch."""
+
+    def test_defaults_when_absent(self):
+        assert T.resilience_spec({}) == {
+            "defaultBand": "default", "deadlineSeconds": 0.0,
+            "hedge": True, "maxInflight": 0}
+        # non-dict resilience degrades to the defaults, never raises
+        assert T.resilience_spec({"resilience": "nope"})["hedge"] is True
+
+    def test_explicit_values_parse(self):
+        r = T.resilience_spec({"resilience": {
+            "defaultBand": "sheddable", "deadlineSeconds": 2.5,
+            "hedge": False, "maxInflight": 8}})
+        assert r == {"defaultBand": "sheddable", "deadlineSeconds": 2.5,
+                     "hedge": False, "maxInflight": 8}
+
+    def test_validation_rejects_bad_knobs(self):
+        svc = T.new_jaxservice("s", model="gpt-125m")
+        svc["spec"]["resilience"] = {"defaultBand": "platinum"}
+        assert any("defaultBand" in e for e in T.validate(svc))
+        svc["spec"]["resilience"] = {"deadlineSeconds": -1}
+        assert any("deadlineSeconds" in e for e in T.validate(svc))
+        svc["spec"]["resilience"] = {"maxInflight": -2}
+        assert any("maxInflight" in e for e in T.validate(svc))
+        svc["spec"]["resilience"] = {"maxInflight": True}
+        assert any("maxInflight" in e for e in T.validate(svc))
+        svc["spec"]["resilience"] = {
+            "defaultBand": "critical", "deadlineSeconds": 30,
+            "maxInflight": 4}
+        assert T.validate(svc) == []
+
+    def test_max_inflight_threaded_into_replica_command(self, world):
+        cluster, ctl, kubelet = world
+        svc = T.new_jaxservice("chat", model="gpt-125m")
+        svc["spec"]["resilience"] = {"maxInflight": 7}
+        cluster.create(svc)
+        drain(ctl, kubelet)
+        pod = cluster.get("v1", "Pod", rep(0), "default")
+        cmd = pod["spec"]["containers"][0]["command"]
+        assert cmd[cmd.index("--max-inflight") + 1] == "7"
+
+    def test_zero_max_inflight_omits_the_flag(self, world):
+        cluster, ctl, kubelet = world
+        make_service(cluster)
+        drain(ctl, kubelet)
+        pod = cluster.get("v1", "Pod", rep(0), "default")
+        assert "--max-inflight" not in pod["spec"]["containers"][0]["command"]
+
+    def test_frontend_adopts_spec_per_event(self):
+        from kubeflow_tpu.serving.router import RouterFrontend
+
+        fe = RouterFrontend(_router())
+        fe.apply_spec({"spec": {"resilience": {
+            "defaultBand": "critical", "deadlineSeconds": 3.0,
+            "hedge": False}}})
+        assert fe.default_band == "critical"
+        assert fe.default_deadline_s == 3.0
+        assert fe.hedging is False
+        # a spec edit that drops the block reverts to the defaults —
+        # the watch applies EVERY event, not just the first
+        fe.apply_spec({"spec": {}})
+        assert fe.default_band == "default"
+        assert fe.default_deadline_s is None
+        assert fe.hedging is True
+
+
 # -- controller: provisioning + endpoints ------------------------------------
 
 

@@ -141,6 +141,106 @@ def test_two_real_replicas_behind_router_under_concurrent_load(lm):
             srv.close()
 
 
+class _ToggleSlowTransport:
+    """HttpTransport wrapper whose delay can be armed after warmup — a
+    live brownout: the replica still answers, just late."""
+
+    def __init__(self, inner, name, served):
+        self.inner = inner
+        self.name = name
+        self.served = served
+        self.delay_s = 0.0
+
+    def predict(self, model, body, headers=None):
+        import time
+
+        if self.delay_s > 0:
+            time.sleep(self.delay_s)
+        out = self.inner.predict(model, body, headers)
+        self.served[self.name] = self.served.get(self.name, 0) + 1
+        return out
+
+
+def test_hedge_rescues_request_from_slow_replica_live(lm):
+    """The ISSUE 14 hedge layer over REAL HTTP: after warmup builds the
+    latency quantile, replica-a browns out (3s delay per request). The
+    next request dispatches to it, the frontend's hedge leg races
+    replica-b, and the caller gets an exact answer WITHOUT waiting out
+    the brownout."""
+    import time
+
+    from kubeflow_tpu.obs import trace as obs_trace
+    from kubeflow_tpu.runtime.metrics import MetricsRegistry
+    from kubeflow_tpu.serving.router import (
+        STATE_ACTIVE, HttpTransport, ResilienceConfig, RouterFrontend,
+        TokenRouter)
+
+    model, variables = lm
+    srv_a, svc_a = _boot_replica("hedge-a")
+    srv_b, svc_b = _boot_replica("hedge-b")
+    served: dict = {}
+    transports: dict = {}
+    router = TokenRouter(
+        service="hedge", namespace="default", max_queue=64,
+        registry=MetricsRegistry(), prom_sink=False,
+        tracer=obs_trace.Tracer(),
+        resilience=ResilienceConfig(hedge_min_samples=4,
+                                    hedge_quantile=0.5,
+                                    hedge_min_s=0.05))
+    try:
+        def factory(ep):
+            tr = _ToggleSlowTransport(HttpTransport(ep["addr"]),
+                                      ep["name"], served)
+            transports[ep["name"]] = tr
+            return tr
+
+        router.sync_endpoints(
+            [{"name": "replica-a",
+              "addr": f"http://127.0.0.1:{svc_a.port}",
+              "state": STATE_ACTIVE},
+             {"name": "replica-b",
+              "addr": f"http://127.0.0.1:{svc_b.port}",
+              "state": STATE_ACTIVE}], transport_factory=factory)
+        frontend = RouterFrontend(router, max_new_tokens=4)
+        prompt = [3, 1, 4]
+        want = reference_generate(model, variables, prompt)
+
+        class _Req:
+            body = json.dumps(
+                {"instances": [{"tokens": prompt}]}).encode()
+            params = {"model": "lm"}
+
+            @staticmethod
+            def json():
+                return json.loads(_Req.body)
+
+            @staticmethod
+            def header(name, default=None):
+                return default
+
+        for _ in range(6):  # warmup: samples for the hedge quantile
+            assert frontend.predict(_Req)["predictions"][0] == want
+        assert router.hedge_delay() is not None
+        transports["replica-a"].delay_s = 3.0    # brownout replica-a
+        served.clear()
+        t0 = time.perf_counter()
+        out = frontend.predict(_Req)
+        elapsed = time.perf_counter() - t0
+        assert out["predictions"][0] == want      # exact despite the race
+        # the hedge leg (replica-b) answered; the caller never waited
+        # out the full brownout
+        assert served.get("replica-b", 0) >= 1, served
+        assert elapsed < 3.0, elapsed
+        reg = router.registry.render()
+        assert 'outcome="won"' in reg             # router_hedges_total
+        assert router.inflight_tokens() == 0      # both legs released
+    finally:
+        router.close()
+        for srv, svc in ((srv_a, svc_a), (srv_b, svc_b)):
+            svc.shutdown()
+            srv.close()
+
+
 def test_router_returns_429_when_saturated_by_real_replicas(lm):
     """Zero-capacity admission against live replicas: max_queue=0 and a
     tiny budget turn the 17th concurrent request into an HTTP 429, not

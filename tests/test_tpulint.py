@@ -267,6 +267,27 @@ def slice_block(num):
     os.environ["MEGASCALE_NUM_SLICES"] = str(num)
 """, 5),
     ],
+    "NET501": [
+        # urlopen with no timeout: the stdlib default is the process
+        # socket timeout, i.e. "forever" — a brownout wedges the thread
+        ("""\
+from urllib.request import urlopen
+
+
+def fetch(url):
+    with urlopen(url) as r:
+        return r.read()
+""", 5),
+        # bare event park on the request path
+        ("""\
+import threading
+
+
+def rendezvous(ev: threading.Event):
+    ev.wait()
+    return True
+""", 5),
+    ],
 }
 
 CLEAN = {
@@ -670,6 +691,28 @@ def status(n):
     os.environ["JAXJOB_NUM_SLICES"] = str(n)
     note = "megascale transport handles cross-slice reduce"
     return jax.distributed.is_initialized(), note
+""",
+    ],
+    "NET501": [
+        # explicit timeouts, kwarg and third-positional spellings; a
+        # bounded event wait is the sanctioned park
+        """\
+import threading
+from urllib.request import urlopen
+
+
+def fetch(url, ev: threading.Event):
+    with urlopen(url, None, 5.0) as r:
+        body = r.read()
+    with urlopen(url, timeout=2.5) as r:
+        body += r.read()
+    ev.wait(timeout=0.05)
+    return body
+""",
+        # wait() on a non-event object with arguments is not a park
+        """\
+def gather(pool, futures):
+    return [f.wait(10.0) for f in futures]
 """,
     ],
 }

@@ -95,6 +95,11 @@ EOF
         echo "== $bench --check"
         JAX_PLATFORMS=cpu "$PY" "tools/$bench.py" --check || rc=1
     done
+    # the resilience ratchet (ISSUE 14): band-goodput, hedge win rate,
+    # breaker round trip and the decision fingerprint vs BENCH_SERVE_r03
+    echo "== serve_bench --resilience --check"
+    JAX_PLATFORMS=cpu "$PY" tools/serve_bench.py --resilience --check \
+        || rc=1
     exit $rc
     ;;
 *)

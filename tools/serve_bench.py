@@ -44,6 +44,20 @@ Two families share this tool:
    as BENCH_SERVE_r02.json; ``--check`` gates BOTH banks.
 
      python tools/serve_bench.py --decode          # run + bank r02
+
+4. **Request-level resilience** (``--resilience``, ISSUE 14): the same
+   deterministic virtual-time harness pointed at the resilience layer —
+   three stub replicas, a 1-of-3 BROWNOUT (10x slower, not dead) with an
+   overload wave inside it, then a flapping replica. Two arms share one
+   seeded trace of banded requests with a 4s deadline: ``resilient``
+   (deadlines + hedging + breakers + band shedding on) vs ``control``
+   (resilience=None — the legacy router; goodput still judged against
+   the same deadline). Banked as BENCH_SERVE_r03.json; ``--check``
+   gates critical-band goodput during the brownout, hedge rescues, the
+   breaker round-trip, the decision fingerprint, and the zero-KV-leak
+   cancel drill.
+
+     python tools/serve_bench.py --resilience      # run + bank r03
 """
 
 from __future__ import annotations
@@ -64,6 +78,8 @@ ROUTER_OUT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_SERVE_r01.json")
 DECODE_OUT = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "BENCH_SERVE_r02.json")
+RESILIENCE_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_SERVE_r03.json")
 
 
 def run_mode(mode: str, args) -> dict:
@@ -745,6 +761,451 @@ def router_main(args) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# The deterministic resilience benchmark (--resilience / --check,
+# ISSUE 14): router core only — no controller, membership is static.
+# Three stub replicas modeled as fixed-rate FIFO servers on the manual
+# clock; the drills are a brownout (slow, not dead), an overload wave
+# inside it, and a fail-fast flap. Every router decision (sheds,
+# deadline drops, hedges, breaker transitions) is tapped via
+# on_decision and fingerprinted, so the whole run replays byte-identical
+# per seed.
+
+
+# (start_s, end_s, arrivals_per_s) — warmup builds the latency samples
+# hedging needs, then the brownout window [6, 30) holds an overload
+# wave [8, 28), then the flap window [30, 36) and a cooldown tail.
+RES_PHASES = ((0.0, 6.0, 8.0), (6.0, 8.0, 10.0), (8.0, 28.0, 40.0),
+              (28.0, 30.0, 10.0), (30.0, 36.0, 8.0), (36.0, 44.0, 6.0))
+RES_CONFIG = {
+    "seed": 0,
+    "tokens_lo": 32, "tokens_hi": 96,
+    "replica_tokens_per_sec": 600.0,
+    "replica_token_budget": 256,
+    "max_queue": 24,
+    "replicas": 3,
+    "deadline_s": 4.0,
+    # band mix: P(critical), P(critical)+P(default) thresholds on one
+    # uniform draw per arrival
+    "band_split": (0.2, 0.8),
+    "brownout": (6.0, 30.0),          # r0 serves at rate/brownout_x here
+    "brownout_x": 10.0,
+    "brownout_replica": "r0",
+    "flap": (30.0, 36.0),             # r1 fails fast here (breaker drill)
+    "flap_replica": "r1",
+    "fail_latency_s": 0.02,           # a fast error, not a timeout
+}
+
+
+def build_res_trace(cfg: dict, rng: random.Random) -> list[tuple]:
+    """Seeded open-loop trace of (time, tokens, band) arrivals."""
+    from kubeflow_tpu.serving.router import (
+        BAND_CRITICAL, BAND_DEFAULT, BAND_SHEDDABLE,
+    )
+
+    p_crit, p_def = cfg["band_split"]
+    out = []
+    for start, end, rate in RES_PHASES:
+        t = start
+        while True:
+            t += rng.expovariate(rate)
+            if t >= end:
+                break
+            tokens = rng.randrange(cfg["tokens_lo"], cfg["tokens_hi"])
+            u = rng.random()
+            band = (BAND_CRITICAL if u < p_crit
+                    else BAND_DEFAULT if u < p_def else BAND_SHEDDABLE)
+            out.append((t, tokens, band))
+    return out
+
+
+def run_resilience_arm(arm: str, cfg: dict,
+                       trace: list[tuple]) -> dict:
+    """One virtual-time run over the shared trace. ``resilient`` turns
+    the full layer on (deadlines reach the router, hedge checks fire,
+    in-flight work is canceled at its deadline — modeling the replica-
+    side slot cancel); ``control`` is the legacy router, with goodput
+    still judged against the same per-request deadline."""
+    import hashlib
+
+    from kubeflow_tpu.serving.router import (
+        BAND_RANK, Member, ResilienceConfig, RouterBusy, TokenRouter,
+    )
+
+    resilient = arm == "resilient"
+    clock = ManualClock()
+    decisions: list[dict] = []
+    router = TokenRouter(
+        service="bench", namespace="default", clock=clock,
+        prom_sink=False, max_queue=cfg["max_queue"],
+        replica_token_budget=cfg["replica_token_budget"],
+        resilience=ResilienceConfig() if resilient else None,
+        on_decision=decisions.append if resilient else None)
+    names = [f"r{i}" for i in range(cfg["replicas"])]
+    router.set_members([Member(name=n) for n in names])
+
+    bo_start, bo_end = cfg["brownout"]
+    fl_start, fl_end = cfg["flap"]
+
+    def rate_of(name: str, at: float) -> float:
+        r = cfg["replica_tokens_per_sec"]
+        if name == cfg["brownout_replica"] and bo_start <= at < bo_end:
+            return r / cfg["brownout_x"]
+        return r
+
+    free_at: dict[str, float] = {}
+    seq: dict[int, int] = {}
+    finished: set[int] = set()
+    events: list[tuple] = []
+    order = [0]
+    # id(t) keys (arrivals/seq/finished) are only stable while the
+    # ticket object is alive — hold every admitted ticket so CPython
+    # never reuses an id mid-run (a recycled id would alias a new
+    # ticket onto a finished one and silently drop its events)
+    hold: list = []
+    arrivals: dict[int, tuple] = {}   # ticket id -> (t_arr, band, tokens)
+    done_at: dict[int, float] = {}
+    per_band = {b: {"arrivals": 0, "rejected": 0} for b in BAND_RANK}
+    hedge_wins = 0
+    deadline_cancels = 0
+
+    def push(due: float, kind: str, payload) -> None:
+        order[0] += 1
+        heapq.heappush(events, (due, order[0], kind, payload))
+
+    def svc_time(name: str, tokens: int, at: float) -> float:
+        return tokens / rate_of(name, at)
+
+    def on_dispatch(t) -> None:
+        """Model the dispatched leg: a flapping replica errors fast;
+        everyone else serves FIFO at its current rate. The resilient arm
+        also arms the deadline cancel and the hedge check."""
+        name = t.member.name
+        now = clock.t
+        seq[id(t)] = seq.get(id(t), 0) + 1
+        gen = seq[id(t)]
+        if name == cfg["flap_replica"] and fl_start <= now < fl_end:
+            push(now + cfg["fail_latency_s"], "fail", (t, name, gen))
+            return
+        svc = svc_time(name, t.tokens, now)
+        due = max(now, free_at.get(name, 0.0)) + svc
+        free_at[name] = due
+        if resilient and t.deadline is not None and due > t.deadline:
+            # the replica cancels the slot AT the deadline (frees its
+            # pages); the leg never produces a completion
+            push(t.deadline, "cancel", (t, name, gen, svc))
+            delay = router.hedge_delay()
+            if delay is not None and now + delay < t.deadline:
+                push(now + delay, "hedge", (t, name, gen))
+            return
+        push(due, "complete", (t, name, gen, svc))
+        if resilient:
+            delay = router.hedge_delay()
+            if delay is not None and now + delay < due \
+                    and (t.deadline is None or now + delay < t.deadline):
+                push(now + delay, "hedge", (t, name, gen))
+
+    def refund(name: str, svc: float) -> None:
+        """A canceled leg frees its replica early (the slot-cancel /
+        hedge-loser path): pull the FIFO horizon back by its share."""
+        if name in free_at:
+            free_at[name] = max(clock.t, free_at[name] - svc)
+
+    for t_arr, tokens, band in trace:
+        push(t_arr, "arrive", (tokens, band))
+
+    while events:
+        due, _, kind, payload = heapq.heappop(events)
+        clock.advance_to(due)
+        if kind == "arrive":
+            tokens, band = payload
+            per_band[band]["arrivals"] += 1
+            try:
+                if resilient:
+                    t = router.submit(
+                        tokens, band=band,
+                        deadline=clock.t + cfg["deadline_s"])
+                else:
+                    t = router.submit(tokens)
+            except RouterBusy:
+                per_band[band]["rejected"] += 1
+                continue
+            hold.append(t)
+            arrivals[id(t)] = (clock.t, band, tokens)
+            if t.member is not None:
+                on_dispatch(t)
+        elif kind == "complete":
+            t, name, gen, svc = payload
+            if id(t) in finished or seq.get(id(t)) != gen \
+                    or t.member is None or t.member.name != name:
+                continue
+            finished.add(id(t))
+            done_at[id(t)] = clock.t
+            if t.hedge_member is not None:
+                refund(t.hedge_member.name, svc_time(
+                    t.hedge_member.name, t.tokens, t._hedge_at))
+            for nt in router.complete(t):
+                on_dispatch(nt)
+        elif kind == "hcomplete":
+            t, hname, svc = payload
+            if id(t) in finished or t.hedge_member is None \
+                    or t.hedge_member.name != hname:
+                continue
+            finished.add(id(t))
+            done_at[id(t)] = clock.t
+            hedge_wins += 1
+            if t.member is not None:
+                refund(t.member.name, svc_time(
+                    t.member.name, t.tokens, t._dispatched_at))
+            for nt in router.complete(t, winner=hname):
+                on_dispatch(nt)
+        elif kind == "hedge":
+            t, name, gen = payload
+            if id(t) in finished or seq.get(id(t)) != gen \
+                    or t.member is None or t.member.name != name:
+                continue
+            m = router.try_hedge(t)
+            if m is None:
+                continue
+            svc = svc_time(m.name, t.tokens, clock.t)
+            hdue = max(clock.t, free_at.get(m.name, 0.0)) + svc
+            free_at[m.name] = hdue
+            if t.deadline is None or hdue <= t.deadline:
+                push(hdue, "hcomplete", (t, m.name, svc))
+            else:
+                push(t.deadline, "hcancel", (t, m.name, svc))
+        elif kind == "hcancel":
+            t, hname, svc = payload
+            if id(t) in finished or t.hedge_member is None \
+                    or t.hedge_member.name != hname:
+                continue
+            refund(hname, svc)
+        elif kind == "cancel":
+            t, name, gen, svc = payload
+            if id(t) in finished or seq.get(id(t)) != gen \
+                    or t.member is None or t.member.name != name:
+                continue
+            finished.add(id(t))
+            deadline_cancels += 1
+            refund(name, svc)
+            if t.hedge_member is not None:
+                refund(t.hedge_member.name, svc_time(
+                    t.hedge_member.name, t.tokens, t._hedge_at))
+            # fail() sees the elapsed deadline and drops with
+            # dropped_reason="deadline" (the shell's 504)
+            for nt in router.fail(t, requeue=True):
+                on_dispatch(nt)
+        elif kind == "fail":
+            t, name, gen = payload
+            if id(t) in finished or seq.get(id(t)) != gen \
+                    or t.member is None or t.member.name != name:
+                continue
+            for nt in router.fail(t, requeue=True):
+                on_dispatch(nt)
+            if t.member is None and t.dropped_reason is not None:
+                finished.add(id(t))
+
+    # goodput per band over the brownout-window arrivals: completed
+    # within the deadline / arrived, resilience on or off
+    goodput = {}
+    for band in BAND_RANK:
+        window = [tid for tid, (ta, b, _tok) in arrivals.items()
+                  if b == band and bo_start <= ta < bo_end]
+        hits = sum(1 for tid in window
+                   if tid in done_at
+                   and done_at[tid] - arrivals[tid][0] <= cfg["deadline_s"])
+        total = sum(1 for t_arr, _tok, b in trace
+                    if b == band and bo_start <= t_arr < bo_end)
+        goodput[band] = round(hits / total, 4) if total else 1.0
+    fingerprint = hashlib.sha256(json.dumps(
+        decisions, sort_keys=True).encode()).hexdigest()
+    breaker_kinds = [d for d in decisions if d["kind"] == "breaker"]
+    completed = len(done_at)
+    return {
+        "arm": arm,
+        "requests": len(trace),
+        "completed": completed,
+        "rejected": {b: per_band[b]["rejected"] for b in per_band},
+        "arrivals": {b: per_band[b]["arrivals"] for b in per_band},
+        "brownout_goodput": goodput,
+        "hedge_wins": hedge_wins,
+        "deadline_cancels": deadline_cancels,
+        "sheds": {b: sum(1 for d in decisions
+                         if d["kind"] == "shed" and d.get("band") == b)
+                  for b in BAND_RANK},
+        "deadline_drops": sum(
+            1 for d in decisions if d["kind"] == "deadline"),
+        "breaker_opened": any(d.get("state") == "open"
+                              for d in breaker_kinds),
+        "breaker_reclosed": any(d.get("state") == "closed"
+                                for d in breaker_kinds),
+        "decisions": len(decisions),
+        "decision_fingerprint": fingerprint,
+        "virtual_makespan_s": round(clock.t, 2),
+    }
+
+
+def run_kv_cancel_drill(seed: int) -> dict:
+    """Host-only proof of the zero-leak contract: drive a PageAllocator
+    through admit / append / mid-flight frees (the deadline-cancel and
+    hedge-loser paths) and assert the refcount invariant plus a fully
+    recovered freelist. No jax involved — this is the allocator the
+    slot decoder's ``_cancel_slot`` calls ``free()`` on."""
+    from kubeflow_tpu.runtime.kvcache import PageAllocator
+
+    rng = random.Random(seed)
+    page, slots = 8, 8
+    # prefix_cache off: the LRU prefix index legitimately retains
+    # prompt pages across frees, which is reuse — not the leak this
+    # drill exists to catch on the cancel path
+    alloc = PageAllocator(num_pages=64, page_size=page, slots=slots,
+                          max_pages_per_slot=12, prefix_cache=False)
+    live: dict[int, tuple[int, int]] = {}   # slot -> (position, total)
+    frees = admits = 0
+    for step in range(400):
+        op = rng.random()
+        free_slots = [s for s in range(slots) if s not in live]
+        if op < 0.5 and free_slots:
+            row = [rng.randrange(1, 50) for _ in range(32)]
+            total = 32 + rng.randrange(8, 33)
+            if alloc.can_admit(row, 0, total):
+                s = free_slots[0]
+                alloc.admit(s, row, 0, total)
+                live[s] = (32, total)
+                admits += 1
+        elif op < 0.8 and live:
+            s = sorted(live)[rng.randrange(len(live))]
+            pos, total = live[s]
+            pos = min(pos + rng.randrange(1, 9), total)
+            live[s] = (pos, total)
+            alloc.append(s, pos)
+        elif live:
+            # the cancel path: a deadline or a lost hedge frees the
+            # slot MID-GENERATION, pages and all
+            s = sorted(live)[rng.randrange(len(live))]
+            alloc.free(s)
+            live.pop(s)
+            frees += 1
+        alloc.check()
+    for s in list(live):
+        alloc.free(s)
+    alloc.check()
+    clean = alloc.free_pages == alloc.num_pages - 1  # page 0 is trash
+    return {"admits": admits, "mid_flight_frees": frees,
+            "pages_recovered": clean, "invariant_clean": True}
+
+
+def run_resilience_bench(cfg: dict) -> dict:
+    rng = random.Random(cfg["seed"])
+    trace = build_res_trace(cfg, rng)
+    resilient = run_resilience_arm("resilient", cfg, trace)
+    control = run_resilience_arm("control", cfg, trace)
+    replay = run_resilience_arm("resilient", cfg, trace)
+    return {
+        "config": dict(cfg),
+        "resilient": resilient,
+        "control": control,
+        "kv_drill": run_kv_cancel_drill(cfg["seed"]),
+        "comparison": {
+            "critical_goodput_resilient":
+                resilient["brownout_goodput"]["critical"],
+            "critical_goodput_control":
+                control["brownout_goodput"]["critical"],
+            "hedge_wins": resilient["hedge_wins"],
+            "critical_sheds": resilient["sheds"].get("critical", 0)
+            if resilient["sheds"] else 0,
+            "breaker_round_trip": resilient["breaker_opened"]
+            and resilient["breaker_reclosed"],
+            "replay_identical":
+                resilient["decision_fingerprint"]
+                == replay["decision_fingerprint"]
+                and resilient["completed"] == replay["completed"],
+        },
+    }
+
+
+def check_resilience_bench(banked_path: str) -> int:
+    """CI ratchet over BENCH_SERVE_r03: rerun the banked config; fail
+    when the resilience layer stops earning its keep — critical-band
+    goodput through the brownout below 90% (or the control arm NOT
+    degrading, which means the drill lost its teeth), zero hedge
+    rescues, a critical-band shed, a broken breaker round-trip, a
+    decision-fingerprint change, or a KV page leak in the cancel
+    drill."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    section = banked.get("resilience")
+    if not section:
+        print(f"check: no resilience section in {banked_path}",
+              file=sys.stderr)
+        return 2
+    now = run_resilience_bench(dict(section["config"]))
+    ok = True
+    cmp_ = now["comparison"]
+    if cmp_["critical_goodput_resilient"] < 0.9:
+        print(f"check: resilience regression — critical goodput "
+              f"{cmp_['critical_goodput_resilient']} < 0.9 through the "
+              "brownout", file=sys.stderr)
+        ok = False
+    if cmp_["critical_goodput_control"] >= 0.7:
+        print(f"check: drill regression — the control arm no longer "
+              f"degrades ({cmp_['critical_goodput_control']} >= 0.7); "
+              "the brownout drill lost its teeth", file=sys.stderr)
+        ok = False
+    if cmp_["hedge_wins"] < 1:
+        print("check: resilience regression — zero hedge rescues",
+              file=sys.stderr)
+        ok = False
+    if cmp_["critical_sheds"] != 0:
+        print(f"check: resilience regression — "
+              f"{cmp_['critical_sheds']} critical-band requests shed",
+              file=sys.stderr)
+        ok = False
+    if not cmp_["breaker_round_trip"]:
+        print("check: resilience regression — breaker never completed "
+              "open -> half-open -> closed", file=sys.stderr)
+        ok = False
+    if not cmp_["replay_identical"]:
+        print("check: determinism regression — same-seed replay "
+              "diverged", file=sys.stderr)
+        ok = False
+    if now["resilient"]["decision_fingerprint"] \
+            != section["resilient"]["decision_fingerprint"]:
+        print("check: decision fingerprint diverged from the banked "
+              "run", file=sys.stderr)
+        ok = False
+    drill = now["kv_drill"]
+    if not (drill["pages_recovered"] and drill["invariant_clean"]
+            and drill["mid_flight_frees"] > 0):
+        print("check: KV cancel drill regression — pages leaked or no "
+              "mid-flight frees exercised", file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "comparison": cmp_}, indent=2))
+    return 0 if ok else 1
+
+
+def resilience_main(args) -> int:
+    if args.check:
+        return check_resilience_bench(args.resilience_out)
+    cfg = dict(RES_CONFIG)
+    cfg["seed"] = args.seed
+    result = {"bench": "serve_bench", "round": "r03",
+              "resilience": run_resilience_bench(cfg)}
+    with open(args.resilience_out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({"out": args.resilience_out,
+                      "comparison": result["resilience"]["comparison"],
+                      "resilient_goodput":
+                          result["resilience"]["resilient"]
+                          ["brownout_goodput"],
+                      "control_goodput":
+                          result["resilience"]["control"]
+                          ["brownout_goodput"]}, indent=2))
+    return 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser("serve_bench")
     p.add_argument("--model", default="gpt-350m")
@@ -783,6 +1244,11 @@ def main() -> int:
                         "benchmark (dense-vs-paged KV cache, prefix "
                         "reuse, speculative lockstep) and bank "
                         "BENCH_SERVE_r02")
+    p.add_argument("--resilience", action="store_true",
+                   help="run the deterministic request-resilience "
+                        "benchmark (brownout + overload + flap drills, "
+                        "deadline/hedge/breaker/band-shed layer vs the "
+                        "legacy router) and bank BENCH_SERVE_r03")
     p.add_argument("--check", action="store_true",
                    help="CI gate: rerun every banked config and fail on "
                         "drops/divergence/counter regression (with "
@@ -790,22 +1256,29 @@ def main() -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--out", default=ROUTER_OUT)
     p.add_argument("--decode-out", default=DECODE_OUT)
+    p.add_argument("--resilience-out", default=RESILIENCE_OUT)
     args = p.parse_args()
     if args.check:
         if args.decode:
             return check_decode_bench(args.decode_out)
         if args.router:
             return check_router_bench(args.out)
+        if args.resilience:
+            return check_resilience_bench(args.resilience_out)
         rc = 0
         if os.path.exists(args.out):
             rc = max(rc, check_router_bench(args.out))
         if os.path.exists(args.decode_out):
             rc = max(rc, check_decode_bench(args.decode_out))
+        if os.path.exists(args.resilience_out):
+            rc = max(rc, check_resilience_bench(args.resilience_out))
         return rc
     if args.decode:
         return decode_main(args)
     if args.router:
         return router_main(args)
+    if args.resilience:
+        return resilience_main(args)
     if args.mesh:
         args.mesh = {k: int(v) for k, v in
                      (kv.split("=", 1) for kv in args.mesh.split(","))}
