@@ -52,6 +52,10 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--hygiene", action="store_true",
                         help="also run the stdlib hygiene gates "
                              "(parse/debugger/conflict markers, yaml)")
+    parser.add_argument("--jobs", type=int, default=1, metavar="N",
+                        help="shard the scan across N worker processes "
+                             "(fork pool; output is byte-identical to "
+                             "the serial run)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print registered rules and exit")
     args = parser.parse_args(argv)
@@ -81,15 +85,18 @@ def main(argv: list[str] | None = None) -> int:
         # selection would silently scan nothing and exit 0
         args.hygiene = True
 
-    findings = core.scan_paths(args.paths, select=select, ignore=ignore)
+    if args.jobs < 0:
+        print(f"--jobs must be >= 0, got {args.jobs}", file=sys.stderr)
+        return 2
+    findings = core.scan_paths(args.paths, select=select, ignore=ignore,
+                               jobs=args.jobs)
     if args.hygiene:
         hyg = hygiene.run_hygiene(args.paths)
         if select:
             hyg = [f for f in hyg if f.rule in select]
         if ignore:
             hyg = [f for f in hyg if f.rule not in ignore]
-        findings = sorted(findings + hyg,
-                          key=lambda f: (f.path, f.line, f.col, f.rule))
+        findings = sorted(findings + hyg, key=core._sort_key)
 
     if args.write_baseline:
         pathlib.Path(args.write_baseline).write_text(

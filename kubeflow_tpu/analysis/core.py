@@ -166,8 +166,8 @@ def _load_builtin_rules() -> None:
     # import for the @register side effect; lazy so core stays importable
     # from rule modules without a cycle
     from kubeflow_tpu.analysis import (  # noqa: F401
-        rules_collectives, rules_jax, rules_lockset, rules_net, rules_obs,
-        rules_order, rules_sharding,
+        rules_collectives, rules_determinism, rules_jax, rules_lockset,
+        rules_net, rules_obs, rules_order, rules_reconcile, rules_sharding,
     )
 
 
@@ -189,7 +189,10 @@ STALE_RULE = "HYG004"  # stale suppression (emitted by full scans)
 
 
 def _sort_key(f: Finding):
-    return (f.path, f.line, f.col, f.rule)
+    # message included: same-position same-rule findings must tie-break
+    # deterministically, or a parallel scan's merge order could leak
+    # into the output (the serial==parallel byte-identity law)
+    return (f.path, f.line, f.col, f.rule, f.message)
 
 
 def _run_rules(modules: dict[str, Module],
@@ -322,11 +325,14 @@ def scan_sources(sources: dict[str, str],
 
 
 def scan_paths(paths: Iterable[str], select: set[str] | None = None,
-               ignore: set[str] | None = None) -> list[Finding]:
+               ignore: set[str] | None = None,
+               jobs: int | None = None) -> list[Finding]:
     """Scan files/directories as ONE program: per-file rules run per
     module, program rules (LOCK201/203/204, TPU105/106) run once over
     the cross-module call graph. select/ignore filter the output (and,
-    when possible, skip running excluded rules)."""
+    when possible, skip running excluded rules). ``jobs > 1`` shards
+    the rule work across a fork pool (analysis/parallel.py) with
+    byte-identical output to the serial path."""
     rules = all_rules()
     active = rules
     if select:
@@ -360,7 +366,14 @@ def scan_paths(paths: Iterable[str], select: set[str] | None = None,
         if name in modules:  # stem collision outside a package
             name = str(f)
         modules[name] = m
-    raw = _run_rules(modules, run_rules)
+    if jobs and jobs > 1 and len(modules) > 1:
+        from kubeflow_tpu.analysis import parallel
+        if parallel.available():
+            raw = parallel.run(modules, run_rules, jobs)
+        else:  # no fork (e.g. Windows): serial, same output
+            raw = _run_rules(modules, run_rules)
+    else:
+        raw = _run_rules(modules, run_rules)
     findings.extend(_finalize(modules, raw, stale=stale))
     # select/ignore also apply to TPU000 parse findings, which are
     # emitted outside the rules list

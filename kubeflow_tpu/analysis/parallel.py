@@ -1,0 +1,106 @@
+"""Parallel scan engine for tpulint (``--jobs N``).
+
+``tools/lint_all.sh`` now runs three full passes per CI cycle and the
+rule set keeps growing; the scan is embarrassingly parallel once the
+program model exists, so this module shards it across a fork pool:
+
+- **File rules** run one task per module (cheap tasks, imap_unordered,
+  so a giant module cannot strand the pool behind it).
+- **Program rules** run one task each over the shared ``Program``.
+- The parent overlaps the Program build (plus the memoized fixpoints
+  every lock rule shares) with the file-rule pool, then forks a
+  *second* pool for program rules: children forked before the build
+  cannot see it, and fork inheritance is the whole point — the parsed
+  module table and the program transfer copy-on-write, nothing is
+  pickled in, and only Finding lists are pickled out.
+
+Output law (pinned by tests/test_tpulint.py): a ``--jobs N`` scan is
+byte-identical to the serial one. Raw findings merge in completion
+order; determinism comes from ``_finalize`` being order-independent
+(suppression and the stale audit are set-membership checks) plus the
+total sort on (path, line, col, rule, message).
+
+Requires ``fork`` (Linux/macOS): callers fall back to the serial path
+when it is unavailable or when there is nothing to parallelize.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable
+
+# Fork-inherited worker state: populated in the parent immediately
+# before each pool is created. Not shared memory — each child gets a
+# copy-on-write snapshot at fork time, which is exactly the lifetime
+# the scan needs (the table is immutable once parsed).
+_STATE: dict = {}
+
+
+def available() -> bool:
+    return hasattr(os, "fork")
+
+
+def _file_task(args) -> list:
+    key, rule_ids = args
+    from kubeflow_tpu.analysis.core import REGISTRY
+
+    module = _STATE["modules"][key]
+    out: list = []
+    for rid in rule_ids:
+        out.extend(REGISTRY[rid].check(module))
+    return out
+
+
+def _prog_task(rule_id: str) -> list:
+    from kubeflow_tpu.analysis.core import REGISTRY
+
+    return list(REGISTRY[rule_id].check_program(_STATE["program"]))
+
+
+def run(modules: dict, rules: Iterable, jobs: int) -> list:
+    """Raw (pre-suppression) findings — the parallel twin of
+    ``core._run_rules``; callers apply ``_finalize`` + sort as usual."""
+    import multiprocessing
+
+    from kubeflow_tpu.analysis.core import ProgramRule
+
+    file_rules = [r for r in rules if not isinstance(r, ProgramRule)]
+    prog_rules = [r for r in rules if isinstance(r, ProgramRule)]
+    ctx = multiprocessing.get_context("fork")
+    raw: list = []
+    pool1 = pool2 = None
+    try:
+        _STATE["modules"] = modules
+        fut1 = None
+        if file_rules:
+            ids = [r.id for r in file_rules]
+            pool1 = ctx.Pool(jobs)
+            fut1 = pool1.imap_unordered(
+                _file_task, [(k, ids) for k in modules], chunksize=4)
+        fut2 = None
+        if prog_rules and modules:
+            # built AFTER pool1 forks: the build runs in the parent
+            # concurrently with the file-rule children
+            from kubeflow_tpu.analysis.callgraph import Program
+
+            program = Program(modules)
+            program.locked_entry()
+            program.may_held()
+            program.writes()
+            _STATE["program"] = program
+            pool2 = ctx.Pool(min(jobs, len(prog_rules)))
+            fut2 = pool2.imap_unordered(
+                _prog_task, [r.id for r in prog_rules])
+        if fut1 is not None:
+            for chunk in fut1:
+                raw.extend(chunk)
+        if fut2 is not None:
+            for chunk in fut2:
+                raw.extend(chunk)
+    finally:
+        for pool in (pool1, pool2):
+            if pool is not None:
+                pool.close()
+                pool.join()
+        _STATE.clear()
+    return raw

@@ -1,0 +1,356 @@
+"""tpulint virtual-time determinism rules (DET6xx) for replay-critical
+modules.
+
+The ROADMAP's macro-bench composes every virtual-time bench into one
+simulated world whose value rests on byte-identical decision-fingerprint
+replay. That property dies silently: one ambient ``time.time()`` or
+unseeded ``random.Random()`` in a decision path and two runs of the
+same scenario diverge, with nothing failing until someone diffs
+fingerprints. The DET6xx family makes "this module is replayable" a
+static property of the modules the benches actually replay:
+
+- **DET601** wall-clock reads (``time.time/monotonic/perf_counter``,
+  ``datetime.now``) in a replay-critical module. The injectable idiom —
+  ``def __init__(self, clock=time.monotonic)`` then ``self.clock()`` —
+  is naturally clean because the rule fires on *calls*, not references.
+  The analysis is call-graph propagated: a call into a helper that
+  *returns* a wall-clock value (``ob.now_iso()``, or any program
+  function whose return expression reaches a wall read and that has no
+  clock-ish injection parameter) fires at the call site in the
+  replay-critical module, where a fix or an audited suppression
+  belongs.
+- **DET602** unseeded / default-constructed RNGs (``random.Random()``
+  with no seed, ``random.SystemRandom``) and ambient module-level
+  ``random.*`` / ``numpy.random.*`` calls, which draw from process
+  state no replay controls.
+- **DET603** raw ``time.sleep`` not routed through an injectable
+  sleeper (``self._sleep = time.sleep`` + ``self._sleep(...)`` is
+  clean; a literal ``time.sleep(...)`` call is not replayable).
+- **DET604** fingerprint-poisoning identity sources: ``uuid.uuid4``,
+  ``os.urandom``, ``secrets.*``, and ``id()``-keyed ordering
+  (``sorted(xs, key=id)``) — values that differ across processes and
+  therefore across replays.
+
+Scope is the module list the bench harnesses replay under virtual
+clocks (see docs/scale.md "Determinism contract"); everything else in
+the tree may read wall clocks freely. Suppressions carry the usual
+audited justification and are held non-stale by HYG004.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, ProgramRule, call_name, register,
+)
+
+# The modules the virtual-time benches replay: decisions made here must
+# be a pure function of injected inputs (clock, rng, sleeper, events).
+_SCOPES = (
+    "control/scheduler/",
+    "control/cache",
+    "serving/router",
+    "serving/continuous",
+    "obs/",
+    "control/jaxservice",
+    "control/jaxjob",
+)
+
+# Direct wall-clock sources, canonicalized through the import table.
+_WALL_CALLS = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# Helpers known (by name) to return a wall-clock-derived value even
+# when the defining module is outside the scanned program — keeps a
+# per-file scan and a whole-tree scan agreeing on the same finding at
+# the same call site, so suppressions stay HYG004-coherent.
+_WALL_HELPERS = {"now_iso"}
+
+# Parameters that mark a function as an injection seam: its callers can
+# substitute a virtual clock, so its internal wall read is the seam's
+# default, not an ambient read at the call site.
+_CLOCKISH_PARAM = re.compile(
+    r"^(clock|now|perf|timer|time_fn|time_source|sleep|sleeper)$"
+    r"|_(clock|now|perf|sleep)$")
+
+# Ambient module-level RNG draws (process-global state).
+_RANDOM_AMBIENT = {
+    "random", "uniform", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "expovariate",
+    "betavariate", "triangular", "getrandbits", "randbytes", "seed",
+}
+_NP_AMBIENT = {
+    "random", "rand", "randn", "randint", "uniform", "choice",
+    "shuffle", "permutation", "normal", "seed",
+}
+
+# Identity sources whose values differ per-process (DET604).
+_IDENTITY_CALLS = {"uuid.uuid4", "uuid.uuid1", "os.urandom"}
+
+_FIXPOINT_CAP = 32
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(s in p for s in _SCOPES)
+
+
+def _canon(name: str, imports: dict[str, tuple]) -> str:
+    """Canonicalize a dotted call through the module's import aliases:
+    ``_time.sleep`` -> ``time.sleep``, ``from datetime import datetime``
+    + ``datetime.now`` -> ``datetime.datetime.now``. An unimported head
+    passes through unchanged, so corpus fragments work verbatim."""
+    parts = name.split(".")
+    got = imports.get(parts[0])
+    if got is not None:
+        if got[0] == "mod":
+            parts = got[1].split(".") + parts[1:]
+        else:  # ("sym", base_module, symbol)
+            parts = got[1].split(".") + [got[2]] + parts[1:]
+    return ".".join(parts)
+
+
+def _scope_modules(program) -> list[tuple[str, Module, dict]]:
+    out = []
+    for modname, module in program.modules.items():
+        if _in_scope(module.path):
+            out.append((modname, module,
+                        program.imports.get(modname, {})))
+    return out
+
+
+def _clockish_seam(fn: ast.FunctionDef) -> bool:
+    args = fn.args
+    params = args.posonlyargs + args.args + args.kwonlyargs
+    return any(_CLOCKISH_PARAM.search(a.arg) for a in params)
+
+
+def _wall_returning(program) -> set[str]:
+    """Function quals whose *return value* reaches a wall-clock read —
+    the call-graph propagation behind DET601. A function with a
+    clock-ish parameter is an injection seam and never taints callers.
+    Bounded union fixpoint (like ``Program.may_held``)."""
+    tainted: set[str] = set()
+    returns: dict[str, list[ast.Call]] = {}
+    for qual, fi in program.functions.items():
+        if _clockish_seam(fi.node):
+            continue
+        imports = program.imports.get(fi.modname, {})
+        calls: list[ast.Call] = []
+        direct = False
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            for sub in ast.walk(node.value):
+                if not isinstance(sub, ast.Call):
+                    continue
+                name = call_name(sub)
+                if name is None:
+                    continue
+                if _canon(name, imports) in _WALL_CALLS:
+                    direct = True
+                else:
+                    calls.append(sub)
+        if direct:
+            tainted.add(qual)
+        elif calls:
+            returns[qual] = calls
+    for _ in range(_FIXPOINT_CAP):
+        changed = False
+        for qual, calls in returns.items():
+            if qual in tainted:
+                continue
+            fi = program.functions[qual]
+            for sub in calls:
+                if program._resolve_call(sub, fi) in tainted:
+                    tainted.add(qual)
+                    changed = True
+                    break
+        if not changed:
+            break
+    return tainted
+
+
+@register
+class WallClockInReplayPath(ProgramRule):
+    """DET601: an ambient wall-clock read in a replay-critical module.
+    Two bench runs of the same scenario read different values here, so
+    the decision fingerprint diverges with no test failing."""
+
+    id = "DET601"
+    name = "wall-clock-in-replay-path"
+    short = "ambient wall-clock read in a replay-critical module"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        mods = _scope_modules(program)
+        if not mods:
+            return
+        tainted = _wall_returning(program) if len(program.modules) > 1 else set()
+        for modname, module, imports in mods:
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                canon = _canon(name, imports)
+                if canon in _WALL_CALLS:
+                    yield self.finding(
+                        module, node,
+                        f"{canon}() read in a replay-critical module: "
+                        "inject a clock (param or attribute defaulting "
+                        "to the real one) so the bench can substitute "
+                        "virtual time")
+                elif name.rsplit(".", 1)[-1] in _WALL_HELPERS:
+                    yield self.finding(
+                        module, node,
+                        f"{name}() returns a wall-clock value in a "
+                        "replay-critical module: thread an injectable "
+                        "now=/clock= instead (or suppress as a metadata "
+                        "timestamp that never enters a decision)")
+                else:
+                    # resolve through the caller-agnostic symbol table:
+                    # module-level and function-level call sites alike
+                    callee = program.resolve_symbol(modname, name)
+                    if callee is not None and callee in tainted:
+                        yield self.finding(
+                            module, node,
+                            f"{name}() reaches a wall-clock read "
+                            "(call-graph): give the helper a clock-ish "
+                            "injection parameter or inject at this "
+                            "call site")
+
+
+@register
+class UnseededRngInReplayPath(ProgramRule):
+    """DET602: RNG state no replay controls — unseeded constructors and
+    ambient module-level draws from the process-global generator."""
+
+    id = "DET602"
+    name = "unseeded-rng-in-replay-path"
+    short = "unseeded / ambient RNG in a replay-critical module"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        for modname, module, imports in _scope_modules(program):
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                canon = _canon(name, imports)
+                if canon == "random.Random" and not node.args \
+                        and not node.keywords:
+                    yield self.finding(
+                        module, node,
+                        "unseeded random.Random() in a replay-critical "
+                        "module: default-construct with a seed "
+                        "(random.Random(0)) and let callers inject")
+                elif canon == "random.SystemRandom":
+                    yield self.finding(
+                        module, node,
+                        "random.SystemRandom draws from the OS entropy "
+                        "pool — unreplayable by construction; use a "
+                        "seeded Random injected by the caller")
+                elif canon == "numpy.random.default_rng" \
+                        and not node.args and not node.keywords:
+                    yield self.finding(
+                        module, node,
+                        "unseeded numpy default_rng() in a "
+                        "replay-critical module: pass an explicit seed")
+                elif "." in canon:
+                    head, leaf = canon.rsplit(".", 1)
+                    if head == "random" and leaf in _RANDOM_AMBIENT:
+                        yield self.finding(
+                            module, node,
+                            f"ambient random.{leaf}() uses the "
+                            "process-global RNG: draw from an injected "
+                            "seeded random.Random instead")
+                    elif head == "numpy.random" and leaf in _NP_AMBIENT:
+                        yield self.finding(
+                            module, node,
+                            f"ambient numpy.random.{leaf}() uses global "
+                            "RNG state: use a seeded Generator")
+
+
+@register
+class RawSleepInReplayPath(ProgramRule):
+    """DET603: a literal ``time.sleep`` pins the module to real time.
+    The virtual-time benches advance a simulated clock; a raw sleep
+    both slows the bench wall-clock and decouples the module from the
+    simulated timeline."""
+
+    id = "DET603"
+    name = "raw-sleep-in-replay-path"
+    short = "raw time.sleep in a replay-critical module"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        for modname, module, imports in _scope_modules(program):
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                if _canon(name, imports) == "time.sleep":
+                    yield self.finding(
+                        module, node,
+                        "raw time.sleep() in a replay-critical module: "
+                        "route through an injectable sleeper "
+                        "(self._sleep = time.sleep; self._sleep(...)) "
+                        "so benches can substitute virtual time")
+
+
+@register
+class FingerprintPoisonInReplayPath(ProgramRule):
+    """DET604: identity sources whose values differ per process. A
+    uuid4 or os.urandom value that leaks into a decision fingerprint —
+    or ``id()``-keyed ordering that leaks allocation addresses into
+    iteration order — makes byte-identical replay impossible."""
+
+    id = "DET604"
+    name = "fingerprint-poison-in-replay-path"
+    short = "per-process identity source in a replay-critical module"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        for modname, module, imports in _scope_modules(program):
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                canon = _canon(name, imports)
+                if canon in _IDENTITY_CALLS or canon.startswith("secrets."):
+                    yield self.finding(
+                        module, node,
+                        f"{canon}() is a per-process identity source in "
+                        "a replay-critical module: derive ids from "
+                        "injected seeds, or suppress with the audit "
+                        "that the value never enters a decision "
+                        "fingerprint")
+                elif self._id_keyed(node):
+                    yield self.finding(
+                        module, node,
+                        "id()-keyed ordering leaks allocation addresses "
+                        "into iteration order — unreplayable across "
+                        "processes; key on a stable field instead")
+
+    @staticmethod
+    def _id_keyed(node: ast.Call) -> bool:
+        orderer = (isinstance(node.func, ast.Name)
+                   and node.func.id in ("sorted", "min", "max")) or (
+                   isinstance(node.func, ast.Attribute)
+                   and node.func.attr == "sort")
+        if not orderer:
+            return False
+        return any(kw.arg == "key" and isinstance(kw.value, ast.Name)
+                   and kw.value.id == "id" for kw in node.keywords)

@@ -1,0 +1,323 @@
+"""tpulint reconcile write-discipline rules (CTL5xx) for the control
+plane.
+
+The platform's hardest-won controller lessons existed only as prose in
+CHANGES.md: record-FIRST durable writes (PR 5's gang restart bumps the
+counter and writes the Restarting condition *before* deleting pods, so
+a crash mid-restart resumes instead of double-restarting), status
+no-op guards (the PR 5 status storm: an unconditional ``update_status``
+per reconcile pass melts the apiserver), read-your-own-writes cache
+folding (PRs 7-8: every write response folds back via
+``note_write``/``note_delete`` or the next pass reads stale state), and
+rv-preconditioned annotation mints (two controller replicas racing a
+traceparent mint must conflict, not last-write-win). CTL5xx turns each
+into a checkable property:
+
+- **CTL501** record-first ordering: a destructive client call
+  (``delete``/``evict``) that precedes the function's durable record
+  write (``update_status``). Call-graph aware: a call into a helper
+  that transitively deletes counts as a delete at the call site; a
+  helper that both records and deletes (a self-contained transaction
+  like ``_gang_restart``) is skipped. Only the wrong order fires — a
+  function whose record write already precedes its deletes, or that
+  never records (its caller does), stays clean.
+- **CTL502** status-storm guard: an ``update_status`` with no
+  conditional guard on any path from function entry. ``changed =
+  cond_set(...); if changed: update_status(...)`` and the
+  double-checked early-return idiom are clean; a private helper that
+  writes unconditionally is clean when every resolved call site is
+  itself guarded (one call-graph hop, like LOCK201's entry context).
+- **CTL503** discarded write response in a ClusterCache-wired
+  controller: a bare-statement ``client.create/patch/replace(...)``
+  throws away the response instead of folding it
+  (``self._note(client.patch(...))``, assignment, or ``return``), so
+  the controller's next pass reads its own write stale.
+- **CTL504** traceparent mints without a ``resourceVersion``
+  precondition: an annotation patch carrying a traceparent key must
+  include the observed rv so concurrent minters conflict (409) instead
+  of silently overwriting each other's trace roots.
+
+Scope is ``control/`` — the reconcile planes these disciplines were
+paid for in.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import (
+    Finding, Module, ProgramRule, Rule, call_name, register,
+)
+
+_SCOPES = ("control/",)
+
+_DESTRUCTIVE = {"delete", "evict", "delete_collection"}
+_RECORD = {"update_status", "replace_status"}
+_WRITES = {"create", "patch", "replace"}
+_NOTE_ATTRS = ("note_write", "note_delete")
+
+_FIXPOINT_CAP = 32
+
+
+def _in_scope(path: str) -> bool:
+    p = path.replace("\\", "/")
+    return any(s in p for s in _SCOPES)
+
+
+def _own_walk(fn: ast.AST) -> Iterator[ast.AST]:
+    """Walk a function body without descending into nested defs — a
+    closure's body runs at call time, not at this point in the
+    reconcile, so its calls must not count toward CFG order."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _attr_of(node: ast.Call) -> str | None:
+    return node.func.attr if isinstance(node.func, ast.Attribute) else None
+
+
+def _direct_kind_closure(program, attrs: set[str]) -> set[str]:
+    """Function quals that (transitively) make a call whose attribute
+    is in ``attrs`` — the may-delete / may-record union fixpoint."""
+    out: set[str] = set()
+    for qual, fi in program.functions.items():
+        for node in _own_walk(fi.node):
+            if isinstance(node, ast.Call) and _attr_of(node) in attrs:
+                out.add(qual)
+                break
+    for _ in range(_FIXPOINT_CAP):
+        changed = False
+        for site in program.calls:
+            if site.callee in out and site.caller.qual not in out:
+                out.add(site.caller.qual)
+                changed = True
+        if not changed:
+            break
+    return out
+
+
+@register
+class RecordFirstOrdering(ProgramRule):
+    """CTL501: destructive call ordered before the durable record
+    write. A crash between the delete and the (later) record write
+    loses the fact that the action happened — record first, so the
+    next pass resumes instead of repeating the destruction."""
+
+    id = "CTL501"
+    name = "record-first-ordering"
+    short = "delete/evict before the reconcile's durable record write"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        scoped = [fi for fi in program.functions.values()
+                  if _in_scope(fi.module.path)]
+        if not scoped:
+            return
+        may_del = _direct_kind_closure(program, _DESTRUCTIVE)
+        may_rec = _direct_kind_closure(program, _RECORD)
+        for fi in scoped:
+            events: list[tuple[tuple[int, int], str, ast.Call, str]] = []
+            for node in _own_walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                attr = _attr_of(node)
+                kinds = set()
+                label = attr or (call_name(node) or "call")
+                if attr in _DESTRUCTIVE:
+                    kinds.add("del")
+                elif attr in _RECORD:
+                    kinds.add("rec")
+                else:
+                    callee = program._resolve_call(node, fi)
+                    if callee is not None:
+                        if callee in may_del:
+                            kinds.add("del")
+                        if callee in may_rec:
+                            kinds.add("rec")
+                if len(kinds) != 1:
+                    # both: a self-contained record+delete transaction
+                    # (e.g. _gang_restart); neither: not interesting
+                    continue
+                events.append(((node.lineno, node.col_offset),
+                               kinds.pop(), node, label))
+            recs = [pos for pos, kind, _, _ in events if kind == "rec"]
+            if not recs:
+                continue  # the record write lives in a caller: no order
+            first_rec = min(recs)
+            for pos, kind, node, label in events:
+                if kind == "del" and pos < first_rec:
+                    yield self.finding(
+                        fi.module, node,
+                        f"destructive {label}() before this function's "
+                        "durable record write (record-first): write the "
+                        "status/record update ahead of the delete so a "
+                        "crash in between resumes instead of repeating "
+                        "the destruction")
+
+
+@register
+class StatusStormGuard(ProgramRule):
+    """CTL502: unconditional status write on the reconcile path. Every
+    pass that writes an unchanged status is an apiserver write, a
+    resourceVersion bump, and a watch event fanned out to every
+    informer — the PR 5 status storm."""
+
+    id = "CTL502"
+    name = "status-storm-guard"
+    short = "status write without a prev-value comparison guard"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        sites = getattr(program, "_sites_by_callee", {})
+        for fi in program.functions.values():
+            if not _in_scope(fi.module.path):
+                continue
+            for node in _own_walk(fi.node):
+                if not (isinstance(node, ast.Call)
+                        and _attr_of(node) in _RECORD):
+                    continue
+                if isinstance(fi.module.parents.get(node), ast.Return):
+                    continue  # delegation: the caller owns the guard
+                if self._guarded(fi, node):
+                    continue
+                callers = sites.get(fi.qual, [])
+                if fi.is_private and callers and all(
+                        self._guarded(s.caller, s.call) for s in callers):
+                    continue  # every way in is guarded (one hop)
+                yield self.finding(
+                    fi.module, node,
+                    "status write with no comparison guard on the path "
+                    "from function entry: compute changed = "
+                    "cond_set(...) (or compare prev/next) and write "
+                    "only when it changed — unconditional writes per "
+                    "pass are a status storm")
+
+    @staticmethod
+    def _guarded(fi, node: ast.AST) -> bool:
+        # (a) conditional ancestor inside this function
+        for anc in fi.module.ancestors(node):
+            if isinstance(anc, (ast.If, ast.IfExp, ast.While,
+                                ast.ExceptHandler, ast.Assert)):
+                return True
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        # (b) the double-checked idiom: an earlier early-exit branch
+        # (``if prev == next: return``) guards everything after it
+        for n in _own_walk(fi.node):
+            if (isinstance(n, ast.If)
+                    and n.lineno < getattr(node, "lineno", 0)
+                    and any(isinstance(x, (ast.Return, ast.Raise,
+                                           ast.Continue))
+                            for b in n.body for x in ast.walk(b))):
+                return True
+        return False
+
+
+@register
+class DiscardedWriteResponse(Rule):
+    """CTL503: a cache-wired controller throwing away a write response.
+    The apiserver's reply carries the new resourceVersion; dropping it
+    instead of folding via note_write means the next reconcile pass
+    reads the controller's own write stale (PRs 7-8)."""
+
+    id = "CTL503"
+    name = "discarded-write-response"
+    short = "write response not folded into the ClusterCache"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not _in_scope(module.path):
+            return
+        for cls in module.tree.body:
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            if not self._cache_wired(cls):
+                continue
+            for node in ast.walk(cls):
+                if not isinstance(node, ast.Expr):
+                    continue
+                call = node.value
+                if not (isinstance(call, ast.Call)
+                        and _attr_of(call) in _WRITES):
+                    continue
+                recv = call_name(call) or ""
+                if "client" not in recv.lower():
+                    continue
+                yield self.finding(
+                    module, call,
+                    f"{recv}() response discarded in a cache-wired "
+                    "controller: fold it (self._note(client.patch(...))"
+                    " / note_write) or the next pass reads this write "
+                    "stale")
+
+    @staticmethod
+    def _cache_wired(cls: ast.ClassDef) -> bool:
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Call):
+                attr = _attr_of(node)
+                if attr and (any(n in attr for n in _NOTE_ATTRS)
+                             or attr in ("_note", "_note_gone")):
+                    return True
+        return False
+
+
+@register
+class TraceparentMintPrecondition(Rule):
+    """CTL504: a traceparent annotation mint without an rv
+    precondition. Two controller replicas racing the mint must get a
+    409 conflict (one wins, one re-reads), not a silent last-write-wins
+    that splits the object's trace across two roots."""
+
+    id = "CTL504"
+    name = "traceparent-mint-precondition"
+    short = "traceparent annotation patch without resourceVersion"
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        if not _in_scope(module.path):
+            return
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Call)
+                    and _attr_of(node) in ("patch", "replace")):
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if not isinstance(arg, ast.Dict):
+                    continue
+                if (self._mints_traceparent(arg)
+                        and not self._has_rv(arg)):
+                    yield self.finding(
+                        module, node,
+                        "traceparent annotation mint without a "
+                        "resourceVersion precondition: include the "
+                        "observed metadata.resourceVersion so "
+                        "concurrent minters conflict instead of "
+                        "overwriting each other's trace roots")
+
+    @classmethod
+    def _mints_traceparent(cls, d: ast.Dict) -> bool:
+        for key, value in zip(d.keys, d.values):
+            if cls._is_traceparent_key(key):
+                return True
+            if isinstance(value, ast.Dict) and cls._mints_traceparent(value):
+                return True
+        return False
+
+    @staticmethod
+    def _is_traceparent_key(key: ast.expr | None) -> bool:
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            return "traceparent" in key.value.lower()
+        if key is not None:
+            name = ast.unparse(key) if hasattr(ast, "unparse") else ""
+            return "traceparent" in name.lower()
+        return False
+
+    @staticmethod
+    def _has_rv(d: ast.Dict) -> bool:
+        for sub in ast.walk(d):
+            if (isinstance(sub, ast.Constant)
+                    and sub.value == "resourceVersion"):
+                return True
+        return False

@@ -46,6 +46,7 @@ class AuthServer:
         login_url: str = "/kflogin",
         cookie_key: bytes | None = None,
         ttl_s: int = DEFAULT_TTL_S,
+        clock=time.time,
     ):
         self.username = username or os.environ.get("GATEKEEPER_USERNAME", "admin")
         self.passhash = passhash or os.environ.get("GATEKEEPER_PASSHASH", "")
@@ -53,11 +54,15 @@ class AuthServer:
         self.login_url = login_url
         self.cookie_key = cookie_key or secrets.token_bytes(32)
         self.ttl_s = ttl_s
+        # one clock for every expiry decision: mint and verify read the
+        # same injectable source, so tests (and replays) drive token
+        # lifecycles without monkey-patching time.time
+        self.clock = clock
 
     # -- cookie minting/verification (:143-199) -----------------------------
 
     def mint_cookie(self, user: str, now: float | None = None) -> str:
-        exp = int((time.time() if now is None else now) + self.ttl_s)
+        exp = int((self.clock() if now is None else now) + self.ttl_s)
         payload = f"{user}|{exp}"
         sig = hmac.new(self.cookie_key, payload.encode(), hashlib.sha256).hexdigest()
         return base64.urlsafe_b64encode(f"{payload}|{sig}".encode()).decode()
@@ -70,7 +75,7 @@ class AuthServer:
                             hashlib.sha256).hexdigest()
             if not hmac.compare_digest(sig, want):
                 return None
-            if int(exp) < (time.time() if now is None else now):
+            if int(exp) < (self.clock() if now is None else now):
                 return None
             return user
         except Exception:

@@ -675,7 +675,7 @@ class JAXJobReconciler(Reconciler):
             ob.cond_set(job, T.COND_RUNNING, "False", "JobCompleted", "")
             ob.cond_set(job, T.COND_SUCCEEDED, "True", "AllWorkersSucceeded",
                         f"{n_succeeded}/{replicas} workers succeeded")
-            job["status"]["completionTime"] = ob.now_iso()
+            job["status"]["completionTime"] = ob.now_iso()  # tpulint: disable=DET601  status timestamp is apiserver metadata, excluded from decision fingerprints
             client.update_status(job)
             if was_running:
                 jobs_running().dec()
@@ -747,7 +747,7 @@ class JAXJobReconciler(Reconciler):
             if not ob.cond_is_true(job, T.COND_RUNNING):
                 ob.cond_set(job, T.COND_RUNNING, "True", "AllWorkersRunning",
                             f"{replicas}/{replicas} workers running")
-                job["status"].setdefault("startTime", ob.now_iso())
+                job["status"].setdefault("startTime", ob.now_iso())  # tpulint: disable=DET601  status timestamp is apiserver metadata, excluded from decision fingerprints
                 client.update_status(job)
                 jobs_running().inc()
                 if self.record_events:
@@ -993,7 +993,7 @@ class JAXJobReconciler(Reconciler):
                 ob.cond_set(job, T.COND_RUNNING, "True", "AllWorkersRunning",
                             f"{world.size}/{replicas} workers running "
                             f"(elastic)")
-                job["status"].setdefault("startTime", ob.now_iso())
+                job["status"].setdefault("startTime", ob.now_iso())  # tpulint: disable=DET601  status timestamp is apiserver metadata, excluded from decision fingerprints
                 client.update_status(job)
                 jobs_running().inc()
                 if self.record_events:

@@ -18,6 +18,7 @@ from __future__ import annotations
 def watch_endpoints(apiserver: str, namespace: str, name: str,
                     router,
                     frontend=None,
+                    sleep=None,
                     ) -> None:  # pragma: no cover - container glue
     """Router-side membership feed: watch ONE JAXService and apply its
     endpoints annotation to the router on every event (plus an initial
@@ -34,6 +35,9 @@ def watch_endpoints(apiserver: str, namespace: str, name: str,
     from kubeflow_tpu.serving.router import HttpTransport
 
     log = logging.getLogger("kubeflow_tpu.jaxservice")
+    # injectable resubscribe backoff (DET603): a reference, not a call,
+    # so the real sleep stays the default outside tests
+    sleep = sleep if sleep is not None else _time.sleep
     client = RestClient(base_url=apiserver or None)
     factory = lambda ep: HttpTransport(ep["addr"])  # noqa: E731
 
@@ -54,4 +58,4 @@ def watch_endpoints(apiserver: str, namespace: str, name: str,
                     apply(ev.object)
         except Exception:
             log.exception("endpoints watch failed; resubscribing")
-        _time.sleep(0.5)
+        sleep(0.5)

@@ -280,7 +280,7 @@ class ChaosClient:
         conds.append({"type": "Ready",
                       "status": "True" if ready else "False"})
         status["conditions"] = conds
-        self.inner.update_status(node)
+        self.inner.update_status(node)  # tpulint: disable=CTL502  chaos drill, not a reconcile: fail/heal_node mutate on purpose every invocation
         log.info("chaos: node %s -> Ready=%s", name, ready)
 
     def delete_node(self, name: str) -> None:

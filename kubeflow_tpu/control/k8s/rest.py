@@ -106,6 +106,7 @@ class RestClient:
         max_retries: int = 4,
         retry_base: float = 0.1,
         retry_cap: float = 2.0,
+        rng: random.Random | None = None,
     ):
         import requests
 
@@ -130,8 +131,10 @@ class RestClient:
         self.max_retries = max_retries
         self.retry_base = retry_base
         self.retry_cap = retry_cap
+        # seeded by default (DET discipline): the jitter schedule is
+        # replayable unless a caller injects entropy on purpose
         self._sleep = time.sleep
-        self._rng = random.Random()
+        self._rng = rng if rng is not None else random.Random(0)
         self._s = requests.Session()
         if token:
             self._s.headers["Authorization"] = f"Bearer {token}"

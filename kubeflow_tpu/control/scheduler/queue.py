@@ -56,9 +56,11 @@ class GangQueue:
         # jitter spreads same-shaped gangs' retries apart (thundering-
         # herd control after a big node comes back); 0.0 (default) keeps
         # the schedule exactly pinnable in tests. rng injectable so a
-        # seeded chaos run replays the same jittered schedule.
+        # seeded chaos run replays the same jittered schedule; the
+        # default is seeded too (DET602), so enabling jitter without
+        # wiring an rng still replays byte-identically.
         self.jitter = jitter
-        self._rng = rng if rng is not None else random.Random()
+        self._rng = rng if rng is not None else random.Random(0)
         self._lock = threading.Lock()
         self._entries: dict[tuple[str, str], Entry] = {}
         # namespaces ever queued: keeps the queue-depth gauge reporting

@@ -174,12 +174,15 @@ class GangScheduler(Reconciler):
         # whole pass is serialized (kube-scheduler's single scheduling
         # cycle). Queue state has its own finer lock.
         self._pass_lock = threading.Lock()
+        # injectable pass timer (DET601): pass-duration metrics come
+        # off this hook so virtual-time benches can pin it
+        self._perf = time.perf_counter
 
     # -- reconcile ----------------------------------------------------------
 
     def reconcile(self, client, req: Request) -> Result | None:
         with self._pass_lock:
-            t0 = time.perf_counter()
+            t0 = self._perf()
             if self.cache is not None:
                 # catch the snapshot up BEFORE reading: the event that
                 # triggered this reconcile is already in the watch
@@ -196,7 +199,7 @@ class GangScheduler(Reconciler):
                 # evict (and double-count) the same pods.
                 self._health_pass(client)
             delay = self._schedule_pass(client)
-            self._observe_pass(time.perf_counter() - t0)
+            self._observe_pass(self._perf() - t0)
         self._publish_metrics()
         if delay is not None:
             return Result(requeue_after=max(delay, 0.01))

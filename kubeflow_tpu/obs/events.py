@@ -76,7 +76,7 @@ class EventRecorder:
                 "apiVersion": "v1",
                 "kind": "Event",
                 "metadata": {
-                    "name": f"{m['name']}.{uuid.uuid4().hex[:10]}",
+                    "name": f"{m['name']}.{uuid.uuid4().hex[:10]}",  # tpulint: disable=DET604  apiserver object-name suffix (client-go idiom), never a decision input
                     "namespace": ns,
                 },
                 "involvedObject": {
@@ -90,8 +90,8 @@ class EventRecorder:
                 "message": message,
                 "type": etype,
                 "source": {"component": comp},
-                "firstTimestamp": ob.now_iso(),
-                "lastTimestamp": ob.now_iso(),
+                "firstTimestamp": ob.now_iso(),  # tpulint: disable=DET601  Event timestamps are apiserver metadata, excluded from decision fingerprints
+                "lastTimestamp": ob.now_iso(),  # tpulint: disable=DET601  Event timestamps are apiserver metadata, excluded from decision fingerprints
                 "count": 1,
             }
             try:
@@ -115,7 +115,7 @@ class EventRecorder:
             return self.client.patch(
                 "v1", "Event", name,
                 {"count": cur.get("count", 1) + 1,
-                 "lastTimestamp": ob.now_iso()},
+                 "lastTimestamp": ob.now_iso()},  # tpulint: disable=DET601  Event timestamps are apiserver metadata, excluded from decision fingerprints
                 namespace)
         except ob.NotFound:
             return None

@@ -40,15 +40,15 @@ TRACEPARENT_ANNOTATION = "obs.kubeflow.org/traceparent"
 # Wall-clock anchor: epoch seconds at the instant perf_counter read 0.
 # Span timestamps are anchor + perf_counter — one wall reading at
 # import, monotonic deltas ever after.
-_EPOCH = time.time() - time.perf_counter()  # tpulint: disable=OBS301  wall anchor, not a duration: sampled once so all span math stays on perf_counter
+_EPOCH = time.time() - time.perf_counter()  # tpulint: disable=OBS301,DET601  wall anchor, not a duration: sampled once at import so all span math stays on perf_counter; never read inside a replayed decision
 
 
 def new_trace_id() -> str:
-    return uuid.uuid4().hex  # 32 hex chars
+    return uuid.uuid4().hex  # tpulint: disable=DET604  trace ids are correlation keys, never decision inputs: fingerprints hash decisions, not span identity
 
 
 def new_span_id() -> str:
-    return uuid.uuid4().hex[:16]
+    return uuid.uuid4().hex[:16]  # tpulint: disable=DET604  span ids are correlation keys, never decision inputs: fingerprints hash decisions, not span identity
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,7 +200,7 @@ class Tracer:
             trace_id = up.trace_id if up is not None else new_trace_id()
             parent_id = up.span_id if up is not None else None
             span_id = new_span_id()
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()  # tpulint: disable=DET601  span timing is observability payload, not a decision input: no control flow reads span durations
         span = Span(name=name, trace_id=trace_id, span_id=span_id,
                     parent_id=parent_id, start=_EPOCH + t0, attrs=dict(attrs))
         span._t0 = t0
@@ -208,7 +208,7 @@ class Tracer:
         return span
 
     def finish(self, span: Span) -> Span:
-        span.end = span.start + (time.perf_counter() - span._t0)
+        span.end = span.start + (time.perf_counter() - span._t0)  # tpulint: disable=DET601  span timing is observability payload, not a decision input: no control flow reads span durations
         token = getattr(span, "_token", None)
         if token is not None:
             span._token = None

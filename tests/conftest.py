@@ -113,3 +113,52 @@ def make_segments(b, l, n_docs, seed=7):
     import jax.numpy as jnp
 
     return jnp.asarray(seg)
+
+
+# -- virtual-time determinism guard (ISSUE 16) -------------------------------
+#
+# The bench-contract tests assert byte-stable decision fingerprints, which
+# only holds if the modules under test never read the wall clock during a
+# replay. tpulint's DET6xx family proves that statically; this fixture is
+# the dynamic twin: it snapshots time.time() call counts per calling
+# module across the test and fails on any read attributed to a
+# replay-critical module (the docs/scale.md "Determinism contract" list).
+
+REPLAY_CRITICAL_MODULES = (
+    "kubeflow_tpu.control.scheduler",
+    "kubeflow_tpu.control.cache",
+    "kubeflow_tpu.serving.router",
+    "kubeflow_tpu.serving.continuous",
+    "kubeflow_tpu.obs",
+    "kubeflow_tpu.control.jaxservice",
+    "kubeflow_tpu.control.jaxjob",
+)
+
+
+@pytest.fixture
+def virtual_time_guard(monkeypatch):
+    """Fail the test if a replay-critical module reads time.time().
+
+    Yields the live {caller module -> call count} snapshot so a test can
+    also assert on reads it *expects* (e.g. from the bench harness
+    itself, which owns the virtual clock and may read real time freely).
+    """
+    import sys
+    import time as _time
+
+    real_time = _time.time
+    reads: dict = {}
+
+    def guarded_time():
+        mod = sys._getframe(1).f_globals.get("__name__", "<unknown>")
+        reads[mod] = reads.get(mod, 0) + 1
+        return real_time()
+
+    monkeypatch.setattr(_time, "time", guarded_time)
+    yield reads
+    offenders = {m: n for m, n in sorted(reads.items())
+                 if m.startswith(REPLAY_CRITICAL_MODULES)}
+    assert not offenders, (
+        "wall-clock time.time() read from replay-critical module(s) "
+        f"during a bench-contract test: {offenders} — inject a clock "
+        "(see docs/scale.md 'Determinism contract')")

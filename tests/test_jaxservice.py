@@ -1073,6 +1073,7 @@ def test_kill_drill_survives_chaos(seed):
 # -- the banked benchmark stays meaningful -----------------------------------
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestServeBenchContract:
     def test_banked_results_satisfy_acceptance(self):
         """BENCH_SERVE_r01.json is the PR's acceptance artifact: the

@@ -51,6 +51,7 @@ def drill(bench):
     return bench.run_drill()
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestMsliceBench:
     def test_double_run_fingerprint_byte_stable(self, bench, smoke):
         again = bench.run_admission(**bench.SMOKE_CONFIG)

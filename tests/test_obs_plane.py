@@ -971,6 +971,7 @@ class TestDashboardRoutes:
 # -- bench contract (CI ratchet) ---------------------------------------------
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestObsBenchContract:
     def test_smoke_is_deterministic_and_fires_the_pack(self):
         from tools.obs_bench import SMOKE_CONFIG, run_bench
@@ -1307,6 +1308,7 @@ class TestGoodputExporter:
 # -- heal bench contract (ISSUE 13 satellite f) -------------------------------
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestHealBenchContract:
     def test_smoke_is_deterministic_and_heals(self):
         from tools.heal_bench import SMOKE_CONFIG, run_bench

@@ -619,6 +619,7 @@ def _bench():
     return sb
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestResilienceBenchContract:
     def test_banked_results_satisfy_acceptance(self):
         """BENCH_SERVE_r03.json is the PR's acceptance artifact: the

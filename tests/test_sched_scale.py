@@ -53,6 +53,7 @@ def smoke_pair(bench):
     return bench, config, cache, legacy
 
 
+@pytest.mark.usefixtures("virtual_time_guard")
 class TestScaleSmoke:
     def test_cache_arm_makes_zero_per_pass_list_scans(self, smoke_pair):
         _bench, _config, cache, legacy = smoke_pair

@@ -749,14 +749,27 @@ def test_clean_fragment_stays_clean(rule, src):
     assert not findings, [f.render() for f in findings]
 
 
+#: Rules with path semantics ("<corpus>" is out of their scope), pinned
+#: in dedicated classes below instead of the generic BAD/CLEAN tables.
+#: DET/CTL deliberately do not fire outside the replay-critical /
+#: control-plane subtrees, so their corpora scan under scoped paths.
+_SCOPED_RULES = {
+    "OBS302",
+    "DET601", "DET602", "DET603", "DET604",
+    "CTL501", "CTL502", "CTL503", "CTL504",
+}
+
+
 def test_at_least_ten_rules_each_with_both_cases():
     ids = {r.id for r in all_rules()}
     assert len(ids) >= 10, ids
-    # OBS302 needs an injected catalog + in-package module paths, which
-    # the generic "<corpus>" harness cannot express — its firing AND
-    # non-firing pins live in TestOBS302 below instead
-    assert ids - {"OBS302"} == set(BAD) == set(CLEAN), (
+    # Scoped rules (OBS302's injected catalog, the DET6xx/CTL5xx path
+    # scopes) can't be expressed under the generic "<corpus>" path —
+    # their firing AND non-firing pins live in the dedicated classes
+    # below (TestOBS302, TestDET6xxCorpus, TestCTL5xxCorpus).
+    assert ids - _SCOPED_RULES == set(BAD) == set(CLEAN), (
         "every registered rule needs a firing AND a non-firing corpus case")
+    assert _SCOPED_RULES <= ids, "scoped-rule pin list names unknown rules"
 
 
 # -- suppression mechanics ---------------------------------------------------
@@ -1573,3 +1586,681 @@ def publish():
         REGISTRY["OBS302"].catalog_override = None
         findings = scan_paths(["kubeflow_tpu"], select={"OBS302"})
         assert findings == []
+
+
+# ===========================================================================
+# ISSUE 16: scoped corpora for the DET6xx / CTL5xx families. These rules
+# carry path semantics (replay-critical modules / the control plane), so
+# their pins scan under in-scope paths instead of "<corpus>".
+# ===========================================================================
+
+DET_PATH = "kubeflow_tpu/control/scheduler/_det_corpus.py"
+CTL_PATH = "kubeflow_tpu/control/_ctl_corpus.py"
+
+
+def _scan_at(path: str, src: str):
+    return scan_source(path, textwrap.dedent(src))
+
+
+DET_BAD = {
+    "DET601": [
+        # ambient monotonic read deciding an admission deadline
+        ("""\
+import time
+
+
+def admission_deadline(queue):
+    deadline = time.monotonic() + 5.0
+    return deadline
+""", 5),
+        # datetime alias resolves through the import table
+        ("""\
+from datetime import datetime
+
+
+class Router:
+    def pick(self, replicas):
+        stamp = datetime.now()
+        return sorted(replicas), stamp
+""", 6),
+        # wall-returning helper by name: fires at the call site even in
+        # a per-file scan (keeps suppressions HYG004-coherent with the
+        # whole-tree pass)
+        ("""\
+from kubeflow_tpu.control.k8s import objects as ob
+
+
+def stamp_event(ev):
+    ev["ts"] = ob.now_iso()
+    return ev
+""", 5),
+    ],
+    "DET602": [
+        # default-constructed RNG: seeded by the process, not the bench
+        ("""\
+import random
+
+
+class Jitter:
+    def __init__(self):
+        self._rng = random.Random()
+""", 6),
+        # ambient module-level draw from the process-global generator
+        ("""\
+import random
+
+
+def spread(pods):
+    random.shuffle(pods)
+    return pods
+""", 5),
+    ],
+    "DET603": [
+        ("""\
+import time
+
+
+def backoff(attempt):
+    time.sleep(0.5 * attempt)
+""", 5),
+        # module alias still canonicalizes to time.sleep
+        ("""\
+import time as _t
+
+
+def settle():
+    _t.sleep(1.0)
+""", 5),
+    ],
+    "DET604": [
+        ("""\
+import uuid
+
+
+def trace_id():
+    return uuid.uuid4().hex
+""", 5),
+        # id()-keyed ordering leaks allocation addresses into decisions
+        ("""\
+def order(pods):
+    return sorted(pods, key=id)
+""", 2),
+        ("""\
+import os
+
+
+def salt():
+    return os.urandom(8)
+""", 5),
+    ],
+}
+
+DET_CLEAN = {
+    "DET601": [
+        # THE injectable-clock idiom: the default is a *reference*, the
+        # read goes through the attribute the bench substitutes
+        """\
+import time
+
+
+class Pacer:
+    def __init__(self, clock=time.monotonic):
+        self.clock = clock
+
+    def due(self, deadline):
+        return self.clock() >= deadline
+""",
+        # injectable param form of the same idiom
+        """\
+import time
+
+
+def tick(handler, clock=time.monotonic):
+    handler(clock())
+""",
+        # converting an injected timestamp is not a wall read
+        """\
+import datetime
+
+
+EPOCH = datetime.timezone.utc
+
+
+def label(ts):
+    return datetime.datetime.fromtimestamp(ts, EPOCH).isoformat()
+""",
+    ],
+    "DET602": [
+        # seeded default: replayable without caller wiring
+        """\
+import random
+
+
+class Jitter:
+    def __init__(self):
+        self._rng = random.Random(0)
+""",
+        # inject-or-seed: the in-tree queue.py / rest.py idiom
+        """\
+import random
+
+
+class Jitter:
+    def __init__(self, rng=None):
+        self._rng = rng if rng is not None else random.Random(17)
+""",
+        # draws from an injected rng are the point, not a finding
+        """\
+def pick(replicas, rng):
+    return replicas[rng.randrange(len(replicas))]
+""",
+    ],
+    "DET603": [
+        # injectable sleeper attribute (the jaxservice watch idiom)
+        """\
+import time
+
+
+class Loop:
+    def __init__(self, sleep=time.sleep):
+        self._sleep = sleep
+
+    def run_once(self, fn):
+        fn()
+        self._sleep(0.0)
+""",
+        # injectable sleeper parameter
+        """\
+import time
+
+
+def drain(q, sleep=time.sleep):
+    while q:
+        q.pop()
+        sleep(0.01)
+""",
+        # event waits are interruptible coordination, not raw sleeps
+        """\
+import threading
+
+
+def pause(stop, interval):
+    stop.wait(interval)
+""",
+    ],
+    "DET604": [
+        # uuid5 is a pure function of its inputs: replayable
+        """\
+import uuid
+
+
+def stable_id(name):
+    return uuid.uuid5(uuid.NAMESPACE_URL, name).hex
+""",
+        # ordering on a stable field
+        """\
+def order(pods):
+    return sorted(pods, key=lambda p: p["uid"])
+""",
+        # a key= that is a Name but not id
+        """\
+def shortest_first(names):
+    names.sort(key=len)
+    return names
+""",
+    ],
+}
+
+CTL_BAD = {
+    "CTL501": [
+        # delete ordered before the durable record write
+        ("""\
+class Reconciler:
+    def reconcile(self, client, job):
+        client.delete("v1", "Pod", "p0")
+        job["status"]["phase"] = "Restarting"
+        client.update_status(job)
+""", 3),
+        # call-graph: the helper transitively deletes, so its call site
+        # counts as the delete
+        ("""\
+class Reconciler:
+    def _purge(self, client, pods):
+        for p in pods:
+            client.delete("v1", "Pod", p)
+
+    def restart(self, client, job, pods):
+        self._purge(client, pods)
+        client.update_status(job)
+""", 7),
+    ],
+    "CTL502": [
+        # unconditional status write per pass: the PR 5 status storm
+        ("""\
+class Reconciler:
+    def reconcile(self, client, job):
+        job["status"]["phase"] = "Active"
+        client.update_status(job)
+""", 4),
+        # unguarded private helper whose one call site is unguarded too
+        ("""\
+class Reconciler:
+    def _flush(self, client, job):
+        client.update_status(job)
+
+    def reconcile(self, client, job):
+        self._flush(client, job)
+""", 3),
+    ],
+    "CTL503": [
+        # bare-statement patch in a cache-wired controller
+        ("""\
+class Reconciler:
+    def __init__(self, cache):
+        self.cache = cache
+
+    def reconcile(self, client, pod):
+        client.patch("v1", "Pod", pod["name"], {"spec": {}})
+        self.cache.note_write(pod)
+""", 6),
+        # one folded write does not excuse the discarded one
+        ("""\
+class Reconciler:
+    def ensure(self, client, pod):
+        obj = client.create(pod)
+        self.cache.note_write(obj)
+
+    def ensure_again(self, client, pod):
+        client.create(pod)
+""", 7),
+    ],
+    "CTL504": [
+        ("""\
+class Minter:
+    def mint(self, client, obj, tp):
+        client.patch("v1", "Pod", obj["name"], {
+            "metadata": {"annotations": {"obs.kubeflow.org/traceparent": tp}},
+        })
+""", 3),
+        # the key spelled through a module constant still counts
+        ("""\
+TRACEPARENT = "obs.kubeflow.org/traceparent"
+
+
+class Minter:
+    def mint(self, client, obj, tp):
+        client.replace("v1", "Pod", obj["name"], {
+            "metadata": {"annotations": {TRACEPARENT: tp}},
+        })
+""", 6),
+    ],
+}
+
+CTL_CLEAN = {
+    "CTL501": [
+        # record-first: the committed gang-restart discipline
+        """\
+class Reconciler:
+    def restart(self, client, job, pods):
+        job["status"]["restarts"] = job["status"].get("restarts", 0) + 1
+        if job["status"]:
+            client.update_status(job)
+        for p in pods:
+            client.delete("v1", "Pod", p)
+""",
+        # a helper that both records and deletes is a self-contained
+        # transaction: its call site is neither a delete nor a record
+        """\
+class Reconciler:
+    def _gang_restart(self, client, job, pods):
+        client.update_status(job)
+        for p in pods:
+            client.delete("v1", "Pod", p)
+
+    def reconcile(self, client, job, pods, changed):
+        self._gang_restart(client, job, pods)
+        if changed:
+            client.update_status(job)
+""",
+        # deletes with no record write here: the caller owns the record
+        """\
+class Sweeper:
+    def sweep(self, client, pods):
+        for p in pods:
+            client.delete("v1", "Pod", p)
+""",
+    ],
+    "CTL502": [
+        # the changed-guard idiom
+        """\
+class Reconciler:
+    def reconcile(self, client, job):
+        changed = cond_set(job, "Ready", "True")
+        if changed:
+            client.update_status(job)
+""",
+        # the double-checked early-return idiom
+        """\
+class Reconciler:
+    def reconcile(self, client, job, prev):
+        if prev == job["status"]:
+            return
+        client.update_status(job)
+""",
+        # unguarded private helper, but every resolved call site guards
+        """\
+class Reconciler:
+    def _flush(self, client, job):
+        client.update_status(job)
+
+    def reconcile(self, client, job, changed):
+        if changed:
+            self._flush(client, job)
+""",
+        # pure delegation: the caller owns the guard
+        """\
+class Proxy:
+    def update_status(self, client, obj):
+        return client.update_status(obj)
+""",
+    ],
+    "CTL503": [
+        # folded inline through the note helper
+        """\
+class Reconciler:
+    def reconcile(self, client, pod):
+        self.cache.note_write(client.patch("v1", "Pod", pod["name"], {}))
+""",
+        # assigned then folded
+        """\
+class Reconciler:
+    def ensure(self, client, pod):
+        created = client.create(pod)
+        self.cache.note_write(created)
+        return created
+""",
+        # a class with no cache wiring has nothing to fold into
+        """\
+class Pusher:
+    def push(self, client, obj):
+        client.patch("v1", "Pod", obj["name"], {})
+""",
+    ],
+    "CTL504": [
+        # rv precondition present: concurrent minters 409 instead of
+        # overwriting each other's trace roots
+        """\
+class Minter:
+    def mint(self, client, obj, tp):
+        client.patch("v1", "Pod", obj["name"], {
+            "metadata": {
+                "resourceVersion": obj["metadata"]["resourceVersion"],
+                "annotations": {"obs.kubeflow.org/traceparent": tp},
+            },
+        })
+""",
+        # annotation patches without a traceparent key are out of scope
+        """\
+class Annotator:
+    def annotate(self, client, obj):
+        client.patch("v1", "Pod", obj["name"], {
+            "metadata": {"annotations": {"kubeflow.org/owner": "sched"}},
+        })
+""",
+        # reading the annotation is not a mint
+        """\
+class Reader:
+    def trace_of(self, obj):
+        return obj["metadata"]["annotations"].get(
+            "obs.kubeflow.org/traceparent")
+""",
+    ],
+}
+
+
+def _scoped_bad_cases():
+    cases = [(rule, src, line, DET_PATH)
+             for rule, cs in sorted(DET_BAD.items()) for src, line in cs]
+    cases += [(rule, src, line, CTL_PATH)
+              for rule, cs in sorted(CTL_BAD.items()) for src, line in cs]
+    return cases
+
+
+def _scoped_clean_cases():
+    cases = [(rule, src, DET_PATH)
+             for rule, cs in sorted(DET_CLEAN.items()) for src in cs]
+    cases += [(rule, src, CTL_PATH)
+              for rule, cs in sorted(CTL_CLEAN.items()) for src in cs]
+    return cases
+
+
+@pytest.mark.parametrize("rule,src,line,path", _scoped_bad_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("DET", "CTL")) else None)
+def test_scoped_rule_fires_with_id_and_line(rule, src, line, path):
+    findings = _scan_at(path, src)
+    hits = [f for f in findings if f.rule == rule]
+    assert hits, f"{rule} did not fire; got {[f.render() for f in findings]}"
+    assert line in [f.line for f in hits], (
+        f"{rule} fired at {[f.line for f in hits]}, expected line {line}")
+
+
+@pytest.mark.parametrize("rule,src,path", _scoped_clean_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("DET", "CTL")) else None)
+def test_scoped_clean_fragment_stays_clean(rule, src, path):
+    findings = [f for f in _scan_at(path, src) if f.rule == rule]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_scoped_corpus_floor():
+    """The ISSUE 16 coverage floor: every DET/CTL rule carries >= 2 bad
+    pins and >= 3 clean FP pins."""
+    assert set(DET_BAD) == set(DET_CLEAN) == {
+        "DET601", "DET602", "DET603", "DET604"}
+    assert set(CTL_BAD) == set(CTL_CLEAN) == {
+        "CTL501", "CTL502", "CTL503", "CTL504"}
+    for table in (DET_BAD, CTL_BAD):
+        for rule, cases in table.items():
+            assert len(cases) >= 2, f"{rule}: need >= 2 bad pins"
+    for table in (DET_CLEAN, CTL_CLEAN):
+        for rule, cases in table.items():
+            assert len(cases) >= 3, f"{rule}: need >= 3 clean pins"
+
+
+def test_det601_call_graph_propagation_fires_at_call_site():
+    """A helper outside the replay scope that *returns* a wall read
+    taints its in-scope call site — the fix (or audited suppression)
+    belongs where the value enters the decision path."""
+    findings = scan_sources({
+        "kubeflow_tpu.control.k8s.clockutil": (
+            "import time\n"
+            "\n"
+            "\n"
+            "def wall_stamp():\n"
+            "    return time.time()\n"),
+        "kubeflow_tpu.control.scheduler.core": (
+            "from kubeflow_tpu.control.k8s.clockutil import wall_stamp\n"
+            "\n"
+            "\n"
+            "def admit(job):\n"
+            "    job[\"ts\"] = wall_stamp()\n"
+            "    return job\n"),
+    })
+    hits = [f for f in findings if f.rule == "DET601"]
+    assert [(f.path, f.line) for f in hits] == \
+        [("kubeflow_tpu/control/scheduler/core.py", 5)]
+    assert "call-graph" in hits[0].message
+
+
+def test_det601_injection_seam_helper_does_not_taint_callers():
+    """A helper with a clock-ish parameter is the injection seam: its
+    internal wall read is the *default*, so in-scope callers stay
+    clean."""
+    findings = scan_sources({
+        "kubeflow_tpu.control.k8s.clockutil": (
+            "import time\n"
+            "\n"
+            "\n"
+            "def stamp(clock=None):\n"
+            "    return time.time() if clock is None else clock()\n"),
+        "kubeflow_tpu.control.scheduler.core": (
+            "from kubeflow_tpu.control.k8s.clockutil import stamp\n"
+            "\n"
+            "\n"
+            "def admit(job):\n"
+            "    job[\"ts\"] = stamp()\n"
+            "    return job\n"),
+    })
+    assert [f for f in findings if f.rule == "DET601"] == []
+
+
+def test_det_rules_ignore_modules_outside_replay_scope():
+    findings = _scan_at("kubeflow_tpu/control/k8s/rest_frag.py", """\
+        import random
+        import time
+
+
+        def jitter(base):
+            time.sleep(base * random.random())
+    """)
+    assert not [f for f in findings if f.rule.startswith("DET")]
+
+
+def test_ctl_rules_ignore_modules_outside_control_plane():
+    findings = _scan_at("kubeflow_tpu/runtime/gc_frag.py", """\
+        class Gc:
+            def sweep(self, client, job):
+                client.delete("v1", "Pod", "p0")
+                client.update_status(job)
+    """)
+    assert not [f for f in findings if f.rule.startswith("CTL")]
+
+
+# -- the per-family real-tree gates (ISSUE 16 acceptance) --------------------
+
+
+def test_determinism_family_clean_on_real_tree():
+    """Every in-tree DET true positive is fixed or carries an audited
+    suppression: the family scan of the shipped package is empty."""
+    from kubeflow_tpu.analysis import scan_paths
+
+    findings = scan_paths([str(PACKAGE)],
+                          select={"DET601", "DET602", "DET603", "DET604"})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_reconcile_family_clean_on_real_tree():
+    from kubeflow_tpu.analysis import scan_paths
+
+    findings = scan_paths([str(PACKAGE)],
+                          select={"CTL501", "CTL502", "CTL503", "CTL504"})
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_det_ctl_families_run_clean_on_tree(capsys):
+    assert tpulint_main([
+        "--select",
+        "DET601,DET602,DET603,DET604,CTL501,CTL502,CTL503,CTL504",
+        str(PACKAGE)]) == 0
+    capsys.readouterr()
+
+
+# -- SARIF round-trip for the new ids ----------------------------------------
+
+
+def test_sarif_roundtrip_for_det_and_ctl_ids():
+    from kubeflow_tpu.analysis.report import render_sarif
+
+    findings = []
+    for rule, cases in sorted(DET_BAD.items()):
+        findings += [f for f in _scan_at(DET_PATH, cases[0][0])
+                     if f.rule == rule]
+    for rule, cases in sorted(CTL_BAD.items()):
+        findings += [f for f in _scan_at(CTL_PATH, cases[0][0])
+                     if f.rule == rule]
+    doc = json.loads(render_sarif(findings))
+    run = doc["runs"][0]
+    rules = {r["id"]: r for r in run["tool"]["driver"]["rules"]}
+    want = {"DET601", "DET602", "DET603", "DET604",
+            "CTL501", "CTL502", "CTL503", "CTL504"}
+    assert want <= set(rules)
+    for rid in want:
+        assert rules[rid]["shortDescription"]["text"]
+    assert {r["ruleId"] for r in run["results"]} == want
+    for res in run["results"]:
+        region = res["locations"][0]["physicalLocation"]["region"]
+        assert region["startLine"] >= 1 and region["startColumn"] >= 1
+
+
+# -- the --jobs output law: parallel == serial, byte for byte ----------------
+
+
+def test_parallel_scan_is_byte_identical_to_serial(tmp_path, capsys):
+    """The pinned output law for the fork-pool engine: a --jobs N scan
+    of a multi-module corpus (both rule families firing across several
+    modules) produces byte-identical output and the same exit code as
+    the serial scan."""
+    corpus = {
+        "control/scheduler/admit.py": DET_BAD["DET601"][0][0],
+        "control/scheduler/jitter.py": DET_BAD["DET602"][0][0],
+        "control/scheduler/pace.py": DET_BAD["DET603"][0][0],
+        "control/scheduler/ids.py": DET_BAD["DET604"][0][0],
+        "control/reconcile.py": CTL_BAD["CTL501"][0][0],
+        "control/status.py": CTL_BAD["CTL502"][0][0],
+        "control/cachefold.py": CTL_BAD["CTL503"][0][0],
+        "control/mint.py": CTL_BAD["CTL504"][0][0],
+    }
+    for rel, src in corpus.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+    from kubeflow_tpu.analysis import scan_paths
+
+    serial = scan_paths([str(tmp_path)])
+    par = scan_paths([str(tmp_path)], jobs=4)
+    assert par == serial
+    assert {f.rule for f in serial} >= {
+        "DET601", "DET602", "DET603", "DET604",
+        "CTL501", "CTL502", "CTL503", "CTL504"}
+
+    rc_serial = tpulint_main(["--json", str(tmp_path)])
+    out_serial = capsys.readouterr().out
+    rc_par = tpulint_main(["--jobs", "4", "--json", str(tmp_path)])
+    out_par = capsys.readouterr().out
+    assert rc_serial == rc_par == 1
+    assert out_par == out_serial
+
+
+def test_cli_rejects_negative_jobs(tmp_path, capsys):
+    (tmp_path / "m.py").write_text("x = 1\n")
+    assert tpulint_main(["--jobs", "-1", str(tmp_path)]) == 2
+    assert "--jobs" in capsys.readouterr().err
+
+
+def test_stale_det_ctl_suppressions_are_flagged():
+    """HYG004 extends to the new families: a disable on a line where the
+    rule does not fire is an orphaned suppression, and a live one is
+    honored without going stale."""
+    stale = _scan_at(DET_PATH, """\
+        def quiet():
+            return 1  # tpulint: disable=DET601  nothing fires here
+    """)
+    assert [f.rule for f in stale] == ["HYG004"]
+    assert "DET601 does not fire" in stale[0].message
+
+    live = _scan_at(DET_PATH, """\
+        import time
+
+
+        def admit():
+            return time.time()  # tpulint: disable=DET601  corpus pin
+    """)
+    assert live == [], [f.render() for f in live]
+
+    stale_ctl = _scan_at(CTL_PATH, """\
+        def quiet():
+            return 1  # tpulint: disable=CTL502  nothing fires here
+    """)
+    assert [f.rule for f in stale_ctl] == ["HYG004"]
