@@ -3,16 +3,21 @@
 Exit codes: 0 clean, 1 findings, 2 usage error. ``--hygiene`` adds the
 stdlib hygiene gates (parse/debugger/conflict-marker, yaml manifests)
 on top of the tpulint rules, so tools/lint_all.sh is one process.
-``--format sarif`` emits a code-scanning artifact; ``--write-baseline``
+``--format sarif`` emits a code-scanning artifact; ``--sarif-file``
+writes one alongside whatever stdout format is selected (so a CI pass
+gets an uploadable artifact without a second scan); ``--write-baseline``
 / ``--baseline`` implement the ratchet (fail only on NEW findings).
-Multi-path scans run the whole-program rules (cross-module call graph)
-over all paths as one program.
+``--select``/``--rules`` accept FAMILY prefixes (``RES``, ``WIRE``,
+``DET``…): an all-caps token expands to every registered id spelled
+``<token><digits>``. Multi-path scans run the whole-program rules
+(cross-module call graph) over all paths as one program.
 """
 
 from __future__ import annotations
 
 import argparse
 import pathlib
+import re
 import sys
 
 from kubeflow_tpu.analysis import core, hygiene, report
@@ -22,6 +27,24 @@ def _parse_rules(text: str | None) -> set[str] | None:
     if not text:
         return None
     return {r.strip() for r in text.split(",") if r.strip()}
+
+
+def _expand_families(wanted: set[str] | None,
+                     known: set[str]) -> set[str] | None:
+    """Expand family prefixes: ``RES`` -> RES701..RES705. A token that
+    is already a known id, or matches no family, passes through (the
+    unknown-id check still rejects typos)."""
+    if not wanted:
+        return wanted
+    out: set[str] = set()
+    for token in wanted:
+        if token in known:
+            out.add(token)
+            continue
+        family = {k for k in known
+                  if re.fullmatch(re.escape(token) + r"\d+", k)}
+        out |= family or {token}
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -49,6 +72,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--write-baseline", metavar="FILE",
                         help="write current findings as the baseline and "
                              "exit 0")
+    parser.add_argument("--sarif-file", metavar="FILE",
+                        help="also write a SARIF artifact to FILE "
+                             "(independent of the stdout format)")
     parser.add_argument("--hygiene", action="store_true",
                         help="also run the stdlib hygiene gates "
                              "(parse/debugger/conflict markers, yaml)")
@@ -76,6 +102,8 @@ def main(argv: list[str] | None = None) -> int:
     select, ignore = _parse_rules(args.select), _parse_rules(args.ignore)
     known = {r.id for r in core.all_rules()} | {core.PARSE_RULE}
     known |= set(hygiene.HYGIENE_RULES)
+    select = _expand_families(select, known)
+    ignore = _expand_families(ignore, known)
     for wanted in (select or set()) | (ignore or set()):
         if wanted not in known:
             print(f"unknown rule id: {wanted}", file=sys.stderr)
@@ -97,6 +125,10 @@ def main(argv: list[str] | None = None) -> int:
         if ignore:
             hyg = [f for f in hyg if f.rule not in ignore]
         findings = sorted(findings + hyg, key=core._sort_key)
+
+    if args.sarif_file:
+        pathlib.Path(args.sarif_file).write_text(
+            report.render_sarif(findings))
 
     if args.write_baseline:
         pathlib.Path(args.write_baseline).write_text(

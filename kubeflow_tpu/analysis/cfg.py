@@ -1,0 +1,442 @@
+"""Per-function control-flow graphs with exception edges, plus a
+worklist dataflow solver — the path-sensitive layer under the RES7xx
+resource-lifecycle rules (rules_resource.py).
+
+tpulint v1–v3 reason about statements and call graphs; they cannot see
+*paths*. The bugs that motivated this layer (the router shed-race, the
+KV over-admission ``fail_all``) all lived on exceptional paths: a
+resource acquired, then a ``raise`` between the acquire and the
+release. This module makes those paths first-class:
+
+- ``build_cfg(fn)``: one graph per function. Every statement is a
+  node; compound statements contribute a *header* node (the test /
+  iterator / context managers) and their bodies flow through it.
+  Synthetic ``entry``/``exit`` nodes bracket the graph, loops get back
+  edges, and — the point of the exercise — **every statement that can
+  throw gets an exception edge** to the enclosing handler or, when
+  nothing catches, to the function exit.
+- ``try/finally`` is modelled by *inlining*: each distinct
+  continuation through a ``finally`` (normal fall-through, uncaught
+  exception, early ``return``, ``break``/``continue``) gets its own
+  copy of the finally body, so a release inside ``finally`` provably
+  covers the exception path without smearing facts between
+  continuations. Exception routing within one ``try`` is funnelled
+  through a per-frame collector node, so the exception copy of a
+  finally is emitted once per ``try``, not once per throwing
+  statement.
+- ``solve_forward``: a classic may-analysis worklist solver over
+  frozensets with union join. The transfer is per *edge*: exceptional
+  out-edges skip the source node's GEN (the acquire itself may be
+  what threw — no resource exists on that path) but still apply KILL
+  (a release that throws has still released — the kill-before-throw
+  law the RES corpus pins).
+
+Throw classification is deliberately conservative-but-useful: calls,
+``raise``/``assert``, ``yield``/``await`` and imports can throw;
+plain name/attribute/subscript reads, stores and arithmetic do not
+(an ``AttributeError`` or ``KeyError`` between an acquire and its
+release is real in theory and pure noise in practice — and nearly
+every such statement neighbors a call that already carries the edge).
+Nested ``def``/``class`` bodies are opaque single nodes — they
+execute at call time, not here.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from collections import deque
+from typing import Callable, Iterable
+
+ENTRY = 0
+EXIT = 1
+
+# Edge kinds. Solver-exceptional kinds (GEN suppressed at the source):
+EXC_KINDS = frozenset({"exc", "raise"})
+# Kinds that terminate the function (edges into EXIT carry one of
+# these; anything else into EXIT is the implicit end-of-body fall-off).
+EXIT_EXC = frozenset({"exc", "raise"})
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node: a statement (header, for compounds) or synthetic."""
+
+    idx: int
+    stmt: ast.stmt | None          # None for entry/exit/join/collector
+    kind: str                      # entry|exit|stmt|handler|join|collect
+    line: int
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: int
+    dst: int
+    kind: str  # norm|true|false|loop|exc|raise|return|break|continue|end
+
+
+@dataclasses.dataclass
+class CFG:
+    func: ast.AST
+    nodes: list[Node]
+    edges: list[Edge]
+
+    def __post_init__(self) -> None:
+        self._succ: dict[int, list[Edge]] = {}
+        self._pred: dict[int, list[Edge]] = {}
+        for e in self.edges:
+            self._succ.setdefault(e.src, []).append(e)
+            self._pred.setdefault(e.dst, []).append(e)
+
+    def succ(self, idx: int) -> list[Edge]:
+        return self._succ.get(idx, [])
+
+    def pred(self, idx: int) -> list[Edge]:
+        return self._pred.get(idx, [])
+
+    def stmt_nodes(self) -> Iterable[Node]:
+        return (n for n in self.nodes if n.stmt is not None)
+
+
+# -- throw classification ----------------------------------------------------
+
+_THROWING_EXPRS = (ast.Call, ast.Await, ast.Yield, ast.YieldFrom)
+
+
+def _header_exprs(stmt: ast.stmt) -> list[ast.expr]:
+    """The expressions evaluated by the statement ITSELF (for compound
+    statements: the header only — bodies are separate nodes)."""
+    if isinstance(stmt, (ast.If, ast.While)):
+        return [stmt.test]
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        return [stmt.iter, stmt.target]
+    if isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in stmt.items]
+    if isinstance(stmt, ast.Return):
+        return [stmt.value] if stmt.value else []
+    if isinstance(stmt, ast.Assign):
+        return [stmt.value, *stmt.targets]
+    if isinstance(stmt, ast.AugAssign):
+        return [stmt.target, stmt.value]
+    if isinstance(stmt, ast.AnnAssign):
+        return ([stmt.value, stmt.target] if stmt.value else [stmt.target])
+    if isinstance(stmt, ast.Expr):
+        return [stmt.value]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    if isinstance(stmt, ast.Match):
+        return [stmt.subject]
+    if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                         ast.ClassDef)):
+        return []  # the def itself; its body runs elsewhere
+    return []
+
+
+def can_raise(stmt: ast.stmt) -> bool:
+    """May this statement (its header, for compounds) throw?"""
+    if isinstance(stmt, (ast.Raise, ast.Assert, ast.Import,
+                         ast.ImportFrom)):
+        return True
+    for expr in _header_exprs(stmt):
+        for sub in ast.walk(expr):
+            if isinstance(sub, _THROWING_EXPRS):
+                return True
+    return False
+
+
+# -- builder -----------------------------------------------------------------
+
+# A dangling edge waiting for its destination: (source node, edge kind).
+_Pred = tuple[int, str]
+
+
+@dataclasses.dataclass
+class _HandlerFrame:
+    """Exception routing for one ``try`` with except clauses: throwing
+    statements in the body edge to ``collector``; at pop time the
+    collector fans out to the handler nodes and (unless a bare/
+    BaseException handler catches everything) onward to the outer
+    frame."""
+
+    collector: int
+    handlers: list[int]
+    catch_all: bool
+    final_body = None  # sentinel: not a finally frame
+
+
+@dataclasses.dataclass
+class _FinallyFrame:
+    """A ``finally`` guard: the collector gathers uncaught exceptions
+    from everything the finally protects; at pop time one copy of the
+    finally body is inlined on that path before propagating outward."""
+
+    collector: int
+    final_body: list[ast.stmt]
+
+
+@dataclasses.dataclass
+class _Loop:
+    head: int        # continue target
+    after: int       # break target (join node)
+    depth: int       # len(frames) at loop entry: break/continue must
+                     # traverse finally frames pushed inside the loop
+
+
+class _Builder:
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: list[Node] = []
+        self.edges: list[Edge] = []
+        self.frames: list[_HandlerFrame | _FinallyFrame] = []
+        self.loops: list[_Loop] = []
+        self._new("entry", None, getattr(fn, "lineno", 1))   # ENTRY
+        self._new("exit", None, getattr(fn, "lineno", 1))    # EXIT
+
+    def _new(self, kind: str, stmt: ast.stmt | None, line: int) -> int:
+        idx = len(self.nodes)
+        self.nodes.append(Node(idx, stmt, kind, line))
+        return idx
+
+    def _connect(self, preds: list[_Pred], dst: int,
+                 kind: str | None = None) -> None:
+        for src, k in preds:
+            self.edges.append(Edge(src, dst, kind if kind is not None else k))
+
+    def _has_preds(self, idx: int) -> bool:
+        return any(e.dst == idx for e in self.edges)
+
+    def _exc_target(self) -> int:
+        """Where an uncaught exception goes from here: the innermost
+        frame's collector, or the function exit."""
+        return self.frames[-1].collector if self.frames else EXIT
+
+    def build(self) -> CFG:
+        body = list(getattr(self.fn, "body", []))
+        out = self._block(body, [(ENTRY, "norm")])
+        self._connect(out, EXIT, "end")
+        return CFG(self.fn, self.nodes, self.edges)
+
+    # -- statement dispatch --------------------------------------------------
+
+    def _block(self, stmts: list[ast.stmt],
+               preds: list[_Pred]) -> list[_Pred]:
+        for stmt in stmts:
+            if not preds:
+                break  # unreachable (after return/raise/break)
+            preds = self._stmt(stmt, preds)
+        return preds
+
+    def _stmt(self, stmt: ast.stmt, preds: list[_Pred]) -> list[_Pred]:
+        if isinstance(stmt, ast.If):
+            return self._if(stmt, preds)
+        if isinstance(stmt, (ast.While, ast.For, ast.AsyncFor)):
+            return self._loop(stmt, preds)
+        if isinstance(stmt, ast.Try):
+            return self._try(stmt, preds)
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            return self._with(stmt, preds)
+        if isinstance(stmt, ast.Match):
+            return self._match(stmt, preds)
+        if isinstance(stmt, ast.Return):
+            return self._return(stmt, preds)
+        if isinstance(stmt, ast.Raise):
+            n = self._simple(stmt, preds, route_exc=False)
+            self.edges.append(Edge(n, self._exc_target(), "raise"))
+            return []
+        if isinstance(stmt, ast.Break):
+            return self._jump(stmt, preds, "break")
+        if isinstance(stmt, ast.Continue):
+            return self._jump(stmt, preds, "continue")
+        n = self._simple(stmt, preds)
+        return [(n, "norm")]
+
+    def _simple(self, stmt: ast.stmt, preds: list[_Pred],
+                route_exc: bool | None = None) -> int:
+        n = self._new("stmt", stmt, stmt.lineno)
+        self._connect(preds, n)
+        if route_exc if route_exc is not None else can_raise(stmt):
+            self.edges.append(Edge(n, self._exc_target(), "exc"))
+        return n
+
+    def _if(self, stmt: ast.If, preds: list[_Pred]) -> list[_Pred]:
+        n = self._simple(stmt, preds)
+        t_out = self._block(stmt.body, [(n, "true")])
+        f_out = (self._block(stmt.orelse, [(n, "false")])
+                 if stmt.orelse else [(n, "false")])
+        return t_out + f_out
+
+    def _loop(self, stmt, preds: list[_Pred]) -> list[_Pred]:
+        head = self._simple(stmt, preds)
+        after = self._new("join", None, stmt.lineno)
+        self.loops.append(_Loop(head, after, len(self.frames)))
+        b_out = self._block(stmt.body, [(head, "true")])
+        self.loops.pop()
+        self._connect(b_out, head, "loop")
+        e_out = (self._block(stmt.orelse, [(head, "false")])
+                 if stmt.orelse else [(head, "false")])
+        self._connect(e_out, after, "norm")
+        return [(after, "norm")] if self._has_preds(after) else []
+
+    def _with(self, stmt, preds: list[_Pred]) -> list[_Pred]:
+        n = self._simple(stmt, preds)
+        return self._block(stmt.body, [(n, "norm")])
+
+    def _match(self, stmt: ast.Match, preds: list[_Pred]) -> list[_Pred]:
+        n = self._simple(stmt, preds)
+        outs: list[_Pred] = []
+        for case in stmt.cases:
+            outs += self._block(case.body, [(n, "true")])
+        outs.append((n, "false"))  # no case matched
+        return outs
+
+    def _return(self, stmt: ast.Return, preds: list[_Pred]) -> list[_Pred]:
+        n = self._simple(stmt, preds)
+        self._unwind([(n, "norm")], 0, EXIT, "return")
+        return []
+
+    def _jump(self, stmt, preds: list[_Pred], kind: str) -> list[_Pred]:
+        n = self._simple(stmt, preds, route_exc=False)
+        if not self.loops:
+            return []  # malformed outside a loop; drop the path
+        loop = self.loops[-1]
+        target = loop.after if kind == "break" else loop.head
+        self._unwind([(n, "norm")], loop.depth, target,
+                     "break" if kind == "break" else "loop")
+        return []
+
+    def _unwind(self, p: list[_Pred], down_to: int, target: int,
+                kind: str) -> None:
+        """Route an early exit (return/break/continue) through every
+        enclosing finally between here and frame depth ``down_to``,
+        inlining a fresh copy of each finally body on this path."""
+        saved = self.frames
+        for i in range(len(saved) - 1, down_to - 1, -1):
+            frame = saved[i]
+            if isinstance(frame, _FinallyFrame) and p:
+                self.frames = saved[:i]  # the finally's own exceptions
+                p = self._block(frame.final_body, p)  # go outward
+        self.frames = saved
+        if p:
+            self._connect(p, target, kind)
+
+    # -- try/except/finally --------------------------------------------------
+
+    def _try(self, stmt: ast.Try, preds: list[_Pred]) -> list[_Pred]:
+        fin: _FinallyFrame | None = None
+        if stmt.finalbody:
+            fin = _FinallyFrame(
+                self._new("collect", None, stmt.lineno), stmt.finalbody)
+            self.frames.append(fin)
+
+        hframe: _HandlerFrame | None = None
+        handler_nodes: list[int] = []
+        if stmt.handlers:
+            catch_all = any(
+                h.type is None
+                or (isinstance(h.type, ast.Name)
+                    and h.type.id in ("Exception", "BaseException"))
+                for h in stmt.handlers)
+            handler_nodes = [self._new("handler", h, h.lineno)
+                             for h in stmt.handlers]
+            hframe = _HandlerFrame(
+                self._new("collect", None, stmt.lineno),
+                handler_nodes, catch_all)
+            self.frames.append(hframe)
+
+        body_out = self._block(stmt.body, preds)
+
+        if hframe is not None:
+            self.frames.pop()
+            if self._has_preds(hframe.collector):
+                for h in handler_nodes:
+                    self.edges.append(Edge(hframe.collector, h, "exc"))
+                if not hframe.catch_all:
+                    self.edges.append(Edge(
+                        hframe.collector, self._exc_target(), "exc"))
+
+        # else-clause: runs only when the body did not raise; its own
+        # exceptions skip this try's handlers (outer frames + finally)
+        if stmt.orelse:
+            body_out = self._block(stmt.orelse, body_out)
+
+        handler_out: list[_Pred] = []
+        for h_node, handler in zip(handler_nodes, stmt.handlers):
+            handler_out += self._block(handler.body, [(h_node, "norm")])
+
+        norm_in = body_out + handler_out
+        if fin is not None:
+            self.frames.pop()
+            norm_out = self._block(stmt.finalbody, norm_in)
+            if self._has_preds(fin.collector):
+                # the exception copy: finally runs, then the exception
+                # keeps propagating outward
+                p = self._block(stmt.finalbody, [(fin.collector, "exc")])
+                self._connect(p, self._exc_target(), "exc")
+            return norm_out
+        return norm_in
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one FunctionDef/AsyncFunctionDef (or any body-carrying
+    node). Nested defs/classes are opaque single nodes."""
+    return _Builder(fn).build()
+
+
+# -- worklist solver ---------------------------------------------------------
+
+def solve_forward(cfg: CFG,
+                  gen: dict[int, frozenset],
+                  kill: dict[int, frozenset],
+                  entry_fact: frozenset = frozenset(),
+                  ) -> dict[int, frozenset]:
+    """Forward may-analysis: IN-facts per node, union join.
+
+    Per-EDGE transfer: on a normal edge ``OUT = (IN | GEN) - KILL``; on
+    an exceptional edge GEN is suppressed (``OUT = IN - KILL``) — if
+    the generating statement itself threw, the fact was never created,
+    while a kill (a release) that throws has still killed. Facts only
+    grow over a finite universe, so the fixpoint terminates and is
+    independent of worklist order.
+    """
+    empty: frozenset = frozenset()
+    ins: dict[int, frozenset] = {n.idx: empty for n in cfg.nodes}
+    ins[ENTRY] = entry_fact
+    work: deque[int] = deque(sorted(ins))
+    queued = set(work)
+    while work:
+        i = work.popleft()
+        queued.discard(i)
+        base = ins[i]
+        k = kill.get(i, empty)
+        norm_out = (base | gen.get(i, empty)) - k
+        exc_out = base - k
+        for e in cfg.succ(i):
+            out = exc_out if e.kind in EXC_KINDS else norm_out
+            if not out <= ins[e.dst]:
+                ins[e.dst] = ins[e.dst] | out
+                if e.dst not in queued:
+                    queued.add(e.dst)
+                    work.append(e.dst)
+    return ins
+
+
+def exit_edges(cfg: CFG) -> list[Edge]:
+    """Every edge into the function exit."""
+    return cfg.pred(EXIT)
+
+
+def exit_facts(cfg: CFG, ins: dict[int, frozenset],
+               gen: dict[int, frozenset], kill: dict[int, frozenset],
+               ) -> list[tuple[Edge, frozenset]]:
+    """(edge-into-exit, facts-live-across-it) pairs, recomputing the
+    per-edge transfer so exceptional exits correctly exclude the
+    throwing statement's own GEN."""
+    empty: frozenset = frozenset()
+    out: list[tuple[Edge, frozenset]] = []
+    for e in exit_edges(cfg):
+        base = ins.get(e.src, empty)
+        k = kill.get(e.src, empty)
+        fact = (base - k if e.kind in EXC_KINDS
+                else (base | gen.get(e.src, empty)) - k)
+        out.append((e, fact))
+    return out

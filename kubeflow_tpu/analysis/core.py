@@ -167,7 +167,8 @@ def _load_builtin_rules() -> None:
     # from rule modules without a cycle
     from kubeflow_tpu.analysis import (  # noqa: F401
         rules_collectives, rules_determinism, rules_jax, rules_lockset,
-        rules_net, rules_obs, rules_order, rules_reconcile, rules_sharding,
+        rules_net, rules_obs, rules_order, rules_reconcile, rules_resource,
+        rules_sharding, rules_wire,
     )
 
 

@@ -1,0 +1,489 @@
+"""tpulint resource-lifecycle rules (RES7xx): acquire/release pairing
+over the exception-edge CFG (analysis/cfg.py).
+
+The fleet's worst real bugs were lifecycle leaks on exceptional paths:
+the router shed-race double-enqueue (PR 8) and the KV over-admission
+that ``fail_all``-ed every in-flight request (PR 9) both put an
+acquire and its release on the same happy path and leaked when a
+``raise`` landed between them. The RES family makes the pairing a
+static property, per real resource:
+
+- **RES701** KV pages: ``PageAllocator.admit`` (the COW-copy plan
+  included) must be balanced by ``free``/``reset`` on every path out
+  of the acquiring function, or the admission plan must be handed to
+  an owner.
+- **RES702** router tickets: a ``submit`` that returns a ticket the
+  caller keeps must ``complete``/``fail`` it (or hand it off) on
+  every path — the token-accounting ledger leaks otherwise.
+- **RES703** capacity transactions: every ``CapacityTxn.fork`` must
+  ``commit``/``rollback`` (or escape to an owner); a trial fork
+  dropped on a raise silently diverges the planner's ledger from the
+  parent's.
+- **RES704** detached spans: ``Tracer.begin`` must reach ``finish``
+  (span passed as the argument) or be stored/handed off — a dropped
+  span never exports and orphans its children.
+- **RES705** manual locks: ``.acquire()`` on a lock-ish receiver with
+  ``.release()`` missing on SOME path out — the CFG upgrade of
+  LOCK201's statement-level model (``with`` blocks are inherently
+  balanced and never flagged).
+
+Ownership model (RacerD-flavored, resolution-bounded): the token dies
+when it is released (receiver-paired call, or the bound variable
+passed to a release method — ``tracer.finish(span)``), escapes
+(returned, yielded, stored into an attribute/container), or is handed
+off — passed bare to an unresolvable call (benefit of the doubt) or
+to a program function whose **summary** says it consumes that
+parameter (releases/stores/returns it, a bounded call-graph
+fixpoint). A resolved callee that does NOT consume the argument keeps
+the token live — ``self._log(ticket)`` is not a release. Publishing
+ownership to a keyed table ALSO kills: when the acquire call's first
+bare-Name positional argument is the resource's key (``plan =
+alloc.admit(slot, ...)``), a later ``owners[slot] = ...`` store hands
+the slot to whatever owns that table — the canonical serving-plane
+idiom for transferring a page to the decode batch. Kills apply
+before exception edges (a release that throws has still released);
+the acquire's own exception edge carries no token (if ``admit``
+raised, nothing was admitted).
+
+Findings land on the acquire line; the message names the first
+leaking exit. Fix by releasing in ``finally``/the handler, or by
+handing the token to an owning helper — suppress only with an audited
+justification (HYG004 keeps it honest).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Iterator
+
+from kubeflow_tpu.analysis import cfg
+from kubeflow_tpu.analysis.core import (
+    Finding, ProgramRule, call_name, dotted, register,
+)
+
+# Container-mutator names: passing the token bare into one of these
+# stores it somewhere that outlives the function — ownership transfer.
+_SINKS = {"append", "appendleft", "add", "put", "put_nowait", "push",
+          "heappush", "insert", "setdefault", "extend", "update",
+          "send", "publish", "record", "enqueue"}
+
+_FIXPOINT_CAP = 32
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceSpec:
+    rule: str
+    noun: str                      # human name for the resource
+    classes: frozenset             # receiver class simple names
+    recv_re: re.Pattern            # receiver-name fallback (last part)
+    acquire: frozenset
+    release: frozenset
+    value_bound: bool              # the call RESULT is the handle
+    track_discarded: bool          # flag `recv.acquire(...)` w/o binding
+    hint: str
+
+
+_SPECS = (
+    ResourceSpec(
+        "RES701", "KV page admission",
+        frozenset({"PageAllocator"}), re.compile(r"alloc"),
+        frozenset({"admit"}), frozenset({"free", "reset"}),
+        value_bound=True, track_discarded=True,
+        hint="free the slot (or reset) in a finally/handler, or hand "
+             "the plan to the owner that frees on completion"),
+    ResourceSpec(
+        "RES702", "router ticket",
+        frozenset({"TokenRouter"}), re.compile(r"router"),
+        frozenset({"submit"}), frozenset({"complete", "fail", "shed"}),
+        value_bound=True, track_discarded=False,
+        hint="complete/fail the ticket in a finally/handler, or hand "
+             "it to the queue that owns its lifecycle"),
+    ResourceSpec(
+        "RES703", "capacity transaction fork",
+        frozenset({"CapacityTxn"}), re.compile(r"txn|trial|credits"),
+        frozenset({"fork"}), frozenset({"commit", "rollback"}),
+        value_bound=True, track_discarded=True,
+        hint="commit or rollback the fork on every path (rollback in "
+             "a handler), or return it to the caller that owns it"),
+    ResourceSpec(
+        "RES704", "detached span",
+        frozenset({"Tracer"}), re.compile(r"tracer"),
+        frozenset({"begin"}), frozenset({"finish"}),
+        value_bound=True, track_discarded=True,
+        hint="finish the span in a finally, store it where the "
+             "finisher finds it, or use the tracer.span context "
+             "manager"),
+)
+
+_LOCK_SPEC = ResourceSpec(
+    "RES705", "lock",
+    frozenset(), re.compile(r"lock|mutex|cond|(^|_)(mu|cv)$"),
+    frozenset({"acquire"}), frozenset({"release"}),
+    value_bound=False, track_discarded=True,
+    hint="release in a finally, or use `with` which is inherently "
+         "balanced")
+
+
+@dataclasses.dataclass
+class _Token:
+    tid: int
+    node: int                      # CFG node index of the acquire
+    var: str | None                # bound variable, if any
+    recv: str                      # receiver dotted text ("self.alloc")
+    meth: str
+    line: int
+    col: int
+    key: str | None = None         # first bare-Name positional arg of
+                                   # the acquire call — `t[key] = ...`
+                                   # publishes ownership (kill)
+
+
+# -- per-program caches ------------------------------------------------------
+
+def _cache(program) -> dict:
+    got = getattr(program, "_res_cache", None)
+    if got is None:
+        got = {"cfg": {}, "consumed": None}
+        program._res_cache = got
+    return got
+
+
+def _cfg_for(program, qual: str) -> cfg.CFG:
+    table = _cache(program)["cfg"]
+    if qual not in table:
+        table[qual] = cfg.build_cfg(program.functions[qual].node)
+    return table[qual]
+
+
+# -- consumption summaries ---------------------------------------------------
+
+_ALL_RELEASE = frozenset().union(*(s.release for s in _SPECS),
+                                 _LOCK_SPEC.release)
+
+
+def _bare_args(call: ast.Call) -> list[str]:
+    out = [a.id for a in call.args if isinstance(a, ast.Name)]
+    out += [kw.value.id for kw in call.keywords
+            if isinstance(kw.value, ast.Name)]
+    return out
+
+
+def _param_names(fn: ast.FunctionDef) -> list[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+
+
+def _arg_param_pairs(call: ast.Call, callee_fi) -> Iterator[tuple[str, str]]:
+    """(bare-arg-name, callee-param-name) pairs, positional + keyword.
+    Method calls through a receiver skip the callee's ``self``."""
+    params = _param_names(callee_fi.node)
+    skip = 1 if (callee_fi.owner is not None and params
+                 and params[0] in ("self", "cls")
+                 and isinstance(call.func, ast.Attribute)) else 0
+    for i, a in enumerate(call.args):
+        if isinstance(a, ast.Name) and i + skip < len(params):
+            yield a.id, params[i + skip]
+    for kw in call.keywords:
+        if isinstance(kw.value, ast.Name) and kw.arg in params:
+            yield kw.value.id, kw.arg
+
+
+def _directly_consumed(fi) -> set[str]:
+    """Params this function releases/escapes without looking at
+    callees (the seed facts of the fixpoint)."""
+    params = set(_param_names(fi.node))
+    out: set[str] = set()
+    for node in ast.walk(fi.node):
+        if isinstance(node, (ast.Return, ast.Yield, ast.YieldFrom)):
+            v = node.value
+            if v is not None:
+                out |= {s.id for s in ast.walk(v)
+                        if isinstance(s, ast.Name) and s.id in params}
+        elif isinstance(node, ast.Assign):
+            stored = any(isinstance(t, (ast.Attribute, ast.Subscript))
+                         for t in node.targets)
+            if stored:
+                out |= {s.id for s in ast.walk(node.value)
+                        if isinstance(s, ast.Name) and s.id in params}
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            meth = name.rsplit(".", 1)[-1] if name else ""
+            if meth in _ALL_RELEASE or meth in _SINKS:
+                out |= set(_bare_args(node)) & params
+            if meth in _ALL_RELEASE and name and "." in name:
+                recv = name.rsplit(".", 1)[0]
+                if recv in params:
+                    out.add(recv)  # e.g. def done(txn): txn.commit()
+    return out
+
+
+def _consumed(program) -> dict[str, frozenset]:
+    """qual -> params the function consumes (releases/escapes/hands
+    off), propagated through resolved calls — bounded union fixpoint
+    in the style of ``Program.may_held``."""
+    cache = _cache(program)
+    if cache["consumed"] is not None:
+        return cache["consumed"]
+    consumed: dict[str, set[str]] = {}
+    passes: dict[str, list[tuple[ast.Call, str]]] = {}
+    for qual, fi in program.functions.items():
+        consumed[qual] = _directly_consumed(fi)
+        params = set(_param_names(fi.node))
+        fwd: list[tuple[ast.Call, str]] = []
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call) and (
+                    set(_bare_args(node)) & params):
+                callee = program._resolve_call(node, fi)
+                if callee is None:
+                    # handoff into the unknown: benefit of the doubt
+                    consumed[qual] |= set(_bare_args(node)) & params
+                else:
+                    fwd.append((node, callee))
+        if fwd:
+            passes[qual] = fwd
+    for _ in range(_FIXPOINT_CAP):
+        changed = False
+        for qual, fwd in passes.items():
+            params = set(_param_names(program.functions[qual].node))
+            for call, callee in fwd:
+                sink = consumed.get(callee, set())
+                for arg, param in _arg_param_pairs(
+                        call, program.functions[callee]):
+                    if arg in params and param in sink \
+                            and arg not in consumed[qual]:
+                        consumed[qual].add(arg)
+                        changed = True
+        if not changed:
+            break
+    out = {q: frozenset(s) for q, s in consumed.items()}
+    cache["consumed"] = out
+    return out
+
+
+# -- the engine --------------------------------------------------------------
+
+def _receiver_matches(spec: ResourceSpec, recv: str, fi, program) -> bool:
+    parts = recv.split(".")
+    if parts[0] in fi.param_classes:
+        cq = fi.param_classes[parts[0]]
+        if len(parts) == 1:
+            if cq.rsplit(":", 1)[-1] in spec.classes:
+                return True
+        elif len(parts) == 2:
+            aq = program.classes[cq].attr_classes.get(parts[1])
+            if aq and aq.rsplit(":", 1)[-1] in spec.classes:
+                return True
+    last = parts[-1]
+    return last != "self" and bool(spec.recv_re.search(last))
+
+
+def _acquire_tokens(spec: ResourceSpec, fi, graph: cfg.CFG,
+                    program) -> list[_Token]:
+    tokens: list[_Token] = []
+    for n in graph.stmt_nodes():
+        stmt = n.stmt
+        if isinstance(stmt, ast.Assign):
+            value, targets = stmt.value, stmt.targets
+        elif isinstance(stmt, ast.Expr):
+            value, targets = stmt.value, None
+        else:
+            continue
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in spec.acquire):
+            continue
+        recv = dotted(value.func.value)
+        if recv is None or not _receiver_matches(spec, recv, fi, program):
+            continue
+        var: str | None = None
+        if targets is not None:
+            if len(targets) != 1:
+                continue
+            t = targets[0]
+            if isinstance(t, ast.Name):
+                var = t.id
+            else:
+                continue  # self.x = acquire(): escaped at birth
+        elif spec.value_bound and not spec.track_discarded:
+            continue  # discarded result: the callee owns it
+        key = (value.args[0].id if value.args
+               and isinstance(value.args[0], ast.Name) else None)
+        tokens.append(_Token(len(tokens), n.idx, var, recv,
+                             value.func.attr, stmt.lineno,
+                             stmt.col_offset, key))
+    return tokens
+
+
+def _node_kills(spec: ResourceSpec, tokens: list[_Token],
+                stmt: ast.stmt, node_idx: int, fi, program,
+                consumed: dict[str, frozenset]) -> frozenset:
+    killed: set[int] = set()
+    for t in tokens:
+        if t.tid in killed:
+            continue
+        if _stmt_kills(spec, t, stmt, node_idx, fi, program, consumed):
+            killed.add(t.tid)
+    return frozenset(killed)
+
+
+def _own_exprs(stmt: ast.stmt) -> list[ast.expr]:
+    """The expressions a CFG node itself evaluates. A compound
+    statement's node is its HEADER — a release inside its body belongs
+    to the body's own nodes, never to the branch point (walking the
+    whole ``ast.If`` would kill the token on both arms at once)."""
+    if isinstance(stmt, ast.Raise):
+        return [e for e in (stmt.exc, stmt.cause) if e is not None]
+    if isinstance(stmt, ast.Assert):
+        return [stmt.test] + ([stmt.msg] if stmt.msg is not None else [])
+    return cfg._header_exprs(stmt)
+
+
+def _stmt_kills(spec: ResourceSpec, t: _Token, stmt: ast.stmt,
+                node_idx: int, fi, program,
+                consumed: dict[str, frozenset]) -> bool:
+    for call in (c for root in _own_exprs(stmt)
+                 for c in ast.walk(root)):
+        if not isinstance(call, ast.Call):
+            continue
+        name = call_name(call)
+        meth = name.rsplit(".", 1)[-1] if name else ""
+        recv = name.rsplit(".", 1)[0] if name and "." in name else None
+        bare = _bare_args(call) if spec.value_bound and t.var else []
+        if meth in spec.release and (recv == t.recv
+                                     or (t.var and recv == t.var)
+                                     or (t.var and t.var in bare)):
+            # released: paired with the acquiring receiver, called ON
+            # the handle itself (`trial.commit()`), or the handle is
+            # the bare argument (`tracer.finish(span)`)
+            return True
+        if t.var and t.var in bare:
+            if meth in _SINKS:
+                return True  # stored into a container/queue: escaped
+            callee = program._resolve_call(call, fi)
+            if callee is None:
+                return True  # handoff into the unknown
+            sink = consumed.get(callee, frozenset())
+            for arg, param in _arg_param_pairs(
+                    call, program.functions[callee]):
+                if arg == t.var and param in sink:
+                    return True
+    if t.key and isinstance(stmt, ast.Assign) and any(
+            isinstance(tt, ast.Subscript)
+            and isinstance(tt.slice, ast.Name)
+            and tt.slice.id == t.key
+            for tt in stmt.targets):
+        return True  # `owners[slot] = ...`: ownership published under
+                     # the resource's own key (discarded results too)
+    if not (spec.value_bound and t.var):
+        return False
+    if isinstance(stmt, (ast.Return, ast.Expr)):
+        v = stmt.value
+        if isinstance(v, (ast.Yield, ast.YieldFrom)):
+            v = v.value
+        if isinstance(stmt, ast.Expr) and not isinstance(
+                stmt.value, (ast.Yield, ast.YieldFrom)):
+            v = None
+        if v is not None and any(
+                isinstance(s, ast.Name) and s.id == t.var
+                for s in ast.walk(v)):
+            return True  # returned/yielded: the caller owns it now
+    if isinstance(stmt, ast.Assign):
+        if any(isinstance(tt, (ast.Attribute, ast.Subscript))
+               for tt in stmt.targets) and any(
+                isinstance(s, ast.Name) and s.id == t.var
+                for s in ast.walk(stmt.value)):
+            return True  # stored into an attribute/container: escaped
+        if node_idx != t.node and any(
+                isinstance(tt, ast.Name) and tt.id == t.var
+                for tt in stmt.targets):
+            return True  # rebound: this token's binding is gone
+    return False
+
+
+_EXIT_DESC = {"return": "a return", "end": "the fall-through exit",
+              "exc": "an exception path", "raise": "a raise",
+              "break": "a break", "loop": "a loop back-edge"}
+
+
+def _function_findings(spec: ResourceSpec, program, qual: str,
+                       consumed: dict[str, frozenset]
+                       ) -> Iterator[Finding]:
+    fi = program.functions[qual]
+    graph = _cfg_for(program, qual)
+    tokens = _acquire_tokens(spec, fi, graph, program)
+    if not tokens:
+        return
+    gen: dict[int, frozenset] = {}
+    for t in tokens:
+        gen.setdefault(t.node, frozenset())
+        gen[t.node] = gen[t.node] | {t.tid}
+    kill = {n.idx: _node_kills(spec, tokens, n.stmt, n.idx, fi,
+                               program, consumed)
+            for n in graph.stmt_nodes()}
+    kill = {i: k for i, k in kill.items() if k}
+    ins = cfg.solve_forward(graph, gen, kill)
+    leaks: dict[int, list[tuple[str, int]]] = {}
+    for edge, fact in cfg.exit_facts(graph, ins, gen, kill):
+        for tid in fact:
+            leaks.setdefault(tid, []).append(
+                (edge.kind, graph.nodes[edge.src].line))
+    for t in tokens:
+        exits = leaks.get(t.tid)
+        if not exits:
+            continue
+        exc = sorted(x for x in exits if x[0] in cfg.EXIT_EXC)
+        pick = exc[0] if exc else sorted(exits)[0]
+        kind, line = pick
+        handle = f"`{t.var}`" if t.var else f"the {t.recv}.{t.meth}() result"
+        yield Finding(
+            spec.rule, fi.module.path, t.line, t.col,
+            f"{spec.noun} {handle} acquired by {t.recv}.{t.meth}() can "
+            f"escape unreleased via {_EXIT_DESC.get(kind, kind)} "
+            f"(exit at line {line}): {spec.hint}")
+
+
+def _spec_findings(spec: ResourceSpec, program) -> Iterator[Finding]:
+    consumed = _consumed(program)
+    probes = tuple(f".{m}(" for m in spec.acquire)
+    for qual in sorted(program.functions):
+        fi = program.functions[qual]
+        if not any(p in fi.module.source for p in probes):
+            continue
+        yield from _function_findings(spec, program, qual, consumed)
+
+
+def _make_rule(spec: ResourceSpec, doc: str):
+    @register
+    class _ResourceRule(ProgramRule):
+        id = spec.rule
+        name = f"leaked-{spec.noun.replace(' ', '-')}"
+        short = (f"{spec.noun} can escape unreleased on some path "
+                 "(exception edges included)")
+
+        def check_program(self, program) -> Iterator[Finding]:
+            yield from _spec_findings(spec, program)
+
+    _ResourceRule.__doc__ = doc
+    _ResourceRule.__name__ = f"ResourceLeak{spec.rule}"
+    return _ResourceRule
+
+
+for _spec in _SPECS:
+    _make_rule(_spec, f"{_spec.rule}: {_spec.noun} acquire/release "
+                      "pairing over the exception-edge CFG.")
+
+
+@register
+class LockReleaseSubset(ProgramRule):
+    """RES705: a lock acquired manually and released on only a subset
+    of paths out — the path-sensitive upgrade of LOCK201's statement
+    model. ``with`` blocks never fire (inherently balanced)."""
+
+    id = "RES705"
+    name = "lock-released-on-subset-of-paths"
+    short = "manual .acquire() not matched by .release() on every path"
+
+    def check_program(self, program) -> Iterator[Finding]:
+        yield from _spec_findings(_LOCK_SPEC, program)

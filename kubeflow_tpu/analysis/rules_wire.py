@@ -1,0 +1,228 @@
+"""tpulint wire-contract one-spelling rules (WIRE8xx).
+
+A wire contract is a string two processes must agree on: a
+``*.kubeflow.org/*`` annotation/label key, a ``JAXJOB_*`` /
+``MEGASCALE_*`` / ``TPU_*`` env name, an ``x-request-*`` HTTP header.
+Each has bitten us with two-spellings drift before (the endpoints
+annotation, the MEGASCALE env block, the topology parse all needed AST
+pins to stay single-sourced). The WIRE family makes single-sourcing
+structural: every contract string is a constant defined in exactly one
+OWNING module and imported everywhere else. The ownership map lives
+here, in the rule — so a new contract (the coming ``role`` field, the
+prefix-affinity page-hash header) gets an owner on day one by adding
+one map entry, and any literal spelled outside its owner is flagged at
+the drifting site with the constant to import.
+
+- **WIRE801** annotation/label keys. Exact-key overrides beat domain
+  prefixes (``jaxservice.kubeflow.org/endpoints`` belongs to the
+  router, the rest of the jaxservice domain to its types module).
+  ``apiVersion``-shaped strings (``.../v1alpha1``) are group/version
+  coordinates, not keys, and are exempt. A key in a domain with no
+  declared owner is flagged too: claim it in the map.
+- **WIRE802** env names, full-string matches only (a log template
+  mentioning ``TPU_CHAOS_SEED=%s`` is prose, not a contract site).
+  Prefixes too generic to blanket-own (bare ``TPU_*``) are opt-in:
+  only mapped prefixes are enforced, so an unrelated ALL-CAPS string
+  cannot false-positive.
+- **WIRE803** ``x-request-*`` headers, owned by the serving router.
+
+Inside the owning module the ONE spelling is the module-level constant
+assignment; a second definition, or an inline literal in a function
+body (even in the owner), is flagged — hoist it. Docstrings and bare
+string statements are prose and never flagged. ``kubeflow_tpu/
+analysis/`` itself is exempt: the linter (and the rule tables below)
+must be able to spell the contracts it polices.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from kubeflow_tpu.analysis.core import Finding, Module, Rule, register
+
+# -- the ownership map -------------------------------------------------------
+# suffix-matched module paths; exact keys beat prefixes, longest
+# prefix wins otherwise.
+
+ANNOTATION_KEY_OWNERS = {
+    "jaxservice.kubeflow.org/endpoints": "kubeflow_tpu/serving/router.py",
+}
+ANNOTATION_PREFIX_OWNERS = {
+    "jaxjob.kubeflow.org/": "kubeflow_tpu/control/jaxjob/types.py",
+    "jaxservice.kubeflow.org/": "kubeflow_tpu/control/jaxservice/types.py",
+    "scheduler.kubeflow.org/": "kubeflow_tpu/control/scheduler/__init__.py",
+    "obs.kubeflow.org/": "kubeflow_tpu/obs/trace.py",
+    "studyjob.kubeflow.org/": "kubeflow_tpu/tune/studyjob.py",
+    "notebooks.kubeflow.org/": "kubeflow_tpu/webapps/jwa_flavors.py",
+    "poddefault.admission.kubeflow.org/":
+        "kubeflow_tpu/control/poddefault/webhook.py",
+}
+
+ENV_KEY_OWNERS = {
+    # JAXJOB_-prefixed keys owned away from dist.py: the collectives
+    # backend contract and the preemption grace knob
+    "JAXJOB_COLLECTIVES_BACKEND": "kubeflow_tpu/parallel/backends.py",
+    "JAXJOB_MESH_DCN_AXES": "kubeflow_tpu/parallel/backends.py",
+    "JAXJOB_LOOPBACK_JOIN_TIMEOUT_S": "kubeflow_tpu/parallel/backends.py",
+    "JAXJOB_TERMINATION_GRACE_S": "kubeflow_tpu/runtime/preemption.py",
+}
+ENV_PREFIX_OWNERS = {
+    "JAXJOB_": "kubeflow_tpu/parallel/dist.py",
+    "MEGASCALE_": "kubeflow_tpu/parallel/backends.py",
+    "TPU_CHAOS_": "kubeflow_tpu/control/k8s/chaos.py",
+    "TPU_GOODPUT_": "kubeflow_tpu/obs/goodput.py",
+    "TPU_RACE_": "kubeflow_tpu/analysis/dyntrace.py",
+}
+
+HEADER_PREFIX_OWNERS = {
+    "x-request-": "kubeflow_tpu/serving/router.py",
+}
+
+_ANN_RE = re.compile(
+    r"^[a-z0-9-]+(?:\.[a-z0-9-]+)*\.kubeflow\.org/[A-Za-z0-9._/-]+$")
+_APIVERSION_RE = re.compile(r"/v\d[a-z0-9]*$")  # group/version, not a key
+_ENV_RE = re.compile(r"^(JAXJOB|MEGASCALE|TPU)_[A-Z0-9_]+$")
+_HDR_RE = re.compile(r"^x-request-[a-z0-9-]+$")
+
+_EXEMPT_DIR = "kubeflow_tpu/analysis/"
+
+
+def _norm(path: str) -> str:
+    return path.replace("\\", "/")
+
+
+def _owner_for(value: str, exact: dict[str, str],
+               prefixes: dict[str, str]) -> str | None:
+    got = exact.get(value)
+    if got is not None:
+        return got
+    best = None
+    for prefix, owner in prefixes.items():
+        if value.startswith(prefix) and (best is None
+                                         or len(prefix) > len(best[0])):
+            best = (prefix, owner)
+    return best[1] if best else None
+
+
+def _is_prose(module: Module, node: ast.Constant) -> bool:
+    """Docstrings and bare string statements are prose, not code."""
+    parent = module.parents.get(node)
+    return isinstance(parent, ast.Expr)
+
+
+def _is_module_level_def(module: Module, node: ast.Constant) -> bool:
+    """True when the literal is the RHS of a module-level constant
+    assignment (``KEY = "..."``) — the one allowed definition site."""
+    parent = module.parents.get(node)
+    if not isinstance(parent, ast.Assign) or parent.value is not node:
+        return False
+    if not all(isinstance(t, ast.Name) for t in parent.targets):
+        return False
+    return isinstance(module.parents.get(parent), ast.Module)
+
+
+class _WireRule(Rule):
+    """Shared engine: subclass sets the matcher + ownership maps."""
+
+    exact: dict[str, str] = {}
+    prefixes: dict[str, str] = {}
+    flag_unmapped = False          # no owner declared -> still flag?
+    what = "wire-contract string"
+
+    def matches(self, value: str) -> bool:  # pragma: no cover
+        raise NotImplementedError
+
+    def check(self, module: Module) -> Iterator[Finding]:
+        path = _norm(module.path)
+        if _EXEMPT_DIR in path:
+            return  # the linter may spell the contracts it polices
+        defs: dict[str, int] = {}  # value -> first definition line
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            value = node.value
+            if not self.matches(value) or _is_prose(module, node):
+                continue
+            owner = _owner_for(value, self.exact, self.prefixes)
+            if owner is None:
+                if self.flag_unmapped:
+                    yield self.finding(
+                        module, node,
+                        f"{self.what} \"{value}\" has no declared "
+                        "owner: add its domain to the ownership map "
+                        f"in rules_wire.py ({self.id})")
+                continue
+            if path.endswith(owner):
+                if _is_module_level_def(module, node):
+                    first = defs.setdefault(value, node.lineno)
+                    if first != node.lineno:
+                        yield self.finding(
+                            module, node,
+                            f"duplicate definition of {self.what} "
+                            f"\"{value}\" (first defined at line "
+                            f"{first}): one spelling, one constant")
+                else:
+                    yield self.finding(
+                        module, node,
+                        f"inline {self.what} \"{value}\" in its own "
+                        "owning module: hoist to the module-level "
+                        "constant and use that")
+            else:
+                yield self.finding(
+                    module, node,
+                    f"re-spelled {self.what} \"{value}\": it is owned "
+                    f"by {owner} — import the constant from there "
+                    "(one wire contract, one spelling)")
+
+
+@register
+class AnnotationKeySpelling(_WireRule):
+    """WIRE801: ``*.kubeflow.org/*`` annotation/label keys must be
+    constants in their owning module (see ANNOTATION_*_OWNERS)."""
+
+    id = "WIRE801"
+    name = "annotation-key-respelled"
+    short = "kubeflow.org annotation/label key spelled outside its owner"
+    exact = ANNOTATION_KEY_OWNERS
+    prefixes = ANNOTATION_PREFIX_OWNERS
+    flag_unmapped = True
+    what = "annotation/label key"
+
+    def matches(self, value: str) -> bool:
+        return bool(_ANN_RE.match(value)
+                    and not _APIVERSION_RE.search(value))
+
+
+@register
+class EnvNameSpelling(_WireRule):
+    """WIRE802: JAXJOB_/MEGASCALE_/TPU_ env names must be constants in
+    their owning module (see ENV_*_OWNERS); unmapped prefixes are
+    exempt so generic ALL-CAPS strings cannot false-positive."""
+
+    id = "WIRE802"
+    name = "env-name-respelled"
+    short = "wire env name spelled outside its owning module"
+    exact = ENV_KEY_OWNERS
+    prefixes = ENV_PREFIX_OWNERS
+    what = "env name"
+
+    def matches(self, value: str) -> bool:
+        return bool(_ENV_RE.match(value))
+
+
+@register
+class RequestHeaderSpelling(_WireRule):
+    """WIRE803: ``x-request-*`` headers are the serving router's
+    contract; every other module imports the HEADER_* constants."""
+
+    id = "WIRE803"
+    name = "request-header-respelled"
+    short = "x-request-* header spelled outside serving/router.py"
+    prefixes = HEADER_PREFIX_OWNERS
+    what = "request header"
+
+    def matches(self, value: str) -> bool:
+        return bool(_HDR_RE.match(value))

@@ -42,15 +42,15 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     # seeded fault injector (TPU_CHAOS_SEED picks the schedule) so a
     # whole controller deployment can be soak-tested against apiserver
     # faults without touching the cluster. 0/unset: no wrapper at all.
-    if float(os.environ.get("TPU_CHAOS_RATE", "0") or 0) > 0:
-        from kubeflow_tpu.control.k8s.chaos import ChaosClient
+    from kubeflow_tpu.control.k8s import chaos
 
-        client = ChaosClient(client)
+    if float(os.environ.get(chaos.ENV_RATE, "0") or 0) > 0:
+        client = chaos.ChaosClient(client)
         logging.getLogger("kubeflow_tpu.chaos").warning(
             "chaos fault injection ENABLED for %s (TPU_CHAOS_RATE=%s, "
             "TPU_CHAOS_SEED=%s)", name,
-            os.environ.get("TPU_CHAOS_RATE"),
-            os.environ.get("TPU_CHAOS_SEED", "0"))
+            os.environ.get(chaos.ENV_RATE),
+            os.environ.get(chaos.ENV_SEED, "0"))
 
     ctl = build(client, args)
 
@@ -74,9 +74,9 @@ def run_controller(name: str, build, *, extra_args=None) -> None:  # pragma: no 
     # into the registry its /metrics endpoint serves, so the fleet
     # scrape plane aggregates goodput like any other series.
     # TPU_GOODPUT_CHIPS sizes chip-seconds-lost; 0 disables the loop.
-    from kubeflow_tpu.obs.goodput import GoodputExporter
+    from kubeflow_tpu.obs.goodput import ENV_GOODPUT_CHIPS, GoodputExporter
 
-    goodput_chips = int(os.environ.get("TPU_GOODPUT_CHIPS", "1") or 0)
+    goodput_chips = int(os.environ.get(ENV_GOODPUT_CHIPS, "1") or 0)
     goodput_exporter = None
     if goodput_chips > 0:
         goodput_exporter = GoodputExporter(chips=goodput_chips).start()

@@ -197,11 +197,13 @@ class Controller:
             "workqueue_wait_seconds", wait,
             help_="time a reconcile key spent queued before processing",
             controller=self.name)
+        result = "success"
+        t0 = time.perf_counter()
+        # nothing may sit between begin() and the try whose finally
+        # finishes the span — a raise in that window orphans it (RES704)
         span = self.tracer.begin(
             "reconcile", controller=self.name, namespace=req.namespace,
             object=req.name, attempt=attempt, queue_wait_s=round(wait, 6))
-        result = "success"
-        t0 = time.perf_counter()
         try:
             res = self.reconciler.reconcile(self.client, req)
             with self._cv:

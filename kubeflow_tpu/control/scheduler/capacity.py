@@ -137,21 +137,61 @@ class Capacity:
 
 
 class CapacityTxn:
-    """Copy-on-write what-if placement over a Capacity snapshot."""
+    """Copy-on-write what-if placement over a Capacity snapshot.
 
-    __slots__ = ("cap", "_delta", "_over")
+    Forks form a lifecycle (tpulint RES703): every ``fork()`` must end
+    in exactly one ``commit()`` (replay this trial's net takes onto the
+    parent) or ``rollback()`` (drop them) — a fork abandoned on an
+    exceptional path silently diverges the caller's ledger from what
+    was actually placed, which is precisely the bug shape the
+    exception-edge dataflow rule exists to catch."""
 
-    def __init__(self, cap: Capacity, _delta=None, _over=None):
+    __slots__ = ("cap", "_delta", "_over", "_parent", "_base", "_closed")
+
+    def __init__(self, cap: Capacity, _delta=None, _over=None,
+                 _parent: "CapacityTxn | None" = None):
         self.cap = cap
         self._delta: dict[str, int] = dict(_delta) if _delta else {}
         self._over: dict[tuple | None, Bucket] = \
             {k: b.clone() for k, b in _over.items()} if _over else {}
+        self._parent = _parent
+        # the fork point: commit() replays only shifts made AFTER this
+        self._base: dict[str, int] = dict(self._delta)
+        self._closed = False
 
     def fork(self) -> "CapacityTxn":
         """An independent trial continuing from this txn's state (the
         preemption loop forks once per what-if assignment so cumulative
         victim credits persist while each trial's takes do not)."""
-        return CapacityTxn(self.cap, self._delta, self._over)
+        return CapacityTxn(self.cap, self._delta, self._over,
+                           _parent=self)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def commit(self) -> None:
+        """Replay this fork's net per-node shifts onto its parent and
+        close the fork. The replay goes through the parent's own
+        ``_shift`` so its bucket overlays stay sorted-correct."""
+        if self._parent is None:
+            raise ValueError("commit() on a root txn: root transactions "
+                             "are scratch overlays with nothing to "
+                             "merge into")
+        if self._closed:
+            raise ValueError("commit() on a closed txn")
+        self._closed = True
+        for name, total in self._delta.items():
+            rel = total - self._base.get(name, 0)
+            if rel:
+                self._parent._shift(name, rel)
+
+    def rollback(self) -> None:
+        """Close the fork, discarding its shifts. Idempotence is NOT
+        offered on purpose — a double close is a lifecycle bug."""
+        if self._closed:
+            raise ValueError("rollback() on a closed txn")
+        self._closed = True
 
     def free_of(self, name: str) -> int:
         return self.cap.free.get(name, 0) + self._delta.get(name, 0)

@@ -686,19 +686,22 @@ class GangScheduler(Reconciler):
         for key in candidates:
             trial = txn.fork()
             placed: dict[str, str] = {}
-            for pod, need in zip(ordered, needs):
-                best = trial.best_fit(pod, need, prefer_spot,
-                                      bucket_key=key)
-                if best is None:
-                    placed = {}
-                    break
-                trial.take(best, need)
-                placed[ob.meta(pod)["name"]] = best
-            if placed:
-                # commit: replay the winning takes on the parent txn
+            try:
                 for pod, need in zip(ordered, needs):
-                    txn.take(placed[ob.meta(pod)["name"]], need)
+                    best = trial.best_fit(pod, need, prefer_spot,
+                                          bucket_key=key)
+                    if best is None:
+                        placed = {}
+                        break
+                    trial.take(best, need)
+                    placed[ob.meta(pod)["name"]] = best
+            except Exception:
+                trial.rollback()  # a torn trial must leave no residue
+                raise
+            if placed:
+                trial.commit()  # replay the winning takes on the parent
                 return placed
+            trial.rollback()
         return None
 
     @staticmethod

@@ -295,6 +295,10 @@ class ServingSLO:
 
 # -- the goodput exporter -----------------------------------------------------
 
+# chip count multiplying chip-seconds-lost in exported series; 0/unset
+# disables the export loop entirely (controller managers read this)
+ENV_GOODPUT_CHIPS = "TPU_GOODPUT_CHIPS"
+
 
 class GoodputExporter:
     """Publish the goodput ledger as ``goodput_*`` series.

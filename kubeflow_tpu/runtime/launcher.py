@@ -80,16 +80,16 @@ def run_builtin_trainer(cfg_dict: dict) -> int:
     # The worker span: child of the job root (TRACEPARENT env, stamped
     # by the JAXJob controller) — trainer/step spans nest inside it, so
     # one trace runs from "JAXJob created" to "step done".
+    from kubeflow_tpu.parallel import dist as D
+
     try:
         with obs_trace.TRACER.span(
-                "worker", process=os.environ.get("JAXJOB_PROCESS_ID", ""),
-                job=os.environ.get("JAXJOB_NAME", "")):
+                "worker", process=os.environ.get(D.ENV_PID, ""),
+                job=os.environ.get(D.ENV_NAME, "")):
             cfg = TrainConfig.from_dict(cfg_dict)
             # SIGTERM (pod eviction / TPU maintenance) => checkpoint +
             # EX_TEMPFAIL so the JAXJob controller gang-restarts and resumes.
             notice = PreemptionNotice().install()
-            from kubeflow_tpu.parallel import dist as D
-
             world_file = os.environ.get(D.ENV_WORLD_FILE)
             if world_file:
                 # elastic job: the controller projects its world stamp

@@ -848,9 +848,9 @@ class SlotDecoder:
                 self._carry = item
                 return
             slot = self._free.pop()
-            plan = self.alloc.admit(slot, row, pad, total)
-            suffix = np.asarray(row[plan.compute_start:], np.int32)
             try:
+                plan = self.alloc.admit(slot, row, pad, total)
+                suffix = np.asarray(row[plan.compute_start:], np.int32)
                 with (ctx or contextlib.nullcontext()):
                     if plan.copies:
                         self.state = self._apply_copies(
@@ -862,6 +862,11 @@ class SlotDecoder:
                         jnp.asarray([pad], jnp.int32),
                         jnp.int32(slot), jnp.int32(req))
             except Exception as e:
+                # the slot's PAGES go back before the slot id does —
+                # recycling the slot while the allocator still holds
+                # its admission leaks every page it claimed (tpulint
+                # RES701); free() is a no-op when admit itself raised
+                self.alloc.free(slot)
                 self._free.append(slot)
                 fail_all(e, [item])
                 return

@@ -281,6 +281,10 @@ class Ticket:
     # 504 / 429 / 503 after its done-event fires
     dropped_reason: str | None = None
     retry_after: float | None = None    # rides with "shed_band" drops
+    # terminally resolved (completed, or failed without requeue) — the
+    # shell's last-resort abandon path keys off this so an exception
+    # AFTER resolution never double-resolves the ticket
+    resolved: bool = False
     _dispatched_at: float = 0.0
     _hedge_at: float = 0.0
 
@@ -662,6 +666,7 @@ class TokenRouter:
                     self._queue.insert(0, ticket)
                     self._count_locked("shed")
             else:
+                ticket.resolved = True
                 if queued:
                     self._queue = [t for t in self._queue
                                    if t is not ticket]
@@ -860,6 +865,7 @@ class TokenRouter:
 
     def _finish_locked(self, t: Ticket, now: float,
                        tokens_done: int | None) -> None:
+        t.resolved = True
         member = t.member
         if member is not None:
             bucket = self._inflight.get(member.name)
@@ -1280,6 +1286,16 @@ class RouterFrontend:
                 headers=_retry_after_headers(ticket.retry_after))
         return None
 
+    def _abandon(self, ticket: Ticket) -> None:
+        """Last-resort resolution when the dispatch loop exits on an
+        unexpected exception: a ticket the router already resolved
+        (completed, or dropped with a reason) is left alone; anything
+        else is failed WITHOUT requeue so the replica's in-flight
+        token accounting is released before the error propagates."""
+        if ticket.resolved or ticket.dropped_reason is not None:
+            return
+        self.router.fail(ticket, requeue=False)
+
     def predict(self, req):
         from kubeflow_tpu.utils.httpd import ApiHttpError
 
@@ -1316,72 +1332,81 @@ class RouterFrontend:
             raise ApiHttpError(
                 429, str(e),
                 headers=_retry_after_headers(e.retry_after))
-        last_err: Exception | None = None
-        failures = 0
-        while failures < 3:
-            if ticket.member is None:
-                wait_s = self.dispatch_timeout
-                if deadline is not None:
-                    wait_s = min(
-                        wait_s,
-                        max(deadline - self.router.clock(), 0.0) + 0.05)
-                fired = ticket.done.wait(wait_s)
-                err = self._drop_error(ticket)
-                if err is not None:
-                    raise err
-                if not fired:
-                    self.router.fail(ticket, requeue=False)
+        # every path below must resolve the ticket (complete, or fail
+        # with/without requeue). The blanket handler is the last-resort
+        # resolution for anything unexpected thrown mid-dispatch --
+        # without it the replica's in-flight accounting would hold this
+        # ticket's tokens forever (RES702).
+        try:
+            last_err: Exception | None = None
+            failures = 0
+            while failures < 3:
+                if ticket.member is None:
+                    wait_s = self.dispatch_timeout
+                    if deadline is not None:
+                        wait_s = min(
+                            wait_s,
+                            max(deadline - self.router.clock(), 0.0) + 0.05)
+                    fired = ticket.done.wait(wait_s)
                     err = self._drop_error(ticket)
-                    if err is not None:  # fail() resolved it as a drop
+                    if err is not None:
                         raise err
-                    if deadline is not None \
-                            and self.router.clock() >= deadline:
+                    if not fired:
+                        self.router.fail(ticket, requeue=False)
+                        err = self._drop_error(ticket)
+                        if err is not None:  # fail() resolved it as a drop
+                            raise err
+                        if deadline is not None \
+                                and self.router.clock() >= deadline:
+                            raise ApiHttpError(504, "deadline exceeded")
+                        raise ApiHttpError(503, "no replica capacity")
+                member = ticket.member
+                if member is None:  # shed mid-wait; loop waits again
+                    continue
+                hdrs: dict[str, str] = {}
+                if req.header("traceparent"):
+                    hdrs["traceparent"] = req.header("traceparent")
+                if band != BAND_DEFAULT:
+                    hdrs[HEADER_BAND] = band
+                if deadline is not None:
+                    remaining = deadline - self.router.clock()
+                    if remaining <= 0:
+                        self.router.fail(ticket, requeue=False)
                         raise ApiHttpError(504, "deadline exceeded")
-                    raise ApiHttpError(503, "no replica capacity")
-            member = ticket.member
-            if member is None:  # shed mid-wait; loop waits again
-                continue
-            hdrs: dict[str, str] = {}
-            if req.header("traceparent"):
-                hdrs["traceparent"] = req.header("traceparent")
-            if band != BAND_DEFAULT:
-                hdrs[HEADER_BAND] = band
-            if deadline is not None:
-                remaining = deadline - self.router.clock()
-                if remaining <= 0:
-                    self.router.fail(ticket, requeue=False)
-                    raise ApiHttpError(504, "deadline exceeded")
-                # the budget SHRINKS across retries: each hop sees only
-                # what's left, so a retried request cannot overstay
-                hdrs[HEADER_DEADLINE] = f"{remaining:.3f}"
-            try:
-                delay = (self.router.hedge_delay()
-                         if self.hedging else None)
-                if delay is None:
-                    raw = member.transport.predict(
-                        model, req.body, headers=hdrs or None)
-                    winner = None
-                else:
-                    raw, winner = self._hedged_predict(
-                        ticket, member, model, req.body, hdrs, delay,
-                        deadline)
-            except Exception as e:  # replica died mid-request: retry
-                last_err = e
-                failures += 1
-                self.router.fail(ticket, requeue=True)
-                err = self._drop_error(ticket)
-                if err is not None:  # deadline/budget ended the retries
-                    raise err
-                floor = getattr(e, "retry_after", None) or 0.0
-                backoff = max(
-                    self.retry_backoff_s * (2 ** (failures - 1)), floor)
-                if backoff > 0:
-                    self._sleep(min(backoff, self.retry_backoff_cap_s))
-                continue
-            self.router.complete(ticket, winner=winner)
-            return json.loads(raw)
-        self.router.fail(ticket, requeue=False)
-        raise ApiHttpError(502, f"replica transport failed: {last_err}")
+                    # the budget SHRINKS across retries: each hop sees only
+                    # what's left, so a retried request cannot overstay
+                    hdrs[HEADER_DEADLINE] = f"{remaining:.3f}"
+                try:
+                    delay = (self.router.hedge_delay()
+                             if self.hedging else None)
+                    if delay is None:
+                        raw = member.transport.predict(
+                            model, req.body, headers=hdrs or None)
+                        winner = None
+                    else:
+                        raw, winner = self._hedged_predict(
+                            ticket, member, model, req.body, hdrs, delay,
+                            deadline)
+                except Exception as e:  # replica died mid-request: retry
+                    last_err = e
+                    failures += 1
+                    self.router.fail(ticket, requeue=True)
+                    err = self._drop_error(ticket)
+                    if err is not None:  # deadline/budget ended the retries
+                        raise err
+                    floor = getattr(e, "retry_after", None) or 0.0
+                    backoff = max(
+                        self.retry_backoff_s * (2 ** (failures - 1)), floor)
+                    if backoff > 0:
+                        self._sleep(min(backoff, self.retry_backoff_cap_s))
+                    continue
+                self.router.complete(ticket, winner=winner)
+                return json.loads(raw)
+            self.router.fail(ticket, requeue=False)
+            raise ApiHttpError(502, f"replica transport failed: {last_err}")
+        except BaseException:
+            self._abandon(ticket)
+            raise
 
     def _hedged_predict(self, ticket: Ticket, member: Member, model: str,
                         body: bytes, hdrs: dict, delay: float,

@@ -39,6 +39,7 @@ API_VERSION = f"{GROUP}/{VERSION}"
 KIND = "StudyJob"
 
 ANNO_OBJECTIVE = "studyjob.kubeflow.org/objective-value"
+ANNO_PARAMETERS = "studyjob.kubeflow.org/parameters"
 LABEL_STUDY = "studyjob.kubeflow.org/study-name"
 LABEL_TRIAL = "studyjob.kubeflow.org/trial-id"
 
@@ -316,7 +317,7 @@ class StudyJobReconciler(Reconciler):
                 "namespace": m["namespace"],
                 "labels": {LABEL_STUDY: m["name"], LABEL_TRIAL: str(idx)},
                 "annotations": {
-                    "studyjob.kubeflow.org/parameters": json.dumps(params)},
+                    ANNO_PARAMETERS: json.dumps(params)},
             },
             "spec": tmpl.get("spec", tmpl) or {
                 "replicas": 1,
@@ -353,8 +354,7 @@ class StudyJobReconciler(Reconciler):
                 results.append({
                     "trial": ob.meta(t)["name"],
                     "parameters": json.loads(
-                        ob.annotations_of(t).get(
-                            "studyjob.kubeflow.org/parameters", "{}")),
+                        ob.annotations_of(t).get(ANNO_PARAMETERS, "{}")),
                     "objective": self.collector(t) if succeeded else None,
                 })
             else:

@@ -757,6 +757,10 @@ _SCOPED_RULES = {
     "OBS302",
     "DET601", "DET602", "DET603", "DET604",
     "CTL501", "CTL502", "CTL503", "CTL504",
+    # ISSUE 17: whole-program dataflow + wire-ownership rules — their
+    # corpora pin real package paths (RES_PATH/WIRE_PATH tables below)
+    "RES701", "RES702", "RES703", "RES704", "RES705",
+    "WIRE801", "WIRE802", "WIRE803",
 }
 
 
@@ -2264,3 +2268,643 @@ def test_stale_det_ctl_suppressions_are_flagged():
             return 1  # tpulint: disable=CTL502  nothing fires here
     """)
     assert [f.rule for f in stale_ctl] == ["HYG004"]
+
+
+# ==========================================================================
+# ISSUE 17: RES7xx resource-lifecycle (exception-edge CFG) and WIRE8xx
+# wire-contract one-spelling corpora. RES rules are whole-program and
+# path-insensitive to module location; WIRE corpora sit at a non-owner
+# path so re-spelling fires, with owner-side shapes tested separately.
+# ==========================================================================
+
+RES_PATH = "kubeflow_tpu/serving/_res_corpus.py"
+WIRE_PATH = "kubeflow_tpu/control/_wire_corpus.py"
+
+RES_BAD = {
+    "RES701": [
+        # the motivating shape: a throwing install between admit and
+        # free leaks every claimed page on the exception edge
+        ("""\
+class Decoder:
+    def admit_one(self, slot, row):
+        plan = self.alloc.admit(slot, row, 0, 8)
+        self.install(plan.pages)
+        self.alloc.free(slot)
+""", 3),
+        # the continuous.py bug: the handler recycles the slot id but
+        # never frees the admission's pages
+        ("""\
+class Decoder:
+    def admit_one(self, slot, row, item):
+        plan = self.alloc.admit(slot, row, 0, 8)
+        try:
+            self.install(plan.pages)
+        except Exception as e:
+            self.free_slots.append(slot)
+            self.fail_all(e)
+            return
+        self.owners[slot] = plan
+""", 3),
+    ],
+    "RES702": [
+        # ledger leak: the model call can raise between submit and
+        # complete
+        ("""\
+class Plane:
+    def handle(self, req):
+        t = self.router.submit(req)
+        out = self.model.run(req)
+        self.router.complete(t)
+        return out
+""", 3),
+        # a narrow handler returns without completing OR failing (and
+        # other exception types escape past it entirely)
+        ("""\
+class Plane:
+    def handle(self, req):
+        t = self.router.submit(req)
+        try:
+            out = self.model.run(req)
+        except TimeoutError:
+            return None
+        self.router.complete(t)
+        return out
+""", 3),
+    ],
+    "RES703": [
+        # a take() that raises abandons the fork: the planner ledger
+        # silently diverges from what was placed
+        ("""\
+class Planner:
+    def place(self, txn, pods):
+        trial = txn.fork()
+        for pod in pods:
+            trial.take(pod, 1)
+        trial.commit()
+""", 3),
+        # early return drops the trial with neither commit nor rollback
+        ("""\
+class Planner:
+    def place(self, txn):
+        trial = txn.fork()
+        if self.flag:
+            return None
+        trial.commit()
+""", 3),
+    ],
+    "RES704": [
+        # the runtime.py window: a throwing statement between begin()
+        # and the try whose finally finishes the span orphans it
+        ("""\
+class Loop:
+    def process(self, req):
+        span = self.tracer.begin("work")
+        t0 = self.clock()
+        try:
+            self.handle(req)
+        finally:
+            self.tracer.finish(span)
+""", 3),
+        # early return never finishes: the span never exports
+        ("""\
+class Loop:
+    def process(self, req):
+        span = self.tracer.begin("work")
+        if self.skip:
+            return None
+        self.tracer.finish(span)
+""", 3),
+    ],
+    "RES705": [
+        # released on only one branch: the False path returns holding
+        # the lock
+        ("""\
+class Guard:
+    def tick(self):
+        self.lock.acquire()
+        if self.flag:
+            self.lock.release()
+            return True
+        return False
+""", 3),
+        # a throwing call between acquire and release leaks on the
+        # exception edge (the CFG upgrade over LOCK201's statements)
+        ("""\
+class Guard:
+    def bump(self):
+        self.mu.acquire()
+        self.refresh()
+        self.mu.release()
+""", 3),
+    ],
+}
+
+RES_CLEAN = {
+    "RES701": [
+        # release-in-finally is proven across every continuation
+        """\
+class Decoder:
+    def admit_one(self, slot, row):
+        plan = self.alloc.admit(slot, row, 0, 8)
+        try:
+            self.install(plan.pages)
+        finally:
+            self.alloc.free(slot)
+""",
+        # the fixed continuous.py shape: pages freed in the handler,
+        # ownership published to the keyed table on success
+        """\
+class Decoder:
+    def admit_one(self, slot, row):
+        plan = self.alloc.admit(slot, row, 0, 8)
+        try:
+            self.install(plan.pages)
+        except Exception:
+            self.alloc.free(slot)
+            raise
+        self.owners[slot] = plan
+""",
+        # release-via-helper: the consumption summary proves _hand_off
+        # stores the plan somewhere that outlives the function
+        """\
+class Decoder:
+    def admit_one(self, slot, row):
+        plan = self.alloc.admit(slot, row, 0, 8)
+        self._hand_off(slot, plan)
+
+    def _hand_off(self, slot, plan):
+        self.ring.append(plan)
+""",
+        # discarded result + key-store publication (the bench leak
+        # drill): `live[s] = ...` hands the slot to the table's owner
+        """\
+class Bench:
+    def drill(self, s, row, live):
+        self.alloc.admit(s, row, 0, 8)
+        live[s] = (32, 8)
+""",
+    ],
+    "RES702": [
+        # finally completes or fails on every path out
+        """\
+class Plane:
+    def handle(self, req):
+        t = self.router.submit(req)
+        ok = False
+        try:
+            out = self.model.run(req)
+            ok = True
+        finally:
+            if ok:
+                self.router.complete(t)
+            else:
+                self.router.fail(t)
+        return out
+""",
+        # ticket handed to the owning queue: ownership transferred
+        """\
+class Plane:
+    def handle(self, req):
+        t = self.router.submit(req)
+        self.inflight.put(t)
+""",
+        # discarded ticket: the router owns its own lifecycle
+        """\
+class Plane:
+    def handle(self, req):
+        self.router.submit(req)
+        return self.model.run(req)
+""",
+    ],
+    "RES703": [
+        # the full discipline: rollback in the handler, commit or
+        # rollback on the two normal paths
+        """\
+class Planner:
+    def place(self, txn):
+        trial = txn.fork()
+        try:
+            ok = self.score()
+        except Exception:
+            trial.rollback()
+            raise
+        if ok:
+            trial.commit()
+            return True
+        trial.rollback()
+        return False
+""",
+        # returned to the caller, which owns it now
+        """\
+class Planner:
+    def begin(self, txn):
+        trial = txn.fork()
+        return trial
+""",
+        # closed by a helper the consumption summary resolves
+        """\
+class Planner:
+    def place(self, txn):
+        trial = txn.fork()
+        self._close(trial)
+
+    def _close(self, trial):
+        trial.commit()
+""",
+    ],
+    "RES704": [
+        # begin -> try/finally finish, nothing in the window
+        """\
+class Loop:
+    def process(self, req):
+        span = self.tracer.begin("work")
+        try:
+            self.handle(req)
+        finally:
+            self.tracer.finish(span)
+""",
+        # stored where the finisher finds it: escaped to an owner
+        """\
+class Loop:
+    def start(self, key):
+        span = self.tracer.begin("work")
+        self.open_spans[key] = span
+""",
+        # the context manager is not a detached begin at all
+        """\
+class Loop:
+    def process(self, req):
+        with self.tracer.span("work"):
+            self.handle(req)
+""",
+    ],
+    "RES705": [
+        # release in finally covers the exception edge
+        """\
+class Guard:
+    def tick(self):
+        self.lock.acquire()
+        try:
+            self.mutate()
+        finally:
+            self.lock.release()
+""",
+        # `with` is inherently balanced and never tokenized
+        """\
+class Guard:
+    def tick(self):
+        with self.lock:
+            self.mutate()
+""",
+        # released on BOTH branches (no throwing statement while held)
+        """\
+class Guard:
+    def tick(self):
+        self.lock.acquire()
+        if self.flag:
+            self.lock.release()
+            return True
+        self.lock.release()
+        return False
+""",
+    ],
+}
+
+WIRE_BAD = {
+    "WIRE801": [
+        # domain-prefix ownership: the jaxjob domain belongs to its
+        # types module, even for a module-level constant elsewhere
+        ("""\
+GANG = "jaxjob.kubeflow.org/replica-type"
+""", 1),
+        # inline key at a use site outside the owner
+        ("""\
+def stamp(meta):
+    meta["obs.kubeflow.org/traceparent"] = "00-1"
+    return meta
+""", 2),
+        # a key in a domain nobody claimed must be claimed in the map
+        ("""\
+KNOB = "mystery.kubeflow.org/knob"
+""", 1),
+    ],
+    "WIRE802": [
+        # env read through a re-spelled literal
+        ("""\
+import os
+
+ADDR = os.environ.get("JAXJOB_COORDINATOR_ADDRESS", "")
+""", 3),
+        # constant re-defined outside the owning module
+        ("""\
+RATE = "TPU_CHAOS_RATE"
+""", 1),
+    ],
+    "WIRE803": [
+        ("""\
+DEADLINE = "x-request-deadline"
+""", 1),
+        ("""\
+def tag(h):
+    h["x-request-hedge"] = "1"
+    return h
+""", 2),
+    ],
+}
+
+WIRE_CLEAN = {
+    "WIRE801": [
+        # group/version coordinates are not annotation keys
+        """\
+API_VERSION = "scheduler.kubeflow.org/v1alpha1"
+""",
+        # a bare string statement is prose, not a contract site
+        """\
+def doc():
+    "jaxjob.kubeflow.org/replica-type"
+    return None
+""",
+        # non-kubeflow domains are out of scope
+        """\
+KEY = "config.example.com/key"
+""",
+    ],
+    "WIRE802": [
+        # unmapped prefixes are opt-in: bare TPU_* stays unclaimed
+        """\
+KNOB = "TPU_CUSTOM_KNOB"
+""",
+        # log templates are not full-string matches
+        """\
+MSG = "TPU_CHAOS_SEED=%s"
+""",
+        # lowercase strings are not env names
+        """\
+name = "jaxjob_process_id"
+""",
+    ],
+    "WIRE803": [
+        # a format template is not a header literal
+        """\
+PAT = "x-request-%s"
+""",
+        # near-miss header outside the x-request- namespace
+        """\
+H = "x-requested-with"
+""",
+        # prose mention
+        """\
+def doc():
+    "x-request-deadline"
+    return None
+""",
+    ],
+}
+
+
+def _issue17_bad_cases():
+    cases = [(rule, src, line, RES_PATH)
+             for rule, cs in sorted(RES_BAD.items()) for src, line in cs]
+    cases += [(rule, src, line, WIRE_PATH)
+              for rule, cs in sorted(WIRE_BAD.items()) for src, line in cs]
+    return cases
+
+
+def _issue17_clean_cases():
+    cases = [(rule, src, RES_PATH)
+             for rule, cs in sorted(RES_CLEAN.items()) for src in cs]
+    cases += [(rule, src, WIRE_PATH)
+              for rule, cs in sorted(WIRE_CLEAN.items()) for src in cs]
+    return cases
+
+
+@pytest.mark.parametrize("rule,src,line,path", _issue17_bad_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("RES", "WIRE")) else None)
+def test_res_wire_rule_fires_with_id_and_line(rule, src, line, path):
+    findings = _scan_at(path, src)
+    hits = [f for f in findings if f.rule == rule]
+    assert hits, f"{rule} did not fire; got {[f.render() for f in findings]}"
+    assert line in [f.line for f in hits], (
+        f"{rule} fired at {[f.line for f in hits]}, expected line {line}")
+
+
+@pytest.mark.parametrize("rule,src,path", _issue17_clean_cases(),
+                         ids=lambda v: v if isinstance(v, str) and
+                         v.startswith(("RES", "WIRE")) else None)
+def test_res_wire_clean_fragment_stays_clean(rule, src, path):
+    findings = [f for f in _scan_at(path, src) if f.rule == rule]
+    assert not findings, [f.render() for f in findings]
+
+
+def test_res_wire_corpus_floor():
+    """The ISSUE 17 coverage floor: every RES/WIRE rule carries >= 2
+    bad pins and >= 3 clean FP pins."""
+    assert set(RES_BAD) == set(RES_CLEAN) == {
+        "RES701", "RES702", "RES703", "RES704", "RES705"}
+    assert set(WIRE_BAD) == set(WIRE_CLEAN) == {
+        "WIRE801", "WIRE802", "WIRE803"}
+    for table in (RES_BAD, WIRE_BAD):
+        for rule, cases in table.items():
+            assert len(cases) >= 2, f"{rule}: need >= 2 bad pins"
+    for table in (RES_CLEAN, WIRE_CLEAN):
+        for rule, cases in table.items():
+            assert len(cases) >= 3, f"{rule}: need >= 3 clean pins"
+
+
+def test_res701_leak_message_names_the_exception_exit():
+    findings = [f for f in _scan_at(RES_PATH, RES_BAD["RES701"][0][0])
+                if f.rule == "RES701"]
+    assert len(findings) == 1
+    assert "exception path" in findings[0].message
+    assert "free the slot" in findings[0].message
+
+
+def test_res_release_via_unresolved_call_gets_benefit_of_doubt():
+    """A token passed bare to a call the program cannot resolve is a
+    handoff, not a leak — cross-module noise stays impossible."""
+    findings = _scan_at(RES_PATH, """\
+        from somewhere import publish
+
+
+        class Plane:
+            def handle(self, req):
+                t = self.router.submit(req)
+                publish(t)
+    """)
+    assert [f for f in findings if f.rule == "RES702"] == []
+
+
+def test_res_resolved_nonconsuming_callee_keeps_token_live():
+    """The flip side: a resolved helper that only LOOKS at the token
+    does not count as a release."""
+    findings = _scan_at(RES_PATH, """\
+        class Plane:
+            def handle(self, req):
+                t = self.router.submit(req)
+                self._log(t)
+
+            def _log(self, t):
+                self.n += 1
+    """)
+    hits = [f for f in findings if f.rule == "RES702"]
+    assert [(f.line,) for f in hits] == [(3,)]
+
+
+def test_wire_exact_key_override_beats_domain_prefix():
+    """jaxservice.kubeflow.org/endpoints belongs to the serving router
+    even though the jaxservice domain belongs to its types module."""
+    hits = [f for f in _scan_at(WIRE_PATH, """\
+        ENDPOINTS = "jaxservice.kubeflow.org/endpoints"
+    """) if f.rule == "WIRE801"]
+    assert len(hits) == 1
+    assert "kubeflow_tpu/serving/router.py" in hits[0].message
+
+
+def test_wire_inline_literal_in_owner_module_is_flagged():
+    hits = [f for f in _scan_at("kubeflow_tpu/tune/studyjob.py", """\
+        def annotate(meta):
+            meta["studyjob.kubeflow.org/parameters"] = "{}"
+            return meta
+    """) if f.rule == "WIRE801"]
+    assert [f.line for f in hits] == [2]
+    assert "owning module" in hits[0].message
+
+
+def test_wire_duplicate_definition_in_owner_is_flagged():
+    hits = [f for f in _scan_at("kubeflow_tpu/control/k8s/chaos.py", """\
+        ENV_SEED = "TPU_CHAOS_SEED"
+        ENV_SEED2 = "TPU_CHAOS_SEED"
+    """) if f.rule == "WIRE802"]
+    assert [f.line for f in hits] == [2]
+    assert "duplicate definition" in hits[0].message
+
+
+def test_wire_owner_definition_site_is_clean():
+    findings = _scan_at("kubeflow_tpu/control/k8s/chaos.py", """\
+        ENV_SEED = "TPU_CHAOS_SEED"
+        ENV_RATE = "TPU_CHAOS_RATE"
+    """)
+    assert [f for f in findings if f.rule.startswith("WIRE")] == []
+
+
+def test_wire_analysis_package_is_exempt():
+    findings = _scan_at("kubeflow_tpu/analysis/_frag.py", """\
+        OWNERS = {"jaxjob.kubeflow.org/replica-type": "somewhere"}
+    """)
+    assert [f for f in findings if f.rule.startswith("WIRE")] == []
+
+
+# -- the per-family real-tree gates (ISSUE 17 acceptance) --------------------
+
+
+RES_IDS = {"RES701", "RES702", "RES703", "RES704", "RES705"}
+WIRE_IDS = {"WIRE801", "WIRE802", "WIRE803"}
+
+
+def test_resource_family_clean_on_real_tree():
+    """Every in-tree RES true positive is fixed (not suppressed): the
+    family scan of the shipped package is empty."""
+    from kubeflow_tpu.analysis import scan_paths
+
+    findings = scan_paths([str(PACKAGE)], select=RES_IDS)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_wire_family_clean_on_real_tree():
+    from kubeflow_tpu.analysis import scan_paths
+
+    findings = scan_paths([str(PACKAGE)], select=WIRE_IDS)
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_family_prefix_expansion_selects_whole_families(capsys):
+    """--rules RES,WIRE expands to every registered RES7xx/WIRE8xx id
+    (the ISSUE 17 CLI contract) and runs clean on the shipped tree."""
+    assert tpulint_main(["--rules", "RES,WIRE", str(PACKAGE)]) == 0
+    capsys.readouterr()
+
+
+def test_cli_family_prefix_expansion_fires_on_corpus(tmp_path, capsys):
+    p = tmp_path / "leak.py"
+    p.write_text(RES_BAD["RES701"][0][0])
+    assert tpulint_main(["--rules", "RES", str(p)]) == 1
+    out = capsys.readouterr().out
+    assert "RES701" in out
+    # an unknown family token still fails fast as an unknown id
+    assert tpulint_main(["--rules", "ZZZ", str(p)]) == 2
+    assert "unknown rule id: ZZZ" in capsys.readouterr().err
+
+
+def test_cli_sarif_file_writes_artifact_alongside_stdout(tmp_path, capsys):
+    """--sarif-file emits a parseable SARIF artifact while stdout keeps
+    the selected format (the lint_all.sh --sarif-dir contract)."""
+    src = tmp_path / "leak.py"
+    src.write_text(RES_BAD["RES701"][0][0])
+    artifact = tmp_path / "out.sarif"
+    rc = tpulint_main(["--rules", "RES",
+                       "--sarif-file", str(artifact), str(src)])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "RES701" in out and not out.lstrip().startswith("{")
+    doc = json.loads(artifact.read_text())
+    assert {r["ruleId"] for r in doc["runs"][0]["results"]} == {"RES701"}
+
+
+def test_parallel_res_wire_scan_is_byte_identical(tmp_path, capsys):
+    """The --jobs output law extends to the new families: program-rule
+    (RES, CFG dataflow) and file-rule (WIRE) findings from a fork-pool
+    scan are byte-identical to the serial run."""
+    corpus = {
+        "serving/decode.py": RES_BAD["RES701"][0][0],
+        "serving/plane.py": RES_BAD["RES702"][1][0],
+        "control/planner.py": RES_BAD["RES703"][0][0],
+        "control/loop.py": RES_BAD["RES704"][0][0],
+        "control/guard.py": RES_BAD["RES705"][0][0],
+        "control/keys.py": WIRE_BAD["WIRE801"][0][0],
+        "control/envs.py": WIRE_BAD["WIRE802"][0][0],
+        "serving/headers.py": WIRE_BAD["WIRE803"][0][0],
+        "serving/clean.py": RES_CLEAN["RES701"][0],
+    }
+    for rel, src in corpus.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+
+    from kubeflow_tpu.analysis import scan_paths
+
+    serial = scan_paths([str(tmp_path)], select=RES_IDS | WIRE_IDS)
+    par = scan_paths([str(tmp_path)], select=RES_IDS | WIRE_IDS, jobs=4)
+    assert par == serial
+    assert {f.rule for f in serial} == {
+        "RES701", "RES702", "RES703", "RES704", "RES705",
+        "WIRE801", "WIRE802", "WIRE803"}
+
+    rc_serial = tpulint_main(["--rules", "RES,WIRE", "--json",
+                              str(tmp_path)])
+    out_serial = capsys.readouterr().out
+    rc_par = tpulint_main(["--rules", "RES,WIRE", "--jobs", "4",
+                           "--json", str(tmp_path)])
+    out_par = capsys.readouterr().out
+    assert rc_serial == rc_par == 1
+    assert out_par == out_serial
+
+
+def test_stale_res_suppressions_are_flagged():
+    """HYG004 extends to the RES family: an orphaned disable goes
+    stale, a live pin is honored."""
+    stale = _scan_at(RES_PATH, """\
+        def quiet():
+            return 1  # tpulint: disable=RES701  nothing fires here
+    """)
+    assert [f.rule for f in stale] == ["HYG004"]
+    assert "RES701 does not fire" in stale[0].message
+
+    live = _scan_at(RES_PATH, """\
+        class Guard:
+            def bump(self):
+                self.mu.acquire()  # tpulint: disable=RES705  corpus pin
+                self.refresh()
+                self.mu.release()
+    """)
+    assert live == [], [f.render() for f in live]

@@ -4,7 +4,10 @@
 # over the package and round tooling, plus the stdlib hygiene gates
 # (parse / debugger hooks / conflict markers, yaml manifests) over
 # everything that ships — tests and examples ride only the hygiene
-# gates, mirroring the pytest lint tier.
+# gates, mirroring the pytest lint tier. Pass 4 is the exception-path
+# dataflow tier (ISSUE 17): RES7xx resource-lifecycle + WIRE8xx
+# wire-contract rules over the shipped tree (tests intentionally
+# re-spell wire literals to pin the contract, so they stay out).
 #
 #   tools/lint_all.sh            # gate: exit nonzero on ANY finding
 #   tools/lint_all.sh --json     # write tools/lint_baseline.json
@@ -15,6 +18,10 @@
 #                                # obs / mslice / heal --check) and fail
 #                                # on fingerprint/op-count drift
 #
+# --sarif-dir DIR (before the mode argument) writes one SARIF artifact
+# per pass into DIR — CI uploads them to code scanning without running
+# a second scan per format.
+#
 # The ratchet (ISSUE 2 satellite) lets a rule tighten without a
 # flag-day: commit today's findings with --json, gate on --diff, and
 # burn the baseline down over time. An empty baseline makes --diff
@@ -24,13 +31,26 @@ cd "$(dirname "$0")/.."
 
 PY=${PYTHON:-python}
 BASELINE=tools/lint_baseline.json
-# pass 1 shards across a fork pool (tpulint --jobs); serial and parallel
-# output are byte-identical (pinned by tests/test_tpulint.py), so CI can
-# scale this with core count. Override with TPULINT_JOBS=1 to force the
-# serial path. On a 1-core box $(nproc) = 1 IS the serial path — the
-# >= 2x pass-1 speedup shows up on multi-core runners, and the per-pass
-# wall times printed below are the CI log evidence either way.
+# passes 1-4 shard across a fork pool (tpulint --jobs); serial and
+# parallel output are byte-identical (pinned by tests/test_tpulint.py),
+# so CI can scale this with core count. Override with TPULINT_JOBS=1 to
+# force the serial path. On a 1-core box $(nproc) = 1 IS the serial
+# path — the >= 2x pass-1 speedup shows up on multi-core runners, and
+# the per-pass wall times printed below are the CI log evidence either
+# way.
 JOBS=${TPULINT_JOBS:-$(nproc)}
+
+SARIF_DIR=""
+if [ "${1:-}" = "--sarif-dir" ]; then
+    SARIF_DIR=${2:?"--sarif-dir needs a directory"}
+    mkdir -p "$SARIF_DIR"
+    shift 2
+fi
+sarif() {  # sarif <pass-label> — emit --sarif-file args when requested
+    if [ -n "$SARIF_DIR" ]; then
+        printf -- '--sarif-file\n%s/%s.sarif\n' "$SARIF_DIR" "$1"
+    fi
+}
 
 t0=$SECONDS
 pass_done() {  # pass_done <label> — print the wall time of the pass
@@ -50,39 +70,55 @@ HYG_PATHS=(kubeflow_tpu tools tests examples bench.py __graft_entry__.py)
 # perf_counter discipline the package does (pass 1 already covers the
 # package + tools)
 OBS_PATHS=(tests)
+# pass 4: exception-path dataflow (RES) + wire-contract spelling (WIRE)
+# over the shipped tree only — tests re-spell wire literals on purpose
+# (a test importing the constant could never catch the constant
+# drifting) and exercise leak shapes as fixtures
+RES_PATHS=("${RULE_PATHS[@]}")
 
 case "${1:-gate}" in
 gate)
-    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" "${RULE_PATHS[@]}"
+    mapfile -t S1 < <(sarif pass1)
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" "${S1[@]}" \
+        "${RULE_PATHS[@]}"
     pass_done "pass 1 (tpulint rules, --jobs $JOBS)"
-    "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
-        "${HYG_PATHS[@]}"
-    pass_done "pass 2 (hygiene)"
-    "$PY" -m kubeflow_tpu.analysis --select OBS301 "${OBS_PATHS[@]}"
-    pass_done "pass 3 (OBS over tests)"
+    mapfile -t S2 < <(sarif pass2)
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" \
+        --select HYG001,HYG002,HYG003 "${S2[@]}" "${HYG_PATHS[@]}"
+    pass_done "pass 2 (hygiene, --jobs $JOBS)"
+    mapfile -t S3 < <(sarif pass3)
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" --select OBS301 \
+        "${S3[@]}" "${OBS_PATHS[@]}"
+    pass_done "pass 3 (OBS over tests, --jobs $JOBS)"
+    mapfile -t S4 < <(sarif pass4)
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" --rules RES,WIRE \
+        "${S4[@]}" "${RES_PATHS[@]}"
+    pass_done "pass 4 (RES/WIRE dataflow, --jobs $JOBS)"
     echo "lint_all: all passes clean in ${SECONDS}s total"
     ;;
 --json)
-    tmp1=$(mktemp) && tmp2=$(mktemp) && tmp3=$(mktemp)
-    trap 'rm -f "$tmp1" "$tmp2" "$tmp3"' EXIT
+    tmp1=$(mktemp) && tmp2=$(mktemp) && tmp3=$(mktemp) && tmp4=$(mktemp)
+    trap 'rm -f "$tmp1" "$tmp2" "$tmp3" "$tmp4"' EXIT
     "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" --write-baseline "$tmp1" \
         "${RULE_PATHS[@]}" >/dev/null
     "$PY" -m kubeflow_tpu.analysis --select HYG001,HYG002,HYG003 \
         --write-baseline "$tmp2" "${HYG_PATHS[@]}" >/dev/null
     "$PY" -m kubeflow_tpu.analysis --select OBS301 \
         --write-baseline "$tmp3" "${OBS_PATHS[@]}" >/dev/null
-    "$PY" - "$tmp1" "$tmp2" "$tmp3" "$BASELINE" <<'EOF'
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" --rules RES,WIRE \
+        --write-baseline "$tmp4" "${RES_PATHS[@]}" >/dev/null
+    "$PY" - "$tmp1" "$tmp2" "$tmp3" "$tmp4" "$BASELINE" <<'EOF'
 import json
 import sys
 
 findings = []
-for path in sys.argv[1:4]:
+for path in sys.argv[1:5]:
     with open(path) as fh:
         findings.extend(json.load(fh)["findings"])
-with open(sys.argv[4], "w") as fh:
+with open(sys.argv[5], "w") as fh:
     json.dump({"version": 1, "findings": sorted(findings)}, fh, indent=2)
     fh.write("\n")
-print(f"lint_all: baseline written to {sys.argv[4]} "
+print(f"lint_all: baseline written to {sys.argv[5]} "
       f"({len(findings)} findings)")
 EOF
     ;;
@@ -98,6 +134,8 @@ EOF
         --baseline "$BASELINE" "${HYG_PATHS[@]}" || rc=1
     "$PY" -m kubeflow_tpu.analysis --select OBS301 \
         --baseline "$BASELINE" "${OBS_PATHS[@]}" || rc=1
+    "$PY" -m kubeflow_tpu.analysis --jobs "$JOBS" --rules RES,WIRE \
+        --baseline "$BASELINE" "${RES_PATHS[@]}" || rc=1
     exit $rc
     ;;
 --bench)
@@ -120,7 +158,7 @@ EOF
     exit $rc
     ;;
 *)
-    echo "usage: tools/lint_all.sh [--json|--diff|--bench]" >&2
+    echo "usage: tools/lint_all.sh [--sarif-dir DIR] [--json|--diff|--bench]" >&2
     exit 2
     ;;
 esac
