@@ -76,6 +76,11 @@ ENV_PREFIX_OWNERS = {
     "TPU_RACE_": "kubeflow_tpu/analysis/dyntrace.py",
 }
 
+HEADER_KEY_OWNERS = {
+    # the chargeback attribution header (HEADER_TENANT): spelled once,
+    # next to the TENANT_RE validator that gates it
+    "x-request-tenant": "kubeflow_tpu/serving/router.py",
+}
 HEADER_PREFIX_OWNERS = {
     "x-request-": "kubeflow_tpu/serving/router.py",
 }
@@ -221,6 +226,7 @@ class RequestHeaderSpelling(_WireRule):
     id = "WIRE803"
     name = "request-header-respelled"
     short = "x-request-* header spelled outside serving/router.py"
+    exact = HEADER_KEY_OWNERS
     prefixes = HEADER_PREFIX_OWNERS
     what = "request header"
 

@@ -38,6 +38,7 @@ from kubeflow_tpu.control.scheduler import (
 from kubeflow_tpu.parallel.dist import WorldSpec
 from kubeflow_tpu.control.scheduler.topology import parse_topology
 from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.runtime.metrics import REGISTRY
 
 log = logging.getLogger("kubeflow_tpu.jaxjob")
 
@@ -189,8 +190,14 @@ def job_world(job: dict) -> WorldSpec:
 
 
 class JAXJobReconciler(Reconciler):
-    def __init__(self, record_events: bool = True, cache=None):
+    def __init__(self, record_events: bool = True, cache=None,
+                 registry=None):
         self.record_events = record_events
+        # MetricsRegistry sink for the tenant-attributed lifecycle
+        # counters (restarts/resizes by namespace) — the prometheus
+        # families above stay fleet-global (labelnames are frozen at
+        # first creation, process-wide)
+        self.registry = registry if registry is not None else REGISTRY
         # indexed ClusterCache (ISSUE 7, wired here per ROADMAP #3's
         # remaining item): pod and node reads come from O(bucket)
         # snapshot indexes instead of per-reconcile list calls. None =
@@ -1051,8 +1058,19 @@ class JAXJobReconciler(Reconciler):
             # increment
             client.update_status(job)
             gang_resizes().labels(direction=direction).inc()
+            ns = ob.meta(job).get("namespace") or "default"
+            self.registry.counter_inc(
+                "jaxjob_resizes_total",
+                help_="elastic gang resizes "
+                      "(shrink-to-survivors / grow-back)",
+                namespace=ns, tenant=ns, direction=direction)
             if slices_changed:
                 slice_resizes().labels(direction=direction).inc()
+                self.registry.counter_inc(
+                    "jaxjob_slice_resizes_total",
+                    help_="whole-slice elastic resizes (slice-loss "
+                          "shrink / slice-readmission grow)",
+                    namespace=ns, tenant=ns, direction=direction)
             if self.record_events:
                 client.record_event(
                     job,
@@ -1157,6 +1175,11 @@ class JAXJobReconciler(Reconciler):
         # re-enters from the original counters, still one increment
         client.update_status(job)
         gang_restarts().inc()
+        ns = m.get("namespace") or "default"
+        self.registry.counter_inc(
+            "jaxjob_gang_restart_total",
+            help_="gang restarts performed",
+            namespace=ns, tenant=ns)
         if self.record_events:
             client.record_event(job, "GangRestart", message, "Warning")
         for p in pods:
@@ -1213,7 +1236,8 @@ def build_controller(client, record_events: bool = True,
         from kubeflow_tpu.control.cache import ClusterCache
 
         cluster_cache = ClusterCache(client).connect()
-    rec = JAXJobReconciler(record_events=record_events, cache=cluster_cache)
+    rec = JAXJobReconciler(record_events=record_events, cache=cluster_cache,
+                           registry=registry)
     ctl = Controller("jaxjob", client, rec, registry=registry)
     if cluster_cache is not None:
         ctl.uses(cluster_cache)

@@ -383,7 +383,7 @@ class JAXServiceReconciler(Reconciler):
                 "jaxservice_scale_total",
                 help_="autoscaler target moves by direction",
                 namespace=req.namespace, service=req.name,
-                direction=direction)
+                tenant=req.namespace, direction=direction)
             if self.record_events:
                 client.record_event(
                     svc, "ScaledUp" if direction == "up" else "ScaledDown",
@@ -445,7 +445,8 @@ class JAXServiceReconciler(Reconciler):
             self.registry.counter_inc(
                 "jaxservice_replica_restarts_total", by=float(restarted),
                 help_="replicas reaped and re-provisioned after dying",
-                namespace=req.namespace, service=req.name)
+                namespace=req.namespace, service=req.name,
+                tenant=req.namespace)
             if self.record_events:
                 client.record_event(
                     svc, "ReplicaRestarted",

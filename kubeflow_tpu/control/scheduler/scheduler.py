@@ -308,7 +308,7 @@ class GangScheduler(Reconciler):
                         "scheduler_requeues_total",
                         help_="gang admission attempts that failed and "
                               "backed off",
-                        namespace=entry.namespace)
+                        namespace=entry.namespace, tenant=entry.namespace)
                     continue
                 # blocked: the namespace head holds its queue; on a
                 # genuine capacity failure (never on a gang still being
@@ -332,7 +332,7 @@ class GangScheduler(Reconciler):
                     "scheduler_requeues_total",
                     help_="gang admission attempts that failed and "
                           "backed off",
-                    namespace=entry.namespace)
+                    namespace=entry.namespace, tenant=entry.namespace)
                 break
         if delays:
             return min(delays)
@@ -817,10 +817,12 @@ class GangScheduler(Reconciler):
         self.registry.histogram(
             "scheduler_bind_latency_seconds", latency,
             help_="queue-to-bound gang latency",
-            buckets=BIND_LATENCY_BUCKETS)
+            buckets=BIND_LATENCY_BUCKETS,
+            namespace=entry.namespace, tenant=entry.namespace)
         self.registry.counter_inc(
             "scheduler_gangs_admitted_total",
-            help_="gangs fully bound", namespace=entry.namespace)
+            help_="gangs fully bound", namespace=entry.namespace,
+            tenant=entry.namespace)
         if self.record_events and hasattr(client, "record_event"):
             # the bind-phase patch responses already carry everything an
             # involvedObject needs — no per-pod re-GET on the hot pass
@@ -995,7 +997,7 @@ class GangScheduler(Reconciler):
             self.registry.counter_inc(
                 "scheduler_preemptions_total",
                 help_="gangs evicted for a higher-priority gang",
-                namespace=ns)
+                namespace=ns, tenant=ns)
             if self.record_events and hasattr(client, "record_event") \
                     and gang_pods:
                 client.record_event(gang_pods[0], "GangPreempted", message,
@@ -1007,7 +1009,8 @@ class GangScheduler(Reconciler):
         for ns, depth in self.queue.depths().items():
             self.registry.gauge(
                 "scheduler_queue_depth", depth,
-                help_="gangs queued awaiting admission", namespace=ns)
+                help_="gangs queued awaiting admission", namespace=ns,
+                tenant=ns)
         if self.cache is None:
             return
         helps = {

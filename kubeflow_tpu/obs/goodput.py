@@ -212,6 +212,108 @@ def job_report(spans: list[Span], chips: int = 1,
     return account(spans, start, end, chips=chips)
 
 
+# -- tenant attribution (the chargeback ledger cut) ---------------------------
+
+# span attrs consulted for the billing tenant, in precedence order: an
+# explicit tenant attr (the router stamps one per dispatch) wins over
+# the emitting controller's namespace.
+TENANT_ATTRS = ("tenant", "namespace")
+DEFAULT_TENANT = "default"
+
+
+def span_tenant(span: Span) -> str:
+    """The tenant a span bills to — its ``tenant`` attr, else its
+    ``namespace``, else the default tenant (fleet-global spans like
+    scheduler passes land there on purpose: unattributable time must
+    stay visible, not vanish)."""
+    for key in TENANT_ATTRS:
+        value = span.attrs.get(key)
+        if value:
+            return str(value)
+    return DEFAULT_TENANT
+
+
+@dataclass
+class TenantLedger:
+    """The per-tenant cut of the goodput ledger over one window.
+
+    Each tenant gets its own sweep-line ``GoodputReport`` over ITS
+    spans (tenants are independent SPMD timelines — one tenant's
+    checkpoint must never mask another's productive step), weighted by
+    that tenant's chips. ``check()`` proves conservation twice: every
+    per-tenant report conserves to the wall window, AND the chip-second
+    buckets summed across tenants equal the fleet total
+    (``wall x total chips``) exactly — a chargeback invoice that does
+    not add up to the fleet bill is raised, never published."""
+
+    wall_s: float
+    reports: dict = field(default_factory=dict)   # tenant -> GoodputReport
+
+    @property
+    def chips(self) -> int:
+        return sum(r.chips for r in self.reports.values())
+
+    def chip_seconds_by_tenant(self) -> dict:
+        """tenant -> {cause: chip_seconds} over EVERY bucket (including
+        productive time — the invoice bills held chips, not just lost
+        ones)."""
+        return {
+            tenant: {name: r.buckets.get(name, 0.0) * r.chips
+                     for name in BUCKETS}
+            for tenant, r in sorted(self.reports.items())
+        }
+
+    def check(self, tolerance: float = 1e-6) -> "TenantLedger":
+        """Conservation, raised not warned (the fleet ledger's
+        discipline): per-tenant bucket seconds sum to the wall window,
+        and summed chip-seconds across tenants equal the fleet
+        ledger."""
+        total = 0.0
+        for tenant, r in self.reports.items():
+            try:
+                r.check(tolerance)
+            except AssertionError as e:
+                raise AssertionError(f"tenant {tenant!r}: {e}") from None
+            total += sum(r.buckets.values()) * r.chips
+        fleet = self.wall_s * self.chips
+        if not math.isclose(total, fleet, abs_tol=tolerance,
+                            rel_tol=1e-9):
+            raise AssertionError(
+                f"tenant chip-seconds sum to {total:.9f} != fleet "
+                f"ledger {fleet:.9f} (delta {total - fleet:+.9f})")
+        return self
+
+    def to_dict(self) -> dict:
+        return {
+            "wall_s": round(self.wall_s, 6),
+            "chips": self.chips,
+            "tenants": {tenant: r.to_dict()
+                        for tenant, r in sorted(self.reports.items())},
+        }
+
+
+def tenant_report(spans: list[Span], window_start: float,
+                  window_end: float,
+                  chips_by_tenant: dict | None = None,
+                  default_chips: int = 1) -> TenantLedger:
+    """Cut the span stream by billing tenant and account each tenant's
+    timeline over the SAME window. ``chips_by_tenant`` sets each
+    tenant's chip weight (missing tenants get ``default_chips``);
+    tenants listed there with no spans still get a report — an
+    all-admission window, the honest bill for chips held idle."""
+    by_tenant: dict[str, list[Span]] = {}
+    for s in spans:
+        by_tenant.setdefault(span_tenant(s), []).append(s)
+    for tenant in (chips_by_tenant or {}):
+        by_tenant.setdefault(tenant, [])
+    ledger = TenantLedger(wall_s=max(window_end - window_start, 0.0))
+    for tenant, tenant_spans in sorted(by_tenant.items()):
+        chips = (chips_by_tenant or {}).get(tenant, default_chips)
+        ledger.reports[tenant] = account(
+            tenant_spans, window_start, window_end, chips=chips)
+    return ledger
+
+
 # -- serving SLOs ------------------------------------------------------------
 
 
@@ -252,9 +354,10 @@ class ServingSLO:
         }
 
     def from_registry(self, registry, namespace: str,
-                      service: str) -> dict:
+                      service: str, tenant: str | None = None) -> dict:
         """Cumulative-since-start attainment from a MetricsRegistry's
-        router histogram (the in-process shape)."""
+        router histogram (the in-process shape). ``tenant`` narrows to
+        one billing tenant's series (the chargeback cut)."""
         fast = total = 0.0
         # the native histogram renders per-le series; read via the text
         # exposition through the ONE parser
@@ -265,6 +368,8 @@ class ServingSLO:
             if labels.get("namespace") != namespace or \
                     labels.get("service") != service:
                 continue
+            if tenant is not None and labels.get("tenant") != tenant:
+                continue
             if s.name == "router_request_seconds_bucket" and \
                     labels.get("le") == self.le:
                 fast += s.value
@@ -273,15 +378,22 @@ class ServingSLO:
         return self._status(fast, total)
 
     def from_store(self, store, at: float, window_s: float = 300.0,
-                   service: str | None = None) -> dict:
+                   service: str | None = None,
+                   tenant: str | None = None) -> dict:
         """Windowed attainment from the fleet TSDB: increase() of the
-        fast bucket vs the count over the last ``window_s``."""
+        fast bucket vs the count over the last ``window_s``. ``tenant``
+        narrows to one billing tenant's series (the chargeback cut)."""
         from kubeflow_tpu.obs.rules import Evaluator
 
         ev = Evaluator(store)
-        match = f'{{service="{service}"}}' if service else ""
-        lematch = (f'{{le="{self.le}",service="{service}"}}'
-                   if service else f'{{le="{self.le}"}}')
+        sel = []
+        if service:
+            sel.append(f'service="{service}"')
+        if tenant:
+            sel.append(f'tenant="{tenant}"')
+        le_sel = 'le="%s"' % self.le
+        match = f"{{{','.join(sel)}}}" if sel else ""
+        lematch = f"{{{','.join([le_sel] + sel)}}}"
         # rounded, floored at 1s: bare int() truncation turned a
         # fractional window into "[0s]" — an empty window that reported
         # a burning service as trivially meeting its SLO

@@ -231,6 +231,59 @@ class FleetPlane:
             return {"audit": []}
         return {"audit": self.remediator.audit()}
 
+    def chargeback(self, window_s: float = 300.0,
+                   at: float | None = None,
+                   chips_by_tenant: dict | None = None,
+                   default_chips: int = 1) -> dict:
+        """The per-tenant bill over the trailing window — the
+        /api/chargeback body. For every tenant seen in the span stream,
+        the TSDB's tenant-labeled router series, or the remediation
+        audit: goodput %, chip-seconds lost by cause (the conservation-
+        checked ledger cut — ``TenantLedger.check`` raises rather than
+        publish an invoice that doesn't add up to the fleet ledger),
+        SLO attainment, and the remediation actions its alerts
+        triggered."""
+        now = self.clock() if at is None else at
+        start = max(now - max(window_s, 0.0), 0.0)
+        ledger = gp.tenant_report(
+            self.collector.spans(), start, now,
+            chips_by_tenant=chips_by_tenant,
+            default_chips=default_chips).check()
+        tenants = set(ledger.reports)
+        for labels, _v in self.engine.query(
+                "sum by (tenant) (router_requests_total)", at=now):
+            if labels.get("tenant"):
+                tenants.add(labels["tenant"])
+        audit = (self.remediator.audit()
+                 if self.remediator is not None else [])
+        remediations: dict[str, int] = {}
+        for decision in audit:
+            if decision.get("at") is not None \
+                    and not (start <= decision["at"] <= now):
+                continue
+            tenant = decision.get("tenant") or "default"
+            remediations[tenant] = remediations.get(tenant, 0) + 1
+        tenants.update(remediations)
+        out: dict = {
+            "window_s": round(max(window_s, 0.0), 6),
+            "at": round(now, 6),
+            "chips": ledger.chips,
+            "tenants": {},
+        }
+        for tenant in sorted(tenants):
+            report = ledger.reports.get(tenant)
+            slos = [slo.from_store(self.store, now,
+                                   window_s=max(window_s, 1.0),
+                                   tenant=tenant)
+                    for slo in self.slos]
+            out["tenants"][tenant] = {
+                "goodput": (report.check().to_dict()
+                            if report is not None else None),
+                "slo": slos,
+                "remediations": remediations.get(tenant, 0),
+            }
+        return out
+
     # -- thread shell --------------------------------------------------------
 
     def start(self) -> "FleetPlane":  # pragma: no cover - thread shell

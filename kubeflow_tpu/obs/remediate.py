@@ -78,7 +78,7 @@ ERROR = "error"
 def remediations_total():
     return _metric("obs_remediations_total", prom.Counter,
                    "remediation decisions by action and result",
-                   labelnames=("action", "result"))
+                   labelnames=("action", "result", "tenant"))
 
 
 class SkipAction(Exception):
@@ -208,9 +208,15 @@ class RemediationEngine:
 
     def _record(self, rem: Remediation, labels: dict, result: str,
                 detail: str, now: float) -> dict:
+        # the namespace whose alert triggered this action IS the tenant
+        # the decision bills to (chargeback attribution); an explicit
+        # tenant label on the transition wins
+        tenant = (labels.get("tenant") or labels.get("namespace")
+                  or "default")
         decision = {
             "action": rem.name, "alert": rem.alert,
             "labels": dict(sorted(labels.items())),
+            "tenant": tenant,
             "result": result, "detail": detail, "at": now,
         }
         self._audit.append(decision)
@@ -218,9 +224,9 @@ class RemediationEngine:
             self.registry.counter_inc(
                 "obs_remediations_total",
                 help_="remediation decisions by action and result",
-                action=rem.name, result=result)
+                action=rem.name, result=result, tenant=tenant)
             remediations_total().labels(
-                action=rem.name, result=result).inc()
+                action=rem.name, result=result, tenant=tenant).inc()
         except Exception:  # telemetry must never break the pass
             log.exception("remediation metric emit failed")
         if self.recorder is not None and result in (EXECUTED, DRY_RUN,

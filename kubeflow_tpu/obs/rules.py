@@ -882,6 +882,53 @@ def node_burn_rules(latency_target_s: float = 0.5,
     ]
 
 
+def tenant_rule_pack(latency_target_s: float = 0.5,
+                     objective: float = 0.99,
+                     short_window: str = "1m",
+                     long_window: str = "5m",
+                     burn_threshold: float = 1.0,
+                     storm_tokens_per_s: float = 0.5) -> list:
+    """Tenant-scoped rules over the tenant label the router stamps:
+    the same multi-window SLO-burn shape as the service rules grouped
+    ``by (tenant)`` (which tenant's traffic is burning the budget),
+    a retry-storm alert over the tenant's retry/hedge budget spend
+    (the noisy-neighbor signal PR-20-era fair-share will bound), and a
+    first-error tripwire. All three depend on the router pre-
+    registering a fresh tenant's counters at 0 — ``rate()`` over a
+    series born non-zero reports nothing (the PR 10 lesson)."""
+    short_burn = burn_rate_expr(latency_target_s, objective,
+                                short_window, by="tenant")
+    long_burn = burn_rate_expr(latency_target_s, objective,
+                               long_window, by="tenant")
+    return [
+        RecordingRule("slo:tenant_burn:short", short_burn),
+        RecordingRule("slo:tenant_burn:long", long_burn),
+        AlertRule(
+            "TenantSLOBurn",
+            f"slo:tenant_burn:short > {burn_threshold} "
+            f"and slo:tenant_burn:long > {burn_threshold}",
+            for_s=30.0, severity="warning",
+            summary=f"one tenant's traffic is burning the latency "
+                    f"error budget >{burn_threshold}x (target "
+                    f"{latency_target_s}s @ {objective:.2%})"),
+        AlertRule(
+            "TenantRetryStorm",
+            "sum by (tenant) "
+            f"(rate(router_tenant_retry_tokens_total[{short_window}])) "
+            f"> {storm_tokens_per_s}",
+            for_s=30.0, severity="warning",
+            summary=f"a tenant is spending retry/hedge budget faster "
+                    f"than {storm_tokens_per_s}/s (retry storm)"),
+        AlertRule(
+            "TenantRequestFailures",
+            "sum by (tenant) (increase("
+            "router_requests_total{outcome=\"failed\"}"
+            f"[{long_window}])) > 0",
+            for_s=0.0, severity="warning",
+            summary="a tenant's requests are failing"),
+    ]
+
+
 def default_rule_pack(latency_target_s: float = 0.5,
                       objective: float = 0.99,
                       short_window: str = "1m",

@@ -47,6 +47,7 @@ import collections
 import json
 import logging
 import math
+import re
 import threading
 import time
 from dataclasses import dataclass, field
@@ -89,9 +90,24 @@ _BREAKER_GAUGE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
 
 # Request headers the shell understands (and forwards replica-ward):
 # the remaining deadline budget in seconds — it SHRINKS across retry
-# hops — and the criticality band.
+# hops — the criticality band, and the tenant the request bills to
+# (defaulted from the JAXService namespace; the chargeback dimension).
 HEADER_DEADLINE = "x-request-deadline-s"
 HEADER_BAND = "x-request-band"
+HEADER_TENANT = "x-request-tenant"
+
+# A tenant is a kubernetes namespace (or an explicit header override
+# spelled the same way): DNS-1123 label. Anything else is a 400 at the
+# shell — unbounded attacker-chosen label values would otherwise flow
+# straight into the metric exposition.
+TENANT_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+# The outcomes every tenant's router_requests_total series is
+# pre-registered at 0 for on first sight (rate() needs a 0-sample
+# BEFORE the first error, or a fresh tenant's first failure never
+# fires its burn rule — the PR 10 lesson).
+TENANT_OUTCOMES = ("completed", "failed", "rejected", "deadline",
+                   "shed", "shed_band")
 
 def _prom_metric(name, kind, doc, **kw):
     from kubeflow_tpu.runtime.metrics import prom_metric
@@ -275,6 +291,9 @@ class Ticket:
     # -- resilience layer -----------------------------------------------
     band: str = BAND_DEFAULT
     deadline: float | None = None       # absolute, on the router clock
+    # the namespace this request bills to (chargeback attribution);
+    # "" means "the router's own namespace" — submit() resolves it
+    tenant: str = ""
     hedge_member: Member | None = field(default=None, repr=False)
     # why the router dropped this ticket without the shell asking
     # ("deadline" / "shed_band" / "retry_budget"); the shell maps it to
@@ -350,6 +369,8 @@ class TokenRouter:
         self._completions: collections.deque = collections.deque(maxlen=64)
         self._retry_tokens = (resilience.retry_budget_cap
                               if resilience else 0.0)
+        # tenants whose counter families are already pre-registered
+        self._tenants: set[str] = set()
 
     # -- membership (controller-fed) ----------------------------------------
 
@@ -445,7 +466,7 @@ class TokenRouter:
                 t._span.error = f"replica {name} lost; shed to survivors"
                 self.tracer.finish(t._span)
                 t._span = None
-            self._count_locked("shed")
+            self._count_locked("shed", t.tenant)
         self.registry.gauge(
             "router_tokens_inflight", 0,
             help_="outstanding token estimate per replica",
@@ -459,17 +480,20 @@ class TokenRouter:
     def submit(self, tokens: int, item: Any = None,
                context: "obs_trace.SpanContext | None" = None,
                band: str = BAND_DEFAULT,
-               deadline: float | None = None) -> Ticket:
+               deadline: float | None = None,
+               tenant: str | None = None) -> Ticket:
         """Admit one request of ``tokens`` estimated cost. Dispatches
         immediately to the least-loaded eligible replica, else queues;
         raises ``RouterBusy`` (429) when the bounded queue is full —
         unless a strictly-less-critical ticket is queued, in which case
         THAT one is shed instead (band shedding; resilience mode only).
         ``deadline`` is absolute on the router clock; a dead-on-arrival
-        request raises ``DeadlineExceeded`` (504) without queueing."""
+        request raises ``DeadlineExceeded`` (504) without queueing.
+        ``tenant`` is the namespace this request bills to (chargeback
+        attribution); None/empty defaults to the router's namespace."""
         t = Ticket(tokens=int(tokens), item=item, context=context,
                    band=band if band in BAND_RANK else BAND_DEFAULT,
-                   deadline=deadline)
+                   deadline=deadline, tenant=tenant or self.namespace)
         victim: Ticket | None = None
         expired: list[Ticket] = []
         try:
@@ -478,6 +502,7 @@ class TokenRouter:
                     raise RouterBusy("router is shut down")
                 now = self.clock()
                 t._t0 = t._queued_at = now
+                self._register_tenant_locked(t.tenant)
                 if self.resilience is not None:
                     self._refill_budget_locked()
                 if t.deadline is not None and now >= t.deadline:
@@ -491,7 +516,7 @@ class TokenRouter:
                 elif len(self._queue) >= self.max_queue:
                     victim = self._shed_band_locked(t, now)
                     if victim is None:
-                        self._count_locked("rejected")
+                        self._count_locked("rejected", t.tenant)
                         e = RouterBusy(
                             f"admission queue full ({self.max_queue})")
                         e.retry_after = self._retry_after_locked(now)
@@ -531,13 +556,13 @@ class TokenRouter:
         victim = self._queue.pop(idx)
         victim.dropped_reason = "shed_band"
         victim.retry_after = self._retry_after_locked(now)
-        self._count_locked("shed_band")
+        self._count_locked("shed_band", victim.tenant)
         self.registry.counter_inc(
             "router_shed_total",
             help_="queued requests evicted by criticality band under "
                   "overload",
             namespace=self.namespace, service=self.service,
-            band=victim.band)
+            tenant=victim.tenant or self.namespace, band=victim.band)
         if self._prom:
             prom_shed_total().labels(self.service, victim.band).inc()
         self._decide_locked("shed", now, band=victim.band)
@@ -659,12 +684,16 @@ class TokenRouter:
                     ticket.dropped_reason = "retry_budget"
                     ticket.retry_after = self._retry_after_locked(now)
                     self._decide_locked("retry_budget_drop", now)
+                else:
+                    # the retry really spent a budget token: charge it
+                    # to the tenant whose request is retrying
+                    self._tenant_spend_locked(ticket.tenant, "retry", 1.0)
             queued = any(t is ticket for t in self._queue)
             if requeue:
                 ticket.done.clear()
                 if not queued:
                     self._queue.insert(0, ticket)
-                    self._count_locked("shed")
+                    self._count_locked("shed", ticket.tenant)
             else:
                 ticket.resolved = True
                 if queued:
@@ -673,7 +702,7 @@ class TokenRouter:
                 if ticket.dropped_reason == "deadline":
                     self._drop_deadline_locked(ticket, now)
                 else:
-                    self._count_locked("failed")
+                    self._count_locked("failed", ticket.tenant)
             expired = self._sweep_deadlines_locked(now)
             dispatched = self._drain_locked(now)
             self._publish_queue_locked()
@@ -738,6 +767,7 @@ class TokenRouter:
                 return None
             if not self._spend_budget_locked(1.0):
                 return None
+            self._tenant_spend_locked(ticket.tenant, "hedge", 1.0)
             ticket.hedge_member = m
             ticket._hedge_at = now
             self._tokens[m.name] = \
@@ -859,6 +889,7 @@ class TokenRouter:
         t._span = self.tracer.begin(
             "router.dispatch", parent=t.context, detached=True,
             service=self.service, namespace=self.namespace,
+            tenant=t.tenant or self.namespace,
             replica=member.name, tokens=t.tokens,
             queue_wait_s=round(max(now - t._queued_at, 0.0), 6))
         self._publish_inflight_locked(member.name)
@@ -879,17 +910,19 @@ class TokenRouter:
             t._span = None
         latency = max(now - t._t0, 0.0)
         done = t.tokens if tokens_done is None else int(tokens_done)
+        tenant = t.tenant or self.namespace
         self.registry.histogram(
             "router_request_seconds", latency,
             help_="submit -> completion latency through the router",
             buckets=REQUEST_BUCKETS,
-            namespace=self.namespace, service=self.service)
+            namespace=self.namespace, service=self.service, tenant=tenant)
         self.registry.counter_inc(
             "router_tokens_total",
             help_="tokens completed through the router (rate = the "
                   "autoscaler's tokens/sec signal)",
-            by=float(done), namespace=self.namespace, service=self.service)
-        self._count_locked("completed")
+            by=float(done), namespace=self.namespace, service=self.service,
+            tenant=tenant)
+        self._count_locked("completed", t.tenant)
         if self._prom:
             prom_request_seconds().labels(self.service).observe(latency)
             prom_tokens_total().labels(self.service).inc(done)
@@ -950,11 +983,12 @@ class TokenRouter:
 
     def _drop_deadline_locked(self, t: Ticket, now: float) -> None:
         t.dropped_reason = "deadline"
-        self._count_locked("deadline")
+        self._count_locked("deadline", t.tenant)
         self.registry.counter_inc(
             "router_deadline_exceeded_total",
             help_="requests dropped because their deadline elapsed",
-            namespace=self.namespace, service=self.service)
+            namespace=self.namespace, service=self.service,
+            tenant=t.tenant or self.namespace)
         if self._prom:
             prom_deadline_exceeded_total().labels(self.service).inc()
         self._decide_locked("deadline", now, band=t.band)
@@ -1071,6 +1105,21 @@ class TokenRouter:
             namespace=self.namespace, service=self.service)
         if self._prom:
             prom_queue_depth().labels(self.service).set(len(self._queue))
+        # the per-tenant cut is a SEPARATE family: RegistrySignals sums
+        # router_queue_depth by label SUBSET, so tenant series on the
+        # fleet gauge would double-count the autoscaler's signal
+        if self._tenants:
+            depth: dict[str, int] = {t: 0 for t in self._tenants}
+            for q in self._queue:
+                tenant = q.tenant or self.namespace
+                depth[tenant] = depth.get(tenant, 0) + 1
+            for tenant, n in depth.items():
+                self.registry.gauge(
+                    "router_tenant_queue_depth", n,
+                    help_="requests waiting in the router admission "
+                          "queue, by billing tenant",
+                    namespace=self.namespace, service=self.service,
+                    tenant=tenant)
 
     def _publish_inflight_locked(self, name: str) -> None:
         self.registry.gauge(
@@ -1081,13 +1130,59 @@ class TokenRouter:
             prom_tokens_inflight().labels(self.service, name).set(
                 self._tokens.get(name, 0))
 
-    def _count_locked(self, outcome: str) -> None:
+    def _count_locked(self, outcome: str, tenant: str = "") -> None:
         self.registry.counter_inc(
             "router_requests_total",
             help_="requests by outcome (completed/rejected/shed/failed)",
-            namespace=self.namespace, service=self.service, outcome=outcome)
+            namespace=self.namespace, service=self.service,
+            tenant=tenant or self.namespace, outcome=outcome)
         if self._prom:
             prom_requests_total().labels(self.service, outcome).inc()
+
+    def _register_tenant_locked(self, tenant: str) -> None:
+        """First sight of a tenant: pre-register its counter families
+        at 0 so ``rate()``/``increase()`` have a sample BEFORE the
+        first error — a fresh tenant's very first failure must trip
+        its burn/storm rules (the PR 10 zero-sample lesson)."""
+        tenant = tenant or self.namespace
+        if tenant in self._tenants:
+            return
+        self._tenants.add(tenant)
+        for outcome in TENANT_OUTCOMES:
+            self.registry.counter_inc(
+                "router_requests_total", by=0.0,
+                help_="requests by outcome "
+                      "(completed/rejected/shed/failed)",
+                namespace=self.namespace, service=self.service,
+                tenant=tenant, outcome=outcome)
+        self.registry.counter_inc(
+            "router_tokens_total", by=0.0,
+            help_="tokens completed through the router (rate = the "
+                  "autoscaler's tokens/sec signal)",
+            namespace=self.namespace, service=self.service, tenant=tenant)
+        for kind in ("retry", "hedge"):
+            self.registry.counter_inc(
+                "router_tenant_retry_tokens_total", by=0.0,
+                help_="retry-budget tokens spent on retries and hedges, "
+                      "by billing tenant",
+                namespace=self.namespace, service=self.service,
+                tenant=tenant, kind=kind)
+        self.registry.gauge(
+            "router_tenant_queue_depth", 0,
+            help_="requests waiting in the router admission queue, by "
+                  "billing tenant",
+            namespace=self.namespace, service=self.service, tenant=tenant)
+
+    def _tenant_spend_locked(self, tenant: str, kind: str,
+                             cost: float) -> None:
+        """Attribute a retry-budget spend (a retry or a hedge leg) to
+        the tenant whose request drew it — the retry-storm signal."""
+        self.registry.counter_inc(
+            "router_tenant_retry_tokens_total", by=cost,
+            help_="retry-budget tokens spent on retries and hedges, "
+                  "by billing tenant",
+            namespace=self.namespace, service=self.service,
+            tenant=tenant or self.namespace, kind=kind)
 
 
 # -- endpoints annotation helpers -------------------------------------------
@@ -1309,6 +1404,15 @@ class RouterFrontend:
         band = req.header(HEADER_BAND) or self.default_band
         if band not in BAND_RANK:
             band = BAND_DEFAULT
+        # the billing tenant: an explicit header override, else the
+        # JAXService namespace (submit() applies the default). Garbage
+        # is a 400, not a label value — header text must never flow
+        # unchecked into the metric exposition.
+        tenant = (req.header(HEADER_TENANT) or "").strip() or None
+        if tenant is not None and not TENANT_RE.match(tenant):
+            raise ApiHttpError(
+                400, f"bad {HEADER_TENANT} header: must be a DNS-1123 "
+                     f"label")
         # the real HTTP shell returns "" for a missing header (httpd
         # HttpReq.header default) while stubs return None — both mean
         # "no deadline requested"
@@ -1325,7 +1429,8 @@ class RouterFrontend:
                     if deadline_s is not None and deadline_s > 0 else None)
         try:
             ticket = self.router.submit(tokens, item=model, context=ctx,
-                                        band=band, deadline=deadline)
+                                        band=band, deadline=deadline,
+                                        tenant=tenant)
         except DeadlineExceeded:
             raise ApiHttpError(504, "deadline exceeded")
         except RouterBusy as e:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import re
 from typing import Protocol
 
@@ -394,6 +395,38 @@ class Dashboard:
                 400, "chips must be an int, window_s a number")
         return self._plane().goodput(chips=chips_i, window_s=window_f)
 
+    def chargeback(self, req: HttpReq):
+        """The per-tenant bill: goodput %, chip-seconds lost by cause
+        (conservation-checked against the fleet ledger), SLO
+        attainment, and remediation count — ?window_s= bounds the
+        trailing window, ?tenant= narrows to one tenant, ?chips=
+        weights every tenant's report (flat rate)."""
+        from kubeflow_tpu.serving.router import TENANT_RE
+
+        self._user(req)
+        window = req.q1("window_s")
+        chips = req.q1("chips")
+        tenant = req.q1("tenant")
+        try:
+            window_f = float(window) if window else 300.0
+            chips_i = int(chips) if chips else 1
+        except ValueError:
+            raise ApiHttpError(
+                400, "window_s must be a number, chips an int")
+        if not math.isfinite(window_f) or window_f <= 0:
+            raise ApiHttpError(400, "window_s must be a positive number")
+        if chips_i < 1:
+            raise ApiHttpError(400, "chips must be >= 1")
+        if tenant and not TENANT_RE.match(tenant):
+            raise ApiHttpError(
+                400, "tenant must be a DNS-1123 label")
+        out = self._plane().chargeback(window_s=window_f,
+                                       default_chips=chips_i)
+        if tenant:
+            out["tenants"] = {tenant: out["tenants"].get(tenant)} \
+                if tenant in out["tenants"] else {}
+        return out
+
     def silences(self, req: HttpReq):
         """Active silences: GET lists, POST creates (body:
         {"matchers": {...}, "until": <unix-s> | "duration_s": <s>,
@@ -464,6 +497,7 @@ class Dashboard:
         r.route("GET", "/api/alerts", self.alerts)
         r.route("GET", "/api/query", self.obs_query)
         r.route("GET", "/api/goodput", self.goodput)
+        r.route("GET", "/api/chargeback", self.chargeback)
         r.route("GET", "/api/silences", self.silences)
         r.route("POST", "/api/silences", self.silences)
         r.route("DELETE", "/api/silences/{id}", self.delete_silence)

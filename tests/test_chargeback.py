@@ -1,0 +1,279 @@
+"""Tenant attribution plane: pre-registration, the chargeback surface,
+and the contention-bench contract.
+
+Covers the ISSUE-19 satellites end to end:
+
+- a FRESH tenant's counter families are pre-registered at 0 on first
+  sight, so its very FIRST error produces a nonzero ``increase()`` and
+  the ``TenantRequestFailures`` tripwire fires (the PR 10 lesson:
+  ``rate()`` over a series born non-zero reports nothing);
+- ``GET /api/chargeback`` validates its params (400 on garbage, never
+  500) and serves the conservation-checked per-tenant bill;
+- ``TenantLedger.check`` raises on a bill that does not add up to the
+  fleet ledger — misattribution is an error, not a log line;
+- ``tools/chargeback_bench.py`` replays byte-identically and its
+  committed bank stays green.
+"""
+
+import json
+
+import pytest
+
+from kubeflow_tpu.obs import goodput as gp
+from kubeflow_tpu.obs import trace as tr
+from kubeflow_tpu.obs.plane import FleetPlane
+from kubeflow_tpu.obs.rules import tenant_rule_pack
+from kubeflow_tpu.obs.tsdb import RegistryTarget
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+from kubeflow_tpu.serving.router import Member, TokenRouter
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mkspan(name, start, end, **attrs):
+    s = tr.Span(name=name, trace_id="t" * 32, span_id=tr.new_span_id(),
+                start=start, attrs=attrs)
+    s.end = end
+    return s
+
+
+def _router(clock, reg):
+    r = TokenRouter(service="chat", namespace="default", clock=clock,
+                    registry=reg, tracer=tr.Tracer(tr.TraceCollector()),
+                    prom_sink=False)
+    r.set_members([Member(name="replica-0", transport=None)])
+    return r
+
+
+# -- satellite 1: pre-registration + first-error alert -----------------------
+
+
+class TestTenantPreRegistration:
+    def test_first_sight_registers_all_outcomes_at_zero(self):
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        router = _router(clock, reg)
+        t = router.submit(10, tenant="team-alpha")
+        router.complete(t)
+        text = reg.render()
+        # every outcome series exists the moment the tenant appears —
+        # including the ones that have not happened yet
+        for outcome in ("failed", "rejected", "deadline", "shed",
+                        "shed_band"):
+            assert (f'router_requests_total{{namespace="default",'
+                    f'outcome="{outcome}",service="chat",'
+                    f'tenant="team-alpha"}} 0') in text, outcome
+        for kind in ("retry", "hedge"):
+            assert (f'router_tenant_retry_tokens_total{{kind="{kind}",'
+                    f'namespace="default",service="chat",'
+                    f'tenant="team-alpha"}} 0') in text, kind
+        assert ('router_tenant_queue_depth{namespace="default",'
+                'service="chat",tenant="team-alpha"}') in text
+
+    def test_fresh_tenants_first_error_fires_the_tripwire(self):
+        """Regression for the zero-sample contract: the first FAILED
+        request of a brand-new tenant must alert. Without the 0-valued
+        pre-registration the failed series would be born at 1 and
+        ``increase()`` would see a single point — no rate, no alert."""
+        clock = ManualClock()
+        reg = MetricsRegistry()
+        router = _router(clock, reg)
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            targets=[RegistryTarget("router", reg)],
+            rules=tenant_rule_pack(), interval_s=15.0, clock=clock,
+            collector=tr.TraceCollector())
+        # cycle 0: the tenant's first-ever request succeeds — the
+        # scrape banks the pre-registered failed=0 sample
+        t = router.submit(10, tenant="team-new")
+        router.complete(t)
+        fired = list(plane.tick(at=clock.t)["transitions"])
+        clock.advance(15.0)
+        # cycle 1: its very FIRST error
+        t = router.submit(10, tenant="team-new")
+        router.fail(t, requeue=False)
+        fired += plane.tick(at=clock.t)["transitions"]
+        hits = [x for x in fired
+                if x["alert"] == "TenantRequestFailures"
+                and x["to"] == "firing"]
+        assert hits, fired
+        assert hits[0]["labels"]["tenant"] == "team-new"
+
+
+# -- the conservation-checked ledger cut -------------------------------------
+
+
+class TestTenantLedger:
+    def _spans(self):
+        return [
+            mkspan("train.step", 10.0, 40.0, tenant="team-a"),
+            mkspan("train.checkpoint", 40.0, 50.0, tenant="team-a"),
+            mkspan("train.step", 0.0, 60.0, tenant="team-b"),
+        ]
+
+    def test_buckets_conserve_per_tenant_and_fleet_wide(self):
+        ledger = gp.tenant_report(
+            self._spans(), 0.0, 100.0,
+            chips_by_tenant={"team-a": 4, "team-b": 8}).check()
+        assert set(ledger.reports) == {"team-a", "team-b"}
+        assert ledger.chips == 12
+        for report in ledger.reports.values():
+            assert sum(report.buckets.values()) == pytest.approx(100.0)
+        total = sum(sum(cs.values()) for cs in
+                    ledger.chip_seconds_by_tenant().values())
+        assert total == pytest.approx(100.0 * 12)
+
+    def test_doctored_bucket_raises_not_warns(self):
+        ledger = gp.tenant_report(self._spans(), 0.0, 100.0)
+        ledger.reports["team-a"].buckets[gp.OTHER] += 1.0
+        with pytest.raises(AssertionError, match="team-a"):
+            ledger.check()
+
+    def test_idle_tenant_listed_in_chips_is_billed_admission(self):
+        ledger = gp.tenant_report(
+            [], 0.0, 50.0, chips_by_tenant={"team-idle": 2}).check()
+        report = ledger.reports["team-idle"]
+        assert report.buckets[gp.ADMISSION] == pytest.approx(50.0)
+
+
+# -- the /api/chargeback surface ---------------------------------------------
+
+
+class TestChargebackApi:
+    def _dash(self):
+        from kubeflow_tpu.control.k8s.fake import FakeCluster
+        from kubeflow_tpu.utils.httpd import HttpReq
+        from kubeflow_tpu.webapps.dashboard import Dashboard
+
+        clock = ManualClock(t=100.0)
+        reg = MetricsRegistry()
+        router = _router(clock, reg)
+        for tenant in ("team-a", "team-b"):
+            t = router.submit(10, tenant=tenant)
+            router.complete(t)
+        collector = tr.TraceCollector()
+        collector.add(mkspan("train.step", 40.0, 90.0, tenant="team-a"))
+        collector.add(mkspan("train.step", 20.0, 100.0, tenant="team-b"))
+        plane = FleetPlane(
+            registry=MetricsRegistry(),
+            targets=[RegistryTarget("router", reg)],
+            rules=tenant_rule_pack(), interval_s=15.0, clock=clock,
+            collector=collector)
+        plane.tick(at=clock.t)
+        router_http = Dashboard(FakeCluster(), plane=plane).router()
+
+        def get(path, query=None):
+            resp = router_http.dispatch(HttpReq(
+                method="GET", path=path, params={},
+                query=query or {},
+                headers={"kubeflow-userid": "alice@example.com"}))
+            return resp.status, json.loads(resp.body)
+
+        return get
+
+    def test_malformed_params_are_400_not_500(self):
+        get = self._dash()
+        assert get("/api/chargeback", {"window_s": ["x"]})[0] == 400
+        assert get("/api/chargeback", {"window_s": ["-5"]})[0] == 400
+        assert get("/api/chargeback", {"window_s": ["inf"]})[0] == 400
+        assert get("/api/chargeback", {"chips": ["abc"]})[0] == 400
+        assert get("/api/chargeback", {"chips": ["0"]})[0] == 400
+        assert get("/api/chargeback",
+                   {"tenant": ["Not_A_Label!"]})[0] == 400
+
+    def test_bill_conserves_over_a_two_tenant_plane(self):
+        get = self._dash()
+        status, doc = get("/api/chargeback",
+                          {"window_s": ["100"], "chips": ["4"]})
+        assert status == 200
+        tenants = doc["tenants"]
+        assert set(tenants) >= {"team-a", "team-b"}
+        # conservation surfaced, not just checked server-side: every
+        # tenant's buckets sum to the window, and chip-seconds across
+        # tenants sum to the fleet ledger
+        fleet = 0.0
+        for bill in tenants.values():
+            good = bill["goodput"]
+            assert sum(good["buckets_s"].values()) == pytest.approx(
+                good["wall_s"])
+            fleet += sum(good["buckets_s"].values()) * good["chips"]
+        assert fleet == pytest.approx(100.0 * doc["chips"])
+        # team-b trained 80 of the 100s window; team-a 50
+        assert tenants["team-b"]["goodput"]["goodput_pct"] \
+            == pytest.approx(80.0)
+        assert tenants["team-a"]["goodput"]["goodput_pct"] \
+            == pytest.approx(50.0)
+        assert tenants["team-a"]["slo"][0]["met"] is True
+
+    def test_tenant_param_narrows_the_bill(self):
+        get = self._dash()
+        status, doc = get("/api/chargeback", {"tenant": ["team-a"],
+                                              "window_s": ["100"]})
+        assert status == 200
+        assert set(doc["tenants"]) == {"team-a"}
+        status, doc = get("/api/chargeback", {"tenant": ["team-zz"],
+                                              "window_s": ["100"]})
+        assert status == 200
+        assert doc["tenants"] == {}
+
+
+# -- bench contract (CI ratchet) ---------------------------------------------
+
+
+@pytest.mark.usefixtures("virtual_time_guard")
+class TestChargebackBenchContract:
+    def test_double_run_is_byte_identical(self):
+        from tools.chargeback_bench import SMOKE_CONFIG, run_bench
+
+        r1 = run_bench(**SMOKE_CONFIG)
+        r2 = run_bench(**SMOKE_CONFIG)
+        r1.pop("machine")
+        r2.pop("machine")
+        assert json.dumps(r1, sort_keys=True) \
+            == json.dumps(r2, sort_keys=True)
+
+    def test_check_green_against_committed_bank(self):
+        from tools.chargeback_bench import DEFAULT_OUT, check_against
+
+        assert check_against(DEFAULT_OUT) == 0
+
+    def test_check_fails_on_poisoned_bank(self, tmp_path):
+        from tools.chargeback_bench import DEFAULT_OUT, check_against
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        bank["smoke"]["decision_fingerprint"] = "0" * 64
+        poisoned = tmp_path / "bank.json"
+        poisoned.write_text(json.dumps(bank))
+        assert check_against(str(poisoned)) == 1
+
+    def test_banked_attribution_is_correct(self):
+        from tools.chargeback_bench import (
+            BURN_TENANT, DEFAULT_OUT, STORM_TENANT,
+        )
+
+        with open(DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        for section in ("full", "smoke"):
+            run = bank[section]
+            assert run["conservation"] == "ok"
+            assert run["tenant_alerts"]["TenantRetryStorm"] \
+                == [STORM_TENANT]
+            assert run["tenant_alerts"]["TenantSLOBurn"] \
+                == [BURN_TENANT]
+            bills = run["invoice"]
+            assert sum(bills[STORM_TENANT]["retry_tokens"].values()) > 0
+            assert bills[BURN_TENANT]["slo_met"] is False
+            for tenant, bill in bills.items():
+                if tenant not in (STORM_TENANT, BURN_TENANT):
+                    assert sum(bill["retry_tokens"].values()) == 0
+                    assert bill["slo_met"] is not False

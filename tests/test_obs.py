@@ -587,7 +587,8 @@ class TestEndToEnd:
         assert ('controller_reconcile_seconds_bucket'
                 '{controller="gang-scheduler"') in text
         assert "# TYPE scheduler_bind_latency_seconds histogram" in text
-        assert 'scheduler_bind_latency_seconds_bucket{le="+Inf"} 1' in text
+        assert ('scheduler_bind_latency_seconds_bucket{namespace="default",'
+                'tenant="default",le="+Inf"} 1') in text
         assert "# TYPE workqueue_wait_seconds histogram" in text
         assert "workqueue_depth" in text
         assert_valid_exposition(text)

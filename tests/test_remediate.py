@@ -162,13 +162,36 @@ class TestDecisionPass:
         eng.observe([firing()], at=0.0)
         eng.observe([firing()], at=1.0)
         text = reg.render()
-        assert 'obs_remediations_total{action="fix",result="executed"}' \
-            in text
+        assert ('obs_remediations_total{action="fix",result="executed",'
+                'tenant="default"}') in text
         events = cluster.list("v1", "Event", namespace="default")
         execd = [e for e in events if e["reason"] == "RemediationExecuted"]
         assert len(execd) == 1  # dedup'd, count bumped
         assert "did it" in execd[0]["message"]
         assert execd[0]["count"] == 2
+
+    def test_decisions_attributed_to_triggering_namespace(self):
+        """The tenant dimension: the namespace whose alert fired rides
+        the audit entry, the counter label, and the Event's
+        involvedObject — chargeback can bill the remediation."""
+        cluster = FakeCluster()
+        reg = MetricsRegistry()
+        eng = engine(
+            [RM.Remediation("fix", "HotZone", lambda tr: "did it",
+                            cooldown_s=0.0)],
+            registry=reg, recorder=EventRecorder(cluster))
+        eng.observe([firing(labels={"namespace": "team-a"})], at=0.0)
+        audit = eng.audit()
+        assert audit[-1]["tenant"] == "team-a"
+        assert ('obs_remediations_total{action="fix",result="executed",'
+                'tenant="team-a"} 1.0') in reg.render()
+        events = cluster.list("v1", "Event", namespace="team-a")
+        assert [e for e in events
+                if e["reason"] == "RemediationExecuted"]
+        # an explicit tenant label on the transition outranks namespace
+        eng.observe([firing(labels={"namespace": "team-a",
+                                    "tenant": "team-b"}, at=5.0)], at=5.0)
+        assert eng.audit()[-1]["tenant"] == "team-b"
 
     def test_failed_action_emits_warning_event(self):
         cluster = FakeCluster()

@@ -296,7 +296,8 @@ class TestAllOrNothingAdmission:
             assert phase == "Pending"
         # queued + backing off, visible in metrics
         text = reg.render()
-        assert 'scheduler_queue_depth{namespace="default"} 1' in text
+        assert 'scheduler_queue_depth{namespace="default",tenant="default"} 1' \
+            in text
         assert "scheduler_requeues_total" in text
 
     def test_admits_when_capacity_appears(self):
@@ -329,10 +330,14 @@ class TestAllOrNothingAdmission:
         assert after == before + 1
         text = reg.render()
         assert "# TYPE scheduler_bind_latency_seconds histogram" in text
-        assert 'scheduler_bind_latency_seconds_bucket{le="+Inf"} 1' in text
-        assert "scheduler_bind_latency_seconds_count 1" in text
-        assert 'scheduler_gangs_admitted_total{namespace="default"} 1' in text
-        assert 'scheduler_queue_depth{namespace="default"} 0' in text
+        assert ('scheduler_bind_latency_seconds_bucket{namespace="default",'
+                'tenant="default",le="+Inf"} 1') in text
+        assert ('scheduler_bind_latency_seconds_count{namespace="default",'
+                'tenant="default"} 1') in text
+        assert ('scheduler_gangs_admitted_total{namespace="default",'
+                'tenant="default"} 1') in text
+        assert 'scheduler_queue_depth{namespace="default",tenant="default"} 0' \
+            in text
 
     def test_node_event_bypasses_backoff(self):
         """New capacity must not wait out an exponential backoff: a
@@ -547,7 +552,8 @@ class TestPriorityPreemption:
         # its recreated pods wait unbound in the queue (no capacity)
         assert b["low-worker-0"] is None and b["low-worker-1"] is None
         text = reg.render()
-        assert 'scheduler_preemptions_total{namespace="default"} 1' in text
+        assert ('scheduler_preemptions_total{namespace="default",'
+                'tenant="default"} 1') in text
 
     def test_preempted_capacity_goes_to_the_preemptor_not_a_thief(self):
         """No priority inversion across namespaces: chips freed by an
@@ -575,7 +581,8 @@ class TestPriorityPreemption:
         assert all(n is None for n in bindings(cluster, "aaa").values())
         # exactly ONE eviction (the victim), never a cascade via thief
         text = reg.render()
-        assert 'scheduler_preemptions_total{namespace="default"} 1' in text
+        assert ('scheduler_preemptions_total{namespace="default",'
+                'tenant="default"} 1') in text
         assert 'scheduler_preemptions_total{namespace="aaa"}' not in text
         thief = cluster.get(JT.API_VERSION, JT.KIND, "thief", "aaa")
         assert not ob.cond_is_true(thief, JT.COND_RUNNING)
@@ -850,7 +857,8 @@ class TestSliceAwareAdmission:
         assert len(b) == 4 and all(v is None for v in b.values()), b
         for p in cluster.list("v1", "Pod", namespace="default"):
             assert p["spec"]["schedulingGates"] == [{"name": GATE_GANG}]
-        assert 'scheduler_queue_depth{namespace="default"} 1' in reg.render()
+        assert ('scheduler_queue_depth{namespace="default",'
+                'tenant="default"} 1') in reg.render()
 
     def test_slice_aligned_partial_admission_and_grow_back(self):
         """Slice-elastic gang, room for one slice: exactly slice 0

@@ -1,0 +1,499 @@
+#!/usr/bin/env python
+"""chargeback_bench — deterministic 8-tenant contention drill.
+
+Builds the REAL serving + control + observability stack on one virtual
+clock — a resilience-mode TokenRouter, the gang scheduler + JAXJob
+controller over a FakeCluster, and a FleetPlane scraping all of them
+with the default AND tenant rule packs — then runs eight tenants
+against it for a fixed number of 15 s cycles:
+
+- every tenant trains (synthetic ``train.step``/``train.checkpoint``
+  spans stamped with its tenant attr) and serves steady traffic;
+- ONE noisy tenant (``tenant-3``) runs a retry storm for a window:
+  each of its requests burns retry-budget tokens twice before
+  completing, plus one outright failure per cycle;
+- ONE tenant (``tenant-6``) burns its latency SLO: its requests
+  complete above the 0.5 s target for a window;
+- mid-run a high-priority burst gang lands in ``tenant-7`` and
+  preempts two running victims (scheduler attribution under
+  contention).
+
+The bench then pulls the bill the plane renders — the
+``FleetPlane.chargeback`` ledger (conservation checked: per-tenant
+chip-second buckets must sum EXACTLY to the fleet ledger or the run
+raises), per-tenant retry/hedge spend, request outcomes, and scheduler
+admission/requeue/preemption counts — and fingerprints the decision
+log (alert transitions + the invoice). Correct attribution is asserted,
+not eyeballed: the storm must bill to the storm tenant, the burn to
+the burn tenant, and nobody else.
+
+    python tools/chargeback_bench.py          # full + smoke, write JSON
+    python tools/chargeback_bench.py --check  # CI gate: rerun the
+        # banked smoke config; fail when the decision fingerprint,
+        # invoice, attribution or op counts drift, or p99 regresses
+        # past 3x budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.control.jaxjob import types as JJ  # noqa: E402
+from kubeflow_tpu.control.jaxjob.controller import (  # noqa: E402
+    build_controller as build_jaxjob_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet  # noqa: E402
+from kubeflow_tpu.control.runtime import seed_controller  # noqa: E402
+from kubeflow_tpu.control.scheduler.nodes import new_tpu_node  # noqa: E402
+from kubeflow_tpu.control.scheduler.scheduler import build_scheduler  # noqa: E402
+from kubeflow_tpu.obs import expofmt  # noqa: E402
+from kubeflow_tpu.obs.plane import FleetPlane  # noqa: E402
+from kubeflow_tpu.obs.rules import (  # noqa: E402
+    default_rule_pack, tenant_rule_pack,
+)
+from kubeflow_tpu.obs.trace import Span, TraceCollector, Tracer  # noqa: E402
+from kubeflow_tpu.obs.tsdb import RegistryTarget  # noqa: E402
+from kubeflow_tpu.runtime.metrics import MetricsRegistry  # noqa: E402
+from kubeflow_tpu.serving.router import (  # noqa: E402
+    Member, ResilienceConfig, TokenRouter,
+)
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_TENANT_r01.json")
+
+SCRAPE_INTERVAL_S = 15.0
+TENANTS = tuple(f"tenant-{i}" for i in range(8))
+STORM_TENANT = "tenant-3"   # retry storm (noisy neighbor)
+BURN_TENANT = "tenant-6"    # latency SLO burn
+BURST_TENANT = "tenant-7"   # lands the preempting burst gang
+# chip weights per tenant (the chargeback denominators): even tenants
+# hold 4 chips, odd tenants 8 — asymmetric on purpose so the fleet
+# conservation check multiplies through unequal weights
+CHIPS_BY_TENANT = {t: 4 if i % 2 == 0 else 8
+                   for i, t in enumerate(TENANTS)}
+NODES = tuple(f"tpu-{i}" for i in range(8))
+TENANT_ALERT_RULES = ("TenantSLOBurn", "TenantRetryStorm",
+                      "TenantRequestFailures")
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(math.ceil(q * len(xs))) - 1)]
+
+
+def build_world(clock: ManualClock, seed: int) -> dict:
+    cluster = FakeCluster()
+    for name in NODES:
+        cluster.create(new_tpu_node(name, topology="2x4"))
+    sched_reg = MetricsRegistry()
+    sched_ctl = seed_controller(build_scheduler(
+        cluster, registry=sched_reg, record_events=False, clock=clock))
+    job_reg = MetricsRegistry()
+    job_ctl = seed_controller(build_jaxjob_controller(
+        cluster, record_events=False, registry=job_reg))
+    router_reg = MetricsRegistry()
+    # the router's dispatch spans go to a private collector: the
+    # plane's ledger cut must account ONLY the deterministic synthetic
+    # training spans staged below (dispatch spans carry wall-clock
+    # stamps and would not replay)
+    router = TokenRouter(
+        service="chat", namespace="default", clock=clock,
+        registry=router_reg, tracer=Tracer(TraceCollector()),
+        prom_sink=False,
+        resilience=ResilienceConfig(
+            # the storm must spend the budget, not exhaust it — and a
+            # breaker trip would turn the synchronous driver's
+            # redispatch into a queue park
+            breaker_failures=10 ** 6,
+            retry_budget_ratio=0.5, retry_budget_cap=200.0))
+    router.set_members([Member(name="replica-0", transport=None),
+                        Member(name="replica-1", transport=None)])
+    train = TraceCollector()
+    plane = FleetPlane(
+        registry=MetricsRegistry(),
+        targets=[
+            RegistryTarget("router", router_reg, labels={"job": "router"}),
+            RegistryTarget("sched", sched_reg, labels={"job": "control"}),
+            RegistryTarget("jaxjob", job_reg, labels={"job": "control"}),
+        ],
+        rules=default_rule_pack() + tenant_rule_pack(),
+        interval_s=SCRAPE_INTERVAL_S, clock=clock, collector=train,
+        max_points=256, max_series=20000)
+    kubelet = FakeKubelet(cluster)
+    # one 2-worker gang per tenant (2x4 tiles as 2 x 4-chip hosts):
+    # 8 single-host nodes hold four gangs, so four tenants requeue
+    # every pass — admission contention is the point, not an accident
+    for i, tenant in enumerate(TENANTS):
+        cluster.create(JJ.new_jaxjob(
+            f"train-{i}", namespace=tenant, replicas=2,
+            accelerator="tpu-v5-lite-podslice", topology="2x4",
+            chips_per_worker=4, gang_schedule=True))
+    return {"cluster": cluster, "router": router, "plane": plane,
+            "train": train, "sched_ctl": sched_ctl, "job_ctl": job_ctl,
+            "kubelet": kubelet, "router_reg": router_reg,
+            "sched_reg": sched_reg, "job_reg": job_reg}
+
+
+def control_tick(world: dict, rounds: int = 3) -> None:
+    for _ in range(rounds):
+        for ctl in (world["sched_ctl"], world["job_ctl"]):
+            ctl.run_until_idle(advance_delayed=True)
+        world["kubelet"].step()
+
+
+def _stage_training(train: TraceCollector, cycle: int,
+                    cycle_start: float) -> None:
+    """One cycle of synthetic per-tenant training spans on the virtual
+    clock. Every tenant steps; each checkpoints on its own staggered
+    cadence; the storm tenant's shorter step leaves visible ``other``
+    time — eight DIFFERENT goodput profiles, so the invoice has
+    something to attribute."""
+    for i, tenant in enumerate(TENANTS):
+        step_start = cycle_start + (6.0 if tenant == STORM_TENANT
+                                    else 3.0)
+        step_end = cycle_start + (12.0 if tenant == STORM_TENANT
+                                  else 14.0)
+        if cycle % 8 == i:
+            train.add(Span(
+                name="train.checkpoint", trace_id=f"trace-{tenant}",
+                span_id=f"{tenant}-c{cycle}-ckpt",
+                start=cycle_start + 1.0, end=cycle_start + 3.0,
+                attrs={"tenant": tenant, "namespace": tenant},
+                pid=0, tid=0))
+        train.add(Span(
+            name="train.step", trace_id=f"trace-{tenant}",
+            span_id=f"{tenant}-c{cycle}-step",
+            start=step_start, end=step_end,
+            attrs={"tenant": tenant, "namespace": tenant, "step": cycle},
+            pid=0, tid=0))
+
+
+def _stage_serving(world: dict, clock: ManualClock, rng: random.Random,
+                   cycle: int, cfg: dict) -> None:
+    """One cycle of synchronous router traffic. Tickets are completed
+    in latency order by advancing the shared clock — completion latency
+    is ``clock - submit``, so the histogram sees exactly the staged
+    distribution."""
+    router: TokenRouter = world["router"]
+    storm = cfg["storm_at"] <= cycle < cfg["storm_until"]
+    burn = cfg["burn_at"] <= cycle < cfg["burn_until"]
+    plan: list[tuple[float, int, object]] = []
+    seq = 0
+    for tenant in TENANTS:
+        for _ in range(3):
+            slow = burn and tenant == BURN_TENANT
+            lat = rng.uniform(0.9, 1.8) if slow \
+                else rng.uniform(0.03, 0.3)
+            plan.append((lat, seq, router.submit(40, tenant=tenant)))
+            seq += 1
+    if storm:
+        for _ in range(6):
+            t = router.submit(40, tenant=STORM_TENANT)
+            # two transport failures -> two retry-budget tokens billed
+            # to the storm tenant; capacity is free so each requeue
+            # redispatches synchronously
+            router.fail(t)
+            router.fail(t)
+            plan.append((rng.uniform(0.05, 0.3), seq, t))
+            seq += 1
+        dead = router.submit(40, tenant=STORM_TENANT)
+        router.fail(dead, requeue=False)  # outcome=failed, storm-billed
+    elapsed = 0.0
+    for lat, _seq, ticket in sorted(plan, key=lambda p: (p[0], p[1])):
+        clock.advance(lat - elapsed)
+        elapsed = lat
+        router.complete(ticket)
+
+
+def _by_tenant(registry: MetricsRegistry, name: str,
+               extra_key: str | None = None) -> dict:
+    """Sum a tenant-labeled family from a registry's exposition:
+    tenant -> value, or tenant -> {extra_label: value}."""
+    out: dict = {}
+    for s in expofmt.parse(registry.render()):
+        if s.name != name:
+            continue
+        labels = s.labels_dict()
+        tenant = labels.get("tenant")
+        if not tenant:
+            continue
+        if extra_key is None:
+            out[tenant] = out.get(tenant, 0.0) + s.value
+        else:
+            sub = out.setdefault(tenant, {})
+            k = labels.get(extra_key, "")
+            sub[k] = sub.get(k, 0.0) + s.value
+    return out
+
+
+def _invoice(world: dict, at: float, window_s: float) -> dict:
+    """The per-tenant bill: the plane's conservation-checked chargeback
+    ledger joined with retry spend, request outcomes and scheduler
+    contention counts — the JSON an operator would hand to billing."""
+    cb = world["plane"].chargeback(
+        window_s=window_s, at=at, chips_by_tenant=dict(CHIPS_BY_TENANT))
+    retry = _by_tenant(world["router_reg"],
+                       "router_tenant_retry_tokens_total",
+                       extra_key="kind")
+    outcomes = _by_tenant(world["router_reg"], "router_requests_total",
+                          extra_key="outcome")
+    admitted = _by_tenant(world["sched_reg"],
+                          "scheduler_gangs_admitted_total")
+    requeues = _by_tenant(world["sched_reg"], "scheduler_requeues_total")
+    preempted = _by_tenant(world["sched_reg"],
+                           "scheduler_preemptions_total")
+    out: dict = {}
+    for tenant in sorted(set(cb["tenants"]) | set(TENANTS)):
+        entry = cb["tenants"].get(tenant) or {}
+        good = entry.get("goodput")
+        slo = (entry.get("slo") or [{}])[0]
+        out[tenant] = {
+            "chips": CHIPS_BY_TENANT.get(tenant, 0),
+            "goodput_pct": (good or {}).get("goodput_pct"),
+            "chip_seconds_lost": (good or {}).get("chip_seconds_lost"),
+            "slo_attainment": slo.get("attainment"),
+            "slo_met": slo.get("met"),
+            "remediations": entry.get("remediations", 0),
+            "retry_tokens": {k: round(v, 6) for k, v in
+                             sorted(retry.get(tenant, {}).items())},
+            "requests": {k: round(v, 6) for k, v in
+                         sorted(outcomes.get(tenant, {}).items())
+                         if v > 0},
+            "sched": {
+                "admitted": round(admitted.get(tenant, 0.0), 6),
+                "requeues": round(requeues.get(tenant, 0.0), 6),
+                "preemptions": round(preempted.get(tenant, 0.0), 6),
+            },
+        }
+    return out
+
+
+def _assert_attribution(invoice: dict, tenant_alerts: dict) -> None:
+    """The bench's reason to exist: the storm bills to the storm
+    tenant, the burn to the burn tenant, and to NOBODY else. Raised,
+    not reported — a chargeback plane that misattributes is worse than
+    none."""
+    for tenant, bill in invoice.items():
+        spent = sum(bill["retry_tokens"].values())
+        if tenant == STORM_TENANT:
+            assert spent > 0, "storm tenant billed zero retry tokens"
+            assert bill["requests"].get("failed", 0) > 0, \
+                "storm tenant shows no failed requests"
+        else:
+            assert spent == 0, \
+                f"retry spend misattributed to {tenant}: {spent}"
+            assert bill["requests"].get("failed", 0) == 0, \
+                f"failures misattributed to {tenant}"
+        if tenant == BURN_TENANT:
+            assert bill["slo_met"] is False, \
+                "burn tenant's SLO reads as met"
+        elif tenant in CHIPS_BY_TENANT:
+            assert bill["slo_met"] is not False, \
+                f"SLO burn misattributed to {tenant}"
+    storm_alerts = tenant_alerts.get("TenantRetryStorm", [])
+    assert storm_alerts == [STORM_TENANT], \
+        f"TenantRetryStorm fired for {storm_alerts}"
+    burn_alerts = tenant_alerts.get("TenantSLOBurn", [])
+    assert burn_alerts == [BURN_TENANT], \
+        f"TenantSLOBurn fired for {burn_alerts}"
+    fail_alerts = tenant_alerts.get("TenantRequestFailures", [])
+    assert fail_alerts == [STORM_TENANT], \
+        f"TenantRequestFailures fired for {fail_alerts}"
+
+
+def run_bench(cycles: int, seed: int = 0, storm_at: int = 8,
+              storm_until: int = 22, burn_at: int = 5,
+              burn_until: int = 30, burst_at: int = 12) -> dict:
+    clock = ManualClock()
+    rng = random.Random(seed)
+    world = build_world(clock, seed)
+    cfg = {"storm_at": storm_at, "storm_until": storm_until,
+           "burn_at": burn_at, "burn_until": burn_until,
+           "burst_at": burst_at}
+    control_tick(world, rounds=4)  # settle: admit the first five gangs
+
+    plane: FleetPlane = world["plane"]
+    plane_ms: list[float] = []
+    control_ms: list[float] = []
+    transitions: list[dict] = []
+    samples_total = 0
+    for cycle in range(cycles):
+        cycle_start = clock.t
+        if cycle == burst_at:
+            # the contention event: a high-priority 2-worker gang in
+            # the burst tenant preempts two running victims
+            world["cluster"].create(JJ.new_jaxjob(
+                "burst", namespace=BURST_TENANT, replicas=2,
+                accelerator="tpu-v5-lite-podslice", topology="2x4",
+                chips_per_worker=4, gang_schedule=True, priority=100))
+        _stage_training(world["train"], cycle, cycle_start)
+        _stage_serving(world, clock, rng, cycle, cfg)
+        t0 = time.perf_counter()
+        control_tick(world)
+        t1 = time.perf_counter()
+        res = plane.tick(at=clock.t)
+        t2 = time.perf_counter()
+        control_ms.append((t1 - t0) * 1e3)
+        plane_ms.append((t2 - t1) * 1e3)
+        samples_total += res["scrape"]["samples"]
+        for tr in res["transitions"]:
+            transitions.append({"cycle": cycle, **tr})
+        clock.advance(SCRAPE_INTERVAL_S - (clock.t - cycle_start))
+
+    window_s = cycles * SCRAPE_INTERVAL_S
+    invoice = _invoice(world, at=clock.t, window_s=window_s)
+    # the chargeback call above already conservation-checked the
+    # ledger; re-prove it independently against the raw span stream so
+    # the banked "ok" is a second computation, not a copied flag
+    from kubeflow_tpu.obs import goodput as gp
+
+    gp.tenant_report(world["train"].spans(), clock.t - window_s, clock.t,
+                     chips_by_tenant=dict(CHIPS_BY_TENANT)).check()
+    tenant_alerts = {
+        rule: sorted({t["labels"].get("tenant") for t in transitions
+                      if t["alert"] == rule and t["to"] == "firing"
+                      and t["labels"].get("tenant")})
+        for rule in TENANT_ALERT_RULES}
+    _assert_attribution(invoice, tenant_alerts)
+    store_stats = plane.store.stats()
+    decision_log = json.dumps(
+        {"transitions": transitions, "invoice": invoice},
+        sort_keys=True)
+    return {
+        "config": {"cycles": cycles, "seed": seed, **cfg},
+        "series": store_stats["series"],
+        "points": store_stats["points"],
+        "appends": store_stats["appends"],
+        "samples_total": samples_total,
+        "alerts_fired": sorted({t["alert"] for t in transitions
+                                if t["to"] == "firing"}),
+        "tenant_alerts": tenant_alerts,
+        "transitions": len(transitions),
+        "invoice": invoice,
+        "conservation": "ok",
+        "decision_fingerprint": hashlib.sha256(
+            decision_log.encode()).hexdigest(),
+        # wall-clock timings live apart from the deterministic body so
+        # a double-run byte-compares everything else
+        "machine": {
+            "plane_p50_ms": round(_percentile(plane_ms, 0.50), 3),
+            "plane_p99_ms": round(_percentile(plane_ms, 0.99), 3),
+            "control_p50_ms": round(_percentile(control_ms, 0.50), 3),
+            "control_p99_ms": round(_percentile(control_ms, 0.99), 3),
+        },
+    }
+
+
+# FULL: storm and burn both open AND close (their alerts fire and
+# resolve as the rate windows slide the bad samples out). SMOKE: the
+# CI-gate config — shorter, but every attribution assert still holds.
+FULL_CONFIG = {"cycles": 48, "seed": 0, "storm_at": 8,
+               "storm_until": 22, "burn_at": 5, "burn_until": 30,
+               "burst_at": 12}
+SMOKE_CONFIG = {"cycles": 28, "seed": 0, "storm_at": 4,
+                "storm_until": 14, "burn_at": 3, "burn_until": 18,
+                "burst_at": 6}
+
+
+def check_against(banked_path: str) -> int:
+    """CI ratchet: rerun the banked smoke config. Fail (1) when the
+    decision fingerprint, the invoice, the tenant-alert attribution or
+    the op counts drift (the plane BILLED differently on identical
+    input), or when plane/control p99 regresses past 3x the committed
+    budget (floored at 250 ms so CI contention cannot flake the
+    gate)."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    smoke = banked.get("smoke")
+    if not smoke:
+        print(f"check: no smoke section in {banked_path}",
+              file=sys.stderr)
+        return 2
+    now = run_bench(**smoke["config"])
+    ok = True
+    if now["decision_fingerprint"] != smoke["decision_fingerprint"]:
+        print("check: decision fingerprint drifted "
+              f"({now['decision_fingerprint'][:12]} != banked "
+              f"{smoke['decision_fingerprint'][:12]}) — alerting or "
+              "the invoice decided differently on identical input",
+              file=sys.stderr)
+        ok = False
+    for key in ("appends", "series", "samples_total", "invoice",
+                "tenant_alerts", "conservation"):
+        if now[key] != smoke[key]:
+            print(f"check: {key} {now[key]!r} != banked {smoke[key]!r} "
+                  "(the bill must replay exactly)", file=sys.stderr)
+            ok = False
+    for key in ("plane_p99_ms", "control_p99_ms"):
+        budget = max(smoke["machine"][key] * 3.0, 250.0)
+        if now["machine"][key] > budget:
+            print(f"check: {key} {now['machine'][key]} exceeds budget "
+                  f"{budget:.3f} (banked {smoke['machine'][key]})",
+                  file=sys.stderr)
+            ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "plane_p99_ms": now["machine"]["plane_p99_ms"],
+                      "control_p99_ms": now["machine"]["control_p99_ms"],
+                      "fingerprint": now["decision_fingerprint"][:12]},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-smoke", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="rerun the banked smoke config and gate on "
+                         "fingerprint/invoice/attribution drift or a "
+                         ">3x p99 budget regression")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_against(args.out)
+
+    config = dict(FULL_CONFIG, seed=args.seed)
+    if args.cycles:
+        config["cycles"] = args.cycles
+    full = run_bench(**config)
+    result = {"bench": "chargeback_bench", "round": "r01", "full": full}
+    if not args.no_smoke:
+        result["smoke"] = run_bench(**SMOKE_CONFIG)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({
+        "out": args.out,
+        "tenant_alerts": full["tenant_alerts"],
+        "storm_bill": full["invoice"][STORM_TENANT]["retry_tokens"],
+        "burn_slo": full["invoice"][BURN_TENANT]["slo_attainment"],
+        "plane_p99_ms": full["machine"]["plane_p99_ms"]}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

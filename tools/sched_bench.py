@@ -223,6 +223,44 @@ def _admitted_total(registry: MetricsRegistry) -> int:
     return total
 
 
+def _tenant_summary(registry: MetricsRegistry) -> dict:
+    """Per-tenant admission-latency / preemption / requeue cut, parsed
+    from the tenant-labeled scheduler series through the ONE exposition
+    parser. Observability only: the scheduler's decisions (and the
+    banked bindings fingerprint) are identical with or without this
+    read."""
+    from kubeflow_tpu.obs import expofmt
+
+    out: dict[str, dict] = {}
+
+    def row(tenant: str) -> dict:
+        return out.setdefault(tenant, {
+            "admitted": 0, "preemptions": 0, "requeues": 0,
+            "_lat_sum": 0.0, "_lat_count": 0})
+
+    for s in expofmt.parse(registry.render()):
+        labels = s.labels_dict()
+        tenant = labels.get("tenant")
+        if not tenant:
+            continue
+        if s.name == "scheduler_gangs_admitted_total":
+            row(tenant)["admitted"] += int(s.value)
+        elif s.name == "scheduler_preemptions_total":
+            row(tenant)["preemptions"] += int(s.value)
+        elif s.name == "scheduler_requeues_total":
+            row(tenant)["requeues"] += int(s.value)
+        elif s.name == "scheduler_bind_latency_seconds_sum":
+            row(tenant)["_lat_sum"] += s.value
+        elif s.name == "scheduler_bind_latency_seconds_count":
+            row(tenant)["_lat_count"] += int(s.value)
+    for r in out.values():
+        n = r.pop("_lat_count")
+        total = r.pop("_lat_sum")
+        r["bound"] = n
+        r["admission_latency_mean_s"] = round(total / n, 6) if n else 0.0
+    return dict(sorted(out.items()))
+
+
 def bindings_fingerprint(cluster: FakeCluster) -> dict[str, str | None]:
     """(namespace/pod) -> node for every scheduler pod — the two arms
     must agree exactly (no semantic drift from the indexed rewrite)."""
@@ -318,6 +356,7 @@ def run_bench(nodes: int, gangs: int, pods: int, seed: int = 0,
                           "get", "patch", "update", "create", "delete")},
         "scan_per_pass": round(stats.get("list_scanned", 0) / passes, 2),
         "copies_per_pass": round(stats.get("list_copied", 0) / passes, 2),
+        "tenants": _tenant_summary(registry),
         "bindings": bindings_fingerprint(cluster),
     }
 
