@@ -48,6 +48,10 @@ from kubeflow_tpu.analysis.core import Finding, Module, Rule, register
 
 ANNOTATION_KEY_OWNERS = {
     "jaxservice.kubeflow.org/endpoints": "kubeflow_tpu/serving/router.py",
+    # the rollout revision label: routers, benches and operators match
+    # on it, so its spelling is a wire contract pinned to ONE owner
+    "jaxservice.kubeflow.org/revision":
+        "kubeflow_tpu/control/jaxservice/types.py",
 }
 ANNOTATION_PREFIX_OWNERS = {
     "jaxjob.kubeflow.org/": "kubeflow_tpu/control/jaxjob/types.py",

@@ -95,11 +95,29 @@ def replica_restarts_total():
                    "replicas reaped and re-provisioned after dying")
 
 
+# numeric encoding for the jaxservice_rollout_phase gauge
+ROLLOUT_PHASE_VALUE = {p: i for i, p in enumerate(T.ROLLOUT_PHASES)}
+
+
+def rollouts_total():
+    return _metric("jaxservice_rollouts_total", prom.Counter,
+                   "rollouts finished by outcome "
+                   "(promoted/rolled_back/aborted)",
+                   labelnames=("service", "outcome"))
+
+
+def rollout_phase_gauge():
+    return _metric("jaxservice_rollout_phase", prom.Gauge,
+                   "rollout state-machine position "
+                   "(0=idle 1=surge 2=analyze 3=promote 4=rollback)",
+                   labelnames=("service",))
+
+
 class JAXServiceReconciler(Reconciler):
     def __init__(self, record_events: bool = True,
                  registry: MetricsRegistry | None = None,
                  signals=None, clock=time.monotonic, cache=None,
-                 store=None):
+                 store=None, rollout_analysis=None):
         self.record_events = record_events
         self.registry = registry if registry is not None else REGISTRY
         # autoscaling signal source (serving.router.RegistrySignals
@@ -125,10 +143,20 @@ class JAXServiceReconciler(Reconciler):
         # hysteresis pending-direction window. In-memory on purpose — a
         # controller restart just re-observes demand for one window.
         self._scale_state: dict[tuple[str, str], dict] = {}
+        # the canary-analysis gate: callable(namespace, service,
+        # baseline_rev, canary_rev, now) -> bool (healthy). None =
+        # rollouts advance on the time ladder alone (no analysis
+        # plane wired). obs/rules.py CanaryAnalysis matches the shape.
+        self.rollout_analysis = rollout_analysis
         # cordon observation times for the signal-less drain grace,
-        # keyed (namespace, pod). In-memory: a controller restart
-        # restarts the grace, which only ever drains LONGER.
+        # keyed (namespace, pod) — the LEGACY fallback: the durable
+        # path persists the deadline as a pod annotation
+        # (ANNOTATION_DRAIN_DEADLINE), so controller restarts resume
+        # the countdown instead of restarting it.
         self._drain_started: dict[tuple[str, str], float] = {}
+        # services whose jaxservice_rollouts_total outcome labels are
+        # pre-registered at 0 (the first-failure tripwire discipline)
+        self._rollout_registered: set[tuple[str, str]] = set()
 
     # -- trace propagation (the jaxjob discipline) --------------------------
 
@@ -190,9 +218,20 @@ class JAXServiceReconciler(Reconciler):
             cmd += ["--max-inflight", str(res["maxInflight"])]
         return cmd
 
-    def generate_pod(self, svc: dict, index: int) -> dict:
+    def generate_pod(self, svc: dict, index: int,
+                     revision: str | None = None) -> dict:
         m = ob.meta(svc)
         spec = svc.get("spec") or {}
+        # revision pinning: a rollout provisions pods for a SPECIFIC
+        # revision — when it is not the live spec's (surge pods while
+        # the base still runs the old revision, or a rollback after the
+        # spec moved on), generate from the status snapshot that minted
+        # it. Default (None) shapes from the live spec.
+        rev = revision if revision is not None else T.revision_hash(spec)
+        if revision is not None and T.revision_hash(spec) != revision:
+            snap = T.revisions_status(svc)["snapshots"].get(revision)
+            if isinstance(snap, dict):
+                spec = snap
         name = T.replica_name(m["name"], index)
         tmpl = ob.deep_copy(spec.get("template") or {"spec": {"containers": [
             {"name": "serving", "image": spec.get(
@@ -233,6 +272,7 @@ class JAXServiceReconciler(Reconciler):
             **(tmpl.get("metadata", {}).get("labels") or {}),
             T.LABEL_SERVICE_NAME: m["name"],
             T.LABEL_REPLICA_INDEX: str(index),
+            T.LABEL_REVISION: rev,
         }
         annotations = dict(tmpl.get("metadata", {}).get("annotations") or {})
         if spec.get("schedulerName"):
@@ -287,24 +327,376 @@ class JAXServiceReconciler(Reconciler):
     def _cordoned(pod: dict) -> bool:
         return ob.annotations_of(pod).get(T.ANNOTATION_CORDON) == "true"
 
+    @staticmethod
+    def _pod_revision(pod: dict) -> str:
+        return ((ob.meta(pod).get("labels") or {})
+                .get(T.LABEL_REVISION, ""))
+
+    def _cordon_pod(self, client, req, name: str, drain_s: float) -> dict:
+        """Cordon a pod AND stamp its drain DEADLINE (now + grace, on
+        the controller clock) as an annotation — durable drain grace:
+        a restarted controller resumes the countdown from the pod
+        instead of restarting its in-memory timer. Raises NotFound
+        like a bare patch would."""
+        deadline = self.clock() + drain_s
+        return client.patch(
+            "v1", "Pod", name,
+            {"metadata": {"annotations": {
+                T.ANNOTATION_CORDON: "true",
+                T.ANNOTATION_DRAIN_DEADLINE: f"{deadline:.6f}"}}},
+            req.namespace)
+
     def _replica_drained(self, namespace: str, service: str,
                          pod: dict, drain_s: float) -> bool:
         """Delete gate for a cordoned replica: a pod that is not
         Running holds no connections; a Running one must read zero on
         the router's in-flight gauge, or — when no signal plane is
         wired (the production run_controller default) — outlive the
-        spec.drainSeconds grace measured from the first reconcile that
-        saw it cordoned. The router keeps routing regardless of the
-        controller's gauge access, so signal-less can never mean
-        "nothing in flight"."""
+        spec.drainSeconds grace. The grace is read from the pod's
+        persisted deadline annotation when present (controller
+        restarts RESUME the countdown); legacy cordons without one
+        fall back to the in-memory timer, which a restart restarts —
+        only ever draining LONGER. The router keeps routing regardless
+        of the controller's gauge access, so signal-less can never
+        mean "nothing in flight"."""
         if (pod.get("status") or {}).get("phase") != "Running":
             return True
         name = ob.meta(pod)["name"]
         if self.signals is not None:
             return self.signals.replica_drained(namespace, service, name)
+        now = self.clock()
+        raw = ob.annotations_of(pod).get(T.ANNOTATION_DRAIN_DEADLINE)
+        if raw is not None:
+            try:
+                deadline = float(raw)
+            except (TypeError, ValueError):
+                deadline = None
+            # a deadline further out than one full grace means the
+            # clock rebased under the annotation (the controller moved
+            # hosts; monotonic clocks are boot-relative) — fall through
+            # to the in-memory grace rather than holding forever
+            if deadline is not None and deadline - now <= drain_s:
+                return now >= deadline
         key = (namespace, name)
-        started = self._drain_started.setdefault(key, self.clock())
-        return self.clock() - started >= drain_s
+        started = self._drain_started.setdefault(key, now)
+        return now - started >= drain_s
+
+    # -- rollout state machine ----------------------------------------------
+
+    def _register_rollout_metrics(self, req) -> None:
+        """Pre-register every rollout outcome at 0 on first sight of a
+        service, so ``rate()``/``increase()`` have a zero sample BEFORE
+        the first abort (the first-failure tripwire discipline)."""
+        key = (req.namespace, req.name)
+        if key in self._rollout_registered:
+            return
+        self._rollout_registered.add(key)
+        for outcome in T.ROLLOUT_OUTCOMES:
+            self.registry.counter_inc(
+                "jaxservice_rollouts_total", by=0.0,
+                help_="rollouts finished by outcome "
+                      "(promoted/rolled_back/aborted)",
+                namespace=req.namespace, service=req.name,
+                tenant=req.namespace, outcome=outcome)
+            rollouts_total().labels(req.name, outcome).inc(0)
+
+    def _rollout_outcome(self, req, outcome: str) -> None:
+        self.registry.counter_inc(
+            "jaxservice_rollouts_total",
+            help_="rollouts finished by outcome "
+                  "(promoted/rolled_back/aborted)",
+            namespace=req.namespace, service=req.name,
+            tenant=req.namespace, outcome=outcome)
+        rollouts_total().labels(req.name, outcome).inc()
+
+    def _abort_rollout(self, client, svc, req, rev, now: float) -> None:
+        """Failed analysis with autoRollback: flip the machine to
+        Rollback toward the previous revision, pin the bad revision as
+        ``aborted`` (sticky — not re-attempted until the spec changes
+        again), record-FIRST."""
+        bad = rev["target"]
+        rev.update(aborted=bad, target=rev["previous"] or rev["current"],
+                   phase=T.PHASE_ROLLBACK, step=0, stepStartedAt=now,
+                   held=False)
+        if (svc["status"].get("revisions") or {}) != rev:
+            svc["status"]["revisions"] = rev
+            self._write_status(client, svc)
+        self._rollout_outcome(req, "aborted")
+        if self.record_events:
+            client.record_event(
+                svc, "RolloutAborted",
+                f"canary revision {bad} failed analysis; rolling back "
+                f"to {rev['target']}", "Warning")
+
+    def _replace_mismatched(self, client, svc, req, by_name, phases,
+                            indices, want_rev: str, batch: int,
+                            drain_s: float) -> int:
+        """Walk the index range; cordon -> drain -> delete pods whose
+        revision label differs from ``want_rev``, keeping at most
+        ``batch`` slots disrupted at once (capacity never
+        oversubscribed). Deleted slots are re-provisioned at
+        ``want_rev`` by the provisioning loop later this same
+        reconcile. Pod labels ARE the migration state — an interrupted
+        walk (controller crash mid-rollout) resumes for free. Returns
+        the number of slots currently disrupted."""
+        busy = 0
+        for i in indices:
+            name = T.replica_name(req.name, i)
+            pod = by_name.get(name)
+            if pod is None or phases.get(name) != "Running" \
+                    or self._cordoned(pod):
+                busy += 1
+        for i in indices:
+            name = T.replica_name(req.name, i)
+            pod = by_name.get(name)
+            if pod is None or self._pod_revision(pod) == want_rev:
+                continue
+            if not self._cordoned(pod):
+                if busy >= batch:
+                    continue
+                try:
+                    patched = self._cordon_pod(client, req, name, drain_s)
+                    by_name[name] = patched
+                    if self.cache is not None:
+                        self.cache.note_write(patched)
+                except ob.NotFound:
+                    by_name.pop(name, None)
+                    continue
+                busy += 1
+                if self.record_events:
+                    client.record_event(
+                        svc, "ReplicaCordoned",
+                        f"{name} cordoned for rollout replacement "
+                        f"(-> {want_rev})")
+            elif self._replica_drained(req.namespace, req.name, pod,
+                                       drain_s):
+                try:
+                    client.delete("v1", "Pod", name, req.namespace)
+                except (ob.NotFound, ob.ApiError):
+                    pass
+                if self.cache is not None:
+                    self.cache.note_delete(pod)
+                self._drain_started.pop((req.namespace, name), None)
+                by_name.pop(name, None)
+                phases.pop(name, None)
+                if self.record_events:
+                    client.record_event(
+                        svc, "ReplicaRemoved",
+                        f"{name} drained and replaced (-> {want_rev})")
+        return busy
+
+    def _reconcile_rollout(self, client, svc, req, target: int,
+                           by_name, phases) -> dict:
+        """Drive the surge -> canary-analyze -> promote | rollback
+        machine. Every transition lands in status.revisions BEFORE any
+        pod is touched (record-FIRST), so an interrupted rollout
+        re-enters idempotently from status. Returns the provisioning
+        plan for the rest of the reconcile: how many slots to keep
+        ({provision_upto}), which revision each slot runs
+        ({revision_for}), and the canary split the endpoints should
+        publish ({canary})."""
+        spec = svc.get("spec") or {}
+        status = svc["status"]
+        roll = T.rollout_spec(spec)
+        rev = T.revisions_status(svc)
+        spec_rev = T.revision_hash(spec)
+        surge = max(int(roll["maxSurge"]), 1)
+        drain_s = T.drain_seconds(spec)
+        now = self.clock()
+        self._register_rollout_metrics(req)
+
+        if not rev["current"]:
+            # first sight: adopt the live spec as the current revision
+            # (no rollout — existing unlabeled pods are grandfathered)
+            rev["current"] = rev["target"] = spec_rev
+            rev["snapshots"] = {spec_rev: ob.deep_copy(spec)}
+            status["revisions"] = rev
+            self._write_status(client, svc)
+
+        # keep the idle snapshot fresh: hash-equal spec edits (replica
+        # bounds, autoscaling windows) must not leave a stale rollback
+        # source. Rides the final status write — any snapshot that
+        # hashes to current generates equivalent pods.
+        if rev["phase"] == T.PHASE_IDLE and rev["current"] == spec_rev \
+                and rev["snapshots"].get(spec_rev) != spec:
+            rev["snapshots"] = {spec_rev: ob.deep_copy(spec)}
+            status["revisions"] = rev
+
+        # a new shaping revision starts a rollout — unless it is the
+        # sticky aborted one (a failed canary is not retried until the
+        # spec moves again). A mid-rollout spec revert re-targets the
+        # machine the same way: rollback IS a rollout whose target is
+        # the previous revision.
+        if spec_rev != rev["target"] and spec_rev != rev["aborted"]:
+            snaps = dict(rev["snapshots"])
+            snaps[spec_rev] = ob.deep_copy(spec)
+            keep = {rev["current"], spec_rev}
+            old = rev["current"]
+            rev.update(
+                snapshots={r: s for r, s in snaps.items() if r in keep},
+                previous=rev["current"], target=spec_rev,
+                phase=T.PHASE_SURGE, step=0, stepStartedAt=now,
+                aborted="", held=False)
+            status["revisions"] = rev
+            self._write_status(client, svc)  # record-FIRST
+            if self.record_events:
+                client.record_event(
+                    svc, "RolloutStarted",
+                    f"rolling out revision {spec_rev} (from {old})")
+
+        steps = [float(w) for w in roll["canarySteps"]]
+        canary: tuple[str, float] | None = None
+
+        if rev["phase"] == T.PHASE_SURGE:
+            # surge replicas run the incoming revision at weight 0 (in
+            # membership, taking no preferred traffic) until all are
+            # Running — then analysis opens
+            canary = (rev["target"], 0.0)
+            names = [T.replica_name(req.name, i)
+                     for i in range(target, target + surge)]
+            stale = [n for n in names if n in by_name
+                     and self._pod_revision(by_name[n]) != rev["target"]]
+            if stale:
+                # leftovers from an interrupted earlier rollout: replace
+                self._replace_mismatched(
+                    client, svc, req, by_name, phases,
+                    range(target, target + surge), rev["target"],
+                    surge, drain_s)
+            elif all(n in by_name and phases.get(n) == "Running"
+                     and not self._cordoned(by_name[n]) for n in names):
+                rev.update(phase=T.PHASE_ANALYZE, stepStartedAt=now)
+                status["revisions"] = rev
+                self._write_status(client, svc)
+                canary = (rev["target"], steps[0])
+                if self.record_events:
+                    client.record_event(
+                        svc, "RolloutAnalyzing",
+                        f"canary {rev['target']} serving at weight "
+                        f"{steps[0]:g}")
+
+        elif rev["phase"] == T.PHASE_ANALYZE:
+            step = min(rev["step"], len(steps) - 1)
+            weight = steps[step]
+            canary = (rev["target"], weight)
+            healthy = True
+            if self.rollout_analysis is not None:
+                healthy = bool(self.rollout_analysis(
+                    req.namespace, req.name, rev["current"],
+                    rev["target"], now))
+            if not healthy:
+                if roll["autoRollback"]:
+                    self._abort_rollout(client, svc, req, rev, now)
+                    canary = ((rev["aborted"], 0.0)
+                              if rev["aborted"] else None)
+                elif not rev["held"]:
+                    # autoRollback off: freeze at this weight until the
+                    # spec changes; fire the audit trail exactly once
+                    rev["held"] = True
+                    status["revisions"] = rev
+                    self._write_status(client, svc)
+                    self._rollout_outcome(req, "aborted")
+                    if self.record_events:
+                        client.record_event(
+                            svc, "RolloutAborted",
+                            f"canary revision {rev['target']} failed "
+                            f"analysis at weight {weight:g}; "
+                            "autoRollback off — holding", "Warning")
+            elif not rev["held"] and \
+                    now - rev["stepStartedAt"] >= \
+                    roll["analysisWindowSeconds"]:
+                rev["step"] = step + 1
+                rev["stepStartedAt"] = now
+                if rev["step"] >= len(steps):
+                    rev["phase"] = T.PHASE_PROMOTE
+                    canary = None
+                else:
+                    canary = (rev["target"], steps[rev["step"]])
+                status["revisions"] = rev
+                self._write_status(client, svc)
+                if self.record_events:
+                    if rev["phase"] == T.PHASE_PROMOTE:
+                        client.record_event(
+                            svc, "RolloutPromoting",
+                            f"canary {rev['target']} healthy through "
+                            "the ladder; replacing the base fleet")
+                    else:
+                        client.record_event(
+                            svc, "RolloutStepAdvanced",
+                            f"canary {rev['target']} weight -> "
+                            f"{steps[rev['step']]:g}")
+
+        if rev["phase"] in (T.PHASE_PROMOTE, T.PHASE_ROLLBACK):
+            if rev["phase"] == T.PHASE_ROLLBACK and rev["aborted"]:
+                # steer traffic off the aborted revision while its
+                # replicas are replaced (availability still beats it)
+                canary = (rev["aborted"], 0.0)
+            span_count = target + (surge if rev["phase"]
+                                   == T.PHASE_PROMOTE else 0)
+            batch = max(1, surge + max(int(roll["maxUnavailable"]), 0))
+            self._replace_mismatched(
+                client, svc, req, by_name, phases, range(span_count),
+                rev["target"], batch, drain_s)
+            base = [T.replica_name(req.name, i) for i in range(target)]
+            base_ok = all(
+                n in by_name and phases.get(n) == "Running"
+                and self._pod_revision(by_name[n]) == rev["target"]
+                and not self._cordoned(by_name[n]) for n in base)
+            extras = [n for n, p in by_name.items()
+                      if self._pod_revision(p) != rev["target"]]
+            if base_ok and not extras:
+                outcome = ("promoted" if rev["phase"] == T.PHASE_PROMOTE
+                           else "rolled_back")
+                if outcome == "promoted":
+                    rev["previous"] = rev["current"]
+                    rev["current"] = rev["target"]
+                snap = rev["snapshots"].get(rev["current"])
+                rev.update(
+                    snapshots={rev["current"]:
+                               (snap if snap is not None
+                                else ob.deep_copy(spec))},
+                    phase=T.PHASE_IDLE, step=0, stepStartedAt=now,
+                    held=False)
+                status["revisions"] = rev
+                self._write_status(client, svc)
+                self._rollout_outcome(req, outcome)
+                canary = None
+                if self.record_events:
+                    if outcome == "promoted":
+                        client.record_event(
+                            svc, "RolloutPromoted",
+                            f"revision {rev['current']} promoted to "
+                            "the full fleet")
+                    else:
+                        client.record_event(
+                            svc, "RolloutRolledBack",
+                            f"fleet back on revision {rev['current']} "
+                            f"(rolled back from {rev['aborted']})",
+                            "Warning")
+
+        phase = rev["phase"]
+        self.registry.gauge(
+            "jaxservice_rollout_phase", ROLLOUT_PHASE_VALUE[phase],
+            help_="rollout state-machine position "
+                  "(0=idle 1=surge 2=analyze 3=promote 4=rollback)",
+            namespace=req.namespace, service=req.name)
+        rollout_phase_gauge().labels(req.name).set(
+            ROLLOUT_PHASE_VALUE[phase])
+
+        upto = (target + surge
+                if phase in (T.PHASE_SURGE, T.PHASE_ANALYZE,
+                             T.PHASE_PROMOTE) else target)
+        cur_rev, target_rev = rev["current"], rev["target"]
+
+        def revision_for(i: int) -> str:
+            if phase in (T.PHASE_PROMOTE, T.PHASE_ROLLBACK):
+                return target_rev
+            if i >= target:  # surge slots run the incoming revision
+                return target_rev
+            return cur_rev
+
+        return {"active": phase != T.PHASE_IDLE, "phase": phase,
+                "target_rev": target_rev, "provision_upto": upto,
+                "revision_for": revision_for, "canary": canary}
 
     # -- reconcile ----------------------------------------------------------
 
@@ -317,6 +709,7 @@ class JAXServiceReconciler(Reconciler):
             # deleted; ownerRef GC reaps replicas. Drop autoscaler and
             # drain-grace memory
             self._scale_state.pop((req.namespace, req.name), None)
+            self._rollout_registered.discard((req.namespace, req.name))
             prefix = req.name + "-replica-"
             for k in [k for k in self._drain_started
                       if k[0] == req.namespace and k[1].startswith(prefix)]:
@@ -392,6 +785,15 @@ class JAXServiceReconciler(Reconciler):
             target = new_target
         span.attrs["target"] = target
 
+        # -- rollout state machine (surge/canary/promote/rollback):
+        # transitions are status-durable record-FIRST; the returned
+        # plan tells the loops below how many slots to keep and which
+        # revision each runs ------------------------------------------
+        rollout = self._reconcile_rollout(client, svc, req, target,
+                                          by_name, phases)
+        upto = rollout["provision_upto"]
+        span.attrs["rollout_phase"] = rollout["phase"]
+
         # -- grow-back: a replica cordoned for a scale-down that was
         # reversed before its drain completed returns to service (the
         # uncordon arrow in docs/serving.md) — otherwise nothing ever
@@ -402,11 +804,17 @@ class JAXServiceReconciler(Reconciler):
             pod = by_name.get(name)
             if pod is None or not self._cordoned(pod):
                 continue
+            if rollout["active"] and \
+                    self._pod_revision(pod) != rollout["revision_for"](i):
+                # cordoned for rollout REPLACEMENT, not scale-down:
+                # let it drain out
+                continue
             try:
                 patched = client.patch(
                     "v1", "Pod", name,
                     {"metadata": {"annotations": {
-                        T.ANNOTATION_CORDON: "false"}}},
+                        T.ANNOTATION_CORDON: "false",
+                        T.ANNOTATION_DRAIN_DEADLINE: None}}},
                     req.namespace)
                 by_name[name] = patched
                 if self.cache is not None:
@@ -420,9 +828,10 @@ class JAXServiceReconciler(Reconciler):
                     svc, "ReplicaUncordoned",
                     f"{name} returned to service (scale-down reversed)")
 
-        # -- reap dead replicas below target (re-provision at same index) --
+        # -- reap dead replicas below the provisioning line (surge
+        # slots included) — re-provision at same index ----------------
         restarted = 0
-        for i in range(target):
+        for i in range(upto):
             name = T.replica_name(req.name, i)
             pod = by_name.get(name)
             if pod is not None and phases[name] in ("Failed", "Succeeded") \
@@ -454,15 +863,18 @@ class JAXServiceReconciler(Reconciler):
                     "Warning")
             # names must free before recreation — poll again shortly
             self._publish_status(client, svc, req, by_name, phases,
-                                 target, prev_status)
+                                 target, prev_status, rollout)
             return Result(requeue_after=_REQUEUE_FAST)
 
-        # -- provision missing replicas below target -----------------------
-        for i in range(target):
+        # -- provision missing replicas below the line (surge slots
+        # run the incoming revision; a rollback re-pins the slot to
+        # the snapshot of the revision it is converging to) ----------
+        for i in range(upto):
             name = T.replica_name(req.name, i)
             if name in by_name:
                 continue
-            pod = self.generate_pod(svc, i)
+            pod = self.generate_pod(svc, i,
+                                    revision=rollout["revision_for"](i))
             ob.set_owner(pod, svc)
             try:
                 created = client.create(pod)
@@ -474,21 +886,21 @@ class JAXServiceReconciler(Reconciler):
             if self.cache is not None:
                 self.cache.note_write(created)
 
-        # -- scale-down drain: indices >= target (the replica_index sort
-        # sentinel puts malformed leftovers here too — drained away, not
-        # aliased to a real slot) --------------------------------------
+        # -- scale-down drain: indices >= the provisioning line (the
+        # replica_index sort sentinel puts malformed leftovers here too
+        # — drained away, not aliased to a real slot). Surge replicas
+        # retire through this same path once a rollout completes (or
+        # rolls back) and the line drops back to target ----------------
         draining = 0
         for name in sorted(by_name, key=T.replica_index):
-            if T.replica_index(name) < target:
+            if T.replica_index(name) < upto:
                 continue
             pod = by_name[name]
             if not self._cordoned(pod):
                 try:
-                    patched = client.patch(
-                        "v1", "Pod", name,
-                        {"metadata": {"annotations": {
-                            T.ANNOTATION_CORDON: "true"}}},
-                        req.namespace)
+                    patched = self._cordon_pod(
+                        client, req, name,
+                        T.drain_seconds(svc.get("spec") or {}))
                     by_name[name] = patched
                     if self.cache is not None:
                         self.cache.note_write(patched)
@@ -521,14 +933,14 @@ class JAXServiceReconciler(Reconciler):
         span.attrs["draining"] = draining
 
         res = self._publish_status(client, svc, req, by_name, phases,
-                                   target, prev_status)
+                                   target, prev_status, rollout)
         span.attrs["ready"] = (status.get("replicas") or {}).get("ready", 0)
         return res
 
     # -- status + endpoints --------------------------------------------------
 
     def _publish_status(self, client, svc, req, by_name, phases, target,
-                        prev_status) -> Result | None:
+                        prev_status, rollout=None) -> Result | None:
         status = svc["status"]
         ready, pending, cordoned = [], [], []
         for name in sorted(by_name, key=T.replica_index):
@@ -550,7 +962,8 @@ class JAXServiceReconciler(Reconciler):
             n: ("Cordoned" if n in cordoned
                 else phases.get(n, "Pending")) for n in sorted(
                 by_name, key=T.replica_index)}
-        all_ready = len(ready) == target and not pending
+        # surge replicas count toward ready during a rollout: >= not ==
+        all_ready = len(ready) >= target and not pending
         ob.cond_set(svc, T.COND_READY,
                     "True" if all_ready else "False",
                     "AllReplicasReady" if all_ready else "ReplicasPending",
@@ -558,13 +971,20 @@ class JAXServiceReconciler(Reconciler):
         if ob.cond_is_true(svc, T.COND_DEGRADED):
             ob.cond_set(svc, T.COND_DEGRADED, "False", "Recovered", "")
 
-        self._publish_endpoints(client, svc, req, ready, cordoned, by_name)
+        self._publish_endpoints(
+            client, svc, req, ready, cordoned, by_name,
+            canary=(rollout or {}).get("canary"))
         self._publish_gauges(req, target, ready, pending, cordoned)
 
         if svc.get("status") != prev_status:
             self._write_status(client, svc)
         if pending or cordoned:
             return Result(requeue_after=_REQUEUE_FAST)
+        if rollout is not None and rollout["active"]:
+            # an analysis window only elapses if someone re-looks: an
+            # active rollout keeps the reconcile scheduled even when
+            # the replica set is momentarily steady
+            return Result(requeue_after=_REQUEUE_POLL)
         if self.signals is not None:
             # the signal plane is pull-only: keep sampling for the
             # autoscaler even when the replica set is steady
@@ -572,26 +992,39 @@ class JAXServiceReconciler(Reconciler):
         return None
 
     def _publish_endpoints(self, client, svc, req, ready, cordoned,
-                           by_name) -> None:
+                           by_name, canary=None) -> None:
         """Stamp the router-consumed endpoint list; no-op when the
         rendered JSON is byte-identical (every write is a watch event —
-        the PR 5 status-storm lesson)."""
+        the PR 5 status-storm lesson). Entries carry the pod's revision
+        label; while a rollout analyzes, the canaried revision's ACTIVE
+        entries also carry the ladder weight — the router derives its
+        deterministic split from them."""
         port = (svc.get("spec") or {}).get("port", T.DEFAULT_PORT)
         eps = []
         for name in ready:
-            eps.append({"name": name,
-                        "addr": f"http://{name}.{req.name}."
-                                f"{req.namespace}.svc:{port}",
-                        "state": T.STATE_ACTIVE})
+            ep = {"name": name,
+                  "addr": f"http://{name}.{req.name}."
+                          f"{req.namespace}.svc:{port}",
+                  "state": T.STATE_ACTIVE}
+            rev = self._pod_revision(by_name[name])
+            if rev:
+                ep["revision"] = rev
+                if canary is not None and rev == canary[0]:
+                    ep["canary"] = canary[1]
+            eps.append(ep)
         for name in cordoned:
             # only a live cordoned replica still drains; terminal ones
             # are awaiting deletion and must leave membership entirely
             if (by_name[name].get("status") or {}).get("phase") \
                     == "Running":
-                eps.append({"name": name,
-                            "addr": f"http://{name}.{req.name}."
-                                    f"{req.namespace}.svc:{port}",
-                            "state": T.STATE_CORDONED})
+                ep = {"name": name,
+                      "addr": f"http://{name}.{req.name}."
+                              f"{req.namespace}.svc:{port}",
+                      "state": T.STATE_CORDONED}
+                rev = self._pod_revision(by_name[name])
+                if rev:
+                    ep["revision"] = rev
+                eps.append(ep)
         rendered = render_endpoints(eps)
         m = ob.meta(svc)
         if (m.get("annotations") or {}).get(T.ANNOTATION_ENDPOINTS) \
@@ -735,7 +1168,8 @@ class JAXServiceReconciler(Reconciler):
 
 def build_controller(client, record_events: bool = True, registry=None,
                      signals=None, clock=time.monotonic,
-                     cache: bool = True, store=None) -> Controller:
+                     cache: bool = True, store=None,
+                     rollout_analysis=None) -> Controller:
     """``cache=True`` (default) reads replica pods from an indexed
     ``ClusterCache`` keyed on the service label — zero per-reconcile
     list calls (the ISSUE 7 discipline, pinned in tests)."""
@@ -749,7 +1183,8 @@ def build_controller(client, record_events: bool = True, registry=None,
     rec = JAXServiceReconciler(record_events=record_events,
                                registry=registry, signals=signals,
                                clock=clock, cache=cluster_cache,
-                               store=store)
+                               store=store,
+                               rollout_analysis=rollout_analysis)
     ctl = Controller("jaxservice", client, rec, registry=registry)
     if cluster_cache is not None:
         ctl.uses(cluster_cache)

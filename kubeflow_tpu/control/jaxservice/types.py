@@ -21,6 +21,9 @@ the router consumes (docs/serving.md).
 
 from __future__ import annotations
 
+import hashlib
+import json
+
 from kubeflow_tpu.control.k8s import objects as ob
 from kubeflow_tpu.control.scheduler import SCHEDULER_NAME
 from kubeflow_tpu.control.scheduler.topology import parse_topology
@@ -51,11 +54,26 @@ COND_DEGRADED = "Degraded"
 LABEL_SERVICE_NAME = "jaxservice.kubeflow.org/service-name"
 LABEL_REPLICA_INDEX = "jaxservice.kubeflow.org/replica-index"
 
+# Revision identity on replica PODS: the content-addressed hash of the
+# pod-shaping spec fields (``revision_hash``). The rollout state machine
+# keys every decision off this label — which replicas are old, which are
+# the surge canary — and the router stamps it on request metrics so
+# canary-vs-baseline burn is measurable (docs/serving.md, "Safe
+# rollouts").
+LABEL_REVISION = "jaxservice.kubeflow.org/revision"
+
 # Scale-down drain marker on replica PODS: a cordoned replica is
 # published to the router as state=cordoned (no new work), the
 # controller deletes it only once the router reports zero in-flight
 # tokens for it — the drain state machine in docs/serving.md.
 ANNOTATION_CORDON = "jaxservice.kubeflow.org/cordon"
+
+# Durable drain deadline on cordoned replica PODS: the absolute
+# controller-clock time after which a signal-less drain may delete the
+# pod. Persisted so a controller restart RESUMES the countdown instead
+# of restarting it (the in-memory timer of PR 8 only ever drained
+# longer; this makes the grace exact across restarts).
+ANNOTATION_DRAIN_DEADLINE = "jaxservice.kubeflow.org/drain-deadline"
 
 # One-shot replica floor on the JAXSERVICE, written by the alert-driven
 # remediation engine (obs/remediate.py, KVPagesExhausted -> scale up).
@@ -86,6 +104,30 @@ DEFAULT_DOWN_STABILIZATION_S = 30.0
 # is held this long after cordon before deletion. With signals wired,
 # the router's per-replica in-flight gauge gates the delete instead.
 DEFAULT_DRAIN_SECONDS = 60.0
+
+# Rollout defaults: one surge replica at a time, never dip below the
+# target (maxUnavailable=0), a 10% -> 50% -> 100% canary ladder, and a
+# 60 s analysis window per step with automatic rollback armed.
+DEFAULT_MAX_SURGE = 1
+DEFAULT_MAX_UNAVAILABLE = 0
+DEFAULT_CANARY_STEPS = (0.1, 0.5, 1.0)
+DEFAULT_ANALYSIS_WINDOW_S = 60.0
+
+# Rollout phases recorded in status.revisions.phase — the rollout state
+# machine (docs/serving.md, "Safe rollouts"). Idle means current ==
+# target (no rollout in flight).
+PHASE_IDLE = "Idle"
+PHASE_SURGE = "Surge"
+PHASE_ANALYZE = "Analyze"
+PHASE_PROMOTE = "Promote"
+PHASE_ROLLBACK = "Rollback"
+ROLLOUT_PHASES = (PHASE_IDLE, PHASE_SURGE, PHASE_ANALYZE,
+                  PHASE_PROMOTE, PHASE_ROLLBACK)
+
+# jaxservice_rollouts_total outcomes, pre-registered at 0 on first
+# sight (the first-failure tripwire discipline): rate()/increase() must
+# see a zero sample before the first aborted rollout.
+ROLLOUT_OUTCOMES = ("promoted", "rolled_back", "aborted")
 
 
 def drain_seconds(spec: dict) -> float:
@@ -156,6 +198,95 @@ def resilience_spec(spec: dict) -> dict:
         "deadlineSeconds": r.get("deadlineSeconds", 0.0),
         "hedge": bool(r.get("hedge", True)),
         "maxInflight": r.get("maxInflight", 0),
+    }
+
+
+def rollout_spec(spec: dict) -> dict:
+    """spec.rollout with defaults — the staged-replacement knobs:
+
+    - ``maxSurge``: extra replicas (above target) the rollout may run
+      while old and new revisions coexist;
+    - ``maxUnavailable``: how far below target the fleet may dip while
+      old replicas drain (0 = surge-only, capacity never drops);
+    - ``canarySteps``: the canary weight ladder — the fraction of
+      traffic the router sends to the NEW revision at each step,
+      strictly increasing, ending at full weight;
+    - ``analysisWindowSeconds``: how long each step must look healthy
+      (canary error rate and latency quantile vs baseline) before the
+      rollout advances;
+    - ``autoRollback``: whether a failed analysis rolls the fleet back
+      to the previous revision automatically.
+    """
+    r = spec.get("rollout")
+    r = r if isinstance(r, dict) else {}
+    steps = r.get("canarySteps")
+    if not isinstance(steps, (list, tuple)) or not steps:
+        steps = list(DEFAULT_CANARY_STEPS)
+    return {
+        "maxSurge": r.get("maxSurge", DEFAULT_MAX_SURGE),
+        "maxUnavailable": r.get("maxUnavailable", DEFAULT_MAX_UNAVAILABLE),
+        "canarySteps": list(steps),
+        "analysisWindowSeconds": r.get("analysisWindowSeconds",
+                                       DEFAULT_ANALYSIS_WINDOW_S),
+        "autoRollback": bool(r.get("autoRollback", True)),
+    }
+
+
+def revision_hash(spec: dict) -> str:
+    """Content-addressed revision of the POD-SHAPING spec fields.
+
+    Two specs that generate byte-identical replica pods hash the same
+    (editing ``spec.replicas`` or the autoscaling windows is NOT a
+    rollout); any change that alters the pod — model flags, port, TPU
+    shape, scheduler opt-in, the inflight cap threaded into the server
+    command line, a custom template — mints a new revision. The hash is
+    a valid k8s label value (``v`` + 10 hex chars).
+    """
+    shaping = {
+        "model": model_spec(spec),
+        "image": spec.get("image", ""),
+        "port": spec.get("port", DEFAULT_PORT),
+        "tpu": spec.get("tpu") or {},
+        "priority": spec.get("priority", 0),
+        "schedulerName": spec.get("schedulerName", ""),
+        "maxInflight": resilience_spec(spec)["maxInflight"],
+        "template": spec.get("template") or {},
+    }
+    blob = json.dumps(shaping, sort_keys=True, separators=(",", ":"))
+    return "v" + hashlib.sha256(blob.encode("utf-8")).hexdigest()[:10]
+
+
+def revisions_status(svc: dict) -> dict:
+    """status.revisions with defaults: the durable rollout record.
+
+    ``current`` is the revision the stable fleet runs, ``target`` the
+    revision a rollout is moving toward (== current when idle),
+    ``previous`` the rollback destination, ``phase`` the state-machine
+    position, ``step`` the canary-ladder index, and ``stepStartedAt``
+    the controller-clock time the step's analysis window opened. The
+    record lands in status BEFORE any pod is touched (record-FIRST), so
+    an interrupted rollout re-enters idempotently.
+
+    ``snapshots`` maps revision -> the spec that minted it, so rollback
+    can regenerate previous-revision pods after the live spec has moved
+    on. ``aborted`` pins the revision a failed analysis rolled back
+    from: the controller will not re-attempt it until the spec changes
+    again (sticky abort). ``held`` marks a failed analysis frozen in
+    place because ``autoRollback`` is off.
+    """
+    rev = (svc.get("status") or {}).get("revisions")
+    rev = rev if isinstance(rev, dict) else {}
+    snaps = rev.get("snapshots")
+    return {
+        "current": rev.get("current", ""),
+        "target": rev.get("target", ""),
+        "previous": rev.get("previous", ""),
+        "phase": rev.get("phase", PHASE_IDLE),
+        "step": rev.get("step", 0),
+        "stepStartedAt": rev.get("stepStartedAt", 0.0),
+        "snapshots": snaps if isinstance(snaps, dict) else {},
+        "aborted": rev.get("aborted", ""),
+        "held": bool(rev.get("held", False)),
     }
 
 
@@ -286,6 +417,32 @@ def validate(svc: dict) -> list[str]:
     if not (isinstance(mi, int) and not isinstance(mi, bool) and mi >= 0):
         errs.append("spec.resilience.maxInflight must be a non-negative "
                     f"int, got {mi!r}")
+    roll = rollout_spec(spec)
+    if not _posint(roll["maxSurge"]):
+        errs.append("spec.rollout.maxSurge must be a positive int, "
+                    f"got {roll['maxSurge']!r}")
+    mu = roll["maxUnavailable"]
+    if not (isinstance(mu, int) and not isinstance(mu, bool) and mu >= 0):
+        errs.append("spec.rollout.maxUnavailable must be a non-negative "
+                    f"int, got {mu!r}")
+    steps = roll["canarySteps"]
+    bad_step = any(
+        not (isinstance(s, (int, float)) and not isinstance(s, bool)
+             and 0 < s <= 1)
+        for s in steps)
+    if bad_step:
+        errs.append("spec.rollout.canarySteps must be fractions in "
+                    f"(0, 1], got {steps!r}")
+    elif list(steps) != sorted(set(steps)):
+        errs.append("spec.rollout.canarySteps must be strictly "
+                    f"increasing, got {steps!r}")
+    elif steps[-1] != 1:
+        errs.append("spec.rollout.canarySteps must end at 1.0 (full "
+                    f"weight), got {steps!r}")
+    if not _posnum(roll["analysisWindowSeconds"]):
+        errs.append("spec.rollout.analysisWindowSeconds must be a "
+                    "positive number, got "
+                    f"{roll['analysisWindowSeconds']!r}")
     tpu = spec.get("tpu") or {}
     topology = tpu.get("topology") or ""
     if topology:

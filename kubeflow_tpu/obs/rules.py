@@ -929,6 +929,179 @@ def tenant_rule_pack(latency_target_s: float = 0.5,
     ]
 
 
+# -- canary analysis ---------------------------------------------------------
+
+
+# Outcomes that count against a revision in canary analysis. ``shed``/
+# ``shed_band``/``rejected`` are load-control verdicts the ROUTER made
+# — blaming the canary for them would abort every rollout that happens
+# during a traffic spike.
+CANARY_ERROR_OUTCOMES = ("failed", "deadline")
+
+
+class CanaryAnalysis:
+    """The SLO gate a rollout must pass: canary error-rate and
+    latency-quantile vs the baseline revision, read straight from the
+    TimeSeriesStore over the ``revision`` label the router stamps.
+
+    Matches the controller's ``rollout_analysis`` hook shape —
+    ``__call__(namespace, service, baseline_rev, canary_rev, now) ->
+    bool`` (healthy) — and is deterministic: pure store reads at the
+    caller's clock, no internal state beyond the last verdict kept for
+    audit.
+
+    Multi-window by construction (the burn-rate lesson): the canary is
+    UNHEALTHY only when **every** window agrees — the short window
+    proves it's happening now, the long window proves it's not a blip.
+    Low volume is inconclusive, and inconclusive is HEALTHY: a rollout
+    must not abort because nobody sent traffic during the window (the
+    time ladder, not the gate, paces such rollouts).
+
+    Verdict per window::
+
+        error_bad   = canary_err_rate > baseline_err_rate * max_error_ratio
+                      and canary_err_rate > min_error_rate
+        latency_bad = canary_q > baseline_q * max_latency_ratio
+        window_bad  = error_bad or latency_bad
+
+    The absolute ``min_error_rate`` floor keeps a zero-error baseline
+    from making any single canary failure fatal (ratio against zero is
+    degenerate)."""
+
+    def __init__(self, store: TimeSeriesStore,
+                 windows_s: tuple[float, ...] = (30.0, 120.0),
+                 latency_quantile: float = 0.95,
+                 max_error_ratio: float = 2.0,
+                 min_error_rate: float = 0.05,
+                 max_latency_ratio: float = 2.0,
+                 min_requests: float = 5.0):
+        self.store = store
+        self.windows_s = tuple(float(w) for w in windows_s)
+        self.latency_quantile = float(latency_quantile)
+        self.max_error_ratio = float(max_error_ratio)
+        self.min_error_rate = float(min_error_rate)
+        self.max_latency_ratio = float(max_latency_ratio)
+        self.min_requests = float(min_requests)
+        # the last verdict's per-window numbers, for events/benches
+        self.last: dict = {}
+
+    def __call__(self, namespace: str, service: str, baseline: str,
+                 canary: str, now: float) -> bool:
+        if not baseline or not canary or baseline == canary:
+            return True
+        verdicts = []
+        detail = []
+        for window_s in self.windows_s:
+            v = self._window_verdict(service, baseline, canary,
+                                     now - window_s, now)
+            verdicts.append(v["bad"])
+            detail.append({"window_s": window_s, **v})
+        self.last = {"service": service, "baseline": baseline,
+                     "canary": canary, "at": now, "windows": detail}
+        # unhealthy only when EVERY window is bad AND conclusive
+        return not (verdicts and all(verdicts))
+
+    # -- internals -----------------------------------------------------------
+
+    def _window_verdict(self, service: str, baseline: str, canary: str,
+                        start: float, end: float) -> dict:
+        b_total, b_err = self._outcomes(service, baseline, start, end)
+        c_total, c_err = self._outcomes(service, canary, start, end)
+        if c_total < self.min_requests or b_total < self.min_requests:
+            return {"bad": False, "inconclusive": True,
+                    "baseline_requests": b_total,
+                    "canary_requests": c_total}
+        b_rate = b_err / b_total
+        c_rate = c_err / c_total
+        error_bad = (c_rate > b_rate * self.max_error_ratio
+                     and c_rate > self.min_error_rate)
+        b_q = self._quantile(service, baseline, start, end)
+        c_q = self._quantile(service, canary, start, end)
+        latency_bad = (b_q is not None and c_q is not None and b_q > 0
+                       and c_q > b_q * self.max_latency_ratio)
+        return {"bad": error_bad or latency_bad, "inconclusive": False,
+                "error_bad": error_bad, "latency_bad": latency_bad,
+                "baseline_error_rate": round(b_rate, 9),
+                "canary_error_rate": round(c_rate, 9),
+                "baseline_q": b_q, "canary_q": c_q,
+                "baseline_requests": b_total,
+                "canary_requests": c_total}
+
+    def _outcomes(self, service: str, revision: str, start: float,
+                  end: float) -> tuple[float, float]:
+        """-> (total request increase, error increase) for one revision
+        over the window, summed across tenants."""
+        total = err = 0.0
+        for labels, points in self.store.window(
+                "router_requests_total",
+                {"service": service, "revision": revision}, start, end):
+            inc = _counter_increase(points)
+            total += inc
+            if labels.get("outcome") in CANARY_ERROR_OUTCOMES:
+                err += inc
+        return total, err
+
+    def _quantile(self, service: str, revision: str, start: float,
+                  end: float) -> float | None:
+        """Latency quantile from the revision's bucket increases over
+        the window; None when the histogram saw nothing."""
+        by_le: dict[str, float] = {}
+        for labels, points in self.store.window(
+                "router_request_seconds_bucket",
+                {"service": service, "revision": revision}, start, end):
+            le = labels.get("le")
+            if le is None:
+                continue
+            by_le[le] = by_le.get(le, 0.0) + _counter_increase(points)
+        if not by_le or sum(by_le.values()) <= 0:
+            return None
+        vec = [({"le": le}, v) for le, v in sorted(by_le.items())]
+        out = _histogram_quantile(self.latency_quantile, vec)
+        if not out or math.isnan(out[0][1]):
+            return None
+        return out[0][1]
+
+
+def canary_rule_pack(latency_target_s: float = 0.5,
+                     objective: float = 0.99,
+                     short_window: str = "1m",
+                     long_window: str = "5m",
+                     error_rate_threshold: float = 0.05,
+                     burn_threshold: float = 1.0) -> list:
+    """Dashboard/alert companions to the programmatic ``CanaryAnalysis``
+    gate: the same signals grouped ``by (service, revision)`` so an
+    operator watching a rollout sees canary-vs-baseline burn as named
+    series. The controller's abort decision comes from the gate, not
+    these alerts — they are the audit surface."""
+    short_burn = burn_rate_expr(latency_target_s, objective,
+                                short_window, by="service, revision")
+    long_burn = burn_rate_expr(latency_target_s, objective,
+                               long_window, by="service, revision")
+    return [
+        RecordingRule("slo:revision_burn:short", short_burn),
+        RecordingRule("slo:revision_burn:long", long_burn),
+        AlertRule(
+            "RevisionSLOBurn",
+            f"slo:revision_burn:short > {burn_threshold} "
+            f"and slo:revision_burn:long > {burn_threshold}",
+            for_s=30.0, severity="warning",
+            summary=f"one revision's traffic is burning the latency "
+                    f"error budget >{burn_threshold}x (target "
+                    f"{latency_target_s}s @ {objective:.2%}) — "
+                    "canary-vs-baseline burn dimension"),
+        AlertRule(
+            "RevisionErrorRate",
+            "sum by (service, revision) (rate("
+            "router_requests_total{outcome=\"failed\"}"
+            f"[{short_window}])) / sum by (service, revision) "
+            f"(rate(router_requests_total[{short_window}])) "
+            f"> {error_rate_threshold}",
+            for_s=30.0, severity="warning",
+            summary=f"a revision is failing more than "
+                    f"{error_rate_threshold:.0%} of its requests"),
+    ]
+
+
 def default_rule_pack(latency_target_s: float = 0.5,
                       objective: float = 0.99,
                       short_window: str = "1m",

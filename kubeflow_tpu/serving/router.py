@@ -109,6 +109,10 @@ TENANT_RE = re.compile(r"^[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
 TENANT_OUTCOMES = ("completed", "failed", "rejected", "deadline",
                    "shed", "shed_band")
 
+# "argument not provided" sentinel for set_members(canary=...): None
+# means "clear the split", absence means "leave it alone"
+_KEEP = object()
+
 def _prom_metric(name, kind, doc, **kw):
     from kubeflow_tpu.runtime.metrics import prom_metric
 
@@ -136,7 +140,8 @@ def prom_request_seconds():
 
     return _prom_metric("router_request_seconds", prom.Histogram,
                         "submit -> completion latency through the router",
-                        labelnames=("service",), buckets=REQUEST_BUCKETS)
+                        labelnames=("service", "revision"),
+                        buckets=REQUEST_BUCKETS)
 
 
 def prom_requests_total():
@@ -144,7 +149,7 @@ def prom_requests_total():
 
     return _prom_metric("router_requests_total", prom.Counter,
                         "requests by outcome (completed/rejected/shed)",
-                        labelnames=("service", "outcome"))
+                        labelnames=("service", "outcome", "revision"))
 
 
 def prom_tokens_total():
@@ -197,7 +202,7 @@ def prom_retry_budget():
     return _prom_metric("router_retry_budget", prom.Gauge,
                         "retry/hedge token bucket level — 0 means the "
                         "fleet is failing faster than it refills",
-                        labelnames=("service",))
+                        labelnames=("service", "tenant"))
 
 
 class RouterBusy(Exception):
@@ -262,11 +267,14 @@ class Member:
     """One routable replica. ``transport`` is whatever the shell uses
     to reach it (an HTTP base URL, an in-process callable, a bench
     stub) — the core never calls it, it only hands it back on
-    dispatch."""
+    dispatch. ``revision`` is the JAXService revision label the
+    controller stamped on the replica's pod ("" for pre-rollout
+    endpoints) — the canary split routes on it."""
 
     name: str
     transport: Any = None
     state: str = STATE_ACTIVE
+    revision: str = ""
 
 
 @dataclass
@@ -306,6 +314,16 @@ class Ticket:
     resolved: bool = False
     _dispatched_at: float = 0.0
     _hedge_at: float = 0.0
+    # -- rollout layer ---------------------------------------------------
+    # the revision of the replica that served (or is serving) this
+    # request — stamped at dispatch, re-stamped if a hedge leg wins, and
+    # carried into the revision label on router_requests_total /
+    # router_request_seconds (the canary-vs-baseline burn dimension)
+    revision: str = ""
+    # the canary draw: (canary_revision, wants_canary) decided ONCE at
+    # admission from the deterministic seeded sequence; None = no canary
+    # active. A soft preference — availability beats the ladder.
+    _canary_pref: Any = field(default=None, repr=False)
 
 
 def estimate_tokens(instances: list, max_new_tokens: int) -> int:
@@ -335,7 +353,8 @@ class TokenRouter:
                  registry: MetricsRegistry | None = None,
                  tracer=None, prom_sink: bool = True,
                  resilience: ResilienceConfig | None = None,
-                 on_decision: Callable[[dict], None] | None = None):
+                 on_decision: Callable[[dict], None] | None = None,
+                 canary_seed: int = 0):
         self.service = service
         self.namespace = namespace
         self.max_queue = max_queue
@@ -367,10 +386,20 @@ class TokenRouter:
             maxlen=(resilience.latency_window if resilience else 64))
         # recent completion stamps -> queue drain rate -> Retry-After
         self._completions: collections.deque = collections.deque(maxlen=64)
-        self._retry_tokens = (resilience.retry_budget_cap
-                              if resilience else 0.0)
+        # per-TENANT retry/hedge token buckets (ISSUE 20 satellite): one
+        # tenant's retry storm drains only its own bucket. The sum is
+        # bounded by retry_budget_cap; a new tenant seeds with whatever
+        # headroom remains (the first tenant gets the full cap, so the
+        # single-tenant banked replays are unchanged).
+        self._retry_tokens: dict[str, float] = {}
         # tenants whose counter families are already pre-registered
         self._tenants: set[str] = set()
+        # canary split state: (revision, weight) the controller is
+        # currently canarying, plus the deterministic draw sequence —
+        # seeded so benches replay decision-for-decision
+        self._canary: tuple[str, float] | None = None
+        self._canary_seed = int(canary_seed)
+        self._canary_seq = 0
 
     # -- membership (controller-fed) ----------------------------------------
 
@@ -379,19 +408,29 @@ class TokenRouter:
                        ) -> list[Ticket]:
         """Apply a controller-published endpoint list (the parsed
         ``ANNOTATION_ENDPOINTS`` value). Returns the tickets re-DISPATCHED
-        after shedding removed members (see ``set_members``)."""
+        after shedding removed members (see ``set_members``). Endpoint
+        entries may carry ``revision`` (the pod's revision label) and a
+        ``canary`` weight — present on the canaried revision's entries
+        while a rollout analyzes; absent entries mean no split."""
         members = []
+        canary: tuple[str, float] | None = None
         for ep in endpoints:
             name = ep.get("name")
             if not name:
                 continue
+            rev = ep.get("revision") or ""
             members.append(Member(
                 name=name,
                 transport=(transport_factory(ep) if transport_factory
                            else ep.get("addr")),
                 state=(STATE_CORDONED if ep.get("state") == STATE_CORDONED
-                       else STATE_ACTIVE)))
-        return self.set_members(members)
+                       else STATE_ACTIVE),
+                revision=rev))
+            w = ep.get("canary")
+            if rev and isinstance(w, (int, float)) \
+                    and not isinstance(w, bool):
+                canary = (rev, float(w))
+        return self.set_members(members, canary=canary)
 
     def sync_from_object(self, service_obj: dict,
                          transport_factory=None) -> list[Ticket]:
@@ -400,16 +439,23 @@ class TokenRouter:
         return self.sync_endpoints(
             parse_endpoints(service_obj), transport_factory)
 
-    def set_members(self, members: list[Member]) -> list[Ticket]:
+    def set_members(self, members: list[Member],
+                    canary: "tuple[str, float] | None | object" = _KEEP,
+                    ) -> list[Ticket]:
         """Replace membership. A member that disappears sheds its
         in-flight tickets back to the queue FRONT (oldest first) and a
         drain pass re-dispatches to survivors — the zero-drop half of
         the replica-kill drill. Returns the newly dispatched tickets so
-        a synchronous driver can start their work on the survivors."""
+        a synchronous driver can start their work on the survivors.
+        ``canary`` sets the (revision, weight) split alongside the
+        membership swap (None clears it); omitted = left unchanged, so
+        pre-rollout callers keep their exact behavior."""
         with self._lock:
             now = self.clock()
             new = {m.name: m for m in members}
             shed: list[Ticket] = []
+            if canary is not _KEEP:
+                self._canary = canary  # type: ignore[assignment]
             for name in list(self._members):
                 if name not in new:
                     shed.extend(self._shed_member_locked(name, now))
@@ -423,6 +469,7 @@ class TokenRouter:
                 else:
                     cur.state = m.state
                     cur.transport = m.transport
+                    cur.revision = m.revision
             # requeue shed tickets at the FRONT, original order. done is
             # CLEARED: a blocking shell waiting on this ticket must park
             # until the re-dispatch below (or a later drain) fires it
@@ -452,6 +499,21 @@ class TokenRouter:
                 m.state = STATE_ACTIVE
         self.kick()
 
+    def set_canary(self, revision: str | None,
+                   weight: float = 0.0) -> None:
+        """Set (or clear, with ``revision=None``) the canary split: new
+        admissions draw from the seeded sequence and prefer the canary
+        revision with probability ``weight``. A preference, not a
+        partition — when the preferred side has no eligible replica the
+        other side serves (availability beats the ladder)."""
+        with self._lock:
+            self._canary = (None if revision is None
+                            else (revision, float(weight)))
+
+    def canary(self) -> "tuple[str, float] | None":
+        with self._lock:
+            return self._canary
+
     def _shed_member_locked(self, name: str, now: float) -> list[Ticket]:
         """Remove a member; return its in-flight tickets oldest-first."""
         self._members.pop(name, None)
@@ -466,7 +528,7 @@ class TokenRouter:
                 t._span.error = f"replica {name} lost; shed to survivors"
                 self.tracer.finish(t._span)
                 t._span = None
-            self._count_locked("shed", t.tenant)
+            self._count_locked("shed", t.tenant, t.revision)
         self.registry.gauge(
             "router_tokens_inflight", 0,
             help_="outstanding token estimate per replica",
@@ -504,13 +566,15 @@ class TokenRouter:
                 t._t0 = t._queued_at = now
                 self._register_tenant_locked(t.tenant)
                 if self.resilience is not None:
-                    self._refill_budget_locked()
+                    self._refill_budget_locked(t.tenant)
+                if self._canary is not None:
+                    t._canary_pref = self._canary_draw_locked()
                 if t.deadline is not None and now >= t.deadline:
                     self._drop_deadline_locked(t, now)
                     raise DeadlineExceeded(
                         "deadline elapsed before admission")
                 expired = self._sweep_deadlines_locked(now)
-                member = self._pick_locked(t.tokens)
+                member = self._pick_locked(t.tokens, pref=t._canary_pref)
                 if member is not None:
                     self._dispatch_locked(t, member, now)
                 elif len(self._queue) >= self.max_queue:
@@ -621,6 +685,9 @@ class TokenRouter:
         won = winner is not None and winner == h.name
         self._hedge_count_locked("won" if won else "canceled")
         if won:
+            # the hedge replica served the response: its revision is
+            # the one the latency/outcome labels should bill
+            ticket.revision = h.revision
             self._decide_locked("hedge_win", now, replica=h.name)
         return won
 
@@ -679,7 +746,7 @@ class TokenRouter:
                 if ticket.deadline is not None and now >= ticket.deadline:
                     requeue = False
                     ticket.dropped_reason = "deadline"
-                elif not self._spend_budget_locked(1.0):
+                elif not self._spend_budget_locked(1.0, ticket.tenant):
                     requeue = False
                     ticket.dropped_reason = "retry_budget"
                     ticket.retry_after = self._retry_after_locked(now)
@@ -693,7 +760,8 @@ class TokenRouter:
                 ticket.done.clear()
                 if not queued:
                     self._queue.insert(0, ticket)
-                    self._count_locked("shed", ticket.tenant)
+                    self._count_locked("shed", ticket.tenant,
+                                       ticket.revision)
             else:
                 ticket.resolved = True
                 if queued:
@@ -702,7 +770,8 @@ class TokenRouter:
                 if ticket.dropped_reason == "deadline":
                     self._drop_deadline_locked(ticket, now)
                 else:
-                    self._count_locked("failed", ticket.tenant)
+                    self._count_locked("failed", ticket.tenant,
+                                       ticket.revision)
             expired = self._sweep_deadlines_locked(now)
             dispatched = self._drain_locked(now)
             self._publish_queue_locked()
@@ -759,13 +828,14 @@ class TokenRouter:
             if ticket.deadline is not None and now >= ticket.deadline:
                 return None
             exclude = set(ticket.tried) | {primary.name}
-            m = self._pick_locked(ticket.tokens, exclude=exclude)
+            m = self._pick_locked(ticket.tokens, exclude=exclude,
+                                  pref=ticket._canary_pref)
             # _pick treats exclude as a soft preference (retry beats
             # starvation); a hedge to the SAME replica is pointless, so
             # enforce it hard here
             if m is None or m.name in exclude:
                 return None
-            if not self._spend_budget_locked(1.0):
+            if not self._spend_budget_locked(1.0, ticket.tenant):
                 return None
             self._tenant_spend_locked(ticket.tenant, "hedge", 1.0)
             ticket.hedge_member = m
@@ -787,9 +857,13 @@ class TokenRouter:
         with self._lock:
             return {n: h.state for n, h in self._health.items()}
 
-    def retry_budget(self) -> float:
+    def retry_budget(self, tenant: str | None = None) -> float:
+        """The fleet-wide retry/hedge budget (sum over tenant buckets),
+        or one tenant's bucket level when ``tenant`` is given."""
         with self._lock:
-            return self._retry_tokens
+            if tenant is not None:
+                return self._retry_tokens.get(tenant, 0.0)
+            return sum(self._retry_tokens.values())
 
     def close(self) -> list[Ticket]:
         """Reject everything still queued (shell shutdown)."""
@@ -825,22 +899,55 @@ class TokenRouter:
 
     # -- locked internals ----------------------------------------------------
 
+    def _canary_draw_locked(self) -> "tuple[str, bool] | None":
+        """One deterministic draw from the seeded sequence: returns
+        (canary_revision, wants_canary). A 32-bit avalanche finalizer
+        over (sequence, seed) — no RNG state beyond the counter, so an
+        identical admission order replays identically, and distinct
+        seeds give decorrelated accept sequences (an additive offset
+        would leave every seed drawing the same splits)."""
+        c = self._canary
+        if c is None:
+            return None
+        rev, weight = c
+        seq = self._canary_seq
+        self._canary_seq += 1
+        x = (seq + 1 + self._canary_seed * 0x9E3779B9) & 0xFFFFFFFF
+        x = ((x ^ (x >> 16)) * 0x7FEB352D) & 0xFFFFFFFF
+        x = ((x ^ (x >> 15)) * 0x846CA68B) & 0xFFFFFFFF
+        u = (x ^ (x >> 16)) / 4294967296.0
+        return (rev, u < weight)
+
+    @staticmethod
+    def _canary_mismatch(m: Member, pref) -> bool:
+        """True when member ``m`` sits on the wrong side of the
+        ticket's canary draw — a SOFT penalty in the pick key."""
+        if pref is None:
+            return False
+        rev, want = pref
+        return (m.revision == rev) != want
+
     def _pick_locked(self, tokens: int,
                      exclude: set | frozenset = frozenset(),
-                     ) -> Member | None:
+                     pref=None) -> Member | None:
         """Least-outstanding-tokens over ACTIVE members; name breaks
         ties so replays are order-independent. Budget-full replicas are
         skipped (the request queues for the next completion). Members
         in ``exclude`` (a retrying ticket's failed transports) are
         avoided — unless they are ALL that's left, in which case a
-        retry beats starvation.
+        retry beats starvation. ``pref`` is the ticket's canary draw:
+        the wrong side of the split is penalized AFTER the tried
+        penalty (a retry avoids the dead replica first) but before
+        load — with no canary active the element is constant and the
+        legacy ordering is untouched.
 
         With resilience on, the key becomes (breaker-rank, tried,
-        score-adjusted load, name): open breakers are ineligible, a
-        half-open breaker admits exactly one probe, and load is scaled
-        by EWMA latency relative to the fleet's fastest replica — a
-        browned-out (slow but alive) member looks proportionally more
-        expensive and drains naturally instead of wedging."""
+        canary-mismatch, score-adjusted load, name): open breakers are
+        ineligible, a half-open breaker admits exactly one probe, and
+        load is scaled by EWMA latency relative to the fleet's fastest
+        replica — a browned-out (slow but alive) member looks
+        proportionally more expensive and drains naturally instead of
+        wedging."""
         best = None
         best_key = None
         resilient = self.resilience is not None
@@ -857,8 +964,9 @@ class TokenRouter:
             if self.replica_token_budget is not None and load > 0 \
                     and load + tokens > self.replica_token_budget:
                 continue
+            mismatch = self._canary_mismatch(m, pref)
             if not resilient:
-                key = (0, name in exclude, load, name)
+                key = (0, name in exclude, mismatch, load, name)
             else:
                 rank = self._breaker_rank_locked(name, now)
                 if rank >= 3:  # open (or probe already out): ineligible
@@ -867,7 +975,7 @@ class TokenRouter:
                 h = self._health.get(name)
                 if h is not None and h.lat is not None and min_lat:
                     score = max(h.lat / min_lat, 1.0)
-                key = (rank, name in exclude, load * score, name)
+                key = (rank, name in exclude, mismatch, load * score, name)
             if best_key is None or key < best_key:
                 best, best_key = m, key
         return best
@@ -875,6 +983,7 @@ class TokenRouter:
     def _dispatch_locked(self, t: Ticket, member: Member,
                          now: float) -> None:
         t.member = member
+        t.revision = member.revision
         t._dispatched_at = now
         self._inflight.setdefault(member.name, {})[id(t)] = t
         self._tokens[member.name] = \
@@ -911,20 +1020,24 @@ class TokenRouter:
         latency = max(now - t._t0, 0.0)
         done = t.tokens if tokens_done is None else int(tokens_done)
         tenant = t.tenant or self.namespace
+        hist_labels = dict(namespace=self.namespace, service=self.service,
+                           tenant=tenant)
+        if t.revision:  # unrevisioned traffic keeps its old series
+            hist_labels["revision"] = t.revision
         self.registry.histogram(
             "router_request_seconds", latency,
             help_="submit -> completion latency through the router",
-            buckets=REQUEST_BUCKETS,
-            namespace=self.namespace, service=self.service, tenant=tenant)
+            buckets=REQUEST_BUCKETS, **hist_labels)
         self.registry.counter_inc(
             "router_tokens_total",
             help_="tokens completed through the router (rate = the "
                   "autoscaler's tokens/sec signal)",
             by=float(done), namespace=self.namespace, service=self.service,
             tenant=tenant)
-        self._count_locked("completed", t.tenant)
+        self._count_locked("completed", t.tenant, t.revision)
         if self._prom:
-            prom_request_seconds().labels(self.service).observe(latency)
+            prom_request_seconds().labels(
+                self.service, t.revision).observe(latency)
             prom_tokens_total().labels(self.service).inc(done)
 
     def _drain_locked(self, now: float) -> list[Ticket]:
@@ -936,7 +1049,8 @@ class TokenRouter:
         if self.resilience is None:
             remaining: list[Ticket] = []
             for t in self._queue:
-                member = self._pick_locked(t.tokens, exclude=t.tried)
+                member = self._pick_locked(t.tokens, exclude=t.tried,
+                                       pref=t._canary_pref)
                 if member is None:
                     remaining.append(t)
                     continue
@@ -951,7 +1065,8 @@ class TokenRouter:
         taken: set[int] = set()
         for i in order:
             t = self._queue[i]
-            member = self._pick_locked(t.tokens, exclude=t.tried)
+            member = self._pick_locked(t.tokens, exclude=t.tried,
+                                       pref=t._canary_pref)
             if member is None:
                 continue
             self._dispatch_locked(t, member, now)
@@ -993,29 +1108,62 @@ class TokenRouter:
             prom_deadline_exceeded_total().labels(self.service).inc()
         self._decide_locked("deadline", now, band=t.band)
 
-    def _refill_budget_locked(self) -> None:
+    def _refill_budget_locked(self, tenant: str) -> None:
+        """Refill the admitting TENANT'S bucket — so refill is
+        proportional to each tenant's admitted traffic. The SUM across
+        buckets never exceeds retry_budget_cap: when the fleet-wide
+        pool is full, the refill reclaims from the fullest OTHER bucket
+        (deterministic tie-break) so an idle tenant's hoard cannot
+        starve an active one — but a storming tenant still only ever
+        SPENDS its own bucket."""
         r = self.resilience
-        self._retry_tokens = min(r.retry_budget_cap,
-                                 self._retry_tokens + r.retry_budget_ratio)
+        tenant = tenant or self.namespace
+        buckets = self._retry_tokens
+        buckets.setdefault(tenant, 0.0)
+        need = r.retry_budget_ratio
+        headroom = r.retry_budget_cap - sum(buckets.values())
+        add = min(need, max(headroom, 0.0))
+        short = need - add
+        if short > 1e-12:
+            others = sorted(((v, k) for k, v in buckets.items()
+                             if k != tenant and v > 0.0), reverse=True)
+            for v, k in others:
+                take = min(v, short)
+                buckets[k] = v - take
+                add += take
+                short -= take
+                if short <= 1e-12:
+                    break
+        if add > 0.0:
+            buckets[tenant] += add
         self._publish_budget_locked()
 
-    def _spend_budget_locked(self, cost: float) -> bool:
+    def _spend_budget_locked(self, cost: float, tenant: str = "") -> bool:
+        """Spend from the tenant's OWN bucket only (the isolation
+        half: a retry storm cannot drain a neighbor's budget)."""
         if self.resilience is None:
             return True
-        if self._retry_tokens < cost:
+        tenant = tenant or self.namespace
+        level = self._retry_tokens.get(tenant, 0.0)
+        if level < cost:
             return False
-        self._retry_tokens -= cost
+        self._retry_tokens[tenant] = level - cost
         self._publish_budget_locked()
         return True
 
     def _publish_budget_locked(self) -> None:
-        self.registry.gauge(
-            "router_retry_budget", round(self._retry_tokens, 6),
-            help_="retry/hedge token bucket level — 0 means the fleet "
-                  "is failing faster than it refills",
-            namespace=self.namespace, service=self.service)
+        for tenant, level in self._retry_tokens.items():
+            self.registry.gauge(
+                "router_retry_budget", round(level, 6),
+                help_="retry/hedge token bucket level — 0 means the "
+                      "fleet is failing faster than it refills",
+                namespace=self.namespace, service=self.service,
+                tenant=tenant)
         if self._prom:
-            prom_retry_budget().labels(self.service).set(self._retry_tokens)
+            # the prometheus surface keeps a fleet-level view per
+            # tenant bucket (cardinality is tenant-bounded either way)
+            for tenant, level in self._retry_tokens.items():
+                prom_retry_budget().labels(self.service, tenant).set(level)
 
     def _health_locked(self, name: str) -> _Health:
         h = self._health.get(name)
@@ -1130,14 +1278,21 @@ class TokenRouter:
             prom_tokens_inflight().labels(self.service, name).set(
                 self._tokens.get(name, 0))
 
-    def _count_locked(self, outcome: str, tenant: str = "") -> None:
+    def _count_locked(self, outcome: str, tenant: str = "",
+                      revision: str = "") -> None:
+        # the revision label exists only while revisions are in play —
+        # unrevisioned traffic keeps its pre-rollout series identity
+        labels = dict(namespace=self.namespace, service=self.service,
+                      tenant=tenant or self.namespace, outcome=outcome)
+        if revision:
+            labels["revision"] = revision
         self.registry.counter_inc(
             "router_requests_total",
             help_="requests by outcome (completed/rejected/shed/failed)",
-            namespace=self.namespace, service=self.service,
-            tenant=tenant or self.namespace, outcome=outcome)
+            **labels)
         if self._prom:
-            prom_requests_total().labels(self.service, outcome).inc()
+            prom_requests_total().labels(
+                self.service, outcome, revision).inc()
 
     def _register_tenant_locked(self, tenant: str) -> None:
         """First sight of a tenant: pre-register its counter families
@@ -1148,6 +1303,33 @@ class TokenRouter:
         if tenant in self._tenants:
             return
         self._tenants.add(tenant)
+        if self.resilience is not None:
+            # seed the tenant's retry bucket with the pool's remaining
+            # headroom, topped up to a fair share (cap / tenants seen)
+            # reclaimed from the fullest buckets when headroom is
+            # short. The FIRST tenant still starts at the full cap
+            # (single-tenant behavior unchanged — banked replays hold);
+            # a late arrival gets a working share immediately instead
+            # of having its very first retry denied, yet the sum across
+            # buckets never exceeds the cap and nobody's bucket is
+            # touched while the pool has headroom.
+            cap = self.resilience.retry_budget_cap
+            buckets = self._retry_tokens
+            seed = max(cap - sum(buckets.values()), 0.0)
+            share = cap / (len(buckets) + 1)
+            short = share - seed
+            if short > 1e-12:
+                others = sorted(((v, k) for k, v in buckets.items()
+                                 if v > 0.0), reverse=True)
+                for v, k in others:
+                    take = min(v, short)
+                    buckets[k] = v - take
+                    seed += take
+                    short -= take
+                    if short <= 1e-12:
+                        break
+            buckets[tenant] = seed
+            self._publish_budget_locked()
         for outcome in TENANT_OUTCOMES:
             self.registry.counter_inc(
                 "router_requests_total", by=0.0,

@@ -276,6 +276,39 @@ class TestRetryBudget:
             min(before + 4 * 0.5, 2.0))
 
 
+class TestTenantRetryIsolation:
+    def test_storm_exhausts_only_the_noisy_tenants_bucket(self):
+        """ISSUE 20 satellite: retry/hedge tokens are bucketed PER
+        TENANT — tenant A's retry storm drains A's bucket to zero while
+        tenant B seeds its own bucket from the pool headroom and its
+        retries still spend."""
+        clock = ManualClock()
+        cfg = ResilienceConfig(retry_budget_cap=2.0,
+                               retry_budget_ratio=0.0)
+        r = _router(clock=clock, resilience=cfg)
+        _members(r, 2)
+        assert r.retry_budget(tenant="A") == 0.0  # unseen: no bucket yet
+        ta = r.submit(8, tenant="A")
+        assert r.retry_budget(tenant="A") == pytest.approx(2.0)
+        for _ in range(2):                        # A's retry storm
+            ta = r.fail(ta, requeue=True)[0]
+            assert ta.dropped_reason is None
+        r.fail(ta, requeue=True)                  # A's bucket is dry
+        assert ta.dropped_reason == "retry_budget"
+        assert r.retry_budget(tenant="A") == pytest.approx(0.0)
+        # B seeds its OWN bucket from the headroom A never consumed —
+        # the storm next door did not spend B's tokens
+        tb = r.submit(8, tenant="B")
+        assert r.retry_budget(tenant="B") == pytest.approx(2.0)
+        redispatched = r.fail(tb, requeue=True)
+        survivor = redispatched[0] if redispatched else tb
+        assert survivor.dropped_reason is None    # B's retry still spends
+        assert r.retry_budget(tenant="B") == pytest.approx(1.0)
+        assert r.retry_budget(tenant="A") == pytest.approx(0.0)
+        assert r.retry_budget() == pytest.approx(1.0)
+        assert 'tenant="A"' in r.registry.render()
+
+
 # -- hedging -----------------------------------------------------------------
 
 

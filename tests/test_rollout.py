@@ -1,0 +1,783 @@
+"""Safe rollouts (ISSUE 20): versioned JAXService revisions, the
+surge -> canary-analyze -> promote | rollback state machine, and the
+SLO gate that aborts a bad canary automatically.
+
+Five layers, mirroring docs/serving.md's rollout section:
+
+1. The revision identity: content-addressed hashes over the
+   POD-SHAPING spec fields (scaling edits are NOT a rollout) and the
+   spec.rollout validation surface.
+2. The controller machine against the fake cluster: revision labels on
+   every replica pod, record-FIRST status.revisions writes, the canary
+   time ladder, sticky aborts, the autoRollback=off hold, and the
+   durable drain-deadline annotation a restarted controller resumes.
+3. The router's revision plane: the seeded deterministic canary draw,
+   weight extremes, soft preference (availability beats the ladder),
+   and the endpoints wire carrying revision + canary weight end to end.
+4. ``CanaryAnalysis`` — the multi-window error-rate/latency-quantile
+   gate read straight off the TimeSeriesStore.
+5. Chaos + the banked benchmark: interrupted rollbacks converge under
+   armed apiserver faults across CHAOS_SEEDS, and the rollout_bench
+   decision ratchet (BENCH_ROLLOUT_r01.json) replays byte-identically.
+"""
+
+import json
+import os
+import re
+import sys
+
+import pytest
+
+from conftest import CHAOS_RATE, CHAOS_SEEDS
+
+from kubeflow_tpu.control.jaxservice import types as T
+from kubeflow_tpu.control.jaxservice.controller import build_controller
+from kubeflow_tpu.control.k8s import objects as ob
+from kubeflow_tpu.control.k8s.chaos import (
+    ChaosClient, ChaosPolicy, arm_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet
+from kubeflow_tpu.control.runtime import seed_controller
+from kubeflow_tpu.obs import trace as obs_trace
+from kubeflow_tpu.obs.rules import CanaryAnalysis
+from kubeflow_tpu.obs.tsdb import TimeSeriesStore
+from kubeflow_tpu.runtime.metrics import MetricsRegistry
+from kubeflow_tpu.serving.router import (
+    Member, RegistrySignals, TokenRouter, parse_endpoints,
+)
+
+pytestmark = pytest.mark.serving
+
+
+class ManualClock:
+    def __init__(self, t: float = 0.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# two-step ladder on a short window so tests walk it in a few advances
+ROLLOUT = {"maxSurge": 1, "maxUnavailable": 0, "canarySteps": [0.5, 1.0],
+           "analysisWindowSeconds": 10.0, "autoRollback": True}
+
+
+def rollout_world(analysis=None, signals=True, replicas=2, **roll_kw):
+    """Controller + manual clock + kubelet with spec.rollout armed.
+    ``signals=True`` wires a RegistrySignals over an idle registry, so
+    cordoned replicas read zero in-flight and drain instantly — the
+    machine's timing then comes from the analysis ladder alone."""
+    clock = ManualClock()
+    cluster = FakeCluster(history_limit=8192)
+    registry = MetricsRegistry()
+    sig = RegistrySignals(registry) if signals else None
+    ctl = seed_controller(build_controller(
+        cluster, record_events=True, registry=registry, signals=sig,
+        clock=clock, rollout_analysis=analysis))
+    kubelet = FakeKubelet(cluster)
+    svc = T.new_jaxservice("chat", model="gpt-125m",
+                           min_replicas=replicas, max_replicas=replicas)
+    roll = dict(ROLLOUT)
+    roll.update(roll_kw)
+    svc["spec"]["rollout"] = roll
+    cluster.create(svc)
+    return cluster, ctl, kubelet, registry, clock
+
+
+def drain(ctl, kubelet=None, rounds=6):
+    for _ in range(rounds):
+        ctl.run_until_idle(advance_delayed=True)
+        if kubelet is not None:
+            kubelet.step()
+
+
+def rep(i, name="chat"):
+    return T.replica_name(name, i)
+
+
+def get_svc(cluster):
+    return cluster.get(T.API_VERSION, T.KIND, "chat", "default")
+
+
+def bump(cluster, ref="gpt-125m-v2"):
+    """Edit a pod-shaping field; returns the revision it mints."""
+    svc = get_svc(cluster)
+    svc["spec"]["model"]["ref"] = ref
+    cluster.update(svc)
+    return T.revision_hash(svc["spec"])
+
+
+def revs(cluster):
+    return T.revisions_status(get_svc(cluster))
+
+
+def pod_revs(cluster):
+    out = {}
+    for p in cluster.list("v1", "Pod", namespace="default"):
+        out[ob.meta(p)["name"]] = (
+            (ob.meta(p).get("labels") or {}).get(T.LABEL_REVISION, ""))
+    return out
+
+
+def outcomes(registry, service="chat"):
+    out = {o: 0.0 for o in T.ROLLOUT_OUTCOMES}
+    for labels, v in registry.series("jaxservice_rollouts_total"):
+        if labels.get("service") == service:
+            out[labels["outcome"]] += v
+    return out
+
+
+def event_counts(cluster):
+    out = {}
+    for e in cluster.list("v1", "Event", namespace="default"):
+        r = e.get("reason", "")
+        out[r] = out.get(r, 0) + int(e.get("count", 1))
+    return out
+
+
+def converge(cluster, ctl, kubelet, clock, registry,
+             done, max_steps=40, dt=2.0, max_surge=1, replicas=2):
+    """Drive the loop until ``done()`` or the step cap, advancing the
+    clock between drains so analysis windows elapse. Asserts the surge
+    capacity bound on EVERY observation along the way."""
+    peak = 0
+    for _ in range(max_steps):
+        drain(ctl, kubelet, rounds=2)
+        peak = max(peak, len(cluster.list("v1", "Pod",
+                                          namespace="default")))
+        assert peak <= replicas + max_surge, \
+            f"capacity oversubscribed: {peak} pods"
+        if done():
+            return peak
+        clock.advance(dt)
+    raise AssertionError(f"did not converge in {max_steps} steps: "
+                         f"revisions={revs(cluster)} "
+                         f"outcomes={outcomes(registry)}")
+
+
+# -- revision identity --------------------------------------------------------
+
+
+class TestRevisionHash:
+    def _spec(self, **over):
+        spec = T.new_jaxservice("chat", model="gpt-125m",
+                                min_replicas=1, max_replicas=4)["spec"]
+        spec.update(over)
+        return spec
+
+    def test_format_is_a_valid_label_value(self):
+        assert re.fullmatch(r"v[0-9a-f]{10}",
+                            T.revision_hash(self._spec()))
+
+    def test_scaling_edits_are_not_a_rollout(self):
+        base = T.revision_hash(self._spec())
+        spec = self._spec()
+        spec["replicas"] = {"min": 3, "max": 9}
+        spec["autoscaling"] = {"targetQueueDepth": 99}
+        spec["drainSeconds"] = 5.0
+        spec["rollout"] = {"maxSurge": 2}
+        assert T.revision_hash(spec) == base
+
+    def test_pod_shaping_edits_mint_distinct_revisions(self):
+        seen = {T.revision_hash(self._spec())}
+        for over in ({"model": {"ref": "gpt-125m-v2"}},
+                     {"port": 9001},
+                     {"image": "tpu-serve:v2"},
+                     {"priority": 7},
+                     {"schedulerName": "kubeflow-gang"},
+                     {"tpu": {"accelerator": "v5e", "topology": "2x2"}},
+                     {"resilience": {"maxInflight": 3}},
+                     {"template": {"metadata": {"labels": {"x": "y"}}}}):
+            h = T.revision_hash(self._spec(**over))
+            assert h not in seen, f"{over} did not change the revision"
+            seen.add(h)
+
+    def test_hash_is_stable_across_key_order(self):
+        a = self._spec()
+        b = json.loads(json.dumps(a))
+        b["model"] = dict(reversed(list(b["model"].items())))
+        assert T.revision_hash(a) == T.revision_hash(b)
+
+
+class TestRolloutSpecValidation:
+    def _svc(self, **roll):
+        svc = T.new_jaxservice("chat", model="gpt-125m")
+        svc["spec"]["rollout"] = roll
+        return svc
+
+    def test_defaults(self):
+        assert T.rollout_spec({}) == {
+            "maxSurge": 1, "maxUnavailable": 0,
+            "canarySteps": list(T.DEFAULT_CANARY_STEPS),
+            "analysisWindowSeconds": T.DEFAULT_ANALYSIS_WINDOW_S,
+            "autoRollback": True}
+        assert T.validate(self._svc()) == []
+        assert T.validate(self._svc(**ROLLOUT)) == []
+
+    def test_bad_knobs_report(self):
+        cases = [
+            (dict(maxSurge=0), "maxSurge"),
+            (dict(maxUnavailable=-1), "maxUnavailable"),
+            (dict(canarySteps=[0.5, 0.25, 1.0]), "canarySteps"),
+            (dict(canarySteps=[0.1, 0.5]), "canarySteps"),
+            (dict(canarySteps=[0.0, 1.0]), "canarySteps"),
+            (dict(canarySteps=[0.5, 1.5]), "canarySteps"),
+            (dict(analysisWindowSeconds=0), "analysisWindowSeconds"),
+        ]
+        for roll, needle in cases:
+            errs = T.validate(self._svc(**roll))
+            assert any(needle in e for e in errs), (roll, errs)
+
+
+# -- the controller machine ---------------------------------------------------
+
+
+class TestRolloutMachine:
+    def test_pods_stamped_and_status_adopted_on_first_sight(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        svc = get_svc(cluster)
+        spec_rev = T.revision_hash(svc["spec"])
+        rev = revs(cluster)
+        assert rev["current"] == rev["target"] == spec_rev
+        assert rev["phase"] == T.PHASE_IDLE
+        assert set(rev["snapshots"]) == {spec_rev}
+        assert pod_revs(cluster) == {rep(0): spec_rev, rep(1): spec_rev}
+        # endpoints carry the revision too (the router's canary plane)
+        eps = {e["name"]: e.get("revision") for e in parse_endpoints(svc)}
+        assert eps == {rep(0): spec_rev, rep(1): spec_rev}
+
+    def test_outcome_counters_preregistered_at_zero(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        assert outcomes(registry) == {
+            "promoted": 0.0, "rolled_back": 0.0, "aborted": 0.0}
+
+    def test_good_rollout_walks_the_ladder_and_promotes(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        new = bump(cluster)
+        assert new != old
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: (revs(cluster)["phase"] == T.PHASE_IDLE
+                          and revs(cluster)["current"] == new
+                          and len(pod_revs(cluster)) == 2))
+        rev = revs(cluster)
+        assert rev["current"] == rev["target"] == new
+        assert rev["previous"] == old
+        assert rev["aborted"] == "" and not rev["held"]
+        assert set(rev["snapshots"]) == {new}  # pruned to the survivor
+        assert set(pod_revs(cluster).values()) == {new}
+        assert outcomes(registry) == {
+            "promoted": 1.0, "rolled_back": 0.0, "aborted": 0.0}
+        evs = event_counts(cluster)
+        for reason in ("RolloutStarted", "RolloutAnalyzing",
+                       "RolloutStepAdvanced", "RolloutPromoting",
+                       "RolloutPromoted"):
+            assert evs.get(reason, 0) >= 1, (reason, evs)
+        assert "RolloutAborted" not in evs
+
+    def test_record_first_status_lands_before_any_pod_moves(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        mark = len(cluster._history)
+        new = bump(cluster)
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: revs(cluster)["phase"] == T.PHASE_IDLE
+                 and revs(cluster)["current"] == new)
+        tail = [ev for _, ev in list(cluster._history)[mark:]]
+
+        def first(pred):
+            return next(i for i, ev in enumerate(tail) if pred(ev.object))
+
+        recorded = first(
+            lambda o: o.get("kind") == T.KIND
+            and ((o.get("status") or {}).get("revisions") or {})
+            .get("target") == new)
+        pod_moved = first(
+            lambda o: o.get("kind") == "Pod"
+            and ((ob.meta(o).get("labels") or {})
+                 .get(T.LABEL_REVISION) == new
+                 or ob.annotations_of(o).get(T.ANNOTATION_CORDON)
+                 == "true"))
+        assert recorded < pod_moved
+
+    def test_interrupted_rollout_resumes_idempotently(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        new = bump(cluster)
+        drain(ctl, kubelet, rounds=2)   # surge pod up, analysis open
+        assert revs(cluster)["phase"] in (T.PHASE_SURGE, T.PHASE_ANALYZE)
+        # "controller crash": a brand-new reconciler over the same
+        # cluster — status.revisions + pod labels ARE the machine state
+        sig = RegistrySignals(registry)
+        ctl2 = seed_controller(build_controller(
+            cluster, record_events=True, registry=registry, signals=sig,
+            clock=clock))
+        converge(cluster, ctl2, kubelet, clock, registry,
+                 lambda: (revs(cluster)["phase"] == T.PHASE_IDLE
+                          and revs(cluster)["current"] == new
+                          and len(pod_revs(cluster)) == 2))
+        assert set(pod_revs(cluster).values()) == {new}
+        assert revs(cluster)["previous"] == old
+        assert outcomes(registry)["promoted"] == 1.0
+
+    def test_failed_analysis_rolls_back_and_abort_is_sticky(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world(
+            analysis=lambda *a: False)
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        new = bump(cluster)
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: (revs(cluster)["phase"] == T.PHASE_IDLE
+                          and revs(cluster)["current"] == old
+                          and len(pod_revs(cluster)) == 2))
+        rev = revs(cluster)
+        assert rev["current"] == rev["target"] == old
+        assert rev["aborted"] == new
+        assert set(pod_revs(cluster).values()) == {old}
+        assert outcomes(registry) == {
+            "promoted": 0.0, "rolled_back": 1.0, "aborted": 1.0}
+        evs = event_counts(cluster)
+        assert evs.get("RolloutAborted") == 1
+        assert evs.get("RolloutRolledBack") == 1
+        # sticky: the aborted revision is NOT retried while the spec
+        # still hashes to it — no new rollout, no extra outcomes
+        for _ in range(3):
+            clock.advance(20.0)
+            drain(ctl, kubelet)
+        assert revs(cluster)["phase"] == T.PHASE_IDLE
+        assert event_counts(cluster).get("RolloutStarted") == 1
+        assert outcomes(registry)["aborted"] == 1.0
+        # a NEW spec revision clears the pin and rolls out again (and,
+        # with the gate still failing, aborts again — pinning v3 now)
+        third = bump(cluster, ref="gpt-125m-v3")
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: revs(cluster)["aborted"] == third)
+        assert event_counts(cluster).get("RolloutStarted") == 2
+
+    def test_auto_rollback_off_holds_at_the_failed_step(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world(
+            analysis=lambda *a: False, autoRollback=False)
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        new = bump(cluster)
+        drain(ctl, kubelet, rounds=2)
+        rev = revs(cluster)
+        assert rev["phase"] == T.PHASE_ANALYZE and rev["held"]
+        assert rev["target"] == new
+        # frozen: windows elapsing do not advance the ladder, the audit
+        # trail fired exactly once, old capacity still serves
+        for _ in range(3):
+            clock.advance(20.0)
+            drain(ctl, kubelet)
+        rev = revs(cluster)
+        assert rev["phase"] == T.PHASE_ANALYZE and rev["step"] == 0
+        assert outcomes(registry) == {
+            "promoted": 0.0, "rolled_back": 0.0, "aborted": 1.0}
+        assert event_counts(cluster).get("RolloutAborted") == 1
+        pr = pod_revs(cluster)
+        assert pr[rep(0)] == pr[rep(1)] == old   # base untouched
+        assert pr[rep(2)] == new                 # canary held in place
+
+    def test_mid_rollout_spec_revert_retargets_to_previous(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        bump(cluster)
+        drain(ctl, kubelet, rounds=2)
+        assert revs(cluster)["phase"] in (T.PHASE_SURGE, T.PHASE_ANALYZE)
+        # operator re-edits the spec back: rollback IS a rollout whose
+        # target is the previous revision
+        assert bump(cluster, ref="gpt-125m") == old
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: (revs(cluster)["phase"] == T.PHASE_IDLE
+                          and len(pod_revs(cluster)) == 2))
+        assert revs(cluster)["current"] == old
+        assert set(pod_revs(cluster).values()) == {old}
+
+
+# -- durable drain grace ------------------------------------------------------
+
+
+class TestDurableDrain:
+    def _scaledown_world(self):
+        """signals=None (the production run_controller wiring): drains
+        are paced by the grace deadline, not a router gauge."""
+        clock = ManualClock()
+        cluster = FakeCluster()
+        ctl = seed_controller(build_controller(cluster, clock=clock))
+        kubelet = FakeKubelet(cluster)
+        cluster.create(T.new_jaxservice("chat", model="gpt-125m",
+                                        min_replicas=2, max_replicas=2))
+        drain(ctl, kubelet)
+        svc = get_svc(cluster)
+        svc["spec"]["replicas"] = {"min": 1, "max": 1}
+        cluster.update(svc)
+        drain(ctl, kubelet)
+        return clock, cluster, ctl, kubelet
+
+    def test_cordon_stamps_the_drain_deadline(self):
+        clock, cluster, ctl, kubelet = self._scaledown_world()
+        pod = cluster.get("v1", "Pod", rep(1), "default")
+        ann = ob.annotations_of(pod)
+        assert ann[T.ANNOTATION_CORDON] == "true"
+        assert ann[T.ANNOTATION_DRAIN_DEADLINE] == \
+            f"{T.DEFAULT_DRAIN_SECONDS:.6f}"  # cordoned at t=0
+
+    def test_controller_restart_resumes_the_countdown(self):
+        clock, cluster, ctl, kubelet = self._scaledown_world()
+        clock.advance(T.DEFAULT_DRAIN_SECONDS - 20.0)
+        # restart: a fresh reconciler has NO in-memory drain timer — an
+        # in-memory-only grace would restart the full 60s here
+        ctl2 = seed_controller(build_controller(cluster, clock=clock))
+        drain(ctl2, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") \
+            is not None
+        clock.advance(25.0)  # past the PERSISTED deadline, not a fresh one
+        drain(ctl2, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") is None
+
+    def test_clock_rebase_falls_back_to_in_memory_grace(self):
+        clock, cluster, ctl, kubelet = self._scaledown_world()
+        # a deadline further out than one full grace can only mean the
+        # controller clock rebased under the annotation
+        cluster.patch(
+            "v1", "Pod", rep(1),
+            {"metadata": {"annotations": {
+                T.ANNOTATION_DRAIN_DEADLINE:
+                    f"{clock() + 10 * T.DEFAULT_DRAIN_SECONDS:.6f}"}}},
+            "default")
+        clock.advance(1.0)
+        drain(ctl, kubelet)  # starts the in-memory fallback timer
+        clock.advance(T.DEFAULT_DRAIN_SECONDS / 2)
+        drain(ctl, kubelet)
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") \
+            is not None
+        clock.advance(T.DEFAULT_DRAIN_SECONDS)
+        drain(ctl, kubelet)  # grace served — NOT held forever
+        assert cluster.get_or_none("v1", "Pod", rep(1), "default") is None
+
+
+# -- the router's canary split ------------------------------------------------
+
+
+def canary_router(seed=0, weight=0.5, canary_state="active"):
+    r = TokenRouter(service="chat", namespace="default",
+                    registry=MetricsRegistry(), prom_sink=False,
+                    tracer=obs_trace.Tracer(), canary_seed=seed,
+                    replica_token_budget=10**6)
+    r.set_members([Member(name="base", revision="vA"),
+                   Member(name="canary", state=canary_state,
+                          revision="vB")],
+                  canary=("vB", weight))
+    return r
+
+
+def served_seq(r, n=32):
+    out = []
+    for _ in range(n):
+        t = r.submit(1)
+        out.append(t.revision)
+        r.complete(t)
+    return out
+
+
+class TestCanarySplit:
+    def test_draw_is_seed_deterministic(self):
+        a = served_seq(canary_router(seed=0))
+        b = served_seq(canary_router(seed=0))
+        c = served_seq(canary_router(seed=1))
+        assert a == b
+        assert a != c
+        assert set(a) == {"vA", "vB"}  # a 0.5 split uses both sides
+
+    def test_weight_extremes(self):
+        assert set(served_seq(canary_router(weight=1.0))) == {"vB"}
+        assert set(served_seq(canary_router(weight=0.0))) == {"vA"}
+
+    def test_preference_is_soft_availability_wins(self):
+        # every draw wants the canary, but it is cordoned: the baseline
+        # serves instead of queueing (a preference, not a partition)
+        r = canary_router(weight=1.0, canary_state="cordoned")
+        assert set(served_seq(r, n=8)) == {"vA"}
+
+    def test_requests_total_carries_the_revision_label(self):
+        r = canary_router(weight=1.0)
+        t = r.submit(1)
+        r.complete(t)
+        text = r.registry.render()
+        assert 'revision="vB"' in text
+
+    def test_endpoints_wire_carries_revision_and_weight(self):
+        cluster, ctl, kubelet, registry, clock = rollout_world()
+        drain(ctl, kubelet)
+        old = revs(cluster)["current"]
+        new = bump(cluster)
+        drain(ctl, kubelet, rounds=2)   # surge up -> Analyze at step 0
+        assert revs(cluster)["phase"] == T.PHASE_ANALYZE
+        eps = parse_endpoints(get_svc(cluster))
+        by_name = {e["name"]: e for e in eps}
+        assert by_name[rep(0)]["revision"] == old
+        assert by_name[rep(2)]["revision"] == new
+        assert by_name[rep(2)]["canary"] == pytest.approx(0.5)
+        assert "canary" not in by_name[rep(0)]
+        router = TokenRouter(service="chat", namespace="default",
+                             registry=registry, prom_sink=False,
+                             tracer=obs_trace.Tracer())
+        router.sync_endpoints(eps)
+        assert router.canary() == (new, 0.5)
+        assert router.members()[rep(2)] == "active"
+        # after promotion the split clears off the wire
+        converge(cluster, ctl, kubelet, clock, registry,
+                 lambda: (revs(cluster)["phase"] == T.PHASE_IDLE
+                          and revs(cluster)["current"] == new
+                          and len(pod_revs(cluster)) == 2))
+        router.sync_endpoints(parse_endpoints(get_svc(cluster)))
+        assert router.canary() is None
+        assert {m.revision for m in router._members.values()} == {new}
+
+
+# -- the canary analysis gate -------------------------------------------------
+
+
+def _counter(store, rev, outcome, pts):
+    for t, v in pts:
+        store.append("router_requests_total",
+                     {"namespace": "default", "service": "chat",
+                      "tenant": "default", "outcome": outcome,
+                      "revision": rev}, v, t)
+
+
+def _buckets(store, rev, le_pts):
+    for le, pts in le_pts.items():
+        for t, v in pts:
+            store.append("router_request_seconds_bucket",
+                         {"namespace": "default", "service": "chat",
+                          "le": le, "revision": rev}, v, t)
+
+
+def _gate(**kw):
+    store = TimeSeriesStore()
+    kw.setdefault("windows_s", (30.0, 120.0))
+    return store, CanaryAnalysis(store, **kw)
+
+
+def _steady(store, rev, rate_per_s, le="0.1", t0=0.0, t1=120.0,
+            outcome="completed"):
+    """A flat request counter + all-latencies-under-``le`` histogram
+    between t0 and t1, sampled every 10s."""
+    n = int((t1 - t0) / 10.0)
+    pts = [(t0 + 10.0 * i, rate_per_s * 10.0 * i) for i in range(n + 1)]
+    _counter(store, rev, outcome, pts)
+    _buckets(store, rev, {le: pts, "1.0": pts, "+Inf": pts})
+
+
+class TestCanaryAnalysis:
+    def test_similar_traffic_is_healthy(self):
+        store, gate = _gate()
+        _steady(store, "vA", 2.0)
+        _steady(store, "vB", 2.0)
+        assert gate("default", "chat", "vA", "vB", 120.0) is True
+        assert gate.last["windows"][0]["bad"] is False
+
+    def test_tenfold_latency_canary_is_unhealthy(self):
+        store, gate = _gate(max_latency_ratio=2.0)
+        _steady(store, "vA", 2.0, le="0.1")
+        # canary: same volume, zero errors, but every request lands in
+        # the (0.1, 1.0] bucket — q95 ~10x the baseline's
+        n = 12
+        pts = [(10.0 * i, 20.0 * i) for i in range(n + 1)]
+        _counter(store, "vB", "completed", pts)
+        zero = [(t, 0.0) for t, _ in pts]
+        _buckets(store, "vB", {"0.1": zero, "1.0": pts, "+Inf": pts})
+        assert gate("default", "chat", "vA", "vB", 120.0) is False
+        for w in gate.last["windows"]:
+            assert w["latency_bad"] is True and not w["error_bad"]
+
+    def test_error_storm_canary_is_unhealthy(self):
+        store, gate = _gate()
+        _steady(store, "vA", 2.0)
+        _steady(store, "vB", 1.0)
+        _steady(store, "vB", 1.0, outcome="failed")  # 50% error rate
+        assert gate("default", "chat", "vA", "vB", 120.0) is False
+        for w in gate.last["windows"]:
+            assert w["error_bad"] is True
+
+    def test_low_volume_is_inconclusive_and_healthy(self):
+        store, gate = _gate(min_requests=5.0)
+        _steady(store, "vA", 2.0)
+        _counter(store, "vB", "failed", [(0.0, 0.0), (119.0, 2.0)])
+        assert gate("default", "chat", "vA", "vB", 120.0) is True
+        for w in gate.last["windows"]:
+            assert w["inconclusive"] is True
+
+    def test_one_bad_window_is_not_enough(self):
+        # a burst of canary errors confined to the SHORT window: the
+        # long window dilutes below the absolute floor, so the verdict
+        # is healthy — both windows must agree before an abort
+        store, gate = _gate(min_error_rate=0.05)
+        _steady(store, "vA", 2.0)
+        good = [(10.0 * i, 10.0 * i) for i in range(13)]
+        _counter(store, "vB", "completed", good)
+        _buckets(store, "vB", {"0.1": good, "1.0": good, "+Inf": good})
+        _counter(store, "vB", "failed",
+                 [(0.0, 0.0), (95.0, 0.0), (119.0, 4.0)])
+        assert gate("default", "chat", "vA", "vB", 120.0) is True
+        short, long_ = gate.last["windows"]
+        assert short["bad"] is True
+        assert long_["bad"] is False
+
+    def test_trivial_inputs_are_healthy(self):
+        store, gate = _gate()
+        assert gate("default", "chat", "", "vB", 0.0) is True
+        assert gate("default", "chat", "vA", "vA", 0.0) is True
+
+
+# -- chaos: interrupted rollbacks converge ------------------------------------
+
+
+def _chaos_rollout_world(seed):
+    clock = ManualClock()
+    inner = FakeCluster()
+    chaos = ChaosClient(inner, ChaosPolicy(seed=seed, rate=CHAOS_RATE,
+                                           watch_drop_every=25),
+                        always_on=False)
+    registry = MetricsRegistry()
+    ctl = arm_controller(seed_controller(build_controller(
+        chaos, record_events=True, registry=registry,
+        signals=RegistrySignals(registry), clock=clock,
+        rollout_analysis=lambda *a: False)), chaos)
+    ctl.CONFLICT_RETRY = (0, 0)
+    ctl.RETRY_BASE = 0.0
+    kubelet = FakeKubelet(inner)
+    svc = T.new_jaxservice("chat", model="gpt-125m",
+                           min_replicas=2, max_replicas=2)
+    svc["spec"]["rollout"] = dict(ROLLOUT)
+    inner.create(svc)
+    return inner, chaos, ctl, registry, kubelet, clock
+
+
+@pytest.mark.chaos
+def test_interrupted_rollback_converges_under_chaos():
+    """The ISSUE 20 chaos drill: a rollout whose canary always fails
+    analysis, under armed apiserver faults, with the controller REBUILT
+    mid-rollback. Hard invariants on every seed: capacity never
+    oversubscribed and no orphaned surge replicas at the end. The full
+    convergence (fleet back on the old revision, machine Idle) must
+    hold on at least two CHAOS_SEEDS."""
+    converged = 0
+    for seed in CHAOS_SEEDS:
+        inner, chaos, ctl, registry, kubelet, clock = \
+            _chaos_rollout_world(seed)
+        drain(ctl, kubelet)
+        old = T.revisions_status(
+            inner.get(T.API_VERSION, T.KIND, "chat", "default"))["current"]
+        svc = inner.get(T.API_VERSION, T.KIND, "chat", "default")
+        svc["spec"]["model"]["ref"] = "gpt-125m-v2"
+        inner.update(svc)
+        peak = 0
+        interrupted = False
+        for _ in range(40):
+            drain(ctl, kubelet, rounds=2)
+            peak = max(peak, len(inner.list("v1", "Pod",
+                                            namespace="default")))
+            rev = T.revisions_status(
+                inner.get(T.API_VERSION, T.KIND, "chat", "default"))
+            if not interrupted and rev["aborted"]:
+                # mid-rollback controller crash: fresh reconciler, same
+                # chaos client, no in-memory state
+                ctl = arm_controller(seed_controller(build_controller(
+                    chaos, record_events=True, registry=registry,
+                    signals=RegistrySignals(registry), clock=clock,
+                    rollout_analysis=lambda *a: False)), chaos)
+                ctl.CONFLICT_RETRY = (0, 0)
+                ctl.RETRY_BASE = 0.0
+                interrupted = True
+            pods = {ob.meta(p)["name"]: (ob.meta(p).get("labels") or {})
+                    .get(T.LABEL_REVISION, "")
+                    for p in inner.list("v1", "Pod", namespace="default")}
+            if interrupted and rev["phase"] == T.PHASE_IDLE \
+                    and rev["current"] == old \
+                    and set(pods) == {rep(0), rep(1)} \
+                    and set(pods.values()) == {old}:
+                converged += 1
+                break
+            clock.advance(2.0)
+        # hard invariants, every seed, converged or not
+        assert peak <= 3, f"seed {seed}: capacity oversubscribed ({peak})"
+        final = [ob.meta(p)["name"]
+                 for p in inner.list("v1", "Pod", namespace="default")]
+        assert rep(2) not in final or not interrupted or \
+            T.revisions_status(inner.get(
+                T.API_VERSION, T.KIND, "chat",
+                "default"))["phase"] != T.PHASE_IDLE, \
+            f"seed {seed}: orphaned surge replica {final}"
+    assert converged >= 2, \
+        f"only {converged}/{len(CHAOS_SEEDS)} seeds converged"
+
+
+# -- the banked benchmark stays meaningful ------------------------------------
+
+
+@pytest.mark.usefixtures("virtual_time_guard")
+class TestRolloutBenchContract:
+    @staticmethod
+    def _bench():
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(here, "tools"))
+        try:
+            import rollout_bench as rb
+        finally:
+            sys.path.pop(0)
+        return rb
+
+    def test_banked_results_satisfy_acceptance(self):
+        """BENCH_ROLLOUT_r01.json is the PR's acceptance artifact: the
+        good drill promotes with zero drops, the bad drill auto-rolls
+        back in-window with the critical band's goodput intact."""
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        with open(os.path.join(here, "BENCH_ROLLOUT_r01.json")) as fh:
+            banked = json.load(fh)
+        for cfg in ("full", "smoke"):
+            good, bad = banked[cfg]["good"], banked[cfg]["bad"]
+            assert good["outcomes"] == {
+                "promoted": 1.0, "rolled_back": 0.0, "aborted": 0.0}
+            assert good["final"]["current"] == good["new_rev"]
+            assert bad["outcomes"] == {
+                "promoted": 0.0, "rolled_back": 1.0, "aborted": 1.0}
+            assert bad["final"]["current"] == bad["old_rev"]
+            assert bad["final"]["aborted"] == bad["new_rev"]
+            for drill in (good, bad):
+                assert all(v == 0 for v in drill["drops"].values())
+                bands = drill["bands"]
+                assert bands["critical"]["completed"] == \
+                    bands["critical"]["submitted"]
+                assert drill["max_pods"] <= 4  # 3 replicas + maxSurge 1
+
+    def test_double_run_is_byte_identical(self):
+        rb = self._bench()
+        a = rb.run_bench(**rb.SMOKE_CONFIG)
+        b = rb.run_bench(**rb.SMOKE_CONFIG)
+        a.pop("machine"), b.pop("machine")
+        assert json.dumps(a, sort_keys=True) == \
+            json.dumps(b, sort_keys=True)
+
+    def test_check_green_against_committed_bank(self):
+        rb = self._bench()
+        assert rb.check_against(rb.DEFAULT_OUT) == 0
+
+    def test_check_fails_on_poisoned_bank(self, tmp_path):
+        rb = self._bench()
+        with open(rb.DEFAULT_OUT) as fh:
+            bank = json.load(fh)
+        bank["smoke"]["decision_fingerprint"] = "0" * 64
+        poisoned = tmp_path / "bank.json"
+        poisoned.write_text(json.dumps(bank))
+        assert rb.check_against(str(poisoned)) == 1

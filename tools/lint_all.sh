@@ -15,8 +15,8 @@
 #                                # vs the committed baseline
 #   tools/lint_all.sh --bench    # decision ratchet: rerun every banked
 #                                # bench smoke config (sched / serve /
-#                                # obs / mslice / heal / chargeback
-#                                # --check) and fail on
+#                                # obs / mslice / heal / chargeback /
+#                                # rollout --check) and fail on
 #                                # fingerprint/op-count drift
 #
 # --sarif-dir DIR (before the mode argument) writes one SARIF artifact
@@ -147,7 +147,7 @@ EOF
     # are 3x-budgeted so a loaded CI box cannot flake this tier.
     rc=0
     for bench in sched_bench serve_bench obs_bench mslice_bench \
-            heal_bench chargeback_bench; do
+            heal_bench chargeback_bench rollout_bench; do
         echo "== $bench --check"
         JAX_PLATFORMS=cpu "$PY" "tools/$bench.py" --check || rc=1
     done

@@ -1,0 +1,414 @@
+#!/usr/bin/env python
+"""rollout_bench — deterministic virtual-time rollout drill.
+
+Builds the REAL rollout plane on one virtual clock — the JAXService
+controller (surge -> canary-analyze -> promote | rollback state
+machine) over a FakeCluster, a revision-aware TokenRouter fed from the
+controller's endpoints annotation, and a FleetPlane scraping the shared
+registry with the default + canary rule packs — then runs two drills:
+
+- **good**: a spec edit rolls out a healthy revision. The canary walks
+  the weight ladder, every analysis window passes, the base fleet is
+  replaced surge-by-surge, and the rollout PROMOTES — with zero request
+  drops in any band.
+- **bad**: the new revision serves at 10x latency. The store-backed
+  ``CanaryAnalysis`` gate (canary latency-quantile vs baseline,
+  multi-window) flunks it inside the FIRST analysis window; the
+  controller auto-rolls back, the fleet converges on the previous
+  revision, and critical-band goodput is held (zero drops).
+
+Both drills log every decision — rollout phase transitions, Rollout*
+events, ``jaxservice_rollouts_total`` outcomes, final pod revisions,
+per-band drop counts — and the bench fingerprints the combined log.
+Correctness is asserted, not eyeballed: a promote that drops requests,
+a bad canary that reaches Promote, or a rollback that leaves a pod on
+the bad revision raises.
+
+    python tools/rollout_bench.py          # full + smoke, write JSON
+    python tools/rollout_bench.py --check  # CI gate: rerun the banked
+        # smoke config; fail when the decision fingerprint, outcomes or
+        # final revisions drift, or control p99 regresses past 3x budget
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeflow_tpu.control.jaxservice import types as T  # noqa: E402
+from kubeflow_tpu.control.jaxservice.controller import (  # noqa: E402
+    build_controller,
+)
+from kubeflow_tpu.control.k8s.fake import FakeCluster  # noqa: E402
+from kubeflow_tpu.control.k8s.kubelet import FakeKubelet  # noqa: E402
+from kubeflow_tpu.control.runtime import seed_controller  # noqa: E402
+from kubeflow_tpu.obs.plane import FleetPlane  # noqa: E402
+from kubeflow_tpu.obs.rules import (  # noqa: E402
+    CanaryAnalysis, canary_rule_pack, default_rule_pack,
+)
+from kubeflow_tpu.obs.tsdb import RegistryTarget  # noqa: E402
+from kubeflow_tpu.runtime.metrics import MetricsRegistry  # noqa: E402
+from kubeflow_tpu.serving.router import (  # noqa: E402
+    BAND_CRITICAL, BAND_DEFAULT, RegistrySignals, TokenRouter,
+    parse_endpoints,
+)
+
+DEFAULT_OUT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "BENCH_ROLLOUT_r01.json")
+
+CYCLE_S = 5.0
+SERVICE = "chat"
+NAMESPACE = "default"
+REPLICAS = 3
+# the rollout knobs under test: one surge slot, capacity never dips,
+# a two-step ladder, and a window short enough that the FULL drill
+# walks the whole machine inside its cycle budget
+ROLLOUT_SPEC = {"maxSurge": 1, "maxUnavailable": 0,
+                "canarySteps": [0.3, 1.0],
+                "analysisWindowSeconds": 15.0, "autoRollback": True}
+# traffic per cycle: enough canary volume at weight 0.3 that the
+# analysis gate's min-request floor is conclusive by the second cycle
+TRAFFIC = ((BAND_CRITICAL, 5), (BAND_DEFAULT, 15))
+BAD_LATENCY_X = 10.0
+
+
+class ManualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    xs = sorted(samples)
+    return xs[min(len(xs) - 1, int(math.ceil(q * len(xs))) - 1)]
+
+
+def build_world(clock: ManualClock) -> dict:
+    cluster = FakeCluster(history_limit=65536)
+    registry = MetricsRegistry()
+    signals = RegistrySignals(registry)
+    plane = FleetPlane(
+        registry=MetricsRegistry(),
+        targets=[RegistryTarget("fleet", registry,
+                                labels={"job": "serving"})],
+        rules=default_rule_pack() + canary_rule_pack(),
+        interval_s=CYCLE_S, clock=clock,
+        max_points=512, max_series=20000)
+    # the SLO gate reads canary-vs-baseline straight from the plane's
+    # store; windows sized to the scrape cadence so the short window
+    # holds two samples and the long one the whole canary history
+    analysis = CanaryAnalysis(
+        plane.store, windows_s=(10.0, 25.0), min_requests=4.0,
+        max_latency_ratio=3.0)
+    ctl = seed_controller(build_controller(
+        cluster, record_events=True, registry=registry, signals=signals,
+        clock=clock, rollout_analysis=analysis))
+    kubelet = FakeKubelet(cluster)
+    router = TokenRouter(
+        service=SERVICE, namespace=NAMESPACE, clock=clock,
+        registry=registry, prom_sink=False,
+        max_queue=4096, replica_token_budget=100000)
+    svc = T.new_jaxservice(SERVICE, model="gpt-125m",
+                           min_replicas=REPLICAS, max_replicas=REPLICAS)
+    svc["spec"]["rollout"] = dict(ROLLOUT_SPEC)
+    cluster.create(svc)
+    return {"cluster": cluster, "ctl": ctl, "kubelet": kubelet,
+            "router": router, "registry": registry, "plane": plane}
+
+
+def control_tick(world: dict, rounds: int = 4) -> None:
+    for _ in range(rounds):
+        if world["ctl"].run_until_idle(max_rounds=1000,
+                                       advance_delayed=True) == 0:
+            break
+        world["kubelet"].step()
+
+
+def _service(world: dict) -> dict:
+    return world["cluster"].get(T.API_VERSION, T.KIND, SERVICE, NAMESPACE)
+
+
+def _sync_router(world: dict) -> None:
+    world["router"].sync_endpoints(parse_endpoints(_service(world)))
+
+
+def _stage_traffic(world: dict, clock: ManualClock, rng: random.Random,
+                   bad_rev: str, bands: dict) -> None:
+    """One cycle of synchronous traffic. Latency is drawn per request
+    and multiplied when the serving replica runs the bad revision —
+    tickets complete in latency order on the shared clock, so the
+    histogram sees exactly the per-revision distributions the analysis
+    gate must tell apart."""
+    router: TokenRouter = world["router"]
+    plan: list[tuple[float, int, object, str]] = []
+    for band, count in TRAFFIC:
+        for _ in range(count):
+            base = rng.uniform(0.05, 0.12)
+            # the plan list owns every ticket from submit to complete
+            plan.append((base, len(plan), router.submit(40, band=band),
+                         band))
+            bands[band]["submitted"] += 1
+    scored = [(base * BAD_LATENCY_X
+               if bad_rev and t.revision == bad_rev else base, seq, t, band)
+              for base, seq, t, band in plan]
+    elapsed = 0.0
+    for lat, _seq, t, band in sorted(scored, key=lambda p: (p[0], p[1])):
+        clock.advance(lat - elapsed)
+        elapsed = lat
+        router.complete(t)
+        bands[band]["completed"] += 1
+
+
+def _pod_revisions(world: dict) -> list[list[str]]:
+    out = []
+    for pod in world["cluster"].list(
+            "v1", "Pod", namespace=NAMESPACE,
+            label_selector={T.LABEL_SERVICE_NAME: SERVICE}):
+        out.append([pod["metadata"]["name"],
+                    (pod["metadata"].get("labels") or {})
+                    .get(T.LABEL_REVISION, "")])
+    return sorted(out)
+
+
+def _rollout_events(world: dict) -> list[list]:
+    out = []
+    for e in world["cluster"].list("v1", "Event", namespace=NAMESPACE):
+        reason = e.get("reason", "")
+        if reason.startswith("Rollout") or reason == "ReplicaCordoned" \
+                or reason == "ReplicaRemoved":
+            out.append([reason, e.get("message", ""),
+                        int(e.get("count", 1))])
+    return sorted(out)
+
+
+def _outcomes(world: dict) -> dict:
+    out = {o: 0.0 for o in T.ROLLOUT_OUTCOMES}
+    for labels, value in world["registry"].series(
+            "jaxservice_rollouts_total"):
+        if labels.get("service") == SERVICE:
+            out[labels["outcome"]] = out.get(labels["outcome"], 0) + value
+    return {k: round(v, 6) for k, v in sorted(out.items())}
+
+
+def run_drill(kind: str, cycles: int, seed: int,
+              rollout_at: int) -> dict:
+    """One drill on a fresh world: ``kind`` is "good" (healthy new
+    revision -> promote) or "bad" (10x-latency canary -> auto
+    rollback)."""
+    clock = ManualClock()
+    rng = random.Random(seed)
+    world = build_world(clock)
+    control_tick(world, rounds=6)  # settle: provision the base fleet
+    old_rev = T.revisions_status(_service(world))["current"]
+
+    bands = {band: {"submitted": 0, "completed": 0}
+             for band, _ in TRAFFIC}
+    phase_log: list[list] = []
+    control_ms: list[float] = []
+    max_pods = 0
+    new_rev = ""
+    analyze_at = abort_at = None
+    for cycle in range(cycles):
+        cycle_start = clock.t
+        if cycle == rollout_at:
+            svc = _service(world)
+            svc["spec"]["model"]["ref"] = "gpt-125m-v2"
+            world["cluster"].update(svc)
+            new_rev = T.revision_hash(svc["spec"])
+        _sync_router(world)
+        bad_rev = new_rev if kind == "bad" else ""
+        _stage_traffic(world, clock, rng, bad_rev, bands)
+        world["plane"].tick(at=clock.t)
+        t0 = time.perf_counter()
+        control_tick(world)
+        control_ms.append((time.perf_counter() - t0) * 1e3)
+        rev = T.revisions_status(_service(world))
+        entry = [cycle, rev["phase"], rev["step"], rev["target"]]
+        if not phase_log or phase_log[-1][1:] != entry[1:]:
+            phase_log.append(entry)
+            if rev["phase"] == T.PHASE_ANALYZE and analyze_at is None:
+                analyze_at = clock.t
+        # Rollback drains instantly here (in-flight is zero between
+        # cycles), so the phase flashes through inside one control tick
+        # — the abort moment is read off the outcome counter instead
+        if abort_at is None and _outcomes(world)["aborted"] >= 1:
+            abort_at = clock.t
+        max_pods = max(max_pods, len(_pod_revisions(world)))
+        clock.advance(CYCLE_S - (clock.t - cycle_start))
+
+    rev = T.revisions_status(_service(world))
+    pods = _pod_revisions(world)
+    outcomes = _outcomes(world)
+    drops = {band: c["submitted"] - c["completed"]
+             for band, c in sorted(bands.items())}
+
+    # -- the drill's reason to exist: assert, don't eyeball ------------------
+    assert new_rev and new_rev != old_rev, "spec edit did not re-hash"
+    assert max_pods <= REPLICAS + ROLLOUT_SPEC["maxSurge"], \
+        f"capacity oversubscribed: {max_pods} pods"
+    assert drops[BAND_CRITICAL] == 0, \
+        f"critical-band drops: {drops[BAND_CRITICAL]}"
+    if kind == "good":
+        assert outcomes == {"aborted": 0.0, "promoted": 1.0,
+                            "rolled_back": 0.0}, outcomes
+        assert rev["current"] == new_rev and rev["phase"] == T.PHASE_IDLE
+        assert all(r == new_rev for _, r in pods), pods
+        assert all(d == 0 for d in drops.values()), drops
+    else:
+        assert outcomes == {"aborted": 1.0, "promoted": 0.0,
+                            "rolled_back": 1.0}, outcomes
+        assert rev["current"] == old_rev and rev["aborted"] == new_rev
+        assert rev["phase"] == T.PHASE_IDLE
+        assert not any(r == new_rev for _, r in pods), pods
+        assert all(d == 0 for d in drops.values()), drops
+        # "inside the analysis window": the gate flunked the canary
+        # before the ladder ever advanced past its first step
+        assert abort_at is not None and analyze_at is not None
+        window = ROLLOUT_SPEC["analysisWindowSeconds"]
+        assert abort_at - analyze_at <= window + CYCLE_S, \
+            f"rollback {abort_at - analyze_at:.1f}s after analyze " \
+            f"opened (window {window}s)"
+        assert not any(p[1] == T.PHASE_PROMOTE for p in phase_log) \
+            and max((p[2] for p in phase_log
+                     if p[1] == T.PHASE_ANALYZE), default=0) == 0, \
+            "bad canary advanced the ladder before the gate caught it"
+    assert len(pods) == REPLICAS, pods
+
+    return {
+        "kind": kind,
+        "old_rev": old_rev,
+        "new_rev": new_rev,
+        "phases": phase_log,
+        "events": _rollout_events(world),
+        "outcomes": outcomes,
+        "final": {"current": rev["current"], "previous": rev["previous"],
+                  "aborted": rev["aborted"], "phase": rev["phase"]},
+        "pods": pods,
+        "bands": {b: dict(sorted(c.items()))
+                  for b, c in sorted(bands.items())},
+        "drops": drops,
+        "max_pods": max_pods,
+        "control_ms": control_ms,
+    }
+
+
+def run_bench(cycles: int, seed: int = 0, rollout_at: int = 4) -> dict:
+    good = run_drill("good", cycles, seed, rollout_at)
+    bad = run_drill("bad", cycles, seed, rollout_at)
+    control_ms = good.pop("control_ms") + bad.pop("control_ms")
+    decision_log = json.dumps({"good": good, "bad": bad}, sort_keys=True)
+    return {
+        "config": {"cycles": cycles, "seed": seed,
+                   "rollout_at": rollout_at},
+        "good": good,
+        "bad": bad,
+        "decision_fingerprint": hashlib.sha256(
+            decision_log.encode()).hexdigest(),
+        # wall-clock timings live apart from the deterministic body so
+        # a double-run byte-compares everything else
+        "machine": {
+            "control_p50_ms": round(_percentile(control_ms, 0.50), 3),
+            "control_p99_ms": round(_percentile(control_ms, 0.99), 3),
+        },
+    }
+
+
+# FULL walks the whole good-rollout ladder with idle margin on both
+# sides; SMOKE is the CI-gate config — the minimum cycles that still
+# promote the good revision and roll back the bad one.
+FULL_CONFIG = {"cycles": 24, "seed": 0, "rollout_at": 4}
+SMOKE_CONFIG = {"cycles": 16, "seed": 0, "rollout_at": 3}
+
+
+def check_against(banked_path: str) -> int:
+    """CI ratchet: rerun the banked smoke config. Fail (1) when the
+    decision fingerprint, the rollout outcomes, the final revision
+    state or the drop counts drift (the machine DECIDED differently on
+    identical input), or when control p99 regresses past 3x the
+    committed budget (floored at 250 ms so CI contention cannot flake
+    the gate)."""
+    with open(banked_path) as fh:
+        banked = json.load(fh)
+    smoke = banked.get("smoke")
+    if not smoke:
+        print(f"check: no smoke section in {banked_path}",
+              file=sys.stderr)
+        return 2
+    now = run_bench(**smoke["config"])
+    ok = True
+    if now["decision_fingerprint"] != smoke["decision_fingerprint"]:
+        print("check: decision fingerprint drifted "
+              f"({now['decision_fingerprint'][:12]} != banked "
+              f"{smoke['decision_fingerprint'][:12]}) — the rollout "
+              "machine decided differently on identical input",
+              file=sys.stderr)
+        ok = False
+    for drill in ("good", "bad"):
+        for key in ("outcomes", "final", "pods", "drops", "phases"):
+            if now[drill][key] != smoke[drill][key]:
+                print(f"check: {drill}.{key} {now[drill][key]!r} != "
+                      f"banked {smoke[drill][key]!r}", file=sys.stderr)
+                ok = False
+    budget = max(smoke["machine"]["control_p99_ms"] * 3.0, 250.0)
+    if now["machine"]["control_p99_ms"] > budget:
+        print(f"check: control_p99_ms {now['machine']['control_p99_ms']}"
+              f" exceeds budget {budget:.3f} (banked "
+              f"{smoke['machine']['control_p99_ms']})", file=sys.stderr)
+        ok = False
+    print(json.dumps({"check": "ok" if ok else "REGRESSED",
+                      "control_p99_ms": now["machine"]["control_p99_ms"],
+                      "fingerprint": now["decision_fingerprint"][:12]},
+                     indent=2))
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--cycles", type=int, default=None)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=DEFAULT_OUT)
+    ap.add_argument("--no-smoke", action="store_true")
+    ap.add_argument("--check", action="store_true",
+                    help="rerun the banked smoke config and gate on "
+                         "fingerprint/outcome/revision drift or a "
+                         ">3x p99 budget regression")
+    args = ap.parse_args(argv)
+    if args.check:
+        return check_against(args.out)
+
+    config = dict(FULL_CONFIG, seed=args.seed)
+    if args.cycles:
+        config["cycles"] = args.cycles
+    full = run_bench(**config)
+    result = {"bench": "rollout_bench", "round": "r01", "full": full}
+    if not args.no_smoke:
+        result["smoke"] = run_bench(**SMOKE_CONFIG)
+    with open(args.out, "w") as fh:
+        json.dump(result, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    print(json.dumps({
+        "out": args.out,
+        "good": full["good"]["outcomes"],
+        "bad": full["bad"]["outcomes"],
+        "bad_final": full["bad"]["final"],
+        "control_p99_ms": full["machine"]["control_p99_ms"]}, indent=2))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
